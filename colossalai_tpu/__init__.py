@@ -1,0 +1,30 @@
+"""colossalai_tpu: a TPU-native distributed training & inference framework.
+
+Capability surface of hpcaitech/ColossalAI, rebuilt idiomatically on
+JAX/XLA/Pallas: a Booster training API over composable parallelism plugins
+(ZeRO data parallel, Gemini-style fully-sharded + offload, tensor parallel via
+per-architecture sharding policies, pipeline schedules, four sequence-parallel
+modes, expert parallelism), all expressed as GSPMD shardings and jax.lax
+collectives over a named ICI/DCN device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .accelerator import get_accelerator, set_accelerator
+from .cluster import DistCoordinator
+from .device import DeviceMesh, MeshConfig, create_device_mesh
+from .initialize import launch, launch_from_env
+from .logging import get_dist_logger
+
+__all__ = [
+    "__version__",
+    "get_accelerator",
+    "set_accelerator",
+    "DistCoordinator",
+    "DeviceMesh",
+    "MeshConfig",
+    "create_device_mesh",
+    "launch",
+    "launch_from_env",
+    "get_dist_logger",
+]

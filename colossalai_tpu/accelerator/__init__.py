@@ -1,0 +1,15 @@
+from .api import auto_set_accelerator, get_accelerator, set_accelerator
+from .base_accelerator import BaseAccelerator
+from .cpu_accelerator import CpuAccelerator, GpuAccelerator
+from .tpu_accelerator import AxonAccelerator, TpuAccelerator
+
+__all__ = [
+    "auto_set_accelerator",
+    "get_accelerator",
+    "set_accelerator",
+    "BaseAccelerator",
+    "CpuAccelerator",
+    "GpuAccelerator",
+    "TpuAccelerator",
+    "AxonAccelerator",
+]

@@ -1,0 +1,51 @@
+"""Accelerator registry + auto-detection.
+
+Analog of ``colossalai/accelerator/api.py:19-60`` (auto-detect order
+cuda→npu→cpu becomes tpu→axon→gpu→cpu).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .base_accelerator import BaseAccelerator
+from .cpu_accelerator import CpuAccelerator, GpuAccelerator
+from .tpu_accelerator import AxonAccelerator, TpuAccelerator
+
+_ACCELERATORS = {
+    "tpu": TpuAccelerator,
+    "axon": AxonAccelerator,
+    "gpu": GpuAccelerator,
+    "cpu": CpuAccelerator,
+}
+
+_DETECT_ORDER = ["tpu", "axon", "gpu", "cpu"]
+
+_CURRENT: Optional[BaseAccelerator] = None
+
+
+def set_accelerator(name: str) -> BaseAccelerator:
+    global _CURRENT
+    if name not in _ACCELERATORS:
+        raise ValueError(f"unknown accelerator {name!r}; choose from {sorted(_ACCELERATORS)}")
+    _CURRENT = _ACCELERATORS[name]()
+    return _CURRENT
+
+
+def auto_set_accelerator() -> BaseAccelerator:
+    global _CURRENT
+    platforms = {d.platform for d in jax.devices()}
+    for name in _DETECT_ORDER:
+        if _ACCELERATORS[name].platform in platforms:
+            _CURRENT = _ACCELERATORS[name]()
+            return _CURRENT
+    _CURRENT = CpuAccelerator()
+    return _CURRENT
+
+
+def get_accelerator() -> BaseAccelerator:
+    if _CURRENT is None:
+        return auto_set_accelerator()
+    return _CURRENT

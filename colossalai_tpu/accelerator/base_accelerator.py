@@ -1,0 +1,95 @@
+"""Accelerator abstraction.
+
+TPU-native analog of the reference's ``BaseAccelerator`` ABC
+(``colossalai/accelerator/base_accelerator.py:11``). The reference abstracts
+torch.cuda / torch_npu / cpu behind ~40 imperative methods (streams, events,
+RNG state, memory stats). Under JAX most of that is the runtime's job, so this
+facade is a thin, functional surface: device enumeration, platform capability
+flags (preferred matmul dtype, HBM size), memory stats, and RNG seeding.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class BaseAccelerator(ABC):
+    """Platform facade over a class of JAX devices."""
+
+    #: platform string as reported by ``jax.devices()[i].platform``
+    platform: str = ""
+    #: human-readable backend name
+    name: str = ""
+    #: communication fabric riding under collectives ("ici" on TPU, "host" on CPU)
+    communication_backend: str = ""
+
+    # ---------------------------------------------------------------- devices
+    def devices(self) -> List[jax.Device]:
+        try:
+            return jax.devices(self.platform)
+        except RuntimeError:
+            return []
+
+    def local_devices(self) -> List[jax.Device]:
+        return [d for d in self.devices() if d.process_index == jax.process_index()]
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def local_device_count(self) -> int:
+        return len(self.local_devices())
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    def current_device(self) -> jax.Device:
+        local = self.local_devices()
+        if not local:
+            raise RuntimeError(f"no local {self.platform!r} devices available")
+        return local[0]
+
+    def synchronize(self) -> None:
+        """Block until all outstanding async dispatches complete."""
+        (jnp.zeros(()) + 0).block_until_ready()
+
+    # ------------------------------------------------------------------- rng
+    def seed(self, seed: int) -> jax.Array:
+        """Return a root PRNG key. JAX RNG is functional: no global state."""
+        return jax.random.PRNGKey(seed)
+
+    # --------------------------------------------------------------- numerics
+    @abstractmethod
+    def preferred_matmul_dtype(self) -> jnp.dtype:
+        """Dtype that maps the platform's matrix unit best (bf16 on MXU)."""
+
+    @abstractmethod
+    def hbm_bytes_per_device(self) -> Optional[int]:
+        """Usable accelerator memory per device, None if unknown."""
+
+    # ----------------------------------------------------------------- memory
+    def memory_stats(self, device: Optional[jax.Device] = None) -> Dict[str, Any]:
+        device = device or self.current_device()
+        stats = getattr(device, "memory_stats", None)
+        if stats is None:
+            return {}
+        try:
+            return dict(stats() or {})
+        except Exception:
+            return {}
+
+    def max_memory_allocated(self, device: Optional[jax.Device] = None) -> int:
+        return int(self.memory_stats(device).get("peak_bytes_in_use", 0))
+
+    def memory_allocated(self, device: Optional[jax.Device] = None) -> int:
+        return int(self.memory_stats(device).get("bytes_in_use", 0))
+
+    def empty_cache(self) -> None:
+        """Drop JAX's jitted-computation caches (used between tests)."""
+        jax.clear_caches()
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"{type(self).__name__}(devices={self.device_count()})"

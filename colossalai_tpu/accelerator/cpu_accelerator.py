@@ -1,0 +1,38 @@
+"""CPU accelerator backend (analog of CpuAccelerator,
+``colossalai/accelerator/cpu_accelerator.py``). Used for tests with
+``--xla_force_host_platform_device_count=N`` virtual-device meshes."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .base_accelerator import BaseAccelerator
+
+
+class CpuAccelerator(BaseAccelerator):
+    platform = "cpu"
+    name = "cpu"
+    communication_backend = "host"
+
+    def preferred_matmul_dtype(self) -> jnp.dtype:
+        return jnp.float32
+
+    def hbm_bytes_per_device(self) -> Optional[int]:
+        return None
+
+
+class GpuAccelerator(BaseAccelerator):
+    """JAX-on-GPU backend, for completeness of the registry."""
+
+    platform = "gpu"
+    name = "gpu"
+    communication_backend = "nccl"
+
+    def preferred_matmul_dtype(self) -> jnp.dtype:
+        return jnp.bfloat16
+
+    def hbm_bytes_per_device(self) -> Optional[int]:
+        stats = self.memory_stats()
+        return int(stats["bytes_limit"]) if "bytes_limit" in stats else None

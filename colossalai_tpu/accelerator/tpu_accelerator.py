@@ -1,0 +1,47 @@
+"""TPU accelerator backend (analog of CudaAccelerator,
+``colossalai/accelerator/cuda_accelerator.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base_accelerator import BaseAccelerator
+
+# Known HBM capacities (bytes) by TPU generation keyword. Used as a fallback
+# when the runtime does not expose memory_stats.
+_TPU_HBM = {
+    "v6": 32 * 1024**3,
+    "v5p": 95 * 1024**3,
+    "v5": 16 * 1024**3,  # v5e
+    "v4": 32 * 1024**3,
+    "v3": 16 * 1024**3,
+    "v2": 8 * 1024**3,
+}
+
+
+class TpuAccelerator(BaseAccelerator):
+    platform = "tpu"
+    name = "tpu"
+    communication_backend = "ici"
+
+    def preferred_matmul_dtype(self) -> jnp.dtype:
+        return jnp.bfloat16
+
+    def hbm_bytes_per_device(self) -> Optional[int]:
+        stats = self.memory_stats()
+        if "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+        kind = getattr(self.current_device(), "device_kind", "").lower()
+        for key, size in _TPU_HBM.items():
+            if key in kind:
+                return size
+        return None
+
+class AxonAccelerator(TpuAccelerator):
+    """TPU reached through an 'axon' tunnel platform (single remote chip)."""
+
+    platform = "axon"
+    name = "axon-tpu"

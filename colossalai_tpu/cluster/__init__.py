@@ -1,0 +1,3 @@
+from .dist_coordinator import DistCoordinator, SingletonMeta
+
+__all__ = ["DistCoordinator", "SingletonMeta"]

@@ -1,0 +1,86 @@
+"""Process-level coordination helpers.
+
+Analog of ``colossalai/cluster/dist_coordinator.py:11-200``. In JAX's
+multi-controller model every host runs the same program, so "rank" here is
+``jax.process_index()`` (one per host, not per chip).
+"""
+
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+class SingletonMeta(type):
+    _instances: dict = {}
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            cls._instances[cls] = super().__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+
+class DistCoordinator(metaclass=SingletonMeta):
+    """Singleton helpers over jax process topology."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    def is_master(self) -> bool:
+        return self.rank == 0
+
+    def print_on_master(self, *args: Any, **kwargs: Any) -> None:
+        if self.is_master():
+            print(*args, **kwargs)
+
+    def on_master_only(self, func: Callable) -> Callable:
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if self.is_master():
+                return func(*args, **kwargs)
+            return None
+
+        return wrapper
+
+    def block_all(self) -> None:
+        """Barrier across all processes (collective over all devices)."""
+        if self.world_size > 1:
+            # A tiny psum over every device acts as a global barrier.
+            x = jax.numpy.zeros((jax.local_device_count(),))
+            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+
+    @contextmanager
+    def priority_execution(self):
+        """Master executes the body first; the rest follow after the barrier.
+
+        Useful for download-then-load-from-cache patterns
+        (≙ ``dist_coordinator.py`` priority_execution).
+        """
+        if not self.is_master():
+            self.block_all()
+        try:
+            yield
+        finally:
+            if self.is_master():
+                self.block_all()
+
+    def all_mean(self, value: float) -> float:
+        """Mean of a python scalar across processes (host-level metric sync)."""
+        if self.world_size == 1:
+            return float(value)
+        arr = jax.numpy.full((jax.local_device_count(),), value / jax.local_device_count())
+        out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(arr)
+        return float(np.asarray(out)[0]) / self.world_size

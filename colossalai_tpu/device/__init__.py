@@ -1,0 +1,3 @@
+from .device_mesh import DATA_AXES, MESH_AXES, DeviceMesh, MeshConfig, create_device_mesh
+
+__all__ = ["DATA_AXES", "MESH_AXES", "DeviceMesh", "MeshConfig", "create_device_mesh"]

@@ -1,0 +1,157 @@
+"""Named device mesh for hybrid parallelism.
+
+TPU-native replacement for the reference's ``ProcessGroupMesh``
+(``colossalai/cluster/process_group_mesh.py:25``) and ``DeviceMesh``
+(``colossalai/device/device_mesh.py:22``). Where the reference lazily creates
+NCCL process groups along axes of a cartesian rank grid, here a single
+``jax.sharding.Mesh`` with named logical axes is the only communication
+object: collectives are inserted by XLA from sharding annotations (GSPMD) or
+written explicitly with ``jax.lax`` primitives inside ``shard_map``.
+
+Canonical axis order (outermost → innermost): ``dp, pp, ep, sp, tp``.
+- ``tp`` innermost: tensor-parallel collectives are per-layer and latency
+  bound → nearest ICI neighbours.
+- ``sp`` next: ring/all-to-all sequence parallelism rides ICI.
+- ``ep`` sits *inside* dp: for MoE, the data axis is split dp = moe_dp × ep;
+  dense params sync over (dp, ep) while experts shard over ep
+  (≙ ``moe_hybrid_parallel_plugin.py:281-286``).
+- ``dp`` outermost: gradient all-reduce tolerates DCN latency across hosts.
+
+Axes of size 1 are kept in the mesh so PartitionSpecs stay uniform across
+parallel configurations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+#: canonical mesh axis names, outermost first
+MESH_AXES: Tuple[str, ...] = ("dp", "pp", "ep", "sp", "tp")
+
+#: the composite data axis used for batch sharding / gradient sync.
+#: ep divides the data axis (moe_dp = dp, experts = ep).
+DATA_AXES: Tuple[str, ...] = ("dp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of each logical axis. ``dp=-1`` means "fill remaining devices"."""
+
+    dp: int = -1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.pp * self.ep * self.sp * self.tp
+        dp = self.dp
+        if dp == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by pp*ep*sp*tp={fixed}"
+                )
+            dp = n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{self.pp}x{self.ep}x{self.sp}x{self.tp} != {n_devices} devices"
+            )
+        return dataclasses.replace(self, dp=dp)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "ep": self.ep, "sp": self.sp, "tp": self.tp}
+
+
+class DeviceMesh:
+    """A named ``jax.sharding.Mesh`` plus axis bookkeeping helpers."""
+
+    def __init__(
+        self,
+        config: MeshConfig,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        explicit = devices is not None
+        devices = list(devices if devices is not None else jax.devices())
+        self.config = config.resolve(len(devices))
+        sizes = self.config.axis_sizes()
+        shape = tuple(sizes[a] for a in MESH_AXES)
+        if explicit:
+            dev_array = np.asarray(devices).reshape(shape)
+        else:
+            # Topology-aware assignment: innermost axes (tp, sp) land on
+            # ICI-adjacent chips; outermost (dp) may span DCN.
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        self.mesh = Mesh(dev_array, MESH_AXES)
+
+    # ------------------------------------------------------------------ sizes
+    def size(self, axis: str) -> int:
+        if axis == "data":
+            return math.prod(self.mesh.shape[a] for a in DATA_AXES)
+        return self.mesh.shape[axis]
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    @property
+    def dp_size(self) -> int:
+        return self.size("data")
+
+    @property
+    def tp_size(self) -> int:
+        return self.size("tp")
+
+    @property
+    def pp_size(self) -> int:
+        return self.size("pp")
+
+    @property
+    def sp_size(self) -> int:
+        return self.size("sp")
+
+    @property
+    def ep_size(self) -> int:
+        return self.size("ep")
+
+    # -------------------------------------------------------------- shardings
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding from a PartitionSpec-like tuple."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def batch_spec(self, extra_seq_axis: bool = False) -> PartitionSpec:
+        """Data-batch PartitionSpec: batch over (dp, ep)[, seq over sp]."""
+        if extra_seq_axis:
+            return PartitionSpec(DATA_AXES, "sp")
+        return PartitionSpec(DATA_AXES)
+
+    def __enter__(self):
+        self._ctx = self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self.mesh.__exit__(*exc)
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"DeviceMesh({dict(self.mesh.shape)})"
+
+
+def create_device_mesh(
+    dp: int = -1,
+    pp: int = 1,
+    ep: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> DeviceMesh:
+    return DeviceMesh(MeshConfig(dp=dp, pp=pp, ep=ep, sp=sp, tp=tp), devices=devices)

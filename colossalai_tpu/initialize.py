@@ -1,0 +1,91 @@
+"""Launcher: bring up the (possibly multi-host) JAX runtime.
+
+Analog of ``colossalai.launch`` (``colossalai/initialize.py:20-185``). The
+reference initializes a torch.distributed TCP rendezvous; the JAX equivalent
+is ``jax.distributed.initialize`` for multi-host, and a no-op on one host.
+Seeding returns a functional PRNG key instead of mutating global state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .accelerator import get_accelerator
+from .logging import get_dist_logger
+
+_LAUNCHED = False
+_DIST_INITIALIZED = False
+
+
+def launch(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+    seed: int = 1024,
+    verbose: bool = True,
+) -> jax.Array:
+    """Initialize the distributed runtime and return the root PRNG key.
+
+    On a single host this only selects the accelerator and seeds. On multiple
+    hosts it joins the JAX coordination service (GRPC rendezvous, the analog
+    of the reference's ``dist.init_process_group`` at ``initialize.py:59``).
+    """
+    global _LAUNCHED, _DIST_INITIALIZED
+    if coordinator_address is not None and not _DIST_INITIALIZED:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        _DIST_INITIALIZED = True
+    _LAUNCHED = True
+    acc = get_accelerator()
+    if verbose:
+        logger = get_dist_logger()
+        logger.info(
+            f"launched: platform={acc.name} devices={acc.device_count()} "
+            f"processes={jax.process_count()}",
+            ranks=[0],
+        )
+    return acc.seed(seed)
+
+
+def launch_from_env(seed: int = 1024, verbose: bool = True) -> jax.Array:
+    """Launch using standard cluster env vars.
+
+    Reads ``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` (set by
+    our CLI) or falls back to JAX's own autodetection (GKE, Cloud TPU VMs,
+    SLURM are auto-detected by ``jax.distributed.initialize`` with no args).
+    Analog of ``launch_from_torch/slurm/openmpi``.
+    """
+    addr = os.environ.get("COORDINATOR_ADDRESS")
+    if addr is not None:
+        missing = [k for k in ("NUM_PROCESSES", "PROCESS_ID") if k not in os.environ]
+        if missing:
+            raise RuntimeError(
+                f"COORDINATOR_ADDRESS is set but {missing} are not; all three env "
+                "vars are required for explicit multi-host launch"
+            )
+        return launch(
+            coordinator_address=addr,
+            num_processes=int(os.environ["NUM_PROCESSES"]),
+            process_id=int(os.environ["PROCESS_ID"]),
+            seed=seed,
+            verbose=verbose,
+        )
+    # Single-host or auto-detectable environment.
+    global _DIST_INITIALIZED
+    if not _DIST_INITIALIZED and any(
+        k in os.environ for k in ("MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES")
+    ):
+        try:
+            jax.distributed.initialize()
+            _DIST_INITIALIZED = True
+        except Exception as e:  # pragma: no cover - env specific
+            get_dist_logger().warning(f"jax.distributed.initialize failed: {e}")
+    return launch(seed=seed, verbose=verbose)

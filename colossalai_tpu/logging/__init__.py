@@ -1,0 +1,3 @@
+from .logger import DistributedLogger, get_dist_logger
+
+__all__ = ["DistributedLogger", "get_dist_logger"]

@@ -1,0 +1,68 @@
+"""Distributed logger with per-rank filtering.
+
+TPU-native analog of the reference's ``DistributedLogger``
+(``colossalai/logging/logger.py:178``): same surface (``info(msg, ranks=[0])``)
+but "rank" is the JAX process index (multi-controller), not a torch.distributed
+rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import List, Optional
+
+_LOGGERS = {}
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class DistributedLogger:
+    """Logger that can restrict emission to a subset of process ranks."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._logger = logging.getLogger(name)
+        if not self._logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter(_FORMAT))
+            self._logger.addHandler(handler)
+            self._logger.setLevel(logging.INFO)
+            self._logger.propagate = False
+
+    def set_level(self, level: str) -> None:
+        self._logger.setLevel(getattr(logging, level.upper()))
+
+    def _should_log(self, ranks: Optional[List[int]]) -> bool:
+        return ranks is None or _process_index() in ranks
+
+    def _log(self, level: str, message: str, ranks: Optional[List[int]] = None) -> None:
+        if self._should_log(ranks):
+            getattr(self._logger, level)(message)
+
+    def info(self, message: str, ranks: Optional[List[int]] = None) -> None:
+        self._log("info", message, ranks)
+
+    def warning(self, message: str, ranks: Optional[List[int]] = None) -> None:
+        self._log("warning", message, ranks)
+
+    def error(self, message: str, ranks: Optional[List[int]] = None) -> None:
+        self._log("error", message, ranks)
+
+    def debug(self, message: str, ranks: Optional[List[int]] = None) -> None:
+        self._log("debug", message, ranks)
+
+
+def get_dist_logger(name: str = "colossalai_tpu") -> DistributedLogger:
+    if name not in _LOGGERS:
+        _LOGGERS[name] = DistributedLogger(name)
+    return _LOGGERS[name]

@@ -1,0 +1,41 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's spawn-real-processes pattern
+(``colossalai/testing/utils.py:229``) in the JAX way: one process, 8 XLA host
+devices, real collectives over them. Must set flags before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# jax may already be imported (site customization) with another platform
+# pinned; config.update before first backend use still wins.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    # ≙ reference tests/conftest.py clearing accelerator cache per test.
+    yield
+    from colossalai_tpu.accelerator import api
+
+    api._CURRENT = None
+
+
+@pytest.fixture
+def mesh8():
+    from colossalai_tpu.device import create_device_mesh
+
+    return create_device_mesh(dp=2, tp=2, sp=2)
+
+
+def pytest_configure(config):
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"

@@ -1,0 +1,30 @@
+import jax.numpy as jnp
+
+from colossalai_tpu.accelerator import CpuAccelerator, get_accelerator, set_accelerator
+
+
+def test_auto_detect_cpu():
+    acc = get_accelerator()
+    assert acc.platform == "cpu"
+    assert acc.device_count() >= 8
+
+
+def test_set_accelerator():
+    acc = set_accelerator("cpu")
+    assert isinstance(acc, CpuAccelerator)
+    assert acc.preferred_matmul_dtype() == jnp.float32
+
+
+def test_seed_key():
+    key = get_accelerator().seed(0)
+    assert key.shape == (2,) or key.dtype.name.startswith("key")
+
+
+def test_coordinator():
+    from colossalai_tpu.cluster import DistCoordinator
+
+    c = DistCoordinator()
+    assert c.rank == 0
+    assert c.is_master()
+    c.block_all()
+    assert abs(c.all_mean(3.0) - 3.0) < 1e-6

@@ -1,0 +1,58 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from colossalai_tpu.device import MESH_AXES, MeshConfig, create_device_mesh
+
+
+def test_mesh_axes_and_sizes():
+    m = create_device_mesh(dp=2, tp=2, sp=2)
+    assert m.n_devices == 8
+    assert m.dp_size == 2
+    assert m.tp_size == 2
+    assert m.sp_size == 2
+    assert m.pp_size == 1
+    assert tuple(m.mesh.axis_names) == MESH_AXES
+
+
+def test_mesh_dp_fill():
+    m = create_device_mesh(tp=4)
+    assert m.dp_size == 2
+    assert m.n_devices == 8
+
+
+def test_mesh_invalid_sizes():
+    with pytest.raises(ValueError):
+        create_device_mesh(dp=3, tp=3)
+    with pytest.raises(ValueError):
+        create_device_mesh(tp=3)
+
+
+def test_ep_divides_data_axis():
+    m = create_device_mesh(dp=2, ep=2, tp=2)
+    # data axis = dp*ep
+    assert m.dp_size == 4
+    assert m.ep_size == 2
+
+
+def test_sharded_matmul_runs():
+    m = create_device_mesh(dp=2, tp=2, sp=2)
+    x = jnp.ones((8, 16), jnp.float32)
+    w = jnp.ones((16, 32), jnp.float32)
+    xs = jax.device_put(x, m.sharding(("dp", "ep"), None))
+    ws = jax.device_put(w, m.sharding(None, "tp"))
+
+    @jax.jit
+    def f(x, w):
+        return x @ w
+
+    out = f(xs, ws)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 32), 16.0))
+
+
+def test_batch_spec():
+    m = create_device_mesh(dp=2, tp=2, sp=2)
+    assert m.batch_spec() == PartitionSpec(("dp", "ep"))
+    assert m.batch_spec(extra_seq_axis=True) == PartitionSpec(("dp", "ep"), "sp")
