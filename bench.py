@@ -1,0 +1,104 @@
+"""Benchmark: LLaMA-style pretraining step throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = measured MFU / 0.45 (the BASELINE.json north-star MFU for
+Llama-3-8B on v5p; no published TPU baseline exists in the reference).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from colossalai_tpu.booster import Booster, HybridParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.utils import (
+    PerformanceEvaluator,
+    causal_lm_flops_per_token,
+    count_params,
+    peak_flops_per_device,
+)
+
+TARGET_MFU = 0.45
+
+
+def pick_config(hbm_bytes: int) -> tuple:
+    """Size the model to the chip: ~0.5B for 16G (v5e), ~2B for 95G (v5p)."""
+    if hbm_bytes >= 64 * 1024**3:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+            num_hidden_layers=20, num_attention_heads=20, num_key_value_heads=4,
+            dtype=jnp.bfloat16, remat=True,
+        )
+        bs, seq = 8, 4096
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
+            dtype=jnp.bfloat16, remat=True,
+        )
+        bs, seq = 4, 2048
+    return cfg, bs, seq
+
+
+def main():
+    n_dev = len(jax.devices())
+    from colossalai_tpu.accelerator import get_accelerator
+
+    hbm = get_accelerator().hbm_bytes_per_device() or 16 * 1024**3
+    cfg, bs, seq = pick_config(hbm)
+
+    plugin = HybridParallelPlugin(zero_stage=1 if n_dev > 1 else 0, precision="bf16")
+    model = LlamaForCausalLM(cfg)
+    batch = {
+        "input_ids": jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, size=(bs * max(n_dev, 1), seq))
+        )
+    }
+    boosted = Booster(plugin=plugin).boost(
+        model, optax.adamw(3e-4, weight_decay=0.01), example_batch=batch,
+        rng=jax.random.PRNGKey(0),
+    )
+    state = boosted.state
+    n_params = count_params(state.params)
+
+    sharded = boosted.shard_batch(batch)
+    # warmup / compile. NOTE: fetch the scalar, don't block_until_ready — on
+    # tunneled platforms (axon) block_until_ready returns before execution.
+    state, m = boosted.train_step(state, sharded)
+    float(m["loss"])
+
+    evaluator = PerformanceEvaluator(
+        flops_per_token=causal_lm_flops_per_token(
+            n_params, cfg.num_hidden_layers, cfg.hidden_size, seq
+        ),
+        n_devices=n_dev,
+    )
+    steps = 10
+    for _ in range(steps):
+        evaluator.on_step_start()
+        state, m = boosted.train_step(state, sharded)
+        loss = float(m["loss"])  # forces device sync (see warmup note)
+        evaluator.on_step_end(n_tokens=batch["input_ids"].size)
+
+    s = evaluator.summary()
+    result = {
+        "metric": f"llama_{n_params/1e9:.2f}B_pretrain_mfu_bs{bs}_seq{seq}",
+        "value": s["mfu"],
+        "unit": "MFU",
+        "vs_baseline": round(s["mfu"] / TARGET_MFU, 4),
+        "tokens_per_second_per_device": s["tokens_per_second_per_device"],
+        "tflops_per_device": s["tflops_per_device"],
+        "peak_tflops": peak_flops_per_device() / 1e12,
+        "n_devices": n_dev,
+        "loss": round(loss, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

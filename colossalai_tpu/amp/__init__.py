@@ -1,0 +1,3 @@
+from .grad_scaler import GradScalerState, all_finite, init_grad_scaler, unscale, update_scaler
+
+__all__ = ["GradScalerState", "all_finite", "init_grad_scaler", "unscale", "update_scaler"]

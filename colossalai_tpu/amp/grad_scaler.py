@@ -1,0 +1,78 @@
+"""Dynamic loss scaling for fp16 training, as functional state.
+
+≙ reference ``DynamicGradScaler`` (``amp/naive_amp/grad_scaler/
+dynamic_grad_scaler.py:15``) and the FP16MixedPrecisionMixin overflow logic:
+inf/nan scan over grads, hysteresis, growth/backoff. Here the scaler is a
+pytree carried in the train state so the whole step stays inside one jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class GradScalerState:
+    scale: jax.Array  # f32 scalar
+    growth_counter: jax.Array  # i32 scalar
+    hysteresis_counter: jax.Array  # i32 scalar
+    growth_factor: float = flax.struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = flax.struct.field(pytree_node=False, default=0.5)
+    growth_interval: int = flax.struct.field(pytree_node=False, default=1000)
+    hysteresis: int = flax.struct.field(pytree_node=False, default=2)
+    min_scale: float = flax.struct.field(pytree_node=False, default=1.0)
+    max_scale: float = flax.struct.field(pytree_node=False, default=2.0**24)
+
+
+def init_grad_scaler(
+    initial_scale: float = 2.0**16,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 1000,
+    hysteresis: int = 2,
+) -> GradScalerState:
+    return GradScalerState(
+        scale=jnp.float32(initial_scale),
+        growth_counter=jnp.int32(0),
+        hysteresis_counter=jnp.int32(hysteresis),
+        growth_factor=growth_factor,
+        backoff_factor=backoff_factor,
+        growth_interval=growth_interval,
+        hysteresis=hysteresis,
+    )
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Single fused finite-check over a pytree (≙ multi-tensor inf/nan scan)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.bool_(True)
+    checks = [jnp.isfinite(l).all() for l in leaves]
+    return jnp.stack(checks).all()
+
+
+def unscale(tree: Any, scaler: GradScalerState) -> Any:
+    inv = 1.0 / scaler.scale
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), tree)
+
+
+def update_scaler(scaler: GradScalerState, is_finite: jax.Array) -> GradScalerState:
+    """Growth on a clean streak, backoff (with hysteresis) on overflow."""
+    new_growth = jnp.where(is_finite, scaler.growth_counter + 1, 0)
+    hit_interval = new_growth >= scaler.growth_interval
+    grown = jnp.minimum(scaler.scale * scaler.growth_factor, scaler.max_scale)
+
+    new_hyst = jnp.where(is_finite, scaler.hysteresis_counter, scaler.hysteresis_counter - 1)
+    do_backoff = (~is_finite) & (new_hyst <= 0)
+    backed = jnp.maximum(scaler.scale * scaler.backoff_factor, scaler.min_scale)
+
+    scale = jnp.where(do_backoff, backed, jnp.where(is_finite & hit_interval, grown, scaler.scale))
+    return scaler.replace(
+        scale=scale,
+        growth_counter=jnp.where(hit_interval, 0, new_growth),
+        hysteresis_counter=jnp.where(do_backoff | is_finite, scaler.hysteresis, new_hyst),
+    )

@@ -1,0 +1,19 @@
+from .booster import Booster
+from .plugin.plugin_base import Boosted, Plugin, TrainState
+from .plugin.plugins import (
+    DataParallelPlugin,
+    GeminiPlugin,
+    HybridParallelPlugin,
+    LowLevelZeroPlugin,
+)
+
+__all__ = [
+    "Booster",
+    "Boosted",
+    "Plugin",
+    "TrainState",
+    "DataParallelPlugin",
+    "GeminiPlugin",
+    "HybridParallelPlugin",
+    "LowLevelZeroPlugin",
+]

@@ -1,0 +1,63 @@
+"""Booster: the single training entry point.
+
+≙ reference ``Booster`` (``booster/booster.py:33``). ``boost()`` delegates to
+the plugin's ``configure`` and returns a ``Boosted`` bundle whose
+``train_step`` is one fused jit (forward, backward, grad sync, optimizer
+update) — the reference's separate ``backward()``/``optimizer.step()`` calls
+collapse into it, which is exactly what lets XLA overlap compute with
+collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import optax
+
+from colossalai_tpu.shardformer.policies.base_policy import Policy
+
+from .plugin.plugin_base import Boosted, Plugin, TrainState
+from .plugin.plugins import DataParallelPlugin
+
+
+class Booster:
+    def __init__(self, plugin: Optional[Plugin] = None):
+        self.plugin = plugin if plugin is not None else DataParallelPlugin()
+
+    def boost(
+        self,
+        model: Any,
+        optimizer: optax.GradientTransformation,
+        loss_fn: Optional[Callable] = None,
+        example_batch: Optional[Dict[str, Any]] = None,
+        rng: Optional[jax.Array] = None,
+        policy: Optional[Policy] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> Boosted:
+        """Wrap model + optimizer into a sharded, compiled training bundle."""
+        return self.plugin.configure(
+            model=model,
+            optimizer=optimizer,
+            loss_fn=loss_fn,
+            example_batch=example_batch,
+            rng=rng,
+            policy=policy,
+            devices=devices,
+        )
+
+    # Checkpoint entry points (≙ booster/booster.py:121-124)
+    def save_model(self, boosted: Boosted, path: str, **kw) -> None:
+        raise NotImplementedError(
+            "checkpoint_io lands in a later milestone; "
+            "use orbax/flax.serialization on boosted.state.params meanwhile"
+        )
+
+    def load_model(self, boosted: Boosted, path: str, **kw) -> TrainState:
+        raise NotImplementedError(
+            "checkpoint_io lands in a later milestone; "
+            "use orbax/flax.serialization on boosted.state.params meanwhile"
+        )
+
+
+__all__ = ["Booster", "Boosted", "TrainState"]

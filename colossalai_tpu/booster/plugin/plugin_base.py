@@ -1,0 +1,343 @@
+"""Plugin base + the shared GSPMD configure core.
+
+≙ reference ``booster/plugin/plugin_base.py`` + the parallel wiring inside
+``hybrid_parallel_plugin.py:1285`` (configure). All dense-model plugins share
+one core here: build a mesh, derive param PartitionSpecs from the policy,
+derive optimizer-state specs (ZeRO), compile a donated train_step with
+explicit in/out shardings. Subclasses choose the mesh shape and flags.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from colossalai_tpu.amp import (
+    GradScalerState,
+    all_finite,
+    init_grad_scaler,
+    unscale,
+    update_scaler,
+)
+from colossalai_tpu.device import DeviceMesh, create_device_mesh
+from colossalai_tpu.shardformer.layer.loss import causal_lm_loss
+from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
+from colossalai_tpu.shardformer.policies.base_policy import (
+    Policy,
+    path_str,
+    tree_add_data_axis,
+)
+from colossalai_tpu.tensor import use_mesh
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Functional train state: the unit every plugin shards and every
+    checkpoint serializes. ≙ (model, optimizer, scaler) triple the reference
+    Booster returns from ``boost()``."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    scaler: Optional[GradScalerState] = None
+
+
+@dataclasses.dataclass
+class Boosted:
+    """What ``Booster.boost`` hands back."""
+
+    state: TrainState
+    train_step: Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]
+    eval_step: Callable[[TrainState, Dict[str, jax.Array]], Dict]
+    apply_fn: Callable
+    mesh: DeviceMesh
+    state_shardings: Any
+    param_specs: Any
+    plugin: "Plugin"
+    model: Any = None
+
+    def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
+        """Place a host batch onto the mesh with the data-parallel layout."""
+        sharding = self.mesh.sharding(*self.mesh.batch_spec())
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+
+
+class Plugin(abc.ABC):
+    """Capability flags ≙ reference Plugin (control_precision etc. collapse
+    into: every plugin controls precision/sharding/checkpoint here)."""
+
+    precision: str = "fp32"
+    support_no_sync: bool = False
+
+    @abc.abstractmethod
+    def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
+        ...
+
+    # flags read by the configure core
+    zero_stage: int = 0
+    fsdp: bool = False
+    max_norm: float = 0.0
+    grad_accum_steps: int = 1
+
+    def modify_model(self, model):
+        """Hook for plugins to adjust the module (e.g. attention impl)."""
+        return model
+
+    # ------------------------------------------------------------- configure
+    def configure(
+        self,
+        model: Any,
+        optimizer: optax.GradientTransformation,
+        loss_fn: Optional[Callable] = None,
+        example_batch: Optional[Dict[str, Any]] = None,
+        rng: Optional[jax.Array] = None,
+        policy: Optional[Policy] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> Boosted:
+        if example_batch is None:
+            raise ValueError("configure() needs example_batch to trace shapes")
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        loss_fn = loss_fn or (lambda out, batch: causal_lm_loss(out.logits, batch["input_ids"]))
+        mesh = self.build_mesh(devices)
+        model = _apply_precision(model, self.precision)
+        model = self.modify_model(model)
+
+        if policy is None:
+            try:
+                policy = get_autopolicy(model)
+            except KeyError:
+                policy = Policy(rules=[])  # replicate everything but ZeRO/FSDP
+
+        if self.max_norm and self.max_norm > 0:
+            optimizer = optax.chain(optax.clip_by_global_norm(self.max_norm), optimizer)
+        if self.grad_accum_steps > 1:
+            optimizer = optax.MultiSteps(optimizer, every_k_schedule=self.grad_accum_steps)
+
+        example_inputs = _model_inputs(example_batch)
+
+        # ---- abstract shapes → shardings (nothing materializes here)
+        params_shape = jax.eval_shape(lambda r: model.init(r, **example_inputs), rng)
+        param_specs = policy.param_specs(params_shape["params"])
+        if self.fsdp:
+            param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh.dp_size)
+        param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh.mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+        opt_state_shape = jax.eval_shape(optimizer.init, params_shape["params"])
+        opt_specs = _opt_state_specs(
+            opt_state_shape,
+            params_shape["params"],
+            param_specs,
+            mesh,
+            shard_over_data=(self.zero_stage >= 1 and not self.fsdp),
+        )
+        opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh.mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+        scaler = init_grad_scaler() if self.precision == "fp16" else None
+        replicated = NamedSharding(mesh.mesh, PartitionSpec())
+        state_shardings = TrainState(
+            step=replicated,
+            params=param_shardings,
+            opt_state=opt_shardings,
+            scaler=None if scaler is None else jax.tree.map(lambda _: replicated, scaler),
+        )
+
+        # ---- materialize state directly into its sharded layout
+        # (≙ LazyInitContext + sharder materialize: params are never built
+        # unsharded on one device)
+        def _init_state(rng):
+            variables = model.init(rng, **example_inputs)
+            params = variables["params"]
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=optimizer.init(params),
+                scaler=scaler,
+            )
+
+        with use_mesh(mesh):
+            state = jax.jit(_init_state, out_shardings=state_shardings)(rng)
+
+        grad_shardings = None
+        if self.zero_stage >= 2 and not self.fsdp:
+            grad_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh.dp_size)
+            grad_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh.mesh, s), grad_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+
+        train_step = self._build_train_step(
+            model, optimizer, loss_fn, mesh, state_shardings, grad_shardings
+        )
+        eval_step = self._build_eval_step(model, loss_fn, mesh, state_shardings)
+
+        return Boosted(
+            state=state,
+            train_step=train_step,
+            eval_step=eval_step,
+            apply_fn=model.apply,
+            mesh=mesh,
+            state_shardings=state_shardings,
+            param_specs=param_specs,
+            plugin=self,
+            model=model,
+        )
+
+    # ------------------------------------------------------------ train step
+    def _build_train_step(self, model, optimizer, loss_fn, mesh, state_shardings, grad_shardings=None):
+        batch_sharding = mesh.sharding(*mesh.batch_spec())
+        precision = self.precision
+
+        def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+            inputs = _model_inputs(batch)
+
+            def compute_loss(params):
+                out = model.apply({"params": params}, **inputs)
+                loss = loss_fn(out, batch)
+                if precision == "fp16":
+                    return loss * state.scaler.scale, loss
+                return loss, loss
+
+            grads, loss = jax.grad(compute_loss, has_aux=True)(state.params)
+
+            if grad_shardings is not None:
+                # ZeRO-2: grads take the optimizer-state layout early → XLA
+                # lowers the dp grad psum to reduce-scatter (+all-gather at
+                # consumption), ≙ bucketized reduce-scatter (low_level_optim.py:327)
+                grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+
+            if precision == "fp16":
+                grads = unscale(grads, state.scaler)
+                finite = all_finite(grads)
+                safe_grads = jax.tree.map(lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+                updates, new_opt = optimizer.update(safe_grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
+                # overflow step: keep old params/opt state
+                new_params = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old), new_params, state.params
+                )
+                new_opt = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old) if new.shape == old.shape else new,
+                    new_opt, state.opt_state,
+                )
+                new_scaler = update_scaler(state.scaler, finite)
+                metrics = {
+                    "loss": loss,
+                    "grad_norm": optax.global_norm(grads),
+                    "loss_scale": state.scaler.scale,
+                    "overflow": (~finite).astype(jnp.float32),
+                }
+                new_state = TrainState(
+                    step=state.step + 1, params=new_params, opt_state=new_opt, scaler=new_scaler
+                )
+            else:
+                updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
+                metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+                new_state = TrainState(
+                    step=state.step + 1, params=new_params, opt_state=new_opt, scaler=None
+                )
+            return new_state, metrics
+
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+        def train_step(state, batch):
+            with use_mesh(mesh):
+                return jitted(state, batch)
+
+        return train_step
+
+    def _build_eval_step(self, model, loss_fn, mesh, state_shardings):
+        batch_sharding = mesh.sharding(*mesh.batch_spec())
+
+        def step_fn(state: TrainState, batch):
+            out = model.apply({"params": state.params}, **_model_inputs(batch))
+            return {"loss": loss_fn(out, batch), "logits": out.logits}
+
+        jitted = jax.jit(step_fn, in_shardings=(state_shardings, batch_sharding))
+
+        def eval_step(state, batch):
+            with use_mesh(mesh):
+                return jitted(state, batch)
+
+        return eval_step
+
+
+# ---------------------------------------------------------------- utilities
+
+_MODEL_INPUT_KEYS = ("input_ids", "positions", "segment_ids")
+
+
+def _model_inputs(batch: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in batch.items() if k in _MODEL_INPUT_KEYS}
+
+
+def _apply_precision(model: Any, precision: str) -> Any:
+    """Rebuild the module with the compute dtype the plugin asks for.
+
+    Params stay fp32 masters (≙ MixedPrecisionOptimizer master weights);
+    flax modules cast per-op via their ``dtype`` attr.
+    """
+    if precision == "fp32" or not hasattr(model, "config"):
+        return model
+    dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16}.get(precision)
+    if dtype is None:
+        raise ValueError(f"unknown precision {precision!r} (fp32|bf16|fp16)")
+    if model.config.dtype == dtype:
+        return model
+    new_cfg = dataclasses.replace(model.config, dtype=dtype)
+    return type(model)(new_cfg)
+
+
+def _opt_state_specs(opt_state_shape, params, param_specs, mesh: DeviceMesh, shard_over_data: bool):
+    """PartitionSpecs for the optimizer state.
+
+    Param-shaped leaves (adam mu/nu, momenta...) inherit the param's spec;
+    with ZeRO-1/2 they additionally shard over the data axis
+    (≙ _create_master_param_current_rank, low_level_optim.py:263).
+    Scalar leaves (count) replicate.
+    """
+    param_spec_by_path: Dict[str, PartitionSpec] = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_flatten_with_path(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )[0]
+    shapes_by_path = {path_str(kp): leaf.shape for kp, leaf in flat_p}
+    for (kp, spec), (kp2, _) in zip(flat_s, flat_p):
+        param_spec_by_path[path_str(kp)] = spec
+
+    def spec_for_leaf(keypath, leaf) -> PartitionSpec:
+        path = path_str(keypath)
+        # optax state paths end with the param path; find the longest match
+        best, best_len = None, -1
+        for ppath, spec in param_spec_by_path.items():
+            if path.endswith(ppath) and len(ppath) > best_len and shapes_by_path[ppath] == leaf.shape:
+                best, best_len = spec, len(ppath)
+        if best is None:
+            return PartitionSpec()
+        if shard_over_data:
+            from colossalai_tpu.shardformer.policies.base_policy import add_data_axis
+
+            return add_data_axis(best, leaf.shape, mesh.dp_size)
+        return best
+
+    flat_o = jax.tree_util.tree_flatten_with_path(opt_state_shape)
+    leaves = [spec_for_leaf(kp, leaf) for kp, leaf in flat_o[0]]
+    return jax.tree_util.tree_unflatten(flat_o[1], leaves)

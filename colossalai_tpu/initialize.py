@@ -16,7 +16,6 @@ import jax
 from .accelerator import get_accelerator
 from .logging import get_dist_logger
 
-_LAUNCHED = False
 _DIST_INITIALIZED = False
 
 
@@ -34,7 +33,7 @@ def launch(
     hosts it joins the JAX coordination service (GRPC rendezvous, the analog
     of the reference's ``dist.init_process_group`` at ``initialize.py:59``).
     """
-    global _LAUNCHED, _DIST_INITIALIZED
+    global _DIST_INITIALIZED
     if coordinator_address is not None and not _DIST_INITIALIZED:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -43,7 +42,6 @@ def launch(
             local_device_ids=local_device_ids,
         )
         _DIST_INITIALIZED = True
-    _LAUNCHED = True
     acc = get_accelerator()
     if verbose:
         logger = get_dist_logger()

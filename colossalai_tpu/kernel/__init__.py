@@ -1,0 +1,18 @@
+"""Kernel library: Pallas TPU kernels with XLA fallbacks.
+
+Analog of the reference's ``extensions/`` CUDA kernels + ``kernel_loader``
+(``colossalai/kernel/kernel_loader.py:31``): a loader that returns the best
+available implementation per op. On TPU the "best" path is a Pallas kernel;
+the fallback is plain jnp, which XLA still fuses well.
+"""
+
+from .loader import KernelLoader
+from .ops import flash_attention, fused_rms_norm, fused_softmax, rope_embed
+
+__all__ = [
+    "KernelLoader",
+    "flash_attention",
+    "fused_rms_norm",
+    "fused_softmax",
+    "rope_embed",
+]

@@ -1,0 +1,43 @@
+"""Kernel registry/loader.
+
+≙ ``colossalai/kernel/kernel_loader.py:31-131``: extensions register
+themselves with an availability predicate; ``load()`` returns the first
+available implementation, preferring Pallas on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+
+class KernelLoader:
+    _registry: Dict[str, List[Tuple[str, Callable[[], bool], Callable]]] = {}
+
+    @classmethod
+    def register(cls, op: str, name: str, available: Callable[[], bool], fn: Callable) -> None:
+        cls._registry.setdefault(op, []).append((name, available, fn))
+
+    @classmethod
+    def load(cls, op: str, prefer: Optional[str] = None) -> Callable:
+        impls = cls._registry.get(op, [])
+        if prefer is not None:
+            for name, avail, fn in impls:
+                if name == prefer and avail():
+                    return fn
+        for name, avail, fn in impls:
+            if avail():
+                return fn
+        raise RuntimeError(f"no available implementation for kernel op {op!r}")
+
+    @classmethod
+    def available_impls(cls, op: str) -> List[str]:
+        return [name for name, avail, _ in cls._registry.get(op, []) if avail()]
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False

@@ -1,0 +1,118 @@
+"""Public kernel ops: dispatch to Pallas TPU kernels with jnp fallbacks.
+
+Each op mirrors a CUDA/Triton kernel from the reference inventory
+(SURVEY §2.8); the Pallas implementations live in ``kernel/pallas/``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .loader import KernelLoader, on_tpu
+
+# ----------------------------------------------------------- flash attention
+# ≙ extensions/pybind/flash_attention + flash_decoding_attention_kernel.cu
+
+
+def _flash_attention_xla(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None):
+    from colossalai_tpu.shardformer.layer.attention import xla_attention
+
+    return xla_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
+    )
+
+
+def _flash_attention_pallas(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None):
+    from .pallas.flash_attention import flash_attention as fa
+
+    return fa(q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale)
+
+
+def _pallas_module(name: str):
+    def check() -> bool:
+        if not on_tpu():
+            return False
+        try:
+            __import__(f"colossalai_tpu.kernel.pallas.{name}")
+            return True
+        except ImportError:
+            return False
+
+    return check
+
+
+KernelLoader.register("flash_attention", "pallas", _pallas_module("flash_attention"), _flash_attention_pallas)
+KernelLoader.register("flash_attention", "xla", lambda: True, _flash_attention_xla)
+
+
+def flash_attention(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None):
+    """[B, S, H, D] attention via the best available kernel."""
+    fn = KernelLoader.load("flash_attention")
+    return fn(q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale)
+
+
+# ------------------------------------------------------------------ RMSNorm
+# ≙ rms_layernorm_kernel.cu (348 LoC)
+
+
+def _rms_norm_xla(x, scale, eps: float = 1e-5, residual=None):
+    if residual is not None:
+        x = x + residual
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = (x32 * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+    return (out, x) if residual is not None else out
+
+
+def _rms_norm_pallas(x, scale, eps: float = 1e-5, residual=None):
+    from .pallas.rms_norm import rms_norm as rn
+
+    return rn(x, scale, eps=eps, residual=residual)
+
+
+KernelLoader.register("rms_norm", "pallas", _pallas_module("rms_norm"), _rms_norm_pallas)
+KernelLoader.register("rms_norm", "xla", lambda: True, _rms_norm_xla)
+
+
+def fused_rms_norm(x, scale, eps: float = 1e-5, residual=None):
+    """RMSNorm; with ``residual`` returns (normed, x+residual) like the
+    reference's fused_add_rms_layernorm."""
+    return KernelLoader.load("rms_norm")(x, scale, eps=eps, residual=residual)
+
+
+# ------------------------------------------------------------ fused softmax
+# ≙ scaled_masked_softmax_kernel.cu / scaled_upper_triang_masked_softmax_kernel.cu
+
+
+def fused_softmax(scores, scale: float = 1.0, causal: bool = False, mask=None):
+    s = scores.astype(jnp.float32) * scale
+    if causal:
+        q_len, kv_len = scores.shape[-2:]
+        cm = jnp.arange(q_len)[:, None] >= jnp.arange(kv_len)[None, :]
+        s = jnp.where(cm, s, -1e9)
+    if mask is not None:
+        s = jnp.where(mask, s, -1e9)
+    return jax.nn.softmax(s, axis=-1).astype(scores.dtype)
+
+
+# --------------------------------------------------------------------- RoPE
+# ≙ fused_rotary_emb_and_cache_kernel.cu / get_cos_and_sin_kernel.cu
+
+
+def rope_embed(q, k, positions, theta: float = 10000.0):
+    from colossalai_tpu.models.llama import apply_rope, rope_table
+
+    cos, sin = rope_table(positions, q.shape[-1], theta)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+
+
+# ------------------------------------------------------------- silu_and_mul
+# ≙ activation_kernel.cu
+
+
+def silu_and_mul(gate_up: jax.Array) -> jax.Array:
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return jax.nn.silu(gate) * up

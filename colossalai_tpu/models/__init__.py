@@ -1,0 +1,32 @@
+"""Model registry.
+
+≙ the reference's HF-architecture auto-dispatch (``policies/auto_policy.py:28``,
+73 entries): model names map to (module class, config class) builders.
+"""
+
+from .base import CausalLMOutput, ModelConfig
+from .gpt2 import GPT2Config, GPT2LMHeadModel
+from .llama import LlamaConfig, LlamaForCausalLM
+
+MODEL_REGISTRY = {
+    "llama": (LlamaForCausalLM, LlamaConfig),
+    "gpt2": (GPT2LMHeadModel, GPT2Config),
+}
+
+
+def get_model_cls(name: str):
+    if name not in MODEL_REGISTRY:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}")
+    return MODEL_REGISTRY[name]
+
+
+__all__ = [
+    "CausalLMOutput",
+    "ModelConfig",
+    "GPT2Config",
+    "GPT2LMHeadModel",
+    "LlamaConfig",
+    "LlamaForCausalLM",
+    "MODEL_REGISTRY",
+    "get_model_cls",
+]

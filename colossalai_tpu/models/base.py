@@ -1,0 +1,30 @@
+"""Model base types shared by all architectures."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.struct
+import jax
+
+
+@flax.struct.dataclass
+class CausalLMOutput:
+    logits: jax.Array
+    hidden_states: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class ModelConfig:
+    """Base config. Subclasses add architecture fields; these are the knobs
+    every model shares (computation dtype, remat, scanned layers)."""
+
+    dtype: Any = None  # computation dtype; None = fp32
+    param_dtype: Any = None  # storage dtype; None = fp32
+    remat: bool = False  # jax.checkpoint each block (≙ gradient checkpointing)
+    scan_layers: bool = True  # lax.scan over decoder blocks (fast compiles, PP-friendly)
+    attention_impl: str = "auto"  # see shardformer.layer.attention
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,128 @@
+"""GPT-2 causal LM (flax), the reference's minimum end-to-end example model
+(``examples/language/gpt``; policy ``shardformer/policies/gpt2.py``).
+
+Learned positional embeddings, pre-LN blocks, GELU MLP, tied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+
+from .base import CausalLMOutput, ModelConfig
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class GPT2Config(ModelConfig):
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    embd_dropout: float = 0.0
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def gpt2_125m(cls, **kw) -> "GPT2Config":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        return cls(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128, **kw,
+        )
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        b, s, _ = x.shape
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        reshape = lambda t: t.reshape(b, s, cfg.num_attention_heads, hd)
+        q, k, v = reshape(q), reshape(k), reshape(v)
+        q = constrain(q, ("dp", "ep"), None, "tp", None)
+        attn = dot_product_attention(
+            q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl
+        )
+        attn = attn.reshape(b, s, cfg.hidden_size)
+        attn = nn.Dense(cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="c_proj")(attn)
+        x = x + attn
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ln_2")(x)
+        h = nn.Dense(4 * cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="c_fc")(h)
+        h = nn.gelu(h)
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        h = nn.Dense(cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="mlp_c_proj")(h)
+        return x + h
+
+
+class _ScanBody(nn.Module):
+    config: GPT2Config
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, segment_ids):
+        cls = nn.remat(GPT2Block, prevent_cse=False) if self.remat else GPT2Block
+        return cls(self.config, name="block")(x, segment_ids), None
+
+
+class GPT2LMHeadModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="wte")
+        wpe = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="wpe"
+        )
+        x = wte(input_ids) + wpe(positions)
+        x = constrain(x, ("dp", "ep"), "sp", None)
+
+        if cfg.scan_layers:
+            Scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = Scanned(cfg, remat=cfg.remat, name="h")(x, segment_ids)
+        else:
+            cls = nn.remat(GPT2Block, prevent_cse=False) if cfg.remat else GPT2Block
+            for i in range(cfg.num_hidden_layers):
+                x = cls(cfg, name=f"h_{i}")(x, segment_ids)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            logits = wte.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=pdtype, name="lm_head"
+            )(x)
+        logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        return CausalLMOutput(logits=logits)

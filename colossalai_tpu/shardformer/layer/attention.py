@@ -1,0 +1,127 @@
+"""Unified attention frontend.
+
+Analog of the reference's ``ColoAttention`` (``shardformer/layer/attn.py:82-334``):
+a single entry point that dispatches across kernel implementations and
+sequence-parallel modes. Where the reference picks between
+FlashAttention-CUDA / SDPA / NPU per dtype+mask, here we pick between
+
+- ``"xla"``   : plain jnp attention — XLA fuses it well for short/medium seq;
+- ``"pallas"``: Pallas TPU flash-attention kernel (tiled online softmax);
+- ``"ring"``  : zigzag ring attention over the ``sp`` mesh axis
+  (≙ ``RingAttention``, ``attn.py:406``) — wired by the sequence-parallel
+  layer, see ``colossalai_tpu/shardformer/layer/ring_attention.py``.
+
+All shapes are ``[batch, seq, heads, head_dim]``. GQA is computed without
+materializing repeated KV heads: q is folded to
+``[batch, seq, kv_heads, group, head_dim]``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+_NEG_INF = -1e9  # large-negative instead of -inf: keeps softmax NaN-free rows
+
+
+def _causal_mask(q_len: int, kv_len: int, offset: int = 0) -> jax.Array:
+    """[q_len, kv_len] bool mask; True = attend. ``offset`` shifts q positions
+    (used by ring attention where the local q block starts mid-sequence)."""
+    q_pos = jnp.arange(q_len)[:, None] + offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return q_pos >= kv_pos
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Numerically-stable attention on the MXU via two einsums.
+
+    ``segment_ids`` ([B, Sq]) enables packed-varlen attention
+    (≙ reference padded/varlen mask types, ``attn.py:54``).
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    assert hq % hkv == 0, f"q heads {hq} not a multiple of kv heads {hkv}"
+    group = hq // hkv
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    qg = rearrange(q, "b s (h g) d -> b s h g d", g=group)
+    # scores: [b, h, g, sq, skv]
+    scores = jnp.einsum("bshgd,bthd->bhgst", qg * scale, k, preferred_element_type=jnp.float32)
+
+    mask = None
+    if causal:
+        mask = _causal_mask(sq, skv, offset=q_offset)[None, None, None]
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        seg = (segment_ids[:, :, None] == kv_seg[:, None, :])[:, None, None]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    if bias is not None:
+        # bias is per-query-head [B, Hq, Sq, Skv]; fold to kv-head groups
+        bias_g = rearrange(bias, "b (h g) s t -> b h g s t", g=group)
+        scores = scores + bias_g.astype(scores.dtype)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v, preferred_element_type=jnp.float32)
+    return rearrange(out, "b s h g d -> b s (h g) d").astype(q.dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention entry point used by all model forwards.
+
+    ``impl``: "auto" | "xla" | "pallas". "auto" chooses the Pallas flash
+    kernel on TPU when shapes are tile-friendly, else XLA.
+    """
+    if impl == "auto":
+        impl = "pallas" if _pallas_eligible(q, k, bias, segment_ids) else "xla"
+    if impl == "pallas":
+        if bias is not None:
+            raise ValueError(
+                "the pallas flash kernel does not support an additive bias; "
+                "use impl='xla' (or 'auto', which falls back automatically)"
+            )
+        from colossalai_tpu.kernel import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
+        )
+    return xla_attention(
+        q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
+        softmax_scale=softmax_scale,
+    )
+
+
+def _pallas_eligible(q, k, bias, segment_ids) -> bool:
+    if bias is not None or segment_ids is not None:
+        return False
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+    # flash kernel wants seq multiples of its block size and head_dim >= 128-lane tiles
+    return on_tpu and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0

@@ -1,0 +1,61 @@
+"""Losses over (possibly vocab-sharded) logits.
+
+≙ reference ``DistCrossEntropy`` (``shardformer/layer/loss.py:25``) and
+``DistLogProb`` (``:148``). There, vocab-parallel CE is a hand-written
+autograd.Function doing masked local max/sum + two all-reduces. Under GSPMD
+the same math is a sharding annotation: logits carry a ``tp``-sharded vocab
+dim and XLA partitions the log-sum-exp reduction, inserting the identical
+collectives. The functions here are therefore plain stable CE, safe under
+any sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: int = -100,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean CE over valid positions. logits [..., V] fp32, labels [...] int."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logit
+    if label_smoothing > 0.0:
+        smooth = lse - jnp.mean(logits, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / denom
+
+
+def causal_lm_loss(
+    logits: jax.Array,
+    input_ids: jax.Array,
+    ignore_index: int = -100,
+    shift: bool = True,
+) -> jax.Array:
+    """Next-token CE: logits [B, S, V] vs input_ids [B, S]."""
+    if shift:
+        logits = logits[:, :-1]
+        labels = input_ids[:, 1:]
+    else:
+        labels = input_ids
+    return softmax_cross_entropy(logits, labels, ignore_index=ignore_index)
+
+
+def dist_log_prob(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-token log-probabilities (RLHF building block, ≙ DistLogProb)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return label_logit - lse

@@ -1,0 +1,39 @@
+"""Policy auto-dispatch by model class or name.
+
+≙ reference ``policies/auto_policy.py:28`` (_POLICY_LIST, 73 entries keyed by
+fully-qualified HF class names).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from .base_policy import Policy
+from .gpt2 import GPT2Policy
+from .llama import LlamaPolicy, MistralPolicy
+
+POLICY_REGISTRY = {
+    "llama": LlamaPolicy,
+    "LlamaForCausalLM": LlamaPolicy,
+    "mistral": MistralPolicy,
+    "qwen2": MistralPolicy,
+    "gpt2": GPT2Policy,
+    "GPT2LMHeadModel": GPT2Policy,
+}
+
+
+def get_autopolicy(model_or_name: Union[str, object]) -> Policy:
+    if isinstance(model_or_name, str):
+        name = model_or_name
+    else:
+        name = type(model_or_name).__name__
+    if name not in POLICY_REGISTRY:
+        raise KeyError(
+            f"no sharding policy for {name!r}; available: {sorted(POLICY_REGISTRY)}. "
+            "Register one via POLICY_REGISTRY or pass policy= explicitly."
+        )
+    return POLICY_REGISTRY[name]()
+
+
+def register_policy(name: str, policy_cls: type) -> None:
+    POLICY_REGISTRY[name] = policy_cls

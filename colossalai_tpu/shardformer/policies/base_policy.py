@@ -1,0 +1,116 @@
+"""Sharding policies: per-architecture parameter partitioning rules.
+
+≙ reference Policy system (``shardformer/policies/base_policy.py:21-65``).
+There a policy performs module surgery (replace submodules/forwards); under
+GSPMD a policy is declarative: regex rules over flattened param paths mapping
+to PartitionSpecs. The same rules serve TP (tp axis on weight dims), ZeRO-3
+/ FSDP (data axis on a remaining dim), and pipeline (pp axis on the scanned
+layer dim).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+from colossalai_tpu.device.device_mesh import DATA_AXES
+
+#: rule: (path regex, spec entries for the param's own dims)
+Rule = Tuple[str, Tuple[Any, ...]]
+
+#: param-path components that indicate a scanned layer stack whose leading
+#: axis is the layer dim (sharded over pp when pipelining).
+SCAN_CONTAINERS = ("layers", "h", "blocks")
+
+
+class Policy:
+    """Declarative sharding policy for one architecture."""
+
+    #: regex → per-dim spec entries (excluding any scan/layer leading dim)
+    rules: List[Rule] = []
+
+    def __init__(self, rules: Optional[List[Rule]] = None):
+        if rules is not None:
+            self.rules = rules
+        self._compiled = [(re.compile(pat), spec) for pat, spec in self.rules]
+
+    # ------------------------------------------------------------------ spec
+    def spec_for(self, path: str, ndim: int, scanned: bool) -> PartitionSpec:
+        base: Tuple[Any, ...] = ()
+        for pat, spec in self._compiled:
+            if pat.search(path):
+                base = spec
+                break
+        own_ndim = ndim - 1 if scanned else ndim
+        # pad/truncate to the param's own rank
+        base = tuple(base[:own_ndim]) + (None,) * (own_ndim - len(base))
+        if scanned:
+            base = (None,) + base  # layer dim; pipeline policy overrides to "pp"
+        return PartitionSpec(*base)
+
+    def param_specs(self, params: Any) -> Any:
+        """Pytree of PartitionSpecs matching ``params``."""
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        specs = {}
+        for keypath, leaf in flat:
+            path = path_str(keypath)
+            scanned = is_scanned(path)
+            specs[path] = self.spec_for(path, leaf.ndim, scanned)
+        return specs_to_tree(params, specs)
+
+
+def path_str(keypath) -> str:
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def is_scanned(path: str) -> bool:
+    parts = path.split("/")
+    return any(
+        parts[i] in SCAN_CONTAINERS and i + 1 < len(parts) and parts[i + 1] == "block"
+        for i in range(len(parts))
+    )
+
+
+def specs_to_tree(params: Any, specs: Dict[str, PartitionSpec]) -> Any:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [specs[path_str(kp)] for kp, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------- transforms
+
+
+def add_data_axis(spec: PartitionSpec, shape: Sequence[int], dp_size: int) -> PartitionSpec:
+    """FSDP/ZeRO-3: add the data axis to the largest unsharded, divisible dim.
+
+    ≙ Gemini chunk sharding (``zero/gemini/gemini_ddp.py``) — but instead of a
+    chunk VM, the weight itself carries a data-axis sharding and XLA inserts
+    the all-gather before use / reduce-scatter on grads.
+    """
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    best, best_size = None, 0
+    for i, (e, dim) in enumerate(zip(entries, shape)):
+        if e is None and dim % dp_size == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best is None:
+        return PartitionSpec(*entries)  # not divisible: stays replicated
+    entries[best] = DATA_AXES if entries[best] is None else entries[best]
+    return PartitionSpec(*entries)
+
+
+def tree_add_data_axis(specs: Any, params: Any, dp_size: int) -> Any:
+    return jax.tree.map(
+        lambda s, p: add_data_axis(s, p.shape, dp_size), specs, params,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )

@@ -1,0 +1,21 @@
+"""GPT-2 sharding policy (≙ ``shardformer/policies/gpt2.py``).
+
+The fused c_attn [H, 3H] is column-parallel on the fused qkv dim — the
+analog of the reference's GPT2FusedLinearConv1D_Col
+(``layer/qkv_fused_linear.py:193``). The fused dim stays head-aligned
+because q, k, v each split evenly across tp.
+"""
+
+from .base_policy import Policy
+
+
+class GPT2Policy(Policy):
+    rules = [
+        (r"wte/embedding$", ("tp", None)),
+        (r"wpe/embedding$", ()),
+        (r"(c_attn|c_fc)/kernel$", (None, "tp")),
+        (r"(c_attn|c_fc)/bias$", ("tp",)),
+        (r"(c_proj|mlp_c_proj)/kernel$", ("tp", None)),
+        (r"lm_head/kernel$", (None, "tp")),
+        (r"(ln_1|ln_2|ln_f)/(scale|bias)$", ()),
+    ]

@@ -1,0 +1,26 @@
+"""LLaMA sharding policy (≙ ``shardformer/policies/llama.py``).
+
+Megatron-style TP layout:
+- q/k/v + gate/up: column parallel → tp on the output dim;
+- o_proj/down_proj: row parallel → tp on the input dim;
+- embed_tokens: vocab-parallel on the vocab dim;
+- lm_head: column parallel on vocab (parallel_output keeps logits sharded
+  through the CE loss, ≙ DistCrossEntropy);
+- norms replicated.
+"""
+
+from .base_policy import Policy
+
+
+class LlamaPolicy(Policy):
+    rules = [
+        (r"embed_tokens/embedding$", ("tp", None)),
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$", (None, "tp")),
+        (r"(o_proj|down_proj)/kernel$", ("tp", None)),
+        (r"lm_head/kernel$", (None, "tp")),
+        (r"(input_layernorm|post_attention_layernorm|norm)/scale$", ()),
+    ]
+
+
+class MistralPolicy(LlamaPolicy):
+    """Mistral/Qwen2-style models share the LLaMA layout."""

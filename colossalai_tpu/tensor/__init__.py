@@ -1,0 +1,3 @@
+from .sharding import constrain, current_mesh, set_current_mesh, use_mesh
+
+__all__ = ["constrain", "current_mesh", "set_current_mesh", "use_mesh"]

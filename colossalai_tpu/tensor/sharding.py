@@ -1,0 +1,53 @@
+"""Ambient-mesh sharding helpers.
+
+TPU-native replacement for the reference's DTensor substrate
+(``colossalai/tensor/d_tensor/``): there, a ShardingSpec + LayoutConverter
+computes collective conversion paths at runtime; under GSPMD a
+``PartitionSpec`` annotation is enough — XLA derives the collectives. These
+helpers let model code annotate activations without threading the mesh
+through every module.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+def set_current_mesh(mesh: Union[Mesh, "object", None]) -> None:
+    """Install the ambient mesh (DeviceMesh or jax Mesh) used by ``constrain``."""
+    global _CURRENT_MESH
+    if mesh is not None and not isinstance(mesh, Mesh):
+        mesh = mesh.mesh  # DeviceMesh wrapper
+    _CURRENT_MESH = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    prev = _CURRENT_MESH
+    set_current_mesh(mesh)
+    try:
+        yield
+    finally:
+        set_current_mesh(prev)
+
+
+def constrain(x: jax.Array, *spec) -> jax.Array:
+    """``with_sharding_constraint`` against the ambient mesh; no-op without one.
+
+    Axis names not present in the mesh (or sized 1) are legal — GSPMD treats
+    them as unsharded, so the same model code runs under every parallel config.
+    """
+    mesh = _CURRENT_MESH
+    if mesh is None or mesh.size == 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*spec)))

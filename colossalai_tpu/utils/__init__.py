@@ -1,0 +1,13 @@
+from .performance_evaluator import (
+    PerformanceEvaluator,
+    causal_lm_flops_per_token,
+    count_params,
+    peak_flops_per_device,
+)
+
+__all__ = [
+    "PerformanceEvaluator",
+    "causal_lm_flops_per_token",
+    "count_params",
+    "peak_flops_per_device",
+]

@@ -1,0 +1,95 @@
+"""Throughput / MFU measurement.
+
+≙ reference ``examples/language/performance_evaluator.py:105``: step timers +
+all-reduce-mean throughput/TFLOPS/MFU. Model flops use the standard
+6·N·tokens + attention term (PaLM appendix convention), peak flops from the
+accelerator table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+
+#: peak bf16 TFLOPS per chip by device-kind keyword
+_PEAK_TFLOPS = {
+    "v6e": 918.0,
+    "v6": 918.0,
+    "v5p": 459.0,
+    "v5e": 197.0,
+    "v5 lite": 197.0,
+    "v5": 459.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "cpu": 1.0,
+}
+
+
+def peak_flops_per_device() -> float:
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    for key, tf in _PEAK_TFLOPS.items():
+        if key in kind:
+            return tf * 1e12
+    return 1e12
+
+
+def causal_lm_flops_per_token(
+    n_params: int, n_layers: int, hidden: int, seq_len: int, with_backward: bool = True
+) -> float:
+    """Training flops/token: 6N for fwd+bwd matmuls + 12·L·h·s attention."""
+    mult = 6.0 if with_backward else 2.0
+    dense = mult * n_params
+    attn = (mult / 2.0) * 12 * n_layers * hidden * seq_len / 2  # causal: half the matrix
+    return dense + attn
+
+
+@dataclasses.dataclass
+class PerformanceEvaluator:
+    flops_per_token: float
+    n_devices: int = 1
+    _tokens: int = 0
+    _time: float = 0.0
+    _t0: Optional[float] = None
+    _steps: int = 0
+
+    def on_step_start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def on_step_end(self, n_tokens: int, sync: bool = False) -> None:
+        if sync:
+            (jax.numpy.zeros(()) + 0).block_until_ready()
+        self._time += time.perf_counter() - self._t0
+        self._tokens += n_tokens
+        self._steps += 1
+
+    @property
+    def tokens_per_second(self) -> float:
+        return self._tokens / max(self._time, 1e-9)
+
+    @property
+    def tokens_per_second_per_device(self) -> float:
+        return self.tokens_per_second / self.n_devices
+
+    @property
+    def tflops_per_device(self) -> float:
+        return self.flops_per_token * self.tokens_per_second / self.n_devices / 1e12
+
+    @property
+    def mfu(self) -> float:
+        return self.tflops_per_device * 1e12 / peak_flops_per_device()
+
+    def summary(self) -> dict:
+        return {
+            "steps": self._steps,
+            "tokens_per_second": round(self.tokens_per_second, 2),
+            "tokens_per_second_per_device": round(self.tokens_per_second_per_device, 2),
+            "tflops_per_device": round(self.tflops_per_device, 2),
+            "mfu": round(self.mfu, 4),
+        }
+
+
+def count_params(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
