@@ -1,0 +1,353 @@
+"""Pallas TPU flash attention (forward + backward).
+
+TPU-native replacement for the reference's flash-attention extensions
+(``extensions/pybind/flash_attention/``, Dao-AILab CUDA) and decode kernel
+(``flash_decoding_attention_kernel.cu``): tiled online-softmax attention that
+never materializes the [Sq, Skv] matrix in HBM.
+
+Layout: kernels work on [B, H, S, D] (seq × head_dim as the trailing MXU
+tiles); the public wrapper transposes from the model-side [B, S, H, D].
+GQA is handled by BlockSpec index maps (q-head → kv-head // group) — no
+KV repetition ever materializes.
+
+Backward follows the standard two-pass flash design: a dq pass (grid over q
+blocks, inner kv) and a dk/dv pass (grid over kv blocks, inner q), both
+recomputing probs from the saved per-row LSE.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 1024
+_NEG_INF = -1e9
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_kv, num_kv_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks entirely above the diagonal contribute nothing — skip
+    # their MXU work (the reference kernel gets the same 2x from its
+    # upper-triangular specialization, scaled_upper_triang_masked_softmax).
+    needed = (qi + 1) * block_q - 1 >= ki * block_kv if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]  # [block_q, d] native dtype → MXU bf16 path
+        k = k_ref[0, 0]  # [block_kv, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_kv]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:]  # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [block_q, block_kv]
+        l_new = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+
+        v = v_ref[0, 0]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(safe_l)
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_kv):
+    """q [B,H,Sq,D], k/v [B,Hkv,Skv,D] → out [B,H,Sq,D], lse [B,H,Sq]."""
+    b, h, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    group = h // hkv
+    nq = pl.cdiv(sq, block_q)
+    nkv = pl.cdiv(skv, block_kv)
+
+    grid = (b * h, nq, nkv)
+
+    def q_map(bh, qi, ki):
+        return (bh // h, bh % h, qi, 0)
+
+    def kv_map(bh, qi, ki):
+        return (bh // h, (bh % h) // group, ki, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, num_kv_blocks=nkv,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi, ki), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: kv_map(bh, qi, ki), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: kv_map(bh, qi, ki), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi, ki), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *, scale, causal, block_q, block_kv, num_kv_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    needed = (qi + 1) * block_q - 1 >= ki * block_kv if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # [block_q, 1]
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_kv]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] = acc_ref[:] + jax.lax.dot(ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_kv, num_q_blocks):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = (qi + 1) * block_q - 1 >= ki * block_kv if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_kv]
+
+        # dv += p^T @ do ; dk += ds^T @ q
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, *, scale, causal, block_q, block_kv):
+    b, h, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    group = h // hkv
+    nq = pl.cdiv(sq, block_q)
+    nkv = pl.cdiv(skv, block_kv)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # [B,H,Sq,1]
+
+    def q_map(bh, qi, ki=None):
+        return (bh // h, bh % h, qi, 0)
+
+    def kv_map_q(bh, qi, ki):
+        return (bh // h, (bh % h) // group, ki, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, num_kv_blocks=nkv,
+        ),
+        grid=(b * h, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_map_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_map_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per (b, q-head, kv block); summed over the GQA group afterwards
+    def kv_map(bh, ki, qi):
+        return (bh // h, (bh % h) // group, ki, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, num_q_blocks=nq,
+        ),
+        grid=(b * h, nkv, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, bh % h, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, bh % h, ki, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, skv, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public entry
+
+
+def _interpret() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except RuntimeError:
+        return True
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_kv):
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_kv):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_kv, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, out, lse, do, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv
+    )
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+) -> jax.Array:
+    """Flash attention on model-layout [B, S, H, D] tensors."""
+    if segment_ids is not None:
+        raise NotImplementedError("packed segment_ids: use the xla impl")
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    sq, skv = q.shape[1], k.shape[1]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    if sq % block_q or skv % block_kv:
+        raise ValueError(
+            f"sequence lengths ({sq}, {skv}) must be multiples of blocks ({block_q}, {block_kv})"
+        )
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_kv)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def supports(q_shape, k_shape, block_q: int = DEFAULT_BLOCK_Q, block_kv: int = DEFAULT_BLOCK_KV) -> bool:
+    """Whether the kernel handles these [B, S, H, D] shapes (tile limits)."""
+    sq, skv, d = q_shape[1], k_shape[1], q_shape[-1]
+    if d % 128 != 0 or q_shape[2] % k_shape[2] != 0:
+        return False
+    bq, bkv = min(block_q, sq), min(block_kv, skv)
+    return sq % bq == 0 and skv % bkv == 0 and sq % 128 == 0 and skv % 128 == 0

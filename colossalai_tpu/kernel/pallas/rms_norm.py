@@ -1,0 +1,94 @@
+"""Pallas fused RMSNorm (+ optional residual add).
+
+≙ reference ``rms_layernorm_kernel.cu`` (348 LoC) incl. the
+fused_add_rms_layernorm variant. Row-tiled, fp32 statistics, differentiable
+via a custom VJP (the backward is the analytic RMSNorm gradient, fused the
+same way).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except RuntimeError:
+        return True
+
+
+def _fwd_kernel(x_ref, scale_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (x * rstd * scale_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _run_fwd(x2d, scale, eps):
+    n, h = x2d.shape
+    rows = min(_BLOCK_ROWS, n)
+    if n % rows:
+        rows = n  # fall back to one block
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(n, rows),),
+        in_specs=[
+            pl.BlockSpec((rows, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_2d(x2d, scale, eps):
+    out, _ = _run_fwd(x2d, scale, eps)
+    return out
+
+
+def _rms_fwd(x2d, scale, eps):
+    out, rstd = _run_fwd(x2d, scale, eps)
+    return out, (x2d, scale, rstd)
+
+
+def _rms_bwd(eps, res, g):
+    x2d, scale, rstd = res
+    x = x2d.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    s = scale.astype(jnp.float32)
+    h = x.shape[-1]
+    xhat = x * rstd
+    gs = g * s
+    # d/dx of x*rstd*s: rstd*(gs - xhat * mean(gs*xhat))
+    dx = rstd * (gs - xhat * jnp.mean(gs * xhat, axis=-1, keepdims=True))
+    dscale = jnp.sum(g * xhat, axis=0)
+    return dx.astype(x2d.dtype), dscale.astype(scale.dtype)
+
+
+_rms_norm_2d.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm(x, scale, eps: float = 1e-5, residual=None):
+    """RMSNorm over the last dim; with residual returns (normed, x+residual)."""
+    if residual is not None:
+        x = x + residual
+    shape = x.shape
+    out = _rms_norm_2d(x.reshape(-1, shape[-1]), scale, eps).reshape(shape)
+    return (out, x) if residual is not None else out

@@ -119,9 +119,12 @@ def dot_product_attention(
 def _pallas_eligible(q, k, bias, segment_ids) -> bool:
     if bias is not None or segment_ids is not None:
         return False
-    try:
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except RuntimeError:
+    from colossalai_tpu.kernel.loader import on_tpu
+
+    if not on_tpu():
         return False
-    # flash kernel wants seq multiples of its block size and head_dim >= 128-lane tiles
-    return on_tpu and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0
+    try:
+        from colossalai_tpu.kernel.pallas.flash_attention import supports
+    except ImportError:
+        return False
+    return supports(q.shape, k.shape)

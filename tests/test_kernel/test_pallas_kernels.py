@@ -1,0 +1,101 @@
+"""Kernel correctness vs jnp references (interpret mode on CPU;
+the same kernels compile on TPU — exercised by bench.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.kernel.pallas.flash_attention import flash_attention, supports
+from colossalai_tpu.kernel.pallas.rms_norm import rms_norm
+from colossalai_tpu.shardformer.layer.attention import xla_attention
+
+RNG = np.random.RandomState(0)
+
+
+def _qkv(b=2, s=256, h=4, hkv=2, d=64, dtype=jnp.float32):
+    q = jnp.asarray(RNG.randn(b, s, h, d), dtype)
+    k = jnp.asarray(RNG.randn(b, s, hkv, d), dtype)
+    v = jnp.asarray(RNG.randn(b, s, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_xla(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_backward_matches_xla():
+    q, k, v = _qkv()
+
+    def lp(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    def lx(q, k, v):
+        return (xla_attention(q, k, v, causal=True) ** 2).sum()
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lx, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-4)
+
+
+def test_flash_mha_no_gqa():
+    q, k, v = _qkv(h=4, hkv=4)
+    out = flash_attention(q, k, v, causal=True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_rejects_segment_ids():
+    q, k, v = _qkv()
+    seg = jnp.zeros(q.shape[:2], jnp.int32)
+    with pytest.raises(NotImplementedError):
+        flash_attention(q, k, v, segment_ids=seg)
+
+
+def test_supports_shapes():
+    assert supports((2, 2048, 16, 128), (2, 2048, 8, 128))
+    assert supports((2, 256, 4, 128), (2, 256, 4, 128))
+    assert not supports((2, 200, 4, 128), (2, 200, 4, 128))  # not 128-multiple
+    assert not supports((2, 256, 4, 64), (2, 256, 4, 64))  # head_dim < 128
+    assert not supports((2, 2048 + 128, 16, 128), (2, 2048 + 128, 8, 128))  # not block-divisible
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_matches(dtype):
+    x = jnp.asarray(RNG.randn(64, 128), dtype)
+    scale = jnp.asarray(RNG.randn(128), jnp.float32)
+    out = rms_norm(x, scale, eps=1e-5)
+    x32 = x.astype(jnp.float32)
+    ref = (x32 * jax.lax.rsqrt(jnp.mean(x32**2, -1, keepdims=True) + 1e-5) * scale).astype(dtype)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_rms_norm_grad():
+    x = jnp.asarray(RNG.randn(32, 128), jnp.float32)
+    scale = jnp.asarray(RNG.randn(128), jnp.float32)
+
+    def lp(x, s):
+        return (rms_norm(x, s) ** 2).sum()
+
+    def lr(x, s):
+        x32 = x.astype(jnp.float32)
+        o = x32 * jax.lax.rsqrt(jnp.mean(x32**2, -1, keepdims=True) + 1e-5) * s
+        return (o**2).sum()
+
+    gp = jax.grad(lp, argnums=(0, 1))(x, scale)
+    gr = jax.grad(lr, argnums=(0, 1))(x, scale)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_rms_norm_residual():
+    x = jnp.asarray(RNG.randn(16, 128), jnp.float32)
+    r = jnp.asarray(RNG.randn(16, 128), jnp.float32)
+    scale = jnp.ones(128, jnp.float32)
+    out, new_res = rms_norm(x, scale, residual=r)
+    np.testing.assert_allclose(np.asarray(new_res), np.asarray(x + r), atol=1e-6)
