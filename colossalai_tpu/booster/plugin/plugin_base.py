@@ -27,7 +27,7 @@ from colossalai_tpu.amp import (
     update_scaler,
 )
 from colossalai_tpu.device import DeviceMesh, create_device_mesh
-from colossalai_tpu.shardformer.layer.loss import causal_lm_loss
+from colossalai_tpu.shardformer.layer.loss import causal_lm_loss, softmax_cross_entropy
 from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
 from colossalai_tpu.shardformer.policies.base_policy import (
     Policy,
@@ -104,7 +104,7 @@ class Plugin(abc.ABC):
         if example_batch is None:
             raise ValueError("configure() needs example_batch to trace shapes")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        loss_fn = loss_fn or (lambda out, batch: causal_lm_loss(out.logits, batch["input_ids"]))
+        loss_fn = loss_fn or default_causal_lm_loss
         mesh = self.build_mesh(devices)
         model = _apply_precision(model, self.precision)
         model = self.modify_model(model)
@@ -122,8 +122,11 @@ class Plugin(abc.ABC):
 
         example_inputs = _model_inputs(example_batch)
 
-        # ---- abstract shapes → shardings (nothing materializes here)
-        params_shape = jax.eval_shape(lambda r: model.init(r, **example_inputs), rng)
+        # ---- abstract shapes → shardings (nothing materializes here).
+        # Tracing happens under the ambient mesh: model code (ring attention,
+        # constrain hints) needs it.
+        with use_mesh(mesh):
+            params_shape = jax.eval_shape(lambda r: model.init(r, **example_inputs), rng)
         param_specs = policy.param_specs(params_shape["params"])
         if self.fsdp:
             param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh.dp_size)
@@ -281,6 +284,22 @@ class Plugin(abc.ABC):
 
 
 # ---------------------------------------------------------------- utilities
+
+
+def default_causal_lm_loss(out, batch):
+    """Default LM objective.
+
+    Convention: ``batch['labels']`` are PRE-SHIFTED targets aligned with the
+    logits (labels[t] is the token that should follow position t) — NOT the
+    HF convention of labels == input_ids. This is required for permuted
+    layouts (zigzag SP) where the shift cannot happen post-hoc;
+    ``split_batch_zigzag`` produces labels in this convention. Without
+    labels, input_ids are next-token shifted here.
+    """
+    if "labels" in batch:
+        return softmax_cross_entropy(out.logits, batch["labels"])
+    return causal_lm_loss(out.logits, batch["input_ids"])
+
 
 _MODEL_INPUT_KEYS = ("input_ids", "positions", "segment_ids")
 

@@ -97,14 +97,19 @@ class HybridParallelPlugin(Plugin):
     enable_flash_attention: bool = True
     microbatch_size: Optional[int] = None
 
+    #: the reference's four SP modes (shard_config.py:13) + none.
+    #: "ring" is the ring-matmul variant of split_gather — under XLA the
+    #: collective schedule is the compiler's choice, so both map to the same
+    #: sharding annotations.
+    SP_MODES = ("none", "split_gather", "ring", "all_to_all", "ring_attn")
+
     def __post_init__(self):
-        # These land with the SP / PP milestones; refuse silently-ignored asks.
-        if self.sequence_parallel_mode != "none":
-            raise NotImplementedError(
-                f"sequence_parallel_mode={self.sequence_parallel_mode!r} is not wired "
-                "yet (sp_size shards activations over the sp axis; explicit ring/"
-                "all_to_all modes land with the sequence-parallel milestone)"
+        if self.sequence_parallel_mode not in self.SP_MODES:
+            raise ValueError(
+                f"sequence_parallel_mode={self.sequence_parallel_mode!r} not in {self.SP_MODES}"
             )
+        if self.sequence_parallel_mode != "none" and self.sp_size == 1:
+            raise ValueError("sequence_parallel_mode needs sp_size > 1")
         if self.pp_size != 1 or self.microbatch_size is not None:
             raise NotImplementedError(
                 "pipeline parallelism (pp_size/microbatch_size) lands with the "
@@ -117,9 +122,23 @@ class HybridParallelPlugin(Plugin):
         )
 
     def modify_model(self, model):
-        if not self.enable_flash_attention and hasattr(model, "config"):
-            import dataclasses as _dc
+        import dataclasses as _dc
 
-            if getattr(model.config, "attention_impl", None) not in (None, "xla"):
-                model = type(model)(_dc.replace(model.config, attention_impl="xla"))
+        if not hasattr(model, "config"):
+            return model
+        updates = {}
+        if not self.enable_flash_attention and getattr(model.config, "attention_impl", None) not in (None, "xla"):
+            updates["attention_impl"] = "xla"
+        mode = {"ring": "split_gather"}.get(self.sequence_parallel_mode, self.sequence_parallel_mode)
+        if mode != "none":
+            supported = getattr(model, "supports_sp_modes", ("split_gather",))
+            if mode not in supported:
+                raise NotImplementedError(
+                    f"{type(model).__name__} does not implement sp_mode={mode!r}; "
+                    f"it supports {supported}"
+                )
+            if getattr(model.config, "sp_mode", "none") != mode:
+                updates["sp_mode"] = mode
+        if updates:
+            model = type(model)(_dc.replace(model.config, **updates))
         return model

@@ -25,6 +25,10 @@ class ModelConfig:
     remat: bool = False  # jax.checkpoint each block (≙ gradient checkpointing)
     scan_layers: bool = True  # lax.scan over decoder blocks (fast compiles, PP-friendly)
     attention_impl: str = "auto"  # see shardformer.layer.attention
+    # sequence-parallel mode (≙ reference's 4 SP modes, shard_config.py:13):
+    # "none"/"split_gather" = seq-sharded outside attention (GSPMD gathers),
+    # "all_to_all" = Ulysses head<->seq all-to-all, "ring_attn" = ring attention
+    sp_mode: str = "none"
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -85,6 +85,8 @@ class _ScanBody(nn.Module):
 
 class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
+    #: GPT-2 only wires the Megatron-style seq-sharded activations
+    supports_sp_modes = ("split_gather",)
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):

@@ -128,18 +128,44 @@ class LlamaAttention(nn.Module):
         q = q.reshape(b, s, cfg.num_attention_heads, hd)
         k = k.reshape(b, s, cfg.num_key_value_heads, hd)
         v = v.reshape(b, s, cfg.num_key_value_heads, hd)
-        # activations: heads sharded over tp, batch over data axes
-        q = constrain(q, ("dp", "ep"), None, "tp", None)
-        k = constrain(k, ("dp", "ep"), None, "tp", None)
-        v = constrain(v, ("dp", "ep"), None, "tp", None)
+        sp = cfg.sp_mode
+        if sp == "ring_attn":
+            # seq stays sp-sharded through attention; ring rotates KV
+            q = constrain(q, ("dp", "ep"), "sp", "tp", None)
+            k = constrain(k, ("dp", "ep"), "sp", "tp", None)
+            v = constrain(v, ("dp", "ep"), "sp", "tp", None)
+        elif sp == "all_to_all":
+            # Ulysses: gather seq, shard heads over (tp, sp) — the constraint
+            # change IS the all-to-all (≙ _AllToAll, layer/_operation.py:1082)
+            q = constrain(q, ("dp", "ep"), None, ("tp", "sp"), None)
+            k = constrain(k, ("dp", "ep"), None, ("tp", "sp"), None)
+            v = constrain(v, ("dp", "ep"), None, ("tp", "sp"), None)
+        else:
+            q = constrain(q, ("dp", "ep"), None, "tp", None)
+            k = constrain(k, ("dp", "ep"), None, "tp", None)
+            v = constrain(v, ("dp", "ep"), None, "tp", None)
 
         cos, sin = rope_table(positions, hd, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        out = dot_product_attention(
-            q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
-        )
+        if sp == "ring_attn":
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed segment_ids are not supported under sp_mode='ring_attn'; "
+                    "use all_to_all or split_gather for packed batches"
+                )
+            from colossalai_tpu.shardformer.layer.ring_attention import ring_attention
+            from colossalai_tpu.tensor import current_mesh
+
+            mesh = current_mesh()
+            if mesh is None:
+                raise RuntimeError("sp_mode='ring_attn' requires an ambient mesh")
+            out = ring_attention(q, k, v, positions, mesh, causal=True)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
+            )
         out = out.reshape(b, s, cfg.num_attention_heads * hd)
         out = dense(cfg.hidden_size, "o_proj")(out)
         return constrain(out, ("dp", "ep"), "sp", None)
@@ -196,6 +222,8 @@ class LlamaForCausalLM(nn.Module):
     """Decoder-only LM. Param tree lays out HF-style for checkpoint interop."""
 
     config: LlamaConfig
+    #: SP modes this architecture honors (checked by plugins before setting)
+    supports_sp_modes = ("split_gather", "all_to_all", "ring_attn")
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):

@@ -1,0 +1,183 @@
+"""Ring attention: context parallelism over the ``sp`` mesh axis.
+
+≙ reference ``RingAttention`` (``shardformer/layer/attn.py:406``): there, a
+hand-written autograd.Function with double-ring NCCL P2P, two CUDA streams
+overlapping LSE correction with the next flash call, and zigzag batch
+splitting. The TPU design:
+
+- ``shard_map`` over the sp axis; KV blocks rotate ring-wise with
+  ``jax.lax.ppermute`` riding ICI neighbours. XLA overlaps the permute with
+  the local attention compute (the analog of the reference's two streams).
+- streaming softmax merge: each step produces a local (out, lse); merged
+  with the running pair by the standard rescaling identity
+  (≙ ``_rescale_out_lse``, ``attn.py:376``).
+- causal balance comes from the **zigzag layout** (``split_batch_zigzag``,
+  ``layer/utils.py:331``): rank r holds chunks (r, 2·sp−1−r), so every rank
+  sees the same causal workload. Correctness is position-based — each chunk
+  carries global position ids, so the mask is exact regardless of layout.
+- the backward is jax autodiff through the scan + ppermute (reverse-mode
+  ppermute is the inverse permute), so no hand-written backward is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from colossalai_tpu.device.device_mesh import DATA_AXES
+
+_NEG_INF = -1e9
+
+
+def _attn_with_lse(q, k, v, q_pos, kv_pos, causal: bool):
+    """Masked attention returning (out [B,S,H,D] fp32, lse [B,H,S] fp32).
+
+    ``q_pos``/``kv_pos`` are per-row global position ids [B, S], so
+    chunk-vs-chunk causal masks are exact for any layout (zigzag, padded
+    offsets). Fully-masked rows yield lse≈-inf and out=0, vanishing in the
+    merge.
+    """
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    scale = d**-0.5
+
+    qg = q.reshape(b, sq, hkv, group, d)
+    scores = jnp.einsum(
+        "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = q_pos[:, :, None] >= kv_pos[:, None, :]  # [b, sq, skv]
+        scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.maximum(m, _NEG_INF)  # keep fully-masked rows finite
+    p = jnp.exp(scores - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgst,bthd->bshgd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # [b,hkv,g,sq]
+    safe_l = jnp.where(l == 0.0, 1.0, l)  # [b, hkv, g, sq, 1]
+    out = out / jnp.transpose(safe_l, (0, 3, 1, 2, 4))  # → [b, sq, hkv, g, 1]
+    return out.reshape(b, sq, hq, d), lse.reshape(b, hq, sq)
+
+
+def _merge(out_a, lse_a, out_b, lse_b):
+    """Combine two partial attentions over disjoint KV sets."""
+    lse_new = jnp.logaddexp(lse_a, lse_b)  # [b,h,s]
+    wa = jnp.exp(lse_a - lse_new)[..., None].swapaxes(1, 2)  # [b,s,h,1]
+    wb = jnp.exp(lse_b - lse_new)[..., None].swapaxes(1, 2)
+    return out_a * wa + out_b * wb, lse_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    positions: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    sp_axis: str = "sp",
+    batch_axes=DATA_AXES,
+    tp_axis: Optional[str] = "tp",
+) -> jax.Array:
+    """Attention with q/k/v sharded on the sequence dim over ``sp_axis``.
+
+    q/k/v: [B, S, H, D] global; positions: [B, S] global token positions
+    (zigzag-permuted layouts pass their permuted positions — the mask is
+    position-exact). Returns [B, S, H, D] with the same sharding as q.
+    """
+    sp_size = mesh.shape[sp_axis]
+    if sp_size == 1:
+        out, _ = _attn_with_lse(q, k, v, positions, positions, causal)
+        return out.astype(q.dtype)
+
+    # keep batch/tp sharding only where sizes divide — the ring itself only
+    # needs the sp axis; everything else is a residency hint
+    import math
+
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    bsz = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if bsz == 1 or q.shape[0] % bsz:
+        batch_axes = ()
+    tp_size = mesh.shape.get(tp_axis, 1) if tp_axis else 1
+    if tp_size == 1 or q.shape[2] % tp_size or k.shape[2] % tp_size:
+        tp_axis = None
+
+    batch_spec = batch_axes if batch_axes else None
+    qkv_spec = P(batch_spec, sp_axis, tp_axis, None)
+    pos_spec = P(batch_spec, sp_axis)
+
+    def local_fn(q_l, k_l, v_l, pos_l):
+        # local shapes: [b_l, s_l, h_l, d], pos [b_l, s_l]
+        out0, lse0 = _attn_with_lse(q_l, k_l, v_l, pos_l, pos_l, causal)
+
+        def body(carry, _):
+            out, lse, k_c, v_c, pos_c = carry
+            # rotate kv + their positions to the next ring neighbour
+            perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+            k_c = jax.lax.ppermute(k_c, sp_axis, perm)
+            v_c = jax.lax.ppermute(v_c, sp_axis, perm)
+            pos_c = jax.lax.ppermute(pos_c, sp_axis, perm)
+            o_i, lse_i = _attn_with_lse(q_l, k_c, v_c, pos_l, pos_c, causal)
+            out, lse = _merge(out, lse, o_i, lse_i)
+            return (out, lse, k_c, v_c, pos_c), None
+
+        (out, lse, *_), _ = jax.lax.scan(
+            body, (out0, lse0, k_l, v_l, pos_l), None, length=sp_size - 1
+        )
+        return out.astype(q_l.dtype)
+
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec),
+        out_specs=qkv_spec,
+        check_rep=False,
+    )
+    return fn(q, k, v, positions)
+
+
+# ------------------------------------------------------------ zigzag layout
+
+
+def zigzag_indices(seq_len: int, sp_size: int) -> jnp.ndarray:
+    """Permutation putting chunks (r, 2·sp−1−r) on rank r
+    (≙ split_batch_zigzag, layer/utils.py:331)."""
+    n_chunks = 2 * sp_size
+    chunk = seq_len // n_chunks
+    idx = []
+    for r in range(sp_size):
+        idx.extend(range(r * chunk, (r + 1) * chunk))
+        idx.extend(range((n_chunks - 1 - r) * chunk, (n_chunks - r) * chunk))
+    return jnp.asarray(idx)
+
+
+def split_batch_zigzag(batch: dict, sp_size: int) -> dict:
+    """Reorder every [B, S] tensor into the zigzag layout and attach the
+    matching ``positions``. Labels must be precomputed (next-token shift
+    happens before permutation — chunk edges are not contiguous after)."""
+    seq_len = batch["input_ids"].shape[1]
+    if seq_len % (2 * sp_size):
+        raise ValueError(
+            f"seq_len {seq_len} must be divisible by 2*sp_size={2 * sp_size}"
+        )
+    idx = zigzag_indices(seq_len, sp_size)
+    b = batch["input_ids"].shape[0]
+    batch = dict(batch)
+    if "labels" not in batch:
+        ids = batch["input_ids"]
+        batch["labels"] = jnp.concatenate(
+            [ids[:, 1:], jnp.full_like(ids[:, :1], -100)], axis=1
+        )
+    if "positions" not in batch:
+        batch["positions"] = jnp.broadcast_to(jnp.arange(seq_len), (b, seq_len))
+    out = {}
+    for key, val in batch.items():
+        out[key] = val[:, idx] if val.ndim >= 2 and val.shape[1] == seq_len else val
+    return out
