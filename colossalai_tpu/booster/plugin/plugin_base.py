@@ -128,6 +128,10 @@ class Plugin(abc.ABC):
         with use_mesh(mesh):
             params_shape = jax.eval_shape(lambda r: model.init(r, **example_inputs), rng)
         param_specs = policy.param_specs(params_shape["params"])
+        if mesh.pp_size > 1:
+            from colossalai_tpu.shardformer.policies.base_policy import tree_add_pp_axis
+
+            param_specs = tree_add_pp_axis(param_specs, params_shape["params"])
         if self.fsdp:
             param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh.dp_size)
         param_shardings = jax.tree.map(

@@ -96,6 +96,7 @@ class HybridParallelPlugin(Plugin):
     fsdp: bool = False
     enable_flash_attention: bool = True
     microbatch_size: Optional[int] = None
+    num_microbatches: Optional[int] = None
 
     #: the reference's four SP modes (shard_config.py:13) + none.
     #: "ring" is the ring-matmul variant of split_gather — under XLA the
@@ -110,10 +111,10 @@ class HybridParallelPlugin(Plugin):
             )
         if self.sequence_parallel_mode != "none" and self.sp_size == 1:
             raise ValueError("sequence_parallel_mode needs sp_size > 1")
-        if self.pp_size != 1 or self.microbatch_size is not None:
-            raise NotImplementedError(
-                "pipeline parallelism (pp_size/microbatch_size) lands with the "
-                "pipeline milestone"
+        if self.pp_size > 1 and self.num_microbatches is None and self.microbatch_size is None:
+            raise ValueError(
+                "pp_size > 1 needs num_microbatches (or microbatch_size, resolved "
+                "against the example batch)"
             )
 
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
@@ -121,12 +122,54 @@ class HybridParallelPlugin(Plugin):
             pp=self.pp_size, sp=self.sp_size, tp=self.tp_size, devices=devices
         )
 
+    def configure(self, model, optimizer, loss_fn=None, example_batch=None,
+                  rng=None, policy=None, devices=None):
+        self._resolved_microbatches = self.num_microbatches
+        if self.pp_size > 1 and example_batch is not None:
+            batch_size = example_batch["input_ids"].shape[0]
+            if self.microbatch_size is not None:
+                if batch_size % self.microbatch_size:
+                    raise ValueError(
+                        f"batch {batch_size} not divisible by microbatch_size={self.microbatch_size}"
+                    )
+                from_size = batch_size // self.microbatch_size
+                if self.num_microbatches is not None and self.num_microbatches != from_size:
+                    raise ValueError(
+                        f"num_microbatches={self.num_microbatches} contradicts "
+                        f"microbatch_size={self.microbatch_size} for batch {batch_size} "
+                        f"(implies {from_size})"
+                    )
+                self._resolved_microbatches = from_size
+        return super().configure(
+            model, optimizer, loss_fn=loss_fn, example_batch=example_batch,
+            rng=rng, policy=policy, devices=devices,
+        )
+
     def modify_model(self, model):
         import dataclasses as _dc
 
         if not hasattr(model, "config"):
             return model
+        if self.pp_size > 1:
+            if not getattr(model, "supports_pipeline", False):
+                raise NotImplementedError(
+                    f"{type(model).__name__} does not implement the pipelined layer "
+                    "stack (supports_pipeline)"
+                )
+            if not getattr(model.config, "scan_layers", True):
+                raise ValueError(
+                    "pipeline parallelism requires scan_layers=True (the pp stages "
+                    "are slices of the stacked layer scan)"
+                )
+            n_layers = getattr(model.config, "num_hidden_layers", None)
+            if n_layers is not None and n_layers % self.pp_size:
+                raise ValueError(
+                    f"num_hidden_layers={n_layers} must be divisible by pp_size={self.pp_size}"
+                )
+        n_micro = getattr(self, "_resolved_microbatches", self.num_microbatches)
         updates = {}
+        if self.pp_size > 1 and model.config.pp_microbatches != n_micro:
+            updates["pp_microbatches"] = n_micro
         if not self.enable_flash_attention and getattr(model.config, "attention_impl", None) not in (None, "xla"):
             updates["attention_impl"] = "xla"
         mode = {"ring": "split_gather"}.get(self.sequence_parallel_mode, self.sequence_parallel_mode)

@@ -29,6 +29,9 @@ class ModelConfig:
     # "none"/"split_gather" = seq-sharded outside attention (GSPMD gathers),
     # "all_to_all" = Ulysses head<->seq all-to-all, "ring_attn" = ring attention
     sp_mode: str = "none"
+    # pipeline parallelism: number of microbatches streamed over the pp mesh
+    # axis (0 = no pipelining). Set by HybridParallelPlugin.
+    pp_microbatches: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

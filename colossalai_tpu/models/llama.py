@@ -224,6 +224,8 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
     #: SP modes this architecture honors (checked by plugins before setting)
     supports_sp_modes = ("split_gather", "all_to_all", "ring_attn")
+    #: streams microbatches over the pp axis when pp_microbatches > 0
+    supports_pipeline = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):
@@ -240,7 +242,32 @@ class LlamaForCausalLM(nn.Module):
         x = embed(input_ids)
         x = constrain(x, ("dp", "ep"), "sp", None)
 
-        if cfg.scan_layers:
+        if cfg.scan_layers and cfg.pp_microbatches > 0 and not self.is_initializing():
+            # pipeline path: params were created by the scan below during
+            # init (stacked [L, ...], sharded over pp by the policy); here
+            # they are consumed functionally by the streaming schedule
+            from colossalai_tpu.pipeline import pipeline_blocks
+            from colossalai_tpu.tensor import current_mesh
+
+            mesh = current_mesh()
+            if mesh is None:
+                raise RuntimeError("pipeline parallelism requires an ambient mesh")
+            stacked = self.scope.get_variable("params", "layers")["block"]
+            block = LlamaBlock(cfg)
+
+            def block_apply(p, h, aux):
+                return block.apply(
+                    {"params": p}, h, aux["positions"], aux.get("segment_ids")
+                )
+
+            aux = {"positions": positions}
+            if segment_ids is not None:
+                aux["segment_ids"] = segment_ids
+            x = pipeline_blocks(
+                block_apply, stacked, x, mesh, cfg.pp_microbatches,
+                aux=aux, remat=cfg.remat,
+            )
+        elif cfg.scan_layers:
             Scanned = nn.scan(
                 _ScanBody,
                 variable_axes={"params": 0},

@@ -1,0 +1,4 @@
+from .schedule import pipeline_blocks
+from .stage_manager import PipelineStageManager
+
+__all__ = ["pipeline_blocks", "PipelineStageManager"]

@@ -1,0 +1,147 @@
+"""Pipeline schedule: microbatch streaming over the ``pp`` mesh axis.
+
+≙ reference ``OneForwardOneBackwardSchedule`` / ``InterleavedSchedule``
+(``pipeline/schedule/``): there, explicit P2P sends of pickled pytrees with
+warmup/steady/cooldown phases hand-ordered per rank. Under XLA the whole
+train step is one program, so the schedule is expressed as data flow:
+
+- layer params stay stacked [L, ...] and sharded over ``pp`` on the layer
+  dim — each stage holds L/pp layers;
+- inside ``shard_map(axis_names={'pp'})`` microbatches stream through the
+  stages: each tick runs the local stage and rotates activations to the
+  next stage with ``ppermute`` (the P2P of ``pipeline/p2p.py``, minus the
+  pickle transport — pytree metadata is static under jit);
+- fill-drain (GPipe) ordering with T = n_micro + pp − 1 ticks; XLA derives
+  the backward pipeline by transposing the loop (ppermuteᵀ = reverse ring),
+  which reproduces the cooldown phase of 1F1B;
+- bubble fraction = (pp−1)/T, same as the reference's 1F1B. The 1F1B
+  *memory* advantage is recovered with per-stage remat instead of schedule
+  reordering.
+
+Other mesh axes (dp/tp/sp/ep) stay in GSPMD auto mode — TP collectives etc.
+keep working inside each stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_blocks(
+    block_apply: Callable[..., jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh,
+    num_microbatches: int,
+    aux: Any = None,
+    *,
+    pp_axis: str = "pp",
+    remat: bool = True,
+):
+    """Run a stack of L identical blocks as a pp-stage pipeline.
+
+    ``block_apply(layer_params, h, aux_mb) -> h`` applies ONE block.
+    ``stacked_params``: pytree with leading layer dim L (sharded over pp).
+    ``x``: [B, S, H] block-stack input. ``aux``: pytree of [B, ...] arrays
+    streamed with the hidden state (positions, segment ids). Returns
+    [B, S, H].
+    """
+    from .stage_manager import PipelineStageManager
+
+    pp = mesh.shape[pp_axis]
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    aux = aux if aux is not None else {}
+
+    stage_body = block_apply
+    if remat:
+        stage_body = jax.checkpoint(block_apply, prevent_cse=False)
+
+    if pp == 1:
+        def body(h, p):
+            return stage_body(p, h, aux), None
+
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    PipelineStageManager(num_stages=pp, num_layers=n_layers)  # validates split
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by num_microbatches={num_microbatches}")
+
+    mb_split = lambda a: a.reshape((num_microbatches, b // num_microbatches) + a.shape[1:])
+    # fp32 at the shard_map boundary: the transpose of a pp-replicated input
+    # is a psum over pp, and XLA's all-reduce promotion miscompiles narrow
+    # dtypes inside manual regions (CPU backend crash); compute stays bf16.
+    x_dtype = x.dtype
+    x_mb = mb_split(x).astype(jnp.float32)
+    aux_mb = jax.tree.map(mb_split, aux)
+
+    def local_fn(params_l, x_mb_l, aux_mb_l):
+        # params_l: [L/pp, ...]; x_mb_l: [n_micro, mb_local, S, H]
+        x_mb_l = x_mb_l.astype(x_dtype)
+        stage = jax.lax.axis_index(pp_axis)
+        T = num_microbatches + pp - 1
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def run_stage(h, aux_t):
+            def body(h, p_layer):
+                return stage_body(p_layer, h, aux_t), None
+
+            h, _ = jax.lax.scan(body, h, params_l)
+            return h
+
+        zero_state = jnp.zeros_like(x_mb_l[0])
+
+        def tick(carry, t):
+            recv, outputs = carry
+            in_idx = jnp.clip(t, 0, num_microbatches - 1)
+            inp = jnp.where(stage == 0, x_mb_l[in_idx], recv)
+            # stage s processes microbatch t-s at tick t; aux is replicated
+            # so each stage indexes its own current microbatch
+            cur_idx = jnp.clip(t - stage, 0, num_microbatches - 1)
+            aux_t = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, cur_idx, keepdims=False),
+                aux_mb_l,
+            )
+            out = run_stage(inp, aux_t)
+            # rotate to next stage; stage pp-1 -> 0 edge carries garbage that
+            # stage 0 never reads (it reads x_mb)
+            recv_next = jax.lax.ppermute(out, pp_axis, fwd_perm)
+            out_idx = jnp.clip(t - (pp - 1), 0, num_microbatches - 1)
+            collect = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(collect, out, prev), out_idx, 0
+            )
+            return (recv_next, outputs), None
+
+        outputs0 = jnp.zeros_like(x_mb_l)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero_state, outputs0), jnp.arange(T)
+        )
+        # replicate the last stage's result across pp so downstream (norm,
+        # head, loss) sees a pp-consistent value. fp32 psum: XLA's
+        # all-reduce-promotion pass miscompiles narrow-dtype psum inside
+        # nested manual regions (crash observed on CPU backend).
+        mask = (stage == pp - 1).astype(jnp.float32)
+        outputs = jax.lax.psum(outputs.astype(jnp.float32) * mask, pp_axis)
+        return outputs.astype(x_mb_l.dtype)
+
+    param_specs = jax.tree.map(
+        lambda l: P(pp_axis, *([None] * (l.ndim - 1))), stacked_params
+    )
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb)),
+        out_specs=P(),
+        axis_names={pp_axis},
+        check_vma=False,
+    )
+    out_mb = fn(stacked_params, x_mb, aux_mb)
+    return out_mb.reshape(x.shape)

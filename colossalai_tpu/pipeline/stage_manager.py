@@ -1,0 +1,47 @@
+"""Pipeline stage bookkeeping.
+
+≙ reference ``PipelineStageManager`` (``pipeline/stage_manager.py:11-231``).
+There it maps mesh coords to stages and owns P2P group creation; here stages
+are coordinates on the ``pp`` mesh axis and the only state is the layer
+split. The streaming schedule (schedule.py) requires an even split because
+stage compute is a ``lax.scan`` over stacked layer params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineStageManager:
+    num_stages: int
+    num_layers: int
+
+    def __post_init__(self):
+        if self.num_layers % self.num_stages:
+            raise ValueError(
+                f"num_layers={self.num_layers} must be divisible by "
+                f"num_stages={self.num_stages} (stacked-scan pipeline)"
+            )
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.num_layers // self.num_stages
+
+    def distribute_layers(self) -> List[int]:
+        """Layers per stage (≙ stage_manager.py:212 balanced split)."""
+        return [self.layers_per_stage] * self.num_stages
+
+    def stage_of_layer(self, layer: int) -> int:
+        return layer // self.layers_per_stage
+
+    def layer_range(self, stage: int) -> Tuple[int, int]:
+        lps = self.layers_per_stage
+        return stage * lps, (stage + 1) * lps
+
+    def is_first_stage(self, stage: int) -> bool:
+        return stage == 0
+
+    def is_last_stage(self, stage: int) -> bool:
+        return stage == self.num_stages - 1

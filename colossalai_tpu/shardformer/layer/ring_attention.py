@@ -27,9 +27,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
-from colossalai_tpu.device.device_mesh import DATA_AXES
 
 _NEG_INF = -1e9
 
@@ -83,35 +81,24 @@ def ring_attention(
     *,
     causal: bool = True,
     sp_axis: str = "sp",
-    batch_axes=DATA_AXES,
-    tp_axis: Optional[str] = "tp",
 ) -> jax.Array:
     """Attention with q/k/v sharded on the sequence dim over ``sp_axis``.
 
     q/k/v: [B, S, H, D] global; positions: [B, S] global token positions
     (zigzag-permuted layouts pass their permuted positions — the mask is
     position-exact). Returns [B, S, H, D] with the same sharding as q.
+
+    Only the sp axis goes manual (partial shard_map): batch/head sharding
+    over dp/tp stays in GSPMD auto mode, so the ring composes with TP and
+    with the pp pipeline's own shard_map.
     """
     sp_size = mesh.shape[sp_axis]
     if sp_size == 1:
         out, _ = _attn_with_lse(q, k, v, positions, positions, causal)
         return out.astype(q.dtype)
 
-    # keep batch/tp sharding only where sizes divide — the ring itself only
-    # needs the sp axis; everything else is a residency hint
-    import math
-
-    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
-    bsz = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
-    if bsz == 1 or q.shape[0] % bsz:
-        batch_axes = ()
-    tp_size = mesh.shape.get(tp_axis, 1) if tp_axis else 1
-    if tp_size == 1 or q.shape[2] % tp_size or k.shape[2] % tp_size:
-        tp_axis = None
-
-    batch_spec = batch_axes if batch_axes else None
-    qkv_spec = P(batch_spec, sp_axis, tp_axis, None)
-    pos_spec = P(batch_spec, sp_axis)
+    qkv_spec = P(None, sp_axis, None, None)
+    pos_spec = P(None, sp_axis)
 
     def local_fn(q_l, k_l, v_l, pos_l):
         # local shapes: [b_l, s_l, h_l, d], pos [b_l, s_l]
@@ -133,12 +120,16 @@ def ring_attention(
         )
         return out.astype(q_l.dtype)
 
-    fn = shard_map(
+    # inside another (partial-)manual region the context mesh must be used
+    ctx = jax.sharding.get_abstract_mesh()
+    mesh_arg = ctx if (ctx is not None and sp_axis in getattr(ctx, "shape", {})) else mesh
+    fn = jax.shard_map(
         local_fn,
-        mesh=mesh,
+        mesh=mesh_arg,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec),
         out_specs=qkv_spec,
-        check_rep=False,
+        axis_names={sp_axis},
+        check_vma=False,
     )
     return fn(q, k, v, positions)
 

@@ -114,3 +114,20 @@ def tree_add_data_axis(specs: Any, params: Any, dp_size: int) -> Any:
         lambda s, p: add_data_axis(s, p.shape, dp_size), specs, params,
         is_leaf=lambda x: isinstance(x, PartitionSpec),
     )
+
+
+def tree_add_pp_axis(specs: Any, params: Any) -> Any:
+    """Pipeline: shard the stacked layer dim of scanned stacks over ``pp``
+    (each stage holds its L/pp layers — ≙ _release_unheld_layers,
+    shard/sharder.py:222, without the surgery)."""
+    flat_s, treedef = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    leaves = []
+    for keypath, spec in flat_s:
+        if is_scanned(path_str(keypath)):
+            entries = list(spec)
+            entries[0] = "pp"
+            spec = PartitionSpec(*entries)
+        leaves.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
