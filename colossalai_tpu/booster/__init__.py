@@ -1,5 +1,6 @@
 from .booster import Booster
 from .plugin.plugin_base import Boosted, Plugin, TrainState
+from .plugin.moe_plugin import MoeHybridParallelPlugin
 from .plugin.plugins import (
     DataParallelPlugin,
     GeminiPlugin,
@@ -16,4 +17,5 @@ __all__ = [
     "GeminiPlugin",
     "HybridParallelPlugin",
     "LowLevelZeroPlugin",
+    "MoeHybridParallelPlugin",
 ]

@@ -133,7 +133,7 @@ class Plugin(abc.ABC):
 
             param_specs = tree_add_pp_axis(param_specs, params_shape["params"])
         if self.fsdp:
-            param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh.dp_size)
+            param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh)
         param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh.mesh, s), param_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
@@ -179,7 +179,7 @@ class Plugin(abc.ABC):
 
         grad_shardings = None
         if self.zero_stage >= 2 and not self.fsdp:
-            grad_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh.dp_size)
+            grad_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh)
             grad_shardings = jax.tree.map(
                 lambda s: NamedSharding(mesh.mesh, s), grad_specs,
                 is_leaf=lambda x: isinstance(x, PartitionSpec),
@@ -213,6 +213,11 @@ class Plugin(abc.ABC):
             def compute_loss(params):
                 out = model.apply({"params": params}, **inputs)
                 loss = loss_fn(out, batch)
+                # model-side auxiliary objectives (MoE balancing/z-loss) are
+                # added here so EVERY loss_fn gets them — a user loss must
+                # not add out.aux_loss itself
+                if getattr(out, "aux_loss", None) is not None:
+                    loss = loss + out.aux_loss
                 if precision == "fp16":
                     return loss * state.scaler.scale, loss
                 return loss, loss
@@ -276,7 +281,10 @@ class Plugin(abc.ABC):
 
         def step_fn(state: TrainState, batch):
             out = model.apply({"params": state.params}, **_model_inputs(batch))
-            return {"loss": loss_fn(out, batch), "logits": out.logits}
+            loss = loss_fn(out, batch)
+            if getattr(out, "aux_loss", None) is not None:
+                loss = loss + out.aux_loss
+            return {"loss": loss, "logits": out.logits}
 
         jitted = jax.jit(step_fn, in_shardings=(state_shardings, batch_sharding))
 
@@ -358,7 +366,7 @@ def _opt_state_specs(opt_state_shape, params, param_specs, mesh: DeviceMesh, sha
         if shard_over_data:
             from colossalai_tpu.shardformer.policies.base_policy import add_data_axis
 
-            return add_data_axis(best, leaf.shape, mesh.dp_size)
+            return add_data_axis(best, leaf.shape, dict(mesh.mesh.shape))
         return best
 
     flat_o = jax.tree_util.tree_flatten_with_path(opt_state_shape)

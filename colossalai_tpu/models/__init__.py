@@ -7,10 +7,12 @@
 from .base import CausalLMOutput, ModelConfig
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM
+from .mixtral import MixtralConfig, MixtralForCausalLM
 
 MODEL_REGISTRY = {
     "llama": (LlamaForCausalLM, LlamaConfig),
     "gpt2": (GPT2LMHeadModel, GPT2Config),
+    "mixtral": (MixtralForCausalLM, MixtralConfig),
 }
 
 
@@ -27,6 +29,8 @@ __all__ = [
     "GPT2LMHeadModel",
     "LlamaConfig",
     "LlamaForCausalLM",
+    "MixtralConfig",
+    "MixtralForCausalLM",
     "MODEL_REGISTRY",
     "get_model_cls",
 ]

@@ -13,6 +13,8 @@ import jax
 class CausalLMOutput:
     logits: jax.Array
     hidden_states: Optional[jax.Array] = None
+    #: auxiliary training loss (MoE load balancing / router z-loss)
+    aux_loss: Optional[jax.Array] = None
 
 
 @dataclasses.dataclass(unsafe_hash=True)

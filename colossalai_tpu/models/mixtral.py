@@ -1,0 +1,187 @@
+"""Mixtral-style MoE causal LM (expert parallelism over the ``ep`` axis).
+
+≙ reference Mixtral/DeepSeek EP support (``shardformer/modeling/mixtral.py``,
+``policies/mixtral.py``, ``moe/_operation.py``, ColossalMoE app). Experts are
+a stacked [E, ...] weight tensor sharded over ``ep``; token dispatch is the
+GSPMD capacity einsum (see ``moe/router.py``) — the all-to-alls the
+reference writes by hand fall out of the dispatch tensor's sharding.
+
+Attention/norm reuse the LLaMA modules; DeepSeek-MoE-style configs (shared
+experts) map onto this with n_shared_experts > 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.moe.router import top_k_routing
+from colossalai_tpu.tensor import constrain
+
+from .base import CausalLMOutput
+from .llama import LlamaAttention, LlamaConfig, LlamaMLP, RMSNorm
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    router_z_coef: float = 0.001
+    n_shared_experts: int = 0  # DeepSeek-MoE style always-on experts
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
+        return cls(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            rope_theta=1e6, num_experts=8, num_experts_per_tok=2, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "MixtralConfig":
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("num_experts_per_tok", 2)
+        return cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, **kw,
+        )
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN with fixed capacity.
+
+    Expert weights: gate/up [E, H, I], down [E, I, H] — dim 0 sharded over
+    ``ep`` (policy), so the two dispatch einsums become all-to-alls over ICI.
+    """
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, s, h = x.shape
+        e = cfg.num_experts
+        # GShard-style group-wise routing: each batch row is a routing group
+        # with its own capacity, keeping dispatch/combine LINEAR in tokens
+        # ([B, S, E, C] with C ∝ S) instead of quadratic global routing.
+        cap = max(int(cfg.capacity_factor * s * cfg.num_experts_per_tok / e), 1)
+
+        router_w = self.param(
+            "router/kernel", nn.initializers.lecun_normal(), (h, e), pdtype
+        )
+        logits = (x @ router_w.astype(dtype)).astype(jnp.float32)  # [B, S, E]
+        routing = jax.vmap(
+            lambda lg: top_k_routing(lg, cfg.num_experts_per_tok, cap)
+        )(logits)
+
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("experts_gate/kernel", init, (e, h, cfg.intermediate_size), pdtype)
+        w_up = self.param("experts_up/kernel", init, (e, h, cfg.intermediate_size), pdtype)
+        w_down = self.param("experts_down/kernel", init, (e, cfg.intermediate_size, h), pdtype)
+
+        # dispatch: [B,S,E,C] x [B,S,H] -> [B,E,C,H]  (GSPMD: all-to-all over ep)
+        expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), x)
+        expert_in = constrain(expert_in, ("dp",), "ep", None, None)
+        gate = jnp.einsum("bech,ehi->beci", expert_in, w_gate.astype(dtype))
+        up = jnp.einsum("bech,ehi->beci", expert_in, w_up.astype(dtype))
+        act = nn.silu(gate) * up
+        expert_out = jnp.einsum("beci,eih->bech", act, w_down.astype(dtype))
+        expert_out = constrain(expert_out, ("dp",), "ep", None, None)
+        # combine: [B,S,E,C] x [B,E,C,H] -> [B,S,H]   (all-to-all back)
+        y = jnp.einsum("bsec,bech->bsh", routing.combine.astype(dtype), expert_out)
+
+        if cfg.n_shared_experts > 0:
+            shared_cfg = dataclasses.replace(
+                cfg, intermediate_size=cfg.intermediate_size * cfg.n_shared_experts
+            )
+            y = y + LlamaMLP(shared_cfg, name="shared_expert")(x)
+
+        aux = cfg.aux_loss_coef * jnp.mean(routing.aux_loss) + cfg.router_z_coef * jnp.mean(
+            routing.router_z_loss
+        )
+        return y, aux
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="input_layernorm")(x)
+        h = LlamaAttention(cfg, name="self_attn")(h, positions, segment_ids)
+        x = x + h
+        h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="post_attention_layernorm")(x)
+        h, aux = MoEMLP(cfg, name="moe")(h)
+        return x + h, aux
+
+
+class _ScanBody(nn.Module):
+    config: MixtralConfig
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        cls = nn.remat(MixtralBlock, prevent_cse=False) if self.remat else MixtralBlock
+        x, aux = cls(self.config, name="block")(x, positions, segment_ids)
+        return x, aux
+
+
+class MixtralForCausalLM(nn.Module):
+    config: MixtralConfig
+    supports_sp_modes = ("split_gather", "all_to_all", "ring_attn")
+    supports_ep = True
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name="embed_tokens",
+        )
+        x = embed(input_ids)
+        x = constrain(x, ("dp", "ep"), "sp", None)
+
+        if cfg.scan_layers:
+            Scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, aux_per_layer = Scanned(cfg, remat=cfg.remat, name="layers")(x, positions, segment_ids)
+            aux_total = jnp.sum(aux_per_layer)
+        else:
+            cls = nn.remat(MixtralBlock, prevent_cse=False) if cfg.remat else MixtralBlock
+            aux_total = 0.0
+            for i in range(cfg.num_hidden_layers):
+                x, aux = cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+                aux_total = aux_total + aux
+
+        x = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype or jnp.float32, name="lm_head",
+            )(x)
+        logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        return CausalLMOutput(logits=logits, aux_loss=aux_total)

@@ -1,0 +1,3 @@
+from .router import RoutingResult, top_k_routing
+
+__all__ = ["RoutingResult", "top_k_routing"]

@@ -1,0 +1,67 @@
+"""Top-k token routing with fixed expert capacity.
+
+≙ reference ``moe_kernel.cu`` (dispatch/combine/cumsum, 661 LoC) and
+``moe/_operation.py`` (MoeDispatch/MoeCombine/AllToAll). The CUDA design
+scatters tokens through dynamic indices; the TPU design keeps shapes static:
+a [tokens, experts, capacity] dispatch tensor turns routing into two
+einsums, and GSPMD inserts the all-to-alls when the expert dim is sharded
+over ``ep``. Fixed capacity also removes the unrouted-expert hang the
+reference documents (``moe_hybrid_parallel_plugin.py:227-234``) — empty
+slots are zeros, overflowing tokens drop (standard Switch/GShard semantics).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RoutingResult(NamedTuple):
+    dispatch: jax.Array  # [N, E, C] bool-ish float: token n -> slot c of expert e
+    combine: jax.Array  # [N, E, C] float: gate weights on the same layout
+    aux_loss: jax.Array  # load-balancing loss (Switch style)
+    router_z_loss: jax.Array  # logit magnitude regularizer
+
+
+def top_k_routing(
+    router_logits: jax.Array,  # [N, E]
+    num_selected: int,
+    capacity: int,
+) -> RoutingResult:
+    n, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)  # [N, k]
+    # renormalize the selected gates (mixtral convention)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # slot assignment: fill slot-0 choices first, then slot-1, ... so the
+    # higher-priority expert choice wins capacity (≙ moe_cumsum kernel)
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.int32)
+    for k in range(num_selected):
+        idx_k = expert_idx[:, k]  # [N]
+        mask_k = jax.nn.one_hot(idx_k, e, dtype=jnp.int32)  # [N, E]
+        pos_k = counts[None, :] + jnp.cumsum(mask_k, axis=0) - mask_k  # [N, E]
+        pos_tok = jnp.sum(pos_k * mask_k, axis=-1)  # [N]
+        keep = pos_tok < capacity
+        disp_k = (
+            jax.nn.one_hot(idx_k, e, dtype=jnp.float32)[:, :, None]
+            * jax.nn.one_hot(jnp.where(keep, pos_tok, 0), capacity, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )
+        dispatch = dispatch + disp_k
+        combine = combine + disp_k * gate_vals[:, k][:, None, None]
+        counts = counts + jnp.sum(mask_k, axis=0)
+
+    # Switch load-balancing loss: E * sum_e f_e * p_e
+    top1 = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+    frac_tokens = top1.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux_loss = e * jnp.sum(frac_tokens * frac_probs)
+    z = jax.scipy.special.logsumexp(router_logits.astype(jnp.float32), axis=-1)
+    router_z_loss = jnp.mean(z**2)
+    return RoutingResult(dispatch, combine, aux_loss, router_z_loss)

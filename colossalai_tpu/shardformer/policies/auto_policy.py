@@ -11,6 +11,7 @@ from typing import Union
 from .base_policy import Policy
 from .gpt2 import GPT2Policy
 from .llama import LlamaPolicy, MistralPolicy
+from .mixtral import DeepSeekMoEPolicy, MixtralPolicy
 
 POLICY_REGISTRY = {
     "llama": LlamaPolicy,
@@ -18,6 +19,9 @@ POLICY_REGISTRY = {
     "mistral": MistralPolicy,
     "qwen2": MistralPolicy,
     "gpt2": GPT2Policy,
+    "mixtral": MixtralPolicy,
+    "MixtralForCausalLM": MixtralPolicy,
+    "deepseek_moe": DeepSeekMoEPolicy,
     "GPT2LMHeadModel": GPT2Policy,
 }
 

@@ -91,27 +91,44 @@ def specs_to_tree(params: Any, specs: Dict[str, PartitionSpec]) -> Any:
 # ---------------------------------------------------------------- transforms
 
 
-def add_data_axis(spec: PartitionSpec, shape: Sequence[int], dp_size: int) -> PartitionSpec:
+def add_data_axis(spec: PartitionSpec, shape: Sequence[int], mesh_shape: dict) -> PartitionSpec:
     """FSDP/ZeRO-3: add the data axis to the largest unsharded, divisible dim.
 
     ≙ Gemini chunk sharding (``zero/gemini/gemini_ddp.py``) — but instead of a
     chunk VM, the weight itself carries a data-axis sharding and XLA inserts
     the all-gather before use / reduce-scatter on grads.
+
+    Params already sharded over part of the data axis (experts over ``ep``)
+    only get the remaining axes (``dp``) — each axis may appear once.
     """
+    import math
+
     entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                used.add(a)
+    axes_to_add = tuple(a for a in DATA_AXES if a not in used)
+    if not axes_to_add:
+        return PartitionSpec(*entries)
+    add_size = math.prod(mesh_shape.get(a, 1) for a in axes_to_add)
+    if add_size == 1:
+        return PartitionSpec(*entries)
     best, best_size = None, 0
     for i, (e, dim) in enumerate(zip(entries, shape)):
-        if e is None and dim % dp_size == 0 and dim > best_size:
+        if e is None and dim % add_size == 0 and dim > best_size:
             best, best_size = i, dim
     if best is None:
         return PartitionSpec(*entries)  # not divisible: stays replicated
-    entries[best] = DATA_AXES if entries[best] is None else entries[best]
+    entries[best] = axes_to_add if len(axes_to_add) > 1 else axes_to_add[0]
     return PartitionSpec(*entries)
 
 
-def tree_add_data_axis(specs: Any, params: Any, dp_size: int) -> Any:
+def tree_add_data_axis(specs: Any, params: Any, mesh) -> Any:
+    mesh_shape = dict(mesh.mesh.shape) if hasattr(mesh, "mesh") else dict(mesh.shape)
     return jax.tree.map(
-        lambda s, p: add_data_axis(s, p.shape, dp_size), specs, params,
+        lambda s, p: add_data_axis(s, p.shape, mesh_shape), specs, params,
         is_leaf=lambda x: isinstance(x, PartitionSpec),
     )
 

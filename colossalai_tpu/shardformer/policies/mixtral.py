@@ -1,0 +1,28 @@
+"""Mixtral MoE sharding policy (≙ ``shardformer/policies/mixtral.py``).
+
+Experts shard over ``ep`` on the stacked expert dim and over ``tp`` inside
+each expert; the router replicates; dense weights follow the LLaMA layout.
+"""
+
+from .base_policy import Policy
+
+
+class MixtralPolicy(Policy):
+    rules = [
+        (r"embed_tokens/embedding$", ("tp", None)),
+        (r"(q_proj|k_proj|v_proj)/kernel$", (None, "tp")),
+        (r"o_proj/kernel$", ("tp", None)),
+        # routed experts: [E, H, I] / [E, I, H]
+        (r"experts_(gate|up)/kernel$", ("ep", None, "tp")),
+        (r"experts_down/kernel$", ("ep", "tp", None)),
+        (r"router/kernel$", ()),
+        # DeepSeek-style shared experts follow dense MLP layout
+        (r"shared_expert/(gate_proj|up_proj)/kernel$", (None, "tp")),
+        (r"shared_expert/down_proj/kernel$", ("tp", None)),
+        (r"lm_head/kernel$", (None, "tp")),
+        (r"(input_layernorm|post_attention_layernorm|norm)/scale$", ()),
+    ]
+
+
+class DeepSeekMoEPolicy(MixtralPolicy):
+    """DeepSeek-MoE models share the layout (config differs, not sharding)."""
