@@ -47,17 +47,38 @@ class Booster:
         )
 
     # Checkpoint entry points (≙ booster/booster.py:121-124)
-    def save_model(self, boosted: Boosted, path: str, **kw) -> None:
-        raise NotImplementedError(
-            "checkpoint_io lands in a later milestone; "
-            "use orbax/flax.serialization on boosted.state.params meanwhile"
-        )
+    @property
+    def checkpoint_io(self):
+        from colossalai_tpu.checkpoint_io import CheckpointIO
 
-    def load_model(self, boosted: Boosted, path: str, **kw) -> TrainState:
-        raise NotImplementedError(
-            "checkpoint_io lands in a later milestone; "
-            "use orbax/flax.serialization on boosted.state.params meanwhile"
+        if not hasattr(self, "_checkpoint_io"):
+            self._checkpoint_io = CheckpointIO()
+        return self._checkpoint_io
+
+    def save_model(self, boosted: Boosted, path: str, **kw) -> None:
+        """Weights only, sharded safetensors (HF-style layout on disk)."""
+        self.checkpoint_io.save_model(boosted.state.params, path, **kw)
+
+    def load_model(self, boosted: Boosted, path: str, **kw) -> Boosted:
+        params = self.checkpoint_io.load_model(
+            path, target=boosted.state.params,
+            shardings=boosted.state_shardings.params, **kw,
         )
+        boosted.state = boosted.state.replace(params=params)
+        return boosted
+
+    def save(self, boosted: Boosted, directory: str, **kw) -> None:
+        """Full resumable state (params + optimizer + step), async orbax."""
+        self.checkpoint_io.save_state(boosted.state, directory, **kw)
+
+    def load(self, boosted: Boosted, directory: str, **kw) -> Boosted:
+        self.checkpoint_io.wait()  # a just-issued async save must be durable
+        boosted.state = self.checkpoint_io.load_state(boosted.state, directory, **kw)
+        return boosted
+
+    def wait(self) -> None:
+        """Block until async checkpoint writes are durable (call before exit)."""
+        self.checkpoint_io.wait()
 
 
 __all__ = ["Booster", "Boosted", "TrainState"]

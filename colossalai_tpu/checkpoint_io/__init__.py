@@ -1,0 +1,18 @@
+from .checkpoint_io import CheckpointIO
+from .hf_llama import hf_to_params, params_to_hf
+from .safetensors_io import (
+    flatten_params,
+    load_sharded,
+    save_sharded,
+    unflatten_params,
+)
+
+__all__ = [
+    "CheckpointIO",
+    "hf_to_params",
+    "params_to_hf",
+    "flatten_params",
+    "load_sharded",
+    "save_sharded",
+    "unflatten_params",
+]

@@ -1,0 +1,71 @@
+"""CheckpointIO: the training-state persistence surface.
+
+≙ reference ``CheckpointIO`` ABC (``checkpoint_io_base.py:18``) +
+``GeneralCheckpointIO``/``HybridParallelCheckpointIO``. Model weights go to
+HF-style safetensors (interop); the FULL train state (params + optimizer +
+step + scaler) goes through orbax, which is sharding-aware and writes
+asynchronously (≙ the reference's pinned-buffer + tensornvme async writer,
+``utils/safetensors.py:162``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from .safetensors_io import load_sharded, save_sharded
+
+
+class CheckpointIO:
+    """Default checkpoint IO: safetensors for weights, orbax for state."""
+
+    def __init__(self, async_save: bool = True):
+        self.async_save = async_save
+        self._ocp_mgr = None
+
+    # ------------------------------------------------------------ model only
+    def save_model(self, params: Any, path: str, max_shard_size: Optional[int] = None) -> None:
+        kwargs = {}
+        if max_shard_size is not None:
+            kwargs["max_shard_size"] = max_shard_size
+        save_sharded(params, path, **kwargs)
+
+    def load_model(self, path: str, target: Any, shardings: Optional[Any] = None) -> Any:
+        return load_sharded(path, target=target, shardings=shardings)
+
+    # ------------------------------------------------------- full train state
+    def _manager(self, directory: str):
+        import orbax.checkpoint as ocp
+
+        if self._ocp_mgr is None or self._ocp_dir != directory:
+            options = ocp.CheckpointManagerOptions(
+                enable_async_checkpointing=self.async_save,
+            )
+            self._ocp_mgr = ocp.CheckpointManager(
+                os.path.abspath(directory), options=options
+            )
+            self._ocp_dir = directory
+        return self._ocp_mgr
+
+    def save_state(self, state: Any, directory: str, step: Optional[int] = None) -> None:
+        """Async sharded save of the full TrainState."""
+        import orbax.checkpoint as ocp
+
+        step = int(step if step is not None else jax.device_get(state.step))
+        mgr = self._manager(directory)
+        mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def load_state(self, state: Any, directory: str, step: Optional[int] = None) -> Any:
+        """Restore into the sharded layout of ``state`` (used as template)."""
+        import orbax.checkpoint as ocp
+
+        mgr = self._manager(directory)
+        step = int(step if step is not None else mgr.latest_step())
+        return mgr.restore(step, args=ocp.args.StandardRestore(state))
+
+    def wait(self) -> None:
+        """Block until async writes are durable (call before exit)."""
+        if self._ocp_mgr is not None:
+            self._ocp_mgr.wait_until_finished()

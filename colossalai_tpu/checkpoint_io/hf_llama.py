@@ -1,0 +1,121 @@
+"""HuggingFace LLaMA checkpoint interop.
+
+≙ reference HF compatibility (``test_plugins_huggingface_compatibility.py``,
+``hybrid_parallel_checkpoint_io.py`` gather-to-HF path): convert between this
+repo's flax layout (scanned layers, [in, out] kernels) and HF transformers'
+``LlamaForCausalLM`` state dict ([out, in] weights, per-layer names).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+#: (hf template, our suffix) for per-layer weights
+_LAYER_MAP = [
+    ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel"),
+    ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel"),
+    ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel"),
+    ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel"),
+    ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel"),
+    ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel"),
+    ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel"),
+    ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale"),
+    ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale"),
+]
+
+_TOP_MAP = [
+    ("model.embed_tokens.weight", "embed_tokens.embedding"),
+    ("model.norm.weight", "norm.scale"),
+    ("lm_head.weight", "lm_head.kernel"),
+]
+
+
+def params_to_hf(params: Dict[str, Any], scanned: bool = True) -> Dict[str, np.ndarray]:
+    """Our llama param tree → HF-named state dict (numpy)."""
+    out: Dict[str, np.ndarray] = {}
+    p = params["params"] if "params" in params else params
+
+    def get(path):
+        node = p
+        for part in path.split("."):
+            node = node[part]
+        return np.asarray(node)
+
+    for hf_name, ours in _TOP_MAP:
+        if _has(p, ours):
+            arr = get(ours)
+            out[hf_name] = arr.T if ours.endswith("kernel") else arr
+
+    if scanned and "layers" in p:
+        stack = p["layers"]["block"]
+        n_layers = np.asarray(next(iter(_leaves(stack)))).shape[0]
+        for i in range(n_layers):
+            for hf_t, ours in _LAYER_MAP:
+                node = stack
+                for part in ours.split("."):
+                    node = node[part]
+                arr = np.asarray(node)[i]
+                out[hf_t.format(i=i)] = arr.T if ours.endswith("kernel") else arr
+    else:
+        i = 0
+        while f"layers_{i}" in p:
+            for hf_t, ours in _LAYER_MAP:
+                node = p[f"layers_{i}"]
+                for part in ours.split("."):
+                    node = node[part]
+                arr = np.asarray(node)
+                out[hf_t.format(i=i)] = arr.T if ours.endswith("kernel") else arr
+            i += 1
+    return out
+
+
+def hf_to_params(state: Dict[str, np.ndarray], num_layers: int, scanned: bool = True, tie_word_embeddings: bool = False) -> Dict[str, Any]:
+    """HF-named state dict → our llama param tree (numpy leaves)."""
+    p: Dict[str, Any] = {}
+
+    def put(path, val):
+        node = p
+        parts = path.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = val
+
+    for hf_name, ours in _TOP_MAP:
+        if hf_name == "lm_head.weight" and tie_word_embeddings:
+            continue
+        arr = state[hf_name]
+        put(ours, arr.T if ours.endswith("kernel") else arr)
+
+    if scanned:
+        for _, ours in _LAYER_MAP:
+            per_layer = []
+            for i in range(num_layers):
+                hf_name = [t for t, o in _LAYER_MAP if o == ours][0].format(i=i)
+                arr = state[hf_name]
+                per_layer.append(arr.T if ours.endswith("kernel") else arr)
+            put("layers.block." + ours, np.stack(per_layer, axis=0))
+    else:
+        for i in range(num_layers):
+            for hf_t, ours in _LAYER_MAP:
+                arr = state[hf_t.format(i=i)]
+                put(f"layers_{i}." + ours, arr.T if ours.endswith("kernel") else arr)
+    return p
+
+
+def _has(tree, dotted):
+    node = tree
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    return True
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    else:
+        yield tree

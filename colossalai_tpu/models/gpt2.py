@@ -45,7 +45,8 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, segment_ids=None):
+    def __call__(self, x, positions=None, segment_ids=None):
+        del positions  # learned positional embeddings are added at the stem
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         pdtype = cfg.param_dtype or jnp.float32
@@ -73,16 +74,6 @@ class GPT2Block(nn.Module):
         return x + h
 
 
-class _ScanBody(nn.Module):
-    config: GPT2Config
-    remat: bool = False
-
-    @nn.compact
-    def __call__(self, x, segment_ids):
-        cls = nn.remat(GPT2Block, prevent_cse=False) if self.remat else GPT2Block
-        return cls(self.config, name="block")(x, segment_ids), None
-
-
 class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
     #: GPT-2 only wires the Megatron-style seq-sharded activations
@@ -104,20 +95,9 @@ class GPT2LMHeadModel(nn.Module):
         x = wte(input_ids) + wpe(positions)
         x = constrain(x, ("dp", "ep"), "sp", None)
 
-        if cfg.scan_layers:
-            Scanned = nn.scan(
-                _ScanBody,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast,),
-                length=cfg.num_hidden_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, _ = Scanned(cfg, remat=cfg.remat, name="h")(x, segment_ids)
-        else:
-            cls = nn.remat(GPT2Block, prevent_cse=False) if cfg.remat else GPT2Block
-            for i in range(cfg.num_hidden_layers):
-                x = cls(cfg, name=f"h_{i}")(x, segment_ids)
+        from .stack import apply_decoder_stack
+
+        x, _ = apply_decoder_stack(self, GPT2Block, x, positions, segment_ids, name="h")
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:

@@ -205,19 +205,6 @@ class LlamaBlock(nn.Module):
         return x + h
 
 
-class _ScanBody(nn.Module):
-    """Adapts LlamaBlock to lax.scan's (carry, ys) convention."""
-
-    config: LlamaConfig
-    remat: bool = False
-
-    @nn.compact
-    def __call__(self, x, positions, segment_ids):
-        block_cls = nn.remat(LlamaBlock, prevent_cse=False) if self.remat else LlamaBlock
-        x = block_cls(self.config, name="block")(x, positions, segment_ids)
-        return x, None
-
-
 class LlamaForCausalLM(nn.Module):
     """Decoder-only LM. Param tree lays out HF-style for checkpoint interop."""
 
@@ -242,45 +229,9 @@ class LlamaForCausalLM(nn.Module):
         x = embed(input_ids)
         x = constrain(x, ("dp", "ep"), "sp", None)
 
-        if cfg.scan_layers and cfg.pp_microbatches > 0 and not self.is_initializing():
-            # pipeline path: params were created by the scan below during
-            # init (stacked [L, ...], sharded over pp by the policy); here
-            # they are consumed functionally by the streaming schedule
-            from colossalai_tpu.pipeline import pipeline_blocks
-            from colossalai_tpu.tensor import current_mesh
+        from .stack import apply_decoder_stack
 
-            mesh = current_mesh()
-            if mesh is None:
-                raise RuntimeError("pipeline parallelism requires an ambient mesh")
-            stacked = self.scope.get_variable("params", "layers")["block"]
-            block = LlamaBlock(cfg)
-
-            def block_apply(p, h, aux):
-                return block.apply(
-                    {"params": p}, h, aux["positions"], aux.get("segment_ids")
-                )
-
-            aux = {"positions": positions}
-            if segment_ids is not None:
-                aux["segment_ids"] = segment_ids
-            x = pipeline_blocks(
-                block_apply, stacked, x, mesh, cfg.pp_microbatches,
-                aux=aux, remat=cfg.remat,
-            )
-        elif cfg.scan_layers:
-            Scanned = nn.scan(
-                _ScanBody,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast),
-                length=cfg.num_hidden_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, _ = Scanned(cfg, remat=cfg.remat, name="layers")(x, positions, segment_ids)
-        else:
-            block_cls = nn.remat(LlamaBlock, prevent_cse=False) if cfg.remat else LlamaBlock
-            for i in range(cfg.num_hidden_layers):
-                x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+        x, _ = apply_decoder_stack(self, LlamaBlock, x, positions, segment_ids)
 
         x = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="norm")(x)
 

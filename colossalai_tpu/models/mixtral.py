@@ -31,6 +31,9 @@ class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     num_experts_per_tok: int = 2
     capacity_factor: float = 1.25
+    #: tokens per routing group (GShard): capacity is per-group so the
+    #: dispatch tensors stay linear in sequence length
+    router_group_size: int = 512
     aux_loss_coef: float = 0.01
     router_z_coef: float = 0.001
     n_shared_experts: int = 0  # DeepSeek-MoE style always-on experts
@@ -40,7 +43,8 @@ class MixtralConfig(LlamaConfig):
         return cls(
             vocab_size=32000, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
-            rope_theta=1e6, num_experts=8, num_experts_per_tok=2, **kw,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=8, num_experts_per_tok=2, **kw,
         )
 
     @classmethod
@@ -70,15 +74,20 @@ class MoEMLP(nn.Module):
         pdtype = cfg.param_dtype or jnp.float32
         b, s, h = x.shape
         e = cfg.num_experts
-        # GShard-style group-wise routing: each batch row is a routing group
-        # with its own capacity, keeping dispatch/combine LINEAR in tokens
-        # ([B, S, E, C] with C ∝ S) instead of quadratic global routing.
-        cap = max(int(cfg.capacity_factor * s * cfg.num_experts_per_tok / e), 1)
+        # GShard-style group-wise routing: fixed-size token groups, capacity
+        # per group — dispatch/combine are [G, g, E, C] with C ∝ g, linear in
+        # total tokens.
+        g = min(cfg.router_group_size, s)
+        if s % g:
+            g = s  # fall back to one group per row for odd lengths
+        n_groups = b * s // g
+        cap = max(int(cfg.capacity_factor * g * cfg.num_experts_per_tok / e), 1)
 
         router_w = self.param(
             "router/kernel", nn.initializers.lecun_normal(), (h, e), pdtype
         )
-        logits = (x @ router_w.astype(dtype)).astype(jnp.float32)  # [B, S, E]
+        xg = x.reshape(n_groups, g, h)
+        logits = (xg @ router_w.astype(dtype)).astype(jnp.float32)  # [G, g, E]
         routing = jax.vmap(
             lambda lg: top_k_routing(lg, cfg.num_experts_per_tok, cap)
         )(logits)
@@ -88,16 +97,16 @@ class MoEMLP(nn.Module):
         w_up = self.param("experts_up/kernel", init, (e, h, cfg.intermediate_size), pdtype)
         w_down = self.param("experts_down/kernel", init, (e, cfg.intermediate_size, h), pdtype)
 
-        # dispatch: [B,S,E,C] x [B,S,H] -> [B,E,C,H]  (GSPMD: all-to-all over ep)
-        expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), x)
+        # dispatch: [G,g,E,C] x [G,g,H] -> [G,E,C,H]  (GSPMD: all-to-all over ep)
+        expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), xg)
         expert_in = constrain(expert_in, ("dp",), "ep", None, None)
         gate = jnp.einsum("bech,ehi->beci", expert_in, w_gate.astype(dtype))
         up = jnp.einsum("bech,ehi->beci", expert_in, w_up.astype(dtype))
         act = nn.silu(gate) * up
         expert_out = jnp.einsum("beci,eih->bech", act, w_down.astype(dtype))
         expert_out = constrain(expert_out, ("dp",), "ep", None, None)
-        # combine: [B,S,E,C] x [B,E,C,H] -> [B,S,H]   (all-to-all back)
-        y = jnp.einsum("bsec,bech->bsh", routing.combine.astype(dtype), expert_out)
+        # combine: [G,g,E,C] x [G,E,C,H] -> [G,g,H]   (all-to-all back)
+        y = jnp.einsum("bsec,bech->bsh", routing.combine.astype(dtype), expert_out).reshape(b, s, h)
 
         if cfg.n_shared_experts > 0:
             shared_cfg = dataclasses.replace(
@@ -126,17 +135,6 @@ class MixtralBlock(nn.Module):
         return x + h, aux
 
 
-class _ScanBody(nn.Module):
-    config: MixtralConfig
-    remat: bool = False
-
-    @nn.compact
-    def __call__(self, x, positions, segment_ids):
-        cls = nn.remat(MixtralBlock, prevent_cse=False) if self.remat else MixtralBlock
-        x, aux = cls(self.config, name="block")(x, positions, segment_ids)
-        return x, aux
-
-
 class MixtralForCausalLM(nn.Module):
     config: MixtralConfig
     supports_sp_modes = ("split_gather", "all_to_all", "ring_attn")
@@ -157,23 +155,11 @@ class MixtralForCausalLM(nn.Module):
         x = embed(input_ids)
         x = constrain(x, ("dp", "ep"), "sp", None)
 
-        if cfg.scan_layers:
-            Scanned = nn.scan(
-                _ScanBody,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast),
-                length=cfg.num_hidden_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, aux_per_layer = Scanned(cfg, remat=cfg.remat, name="layers")(x, positions, segment_ids)
-            aux_total = jnp.sum(aux_per_layer)
-        else:
-            cls = nn.remat(MixtralBlock, prevent_cse=False) if cfg.remat else MixtralBlock
-            aux_total = 0.0
-            for i in range(cfg.num_hidden_layers):
-                x, aux = cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
-                aux_total = aux_total + aux
+        from .stack import apply_decoder_stack
+
+        x, aux_total = apply_decoder_stack(
+            self, MixtralBlock, x, positions, segment_ids, has_aux=True
+        )
 
         x = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="norm")(x)
         if cfg.tie_word_embeddings:

@@ -1,0 +1,101 @@
+"""Shared decoder-stack scaffolding (scan / unroll / pipeline / aux).
+
+Every decoder-only LM (llama, mixtral, ...) runs the same layer-stack
+machinery; only the block differs. Blocks return either ``x`` or
+``(x, aux_scalar)`` — aux (MoE balancing losses) is threaded through the
+scan as per-layer outputs and summed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _ScanBody(nn.Module):
+    block_cls: Type[nn.Module]
+    config: Any
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        cls = nn.remat(self.block_cls, prevent_cse=False) if self.remat else self.block_cls
+        out = cls(self.config, name="block")(x, positions, segment_ids)
+        if isinstance(out, tuple):
+            x, aux = out
+        else:
+            x, aux = out, jnp.zeros((), jnp.float32)
+        return x, aux
+
+
+def apply_decoder_stack(
+    parent: nn.Module,
+    block_cls: Type[nn.Module],
+    x,
+    positions,
+    segment_ids,
+    *,
+    has_aux: bool = False,
+    name: str = "layers",
+) -> Tuple[Any, Optional[Any]]:
+    """Run cfg.num_hidden_layers blocks; returns (x, aux_total|None).
+
+    Must be called from the parent's ``@nn.compact`` ``__call__``. Handles
+    the scanned stack, the unrolled fallback, and the pipeline-parallel
+    streaming path (``cfg.pp_microbatches > 0``).
+    """
+    cfg = parent.config
+
+    if cfg.scan_layers and cfg.pp_microbatches > 0 and not parent.is_initializing():
+        if has_aux:
+            raise NotImplementedError(
+                "auxiliary-loss models (MoE) under pipeline parallelism: aux "
+                "collection through the pp stream is not wired yet"
+            )
+        from colossalai_tpu.pipeline import pipeline_blocks
+        from colossalai_tpu.tensor import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError("pipeline parallelism requires an ambient mesh")
+        stacked = parent.scope.get_variable("params", name)["block"]
+        block = block_cls(cfg)
+
+        def block_apply(p, h, aux_in):
+            return block.apply({"params": p}, h, aux_in["positions"], aux_in.get("segment_ids"))
+
+        aux_in = {"positions": positions}
+        if segment_ids is not None:
+            aux_in["segment_ids"] = segment_ids
+        x = pipeline_blocks(
+            block_apply, stacked, x, mesh, cfg.pp_microbatches,
+            aux=aux_in, remat=cfg.remat,
+        )
+        return x, None
+
+    if cfg.scan_layers:
+        Scanned = nn.scan(
+            _ScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast),
+            length=cfg.num_hidden_layers,
+            metadata_params={nn.PARTITION_NAME: name},
+        )
+        x, aux_per_layer = Scanned(block_cls, cfg, remat=cfg.remat, name=name)(
+            x, positions, segment_ids
+        )
+        return x, (jnp.sum(aux_per_layer) if has_aux else None)
+
+    cls = nn.remat(block_cls, prevent_cse=False) if cfg.remat else block_cls
+    aux_total = jnp.zeros((), jnp.float32)
+    for i in range(cfg.num_hidden_layers):
+        out = cls(cfg, name=f"{name}_{i}")(x, positions, segment_ids)
+        if isinstance(out, tuple):
+            x, aux = out
+            aux_total = aux_total + aux
+        else:
+            x = out
+    return x, (aux_total if has_aux else None)
