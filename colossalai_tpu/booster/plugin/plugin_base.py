@@ -147,10 +147,38 @@ class Plugin(abc.ABC):
             mesh,
             shard_over_data=(self.zero_stage >= 1 and not self.fsdp),
         )
+        opt_memory_kind = None
+        if getattr(self, "offload_optim", False):
+            # host-offloaded optimizer states (≙ HybridAdam/Gemini offload):
+            # states live in pinned host memory; XLA streams them through the
+            # update. Probe with a real jitted transfer — some backends accept
+            # the sharding but cannot compile host-memory placement.
+            try:
+                host = NamedSharding(mesh.mesh, PartitionSpec(), memory_kind="pinned_host")
+                probe = jax.jit(lambda: jnp.zeros((8,)), out_shardings=host)
+                jax.device_get(probe())
+                opt_memory_kind = "pinned_host"
+            except Exception:
+                from colossalai_tpu.logging import get_dist_logger
+
+                get_dist_logger().warning(
+                    "offload_optim requested but this runtime cannot place "
+                    "arrays in pinned host memory; optimizer states stay in "
+                    "device memory"
+                )
         opt_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh.mesh, s), opt_specs,
+            lambda s: NamedSharding(mesh.mesh, s, memory_kind=opt_memory_kind),
+            opt_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
+        opt_shardings_device = None
+        if opt_memory_kind:
+            # device-resident twin layout: the train step streams host states
+            # through these before the update and back out via out_shardings
+            opt_shardings_device = jax.tree.map(
+                lambda s: s.with_memory_kind("device"), opt_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            )
 
         scaler = init_grad_scaler() if self.precision == "fp16" else None
         replicated = NamedSharding(mesh.mesh, PartitionSpec())
@@ -186,7 +214,8 @@ class Plugin(abc.ABC):
             )
 
         train_step = self._build_train_step(
-            model, optimizer, loss_fn, mesh, state_shardings, grad_shardings
+            model, optimizer, loss_fn, mesh, state_shardings, grad_shardings,
+            opt_shardings_device,
         )
         eval_step = self._build_eval_step(model, loss_fn, mesh, state_shardings)
 
@@ -203,12 +232,18 @@ class Plugin(abc.ABC):
         )
 
     # ------------------------------------------------------------ train step
-    def _build_train_step(self, model, optimizer, loss_fn, mesh, state_shardings, grad_shardings=None):
+    def _build_train_step(self, model, optimizer, loss_fn, mesh, state_shardings, grad_shardings=None, opt_shardings_device=None):
         batch_sharding = mesh.sharding(*mesh.batch_spec())
         precision = self.precision
 
         def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
             inputs = _model_inputs(batch)
+            if opt_shardings_device is not None:
+                # host-offloaded states: stream to device for the update;
+                # out_shardings move the new states back to pinned host
+                state = state.replace(
+                    opt_state=jax.device_put(state.opt_state, opt_shardings_device)
+                )
 
             def compute_loss(params):
                 out = model.apply({"params": params}, **inputs)

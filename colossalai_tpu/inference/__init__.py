@@ -1,0 +1,12 @@
+from .engine import GenerationConfig, LLMEngine, Request
+from .modeling import KVCache, decode_step, init_cache, prefill
+
+__all__ = [
+    "GenerationConfig",
+    "LLMEngine",
+    "Request",
+    "KVCache",
+    "decode_step",
+    "init_cache",
+    "prefill",
+]

@@ -1,0 +1,205 @@
+"""Continuous-batching inference engine.
+
+≙ reference ``LLMEngine`` (``inference/core/llm_engine.py:46``) +
+``RequestHandler`` scheduler (``request_handler.py:140``) + ``BatchBucket``
+(``batch_bucket.py``) + ``KVCacheManager`` (``kvcache_manager.py:18``).
+Design deltas for TPU/XLA:
+
+- static shapes: a fixed pool of decode slots with a [L, slots, S_max]
+  KV cache (slot cache; paged block tables are a later refinement) —
+  recompiles happen only per prompt-length bucket, not per request;
+- prefill runs per-request (padded to a bucket) and scatters K/V into the
+  request's slot; decode advances ALL running slots in one jitted step —
+  that interleaving is the continuous batching;
+- sampling (greedy / temperature / top-k / top-p) is jitted alongside.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colossalai_tpu.models.llama import LlamaConfig
+
+from .modeling import KVCache, decode_step, init_cache, prefill
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    max_new_tokens: int = 64
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = off
+    top_p: float = 1.0
+    do_sample: bool = False
+    eos_token_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: int
+    prompt_ids: List[int]
+    gen: GenerationConfig
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    finished: bool = False
+
+
+def _sample(logits, rng, gen: GenerationConfig):
+    if not gen.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / max(gen.temperature, 1e-5)
+    if gen.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -gen.top_k][..., None]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if gen.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < gen.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e9, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+class LLMEngine:
+    """Slot-based continuous batching over a llama-family model."""
+
+    def __init__(
+        self,
+        params,
+        config: LlamaConfig,
+        max_batch_size: int = 8,
+        max_seq_len: int = 1024,
+        prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024),
+        seed: int = 0,
+    ):
+        self.params = params
+        self.config = config
+        self.max_batch = max_batch_size
+        self.max_seq = max_seq_len
+        self.buckets = tuple(b for b in sorted(prefill_buckets) if b <= max_seq_len)
+        dtype = config.dtype or jnp.bfloat16
+        self.cache = init_cache(config, max_batch_size, max_seq_len, dtype=dtype)
+        self._rng = jax.random.PRNGKey(seed)
+        self._ids = itertools.count()
+        self.waiting: List[Request] = []
+        self.running: Dict[int, Request] = {}  # slot -> request
+        self._slot_tokens = np.zeros((max_batch_size,), np.int64)
+
+    # ------------------------------------------------------------- frontend
+    def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None) -> int:
+        req = Request(next(self._ids), list(map(int, prompt_ids)), gen or GenerationConfig())
+        if len(req.prompt_ids) >= self.max_seq:
+            raise ValueError(f"prompt length {len(req.prompt_ids)} >= max_seq_len {self.max_seq}")
+        self.waiting.append(req)
+        return req.request_id
+
+    def generate(self, prompts: List[List[int]], gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        """Blocking batch API (≙ LLMEngine.generate :496)."""
+        order = [self.add_request(p, gen) for p in prompts]
+        done: Dict[int, Request] = {}
+        while self.waiting or self.running:
+            for req in self.step():
+                done[req.request_id] = req
+        return [done[rid].output_ids for rid in order]
+
+    # ------------------------------------------------------------ scheduler
+    def _free_slots(self) -> List[int]:
+        return [s for s in range(self.max_batch) if s not in self.running]
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_seq
+
+    def step(self) -> List[Request]:
+        """Admit waiting requests into free slots (prefill), then advance all
+        running slots one token (decode). Returns newly finished requests."""
+        # ---- admission/prefill (≙ RequestHandler.schedule)
+        finished_at_prefill: List[Request] = []
+        for slot in self._free_slots():
+            if not self.waiting:
+                break
+            req = self.waiting.pop(0)
+            req.slot = slot
+            self._prefill_into_slot(req)
+            # the prefill already produced the first token — it may finish
+            if self._is_finished(req, req.output_ids[-1]):
+                req.finished = True
+                finished_at_prefill.append(req)
+                self.cache = KVCache(
+                    k=self.cache.k, v=self.cache.v,
+                    lengths=self.cache.lengths.at[slot].set(0),
+                )
+            else:
+                self.running[slot] = req
+
+        if not self.running:
+            return finished_at_prefill
+
+        # ---- decode tick for every running slot
+        tokens = jnp.asarray(self._slot_tokens, jnp.int32)
+        logits, self.cache = decode_step(self.params, self.config, tokens, self.cache)
+        next_np = np.asarray(jnp.argmax(logits, axis=-1))
+
+        finished: List[Request] = []
+        for slot, req in list(self.running.items()):
+            tok = self._pick_token(logits[slot], next_np[slot], req.gen)
+            req.output_ids.append(tok)
+            self._slot_tokens[slot] = tok
+            if self._is_finished(req, tok):
+                req.finished = True
+                finished.append(req)
+                self._release(slot)
+        return finished_at_prefill + finished
+
+    def _pick_token(self, row_logits, greedy_tok, gen: GenerationConfig) -> int:
+        """Per-request sampling with the request's OWN config."""
+        if not gen.do_sample:
+            return int(greedy_tok)
+        self._rng, key = jax.random.split(self._rng)
+        return int(np.asarray(_sample(row_logits[None], key, gen)[0]))
+
+    def _is_finished(self, req: Request, last_tok: int) -> bool:
+        total = len(req.prompt_ids) + len(req.output_ids)
+        hit_eos = req.gen.eos_token_id is not None and last_tok == req.gen.eos_token_id
+        return (
+            hit_eos
+            or len(req.output_ids) >= req.gen.max_new_tokens
+            or total >= self.max_seq - 1
+        )
+
+    # -------------------------------------------------------------- internal
+    def _prefill_into_slot(self, req: Request) -> None:
+        n = len(req.prompt_ids)
+        bucket = self._bucket(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.prompt_ids
+        mini = init_cache(self.config, 1, self.max_seq, dtype=self.cache.k.dtype)
+        logits, mini = prefill(
+            self.params, self.config, jnp.asarray(ids), mini, jnp.asarray([n], jnp.int32)
+        )
+        slot = req.slot
+        self.cache = KVCache(
+            k=self.cache.k.at[:, slot].set(mini.k[:, 0]),
+            v=self.cache.v.at[:, slot].set(mini.v[:, 0]),
+            lengths=self.cache.lengths.at[slot].set(n),
+        )
+        # first generated token comes from the prefill logits; honor the
+        # request's sampling config here too
+        tok = self._pick_token(logits[0], int(np.asarray(jnp.argmax(logits[0]))), req.gen)
+        req.output_ids.append(tok)
+        self._slot_tokens[slot] = tok
+
+    def _release(self, slot: int) -> None:
+        del self.running[slot]
+        self.cache = KVCache(
+            k=self.cache.k, v=self.cache.v,
+            lengths=self.cache.lengths.at[slot].set(0),
+        )

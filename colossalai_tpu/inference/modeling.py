@@ -1,0 +1,184 @@
+"""Cache-aware decode forwards.
+
+≙ reference inference modeling rewrites (``nopadding_llama.py``, 677 LoC,
+backed by context_attn_unpad / flash_decoding / kvcache_copy kernels). The
+training modules stay cache-free; these functions re-run the same param
+tree functionally with a static-shape KV cache:
+
+- prefill: full-sequence forward that also returns per-layer K/V;
+- decode_step: one-token forward reading/writing the cache in place
+  (``lax.dynamic_update_slice`` ≙ decode_kv_cache_memcpy kernel).
+
+Static shapes everywhere: the cache is [L, B, S_max, Hkv, D]; attention
+masks by position, so padded slots never contribute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, S_max, Hkv, D]
+    v: jax.Array  # [L, B, S_max, Hkv, D]
+    lengths: jax.Array  # [B] current length per slot
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_hidden_layers, batch, max_len, cfg.num_key_value_heads, cfg.head_dim_)
+    return KVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def _rms(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    return (x32 * jax.lax.rsqrt(jnp.mean(x32**2, -1, keepdims=True) + eps) * scale).astype(x.dtype)
+
+
+def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask):
+    """One decoder block over x [B, S, H] attending to the cache + itself.
+
+    k_cache/v_cache: [B, S_max, Hkv, D] already containing THIS x's K/V at
+    ``positions``. ``kv_valid_mask``: [B, S_max] True where cache is valid.
+    """
+    dtype = x.dtype
+    eps = cfg.rms_norm_eps
+    hd = cfg.head_dim_
+    b, s, _ = x.shape
+
+    h = _rms(x, p["input_layernorm"]["scale"], eps)
+    q = h @ p["self_attn"]["q_proj"]["kernel"].astype(dtype)
+    q = q.reshape(b, s, cfg.num_attention_heads, hd)
+    cos, sin = rope_table(positions, hd, cfg.rope_theta)
+    q = apply_rope(q, cos, sin)
+
+    group = cfg.num_attention_heads // cfg.num_key_value_heads
+    qg = q.reshape(b, s, cfg.num_key_value_heads, group, hd)
+    scores = jnp.einsum(
+        "bshgd,bthd->bhgst", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    kv_pos = jnp.arange(k_cache.shape[1])[None, :]  # [1, S_max]
+    causal = positions[:, :, None] >= kv_pos[:, None, :]  # [B, S, S_max]
+    mask = causal & kv_valid_mask[:, None, :]
+    scores = jnp.where(mask[:, None, None], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    attn = jnp.einsum("bhgst,bthd->bshgd", probs, v_cache, preferred_element_type=jnp.float32)
+    attn = attn.reshape(b, s, cfg.num_attention_heads * hd).astype(dtype)
+    x = x + attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype)
+
+    h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
+    gate = h @ p["mlp"]["gate_proj"]["kernel"].astype(dtype)
+    up = h @ p["mlp"]["up_proj"]["kernel"].astype(dtype)
+    act = jax.nn.silu(gate) * up
+    return x + act @ p["mlp"]["down_proj"]["kernel"].astype(dtype)
+
+
+def _project_kv(cfg, p, h_normed, positions):
+    dtype = h_normed.dtype
+    hd = cfg.head_dim_
+    b, s, _ = h_normed.shape
+    k = (h_normed @ p["self_attn"]["k_proj"]["kernel"].astype(dtype)).reshape(
+        b, s, cfg.num_key_value_heads, hd
+    )
+    v = (h_normed @ p["self_attn"]["v_proj"]["kernel"].astype(dtype)).reshape(
+        b, s, cfg.num_key_value_heads, hd
+    )
+    cos, sin = rope_table(positions, hd, cfg.rope_theta)
+    return apply_rope(k, cos, sin), v
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def prefill(params, cfg: LlamaConfig, input_ids, cache: KVCache, slot_lengths) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt [B, S] (right-padded; true lengths ``slot_lengths``),
+    fill the cache, return last-valid-token logits [B, V]."""
+    p = params["params"] if "params" in params else params
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    b, s = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[input_ids]
+    s_max = cache.k.shape[2]
+    valid_now = jnp.arange(s_max)[None, :] < slot_lengths[:, None]
+
+    k_new = jnp.zeros_like(cache.k)
+    v_new = jnp.zeros_like(cache.v)
+
+    def layer(carry, layer_params):
+        x, k_all, v_all, i = carry
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)
+        k_l = jax.lax.dynamic_update_slice(
+            jnp.zeros((b, s_max) + k.shape[2:], k.dtype), k, (0, 0, 0, 0)
+        )
+        v_l = jax.lax.dynamic_update_slice(
+            jnp.zeros((b, s_max) + v.shape[2:], v.dtype), v, (0, 0, 0, 0)
+        )
+        x = _block_step(cfg, layer_params, x, k_l, v_l, positions, valid_now)
+        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_l, i, 0)
+        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_l, i, 0)
+        return (x, k_all, v_all, i + 1), None
+
+    (x, k_new, v_new, _), _ = jax.lax.scan(
+        layer, (x.astype(dtype), k_new, v_new, 0), stacked
+    )
+
+    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    else:
+        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    # pick logits of each slot's last real token
+    last = jnp.take_along_axis(
+        logits, (slot_lengths - 1)[:, None, None].clip(0), axis=1
+    )[:, 0]
+    return last, KVCache(k=k_new, v=v_new, lengths=slot_lengths)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def decode_step(params, cfg: LlamaConfig, tokens, cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """One token per slot: tokens [B] → logits [B, V], cache advanced."""
+    p = params["params"] if "params" in params else params
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    b = tokens.shape[0]
+    positions = cache.lengths[:, None]  # [B, 1]
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[tokens][:, None, :]  # [B,1,H]
+    s_max = cache.k.shape[2]
+    valid = jnp.arange(s_max)[None, :] <= cache.lengths[:, None]  # includes new token
+
+    def write_at(cache_l, new):  # [B,S_max,...] <- [B,1,...] at per-row lengths
+        idx = cache.lengths  # [B]
+        return jax.vmap(
+            lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0))
+        )(cache_l, new, idx)
+
+    def layer(carry, inputs):
+        x, i = carry
+        layer_params, k_all, v_all = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)
+        k_l = write_at(k_all, k)
+        v_l = write_at(v_all, v)
+        x = _block_step(cfg, layer_params, x, k_l, v_l, positions, valid)
+        return (x, i + 1), (k_l, v_l)
+
+    (x, _), (k_new, v_new) = jax.lax.scan(
+        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+    )
+
+    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    else:
+        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    return logits[:, 0], KVCache(k=k_new, v=v_new, lengths=cache.lengths + 1)

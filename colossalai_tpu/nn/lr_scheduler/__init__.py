@@ -1,0 +1,71 @@
+"""LR schedules with the reference's scheduler-zoo surface
+(≙ ``colossalai/nn/lr_scheduler``: cosine/linear/onecycle/poly/multistep +
+delayed-warmup wrappers), expressed as optax schedules."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import optax
+
+
+def _with_warmup(schedule, warmup_steps: int, peak_lr: float):
+    if warmup_steps <= 0:
+        return schedule
+    warmup = optax.linear_schedule(0.0, peak_lr, warmup_steps)
+    return optax.join_schedules([warmup, schedule], [warmup_steps])
+
+
+def cosine_annealing_lr(lr: float, total_steps: int, warmup_steps: int = 0, eta_min: float = 0.0):
+    body = optax.cosine_decay_schedule(
+        lr, max(total_steps - warmup_steps, 1), alpha=eta_min / lr if lr else 0.0
+    )
+    return _with_warmup(body, warmup_steps, lr)
+
+
+def linear_warmup_lr(lr: float, total_steps: int, warmup_steps: int = 0, end_lr: float = 0.0):
+    body = optax.linear_schedule(lr, end_lr, max(total_steps - warmup_steps, 1))
+    return _with_warmup(body, warmup_steps, lr)
+
+
+def polynomial_lr(lr: float, total_steps: int, power: float = 1.0, warmup_steps: int = 0, end_lr: float = 0.0):
+    body = optax.polynomial_schedule(lr, end_lr, power, max(total_steps - warmup_steps, 1))
+    return _with_warmup(body, warmup_steps, lr)
+
+
+def multistep_lr(lr: float, milestones: Sequence[int], gamma: float = 0.1):
+    return optax.piecewise_constant_schedule(lr, {m: gamma for m in milestones})
+
+
+def onecycle_lr(lr: float, total_steps: int, pct_start: float = 0.3, div_factor: float = 25.0, final_div_factor: float = 1e4):
+    return optax.cosine_onecycle_schedule(
+        total_steps, lr, pct_start=pct_start, div_factor=div_factor,
+        final_div_factor=final_div_factor,
+    )
+
+
+def constant_lr(lr: float, warmup_steps: int = 0):
+    return _with_warmup(optax.constant_schedule(lr), warmup_steps, lr)
+
+
+CosineAnnealingLR = cosine_annealing_lr
+CosineAnnealingWarmupLR = cosine_annealing_lr
+LinearWarmupLR = linear_warmup_lr
+PolynomialLR = polynomial_lr
+MultiStepLR = multistep_lr
+OneCycleLR = onecycle_lr
+
+__all__ = [
+    "cosine_annealing_lr",
+    "linear_warmup_lr",
+    "polynomial_lr",
+    "multistep_lr",
+    "onecycle_lr",
+    "constant_lr",
+    "CosineAnnealingLR",
+    "CosineAnnealingWarmupLR",
+    "LinearWarmupLR",
+    "PolynomialLR",
+    "MultiStepLR",
+    "OneCycleLR",
+]

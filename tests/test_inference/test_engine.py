@@ -1,0 +1,109 @@
+"""Inference engine tests (≙ reference tests/test_inference/): decode path
+must match the training forward, and continuous batching must schedule
+correctly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine, init_cache, prefill, decode_step
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+RNG = np.random.RandomState(0)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    return cfg, model, params
+
+
+def test_prefill_matches_training_forward(model_and_params):
+    cfg, model, params = model_and_params
+    ids = jnp.asarray(RNG.randint(0, cfg.vocab_size, size=(2, 12)))
+    train_logits = model.apply(params, ids).logits
+
+    cache = init_cache(cfg, 2, 32, dtype=jnp.float32)
+    last, cache = prefill(params, cfg, ids, cache, jnp.asarray([12, 12], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(last), np.asarray(train_logits[:, -1]), atol=2e-4, rtol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(cache.lengths), [12, 12])
+
+
+def test_decode_matches_training_forward(model_and_params):
+    """Greedy decode via the cache == rerunning the full forward each step."""
+    cfg, model, params = model_and_params
+    prompt = RNG.randint(0, cfg.vocab_size, size=(1, 6))
+
+    # reference: full forward argmax loop
+    seq = list(prompt[0])
+    for _ in range(5):
+        logits = model.apply(params, jnp.asarray([seq])).logits
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    ref_out = seq[6:]
+
+    # cached path
+    cache = init_cache(cfg, 1, 32, dtype=jnp.float32)
+    last, cache = prefill(params, cfg, jnp.asarray(prompt), cache, jnp.asarray([6], jnp.int32))
+    out = [int(jnp.argmax(last[0]))]
+    for _ in range(4):
+        logits, cache = decode_step(params, cfg, jnp.asarray(out[-1:], jnp.int32), cache)
+        out.append(int(jnp.argmax(logits[0])))
+    assert out == ref_out, (out, ref_out)
+
+
+def test_engine_generate(model_and_params):
+    cfg, _, params = model_and_params
+    engine = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64)
+    prompts = [list(RNG.randint(0, cfg.vocab_size, size=(n,))) for n in (5, 9, 3)]
+    outs = engine.generate(prompts, GenerationConfig(max_new_tokens=6))
+    assert len(outs) == 3
+    assert all(len(o) == 6 for o in outs)
+    # engine drained
+    assert not engine.waiting and not engine.running
+
+
+def test_engine_continuous_batching_overflow(model_and_params):
+    """More requests than slots: scheduler runs waves (≙ RequestHandler)."""
+    cfg, _, params = model_and_params
+    engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64)
+    prompts = [list(RNG.randint(0, cfg.vocab_size, size=(4,))) for _ in range(5)]
+    outs = engine.generate(prompts, GenerationConfig(max_new_tokens=4))
+    assert len(outs) == 5
+    assert all(len(o) == 4 for o in outs)
+
+
+def test_engine_matches_uncached(model_and_params):
+    """Engine greedy output == the full-forward greedy loop."""
+    cfg, model, params = model_and_params
+    prompt = list(RNG.randint(0, cfg.vocab_size, size=(7,)))
+    seq = list(prompt)
+    for _ in range(5):
+        logits = model.apply(params, jnp.asarray([seq])).logits
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64)
+    outs = engine.generate([prompt], GenerationConfig(max_new_tokens=5))
+    assert outs[0] == seq[7:]
+
+
+def test_engine_eos_stops(model_and_params):
+    cfg, model, params = model_and_params
+    prompt = list(RNG.randint(0, cfg.vocab_size, size=(5,)))
+    # find the greedy first token and use it as eos -> stops after 1
+    engine = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=64)
+    first = engine.generate([prompt], GenerationConfig(max_new_tokens=1))[0][0]
+    engine2 = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=64)
+    outs = engine2.generate([prompt], GenerationConfig(max_new_tokens=8, eos_token_id=first))
+    assert outs[0] == [first]
+
+
+def test_prompt_too_long(model_and_params):
+    cfg, _, params = model_and_params
+    engine = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=16)
+    with pytest.raises(ValueError):
+        engine.add_request(list(range(20)))
