@@ -120,7 +120,7 @@ class Plugin(abc.ABC):
         if self.grad_accum_steps > 1:
             optimizer = optax.MultiSteps(optimizer, every_k_schedule=self.grad_accum_steps)
 
-        example_inputs = _model_inputs(example_batch)
+        example_inputs = _model_inputs(example_batch, model)
 
         # ---- abstract shapes → shardings (nothing materializes here).
         # Tracing happens under the ambient mesh: model code (ring attention,
@@ -237,7 +237,7 @@ class Plugin(abc.ABC):
         precision = self.precision
 
         def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
-            inputs = _model_inputs(batch)
+            inputs = _model_inputs(batch, model)
             if opt_shardings_device is not None:
                 # host-offloaded states: stream to device for the update;
                 # out_shardings move the new states back to pinned host
@@ -315,7 +315,7 @@ class Plugin(abc.ABC):
         batch_sharding = mesh.sharding(*mesh.batch_spec())
 
         def step_fn(state: TrainState, batch):
-            out = model.apply({"params": state.params}, **_model_inputs(batch))
+            out = model.apply({"params": state.params}, **_model_inputs(batch, model))
             loss = loss_fn(out, batch)
             if getattr(out, "aux_loss", None) is not None:
                 loss = loss + out.aux_loss
@@ -348,11 +348,23 @@ def default_causal_lm_loss(out, batch):
     return causal_lm_loss(out.logits, batch["input_ids"])
 
 
-_MODEL_INPUT_KEYS = ("input_ids", "positions", "segment_ids")
+_MODEL_INPUT_KEYS = ("input_ids", "positions", "segment_ids", "token_type_ids", "pixel_values")
 
 
-def _model_inputs(batch: Dict[str, Any]) -> Dict[str, Any]:
-    return {k: v for k, v in batch.items() if k in _MODEL_INPUT_KEYS}
+def _model_inputs(batch: Dict[str, Any], model: Any = None) -> Dict[str, Any]:
+    """Batch entries that are model-forward inputs. With a model, filter by
+    its __call__ signature so e.g. token_type_ids from a BERT tokenizer never
+    reaches a llama forward."""
+    keys = _MODEL_INPUT_KEYS
+    if model is not None:
+        import inspect
+
+        try:
+            sig_params = inspect.signature(type(model).__call__).parameters
+            keys = tuple(k for k in _MODEL_INPUT_KEYS if k in sig_params)
+        except (TypeError, ValueError):
+            pass
+    return {k: v for k, v in batch.items() if k in keys}
 
 
 def _apply_precision(model: Any, precision: str) -> Any:

@@ -1,0 +1,110 @@
+"""CLI: launch + environment check.
+
+≙ reference ``colossalai run`` / ``colossalai check -i`` (``cli/cli.py``,
+``cli/launcher/run.py:108,212``). The reference fabricates per-node torchrun
+commands over SSH; the JAX model is one process per host joining a GRPC
+coordinator, so ``run`` sets the coordination env vars (or spawns N local
+processes for single-host multi-process testing) and ``check`` prints the
+device/topology report.
+
+Usage:
+    python -m colossalai_tpu.cli check
+    # launcher flags come BEFORE the script; everything after the script
+    # path is passed to the script verbatim
+    python -m colossalai_tpu.cli run --num-processes 4 \
+        --coordinator host0:7777 --process-id 0 script.py --script-arg ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _cmd_check(_args) -> int:
+    import jax
+
+    import colossalai_tpu as clt
+
+    acc = clt.get_accelerator()
+    print(f"colossalai_tpu {clt.__version__}")
+    print(f"jax {jax.__version__}")
+    print(f"platform: {acc.name} ({acc.platform})")
+    print(f"devices: {acc.device_count()} ({acc.local_device_count()} local)")
+    print(f"processes: {jax.process_count()} (index {jax.process_index()})")
+    hbm = acc.hbm_bytes_per_device()
+    print(f"hbm/device: {hbm / 1024**3:.1f} GiB" if hbm else "hbm/device: unknown")
+    for d in acc.local_devices()[:8]:
+        print(f"  - {d.device_kind} id={d.id}")
+    print(f"preferred matmul dtype: {acc.preferred_matmul_dtype().__name__}")
+    return 0
+
+
+def _cmd_run(args) -> int:
+    env = dict(os.environ)
+    # make the package importable from the launched script regardless of its
+    # location (≙ torchrun's cwd handling)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    if args.coordinator:
+        env["COORDINATOR_ADDRESS"] = args.coordinator
+        env["NUM_PROCESSES"] = str(args.num_processes)
+        env["PROCESS_ID"] = str(args.process_id)
+        return subprocess.call([sys.executable, args.script, *args.script_args], env=env)
+
+    if args.num_processes <= 1:
+        return subprocess.call([sys.executable, args.script, *args.script_args], env=env)
+
+    # single-host multi-process (testing): spawn local workers with a
+    # localhost coordinator (≙ testing/utils.py spawn pattern)
+    procs = []
+    port = args.port
+    for i in range(args.num_processes):
+        worker_env = dict(env)
+        worker_env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        worker_env["NUM_PROCESSES"] = str(args.num_processes)
+        worker_env["PROCESS_ID"] = str(i)
+        procs.append(
+            subprocess.Popen([sys.executable, args.script, *args.script_args], env=worker_env)
+        )
+    rcs = [p.wait() for p in procs]  # reap every worker before returning
+    return next((r for r in rcs if r), 0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="colossalai_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_check = sub.add_parser("check", help="print device/topology report")
+    p_check.set_defaults(fn=_cmd_check)
+
+    p_run = sub.add_parser(
+        "run", help="launch a training script (launcher flags BEFORE the script)"
+    )
+    p_run.add_argument("--num-processes", type=int, default=1)
+    p_run.add_argument("--process-id", type=int, default=0)
+    p_run.add_argument("--coordinator", default=None, help="host:port of process 0")
+    p_run.add_argument("--port", type=int, default=7777)
+    p_run.add_argument("script")
+    p_run.add_argument("script_args", nargs=argparse.REMAINDER)
+    p_run.set_defaults(fn=_cmd_run)
+
+    args = parser.parse_args(argv)
+    if args.command == "run":
+        if args.script_args[:1] == ["--"]:
+            args.script_args = args.script_args[1:]
+        # catch the flags-after-script mistake instead of silently ignoring it
+        launcher_flags = {"--num-processes", "--process-id", "--coordinator", "--port"}
+        misplaced = launcher_flags.intersection(args.script_args)
+        if misplaced:
+            parser.error(
+                f"launcher flags {sorted(misplaced)} must come BEFORE the script "
+                "path; everything after it is passed to the script"
+            )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

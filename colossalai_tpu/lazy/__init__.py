@@ -1,0 +1,44 @@
+"""Lazy initialization.
+
+≙ reference ``LazyTensor``/``LazyInitContext`` (``lazy/lazy_init.py:134,474``):
+there, tensor constructors are intercepted and replayed so huge models never
+materialize unsharded. Under jit this is the DEFAULT behavior — the configure
+core traces ``model.init`` with ``jax.eval_shape`` (zero bytes) and
+materializes directly into the sharded layout via out_shardings. This module
+keeps the reference-shaped API for code that wants it explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class LazyInitContext:
+    """API-compatible shim: under this context, build abstract params with
+    ``eval_shape`` and materialize them sharded with ``materialize``."""
+
+    def __init__(self):
+        self._active = False
+
+    def __enter__(self):
+        self._active = True
+        return self
+
+    def __exit__(self, *exc):
+        self._active = False
+
+    @staticmethod
+    def abstract_init(init_fn: Callable, *args, **kwargs) -> Any:
+        """Shape-only trace of a flax ``init`` (no memory allocated)."""
+        return jax.eval_shape(init_fn, *args, **kwargs)
+
+    @staticmethod
+    def materialize(init_fn: Callable, shardings: Any, *args, **kwargs) -> Any:
+        """Run ``init_fn`` jitted with the given out_shardings: every param
+        is created directly in its shard (never full-size on one device)."""
+        return jax.jit(init_fn, out_shardings=shardings)(*args, **kwargs)
+
+
+__all__ = ["LazyInitContext"]

@@ -5,14 +5,18 @@
 """
 
 from .base import CausalLMOutput, ModelConfig
+from .bert import BertConfig, BertModel, BertOutput
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM
 from .mixtral import MixtralConfig, MixtralForCausalLM
+from .vit import ViTConfig, ViTForImageClassification, ViTOutput
 
 MODEL_REGISTRY = {
     "llama": (LlamaForCausalLM, LlamaConfig),
     "gpt2": (GPT2LMHeadModel, GPT2Config),
     "mixtral": (MixtralForCausalLM, MixtralConfig),
+    "bert": (BertModel, BertConfig),
+    "vit": (ViTForImageClassification, ViTConfig),
 }
 
 
@@ -31,6 +35,12 @@ __all__ = [
     "LlamaForCausalLM",
     "MixtralConfig",
     "MixtralForCausalLM",
+    "BertConfig",
+    "BertModel",
+    "BertOutput",
+    "ViTConfig",
+    "ViTForImageClassification",
+    "ViTOutput",
     "MODEL_REGISTRY",
     "get_model_cls",
 ]

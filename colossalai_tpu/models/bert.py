@@ -1,0 +1,114 @@
+"""BERT encoder (flax) — the reference's convergence-test workhorse
+(``shardformer/policies/bert.py``, Shardformer README's BERT finetune
+benchmark). Bidirectional attention, learned positions, pooler + optional
+MLM/classification heads."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+
+from .base import ModelConfig
+
+
+@flax.struct.dataclass
+class BertOutput:
+    last_hidden_state: jax.Array
+    pooled: Optional[jax.Array] = None
+    logits: Optional[jax.Array] = None
+    aux_loss: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class BertConfig(ModelConfig):
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 0  # >0 adds a classification head on the pooled output
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        return cls(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, **kw,
+        )
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, positions=None, segment_ids=None):
+        del positions
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        b, s, _ = x.shape
+        dense = lambda feats, name: nn.Dense(feats, dtype=dtype, param_dtype=pdtype, name=name)
+
+        q = dense(cfg.hidden_size, "query")(x).reshape(b, s, cfg.num_attention_heads, hd)
+        k = dense(cfg.hidden_size, "key")(x).reshape(b, s, cfg.num_attention_heads, hd)
+        v = dense(cfg.hidden_size, "value")(x).reshape(b, s, cfg.num_attention_heads, hd)
+        q = constrain(q, ("dp", "ep"), None, "tp", None)
+        attn = dot_product_attention(
+            q, k, v, causal=False, segment_ids=segment_ids, impl=cfg.attention_impl
+        ).reshape(b, s, cfg.hidden_size)
+        attn = dense(cfg.hidden_size, "attn_out")(attn)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="attn_norm")(x + attn)
+
+        h = dense(cfg.intermediate_size, "ffn_in")(x)
+        h = nn.gelu(h)
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        h = dense(cfg.hidden_size, "ffn_out")(h)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ffn_norm")(x + h)
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+    supports_sp_modes = ("split_gather",)
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None, token_type_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+
+        x = (
+            nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="word_embeddings")(input_ids)
+            + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="position_embeddings")(positions)
+            + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="token_type_embeddings")(token_type_ids)
+        )
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="embeddings_norm")(x)
+        x = constrain(x, ("dp", "ep"), "sp", None)
+
+        from .stack import apply_decoder_stack
+
+        x, _ = apply_decoder_stack(self, BertLayer, x, positions, segment_ids, name="encoder")
+
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="pooler")(x[:, 0])
+        )
+        logits = None
+        if cfg.num_labels > 0:
+            logits = nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=pdtype, name="classifier")(pooled)
+        return BertOutput(last_hidden_state=x, pooled=pooled, logits=logits)

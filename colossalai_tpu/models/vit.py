@@ -1,0 +1,110 @@
+"""Vision Transformer (flax) — exercises conv patchify + non-LLM policies
+(≙ reference ``shardformer/policies/vit.py``; BASELINE.json's non-LLM
+config)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+
+from .base import ModelConfig
+
+
+@flax.struct.dataclass
+class ViTOutput:
+    last_hidden_state: jax.Array
+    logits: Optional[jax.Array] = None
+    aux_loss: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class ViTConfig(ModelConfig):
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-6
+    num_labels: int = 1000
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        return cls(
+            image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128, num_labels=10, **kw,
+        )
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, positions=None, segment_ids=None):
+        del positions, segment_ids
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        b, s, _ = x.shape
+        dense = lambda feats, name: nn.Dense(feats, dtype=dtype, param_dtype=pdtype, name=name)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm1")(x)
+        qkv = dense(3 * cfg.hidden_size, "qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rs = lambda t: t.reshape(b, s, cfg.num_attention_heads, hd)
+        q = constrain(rs(q), ("dp", "ep"), None, "tp", None)
+        attn = dot_product_attention(q, rs(k), rs(v), causal=False, impl=cfg.attention_impl)
+        x = x + dense(cfg.hidden_size, "proj")(attn.reshape(b, s, cfg.hidden_size))
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm2")(x)
+        h = dense(cfg.intermediate_size, "fc1")(h)
+        h = nn.gelu(h)
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        return x + dense(cfg.hidden_size, "fc2")(h)
+
+
+class ViTForImageClassification(nn.Module):
+    config: ViTConfig
+    # seq length is patches+cls (odd) and blocks carry no sp constraints —
+    # no SP mode is honored yet
+    supports_sp_modes = ()
+
+    @nn.compact
+    def __call__(self, pixel_values, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b = pixel_values.shape[0]
+        # patchify: conv with stride = patch (maps to MXU as one matmul)
+        x = nn.Conv(
+            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), dtype=dtype,
+            param_dtype=pdtype, name="patch_embed",
+        )(pixel_values)
+        x = x.reshape(b, -1, cfg.hidden_size)
+        n = x.shape[1]
+        cls_tok = self.param("cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size), pdtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls_tok.astype(dtype), (b, 1, cfg.hidden_size)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, n + 1, cfg.hidden_size), pdtype
+        )
+        x = x + pos.astype(dtype)
+        x = constrain(x, ("dp", "ep"), None, None)
+
+        from .stack import apply_decoder_stack
+
+        x, _ = apply_decoder_stack(self, ViTBlock, x, None, None, name="blocks")
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm")(x)
+        logits = nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=pdtype, name="head")(x[:, 0])
+        return ViTOutput(last_hidden_state=x, logits=logits)

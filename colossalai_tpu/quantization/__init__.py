@@ -1,0 +1,21 @@
+from .fp8 import (
+    E4M3,
+    E5M2,
+    FP8Hook,
+    cast_from_fp8,
+    cast_to_fp8,
+    fp8_compress_for_allreduce,
+    fp8_decompress,
+    fp8_matmul,
+)
+
+__all__ = [
+    "E4M3",
+    "E5M2",
+    "FP8Hook",
+    "cast_from_fp8",
+    "cast_to_fp8",
+    "fp8_compress_for_allreduce",
+    "fp8_decompress",
+    "fp8_matmul",
+]

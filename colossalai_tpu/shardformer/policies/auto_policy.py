@@ -11,6 +11,7 @@ from typing import Union
 from .base_policy import Policy
 from .gpt2 import GPT2Policy
 from .llama import LlamaPolicy, MistralPolicy
+from .bert_vit import BertPolicy, ViTPolicy
 from .mixtral import DeepSeekMoEPolicy, MixtralPolicy
 
 POLICY_REGISTRY = {
@@ -22,6 +23,10 @@ POLICY_REGISTRY = {
     "mixtral": MixtralPolicy,
     "MixtralForCausalLM": MixtralPolicy,
     "deepseek_moe": DeepSeekMoEPolicy,
+    "bert": BertPolicy,
+    "BertModel": BertPolicy,
+    "vit": ViTPolicy,
+    "ViTForImageClassification": ViTPolicy,
     "GPT2LMHeadModel": GPT2Policy,
 }
 

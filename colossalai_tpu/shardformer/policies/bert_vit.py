@@ -1,0 +1,27 @@
+"""BERT / ViT sharding policies (≙ ``policies/bert.py``, ``policies/vit.py``)."""
+
+from .base_policy import Policy
+
+
+class BertPolicy(Policy):
+    rules = [
+        (r"word_embeddings/embedding$", ("tp", None)),
+        (r"(position|token_type)_embeddings/embedding$", ()),
+        (r"(query|key|value|ffn_in)/kernel$", (None, "tp")),
+        (r"(query|key|value|ffn_in)/bias$", ("tp",)),
+        (r"(attn_out|ffn_out)/kernel$", ("tp", None)),
+        (r"(pooler|classifier)/kernel$", ()),
+        (r"norm/(scale|bias)$", ()),
+    ]
+
+
+class ViTPolicy(Policy):
+    rules = [
+        (r"patch_embed/kernel$", ()),
+        (r"(qkv|fc1)/kernel$", (None, "tp")),
+        (r"(qkv|fc1)/bias$", ("tp",)),
+        (r"(proj|fc2)/kernel$", ("tp", None)),
+        (r"head/kernel$", (None, "tp")),
+        (r"(norm1|norm2|norm)/(scale|bias)$", ()),
+        (r"(cls_token|pos_embed)$", ()),
+    ]

@@ -1,0 +1,101 @@
+"""LLaMA pretraining benchmark.
+
+≙ reference ``examples/language/llama/benchmark.py`` +
+``performance_evaluator.py``: pick a model size and parallel config, run
+synthetic-data training steps, report tokens/s, TFLOPS/chip and MFU.
+
+Examples:
+    python benchmark.py --model tiny --steps 10
+    python benchmark.py --model 8b --tp 4 --zero 1 --precision bf16 \
+        --batch-size 16 --seq-len 4096 --remat
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import colossalai_tpu as clt
+from colossalai_tpu.booster import Booster, HybridParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.utils import (
+    PerformanceEvaluator,
+    causal_lm_flops_per_token,
+    count_params,
+)
+
+SIZES = {
+    "tiny": LlamaConfig.tiny,
+    "7b": LlamaConfig.llama2_7b,
+    "8b": LlamaConfig.llama3_8b,
+    "70b": LlamaConfig.llama3_70b,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=sorted(SIZES))
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--sp-mode", default="none")
+    ap.add_argument("--zero", type=int, default=0)
+    ap.add_argument("--num-microbatches", type=int, default=None)
+    ap.add_argument("--precision", default="bf16", choices=["fp32", "bf16", "fp16"])
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--lr", type=float, default=3e-4)
+    args = ap.parse_args()
+
+    clt.launch_from_env(verbose=True)
+    cfg = SIZES[args.model](
+        dtype=jnp.bfloat16 if args.precision == "bf16" else None, remat=args.remat
+    )
+    plugin = HybridParallelPlugin(
+        tp_size=args.tp, pp_size=args.pp, sp_size=args.sp,
+        sequence_parallel_mode=args.sp_mode, zero_stage=args.zero,
+        num_microbatches=args.num_microbatches, precision=args.precision,
+        max_norm=1.0,
+    )
+    model = LlamaForCausalLM(cfg)
+    batch = {
+        "input_ids": jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, size=(args.batch_size, args.seq_len))
+        )
+    }
+    boosted = Booster(plugin=plugin).boost(
+        model, optax.adamw(args.lr, weight_decay=0.1), example_batch=batch,
+        rng=jax.random.PRNGKey(0),
+    )
+    state = boosted.state
+    n_params = count_params(state.params)
+    print(f"model: {n_params / 1e9:.2f}B params, mesh {boosted.mesh}")
+
+    sharded = boosted.shard_batch(batch)
+    state, m = boosted.train_step(state, sharded)
+    float(m["loss"])  # sync (block_until_ready is unreliable on tunneled TPUs)
+
+    ev = PerformanceEvaluator(
+        flops_per_token=causal_lm_flops_per_token(
+            n_params, cfg.num_hidden_layers, cfg.hidden_size, args.seq_len
+        ),
+        n_devices=len(jax.devices()),
+    )
+    for step in range(args.steps):
+        ev.on_step_start()
+        state, m = boosted.train_step(state, sharded)
+        loss = float(m["loss"])
+        ev.on_step_end(n_tokens=batch["input_ids"].size)
+        print(f"step {step}: loss {loss:.4f}")
+    print(json.dumps(ev.summary()))
+
+
+if __name__ == "__main__":
+    main()
