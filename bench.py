@@ -41,7 +41,7 @@ def pick_config(hbm_bytes: int) -> tuple:
             num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
             dtype=jnp.bfloat16, remat=True,
         )
-        bs, seq = 4, 2048
+        bs, seq = 8, 4096  # seq matches the reference's benchmark configs
     return cfg, bs, seq
 
 
