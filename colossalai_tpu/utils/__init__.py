@@ -1,3 +1,4 @@
+from .data import TokenDataLoader, write_token_file
 from .performance_evaluator import (
     PerformanceEvaluator,
     causal_lm_flops_per_token,
@@ -6,6 +7,8 @@ from .performance_evaluator import (
 )
 
 __all__ = [
+    "TokenDataLoader",
+    "write_token_file",
     "PerformanceEvaluator",
     "causal_lm_flops_per_token",
     "count_params",

@@ -1,0 +1,129 @@
+"""Native-backed token dataloader.
+
+≙ the reference's native IO path (csrc + async readers backing
+``Booster.prepare_dataloader``): a C++ shared library (``csrc/dataloader.cpp``)
+mmaps a binary int32 token file and prefetches random fixed-length batches on
+a background thread; Python receives them with one memcpy via ctypes.
+
+The library is JIT-compiled with g++ on first use and cached
+(≙ extensions' build_jit path). Falls back to a pure-numpy loader when no
+compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Iterator, Optional
+
+import numpy as np
+
+_LIB = None
+_LIB_ERR: Optional[str] = None
+
+
+def _csrc_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc", "dataloader.cpp")
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    src = _csrc_path()
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "colossalai_tpu"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    lib_path = os.path.join(cache_dir, "libdataloader.so")
+    try:
+        stale = not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(src)
+        if stale:
+            # build atomically: compile to a temp file, rename into place, so
+            # concurrent processes never CDLL a half-written .so
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, lib_path)
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        if not os.path.exists(lib_path):
+            _LIB_ERR = f"native dataloader build failed: {e}"
+            return None
+        # a previously-built lib exists; use it even if the source is missing
+        # (pip-installed layout without csrc/)
+    lib = ctypes.CDLL(lib_path)
+    lib.dl_open.restype = ctypes.c_void_p
+    lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long]
+    lib.dl_num_tokens.restype = ctypes.c_long
+    lib.dl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.dl_next.restype = ctypes.c_int
+    lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.dl_close.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Persist an int32 token stream in the loader's binary format."""
+    np.asarray(tokens, dtype=np.int32).tofile(path)
+
+
+class TokenDataLoader:
+    """Infinite random-crop batches of [batch, seq_len] int32 tokens.
+
+    Uses the C++ prefetching loader when g++ is available; numpy otherwise.
+    """
+
+    def __init__(self, path: str, seq_len: int, batch_size: int, seed: int = 0, queue_depth: int = 4):
+        self.path = path
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self._handle = None
+        self._np_tokens = None
+        lib = _build_lib()
+        if lib is not None:
+            handle = lib.dl_open(path.encode(), seq_len, batch_size, seed, queue_depth)
+            if handle:
+                self._handle = ctypes.c_void_p(handle)
+                self._lib = lib
+                self.n_tokens = int(lib.dl_num_tokens(self._handle))
+                return
+            raise FileNotFoundError(f"cannot open token file {path!r} (or too short)")
+        # numpy fallback
+        self._np_tokens = np.fromfile(path, dtype=np.int32)
+        if self._np_tokens.size < seq_len:
+            raise FileNotFoundError(f"cannot open token file {path!r} (or too short)")
+        self.n_tokens = int(self._np_tokens.size)
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def next_batch(self) -> np.ndarray:
+        if self._handle is not None:
+            out = np.empty((self.batch_size, self.seq_len), np.int32)
+            rc = self._lib.dl_next(self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if rc != 0:
+                raise RuntimeError("native dataloader failed")
+            return out
+        starts = self._rng.randint(0, self.n_tokens - self.seq_len + 1, size=self.batch_size)
+        return np.stack([self._np_tokens[s : s + self.seq_len] for s in starts]).astype(np.int32)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch()
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

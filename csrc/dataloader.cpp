@@ -1,0 +1,143 @@
+// Native token-dataset loader: mmap + background prefetch.
+//
+// ≙ the reference's native IO layer (extensions/csrc + tensornvme-backed
+// async readers): the Python side should never block on disk. A C++ thread
+// keeps a ring of ready batches; Python swaps them out with one memcpy.
+//
+// Exposed C ABI (ctypes-bound in colossalai_tpu/utils/data.py):
+//   void* dl_open(const char* path, long seq_len, long batch, long seed,
+//                 long queue_depth);
+//   long  dl_num_tokens(void* h);
+//   int   dl_next(void* h, int* out);   // blocks until a batch is ready
+//   void  dl_close(void* h);
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Loader {
+  const int32_t* tokens = nullptr;
+  size_t n_tokens = 0;
+  size_t map_bytes = 0;
+  int fd = -1;
+  long seq_len = 0;
+  long batch = 0;
+  long queue_depth = 4;
+
+  std::mt19937_64 rng;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::deque<std::vector<int32_t>> ready;
+  std::atomic<bool> stop{false};
+
+  void fill_batch(std::vector<int32_t>& out) {
+    const size_t span = static_cast<size_t>(seq_len);
+    const size_t max_start = n_tokens - span;
+    for (long b = 0; b < batch; ++b) {
+      size_t start = rng() % (max_start + 1);
+      std::memcpy(out.data() + b * span, tokens + start, span * sizeof(int32_t));
+    }
+  }
+
+  void run() {
+    while (!stop.load(std::memory_order_relaxed)) {
+      std::vector<int32_t> buf(static_cast<size_t>(batch) * seq_len);
+      fill_batch(buf);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] {
+        return stop.load(std::memory_order_relaxed) ||
+               ready.size() < static_cast<size_t>(queue_depth);
+      });
+      if (stop.load(std::memory_order_relaxed)) return;
+      ready.push_back(std::move(buf));
+      cv_ready.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_open(const char* path, long seq_len, long batch, long seed,
+              long queue_depth) {
+  if (seq_len <= 0 || batch <= 0) return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(seq_len * sizeof(int32_t))) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  madvise(map, st.st_size, MADV_RANDOM);
+
+  auto* l = new Loader();
+  l->tokens = static_cast<const int32_t*>(map);
+  l->n_tokens = st.st_size / sizeof(int32_t);
+  l->map_bytes = st.st_size;
+  l->fd = fd;
+  l->seq_len = seq_len;
+  l->batch = batch;
+  l->queue_depth = queue_depth > 0 ? queue_depth : 4;
+  l->rng.seed(static_cast<uint64_t>(seed));
+  l->worker = std::thread([l] { l->run(); });
+  return l;
+}
+
+long dl_num_tokens(void* h) {
+  return h ? static_cast<long>(static_cast<Loader*>(h)->n_tokens) : -1;
+}
+
+int dl_next(void* h, int32_t* out) {
+  if (!h || !out) return -1;
+  auto* l = static_cast<Loader*>(h);
+  std::vector<int32_t> buf;
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    l->cv_ready.wait(lk, [&] { return !l->ready.empty(); });
+    buf = std::move(l->ready.front());
+    l->ready.pop_front();
+    l->cv_space.notify_one();
+  }
+  std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+  return 0;
+}
+
+void dl_close(void* h) {
+  if (!h) return;
+  auto* l = static_cast<Loader*>(h);
+  {
+    // set stop and notify under the mutex: a notify issued between the
+    // worker's predicate check and its wait would otherwise be lost and
+    // join() would hang
+    std::lock_guard<std::mutex> lk(l->mu);
+    l->stop.store(true);
+    l->cv_space.notify_all();
+    l->cv_ready.notify_all();
+  }
+  if (l->worker.joinable()) l->worker.join();
+  munmap(const_cast<int32_t*>(l->tokens), l->map_bytes);
+  ::close(l->fd);
+  delete l;
+}
+
+}  // extern "C"
