@@ -13,6 +13,9 @@ from .vit import ViTConfig, ViTForImageClassification, ViTOutput
 
 MODEL_REGISTRY = {
     "llama": (LlamaForCausalLM, LlamaConfig),
+    # llama-family architectures sharing the module (configs differ)
+    "mistral": (LlamaForCausalLM, LlamaConfig),
+    "qwen2": (LlamaForCausalLM, LlamaConfig),
     "gpt2": (GPT2LMHeadModel, GPT2Config),
     "mixtral": (MixtralForCausalLM, MixtralConfig),
     "bert": (BertModel, BertConfig),

@@ -44,6 +44,8 @@ class LlamaConfig(ModelConfig):
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    #: biases on q/k/v projections (Qwen2-style); o_proj stays bias-free
+    attention_bias: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -67,6 +69,25 @@ class LlamaConfig(ModelConfig):
             vocab_size=128256, hidden_size=8192, intermediate_size=28672,
             num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8,
             max_position_embeddings=8192, rope_theta=500000.0, **kw,
+        )
+
+    @classmethod
+    def mistral_7b(cls, **kw) -> "LlamaConfig":
+        """Mistral-7B shapes (sliding-window attention not yet wired; full
+        attention is a correct superset for training)."""
+        return cls(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=32768, rope_theta=10000.0, **kw,
+        )
+
+    @classmethod
+    def qwen2_7b(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("attention_bias", True)  # Qwen2 has q/k/v biases
+        return cls(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+            max_position_embeddings=32768, rope_theta=1e6, **kw,
         )
 
     @classmethod
@@ -117,13 +138,14 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         hd = cfg.head_dim_
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=dtype,
+        dense = lambda feats, name, bias=False: nn.Dense(
+            feats, use_bias=bias, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name=name,
         )
-        q = dense(cfg.num_attention_heads * hd, "q_proj")(x)
-        k = dense(cfg.num_key_value_heads * hd, "k_proj")(x)
-        v = dense(cfg.num_key_value_heads * hd, "v_proj")(x)
+        qkv_bias = cfg.attention_bias
+        q = dense(cfg.num_attention_heads * hd, "q_proj", qkv_bias)(x)
+        k = dense(cfg.num_key_value_heads * hd, "k_proj", qkv_bias)(x)
+        v = dense(cfg.num_key_value_heads * hd, "v_proj", qkv_bias)(x)
         b, s, _ = x.shape
         q = q.reshape(b, s, cfg.num_attention_heads, hd)
         k = k.reshape(b, s, cfg.num_key_value_heads, hd)

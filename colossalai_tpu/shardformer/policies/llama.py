@@ -16,6 +16,7 @@ class LlamaPolicy(Policy):
     rules = [
         (r"embed_tokens/embedding$", ("tp", None)),
         (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$", (None, "tp")),
+        (r"(q_proj|k_proj|v_proj)/bias$", ("tp",)),
         (r"(o_proj|down_proj)/kernel$", ("tp", None)),
         (r"lm_head/kernel$", (None, "tp")),
         (r"(input_layernorm|post_attention_layernorm|norm)/scale$", ()),

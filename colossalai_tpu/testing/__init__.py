@@ -1,0 +1,82 @@
+"""Testing utilities.
+
+≙ reference ``colossalai.testing`` (``testing/utils.py``): ``@parameterize``
+sweeps, multi-process ``spawn``, tensor comparison helpers. The JAX analog
+of spawn-with-NCCL is a virtual multi-device mesh in one process (see
+tests/conftest.py); ``spawn`` here covers the cases that truly need separate
+processes (multi-controller behavior).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Dict, Sequence
+
+import jax
+import numpy as np
+
+
+def parameterize(arg_name: str, values: Sequence[Any]):
+    """Loop-based parameter sweep that shares one process/mesh
+    (≙ testing/utils.py:16 — avoids re-spawning process groups)."""
+
+    def decorator(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            for v in values:
+                fn(*args, **{**kwargs, arg_name: v})
+
+        return wrapper
+
+    return decorator
+
+
+def assert_close(a, b, rtol: float = 1e-5, atol: float = 1e-6, msg: str = ""):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol, err_msg=msg)
+
+
+def check_state_dict_equal(tree_a, tree_b, rtol: float = 1e-5, atol: float = 1e-6):
+    """≙ testing/comparison.py:41 — whole-pytree equality with paths in errors."""
+    flat_a = jax.tree_util.tree_flatten_with_path(tree_a)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(tree_b)[0]
+    assert len(flat_a) == len(flat_b), f"tree sizes differ: {len(flat_a)} vs {len(flat_b)}"
+    for (path_a, leaf_a), (_, leaf_b) in zip(flat_a, flat_b):
+        assert_close(leaf_a, leaf_b, rtol=rtol, atol=atol, msg=str(path_a))
+
+
+def assert_loss_close(a: float, b: float, rtol: float = 1e-4):
+    np.testing.assert_allclose(float(a), float(b), rtol=rtol)
+
+
+def spawn(fn: Callable, nprocs: int, *args, **kwargs) -> None:
+    """Run ``fn(rank, *args)`` in ``nprocs`` separate processes
+    (≙ testing/utils.py:229). For collective behavior prefer the in-process
+    virtual mesh; use this only for true multi-controller tests."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=fn, args=(rank, *args), kwargs=kwargs) for rank in range(nprocs)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+    failed = [i for i, p in enumerate(procs) if p.exitcode != 0]
+    assert not failed, f"ranks {failed} exited nonzero"
+
+
+def virtual_mesh(n_devices: int = 8, **axes):
+    """Convenience: a DeviceMesh over the first n virtual devices."""
+    from colossalai_tpu.device import create_device_mesh
+
+    return create_device_mesh(devices=jax.devices()[:n_devices], **axes)
+
+
+__all__ = [
+    "parameterize",
+    "assert_close",
+    "check_state_dict_equal",
+    "assert_loss_close",
+    "spawn",
+    "virtual_mesh",
+]

@@ -38,6 +38,7 @@ def _build_lib() -> Optional[ctypes.CDLL]:
     )
     os.makedirs(cache_dir, exist_ok=True)
     lib_path = os.path.join(cache_dir, "libdataloader.so")
+    tmp = None
     try:
         stale = not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(src)
         if stale:
@@ -48,13 +49,22 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", tmp]
             subprocess.run(cmd, check=True, capture_output=True)
             os.replace(tmp, lib_path)
+            tmp = None
     except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
         if not os.path.exists(lib_path):
             _LIB_ERR = f"native dataloader build failed: {e}"
             return None
         # a previously-built lib exists; use it even if the source is missing
         # (pip-installed layout without csrc/)
-    lib = ctypes.CDLL(lib_path)
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError as e:
+        # corrupt/foreign-arch cached .so: fall back rather than crash
+        _LIB_ERR = f"native dataloader load failed: {e}"
+        return None
     lib.dl_open.restype = ctypes.c_void_p
     lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long]
     lib.dl_num_tokens.restype = ctypes.c_long
@@ -92,8 +102,11 @@ class TokenDataLoader:
                 self.n_tokens = int(lib.dl_num_tokens(self._handle))
                 return
             raise FileNotFoundError(f"cannot open token file {path!r} (or too short)")
-        # numpy fallback
-        self._np_tokens = np.fromfile(path, dtype=np.int32)
+        # numpy fallback: memmap so huge corpora never materialize in RAM
+        try:
+            self._np_tokens = np.memmap(path, dtype=np.int32, mode="r")
+        except (FileNotFoundError, ValueError) as e:
+            raise FileNotFoundError(f"cannot open token file {path!r}: {e}")
         if self._np_tokens.size < seq_len:
             raise FileNotFoundError(f"cannot open token file {path!r} (or too short)")
         self.n_tokens = int(self._np_tokens.size)

@@ -1,0 +1,38 @@
+import numpy as np
+import pytest
+
+from colossalai_tpu.testing import (
+    assert_close,
+    check_state_dict_equal,
+    parameterize,
+    virtual_mesh,
+)
+
+
+def test_parameterize_sweeps():
+    seen = []
+
+    @parameterize("x", [1, 2, 3])
+    def fn(x):
+        seen.append(x)
+
+    fn()
+    assert seen == [1, 2, 3]
+
+
+def test_check_state_dict_equal():
+    a = {"w": np.ones((2, 2)), "b": {"c": np.zeros(3)}}
+    check_state_dict_equal(a, {"w": np.ones((2, 2)), "b": {"c": np.zeros(3)}})
+    with pytest.raises(AssertionError):
+        check_state_dict_equal(a, {"w": np.ones((2, 2)) * 2, "b": {"c": np.zeros(3)}})
+
+
+def test_virtual_mesh():
+    m = virtual_mesh(8, tp=2)
+    assert m.tp_size == 2 and m.n_devices == 8
+
+
+def test_assert_close():
+    assert_close(np.ones(3), np.ones(3) + 1e-8)
+    with pytest.raises(AssertionError):
+        assert_close(np.ones(3), np.ones(3) * 2)
