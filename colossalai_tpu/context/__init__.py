@@ -1,0 +1,53 @@
+"""Config utilities (≙ reference ``colossalai/context``): dict with attribute
+access, loadable from .py/.json files, plus SingletonMeta re-export."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+from typing import Any
+
+from colossalai_tpu.cluster.dist_coordinator import SingletonMeta
+
+
+class Config(dict):
+    """Dict with attribute access (``cfg.lr`` == ``cfg['lr']``).
+
+    Nested dicts are converted to Config at construction, so attribute
+    writes on nested configs mutate the real tree."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for key, value in list(self.items()):
+            if isinstance(value, dict) and not isinstance(value, Config):
+                self[key] = Config(value)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, dict) and not isinstance(value, Config):
+            value = Config(value)
+        self[name] = value
+
+    @staticmethod
+    def from_file(path: str) -> "Config":
+        """Load a config from a ``.py`` (module globals) or ``.json`` file."""
+        if path.endswith(".json"):
+            with open(path) as f:
+                return Config(json.load(f))
+        if path.endswith(".py"):
+            spec = importlib.util.spec_from_file_location("_clt_config", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            return Config(
+                {k: v for k, v in vars(mod).items() if not k.startswith("_")}
+            )
+        raise ValueError(f"unsupported config file type: {path!r} (.py or .json)")
+
+
+__all__ = ["Config", "SingletonMeta"]

@@ -7,15 +7,15 @@
 from .base import CausalLMOutput, ModelConfig
 from .bert import BertConfig, BertModel, BertOutput
 from .gpt2 import GPT2Config, GPT2LMHeadModel
-from .llama import LlamaConfig, LlamaForCausalLM
+from .llama import LlamaConfig, LlamaForCausalLM, MistralConfig, Qwen2Config
 from .mixtral import MixtralConfig, MixtralForCausalLM
 from .vit import ViTConfig, ViTForImageClassification, ViTOutput
 
 MODEL_REGISTRY = {
     "llama": (LlamaForCausalLM, LlamaConfig),
-    # llama-family architectures sharing the module (configs differ)
-    "mistral": (LlamaForCausalLM, LlamaConfig),
-    "qwen2": (LlamaForCausalLM, LlamaConfig),
+    # llama-family architectures sharing the module (config defaults differ)
+    "mistral": (LlamaForCausalLM, MistralConfig),
+    "qwen2": (LlamaForCausalLM, Qwen2Config),
     "gpt2": (GPT2LMHeadModel, GPT2Config),
     "mixtral": (MixtralForCausalLM, MixtralConfig),
     "bert": (BertModel, BertConfig),
@@ -36,6 +36,8 @@ __all__ = [
     "GPT2LMHeadModel",
     "LlamaConfig",
     "LlamaForCausalLM",
+    "MistralConfig",
+    "Qwen2Config",
     "MixtralConfig",
     "MixtralForCausalLM",
     "BertConfig",

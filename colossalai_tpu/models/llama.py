@@ -46,6 +46,8 @@ class LlamaConfig(ModelConfig):
     tie_word_embeddings: bool = False
     #: biases on q/k/v projections (Qwen2-style); o_proj stays bias-free
     attention_bias: bool = False
+    #: Mistral-style sliding-window attention (None = full causal)
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim_(self) -> int:
@@ -73,8 +75,7 @@ class LlamaConfig(ModelConfig):
 
     @classmethod
     def mistral_7b(cls, **kw) -> "LlamaConfig":
-        """Mistral-7B shapes (sliding-window attention not yet wired; full
-        attention is a correct superset for training)."""
+        kw.setdefault("sliding_window", 4096)
         return cls(
             vocab_size=32000, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
@@ -98,6 +99,23 @@ class LlamaConfig(ModelConfig):
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=128, **kw,
         )
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class MistralConfig(LlamaConfig):
+    """Mistral defaults: sliding-window attention on llama structure."""
+
+    sliding_window: Optional[int] = 4096
+    max_position_embeddings: int = 32768
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class Qwen2Config(LlamaConfig):
+    """Qwen2 defaults: q/k/v projection biases on llama structure."""
+
+    attention_bias: bool = True
+    max_position_embeddings: int = 32768
+    rope_theta: float = 1e6
 
 
 class RMSNorm(nn.Module):
@@ -177,6 +195,11 @@ class LlamaAttention(nn.Module):
                     "packed segment_ids are not supported under sp_mode='ring_attn'; "
                     "use all_to_all or split_gather for packed batches"
                 )
+            if cfg.sliding_window is not None:
+                raise NotImplementedError(
+                    "sliding_window is not supported under sp_mode='ring_attn'; "
+                    "use all_to_all or split_gather"
+                )
             from colossalai_tpu.shardformer.layer.ring_attention import ring_attention
             from colossalai_tpu.tensor import current_mesh
 
@@ -187,6 +210,7 @@ class LlamaAttention(nn.Module):
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
+                sliding_window=cfg.sliding_window,
             )
         out = out.reshape(b, s, cfg.num_attention_heads * hd)
         out = dense(cfg.hidden_size, "o_proj")(out)

@@ -46,11 +46,13 @@ def xla_attention(
     kv_segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     q_offset: int = 0,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Numerically-stable attention on the MXU via two einsums.
 
     ``segment_ids`` ([B, Sq]) enables packed-varlen attention
     (≙ reference padded/varlen mask types, ``attn.py:54``).
+    ``sliding_window`` limits each query to the last W keys (Mistral-style).
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -65,6 +67,12 @@ def xla_attention(
     mask = None
     if causal:
         mask = _causal_mask(sq, skv, offset=q_offset)[None, None, None]
+    if sliding_window is not None:
+        q_pos = jnp.arange(sq)[:, None] + q_offset
+        kv_pos = jnp.arange(skv)[None, :]
+        win = (q_pos - kv_pos) < sliding_window
+        win = win[None, None, None]
+        mask = win if mask is None else (mask & win)
     if segment_ids is not None:
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         seg = (segment_ids[:, :, None] == kv_seg[:, None, :])[:, None, None]
@@ -91,14 +99,18 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     impl: str = "auto",
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Attention entry point used by all model forwards.
 
     ``impl``: "auto" | "xla" | "pallas". "auto" chooses the Pallas flash
-    kernel on TPU when shapes are tile-friendly, else XLA.
+    kernel on TPU when shapes are tile-friendly, else XLA. A sliding window
+    forces the XLA path (the flash kernel has no window support yet).
     """
     if impl == "auto":
-        impl = "pallas" if _pallas_eligible(q, k, bias, segment_ids) else "xla"
+        impl = "pallas" if (sliding_window is None and _pallas_eligible(q, k, bias, segment_ids)) else "xla"
+    if impl == "pallas" and sliding_window is not None:
+        raise ValueError("sliding_window is not supported by the pallas kernel; use impl='xla'/'auto'")
     if impl == "pallas":
         if bias is not None:
             raise ValueError(
@@ -112,7 +124,7 @@ def dot_product_attention(
         )
     return xla_attention(
         q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
-        softmax_scale=softmax_scale,
+        softmax_scale=softmax_scale, sliding_window=sliding_window,
     )
 
 

@@ -41,7 +41,8 @@ def check_state_dict_equal(tree_a, tree_b, rtol: float = 1e-5, atol: float = 1e-
     flat_a = jax.tree_util.tree_flatten_with_path(tree_a)[0]
     flat_b = jax.tree_util.tree_flatten_with_path(tree_b)[0]
     assert len(flat_a) == len(flat_b), f"tree sizes differ: {len(flat_a)} vs {len(flat_b)}"
-    for (path_a, leaf_a), (_, leaf_b) in zip(flat_a, flat_b):
+    for (path_a, leaf_a), (path_b, leaf_b) in zip(flat_a, flat_b):
+        assert path_a == path_b, f"key paths differ: {path_a} vs {path_b}"
         assert_close(leaf_a, leaf_b, rtol=rtol, atol=atol, msg=str(path_a))
 
 

@@ -1,0 +1,24 @@
+"""ZeRO namespace (≙ ``colossalai/zero``): discoverable aliases.
+
+The actual machinery lives in the plugins — under GSPMD, ZeRO stages are
+sharding layouts, not runtimes:
+
+- stage 1/2 → ``LowLevelZeroPlugin`` (optimizer-state / +grad sharding over
+  the data axis; ≙ ``LowLevelZeroOptimizer``)
+- stage 3   → ``GeminiPlugin`` (param sharding + optional pinned-host
+  optimizer offload; ≙ ``GeminiDDP``/chunk manager)
+"""
+
+from colossalai_tpu.booster.plugin.plugins import GeminiPlugin, LowLevelZeroPlugin
+
+
+def zero_model_wrapper(zero_stage: int = 1, offload_optim: bool = False):
+    """Convenience plugin factory (≙ ``zero/wrapper.py``)."""
+    if zero_stage in (1, 2):
+        return LowLevelZeroPlugin(stage=zero_stage)
+    if zero_stage == 3:
+        return GeminiPlugin(offload_optim=offload_optim)
+    raise ValueError(f"zero_stage must be 1, 2 or 3, got {zero_stage}")
+
+
+__all__ = ["GeminiPlugin", "LowLevelZeroPlugin", "zero_model_wrapper"]

@@ -1,0 +1,72 @@
+"""Config utilities, zero namespace, and sliding-window attention."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.context import Config
+from colossalai_tpu.shardformer.layer.attention import xla_attention
+from colossalai_tpu.zero import LowLevelZeroPlugin, zero_model_wrapper
+
+
+def test_config_attr_access(tmp_path):
+    c = Config({"lr": 1e-3, "model": {"hidden": 64}})
+    assert c.lr == 1e-3
+    assert c.model.hidden == 64
+    c.steps = 10
+    assert c["steps"] == 10
+    with pytest.raises(AttributeError):
+        _ = c.missing
+
+    py = tmp_path / "cfg.py"
+    py.write_text("lr = 0.01\nplugin = dict(stage=2)\n")
+    loaded = Config.from_file(str(py))
+    assert loaded.lr == 0.01 and loaded.plugin.stage == 2
+
+    js = tmp_path / "cfg.json"
+    js.write_text('{"bs": 8}')
+    assert Config.from_file(str(js)).bs == 8
+
+
+def test_zero_wrapper():
+    assert isinstance(zero_model_wrapper(1), LowLevelZeroPlugin)
+    assert zero_model_wrapper(3).fsdp
+    with pytest.raises(ValueError):
+        zero_model_wrapper(0)
+
+
+def test_sliding_window_masks_far_tokens():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+    out_w = xla_attention(q, k, v, causal=True, sliding_window=4)
+    # reference: manual window mask
+    full = xla_attention(q, k, v, causal=True)
+    assert not np.allclose(np.asarray(out_w), np.asarray(full))
+    # a query at pos p must be independent of keys at pos <= p - window
+    k2 = k.at[0, 0].set(99.0)
+    v2 = v.at[0, 0].set(99.0)
+    out_w2 = xla_attention(q, k2, v2, causal=True, sliding_window=4)
+    np.testing.assert_allclose(
+        np.asarray(out_w[0, 8:]), np.asarray(out_w2[0, 8:]), atol=1e-6
+    )  # positions >= window unaffected by token 0
+    assert not np.allclose(np.asarray(out_w[0, :4]), np.asarray(out_w2[0, :4]))
+
+
+def test_mistral_model_uses_window():
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=4)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.ones((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    out1 = model.apply(params, ids)
+    # changing token 0 must not affect logits at positions >= window+1
+    out2 = model.apply(params, ids.at[0, 0].set(5))
+    np.testing.assert_allclose(
+        np.asarray(out1.logits[0, 10:]), np.asarray(out2.logits[0, 10:]), atol=1e-5
+    )
