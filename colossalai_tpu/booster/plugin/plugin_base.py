@@ -104,7 +104,9 @@ class Plugin(abc.ABC):
         if example_batch is None:
             raise ValueError("configure() needs example_batch to trace shapes")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        loss_fn = loss_fn or default_causal_lm_loss
+        if loss_fn is None:
+            loss_fn = default_causal_lm_loss
+            _warn_if_hf_label_convention(example_batch)
         mesh = self.build_mesh(devices)
         model = _apply_precision(model, self.precision)
         model = self.modify_model(model)
@@ -331,6 +333,38 @@ class Plugin(abc.ABC):
 
 
 # ---------------------------------------------------------------- utilities
+
+
+def _warn_if_hf_label_convention(batch) -> None:
+    """The default loss expects PRE-SHIFTED labels; HF pipelines pass
+    labels == input_ids (shift happens inside the model there). That
+    mismatch is a silent off-by-one — detect it on the concrete example
+    batch and warn loudly."""
+    import numpy as np
+
+    labels = batch.get("labels") if hasattr(batch, "get") else None
+    ids = batch.get("input_ids") if hasattr(batch, "get") else None
+    if labels is None or ids is None:
+        return
+    try:
+        la, ia = np.asarray(labels), np.asarray(ids)
+        if la.shape != ia.shape:
+            return
+        # HF collators mask pad positions with -100; compare only live ones.
+        live = la != -100
+        same = bool(live.any()) and bool(np.all((la == ia) | ~live))
+    except Exception:
+        return
+    if same:
+        import warnings
+
+        warnings.warn(
+            "batch['labels'] is identical to batch['input_ids'] — the default "
+            "loss expects PRE-SHIFTED labels (labels[t] = token after position "
+            "t), not the HF convention. Drop 'labels' to let the loss shift "
+            "input_ids itself, or pre-shift your labels.",
+            stacklevel=3,
+        )
 
 
 def default_causal_lm_loss(out, batch):

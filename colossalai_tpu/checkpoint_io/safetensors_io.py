@@ -3,10 +3,11 @@
 ≙ reference ``checkpoint_io/`` (4 205 LoC): CheckpointIO ABC +
 HybridParallelCheckpointIO's tp-gather + size-based shard splitting with a
 ``model.safetensors.index.json`` (``utils.py:149``, ``index_file.py:12``).
-Under GSPMD there is no per-rank gather choreography: ``np.asarray`` on a
-sharded jax.Array IS the global tensor (XLA gathers), and loading places
-shards directly via ``jax.device_put`` with the target sharding — the
-reference's gather/scatter maps collapse into the sharding metadata.
+Under GSPMD there is no per-rank gather choreography: each tensor is
+materialized globally one at a time (``process_allgather`` across hosts,
+plain device fetch single-host), and loading places shards directly via
+``jax.device_put`` with the target sharding — the reference's
+gather/scatter maps collapse into the sharding metadata.
 """
 
 from __future__ import annotations
@@ -34,6 +35,20 @@ DEFAULT_SHARD_SIZE = 5 * 1024**3
 def _require_safetensors():
     if save_file is None:
         raise RuntimeError("safetensors is not available in this environment")
+
+
+def _to_global_numpy(v) -> np.ndarray:
+    """Materialize a (possibly multi-host sharded) array as a global np array.
+
+    ``np.asarray`` on a jax.Array only works when every shard is addressable
+    from this process; in a multi-process job we must run a collective gather
+    (all processes participate) before process 0 can write.
+    """
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+    return np.asarray(v)
 
 
 def flatten_params(params: Any, sep: str = ".") -> Dict[str, Any]:
@@ -65,42 +80,56 @@ def save_sharded(
 ) -> None:
     """Write params as safetensors shard(s) + HF-style index.
 
-    Sharded/distributed arrays are gathered via np.asarray (XLA all-gather);
-    only process 0 writes in a multi-host job.
+    Multi-host jobs gather collectively: every process walks the tensors in
+    the same deterministic order, one shard-group at a time (peak host RAM is
+    bounded by ``max_shard_size``, never the full model), and only process 0
+    writes.
     """
     _require_safetensors()
-    if jax.process_index() != 0:
-        return
-    os.makedirs(path, exist_ok=True)
-    flat = {k: np.asarray(v) for k, v in flatten_params(params).items()}
+    flat = flatten_params(params)
 
-    # size-based shard split (≙ StateDictSharder, checkpoint_io/utils.py:149)
-    shards, current, current_size = [], {}, 0
+    def _nbytes(v) -> int:
+        return int(np.prod(v.shape, dtype=np.int64)) * np.dtype(v.dtype).itemsize
+
+    # size-based shard split planned from shape metadata only — no gather yet
+    # (≙ StateDictSharder, checkpoint_io/utils.py:149)
+    groups, current, current_size = [], [], 0
     for name in sorted(flat):
-        arr = flat[name]
-        if current and current_size + arr.nbytes > max_shard_size:
-            shards.append(current)
-            current, current_size = {}, 0
-        current[name] = arr
-        current_size += arr.nbytes
+        nb = _nbytes(flat[name])
+        if current and current_size + nb > max_shard_size:
+            groups.append(current)
+            current, current_size = [], 0
+        current.append(name)
+        current_size += nb
     if current:
-        shards.append(current)
+        groups.append(current)
 
+    is_writer = jax.process_index() == 0
+    if is_writer:
+        os.makedirs(path, exist_ok=True)
     meta = dict(metadata or {})
     meta.setdefault("format", "colossalai_tpu")
-    if len(shards) == 1:
-        save_file(shards[0], os.path.join(path, WEIGHTS_NAME), metadata=meta)
-        return
+
     weight_map = {}
-    total = sum(a.nbytes for a in flat.values())
-    for i, shard in enumerate(shards):
-        fname = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
-        save_file(shard, os.path.join(path, fname), metadata=meta)
-        for name in shard:
+    for i, group in enumerate(groups):
+        # collective per-tensor gather on ALL processes; freed per group
+        shard = {name: _to_global_numpy(flat[name]) for name in group}
+        fname = (
+            WEIGHTS_NAME
+            if len(groups) == 1
+            else f"model-{i + 1:05d}-of-{len(groups):05d}.safetensors"
+        )
+        if is_writer:
+            save_file(shard, os.path.join(path, fname), metadata=meta)
+        for name in group:
             weight_map[name] = fname
-    index = {"metadata": {"total_size": total}, "weight_map": weight_map}
-    with open(os.path.join(path, INDEX_NAME), "w") as f:
-        json.dump(index, f, indent=2, sort_keys=True)
+        del shard
+
+    if len(groups) > 1 and is_writer:
+        total = sum(_nbytes(v) for v in flat.values())
+        index = {"metadata": {"total_size": total}, "weight_map": weight_map}
+        with open(os.path.join(path, INDEX_NAME), "w") as f:
+            json.dump(index, f, indent=2, sort_keys=True)
 
 
 def load_sharded(

@@ -143,9 +143,13 @@ class LLMEngine:
         if not self.running:
             return finished_at_prefill
 
-        # ---- decode tick for every running slot
+        # ---- decode tick for every running slot (idle slots frozen)
         tokens = jnp.asarray(self._slot_tokens, jnp.int32)
-        logits, self.cache = decode_step(self.params, self.config, tokens, self.cache)
+        active = np.zeros((self.max_batch,), bool)
+        active[list(self.running)] = True
+        logits, self.cache = decode_step(
+            self.params, self.config, tokens, self.cache, jnp.asarray(active)
+        )
         next_np = np.asarray(jnp.argmax(logits, axis=-1))
 
         finished: List[Request] = []

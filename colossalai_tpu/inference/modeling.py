@@ -144,8 +144,14 @@ def prefill(params, cfg: LlamaConfig, input_ids, cache: KVCache, slot_lengths) -
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def decode_step(params, cfg: LlamaConfig, tokens, cache: KVCache) -> Tuple[jax.Array, KVCache]:
-    """One token per slot: tokens [B] → logits [B, V], cache advanced."""
+def decode_step(
+    params, cfg: LlamaConfig, tokens, cache: KVCache, active=None
+) -> Tuple[jax.Array, KVCache]:
+    """One token per slot: tokens [B] → logits [B, V], cache advanced.
+
+    ``active`` ([B] bool) freezes idle slots: their lengths do not advance,
+    so a free slot's stale cache rows are never progressively marked valid
+    and lengths can't creep past S_max while the slot sits empty."""
     p = params["params"] if "params" in params else params
     stacked = p["layers"]["block"]
     dtype = cfg.dtype or jnp.bfloat16
@@ -181,4 +187,5 @@ def decode_step(params, cfg: LlamaConfig, tokens, cache: KVCache) -> Tuple[jax.A
         logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
     else:
         logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
-    return logits[:, 0], KVCache(k=k_new, v=v_new, lengths=cache.lengths + 1)
+    advance = 1 if active is None else active.astype(jnp.int32)
+    return logits[:, 0], KVCache(k=k_new, v=v_new, lengths=cache.lengths + advance)

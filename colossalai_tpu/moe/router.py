@@ -57,9 +57,12 @@ def top_k_routing(
         combine = combine + disp_k * gate_vals[:, k][:, None, None]
         counts = counts + jnp.sum(mask_k, axis=0)
 
-    # Switch load-balancing loss: E * sum_e f_e * p_e
-    top1 = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
-    frac_tokens = top1.mean(axis=0)
+    # Load-balancing loss: E * sum_e f_e * p_e, with f_e summed over ALL
+    # top-k selections (matches HF Mixtral's load_balancing_loss_func:
+    # loss == k at perfect balance) — top-1-only would leave half the
+    # routing mass invisible at k=2.
+    sel = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, k, E]
+    frac_tokens = sel.mean(axis=0).sum(axis=0)
     frac_probs = probs.mean(axis=0)
     aux_loss = e * jnp.sum(frac_tokens * frac_probs)
     z = jax.scipy.special.logsumexp(router_logits.astype(jnp.float32), axis=-1)

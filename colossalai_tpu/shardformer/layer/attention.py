@@ -77,12 +77,14 @@ def xla_attention(
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         seg = (segment_ids[:, :, None] == kv_seg[:, None, :])[:, None, None]
         mask = seg if mask is None else (mask & seg)
-    if mask is not None:
-        scores = jnp.where(mask, scores, _NEG_INF)
     if bias is not None:
-        # bias is per-query-head [B, Hq, Sq, Skv]; fold to kv-head groups
+        # bias is per-query-head [B, Hq, Sq, Skv]; fold to kv-head groups.
+        # Applied BEFORE masking so a positive bias can never un-mask a
+        # forbidden position.
         bias_g = rearrange(bias, "b (h g) s t -> b h g s t", g=group)
         scores = scores + bias_g.astype(scores.dtype)
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgst,bthd->bshgd", probs, v, preferred_element_type=jnp.float32)
