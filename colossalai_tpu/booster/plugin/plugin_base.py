@@ -311,6 +311,8 @@ class Plugin(abc.ABC):
             with use_mesh(mesh):
                 return jitted(state, batch)
 
+        train_step._jitted = jitted  # for HLO inspection (tests assert ZeRO-2
+        train_step._mesh = mesh      # lowers the dp grad sync to reduce-scatter)
         return train_step
 
     def _build_eval_step(self, model, loss_fn, mesh, state_shardings):

@@ -97,6 +97,14 @@ class HybridParallelPlugin(Plugin):
     enable_flash_attention: bool = True
     microbatch_size: Optional[int] = None
     num_microbatches: Optional[int] = None
+    #: pipeline schedule: "1f1b" | "interleaved" | "zb" | "gpipe"
+    #: (≙ reference pp_style one_f_one_b / interleaved / zbv)
+    pp_schedule: str = "1f1b"
+    #: virtual stages per device when pp_schedule == "interleaved"
+    #: (≙ num_model_chunks)
+    pp_chunks: int = 1
+
+    PP_SCHEDULES = ("1f1b", "interleaved", "zb", "gpipe")
 
     #: the reference's four SP modes (shard_config.py:13) + none.
     #: "ring" is the ring-matmul variant of split_gather — under XLA the
@@ -115,6 +123,22 @@ class HybridParallelPlugin(Plugin):
             raise ValueError(
                 "pp_size > 1 needs num_microbatches (or microbatch_size, resolved "
                 "against the example batch)"
+            )
+        if self.pp_schedule not in self.PP_SCHEDULES:
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r} not in {self.PP_SCHEDULES}"
+            )
+        # chunked virtual stages: required by interleaved, optional for zb
+        # (≙ ZBV's V-shaped chunking), meaningless for 1f1b/gpipe
+        if self.pp_schedule == "interleaved" and self.pp_chunks < 2:
+            raise ValueError(
+                "pp_schedule='interleaved' needs pp_chunks >= 2 (virtual "
+                "stages per device, ≙ num_model_chunks)"
+            )
+        if self.pp_schedule in ("1f1b", "gpipe") and self.pp_chunks != 1:
+            raise ValueError(
+                f"pp_chunks={self.pp_chunks} only applies to the interleaved/"
+                "zb schedules; use pp_schedule='interleaved'"
             )
 
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
@@ -170,6 +194,11 @@ class HybridParallelPlugin(Plugin):
         updates = {}
         if self.pp_size > 1 and model.config.pp_microbatches != n_micro:
             updates["pp_microbatches"] = n_micro
+        if self.pp_size > 1:
+            if getattr(model.config, "pp_schedule", "1f1b") != self.pp_schedule:
+                updates["pp_schedule"] = self.pp_schedule
+            if getattr(model.config, "pp_chunks", 1) != self.pp_chunks:
+                updates["pp_chunks"] = self.pp_chunks
         if not self.enable_flash_attention and getattr(model.config, "attention_impl", None) not in (None, "xla"):
             updates["attention_impl"] = "xla"
         mode = {"ring": "split_gather"}.get(self.sequence_parallel_mode, self.sequence_parallel_mode)

@@ -58,9 +58,12 @@ class DistCoordinator(metaclass=SingletonMeta):
     def block_all(self) -> None:
         """Barrier across all processes (collective over all devices)."""
         if self.world_size > 1:
-            # A tiny psum over every device acts as a global barrier.
+            # A tiny psum over every device acts as a global barrier. Sync by
+            # FETCHING the result — block_until_ready is a no-op on tunneled
+            # TPU backends, while a host fetch always waits for the value.
             x = jax.numpy.zeros((jax.local_device_count(),))
-            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+            out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
+            np.asarray(out)
 
     @contextmanager
     def priority_execution(self):

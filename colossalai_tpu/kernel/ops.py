@@ -17,18 +17,20 @@ from .loader import KernelLoader, on_tpu
 # ≙ extensions/pybind/flash_attention + flash_decoding_attention_kernel.cu
 
 
-def _flash_attention_xla(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None):
+def _flash_attention_xla(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None, sliding_window=None):
     from colossalai_tpu.shardformer.layer.attention import xla_attention
 
     return xla_attention(
-        q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        softmax_scale=softmax_scale, sliding_window=sliding_window,
     )
 
 
-def _flash_attention_pallas(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None):
+def _flash_attention_pallas(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None, sliding_window=None):
     from .pallas.flash_attention import flash_attention as fa
 
-    return fa(q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale)
+    return fa(q, k, v, causal=causal, segment_ids=segment_ids,
+              softmax_scale=softmax_scale, sliding_window=sliding_window)
 
 
 def _pallas_module(name: str):
@@ -48,10 +50,11 @@ KernelLoader.register("flash_attention", "pallas", _pallas_module("flash_attenti
 KernelLoader.register("flash_attention", "xla", lambda: True, _flash_attention_xla)
 
 
-def flash_attention(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None):
+def flash_attention(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None, sliding_window=None):
     """[B, S, H, D] attention via the best available kernel."""
     fn = KernelLoader.load("flash_attention")
-    return fn(q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale)
+    return fn(q, k, v, causal=causal, segment_ids=segment_ids,
+              softmax_scale=softmax_scale, sliding_window=sliding_window)
 
 
 # ------------------------------------------------------------------ RMSNorm

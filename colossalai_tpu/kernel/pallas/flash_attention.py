@@ -10,15 +10,25 @@ tiles); the public wrapper transposes from the model-side [B, S, H, D].
 GQA is handled by BlockSpec index maps (q-head → kv-head // group) — no
 KV repetition ever materializes.
 
+Masking (all composable, ≙ the reference's AttnMaskType matrix +
+RingAttention's position-exact masks, ``attn.py:54,406``):
+
+- causal, from block indices (static block skip above the diagonal) or from
+  **explicit position ids** (``q_positions``/``kv_positions``) — the ring
+  attention zigzag layout passes per-chunk global positions and the block
+  skip becomes a dynamic predicate on the loaded position tiles;
+- sliding window (Mistral), also position-exact;
+- segment ids (packed varlen, ≙ varlen_kvpacked path).
+
 Backward follows the standard two-pass flash design: a dq pass (grid over q
 blocks, inner kv) and a dk/dv pass (grid over kv blocks, inner q), both
-recomputing probs from the saved per-row LSE.
+recomputing probs from the saved per-row LSE with the same masks.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,10 +40,99 @@ DEFAULT_BLOCK_KV = 1024
 _NEG_INF = -1e9
 
 
+# Mosaic tiling: a [B, S] int vector cannot be block-specced as (1, block),
+# so q-side vectors are pre-broadcast to [B, S, LANES] (values along
+# sublanes of a (block_q, LANES) tile) and kv-side to [B, SUBLANES, S]
+# (values along lanes) — the same trick jax's own TPU flash kernel uses for
+# segment ids.
+_LANES = 128
+_SUBLANES = 8
+
+
+def _q_col(ref):
+    """(block_q, 1) value column from a q-side [1, block_q, LANES] tile."""
+    return ref[0][:, :1]
+
+
+def _kv_row(ref):
+    """(1, block_kv) value row from a kv-side [1, SUBLANES, block_kv] tile."""
+    return ref[0][:1, :]
+
+
+def _tile_mask(qi, ki, qpos_ref, kpos_ref, qseg_ref, kseg_ref, *, causal,
+               window, block_q, block_kv):
+    """[block_q, block_kv] bool mask (None = nothing to mask)."""
+    mask = None
+    if causal or window is not None:
+        if qpos_ref is not None:
+            qp = _q_col(qpos_ref)
+            kp = _kv_row(kpos_ref)
+        else:
+            shape = (block_q, block_kv)
+            qp = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            kp = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        if causal:
+            mask = qp >= kp
+        if window is not None:
+            w = (qp - kp) < window
+            mask = w if mask is None else mask & w
+    if qseg_ref is not None:
+        seg = _q_col(qseg_ref) == _kv_row(kseg_ref)
+        mask = seg if mask is None else mask & seg
+    if mask is not None and mask.shape != (block_q, block_kv):
+        mask = jnp.broadcast_to(mask, (block_q, block_kv))
+    return mask
+
+
+def _tile_needed(qi, ki, qpos_ref, kpos_ref, *, causal, window, block_q, block_kv):
+    """Block-skip predicate: static-shaped traced bool. With implicit
+    positions it depends only on program ids; with explicit ids it is
+    computed from the loaded position tiles (zigzag chunks stay skippable)."""
+    has_pos = qpos_ref is not None
+    conds = []
+    if causal:
+        if has_pos:
+            conds.append(jnp.max(qpos_ref[0]) >= jnp.min(kpos_ref[0]))
+        else:
+            conds.append((qi + 1) * block_q - 1 >= ki * block_kv)
+    if window is not None:
+        if has_pos:
+            conds.append(jnp.min(qpos_ref[0]) - jnp.max(kpos_ref[0]) < window)
+        else:
+            conds.append(qi * block_q - ((ki + 1) * block_kv - 1) < window)
+    if not conds:
+        return qi >= 0
+    needed = conds[0]
+    for c in conds[1:]:
+        needed = jnp.logical_and(needed, c)
+    return needed
+
+
+def _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg):
+    """[B, S] vectors → Mosaic-tileable layouts (see _LANES/_SUBLANES)."""
+    q_side = lambda a: None if a is None else jax.lax.broadcast_in_dim(
+        a, (a.shape[0], a.shape[1], _LANES), (0, 1)
+    )
+    kv_side = lambda a: None if a is None else jax.lax.broadcast_in_dim(
+        a, (a.shape[0], _SUBLANES, a.shape[1]), (0, 2)
+    )
+    return q_side(qpos), kv_side(kpos), q_side(qseg), kv_side(kseg)
+
+
 # ----------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_kv, num_kv_blocks):
+def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
+                block_kv, num_kv_blocks):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    qpos_ref = next(it) if has_pos else None
+    kpos_ref = next(it) if has_pos else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    o_ref, lse_ref = next(it), next(it)
+    acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -43,10 +142,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, s
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: blocks entirely above the diagonal contribute nothing — skip
-    # their MXU work (the reference kernel gets the same 2x from its
-    # upper-triangular specialization, scaled_upper_triang_masked_softmax).
-    needed = (qi + 1) * block_q - 1 >= ki * block_kv if causal else True
+    needed = _tile_needed(
+        qi, ki, qpos_ref, kpos_ref, causal=causal, window=window,
+        block_q=block_q, block_kv=block_kv,
+    )
 
     @pl.when(needed)
     def _compute():
@@ -56,16 +155,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, s
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_kv]
 
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        mask = _tile_mask(
+            qi, ki, qpos_ref, kpos_ref, qseg_ref, kseg_ref,
+            causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        )
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:]  # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)  # [block_q, block_kv]
+        if mask is not None:
+            # fully-masked rows: m stays _NEG_INF, exp(-1e9 - -1e9)=1 rows
+            # must not pollute l/acc
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
 
         v = v_ref[0, 0]
@@ -80,39 +185,63 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, s
         l = l_ref[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:] + jnp.log(safe_l)
+        # fully-masked rows keep lse = -inf-ish so ring merges ignore them
+        lse = jnp.where(l == 0.0, _NEG_INF, m_ref[:] + jnp.log(safe_l))
+        lse_ref[0, 0] = lse
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_kv):
-    """q [B,H,Sq,D], k/v [B,Hkv,Skv,D] → out [B,H,Sq,D], lse [B,H,Sq]."""
+def _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, kv_major=False):
+    """BlockSpecs for the optional (qpos, kpos, qseg, kseg) inputs.
+    Grid is (b*h, nq, nkv), or (b*h, nkv, nq) when ``kv_major`` (dkv pass).
+    q-side arrays are [B, Sq, LANES]; kv-side [B, SUBLANES, Skv]."""
+    if kv_major:
+        q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, qi: (bh // h, qi, 0), memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, ki, qi: (bh // h, 0, ki), memory_space=pltpu.VMEM)
+    else:
+        q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh // h, qi, 0), memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, qi, ki: (bh // h, 0, ki), memory_space=pltpu.VMEM)
+    specs = []
+    if has_pos:
+        specs += [q_spec, kv_spec]
+    if has_seg:
+        specs += [q_spec, kv_spec]
+    return specs
+
+
+def _fwd(q, k, v, qpos, kpos, qseg, kseg, *, scale, causal, window, block_q, block_kv):
+    """q [B,H,Sq,D], k/v [B,Hkv,Skv,D] → out [B,H,Sq,D], lse [B,H,Sq,1]."""
     b, h, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     group = h // hkv
     nq = pl.cdiv(sq, block_q)
     nkv = pl.cdiv(skv, block_kv)
+    has_pos = qpos is not None
+    has_seg = qseg is not None
 
     grid = (b * h, nq, nkv)
 
-    def q_map(bh, qi, ki):
-        return (bh // h, bh % h, qi, 0)
-
-    def kv_map(bh, qi, ki):
-        return (bh // h, (bh % h) // group, ki, 0)
-
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        has_pos=has_pos, has_seg=has_seg,
         block_q=block_q, block_kv=block_kv, num_kv_blocks=nkv,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
+    ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv)
+    qpos_t, kpos_t, qseg_t, kseg_t = _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg)
+    args = [q, k, v]
+    if has_pos:
+        args += [qpos_t, kpos_t]
+    if has_seg:
+        args += [qseg_t, kseg_t]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi, ki), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: kv_map(bh, qi, ki), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: kv_map(bh, qi, ki), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi, ki), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -125,14 +254,25 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_kv):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
 # ---------------------------------------------------------------- backward
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *, scale, causal, block_q, block_kv, num_kv_blocks):
+def _bwd_dq_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
+                   block_kv, num_kv_blocks):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    qpos_ref = next(it) if has_pos else None
+    kpos_ref = next(it) if has_pos else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    dq_ref = next(it)
+    acc_ref = next(it)
+
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -140,7 +280,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    needed = (qi + 1) * block_q - 1 >= ki * block_kv if causal else True
+    needed = _tile_needed(
+        qi, ki, qpos_ref, kpos_ref, causal=causal, window=window,
+        block_q=block_q, block_kv=block_kv,
+    )
 
     @pl.when(needed)
     def _compute():
@@ -152,11 +295,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
         delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        mask = _tile_mask(
+            qi, ki, qpos_ref, kpos_ref, qseg_ref, kseg_ref,
+            causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        )
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_kv]
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         acc_ref[:] = acc_ref[:] + jax.lax.dot(ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
@@ -166,7 +313,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_kv, num_q_blocks):
+def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
+                    block_kv, num_q_blocks):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    qpos_ref = next(it) if has_pos else None
+    kpos_ref = next(it) if has_pos else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    dk_ref, dv_ref = next(it), next(it)
+    dk_acc, dv_acc = next(it), next(it)
+
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -175,7 +333,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    needed = (qi + 1) * block_q - 1 >= ki * block_kv if causal else True
+    needed = _tile_needed(
+        qi, ki, qpos_ref, kpos_ref, causal=causal, window=window,
+        block_q=block_q, block_kv=block_kv,
+    )
 
     @pl.when(needed)
     def _compute():
@@ -187,11 +348,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        mask = _tile_mask(
+            qi, ki, qpos_ref, kpos_ref, qseg_ref, kseg_ref,
+            causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        )
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_kv]
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
 
         # dv += p^T @ do ; dk += ds^T @ q
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -209,55 +374,58 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, *, scale, causal, block_q, block_kv):
+def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
+         window, block_q, block_kv):
     b, h, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     group = h // hkv
     nq = pl.cdiv(sq, block_q)
     nkv = pl.cdiv(skv, block_kv)
+    has_pos = qpos is not None
+    has_seg = qseg is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # [B,H,Sq,1]
 
-    def q_map(bh, qi, ki=None):
-        return (bh // h, bh % h, qi, 0)
-
-    def kv_map_q(bh, qi, ki):
-        return (bh // h, (bh % h) // group, ki, 0)
+    qpos_t, kpos_t, qseg_t, kseg_t = _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg)
+    mask_args = ([qpos_t, kpos_t] if has_pos else []) + ([qseg_t, kseg_t] if has_seg else [])
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal,
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window,
+            has_pos=has_pos, has_seg=has_seg,
             block_q=block_q, block_kv=block_kv, num_kv_blocks=nkv,
         ),
         grid=(b * h, nq, nkv),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), kv_map_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), kv_map_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
+        ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv) + [
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: q_map(bh, qi), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, *mask_args, do, lse, delta)
 
-    # dk/dv per (b, q-head, kv block); summed over the GQA group afterwards
-    def kv_map(bh, ki, qi):
-        return (bh // h, (bh % h) // group, ki, 0)
-
+    # dk/dv per (b, q-head, kv block); summed over the GQA group afterwards.
+    # grid axis 1 = kv blocks, axis 2 = q blocks — mask specs get swapped
+    # index maps via kv_major.
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal,
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+            has_pos=has_pos, has_seg=has_seg,
             block_q=block_q, block_kv=block_kv, num_q_blocks=nq,
         ),
         grid=(b * h, nkv, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), kv_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
+        ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, kv_major=True) + [
             pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
@@ -275,7 +443,7 @@ def _bwd(q, k, v, out, lse, do, *, scale, causal, block_q, block_kv):
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, *mask_args, do, lse, delta)
 
     if group > 1:
         dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2)
@@ -293,23 +461,36 @@ def _interpret() -> bool:
         return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_kv):
-    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
-    return out
-
-
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_kv):
-    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd_rule(scale, causal, block_q, block_kv, res, do):
-    q, k, v, out, lse = res
-    dq, dk, dv = _bwd(
-        q, k, v, out, lse, do, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv
+# (q, k, v, qpos, kpos, qseg, kseg) diff/nondiff: mask inputs get zero
+# cotangents via custom_vjp residuals; statics are (scale, causal, window,
+# blocks, lse-return flag).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash_bhsd(q, k, v, qpos, kpos, qseg, kseg, scale, causal, window, block_q, block_kv):
+    out, lse = _fwd(
+        q, k, v, qpos, kpos, qseg, kseg,
+        scale=scale, causal=causal, window=window, block_q=block_q, block_kv=block_kv,
     )
-    return dq, dk, dv
+    return out, lse[..., 0]
+
+
+def _flash_fwd_rule(q, k, v, qpos, kpos, qseg, kseg, scale, causal, window, block_q, block_kv):
+    out, lse = _fwd(
+        q, k, v, qpos, kpos, qseg, kseg,
+        scale=scale, causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+    )
+    return (out, lse[..., 0]), (q, k, v, qpos, kpos, qseg, kseg, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, window, block_q, block_kv, res, cots):
+    q, k, v, qpos, kpos, qseg, kseg, out, lse = res
+    do, _ = cots  # lse cotangent: lse is a streaming statistic, treated as
+    # non-differentiable output (ring merges re-derive gradients through out)
+    dq, dk, dv = _bwd(
+        q, k, v, out, lse, do, qpos, kpos, qseg, kseg,
+        scale=scale, causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+    )
+    zero = lambda a: None if a is None else jnp.zeros_like(a)
+    return dq, dk, dv, zero(qpos), zero(kpos), zero(qseg), zero(kseg)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -322,13 +503,42 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_kv: int = DEFAULT_BLOCK_KV,
 ) -> jax.Array:
     """Flash attention on model-layout [B, S, H, D] tensors."""
-    if segment_ids is not None:
-        raise NotImplementedError("packed segment_ids: use the xla impl")
+    out, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        kv_segment_ids=kv_segment_ids, q_positions=q_positions,
+        kv_positions=kv_positions, sliding_window=sliding_window,
+        softmax_scale=softmax_scale, block_q=block_q, block_kv=block_kv,
+    )
+    return out
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
+    softmax_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+) -> Tuple[jax.Array, jax.Array]:
+    """Like :func:`flash_attention` but also returns the per-row LSE
+    ([B, H, Sq] fp32) — the streaming-softmax statistic ring attention needs
+    for its rescaled merge (≙ ``attn.py:376`` _rescale_out_lse)."""
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, skv = q.shape[1], k.shape[1]
     block_q = min(block_q, sq)
@@ -337,11 +547,21 @@ def flash_attention(
         raise ValueError(
             f"sequence lengths ({sq}, {skv}) must be multiples of blocks ({block_q}, {block_kv})"
         )
+    if (q_positions is None) != (kv_positions is None):
+        raise ValueError("pass both q_positions and kv_positions or neither")
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_kv)
-    return jnp.swapaxes(out, 1, 2)
+    as_i32 = lambda a: None if a is None else a.astype(jnp.int32)
+    out, lse = _flash_bhsd(
+        qt, kt, vt, as_i32(q_positions), as_i32(kv_positions),
+        as_i32(segment_ids), as_i32(kv_segment_ids),
+        scale, causal, sliding_window, block_q, block_kv,
+    )
+    return jnp.swapaxes(out, 1, 2), lse
 
 
 def supports(q_shape, k_shape, block_q: int = DEFAULT_BLOCK_Q, block_kv: int = DEFAULT_BLOCK_KV) -> bool:

@@ -34,6 +34,13 @@ class ModelConfig:
     # pipeline parallelism: number of microbatches streamed over the pp mesh
     # axis (0 = no pipelining). Set by HybridParallelPlugin.
     pp_microbatches: int = 0
+    # pipeline schedule (≙ reference pipeline/schedule/*): "1f1b" = memory-
+    # bounded custom_vjp stream (O(pp) live activations), "interleaved" =
+    # 1f1b with pp_chunks virtual stages per device, "zb" = 1f1b + deferred
+    # dW (zero-bubble weight store), "gpipe" = autodiff fill-drain stream.
+    pp_schedule: str = "1f1b"
+    # virtual stages per device for the interleaved schedule
+    pp_chunks: int = 1
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -190,23 +190,16 @@ class LlamaAttention(nn.Module):
         k = apply_rope(k, cos, sin)
 
         if sp == "ring_attn":
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "packed segment_ids are not supported under sp_mode='ring_attn'; "
-                    "use all_to_all or split_gather for packed batches"
-                )
-            if cfg.sliding_window is not None:
-                raise NotImplementedError(
-                    "sliding_window is not supported under sp_mode='ring_attn'; "
-                    "use all_to_all or split_gather"
-                )
             from colossalai_tpu.shardformer.layer.ring_attention import ring_attention
             from colossalai_tpu.tensor import current_mesh
 
             mesh = current_mesh()
             if mesh is None:
                 raise RuntimeError("sp_mode='ring_attn' requires an ambient mesh")
-            out = ring_attention(q, k, v, positions, mesh, causal=True)
+            out = ring_attention(
+                q, k, v, positions, mesh, causal=True,
+                sliding_window=cfg.sliding_window, segment_ids=segment_ids,
+            )
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,

@@ -139,6 +139,9 @@ class MixtralForCausalLM(nn.Module):
     config: MixtralConfig
     supports_sp_modes = ("split_gather", "all_to_all", "ring_attn")
     supports_ep = True
+    #: EP×PP composes (≙ MoeHybridParallelPlugin pp support): the 1f1b/zb
+    #: schedules stream per-stage MoE aux losses natively
+    supports_pipeline = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):

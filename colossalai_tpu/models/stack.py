@@ -49,12 +49,6 @@ def apply_decoder_stack(
     cfg = parent.config
 
     if cfg.scan_layers and cfg.pp_microbatches > 0 and not parent.is_initializing():
-        if has_aux:
-            raise NotImplementedError(
-                "auxiliary-loss models (MoE) under pipeline parallelism: aux "
-                "collection through the pp stream is not wired yet"
-            )
-        from colossalai_tpu.pipeline import pipeline_blocks
         from colossalai_tpu.tensor import current_mesh
 
         mesh = current_mesh()
@@ -69,11 +63,34 @@ def apply_decoder_stack(
         aux_in = {"positions": positions}
         if segment_ids is not None:
             aux_in["segment_ids"] = segment_ids
-        x = pipeline_blocks(
+
+        schedule = getattr(cfg, "pp_schedule", "1f1b")
+        if schedule == "gpipe":
+            if has_aux:
+                raise NotImplementedError(
+                    "MoE aux loss under the gpipe schedule: use pp_schedule="
+                    "'1f1b'/'interleaved'/'zb', which stream aux natively"
+                )
+            from colossalai_tpu.pipeline import pipeline_blocks
+
+            x = pipeline_blocks(
+                block_apply, stacked, x, mesh, cfg.pp_microbatches,
+                aux=aux_in, remat=cfg.remat,
+            )
+            return x, None
+
+        from colossalai_tpu.pipeline import pipeline_blocks_vjp
+
+        # pp_chunks is validated against the schedule by the plugin
+        chunks = getattr(cfg, "pp_chunks", 1)
+        out = pipeline_blocks_vjp(
             block_apply, stacked, x, mesh, cfg.pp_microbatches,
-            aux=aux_in, remat=cfg.remat,
+            aux=aux_in, remat=cfg.remat, chunks=chunks,
+            split_dw=(schedule == "zb"), has_aux=has_aux,
         )
-        return x, None
+        if has_aux:
+            return out
+        return out, None
 
     if cfg.scan_layers:
         Scanned = nn.scan(

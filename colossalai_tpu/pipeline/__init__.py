@@ -1,4 +1,5 @@
+from .one_f_one_b import pipeline_blocks_vjp
 from .schedule import pipeline_blocks
 from .stage_manager import PipelineStageManager
 
-__all__ = ["pipeline_blocks", "PipelineStageManager"]
+__all__ = ["pipeline_blocks", "pipeline_blocks_vjp", "PipelineStageManager"]

@@ -1,0 +1,427 @@
+"""Memory-bounded pipeline schedules: 1F1B / interleaved / dW-split (ZB).
+
+≙ reference ``pipeline/schedule/one_f_one_b.py:28``, ``interleaved_pp.py:26``,
+``zero_bubble_pp.py:40`` + ``weight_grad_store.py:4``. There, every rank runs
+a hand-ordered Python loop of P2P sends and autograd calls; the 1F1B point is
+the MEMORY profile — at most ``pp`` microbatch activations live per stage,
+vs GPipe's ``n_micro``.
+
+The TPU redesign keeps the whole step one XLA program and gets the same
+memory profile from a ``jax.custom_vjp``:
+
+- **forward** streams microbatches through the stage ring (``ppermute``)
+  storing NOTHING but the pipeline input (O(1) residuals);
+- **backward** re-streams the forward (recompute) while the cotangent ring
+  runs ``2·(V-1)`` ticks behind, popping stage inputs from a ring stash of
+  depth ``min(n_micro, 2V-1)`` — O(pp) live activations per stage, the 1F1B
+  profile (the lockstep-SPMD in-flight bound is 2·(V-1-u)+1 for virtual
+  stage u, vs the async reference's pp-u; both are O(pp), not O(n_micro));
+- **interleaved** (``chunks > 1``): each physical stage holds ``chunks``
+  non-contiguous layer spans (virtual stages u = c·pp + s, ring lanes carry
+  one activation per chunk), reducing the fill/drain bubble fraction the
+  same way ``InterleavedSchedule`` does;
+- **dW split** (``split_dw=True``, ≙ ``weight_grad_store.py:4`` /
+  ZeroBubbleVPipeScheduler): the backward tick computes only dX (the
+  critical-path chain) and defers each stage's dW by ``V`` ticks, filling
+  the cooldown bubble with weight-gradient work.
+
+Compute cost: forward + recompute + backward — identical to full-remat
+GPipe; the win is peak memory (asserted by tests/test_pipeline).
+Collectives (``ppermute``/``psum``) stay OUTSIDE ``lax.cond`` so control
+flow can diverge per stage without deadlocking the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _mb_split(a, n):
+    return a.reshape((n, a.shape[0] // n) + a.shape[1:])
+
+
+def _platform(mesh) -> str:
+    return mesh.devices.flat[0].platform
+
+
+def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool):
+    """(p_c [Lv, ...], h, aux_t) -> (h, aux_scalar): scan of one stage's blocks."""
+
+    body_fn = block_apply
+    if remat:
+        body_fn = jax.checkpoint(block_apply, prevent_cse=False)
+
+    def stage_fn(p_c, h, aux_t):
+        def body(carry, p_layer):
+            h, aux = carry
+            out = body_fn(p_layer, h, aux_t)
+            if has_aux:
+                h2, a = out
+                return (h2, aux + a), None
+            return (out, aux), None
+
+        (h, aux), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)), p_c)
+        return h, aux
+
+    return stage_fn
+
+
+# custom_vjp: static config first (nondiff), then diff args (params, x, aux).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _pipe(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw, has_aux,
+          stacked_params, x, aux):
+    out, aux_total, _ = _pipe_fwd_impl(
+        block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw, has_aux,
+        stacked_params, x, aux,
+    )
+    return out, aux_total
+
+
+def _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks):
+    pp = mesh.shape[pp_axis]
+    V = chunks * pp
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if L % V:
+        raise ValueError(f"L={L} layers not divisible by chunks*pp={V}")
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by num_microbatches={n_micro}")
+    return pp, V, L // V
+
+
+def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
+                   has_aux, stacked_params, x, aux):
+    pp, V, Lv = _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks)
+    n = n_micro
+    cast = _platform(mesh) != "tpu"  # CPU XLA miscompiles narrow-dtype
+    x_dtype = x.dtype                # collectives in nested manual regions
+
+    params_r = jax.tree.map(
+        lambda l: l.reshape((chunks, pp, Lv) + l.shape[1:]), stacked_params
+    )
+    x_mb = _mb_split(x, n)
+    if cast:
+        x_mb = x_mb.astype(jnp.float32)
+    aux_mb = jax.tree.map(lambda a: _mb_split(a, n), aux)
+    stage_fn = _make_stage_fn(block_apply, remat, has_aux)
+
+    def local_fn(params_l, x_mb_l, aux_mb_l):
+        s = jax.lax.axis_index(pp_axis)
+        T = n + V - 1
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def run(c, valid, inp, t):
+            """Masked stage compute for chunk c at tick t. Always executes
+            (no lax.cond): the block body may contain GSPMD auto-axis
+            collectives (dp/tp resharding inside the model), and divergent
+            per-stage branches around collectives deadlock the program —
+            uniform execution with a select is the only safe SPMD form."""
+            f = jnp.clip(t - (c * pp + s), 0, n - 1)
+            aux_t = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, f, keepdims=False),
+                aux_mb_l,
+            )
+            p_c = jax.tree.map(lambda l: l[c, 0], params_l)
+            inp = inp.astype(x_dtype)
+            h, a = stage_fn(p_c, inp, aux_t)
+            h = jnp.where(valid, h, inp)
+            a = jnp.where(valid, a, 0.0)
+            return h.astype(x_mb_l.dtype), a
+
+        def tick(carry, t):
+            send, outputs, aux_acc = carry
+            recv = jax.lax.ppermute(send, pp_axis, fwd_perm)
+            lanes = []
+            for c in range(chunks):
+                u = c * pp + s
+                f = t - u
+                valid = (f >= 0) & (f < n)
+                x_in = jax.lax.dynamic_index_in_dim(
+                    x_mb_l, jnp.clip(f, 0, n - 1), keepdims=False
+                )
+                if c == 0:
+                    inp = jnp.where(s == 0, x_in, recv[0])
+                else:
+                    inp = jnp.where(s == 0, recv[c - 1], recv[c])
+                h, a = run(c, valid, inp, t)
+                lanes.append(h)
+                aux_acc = aux_acc + jnp.where(valid, a, 0.0)
+            # collect the last chunk's output at the last stage
+            out_i = jnp.clip(t - (V - 1), 0, n - 1)
+            collect = (s == pp - 1) & (t - (V - 1) >= 0)
+            prev = jax.lax.dynamic_index_in_dim(outputs, out_i, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(collect, lanes[-1], prev), out_i, 0
+            )
+            return (jnp.stack(lanes), outputs, aux_acc), None
+
+        send0 = jnp.zeros((chunks,) + x_mb_l.shape[1:], x_mb_l.dtype)
+        (send, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (send0, jnp.zeros_like(x_mb_l), jnp.zeros((), jnp.float32)),
+            jnp.arange(T),
+        )
+        # replicate last-stage outputs across pp; aux: sum over stages/layers
+        # but MEAN over microbatches — block aux is a batch-mean statistic
+        # (equal-size microbatches: full-batch mean = mean of per-mb means)
+        mask = (s == pp - 1).astype(outputs.dtype)
+        outputs = jax.lax.psum(outputs * mask, pp_axis)
+        aux_acc = jax.lax.psum(aux_acc, pp_axis) / n
+        return outputs, aux_acc
+
+    param_specs = jax.tree.map(
+        lambda l: P(None, pp_axis, *([None] * (l.ndim - 2))), params_r
+    )
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb)),
+        out_specs=(P(), P()),
+        axis_names={pp_axis},
+        check_vma=False,
+    )
+    out_mb, aux_total = fn(params_r, x_mb, aux_mb)
+    out = out_mb.reshape(x.shape).astype(x_dtype)
+    return out, aux_total, (stacked_params, x, aux)
+
+
+def _pipe_fwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
+              has_aux, stacked_params, x, aux):
+    out, aux_total, res = _pipe_fwd_impl(
+        block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw, has_aux,
+        stacked_params, x, aux,
+    )
+    return (out, aux_total), res
+
+
+def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
+              has_aux, res, cotangents):
+    """Recompute-interleaved backward: forward re-stream + cotangent ring
+    2(V-1) ticks behind, ring stash of stage inputs (depth O(pp))."""
+    dout, daux = cotangents
+    stacked_params, x, aux = res
+    pp, V, Lv = _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks)
+    n = n_micro
+    cast = _platform(mesh) != "tpu"
+    x_dtype = x.dtype
+
+    params_r = jax.tree.map(
+        lambda l: l.reshape((chunks, pp, Lv) + l.shape[1:]), stacked_params
+    )
+    x_mb = _mb_split(x, n)
+    dout_mb = _mb_split(dout.astype(x_dtype), n)
+    if cast:
+        x_mb = x_mb.astype(jnp.float32)
+        dout_mb = dout_mb.astype(jnp.float32)
+    aux_mb = jax.tree.map(lambda a: _mb_split(a, n), aux)
+    stage_fn = _make_stage_fn(block_apply, remat, has_aux)
+
+    Dw = V if split_dw else 0      # dW deferral distance (ZB weight store)
+    R = min(n, 2 * V - 1 + Dw)     # input-stash ring depth: O(pp), not O(n)
+    # cotangent stash: b_i and w_i = b_i - Dw are both live in one tick, so
+    # the ring needs Dw+1 slots (Dw aliases w_i onto the slot written first)
+    Rw = min(n, Dw + 1) if split_dw else 1
+
+    def local_fn(params_l, x_mb_l, aux_mb_l, dout_l, daux_l):
+        s = jax.lax.axis_index(pp_axis)
+        T = n + 2 * (V - 1) + Dw
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        rev_perm = [(i, (i - 1) % pp) for i in range(pp)]
+        mb_shape = x_mb_l.shape[1:]
+
+        p_local = jax.tree.map(lambda l: l[:, 0], params_l)  # [chunks, Lv, ...]
+        dparams0 = jax.tree.map(jnp.zeros_like, p_local)
+
+        def aux_at(idx):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, jnp.clip(idx, 0, n - 1), keepdims=False
+                ),
+                aux_mb_l,
+            )
+
+        def p_at(c):
+            return jax.tree.map(lambda l: l[c], p_local)
+
+        # No lax.cond around stage compute anywhere below: block bodies can
+        # contain GSPMD auto-axis collectives and divergent per-stage
+        # branches around collectives deadlock — always compute, mask with
+        # selects (bubble ticks burn compute; the memory profile is what
+        # 1F1B is about).
+
+        def fwd_compute(c, valid, inp, f):
+            inp = inp.astype(x_dtype)
+            h, _ = stage_fn(p_at(c), inp, aux_at(f))
+            h = jnp.where(valid, h, inp)
+            return h.astype(x_mb_l.dtype)
+
+        def bwd_compute(c, valid, h_in, g_out, b):
+            """vjp of stage c on stashed input; returns (dp_c, dx)."""
+            p_c = p_at(c)
+            aux_t = aux_at(b)
+            h_in = h_in.astype(x_dtype)
+            g = (g_out.astype(x_dtype), daux_l.astype(jnp.float32))
+
+            if split_dw:
+                # dX only: params closed over (≙ ZB's B pass)
+                _, vjp = jax.vjp(lambda hh: stage_fn(p_c, hh, aux_t), h_in)
+                dx = vjp(g)[0]
+                return None, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype)
+
+            _, vjp = jax.vjp(lambda p, hh: stage_fn(p, hh, aux_t), p_c, h_in)
+            dp, dx = vjp(g)
+            dp = jax.tree.map(lambda g_: jnp.where(valid, g_, 0.0), dp)
+            return dp, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype)
+
+        def w_compute(c, valid, h_in, g_out, b):
+            """deferred dW (≙ WeightGradStore.flush): params-grad only."""
+            p_c = p_at(c)
+            aux_t = aux_at(b)
+            g = (g_out.astype(x_dtype), daux_l.astype(jnp.float32))
+            _, vjp = jax.vjp(lambda p: stage_fn(p, h_in.astype(x_dtype), aux_t), p_c)
+            dp = vjp(g)[0]
+            return jax.tree.map(lambda g_: jnp.where(valid, g_, 0.0), dp)
+
+        def tick(carry, t):
+            send_f, send_b, stash, wstash, dparams, dx_acc = carry
+            recv_f = jax.lax.ppermute(send_f, pp_axis, fwd_perm)
+            recv_b = jax.lax.ppermute(send_b, pp_axis, rev_perm)
+            lanes_f, lanes_b = [], []
+            for c in range(chunks):
+                u = c * pp + s
+                # ---- recompute stream (same cadence as the primal forward)
+                f = t - u
+                valid_f = (f >= 0) & (f < n)
+                x_in = jax.lax.dynamic_index_in_dim(
+                    x_mb_l, jnp.clip(f, 0, n - 1), keepdims=False
+                )
+                if c == 0:
+                    inp = jnp.where(s == 0, x_in, recv_f[0])
+                else:
+                    inp = jnp.where(s == 0, recv_f[c - 1], recv_f[c])
+                slot = jnp.where(valid_f, jnp.mod(f, R), 0)
+                old = jax.lax.dynamic_index_in_dim(stash[c], slot, keepdims=False)
+                stash = stash.at[c].set(
+                    jax.lax.dynamic_update_index_in_dim(
+                        stash[c], jnp.where(valid_f, inp, old), slot, 0
+                    )
+                )
+                lanes_f.append(fwd_compute(c, valid_f, inp, f))
+
+                # ---- cotangent stream, 2(V-1) ticks behind
+                b_i = t - 2 * (V - 1) + u
+                valid_b = (b_i >= 0) & (b_i < n)
+                d_seed = jax.lax.dynamic_index_in_dim(
+                    dout_l, jnp.clip(b_i, 0, n - 1), keepdims=False
+                )
+                if c == chunks - 1:
+                    g_out = jnp.where(s == pp - 1, d_seed, recv_b[c])
+                else:
+                    g_out = jnp.where(s == pp - 1, recv_b[c + 1], recv_b[c])
+                bslot = jnp.where(valid_b, jnp.mod(b_i, R), 0)
+                h_in = jax.lax.dynamic_index_in_dim(stash[c], bslot, keepdims=False)
+                dp, dx = bwd_compute(c, valid_b, h_in, g_out, b_i)
+                lanes_b.append(dx)
+                if dp is not None:
+                    dparams = jax.tree.map(
+                        lambda acc, g_: acc.at[c].add(g_), dparams, dp
+                    )
+                if split_dw:
+                    # store (g_out) for the deferred dW pass
+                    wslot = jnp.where(valid_b, jnp.mod(b_i, Rw), 0)
+                    oldw = jax.lax.dynamic_index_in_dim(wstash[c], wslot, keepdims=False)
+                    wstash = wstash.at[c].set(
+                        jax.lax.dynamic_update_index_in_dim(
+                            wstash[c], jnp.where(valid_b, g_out, oldw), wslot, 0
+                        )
+                    )
+                    # ---- deferred dW, Dw ticks behind the dX pass
+                    w_i = b_i - Dw
+                    valid_w = (w_i >= 0) & (w_i < n)
+                    ws = jnp.where(valid_w, jnp.mod(w_i, Rw), 0)
+                    hs = jnp.where(valid_w, jnp.mod(w_i, R), 0)
+                    g_w = jax.lax.dynamic_index_in_dim(wstash[c], ws, keepdims=False)
+                    h_w = jax.lax.dynamic_index_in_dim(stash[c], hs, keepdims=False)
+                    dp_w = w_compute(c, valid_w, h_w, g_w, w_i)
+                    dparams = jax.tree.map(
+                        lambda acc, g_: acc.at[c].add(g_), dparams, dp_w
+                    )
+
+                # embed cotangent: stage 0, chunk 0
+                if c == 0:
+                    bi_c = jnp.clip(b_i, 0, n - 1)
+                    write_dx = (s == 0) & valid_b
+                    prev_dx = jax.lax.dynamic_index_in_dim(dx_acc, bi_c, keepdims=False)
+                    dx_acc = jax.lax.dynamic_update_index_in_dim(
+                        dx_acc, jnp.where(write_dx, dx, prev_dx), bi_c, 0
+                    )
+            return (
+                jnp.stack(lanes_f), jnp.stack(lanes_b), stash, wstash,
+                dparams, dx_acc,
+            ), None
+
+        send0 = jnp.zeros((chunks,) + mb_shape, x_mb_l.dtype)
+        stash0 = jnp.zeros((chunks, R) + mb_shape, x_mb_l.dtype)
+        wstash0 = jnp.zeros((chunks, Rw) + mb_shape, x_mb_l.dtype)
+        carry0 = (send0, send0, stash0, wstash0, dparams0, jnp.zeros_like(x_mb_l))
+        (_, _, _, _, dparams, dx_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+        # dx lives only on stage 0 → replicate; dparams stay pp-local
+        mask = (s == 0).astype(dx_acc.dtype)
+        dx_acc = jax.lax.psum(dx_acc * mask, pp_axis)
+        dparams = jax.tree.map(lambda g: g[:, None], dparams)  # [chunks,1,Lv,...]
+        return dparams, dx_acc
+
+    param_specs = jax.tree.map(
+        lambda l: P(None, pp_axis, *([None] * (l.ndim - 2))), params_r
+    )
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb), P(), P()),
+        out_specs=(param_specs, P()),
+        axis_names={pp_axis},
+        check_vma=False,
+    )
+    # the fwd averaged aux over microbatches, so each per-mb vjp seed is 1/n
+    daux_in = jnp.asarray(daux, jnp.float32) / n
+    dparams_r, dx_mb = fn(params_r, x_mb, aux_mb, dout_mb, daux_in)
+    dparams = jax.tree.map(
+        lambda g, l: g.reshape(l.shape).astype(l.dtype), dparams_r, stacked_params
+    )
+    dx = dx_mb.reshape(x.shape).astype(x.dtype)
+    daux_zeros = jax.tree.map(lambda a: jnp.zeros_like(a), aux)
+    return dparams, dx, daux_zeros
+
+
+_pipe.defvjp(_pipe_fwd, _pipe_bwd)
+
+
+def pipeline_blocks_vjp(
+    block_apply: Callable,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh,
+    num_microbatches: int,
+    aux: Any = None,
+    *,
+    pp_axis: str = "pp",
+    remat: bool = True,
+    chunks: int = 1,
+    split_dw: bool = False,
+    has_aux: bool = False,
+):
+    """Run a stack of L blocks as a memory-bounded pp pipeline (see module
+    docstring). Returns ``x_out`` or ``(x_out, aux_total)`` if ``has_aux``."""
+    aux = aux if aux is not None else {}
+    out, aux_total = _pipe(
+        block_apply, mesh, num_microbatches, pp_axis, bool(remat), int(chunks),
+        bool(split_dw), bool(has_aux), stacked_params, x, aux,
+    )
+    if has_aux:
+        return out, aux_total
+    return out

@@ -1,9 +1,10 @@
-"""Pipeline schedule: microbatch streaming over the ``pp`` mesh axis.
+"""GPipe pipeline schedule: autodiff microbatch streaming over ``pp``.
 
-≙ reference ``OneForwardOneBackwardSchedule`` / ``InterleavedSchedule``
-(``pipeline/schedule/``): there, explicit P2P sends of pickled pytrees with
-warmup/steady/cooldown phases hand-ordered per rank. Under XLA the whole
-train step is one program, so the schedule is expressed as data flow:
+≙ reference GPipe-style fill-drain; the memory-bounded 1F1B / interleaved /
+zero-bubble schedules live in ``one_f_one_b.py`` (the default). This
+schedule keeps the simplest possible structure — a forward-only streamed
+loop whose backward XLA derives by transposing the scan (ppermuteᵀ =
+reverse ring):
 
 - layer params stay stacked [L, ...] and sharded over ``pp`` on the layer
   dim — each stage holds L/pp layers;
@@ -11,12 +12,10 @@ train step is one program, so the schedule is expressed as data flow:
   stages: each tick runs the local stage and rotates activations to the
   next stage with ``ppermute`` (the P2P of ``pipeline/p2p.py``, minus the
   pickle transport — pytree metadata is static under jit);
-- fill-drain (GPipe) ordering with T = n_micro + pp − 1 ticks; XLA derives
-  the backward pipeline by transposing the loop (ppermuteᵀ = reverse ring),
-  which reproduces the cooldown phase of 1F1B;
-- bubble fraction = (pp−1)/T, same as the reference's 1F1B. The 1F1B
-  *memory* advantage is recovered with per-stage remat instead of schedule
-  reordering.
+- fill-drain ordering with T = n_micro + pp − 1 ticks; bubble fraction
+  (pp−1)/T, same as 1F1B. Live activations are O(n_micro) per stage (the
+  scan carry + autodiff residuals) — use pp_schedule="1f1b" when n_micro
+  is large (tests/test_pipeline asserts the memory gap).
 
 Other mesh axes (dp/tp/sp/ep) stay in GSPMD auto mode — TP collectives etc.
 keep working inside each stage.
@@ -74,11 +73,13 @@ def pipeline_blocks(
         raise ValueError(f"batch {b} not divisible by num_microbatches={num_microbatches}")
 
     mb_split = lambda a: a.reshape((num_microbatches, b // num_microbatches) + a.shape[1:])
-    # fp32 at the shard_map boundary: the transpose of a pp-replicated input
-    # is a psum over pp, and XLA's all-reduce promotion miscompiles narrow
-    # dtypes inside manual regions (CPU backend crash); compute stays bf16.
+    # fp32 at the shard_map boundary on NON-TPU backends only: the transpose
+    # of a pp-replicated input is a psum over pp, and the CPU backend's
+    # all-reduce promotion miscompiles narrow dtypes inside manual regions.
+    # On TPU the boundary stays in the compute dtype (bf16) — no extra bytes.
+    cast = mesh.devices.flat[0].platform != "tpu"
     x_dtype = x.dtype
-    x_mb = mb_split(x).astype(jnp.float32)
+    x_mb = mb_split(x).astype(jnp.float32) if cast else mb_split(x)
     aux_mb = jax.tree.map(mb_split, aux)
 
     def local_fn(params_l, x_mb_l, aux_mb_l):
@@ -125,12 +126,13 @@ def pipeline_blocks(
             tick, (zero_state, outputs0), jnp.arange(T)
         )
         # replicate the last stage's result across pp so downstream (norm,
-        # head, loss) sees a pp-consistent value. fp32 psum: XLA's
-        # all-reduce-promotion pass miscompiles narrow-dtype psum inside
-        # nested manual regions (crash observed on CPU backend).
-        mask = (stage == pp - 1).astype(jnp.float32)
-        outputs = jax.lax.psum(outputs.astype(jnp.float32) * mask, pp_axis)
-        return outputs.astype(x_mb_l.dtype)
+        # head, loss) sees a pp-consistent value. The psum runs fp32 on CPU
+        # only (see cast above); on TPU it stays in the compute dtype.
+        if cast:
+            outputs = outputs.astype(jnp.float32)
+        mask = (stage == pp - 1).astype(outputs.dtype)
+        outputs = jax.lax.psum(outputs * mask, pp_axis)
+        return outputs.astype(x_dtype)
 
     param_specs = jax.tree.map(
         lambda l: P(pp_axis, *([None] * (l.ndim - 1))), stacked_params

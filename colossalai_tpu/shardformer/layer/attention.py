@@ -106,13 +106,12 @@ def dot_product_attention(
     """Attention entry point used by all model forwards.
 
     ``impl``: "auto" | "xla" | "pallas". "auto" chooses the Pallas flash
-    kernel on TPU when shapes are tile-friendly, else XLA. A sliding window
-    forces the XLA path (the flash kernel has no window support yet).
+    kernel on TPU when shapes are tile-friendly, else XLA. Sliding windows
+    and packed segment ids run in the kernel (position/segment tile masks);
+    only an additive bias forces the XLA path.
     """
     if impl == "auto":
-        impl = "pallas" if (sliding_window is None and _pallas_eligible(q, k, bias, segment_ids)) else "xla"
-    if impl == "pallas" and sliding_window is not None:
-        raise ValueError("sliding_window is not supported by the pallas kernel; use impl='xla'/'auto'")
+        impl = "pallas" if _pallas_eligible(q, k, bias) else "xla"
     if impl == "pallas":
         if bias is not None:
             raise ValueError(
@@ -122,7 +121,8 @@ def dot_product_attention(
         from colossalai_tpu.kernel import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            sliding_window=sliding_window, softmax_scale=softmax_scale,
         )
     return xla_attention(
         q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
@@ -130,8 +130,8 @@ def dot_product_attention(
     )
 
 
-def _pallas_eligible(q, k, bias, segment_ids) -> bool:
-    if bias is not None or segment_ids is not None:
+def _pallas_eligible(q, k, bias) -> bool:
+    if bias is not None:
         return False
     from colossalai_tpu.kernel.loader import on_tpu
 

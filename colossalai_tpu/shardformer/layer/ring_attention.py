@@ -5,18 +5,26 @@ hand-written autograd.Function with double-ring NCCL P2P, two CUDA streams
 overlapping LSE correction with the next flash call, and zigzag batch
 splitting. The TPU design:
 
-- ``shard_map`` over the sp axis; KV blocks rotate ring-wise with
-  ``jax.lax.ppermute`` riding ICI neighbours. XLA overlaps the permute with
-  the local attention compute (the analog of the reference's two streams).
+- ``shard_map``; KV blocks rotate ring-wise with ``jax.lax.ppermute``
+  riding ICI neighbours. XLA overlaps the permute with the local attention
+  compute (the analog of the reference's two streams).
+- **the inner step is the Pallas flash kernel** (out + LSE): per ring step
+  HBM traffic is O(s_local·d), never O(s_local²) — the composition the
+  reference gets from flash-attn-inside-ring (``attn.py:406-622``).
 - streaming softmax merge: each step produces a local (out, lse); merged
   with the running pair by the standard rescaling identity
   (≙ ``_rescale_out_lse``, ``attn.py:376``).
 - causal balance comes from the **zigzag layout** (``split_batch_zigzag``,
   ``layer/utils.py:331``): rank r holds chunks (r, 2·sp−1−r), so every rank
   sees the same causal workload. Correctness is position-based — each chunk
-  carries global position ids, so the mask is exact regardless of layout.
-- the backward is jax autodiff through the scan + ppermute (reverse-mode
-  ppermute is the inverse permute), so no hand-written backward is needed.
+  carries global position ids, so the mask is exact regardless of layout;
+  sliding windows and packed segment ids ride the same masks.
+- the flash path has a hand-written ring backward (``custom_vjp``): probs
+  are recomputed against the GLOBAL lse, which linearizes the merge — each
+  ring step runs the flash backward and dk/dv accumulators travel around
+  the ring back to their owner (≙ the reference's backward ring of
+  flash_attn_backward calls). The jnp fallback (odd shapes) remains plain
+  autodiff through the scan.
 """
 
 from __future__ import annotations
@@ -72,6 +80,168 @@ def _merge(out_a, lse_a, out_b, lse_b):
     return out_a * wa + out_b * wb, lse_new
 
 
+# ------------------------------------------------------- flash ring (pallas)
+
+
+def _ring_specs(mesh, sp_axis):
+    """Fully-manual specs for the flash ring: a pallas_call is opaque to
+    GSPMD, so every sharded axis (batch over dp/ep, heads over tp) must be
+    manual, not auto, or XLA would replicate those dims around the kernel."""
+    names = set(getattr(mesh, "axis_names", ()) or mesh.shape.keys())
+    batch = tuple(a for a in ("dp", "ep") if a in names)
+    head = "tp" if "tp" in names else None
+    b_spec = batch if batch else None
+    qkv = P(b_spec, sp_axis, head, None)
+    pos = P(b_spec, sp_axis)
+    lse = P(b_spec, head, sp_axis)  # [B, H, S] — heads stay tp-sharded
+    manual = set(batch) | {sp_axis} | ({head} if head else set())
+    return qkv, pos, lse, manual
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _ring_flash(mesh, sp_axis, causal, window, scale, q, k, v, pos, seg):
+    out, _ = _ring_flash_fwd_impl(mesh, sp_axis, causal, window, scale, q, k, v, pos, seg)
+    return out
+
+
+def _ring_flash_fwd_impl(mesh, sp_axis, causal, window, scale, q, k, v, pos, seg):
+    from colossalai_tpu.kernel.pallas.flash_attention import flash_attention_with_lse
+
+    sp_size = mesh.shape[sp_axis]
+    qkv_spec, pos_spec, lse_spec, manual = _ring_specs(mesh, sp_axis)
+    has_seg = seg is not None
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    def local_fn(q_l, k_l, v_l, pos_l, *rest):
+        seg_l = rest[0] if has_seg else None
+
+        def step(k_c, v_c, pos_c, seg_c):
+            o, lse = flash_attention_with_lse(
+                q_l, k_c, v_c, causal=causal, sliding_window=window,
+                q_positions=pos_l, kv_positions=pos_c,
+                segment_ids=seg_l,
+                kv_segment_ids=seg_c if has_seg else None,
+                softmax_scale=scale,
+            )
+            return o.astype(jnp.float32), lse
+
+        out, lse = step(k_l, v_l, pos_l, seg_l)
+
+        def body(carry, _):
+            out, lse, k_c, v_c, pos_c, seg_c = carry
+            k_c = jax.lax.ppermute(k_c, sp_axis, perm)
+            v_c = jax.lax.ppermute(v_c, sp_axis, perm)
+            pos_c = jax.lax.ppermute(pos_c, sp_axis, perm)
+            if has_seg:
+                seg_c = jax.lax.ppermute(seg_c, sp_axis, perm)
+            o_i, lse_i = step(k_c, v_c, pos_c, seg_c)
+            out, lse = _merge(out, lse, o_i, lse_i)
+            return (out, lse, k_c, v_c, pos_c, seg_c), None
+
+        seg0 = seg_l if has_seg else jnp.zeros((), jnp.int32)
+        (out, lse, *_), _ = jax.lax.scan(
+            body, (out, lse, k_l, v_l, pos_l, seg0), None, length=sp_size - 1
+        )
+        return out.astype(q_l.dtype), lse
+
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, pos_spec] + ([pos_spec] if has_seg else [])
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(qkv_spec, lse_spec),
+        axis_names=manual,
+        check_vma=False,
+    )
+    args = (q, k, v, pos) + ((seg,) if has_seg else ())
+    return fn(*args)
+
+
+def _ring_flash_fwd(mesh, sp_axis, causal, window, scale, q, k, v, pos, seg):
+    out, lse = _ring_flash_fwd_impl(mesh, sp_axis, causal, window, scale, q, k, v, pos, seg)
+    return out, (q, k, v, pos, seg, out, lse)
+
+
+def _ring_flash_bwd(mesh, sp_axis, causal, window, scale, res, do):
+    """Ring backward with the global-LSE trick: probs recomputed against the
+    merged lse make each partial contribution linear, so the merge needs no
+    differentiation. dk/dv accumulators travel the full ring (sp rotations)
+    back to their owners."""
+    from colossalai_tpu.kernel.pallas.flash_attention import _bwd
+
+    q, k, v, pos, seg, out, lse = res
+    sp_size = mesh.shape[sp_axis]
+    qkv_spec, pos_spec, lse_spec, manual = _ring_specs(mesh, sp_axis)
+    has_seg = seg is not None
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    def local_fn(q_l, k_l, v_l, pos_l, out_l, lse_l, do_l, *rest):
+        seg_l = rest[0] if has_seg else None
+        swap = lambda a: jnp.swapaxes(a, 1, 2)
+        qt, out_t, do_t = swap(q_l), swap(out_l), swap(do_l)
+        lse4 = lse_l[..., None]
+        i32 = lambda a: None if a is None else a.astype(jnp.int32)
+
+        def step(k_c, v_c, pos_c, seg_c):
+            return _bwd(
+                qt, swap(k_c), swap(v_c), out_t, lse4, do_t,
+                i32(pos_l), i32(pos_c), i32(seg_l),
+                i32(seg_c) if has_seg else None,
+                scale=scale, causal=causal, window=window,
+                block_q=512 if qt.shape[2] >= 512 else qt.shape[2],
+                block_kv=1024 if k_c.shape[1] >= 1024 else k_c.shape[1],
+            )
+
+        def body(carry, _):
+            dq, k_c, v_c, pos_c, seg_c, dk_c, dv_c = carry
+            dq_i, dk_i, dv_i = step(k_c, v_c, pos_c, seg_c)
+            dq = dq + dq_i.astype(jnp.float32)
+            dk_c = dk_c + dk_i.astype(jnp.float32)
+            dv_c = dv_c + dv_i.astype(jnp.float32)
+            # rotate kv AND their grad accumulators to the next rank; after
+            # sp_size rotations everything is home
+            k_c = jax.lax.ppermute(k_c, sp_axis, perm)
+            v_c = jax.lax.ppermute(v_c, sp_axis, perm)
+            pos_c = jax.lax.ppermute(pos_c, sp_axis, perm)
+            dk_c = jax.lax.ppermute(dk_c, sp_axis, perm)
+            dv_c = jax.lax.ppermute(dv_c, sp_axis, perm)
+            if has_seg:
+                seg_c = jax.lax.ppermute(seg_c, sp_axis, perm)
+            return (dq, k_c, v_c, pos_c, seg_c, dk_c, dv_c), None
+
+        b, s_l, hkv, d = k_l.shape
+        dq0 = jnp.zeros(qt.shape, jnp.float32)
+        dkv0 = jnp.zeros((b, hkv, s_l, d), jnp.float32)
+        seg0 = seg_l if has_seg else jnp.zeros((), jnp.int32)
+        (dq, _, _, _, _, dk, dv), _ = jax.lax.scan(
+            body, (dq0, k_l, v_l, pos_l, seg0, dkv0, dkv0), None, length=sp_size
+        )
+        return (
+            swap(dq).astype(q_l.dtype),
+            swap(dk).astype(k_l.dtype),
+            swap(dv).astype(v_l.dtype),
+        )
+
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, pos_spec, qkv_spec, lse_spec, qkv_spec]
+    if has_seg:
+        in_specs.append(pos_spec)
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(qkv_spec, qkv_spec, qkv_spec),
+        axis_names=manual,
+        check_vma=False,
+    )
+    args = (q, k, v, pos, out, lse, do) + ((seg,) if has_seg else ())
+    dq, dk, dv = fn(*args)
+    dseg = None if seg is None else jnp.zeros_like(seg)
+    return dq, dk, dv, jnp.zeros_like(pos), dseg
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -81,6 +251,8 @@ def ring_attention(
     *,
     causal: bool = True,
     sp_axis: str = "sp",
+    sliding_window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention with q/k/v sharded on the sequence dim over ``sp_axis``.
 
@@ -88,11 +260,44 @@ def ring_attention(
     (zigzag-permuted layouts pass their permuted positions — the mask is
     position-exact). Returns [B, S, H, D] with the same sharding as q.
 
-    Only the sp axis goes manual (partial shard_map): batch/head sharding
-    over dp/tp stays in GSPMD auto mode, so the ring composes with TP and
-    with the pp pipeline's own shard_map.
+    Tile-friendly shapes (s_local and head_dim multiples of 128) run the
+    Pallas flash kernel inside the ring (O(s·d) HBM per step) with
+    sliding-window and packed-segment masks; other shapes fall back to a
+    jnp inner step (full local score matrix, autodiff backward).
     """
     sp_size = mesh.shape[sp_axis]
+    # inside another (partial-)manual region the context mesh must be used
+    ctx = jax.sharding.get_abstract_mesh()
+    mesh_arg = ctx if (ctx is not None and sp_axis in getattr(ctx, "shape", {})) else mesh
+
+    from colossalai_tpu.kernel.pallas.flash_attention import supports
+
+    s_local = q.shape[1] // sp_size
+    flash_ok = (
+        s_local % 128 == 0
+        and supports((q.shape[0], s_local, q.shape[2], q.shape[3]),
+                     (k.shape[0], s_local, k.shape[2], k.shape[3]))
+    )
+    if flash_ok and sp_size > 1:
+        scale = q.shape[-1] ** -0.5
+        return _ring_flash(
+            mesh_arg, sp_axis, causal, sliding_window, scale,
+            q, k, v, positions, segment_ids,
+        )
+
+    if sliding_window is not None or segment_ids is not None:
+        if sp_size == 1:
+            from .attention import xla_attention
+
+            return xla_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                sliding_window=sliding_window,
+            )
+        raise NotImplementedError(
+            "sliding_window/segment_ids under ring attention need "
+            "flash-eligible shapes (s_local and head_dim multiples of 128)"
+        )
+
     if sp_size == 1:
         out, _ = _attn_with_lse(q, k, v, positions, positions, causal)
         return out.astype(q.dtype)

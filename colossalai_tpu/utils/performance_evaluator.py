@@ -58,9 +58,21 @@ class PerformanceEvaluator:
     def on_step_start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def on_step_end(self, n_tokens: int, sync: bool = False) -> None:
-        if sync:
-            (jax.numpy.zeros(()) + 0).block_until_ready()
+    def on_step_end(self, n_tokens: int, sync: bool = False, sync_on=None) -> None:
+        """End-of-step accounting. Pass ``sync_on`` (e.g. the step's loss) to
+        synchronize by fetching one scalar from it — ``block_until_ready`` is
+        a NO-OP on tunneled TPU backends, so a scalar fetch is the only
+        reliable sync (device execution is in-order, so fetching any output
+        of the step waits for the whole step)."""
+        if sync_on is not None:
+            import numpy as np
+
+            leaf = jax.tree_util.tree_leaves(sync_on)[0]
+            float(np.asarray(leaf).ravel()[0])
+        elif sync:
+            import numpy as np
+
+            float(np.asarray(jax.numpy.zeros(()) + 0))
         self._time += time.perf_counter() - self._t0
         self._tokens += n_tokens
         self._steps += 1

@@ -58,6 +58,7 @@ def test_loss_decreases(plugin):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_plugins_agree_numerically():
     """All parallel layouts compute the same math (≙ the reference's
     numerical-equivalence tests, test_shard_llama.py:30-80)."""

@@ -83,6 +83,7 @@ def test_booster_save_load_model(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_full_state_resume(tmp_path):
     """Save mid-training, restore, and continue: trajectories must agree
     (≙ reference checkpoint-resume tests)."""

@@ -50,11 +50,14 @@ def test_flash_mha_no_gqa():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_flash_rejects_segment_ids():
+def test_flash_accepts_segment_ids():
+    # segment masking moved into the kernel (tests/test_kernel/test_flash_masks.py
+    # checks numerics); a single-segment batch must equal the unmasked result
     q, k, v = _qkv()
     seg = jnp.zeros(q.shape[:2], jnp.int32)
-    with pytest.raises(NotImplementedError):
-        flash_attention(q, k, v, segment_ids=seg)
+    a = flash_attention(q, k, v, segment_ids=seg)
+    b = flash_attention(q, k, v)
+    assert float(jnp.abs(a - b).max()) < 1e-6
 
 
 def test_supports_shapes():

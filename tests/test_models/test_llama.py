@@ -90,6 +90,7 @@ def test_loss_ignore_index():
     np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_matches():
     cfg = LlamaConfig.tiny(remat=False)
     cfg_r = LlamaConfig.tiny(remat=True)

@@ -48,6 +48,7 @@ def test_mixtral_forward():
     assert moe["experts_gate/kernel"].shape == (2, 4, 64, 128)  # [L, E, H, I]
 
 
+@pytest.mark.slow
 def test_moe_training_ep():
     cfg = MixtralConfig.tiny()
     batch = {"input_ids": jnp.asarray(RNG.randint(0, 256, size=(8, 16)))}
@@ -69,6 +70,7 @@ def test_moe_training_ep():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_moe_ep_matches_dense_mesh():
     """ep sharding is a layout, not math: ep=2 equals ep=1 training."""
     cfg = MixtralConfig.tiny()

@@ -41,6 +41,7 @@ def test_came_trains():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_came_zero_sharded_matches_replicated():
     l_shard = _train(came(1e-3), plugin=LowLevelZeroPlugin(stage=1, precision="fp32"))
     l_repl = _train(came(1e-3), plugin=GeminiPlugin(precision="fp32"))

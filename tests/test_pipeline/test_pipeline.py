@@ -87,6 +87,7 @@ def _train(plugin, batch, steps=3):
     return float(metrics["loss"]), boosted
 
 
+@pytest.mark.slow
 def test_pp_training_matches_baseline():
     ids = jnp.asarray(RNG.randint(0, 256, size=(8, 16)))
     batch = {"input_ids": ids}
@@ -100,6 +101,7 @@ def test_pp_training_matches_baseline():
     assert spec[0] == "pp", spec
 
 
+@pytest.mark.slow
 def test_pp_with_tp_and_zero():
     ids = jnp.asarray(RNG.randint(0, 256, size=(8, 16)))
     batch = {"input_ids": ids}

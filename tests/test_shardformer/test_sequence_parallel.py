@@ -87,6 +87,7 @@ def _train(plugin, batch, steps=3):
 
 
 @pytest.mark.parametrize("mode", ["split_gather", "ring", "all_to_all", "ring_attn"])
+@pytest.mark.slow
 def test_sp_modes_match_baseline(mode):
     """Every SP mode trains to the same loss as plain DP
     (≙ reference numerical-equivalence matrix over SP configs)."""

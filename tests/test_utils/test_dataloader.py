@@ -52,6 +52,7 @@ def test_missing_file():
         TokenDataLoader("/nonexistent/tokens.bin", seq_len=8, batch_size=1)
 
 
+@pytest.mark.slow
 def test_feeds_training(token_file):
     import jax, jax.numpy as jnp, optax
 
