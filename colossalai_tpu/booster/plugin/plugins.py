@@ -192,6 +192,16 @@ class HybridParallelPlugin(Plugin):
                 )
         n_micro = getattr(self, "_resolved_microbatches", self.num_microbatches)
         updates = {}
+        vocab = getattr(model.config, "vocab_size", None)
+        if (
+            self.tp_size > 1
+            and vocab is not None
+            and vocab % self.tp_size
+            and getattr(model.config, "vocab_pad_multiple", 1) != self.tp_size
+        ):
+            # ≙ make_vocab_size_divisible_by: pad so GSPMD can shard the
+            # vocab dim; phantom logits are masked in the model forward
+            updates["vocab_pad_multiple"] = self.tp_size
         if self.pp_size > 1 and model.config.pp_microbatches != n_micro:
             updates["pp_microbatches"] = n_micro
         if self.pp_size > 1:

@@ -32,8 +32,18 @@ _TOP_MAP = [
 ]
 
 
-def params_to_hf(params: Dict[str, Any], scanned: bool = True) -> Dict[str, np.ndarray]:
-    """Our llama param tree → HF-named state dict (numpy)."""
+#: HF names whose dim-0 is the vocab dim (after our kernel→weight transpose)
+_VOCAB_KEYS = ("model.embed_tokens.weight", "lm_head.weight")
+
+
+def params_to_hf(
+    params: Dict[str, Any], scanned: bool = True, vocab_size: int | None = None
+) -> Dict[str, np.ndarray]:
+    """Our llama param tree → HF-named state dict (numpy).
+
+    ``vocab_size``: true vocab — phantom rows added by ``vocab_pad_multiple``
+    (tp padding) are sliced off so the export has the real HF shape
+    (≙ to_unpadded_tensor in the reference's gather-to-HF path)."""
     out: Dict[str, np.ndarray] = {}
     p = params["params"] if "params" in params else params
 
@@ -46,7 +56,12 @@ def params_to_hf(params: Dict[str, Any], scanned: bool = True) -> Dict[str, np.n
     for hf_name, ours in _TOP_MAP:
         if _has(p, ours):
             arr = get(ours)
-            out[hf_name] = arr.T if ours.endswith("kernel") else arr
+            arr = arr.T if ours.endswith("kernel") else arr
+            if vocab_size is not None and hf_name in _VOCAB_KEYS:
+                from colossalai_tpu.tensor.padded_vocab import unpad_vocab
+
+                arr = unpad_vocab(arr, vocab_size, axis=0)
+            out[hf_name] = arr
 
     if scanned and "layers" in p:
         stack = p["layers"]["block"]
@@ -71,8 +86,18 @@ def params_to_hf(params: Dict[str, Any], scanned: bool = True) -> Dict[str, np.n
     return out
 
 
-def hf_to_params(state: Dict[str, np.ndarray], num_layers: int, scanned: bool = True, tie_word_embeddings: bool = False) -> Dict[str, Any]:
-    """HF-named state dict → our llama param tree (numpy leaves)."""
+def hf_to_params(
+    state: Dict[str, np.ndarray],
+    num_layers: int,
+    scanned: bool = True,
+    tie_word_embeddings: bool = False,
+    padded_vocab_size: int | None = None,
+) -> Dict[str, Any]:
+    """HF-named state dict → our llama param tree (numpy leaves).
+
+    ``padded_vocab_size``: zero-pad the vocab dim up to the model's
+    ``padded_vocab_size_`` (tp-divisible) so the tree matches a padded
+    model's shapes (≙ to_padded_tensor on load)."""
     p: Dict[str, Any] = {}
 
     def put(path, val):
@@ -86,6 +111,10 @@ def hf_to_params(state: Dict[str, np.ndarray], num_layers: int, scanned: bool = 
         if hf_name == "lm_head.weight" and tie_word_embeddings:
             continue
         arr = state[hf_name]
+        if padded_vocab_size is not None and hf_name in _VOCAB_KEYS:
+            from colossalai_tpu.tensor.padded_vocab import pad_vocab
+
+            arr = pad_vocab(arr, padded_vocab_size, axis=0)
         put(ours, arr.T if ours.endswith("kernel") else arr)
 
     if scanned:

@@ -375,7 +375,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
 
 
 def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
-         window, block_q, block_kv):
+         window, block_q, block_kv, delta=None):
     b, h, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     group = h // hkv
@@ -384,7 +384,8 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
     has_pos = qpos is not None
     has_seg = qseg is not None
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # [B,H,Sq,1]
+    if delta is None:  # ring callers precompute: delta is loop-invariant
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # [B,H,Sq,1]
 
     qpos_t, kpos_t, qseg_t, kseg_t = _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg)
     mask_args = ([qpos_t, kpos_t] if has_pos else []) + ([qseg_t, kseg_t] if has_seg else [])
@@ -549,6 +550,8 @@ def flash_attention_with_lse(
         )
     if (q_positions is None) != (kv_positions is None):
         raise ValueError("pass both q_positions and kv_positions or neither")
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError("kv_segment_ids without segment_ids would be silently dropped")
     if segment_ids is not None and kv_segment_ids is None:
         kv_segment_ids = segment_ids
 

@@ -41,6 +41,16 @@ class ModelConfig:
     pp_schedule: str = "1f1b"
     # virtual stages per device for the interleaved schedule
     pp_chunks: int = 1
+    # pad embed/lm_head vocab dim to this multiple so tp can shard it
+    # (≙ make_vocab_size_divisible_by / padded_tensor). Set by the plugin
+    # when vocab_size % tp != 0; phantom logits are masked in the forward.
+    vocab_pad_multiple: int = 1
+
+    @property
+    def padded_vocab_size_(self) -> int:
+        from colossalai_tpu.tensor.padded_vocab import padded_vocab_size
+
+        return padded_vocab_size(self.vocab_size, self.vocab_pad_multiple)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

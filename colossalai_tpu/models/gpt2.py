@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
 from .base import CausalLMOutput, ModelConfig
 
@@ -88,7 +89,7 @@ class GPT2LMHeadModel(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
-        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="wte")
+        wte = nn.Embed(cfg.padded_vocab_size_, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="wte")
         wpe = nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=dtype, param_dtype=pdtype, name="wpe"
         )
@@ -104,7 +105,8 @@ class GPT2LMHeadModel(nn.Module):
             logits = wte.attend(x.astype(jnp.float32))
         else:
             logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=pdtype, name="lm_head"
+                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32, param_dtype=pdtype, name="lm_head"
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
 from .base import CausalLMOutput, ModelConfig
 
@@ -262,7 +263,7 @@ class LlamaForCausalLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
         embed = nn.Embed(
-            cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+            cfg.padded_vocab_size_, cfg.hidden_size, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name="embed_tokens",
         )
         x = embed(input_ids)
@@ -278,8 +279,9 @@ class LlamaForCausalLM(nn.Module):
             logits = embed.attend(x.astype(jnp.float32))
         else:
             logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
                 param_dtype=cfg.param_dtype or jnp.float32, name="lm_head",
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits)

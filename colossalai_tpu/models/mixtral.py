@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from colossalai_tpu.moe.router import top_k_routing
 from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
 from .base import CausalLMOutput
 from .llama import LlamaAttention, LlamaConfig, LlamaMLP, RMSNorm
@@ -152,7 +153,7 @@ class MixtralForCausalLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
         embed = nn.Embed(
-            cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+            cfg.padded_vocab_size_, cfg.hidden_size, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name="embed_tokens",
         )
         x = embed(input_ids)
@@ -169,8 +170,9 @@ class MixtralForCausalLM(nn.Module):
             logits = embed.attend(x.astype(jnp.float32))
         else:
             logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
                 param_dtype=cfg.param_dtype or jnp.float32, name="lm_head",
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits, aux_loss=aux_total)

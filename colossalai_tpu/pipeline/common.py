@@ -1,0 +1,15 @@
+"""Shared helpers for the pipeline schedules."""
+
+from __future__ import annotations
+
+
+def mb_split(a, n_micro: int):
+    """[B, ...] → [n_micro, B/n_micro, ...]."""
+    return a.reshape((n_micro, a.shape[0] // n_micro) + a.shape[1:])
+
+
+def fp32_boundary(mesh) -> bool:
+    """Whether shard_map boundaries must be cast to fp32: the CPU backend's
+    all-reduce promotion miscompiles narrow-dtype collectives inside nested
+    manual regions. On TPU the boundary stays in the compute dtype."""
+    return mesh.devices.flat[0].platform != "tpu"

@@ -41,12 +41,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def _mb_split(a, n):
-    return a.reshape((n, a.shape[0] // n) + a.shape[1:])
-
-
-def _platform(mesh) -> str:
-    return mesh.devices.flat[0].platform
+from .common import fp32_boundary as _fp32_boundary
+from .common import mb_split as _mb_split
 
 
 def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool):
@@ -98,8 +94,8 @@ def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
                    has_aux, stacked_params, x, aux):
     pp, V, Lv = _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks)
     n = n_micro
-    cast = _platform(mesh) != "tpu"  # CPU XLA miscompiles narrow-dtype
-    x_dtype = x.dtype                # collectives in nested manual regions
+    cast = _fp32_boundary(mesh)
+    x_dtype = x.dtype
 
     params_r = jax.tree.map(
         lambda l: l.reshape((chunks, pp, Lv) + l.shape[1:]), stacked_params
@@ -148,9 +144,9 @@ def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
                     inp = jnp.where(s == 0, x_in, recv[0])
                 else:
                     inp = jnp.where(s == 0, recv[c - 1], recv[c])
-                h, a = run(c, valid, inp, t)
+                h, a = run(c, valid, inp, t)  # a already masked by run()
                 lanes.append(h)
-                aux_acc = aux_acc + jnp.where(valid, a, 0.0)
+                aux_acc = aux_acc + a
             # collect the last chunk's output at the last stage
             out_i = jnp.clip(t - (V - 1), 0, n - 1)
             collect = (s == pp - 1) & (t - (V - 1) >= 0)
@@ -206,7 +202,7 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
     stacked_params, x, aux = res
     pp, V, Lv = _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks)
     n = n_micro
-    cast = _platform(mesh) != "tpu"
+    cast = _fp32_boundary(mesh)
     x_dtype = x.dtype
 
     params_r = jax.tree.map(

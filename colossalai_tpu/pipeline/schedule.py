@@ -72,15 +72,16 @@ def pipeline_blocks(
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by num_microbatches={num_microbatches}")
 
-    mb_split = lambda a: a.reshape((num_microbatches, b // num_microbatches) + a.shape[1:])
-    # fp32 at the shard_map boundary on NON-TPU backends only: the transpose
-    # of a pp-replicated input is a psum over pp, and the CPU backend's
-    # all-reduce promotion miscompiles narrow dtypes inside manual regions.
-    # On TPU the boundary stays in the compute dtype (bf16) — no extra bytes.
-    cast = mesh.devices.flat[0].platform != "tpu"
+    from .common import fp32_boundary, mb_split
+
+    # fp32 at the shard_map boundary on NON-TPU backends only (see
+    # pipeline/common.py); on TPU it stays in the compute dtype (bf16).
+    cast = fp32_boundary(mesh)
     x_dtype = x.dtype
-    x_mb = mb_split(x).astype(jnp.float32) if cast else mb_split(x)
-    aux_mb = jax.tree.map(mb_split, aux)
+    x_mb = mb_split(x, num_microbatches)
+    if cast:
+        x_mb = x_mb.astype(jnp.float32)
+    aux_mb = jax.tree.map(lambda a: mb_split(a, num_microbatches), aux)
 
     def local_fn(params_l, x_mb_l, aux_mb_l):
         # params_l: [L/pp, ...]; x_mb_l: [n_micro, mb_local, S, H]

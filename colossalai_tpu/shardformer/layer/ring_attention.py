@@ -181,6 +181,10 @@ def _ring_flash_bwd(mesh, sp_axis, causal, window, scale, res, do):
         qt, out_t, do_t = swap(q_l), swap(out_l), swap(do_l)
         lse4 = lse_l[..., None]
         i32 = lambda a: None if a is None else a.astype(jnp.int32)
+        # delta = sum(do*out) is ring-step invariant — compute once
+        delta = jnp.sum(
+            do_t.astype(jnp.float32) * out_t.astype(jnp.float32), -1, keepdims=True
+        )
 
         def step(k_c, v_c, pos_c, seg_c):
             return _bwd(
@@ -190,6 +194,7 @@ def _ring_flash_bwd(mesh, sp_axis, causal, window, scale, res, do):
                 scale=scale, causal=causal, window=window,
                 block_q=512 if qt.shape[2] >= 512 else qt.shape[2],
                 block_kv=1024 if k_c.shape[1] >= 1024 else k_c.shape[1],
+                delta=delta,
             )
 
         def body(carry, _):

@@ -1,0 +1,58 @@
+"""Vocab padding for tensor-parallel embeddings and LM heads.
+
+≙ reference ``tensor/padded_tensor/api.py`` + VocabParallelEmbedding1D's
+``make_vocab_size_divisible_by`` (``shardformer/layer/embedding.py:241``).
+There, a PaddedTensor wrapper tracks (current, origin) lengths and every
+checkpoint path calls to_unpadded/to_padded. Here padding is a static
+config fact: models build their embed/lm_head with ``padded_vocab_size_``
+(a tp multiple, so GSPMD can shard the vocab dim), the forward masks the
+phantom logits to -1e9 (so CE / sampling / logprob are untouched), and
+these helpers convert parameter tensors at the checkpoint boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def padded_vocab_size(vocab_size: int, multiple: int) -> int:
+    """Round ``vocab_size`` up to a multiple (no-op for multiple <= 1)."""
+    if multiple <= 1:
+        return vocab_size
+    return ((vocab_size + multiple - 1) // multiple) * multiple
+
+
+def pad_vocab(arr, padded_size: int, axis: int = 0):
+    """Zero-pad a parameter tensor's vocab ``axis`` up to ``padded_size``
+    (≙ to_padded_tensor). Accepts numpy or jax arrays."""
+    cur = arr.shape[axis]
+    if cur == padded_size:
+        return arr
+    if cur > padded_size:
+        raise ValueError(f"vocab dim {cur} larger than padded size {padded_size}")
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, padded_size - cur)
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, widths)
+    return jnp.pad(arr, widths)
+
+
+def unpad_vocab(arr, vocab_size: int, axis: int = 0):
+    """Slice the vocab ``axis`` back to the true size (≙ to_unpadded_tensor)."""
+    if arr.shape[axis] == vocab_size:
+        return arr
+    return jax.lax.slice_in_dim(arr, 0, vocab_size, axis=axis) if isinstance(
+        arr, jax.Array
+    ) else np.take(arr, np.arange(vocab_size), axis=axis)
+
+
+def mask_padded_logits(logits: jax.Array, vocab_size: int) -> jax.Array:
+    """-1e9 on phantom vocab entries so softmax/argmax/logprob never see
+    them. No-op when the trailing dim is already the true vocab."""
+    padded = logits.shape[-1]
+    if padded == vocab_size:
+        return logits
+    phantom = jnp.arange(padded) >= vocab_size
+    return jnp.where(phantom, jnp.asarray(-1e9, logits.dtype), logits)

@@ -1,0 +1,104 @@
+"""Vocab padding under tensor parallelism.
+
+≙ reference ``tests/test_shardformer/test_layer/test_vocab_parallel_*`` +
+``padded_tensor`` tests: a vocab NOT divisible by tp (gpt2's 50257) must
+train identically to the dp baseline once the plugin pads the embed/head,
+and the padding helpers must round-trip parameters.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.models import GPT2Config, GPT2LMHeadModel
+from colossalai_tpu.shardformer.layer.loss import dist_log_prob
+from colossalai_tpu.tensor.padded_vocab import (
+    mask_padded_logits,
+    pad_vocab,
+    padded_vocab_size,
+    unpad_vocab,
+)
+
+
+def test_padding_helpers_roundtrip():
+    assert padded_vocab_size(50257, 2) == 50258
+    assert padded_vocab_size(50257, 8) == 50264
+    assert padded_vocab_size(32000, 4) == 32000
+    w = np.random.default_rng(0).normal(size=(7, 4)).astype(np.float32)
+    p = pad_vocab(w, 8)
+    assert p.shape == (8, 4) and np.all(p[7] == 0)
+    assert np.array_equal(unpad_vocab(p, 7), w)
+    logits = jnp.ones((2, 3, 8))
+    masked = mask_padded_logits(logits, 7)
+    assert float(masked[..., -1].max()) <= -1e8
+    assert float(jnp.abs(masked[..., :7] - 1.0).max()) == 0.0
+
+
+@pytest.mark.slow
+def test_odd_vocab_tp_matches_dp():
+    """vocab 257 (prime) with tp=2: the plugin pads to 258, losses match
+    the unpadded dp baseline (phantom logits masked to -1e9)."""
+    cfg = dataclasses.replace(GPT2Config.tiny(), vocab_size=257)
+    ids = jax.random.randint(jax.random.PRNGKey(5), (8, 16), 0, 257)
+    batch = {"input_ids": ids}
+
+    def losses(plugin):
+        b = Booster(plugin=plugin).boost(
+            GPT2LMHeadModel(cfg), optax.sgd(1e-2),
+            example_batch=batch, rng=jax.random.PRNGKey(0),
+        )
+        state, out = b.state, []
+        for _ in range(3):
+            state, m = b.train_step(state, b.shard_batch(batch))
+            out.append(float(m["loss"]))
+        return out, b
+
+    base, _ = losses(DataParallelPlugin(precision="fp32"))
+    tp, boosted = losses(HybridParallelPlugin(tp_size=2, precision="fp32"))
+    assert np.allclose(tp, base, atol=1e-4), (tp, base)
+    # embed param really got padded + vocab-sharded
+    wte = boosted.state.params["wte"]["embedding"]
+    assert wte.shape[0] == 258
+
+
+def test_hf_interop_pads_and_unpads():
+    """A padded llama exports unpadded HF weights and re-imports padded
+    (≙ padded_tensor at the checkpoint boundary)."""
+    import dataclasses as dc
+
+    from colossalai_tpu.checkpoint_io.hf_llama import hf_to_params, params_to_hf
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = dc.replace(LlamaConfig.tiny(), vocab_size=255, vocab_pad_multiple=4)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    assert params["params"]["embed_tokens"]["embedding"].shape[0] == 256
+
+    hf = params_to_hf(params, vocab_size=cfg.vocab_size)
+    assert hf["model.embed_tokens.weight"].shape[0] == 255
+    assert hf["lm_head.weight"].shape[0] == 255
+
+    back = hf_to_params(
+        hf, cfg.num_hidden_layers, padded_vocab_size=cfg.padded_vocab_size_
+    )
+    assert back["embed_tokens"]["embedding"].shape[0] == 256
+    np.testing.assert_array_equal(
+        back["embed_tokens"]["embedding"][:255],
+        np.asarray(params["params"]["embed_tokens"]["embedding"])[:255],
+    )
+
+
+def test_dist_log_prob_ignores_phantom_vocab():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    padded = mask_padded_logits(
+        jnp.concatenate([logits, jnp.zeros((2, 5, 4))], -1), 16
+    )
+    labels = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 16)
+    a = dist_log_prob(logits, labels)
+    b = dist_log_prob(padded, labels)
+    assert float(jnp.abs(a - b).max()) < 1e-5
