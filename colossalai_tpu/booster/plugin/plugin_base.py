@@ -105,8 +105,18 @@ class Plugin(abc.ABC):
             raise ValueError("configure() needs example_batch to trace shapes")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         if loss_fn is None:
-            loss_fn = default_causal_lm_loss
-            _warn_if_hf_label_convention(example_batch)
+            if "decoder_input_ids" in example_batch or "input_features" in example_batch:
+                # seq2seq: logits align with the DECODER stream, never with
+                # encoder input_ids — require explicit labels
+                loss_fn = default_seq2seq_loss
+                if "labels" not in example_batch:
+                    raise ValueError(
+                        "seq2seq models need batch['labels'] (decoder targets) "
+                        "for the default loss; or pass loss_fn explicitly"
+                    )
+            else:
+                loss_fn = default_causal_lm_loss
+                _warn_if_hf_label_convention(example_batch)
         mesh = self.build_mesh(devices)
         model = _apply_precision(model, self.precision)
         model = self.modify_model(model)
@@ -384,7 +394,16 @@ def default_causal_lm_loss(out, batch):
     return causal_lm_loss(out.logits, batch["input_ids"])
 
 
-_MODEL_INPUT_KEYS = ("input_ids", "positions", "segment_ids", "token_type_ids", "pixel_values")
+def default_seq2seq_loss(out, batch):
+    """CE of decoder logits vs ``labels`` (teacher forcing; labels are NOT
+    shifted here — build decoder_input_ids with ``models.shift_right``)."""
+    return softmax_cross_entropy(out.logits, batch["labels"])
+
+
+_MODEL_INPUT_KEYS = (
+    "input_ids", "decoder_input_ids", "positions", "segment_ids",
+    "token_type_ids", "pixel_values", "input_features",
+)
 
 
 def _model_inputs(batch: Dict[str, Any], model: Any = None) -> Dict[str, Any]:

@@ -192,12 +192,11 @@ class HybridParallelPlugin(Plugin):
                 )
         n_micro = getattr(self, "_resolved_microbatches", self.num_microbatches)
         updates = {}
-        vocab = getattr(model.config, "vocab_size", None)
+        padded_vocab = getattr(model.config, "padded_vocab_size_", None)
         if (
             self.tp_size > 1
-            and vocab is not None
-            and vocab % self.tp_size
-            and getattr(model.config, "vocab_pad_multiple", 1) != self.tp_size
+            and padded_vocab is not None
+            and padded_vocab % self.tp_size
         ):
             # ≙ make_vocab_size_divisible_by: pad so GSPMD can shard the
             # vocab dim; phantom logits are masked in the model forward

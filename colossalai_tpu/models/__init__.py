@@ -6,9 +6,38 @@
 
 from .base import CausalLMOutput, ModelConfig
 from .bert import BertConfig, BertModel, BertOutput
+from .deepseek import DeepseekV2Config, DeepseekV2ForCausalLM
+from .families import (
+    FAMILY_MODELS,
+    BaichuanConfig,
+    BaichuanForCausalLM,
+    BloomConfig,
+    BloomForCausalLM,
+    ChatGLMConfig,
+    ChatGLMForConditionalGeneration,
+    CohereConfig,
+    CohereForCausalLM,
+    FalconConfig,
+    FalconForCausalLM,
+    GemmaConfig,
+    GemmaForCausalLM,
+    GPTJConfig,
+    GPTJForCausalLM,
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
+    OPTConfig,
+    OPTForCausalLM,
+    PhiConfig,
+    PhiForCausalLM,
+    StarCoder2Config,
+    Starcoder2ForCausalLM,
+)
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM, MistralConfig, Qwen2Config
 from .mixtral import MixtralConfig, MixtralForCausalLM
+from .t5 import Seq2SeqOutput, T5Config, T5EncoderModel, T5ForConditionalGeneration, shift_right
+from .transformer import DecoderConfig, DecoderLM
+from .whisper import WhisperConfig, WhisperForConditionalGeneration
 from .vit import ViTConfig, ViTForImageClassification, ViTOutput
 
 MODEL_REGISTRY = {
@@ -20,6 +49,11 @@ MODEL_REGISTRY = {
     "mixtral": (MixtralForCausalLM, MixtralConfig),
     "bert": (BertModel, BertConfig),
     "vit": (ViTForImageClassification, ViTConfig),
+    "t5": (T5ForConditionalGeneration, T5Config),
+    "deepseek_v2": (DeepseekV2ForCausalLM, DeepseekV2Config),
+    "deepseek_v3": (DeepseekV2ForCausalLM, DeepseekV2Config),
+    "whisper": (WhisperForConditionalGeneration, WhisperConfig),
+    **FAMILY_MODELS,
 }
 
 
@@ -32,6 +66,8 @@ def get_model_cls(name: str):
 __all__ = [
     "CausalLMOutput",
     "ModelConfig",
+    "DecoderConfig",
+    "DecoderLM",
     "GPT2Config",
     "GPT2LMHeadModel",
     "LlamaConfig",
@@ -46,6 +82,38 @@ __all__ = [
     "ViTConfig",
     "ViTForImageClassification",
     "ViTOutput",
+    "OPTConfig",
+    "OPTForCausalLM",
+    "BloomConfig",
+    "BloomForCausalLM",
+    "FalconConfig",
+    "FalconForCausalLM",
+    "GPTJConfig",
+    "GPTJForCausalLM",
+    "GPTNeoXConfig",
+    "GPTNeoXForCausalLM",
+    "ChatGLMConfig",
+    "ChatGLMForConditionalGeneration",
+    "PhiConfig",
+    "PhiForCausalLM",
+    "GemmaConfig",
+    "GemmaForCausalLM",
+    "CohereConfig",
+    "CohereForCausalLM",
+    "BaichuanConfig",
+    "BaichuanForCausalLM",
+    "StarCoder2Config",
+    "Starcoder2ForCausalLM",
+    "T5Config",
+    "T5ForConditionalGeneration",
+    "T5EncoderModel",
+    "Seq2SeqOutput",
+    "shift_right",
+    "WhisperConfig",
+    "WhisperForConditionalGeneration",
+    "DeepseekV2Config",
+    "DeepseekV2ForCausalLM",
     "MODEL_REGISTRY",
     "get_model_cls",
+    "FAMILY_MODELS",
 ]

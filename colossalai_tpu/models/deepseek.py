@@ -1,0 +1,219 @@
+"""DeepSeek-V2/V3-style model: Multi-head Latent Attention + DeepSeekMoE.
+
+≙ reference ``shardformer/policies/deepseek.py`` / ``deepseek_v3.py`` +
+``modeling/deepseek*`` (the newest family in the reference's table).
+Arch-true pieces:
+
+- **MLA**: queries optionally low-rank (q_a/q_b with RMSNorm between); K/V
+  jointly compressed to ``kv_lora_rank`` (kv_a) then expanded per head
+  (kv_b); RoPE lives on separate "pe" dims — per-head for q, a single
+  shared MQA-style k_pe broadcast to all heads; softmax scale uses the
+  full (nope+rope) q/k dim.
+- **DeepSeekMoE**: first ``first_k_dense_replace`` layers dense; the rest
+  route over many small experts (top-k, optional routed scaling) with
+  ``n_shared_experts`` always-on shared experts — reuses the capacity-based
+  dispatch of ``moe/router.py`` (same machinery as mixtral).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
+
+from .base import CausalLMOutput
+from .llama import LlamaConfig, LlamaMLP, RMSNorm, apply_rope, rope_table
+from .mixtral import MixtralConfig, MoEMLP
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class DeepseekV2Config(MixtralConfig):
+    # MLA dims (HF DeepseekV2Config names)
+    q_lora_rank: Optional[int] = None  # None = plain q_proj (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # MoE structure
+    first_k_dense_replace: int = 1
+    routed_scaling_factor: float = 1.0
+
+    @classmethod
+    def deepseek_v2_lite(cls, **kw):
+        return cls(
+            vocab_size=102400, hidden_size=2048, intermediate_size=10944,
+            num_hidden_layers=27, num_attention_heads=16, num_key_value_heads=16,
+            q_lora_rank=None, kv_lora_rank=512, qk_nope_head_dim=128,
+            qk_rope_head_dim=64, v_head_dim=128,
+            num_experts=64, num_experts_per_tok=6, n_shared_experts=2,
+            moe_intermediate_size=1408,  # narrow DeepSeekMoE experts
+            first_k_dense_replace=1, max_position_embeddings=163840, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("num_experts_per_tok", 2)
+        kw.setdefault("n_shared_experts", 1)
+        kw.setdefault("first_k_dense_replace", 0)
+        kw.setdefault("q_lora_rank", None)
+        base = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+            kv_lora_rank=32, qk_nope_head_dim=16,
+            qk_rope_head_dim=8, v_head_dim=16,
+            max_position_embeddings=128,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+class MLAttention(nn.Module):
+    """Multi-head Latent Attention (≙ DeepseekV2Attention)."""
+
+    config: DeepseekV2Config
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        nh = cfg.num_attention_heads
+        dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        b, s, _ = x.shape
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=dtype, param_dtype=pdtype, name=name
+        )
+
+        # ---- queries (optionally low-rank)
+        if cfg.q_lora_rank:
+            qa = dense(cfg.q_lora_rank, "q_a_proj")(x)
+            qa = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="q_a_layernorm")(qa)
+            q = dense(nh * (dn + dr), "q_b_proj")(qa)
+        else:
+            q = dense(nh * (dn + dr), "q_proj")(x)
+        q = q.reshape(b, s, nh, dn + dr)
+        q = constrain(q, ("dp", "ep"), None, "tp", None)
+        q_nope, q_pe = q[..., :dn], q[..., dn:]
+
+        # ---- compressed KV + shared rope key
+        ckv = dense(cfg.kv_lora_rank + dr, "kv_a_proj_with_mqa")(x)
+        kv_c, k_pe = ckv[..., : cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank :]
+        kv_c = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="kv_a_layernorm")(kv_c)
+        kv = dense(nh * (dn + dv), "kv_b_proj")(kv_c).reshape(b, s, nh, dn + dv)
+        kv = constrain(kv, ("dp", "ep"), None, "tp", None)
+        k_nope, v = kv[..., :dn], kv[..., dn:]
+
+        # ---- rope on the pe dims (k_pe is ONE head broadcast to all)
+        cos, sin = rope_table(positions, dr, cfg.rope_theta)
+        q_pe = apply_rope(q_pe, cos, sin)
+        k_pe = apply_rope(k_pe[:, :, None, :], cos, sin)
+        k_pe = jnp.broadcast_to(k_pe, (b, s, nh, dr))
+
+        q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
+        k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
+        out = dot_product_attention(
+            q_full, k_full, v, causal=True, segment_ids=segment_ids,
+            softmax_scale=(dn + dr) ** -0.5, impl="xla",
+        )
+        out = out.reshape(b, s, nh * dv)
+        out = dense(cfg.hidden_size, "o_proj")(out)
+        return constrain(out, ("dp", "ep"), "sp", None)
+
+
+class DeepseekBlock(nn.Module):
+    config: DeepseekV2Config
+    #: scanned stacks need uniform structure; dense-vs-moe is selected by a
+    #: static flag per sub-stack (see DeepseekV2ForCausalLM)
+    use_moe: bool = True
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="input_layernorm")(x)
+        h = MLAttention(cfg, name="self_attn")(h, positions, segment_ids)
+        x = x + h
+        h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="post_attention_layernorm")(x)
+        if self.use_moe:
+            h, aux = MoEMLP(cfg, name="moe")(h)
+        else:
+            h, aux = LlamaMLP(cfg, name="mlp")(h), jnp.zeros((), jnp.float32)
+        return x + h, aux
+
+
+class _DenseBody(nn.Module):
+    config: DeepseekV2Config
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        cls = nn.remat(DeepseekBlock, prevent_cse=False) if self.config.remat else DeepseekBlock
+        x, aux = cls(self.config, use_moe=False, name="block")(x, positions, segment_ids)
+        return x, aux
+
+
+class _MoeBody(nn.Module):
+    config: DeepseekV2Config
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        cls = nn.remat(DeepseekBlock, prevent_cse=False) if self.config.remat else DeepseekBlock
+        x, aux = cls(self.config, use_moe=True, name="block")(x, positions, segment_ids)
+        return x, aux
+
+
+class DeepseekV2ForCausalLM(nn.Module):
+    config: DeepseekV2Config
+    supports_ep = True
+    supports_sp_modes = ("split_gather", "all_to_all")
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        embed = nn.Embed(
+            cfg.padded_vocab_size_, cfg.hidden_size, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name="embed_tokens",
+        )
+        x = embed(input_ids)
+        x = constrain(x, ("dp", "ep"), "sp", None)
+
+        def stack(body, length, name, x, aux_total):
+            if length == 0:
+                return x, aux_total
+            out, aux = nn.scan(
+                body, variable_axes={"params": 0}, split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast), length=length,
+                metadata_params={nn.PARTITION_NAME: name},
+            )(cfg, name=name)(x, positions, segment_ids)
+            return out, aux_total + jnp.sum(aux)
+
+        aux_total = jnp.zeros((), jnp.float32)
+        n_dense = min(cfg.first_k_dense_replace, cfg.num_hidden_layers)
+        x, aux_total = stack(_DenseBody, n_dense, "dense_layers", x, aux_total)
+        x, aux_total = stack(
+            _MoeBody, cfg.num_hidden_layers - n_dense, "layers", x, aux_total
+        )
+
+        x = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype or jnp.float32, name="lm_head",
+            )(x)
+        logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
+        return CausalLMOutput(logits=logits, aux_loss=aux_total)

@@ -1,0 +1,393 @@
+"""Arch-true family presets over the generalized decoder.
+
+≙ reference policy/modeling pairs in ``shardformer/policies/auto_policy.py``:
+opt, bloom, falcon, gptj, gpt_neox, chatglm2, command (Cohere), plus phi,
+gemma, baichuan, starcoder2. Each family pins the feature matrix
+(``transformer.DecoderConfig``) to its published architecture and ships a
+full-size preset + a tiny test config. Class names match HF's so the policy
+auto-dispatch mirrors the reference's ``_POLICY_LIST`` keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from .transformer import DecoderConfig, DecoderLM
+
+
+def _tiny_fields(**kw):
+    base = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128,
+    )
+    base.update(kw)
+    return base
+
+
+# --------------------------------------------------------------------- OPT
+@dataclasses.dataclass(unsafe_hash=True)
+class OPTConfig(DecoderConfig):
+    """OPT (≙ policies/opt.py): learned positions stored at pos+2, ReLU
+    MLP, pre-LN, biases everywhere, tied embeddings."""
+
+    act_fn: str = "relu"
+    pos_embedding: str = "learned"
+    learned_pos_offset: int = 2
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def opt_6b7(cls, **kw):
+        return cls(
+            vocab_size=50272, hidden_size=4096, intermediate_size=16384,
+            num_hidden_layers=32, num_attention_heads=32,
+            max_position_embeddings=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class OPTForCausalLM(DecoderLM):
+    pass
+
+
+# ------------------------------------------------------------------- BLOOM
+@dataclasses.dataclass(unsafe_hash=True)
+class BloomConfig(DecoderConfig):
+    """BLOOM (≙ policies/bloom.py): ALiBi, embedding LayerNorm, gelu,
+    biases, tied embeddings."""
+
+    act_fn: str = "gelu_new"
+    pos_embedding: str = "alibi"
+    embed_layernorm: bool = True
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def bloom_7b1(cls, **kw):
+        return cls(
+            vocab_size=250880, hidden_size=4096, intermediate_size=16384,
+            num_hidden_layers=30, num_attention_heads=32, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class BloomForCausalLM(DecoderLM):
+    pass
+
+
+# ------------------------------------------------------------------ Falcon
+@dataclasses.dataclass(unsafe_hash=True)
+class FalconConfig(DecoderConfig):
+    """Falcon (≙ policies/falcon.py): MQA, RoPE, parallel attention+MLP
+    with a shared LN, no biases, tied embeddings."""
+
+    num_key_value_heads: Optional[int] = 1
+    pos_embedding: str = "rope"
+    parallel_block: bool = True
+    parallel_norm_shared: bool = True
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    act_fn: str = "gelu"
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def falcon_7b(cls, **kw):
+        return cls(
+            vocab_size=65024, hidden_size=4544, intermediate_size=18176,
+            num_hidden_layers=32, num_attention_heads=71,
+            max_position_embeddings=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(num_key_value_heads=1, **kw))
+
+
+class FalconForCausalLM(DecoderLM):
+    pass
+
+
+# ------------------------------------------------------------------- GPT-J
+@dataclasses.dataclass(unsafe_hash=True)
+class GPTJConfig(DecoderConfig):
+    """GPT-J (≙ policies/gptj.py): interleaved partial rotary (64 of 256),
+    parallel block with one LN, attn bias-free, MLP biased."""
+
+    pos_embedding: str = "rope"
+    rotary_pct: float = 0.25
+    rope_interleaved: bool = True
+    parallel_block: bool = True
+    parallel_norm_shared: bool = True
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = True
+    act_fn: str = "gelu_new"
+
+    @classmethod
+    def gptj_6b(cls, **kw):
+        return cls(
+            vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+            num_hidden_layers=28, num_attention_heads=16,
+            max_position_embeddings=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class GPTJForCausalLM(DecoderLM):
+    pass
+
+
+# ---------------------------------------------------------------- GPT-NeoX
+@dataclasses.dataclass(unsafe_hash=True)
+class GPTNeoXConfig(DecoderConfig):
+    """GPT-NeoX (Pythia): half-split partial rotary (pct 0.25), parallel
+    residual with TWO LayerNorms, biases, gelu."""
+
+    pos_embedding: str = "rope"
+    rotary_pct: float = 0.25
+    parallel_block: bool = True
+    parallel_norm_shared: bool = False
+    act_fn: str = "gelu"
+
+    @classmethod
+    def gpt_neox_20b(cls, **kw):
+        return cls(
+            vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+            num_hidden_layers=44, num_attention_heads=64,
+            max_position_embeddings=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class GPTNeoXForCausalLM(DecoderLM):
+    pass
+
+
+# ----------------------------------------------------------------- ChatGLM
+@dataclasses.dataclass(unsafe_hash=True)
+class ChatGLMConfig(DecoderConfig):
+    """ChatGLM2/3 (≙ policies/chatglm2.py): RMSNorm + SwiGLU on GLM
+    bones — GQA (multi_query_group_num), rotary on half the head dim,
+    qkv biases only."""
+
+    norm_type: str = "rmsnorm"
+    glu: bool = True
+    act_fn: str = "silu"
+    pos_embedding: str = "rope"
+    rotary_pct: float = 0.5
+    rope_interleaved: bool = True
+    attention_bias: bool = True
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    num_key_value_heads: Optional[int] = 2
+
+    @classmethod
+    def chatglm3_6b(cls, **kw):
+        return cls(
+            vocab_size=65024, hidden_size=4096, intermediate_size=13696,
+            num_hidden_layers=28, num_attention_heads=32,
+            num_key_value_heads=2, max_position_embeddings=32768, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(num_key_value_heads=2, **kw))
+
+
+class ChatGLMForConditionalGeneration(DecoderLM):
+    pass
+
+
+# --------------------------------------------------------------------- Phi
+@dataclasses.dataclass(unsafe_hash=True)
+class PhiConfig(DecoderConfig):
+    """Phi-1/2: parallel attention+MLP sharing one LN, partial rotary
+    (pct 0.4), LayerNorm, biases."""
+
+    pos_embedding: str = "rope"
+    rotary_pct: float = 0.4
+    parallel_block: bool = True
+    parallel_norm_shared: bool = True
+    act_fn: str = "gelu_new"
+
+    @classmethod
+    def phi_2(cls, **kw):
+        return cls(
+            vocab_size=51200, hidden_size=2560, intermediate_size=10240,
+            num_hidden_layers=32, num_attention_heads=32,
+            max_position_embeddings=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class PhiForCausalLM(DecoderLM):
+    pass
+
+
+# ------------------------------------------------------------------- Gemma
+@dataclasses.dataclass(unsafe_hash=True)
+class GemmaConfig(DecoderConfig):
+    """Gemma: RMSNorm with (1+scale), GeGLU, RoPE, sqrt(hidden) embedding
+    scale, tied embeddings, wide head_dim."""
+
+    norm_type: str = "rmsnorm"
+    rms_scale_offset: float = 1.0
+    norm_eps: float = 1e-6
+    glu: bool = True
+    act_fn: str = "gelu_new"
+    pos_embedding: str = "rope"
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    tie_word_embeddings: bool = True
+    head_dim: Optional[int] = 256
+
+    def __post_init__(self):
+        if self.embedding_scale is None:
+            object.__setattr__(self, "embedding_scale", math.sqrt(self.hidden_size))
+
+    @classmethod
+    def gemma_7b(cls, **kw):
+        return cls(
+            vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+            num_hidden_layers=28, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=8192, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("head_dim", 16)
+        return cls(**_tiny_fields(**kw))
+
+
+class GemmaForCausalLM(DecoderLM):
+    pass
+
+
+# ------------------------------------------------------------------ Cohere
+@dataclasses.dataclass(unsafe_hash=True)
+class CohereConfig(DecoderConfig):
+    """Cohere Command-R (≙ policies/command.py): parallel block with one
+    bias-free LayerNorm, interleaved RoPE, logit scale, tied embeddings."""
+
+    parallel_block: bool = True
+    parallel_norm_shared: bool = True
+    norm_bias: bool = False
+    glu: bool = True
+    act_fn: str = "silu"
+    pos_embedding: str = "rope"
+    rope_interleaved: bool = True
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    logit_scale: Optional[float] = 0.0625
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def command_r(cls, **kw):
+        return cls(
+            vocab_size=256000, hidden_size=8192, intermediate_size=22528,
+            num_hidden_layers=40, num_attention_heads=64,
+            max_position_embeddings=8192, rope_theta=8e6, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class CohereForCausalLM(DecoderLM):
+    pass
+
+
+# ---------------------------------------------------------------- Baichuan
+@dataclasses.dataclass(unsafe_hash=True)
+class BaichuanConfig(DecoderConfig):
+    """Baichuan-13B: llama bones (RMSNorm + SwiGLU, no biases) with ALiBi
+    instead of RoPE (the 7B uses RoPE = plain llama)."""
+
+    norm_type: str = "rmsnorm"
+    glu: bool = True
+    act_fn: str = "silu"
+    pos_embedding: str = "alibi"
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+
+    @classmethod
+    def baichuan_13b(cls, **kw):
+        return cls(
+            vocab_size=64000, hidden_size=5120, intermediate_size=13696,
+            num_hidden_layers=40, num_attention_heads=40,
+            max_position_embeddings=4096, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class BaichuanForCausalLM(DecoderLM):
+    pass
+
+
+# -------------------------------------------------------------- StarCoder2
+@dataclasses.dataclass(unsafe_hash=True)
+class StarCoder2Config(DecoderConfig):
+    """StarCoder2: RoPE + sliding window + GQA on a GPT-2-ish body
+    (LayerNorm, plain gelu MLP, biases)."""
+
+    pos_embedding: str = "rope"
+    act_fn: str = "gelu_new"
+    sliding_window: Optional[int] = 4096
+    num_key_value_heads: Optional[int] = 4
+
+    @classmethod
+    def starcoder2_7b(cls, **kw):
+        return cls(
+            vocab_size=49152, hidden_size=4608, intermediate_size=18432,
+            num_hidden_layers=32, num_attention_heads=36,
+            num_key_value_heads=4, max_position_embeddings=16384,
+            rope_theta=1e6, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("sliding_window", 32)
+        return cls(**_tiny_fields(num_key_value_heads=2, **kw))
+
+
+class Starcoder2ForCausalLM(DecoderLM):
+    pass
+
+
+FAMILY_MODELS = {
+    "opt": (OPTForCausalLM, OPTConfig),
+    "bloom": (BloomForCausalLM, BloomConfig),
+    "falcon": (FalconForCausalLM, FalconConfig),
+    "gptj": (GPTJForCausalLM, GPTJConfig),
+    "gpt_neox": (GPTNeoXForCausalLM, GPTNeoXConfig),
+    "chatglm": (ChatGLMForConditionalGeneration, ChatGLMConfig),
+    "phi": (PhiForCausalLM, PhiConfig),
+    "gemma": (GemmaForCausalLM, GemmaConfig),
+    "cohere": (CohereForCausalLM, CohereConfig),
+    "baichuan": (BaichuanForCausalLM, BaichuanConfig),
+    "starcoder2": (Starcoder2ForCausalLM, StarCoder2Config),
+}

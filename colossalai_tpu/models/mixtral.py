@@ -32,6 +32,9 @@ class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     num_experts_per_tok: int = 2
     capacity_factor: float = 1.25
+    #: per-expert FFN width; None = intermediate_size (Mixtral). DeepSeekMoE
+    #: uses many NARROW experts (e.g. 1408 vs dense 10944).
+    moe_intermediate_size: "int | None" = None
     #: tokens per routing group (GShard): capacity is per-group so the
     #: dispatch tensors stay linear in sequence length
     router_group_size: int = 512
@@ -94,9 +97,10 @@ class MoEMLP(nn.Module):
         )(logits)
 
         init = nn.initializers.lecun_normal()
-        w_gate = self.param("experts_gate/kernel", init, (e, h, cfg.intermediate_size), pdtype)
-        w_up = self.param("experts_up/kernel", init, (e, h, cfg.intermediate_size), pdtype)
-        w_down = self.param("experts_down/kernel", init, (e, cfg.intermediate_size, h), pdtype)
+        moe_i = cfg.moe_intermediate_size or cfg.intermediate_size
+        w_gate = self.param("experts_gate/kernel", init, (e, h, moe_i), pdtype)
+        w_up = self.param("experts_up/kernel", init, (e, h, moe_i), pdtype)
+        w_down = self.param("experts_down/kernel", init, (e, moe_i, h), pdtype)
 
         # dispatch: [G,g,E,C] x [G,g,H] -> [G,E,C,H]  (GSPMD: all-to-all over ep)
         expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), xg)
@@ -108,10 +112,14 @@ class MoEMLP(nn.Module):
         expert_out = constrain(expert_out, ("dp",), "ep", None, None)
         # combine: [G,g,E,C] x [G,E,C,H] -> [G,g,H]   (all-to-all back)
         y = jnp.einsum("bsec,bech->bsh", routing.combine.astype(dtype), expert_out).reshape(b, s, h)
+        # DeepSeek-V2 scales the routed output (routed_scaling_factor)
+        scale = getattr(cfg, "routed_scaling_factor", 1.0)
+        if scale != 1.0:
+            y = y * jnp.asarray(scale, y.dtype)
 
         if cfg.n_shared_experts > 0:
             shared_cfg = dataclasses.replace(
-                cfg, intermediate_size=cfg.intermediate_size * cfg.n_shared_experts
+                cfg, intermediate_size=moe_i * cfg.n_shared_experts
             )
             y = y + LlamaMLP(shared_cfg, name="shared_expert")(x)
 

@@ -1,0 +1,318 @@
+"""T5 encoder-decoder (v1.0 and v1.1 "gated-gelu" variants).
+
+≙ reference ``shardformer/policies/t5.py`` + ``modeling/t5.py`` (the
+largest single policy family: T5Model/T5ForConditionalGeneration/
+T5EncoderModel). Encoder-decoder machinery the decoder-only matrix lacks:
+
+- relative position bias (bucketed, shared across layers — ONE embedding
+  owned by each stack, added to attention scores of every layer);
+- cross-attention from decoder to encoder states;
+- T5LayerNorm == RMSNorm (no mean subtraction, no bias);
+- no absolute positions; q/k/v/o and MLP are all bias-free;
+- v1.0: relu MLP + tied embeddings with d_model^-0.5 logit scaling;
+  v1.1: gated-gelu MLP + untied lm_head.
+
+TPU design: both stacks are ``nn.scan`` over blocks (single compile,
+pp-shardable layer dim); the shared relative bias is computed once per
+stack and broadcast into the scan — matching T5's first-layer-owned bias
+without per-layer parameter surgery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import xla_attention
+from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
+
+from .base import ModelConfig
+from .llama import RMSNorm
+
+import flax.struct
+
+
+@flax.struct.dataclass
+class Seq2SeqOutput:
+    logits: jax.Array
+    encoder_last_hidden_state: Optional[jax.Array] = None
+    aux_loss: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class T5Config(ModelConfig):
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # "relu" (v1.0) | "gated-gelu" (v1.1)
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+
+    # registry/config aliases so shared tooling (vocab padding, loss) works
+    @property
+    def hidden_size(self) -> int:
+        return self.d_model
+
+    @property
+    def num_hidden_layers(self) -> int:
+        return self.num_layers
+
+    @property
+    def decoder_layers_(self) -> int:
+        return self.num_decoder_layers or self.num_layers
+
+    @classmethod
+    def t5_base(cls, **kw):
+        return cls(d_model=768, d_ff=3072, num_layers=12, num_heads=12, **kw)
+
+    @classmethod
+    def t5_v1_1_large(cls, **kw):
+        kw.setdefault("feed_forward_proj", "gated-gelu")
+        kw.setdefault("tie_word_embeddings", False)
+        return cls(d_model=1024, d_kv=64, d_ff=2816, num_layers=24, num_heads=16, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(
+            vocab_size=256, d_model=64, d_kv=16, d_ff=128,
+            num_layers=2, num_heads=4, **kw,
+        )
+
+
+def relative_position_bucket(rel_pos, bidirectional: bool, num_buckets: int, max_distance: int):
+    """T5's log-bucketed relative positions (modeling_t5._relative_position_bucket)."""
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class RelativeBias(nn.Module):
+    """Shared-across-layers relative attention bias → [1, H, Sq, Skv]."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, sq: int, skv: int):
+        cfg = self.config
+        emb = nn.Embed(
+            cfg.relative_attention_num_buckets, cfg.num_heads,
+            param_dtype=cfg.param_dtype or jnp.float32,
+            name="relative_attention_bias",
+        )
+        rel = jnp.arange(skv)[None, :] - jnp.arange(sq)[:, None]  # mem - ctx
+        buckets = relative_position_bucket(
+            rel, self.bidirectional, cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance,
+        )
+        bias = emb(buckets)  # [Sq, Skv, H]
+        return jnp.transpose(bias, (2, 0, 1))[None].astype(jnp.float32)
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x, kv=None, bias=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        inner = cfg.num_heads * cfg.d_kv
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name=name,
+        )
+        kv = x if kv is None else kv
+        b, sq, _ = x.shape
+        skv = kv.shape[1]
+        q = dense(inner, "q_proj")(x).reshape(b, sq, cfg.num_heads, cfg.d_kv)
+        k = dense(inner, "k_proj")(kv).reshape(b, skv, cfg.num_heads, cfg.d_kv)
+        v = dense(inner, "v_proj")(kv).reshape(b, skv, cfg.num_heads, cfg.d_kv)
+        q, k, v = (constrain(t, ("dp", "ep"), None, "tp", None) for t in (q, k, v))
+        bias_b = None if bias is None else jnp.broadcast_to(
+            bias, (b, cfg.num_heads, sq, skv)
+        )
+        # T5 does NOT scale scores by sqrt(d) — softmax_scale=1
+        out = xla_attention(
+            q, k, v, causal=self.causal, bias=bias_b, softmax_scale=1.0
+        )
+        out = out.reshape(b, sq, inner)
+        out = dense(cfg.d_model, "o_proj")(out)
+        return constrain(out, ("dp", "ep"), "sp", None)
+
+
+class T5MLP(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name=name,
+        )
+        if cfg.feed_forward_proj == "gated-gelu":
+            h = nn.gelu(dense(cfg.d_ff, "wi_0")(x), approximate=True) * dense(cfg.d_ff, "wi_1")(x)
+        else:
+            h = nn.relu(dense(cfg.d_ff, "wi")(x))
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        out = dense(cfg.d_model, "wo")(h)
+        return constrain(out, ("dp", "ep"), "sp", None)
+
+
+class T5EncoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        h = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="ln_self")(x)
+        x = x + T5Attention(cfg, causal=False, name="self_attn")(h, bias=bias)
+        h = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="ln_mlp")(x)
+        return x + T5MLP(cfg, name="mlp")(h)
+
+
+class T5DecoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc, bias):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        h = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="ln_self")(x)
+        x = x + T5Attention(cfg, causal=True, name="self_attn")(h, bias=bias)
+        h = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="ln_cross")(x)
+        x = x + T5Attention(cfg, causal=False, name="cross_attn")(h, kv=enc)
+        h = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="ln_mlp")(x)
+        return x + T5MLP(cfg, name="mlp")(h)
+
+
+class _ScanEnc(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cls = nn.remat(T5EncoderBlock, prevent_cse=False) if self.config.remat else T5EncoderBlock
+        return cls(self.config, name="block")(x, bias), None
+
+
+class _ScanDec(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc, bias):
+        cls = nn.remat(T5DecoderBlock, prevent_cse=False) if self.config.remat else T5DecoderBlock
+        return cls(self.config, name="block")(x, enc, bias), None
+
+
+def _scan_stack(body_cls, cfg, length, name):
+    return nn.scan(
+        body_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        in_axes=(nn.broadcast,) * (2 if body_cls is _ScanDec else 1),
+        length=length,
+        metadata_params={nn.PARTITION_NAME: name},
+    )(cfg, name=name)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    config: T5Config
+    supports_pipeline = False  # enc-dec staging lands with the pp seq2seq path
+    supports_sp_modes = ("split_gather",)
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        embed = nn.Embed(
+            cfg.padded_vocab_size_, cfg.d_model, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name="shared",
+        )
+
+        # ---------------- encoder
+        x = embed(input_ids)
+        x = constrain(x, ("dp", "ep"), "sp", None)
+        enc_bias = RelativeBias(cfg, bidirectional=True, name="enc_rel_bias")(
+            input_ids.shape[1], input_ids.shape[1]
+        )
+        x, _ = _scan_stack(_ScanEnc, cfg, cfg.num_layers, "encoder")(x, enc_bias)
+        enc = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="enc_norm")(x)
+
+        # ---------------- decoder
+        y = embed(decoder_input_ids)
+        y = constrain(y, ("dp", "ep"), "sp", None)
+        dec_bias = RelativeBias(cfg, bidirectional=False, name="dec_rel_bias")(
+            decoder_input_ids.shape[1], decoder_input_ids.shape[1]
+        )
+        y, _ = _scan_stack(_ScanDec, cfg, self.config.decoder_layers_, "decoder")(y, enc, dec_bias)
+        y = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="dec_norm")(y)
+
+        if cfg.tie_word_embeddings:
+            # v1.0 rescales before the tied head (modeling_t5.py)
+            y = y * (cfg.d_model**-0.5)
+            logits = embed.attend(y.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype or jnp.float32, name="lm_head",
+            )(y)
+        logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
+        return Seq2SeqOutput(logits=logits, encoder_last_hidden_state=enc)
+
+
+class T5EncoderModel(nn.Module):
+    """Encoder-only variant (≙ HF T5EncoderModel in the policy table)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        embed = nn.Embed(
+            cfg.padded_vocab_size_, cfg.d_model, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name="shared",
+        )
+        x = embed(input_ids)
+        bias = RelativeBias(cfg, bidirectional=True, name="enc_rel_bias")(
+            input_ids.shape[1], input_ids.shape[1]
+        )
+        x, _ = _scan_stack(_ScanEnc, cfg, cfg.num_layers, "encoder")(x, bias)
+        return RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="enc_norm")(x)
+
+
+def shift_right(labels: jax.Array, decoder_start_token_id: int, pad_id: int = 0) -> jax.Array:
+    """Teacher-forcing decoder inputs from labels (≙ T5._shift_right)."""
+    start = jnp.full_like(labels[:, :1], decoder_start_token_id)
+    shifted = jnp.concatenate([start, labels[:, :-1]], axis=1)
+    return jnp.where(shifted == -100, pad_id, shifted)

@@ -1,0 +1,306 @@
+"""Generalized decoder-only transformer: the family feature matrix.
+
+≙ the reference's per-family ``shardformer/modeling/*.py`` + ``policies/*``
+pairs (opt, bloom, falcon, gptj, gpt_neox, chatglm2, command, …). The
+reference re-implements each block because module surgery must match each
+HF class; under GSPMD the differences between these families are a small
+feature matrix over ONE scanned-stack machine:
+
+- norm: LayerNorm vs RMSNorm (± Gemma's (1+scale) offset, ± bias)
+- MLP: GLU (gate/up/down) vs plain (fc_in/fc_out), silu/gelu/gelu_new/relu
+- positions: RoPE (full/partial, half-split or interleaved), learned
+  (± OPT's +2 offset), ALiBi, or none
+- block: sequential residuals, or parallel attention+MLP with a shared LN
+  (GPT-J/Phi/Falcon/Cohere) or two LNs (GPT-NeoX)
+- biases on qkv / attn-out / mlp, embedding LayerNorm (BLOOM),
+  embedding scale (Gemma), logit scale (Cohere), sliding window
+- GQA/MQA via num_key_value_heads (Falcon MQA = 1)
+
+Family presets with arch-true numbers live in ``models/families.py``; each
+is a thin Config/Module subclass so policies dispatch on the class name
+exactly like the reference's auto-policy table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
+
+from .base import CausalLMOutput, ModelConfig
+from .llama import RMSNorm
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class DecoderConfig(ModelConfig):
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: Optional[int] = None  # None = MHA
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 2048
+
+    # norm
+    norm_type: str = "layernorm"  # "layernorm" | "rmsnorm"
+    norm_eps: float = 1e-5
+    norm_bias: bool = True  # LayerNorm bias (Cohere: False)
+    rms_scale_offset: float = 0.0  # Gemma: weights stored as (scale - 1)
+
+    # mlp
+    glu: bool = False  # gate/up/down vs fc_in/fc_out
+    act_fn: str = "gelu"  # silu | gelu | gelu_new | relu
+    mlp_bias: bool = True
+
+    # positions
+    pos_embedding: str = "learned"  # rope | learned | alibi | none
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # fraction of head_dim rotated (GPT-J/NeoX/Phi)
+    rope_interleaved: bool = False  # rotate-every-two (GPT-J) vs half-split
+    learned_pos_offset: int = 0  # OPT stores positions at index pos+2
+
+    # block
+    parallel_block: bool = False  # x + attn(h) + mlp(h)
+    parallel_norm_shared: bool = True  # one LN (GPT-J) vs two (GPT-NeoX)
+    attention_bias: bool = True
+    attention_out_bias: bool = True
+    embed_layernorm: bool = False  # BLOOM word_embeddings_layernorm
+    embedding_scale: Optional[float] = None  # Gemma sqrt(hidden)
+    logit_scale: Optional[float] = None  # Cohere
+    tie_word_embeddings: bool = False
+    sliding_window: Optional[int] = None
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads_(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+_ACTS = {
+    "silu": nn.silu,
+    "gelu": nn.gelu,
+    "gelu_new": lambda x: nn.gelu(x, approximate=True),
+    "relu": nn.relu,
+}
+
+
+def make_norm(cfg: DecoderConfig, name: str, dtype):
+    if cfg.norm_type == "rmsnorm":
+        if cfg.rms_scale_offset:
+            return OffsetRMSNorm(eps=cfg.norm_eps, offset=cfg.rms_scale_offset, dtype=dtype, name=name)
+        return RMSNorm(eps=cfg.norm_eps, dtype=dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias, dtype=dtype, name=name)
+
+
+class OffsetRMSNorm(nn.Module):
+    """RMSNorm whose stored scale is offset (Gemma: y *= 1 + scale)."""
+
+    eps: float = 1e-6
+    offset: float = 1.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.zeros, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * (self.offset + scale)).astype(self.dtype)
+
+
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """Standard ALiBi head slopes (power-of-two recipe + interpolation)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start**i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return jnp.asarray(pow2_slopes(n_heads), jnp.float32)
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][: n_heads - closest]
+    return jnp.asarray(base + extra, jnp.float32)
+
+
+def apply_rope_partial(x, cos, sin, rotary_dim: int, interleaved: bool):
+    """Rotate the first ``rotary_dim`` dims of [B,S,H,D]; rest pass through.
+    ``interleaved``: GPT-J rotate-every-two; half-split delegates to the
+    shared llama implementation (one copy of the rotation math)."""
+    from .llama import apply_rope
+
+    xr = x[..., :rotary_dim]
+    xp = x[..., rotary_dim:]
+    if interleaved:
+        xr32 = xr.astype(jnp.float32)
+        c = cos[..., :, None, :]
+        s = sin[..., :, None, :]
+        x1 = xr32[..., 0::2]
+        x2 = xr32[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x1 * s + x2 * c
+        rot = jnp.stack([r1, r2], axis=-1).reshape(xr.shape).astype(x.dtype)
+    else:
+        rot = apply_rope(xr, cos, sin)
+    return rot if rotary_dim == x.shape[-1] else jnp.concatenate([rot, xp], axis=-1)
+
+
+class DecoderAttention(nn.Module):
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        hd = cfg.head_dim_
+        kvh = cfg.kv_heads_
+        dense = lambda feats, name, bias: nn.Dense(
+            feats, use_bias=bias, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name=name,
+        )
+        q = dense(cfg.num_attention_heads * hd, "q_proj", cfg.attention_bias)(x)
+        k = dense(kvh * hd, "k_proj", cfg.attention_bias)(x)
+        v = dense(kvh * hd, "v_proj", cfg.attention_bias)(x)
+        b, s, _ = x.shape
+        q = q.reshape(b, s, cfg.num_attention_heads, hd)
+        k = k.reshape(b, s, kvh, hd)
+        v = v.reshape(b, s, kvh, hd)
+        sp = cfg.sp_mode
+        if sp == "all_to_all":
+            spec = (("dp", "ep"), None, ("tp", "sp"), None)
+        else:
+            spec = (("dp", "ep"), None, "tp", None)
+        q, k, v = (constrain(t, *spec) for t in (q, k, v))
+
+        if cfg.pos_embedding == "rope":
+            rotary_dim = max(2, int(hd * cfg.rotary_pct)) // 2 * 2
+            from .llama import rope_table
+
+            cos, sin = rope_table(positions, rotary_dim, cfg.rope_theta)
+            q = apply_rope_partial(q, cos, sin, rotary_dim, cfg.rope_interleaved)
+            k = apply_rope_partial(k, cos, sin, rotary_dim, cfg.rope_interleaved)
+
+        bias = None
+        if cfg.pos_embedding == "alibi":
+            # position-exact ALiBi: -slope * (q_pos - k_pos), causal-masked
+            # by the dispatcher (≙ bloom build_alibi_tensor)
+            slopes = alibi_slopes(cfg.num_attention_heads)  # [H]
+            dist = (positions[:, :, None] - positions[:, None, :]).astype(jnp.float32)
+            bias = -slopes[None, :, None, None] * dist[:, None, :, :]
+
+        out = dot_product_attention(
+            q, k, v, causal=True, bias=bias, segment_ids=segment_ids,
+            impl=cfg.attention_impl, sliding_window=cfg.sliding_window,
+        )
+        out = out.reshape(b, s, cfg.num_attention_heads * hd)
+        out = dense(cfg.hidden_size, "o_proj", cfg.attention_out_bias)(out)
+        return constrain(out, ("dp", "ep"), "sp", None)
+
+
+class DecoderMLP(nn.Module):
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        act = _ACTS[cfg.act_fn]
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.mlp_bias, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name=name,
+        )
+        if cfg.glu:
+            gate = dense(cfg.intermediate_size, "gate_proj")(x)
+            up = dense(cfg.intermediate_size, "up_proj")(x)
+            h = act(gate) * up
+            h = constrain(h, ("dp", "ep"), None, "tp")
+            out = dense(cfg.hidden_size, "down_proj")(h)
+        else:
+            h = act(dense(cfg.intermediate_size, "fc_in")(x))
+            h = constrain(h, ("dp", "ep"), None, "tp")
+            out = dense(cfg.hidden_size, "fc_out")(h)
+        return constrain(out, ("dp", "ep"), "sp", None)
+
+
+class DecoderBlock(nn.Module):
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        if cfg.parallel_block:
+            h1 = make_norm(cfg, "input_layernorm", dtype)(x)
+            h2 = h1 if cfg.parallel_norm_shared else make_norm(
+                cfg, "post_attention_layernorm", dtype
+            )(x)
+            attn = DecoderAttention(cfg, name="self_attn")(h1, positions, segment_ids)
+            mlp = DecoderMLP(cfg, name="mlp")(h2)
+            return x + attn + mlp
+        h = make_norm(cfg, "input_layernorm", dtype)(x)
+        x = x + DecoderAttention(cfg, name="self_attn")(h, positions, segment_ids)
+        h = make_norm(cfg, "post_attention_layernorm", dtype)(x)
+        return x + DecoderMLP(cfg, name="mlp")(h)
+
+
+class DecoderLM(nn.Module):
+    config: DecoderConfig
+    supports_pipeline = True
+    supports_sp_modes = ("split_gather", "all_to_all")
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        embed = nn.Embed(
+            cfg.padded_vocab_size_, cfg.hidden_size, dtype=dtype,
+            param_dtype=pdtype, name="embed_tokens",
+        )
+        x = embed(input_ids)
+        if cfg.embedding_scale is not None:
+            x = x * jnp.asarray(cfg.embedding_scale, dtype)
+        if cfg.pos_embedding == "learned":
+            wpe = nn.Embed(
+                cfg.max_position_embeddings + cfg.learned_pos_offset,
+                cfg.hidden_size, dtype=dtype, param_dtype=pdtype,
+                name="embed_positions",
+            )
+            x = x + wpe(positions + cfg.learned_pos_offset)
+        if cfg.embed_layernorm:
+            x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype, name="embed_layernorm")(x)
+        x = constrain(x, ("dp", "ep"), "sp", None)
+
+        from .stack import apply_decoder_stack
+
+        x, _ = apply_decoder_stack(self, DecoderBlock, x, positions, segment_ids)
+
+        x = make_norm(cfg, "norm", dtype)(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
+                param_dtype=pdtype, name="lm_head",
+            )(x)
+        if cfg.logit_scale is not None:
+            logits = logits * cfg.logit_scale
+        logits = constrain(logits, ("dp", "ep"), "sp", "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
+        return CausalLMOutput(logits=logits)

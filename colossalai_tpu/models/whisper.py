@@ -1,0 +1,213 @@
+"""Whisper speech-to-text encoder-decoder.
+
+≙ reference ``shardformer/policies/whisper.py`` + ``modeling/whisper.py``
+(WhisperModel/WhisperForConditionalGeneration/WhisperForAudioClassification).
+Architecture facts kept arch-true:
+
+- encoder frontend: two Conv1d (k=3; the second stride-2) + GELU over
+  log-mel features, then FIXED sinusoidal positions;
+- decoder: learned positions, causal self-attention + cross-attention;
+- attention: q/v/out projections biased, k_proj bias-FREE (Whisper quirk);
+- pre-LN blocks, GELU MLP, tied decoder embedding as the LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colossalai_tpu.shardformer.layer.attention import xla_attention
+from colossalai_tpu.tensor import constrain
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
+
+from .base import ModelConfig
+from .t5 import Seq2SeqOutput
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class WhisperConfig(ModelConfig):
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    num_heads: int = 6
+    ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    layer_norm_eps: float = 1e-5
+    decoder_start_token_id: int = 50258
+
+    @property
+    def hidden_size(self) -> int:
+        return self.d_model
+
+    @property
+    def num_hidden_layers(self) -> int:
+        return self.encoder_layers + self.decoder_layers
+
+    @classmethod
+    def whisper_small(cls, **kw):
+        return cls(
+            d_model=768, encoder_layers=12, decoder_layers=12,
+            num_heads=12, ffn_dim=3072, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(
+            vocab_size=256, num_mel_bins=8, d_model=64,
+            encoder_layers=2, decoder_layers=2, num_heads=4, ffn_dim=128,
+            max_source_positions=32, max_target_positions=32, **kw,
+        )
+
+
+def sinusoids(length: int, channels: int) -> np.ndarray:
+    """Fixed sinusoidal position table (≙ modeling_whisper.sinusoids)."""
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(np.float32)
+
+
+class WhisperAttention(nn.Module):
+    config: WhisperConfig
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x, kv=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        hd = cfg.d_model // cfg.num_heads
+        dense = lambda name, bias: nn.Dense(
+            cfg.d_model, use_bias=bias, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name=name,
+        )
+        kv = x if kv is None else kv
+        b, sq, _ = x.shape
+        skv = kv.shape[1]
+        q = dense("q_proj", True)(x).reshape(b, sq, cfg.num_heads, hd)
+        k = dense("k_proj", False)(kv).reshape(b, skv, cfg.num_heads, hd)  # bias-free
+        v = dense("v_proj", True)(kv).reshape(b, skv, cfg.num_heads, hd)
+        q, k, v = (constrain(t, ("dp", "ep"), None, "tp", None) for t in (q, k, v))
+        out = xla_attention(q, k, v, causal=self.causal)
+        out = out.reshape(b, sq, cfg.d_model)
+        out = dense("out_proj", True)(out)
+        return constrain(out, ("dp", "ep"), None, None)
+
+
+class WhisperMLP(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=dtype,
+            param_dtype=cfg.param_dtype or jnp.float32, name=name,
+        )
+        h = nn.gelu(dense(cfg.ffn_dim, "fc1")(x))
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        return dense(cfg.d_model, "fc2")(h)
+
+
+class WhisperEncoderBlock(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name=name)
+        x = x + WhisperAttention(cfg, causal=False, name="self_attn")(ln("self_attn_layer_norm")(x))
+        return x + WhisperMLP(cfg, name="mlp")(ln("final_layer_norm")(x))
+
+
+class WhisperDecoderBlock(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x, enc):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name=name)
+        x = x + WhisperAttention(cfg, causal=True, name="self_attn")(ln("self_attn_layer_norm")(x))
+        x = x + WhisperAttention(cfg, causal=False, name="encoder_attn")(
+            ln("encoder_attn_layer_norm")(x), kv=enc
+        )
+        return x + WhisperMLP(cfg, name="mlp")(ln("final_layer_norm")(x))
+
+
+class _ScanEnc(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cls = nn.remat(WhisperEncoderBlock, prevent_cse=False) if self.config.remat else WhisperEncoderBlock
+        return cls(self.config, name="block")(x), None
+
+
+class _ScanDec(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x, enc):
+        cls = nn.remat(WhisperDecoderBlock, prevent_cse=False) if self.config.remat else WhisperDecoderBlock
+        return cls(self.config, name="block")(x, enc), None
+
+
+class WhisperForConditionalGeneration(nn.Module):
+    config: WhisperConfig
+    supports_pipeline = False
+    supports_sp_modes = ()
+
+    @nn.compact
+    def __call__(self, input_features, decoder_input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+
+        # -------------- encoder: [B, n_mels, T] conv frontend
+        x = jnp.swapaxes(input_features.astype(dtype), 1, 2)  # [B, T, mels]
+        x = nn.gelu(nn.Conv(cfg.d_model, (3,), padding=1, dtype=dtype, param_dtype=pdtype, name="conv1")(x))
+        x = nn.gelu(nn.Conv(cfg.d_model, (3,), strides=(2,), padding=1, dtype=dtype, param_dtype=pdtype, name="conv2")(x))
+        pos_table = jnp.asarray(sinusoids(cfg.max_source_positions, cfg.d_model), dtype)
+        x = x + pos_table[: x.shape[1]][None]
+        x = constrain(x, ("dp", "ep"), None, None)
+        enc, _ = nn.scan(
+            _ScanEnc, variable_axes={"params": 0}, split_rngs={"params": True},
+            length=cfg.encoder_layers, metadata_params={nn.PARTITION_NAME: "encoder"},
+        )(cfg, name="encoder")(x)
+        enc = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="encoder_layer_norm")(enc)
+
+        # -------------- decoder
+        embed = nn.Embed(
+            cfg.padded_vocab_size_, cfg.d_model, dtype=dtype, param_dtype=pdtype,
+            name="embed_tokens",
+        )
+        y = embed(decoder_input_ids)
+        b, s = decoder_input_ids.shape
+        wpe = nn.Embed(
+            cfg.max_target_positions, cfg.d_model, dtype=dtype, param_dtype=pdtype,
+            name="embed_positions",
+        )
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        y = y + wpe(positions)
+        y, _ = nn.scan(
+            _ScanDec, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=(nn.broadcast,), length=cfg.decoder_layers,
+            metadata_params={nn.PARTITION_NAME: "decoder"},
+        )(cfg, name="decoder")(y, enc)
+        y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="decoder_layer_norm")(y)
+
+        logits = embed.attend(y.astype(jnp.float32))
+        logits = constrain(logits, ("dp", "ep"), None, "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
+        return Seq2SeqOutput(logits=logits, encoder_last_hidden_state=enc)

@@ -12,7 +12,9 @@ from .base_policy import Policy
 from .gpt2 import GPT2Policy
 from .llama import LlamaPolicy, MistralPolicy
 from .bert_vit import BertPolicy, ViTPolicy
-from .mixtral import DeepSeekMoEPolicy, MixtralPolicy
+from .mixtral import DeepSeekMoEPolicy, DeepseekV2Policy, MixtralPolicy
+from .t5 import T5Policy, WhisperPolicy
+from .transformer import DecoderPolicy
 
 POLICY_REGISTRY = {
     "llama": LlamaPolicy,
@@ -28,6 +30,40 @@ POLICY_REGISTRY = {
     "vit": ViTPolicy,
     "ViTForImageClassification": ViTPolicy,
     "GPT2LMHeadModel": GPT2Policy,
+    # generalized-decoder families (models/families.py): one Megatron
+    # layout over shared param names (≙ each family's policy file in the
+    # reference's _POLICY_LIST)
+    "t5": T5Policy,
+    "T5ForConditionalGeneration": T5Policy,
+    "T5EncoderModel": T5Policy,
+    "whisper": WhisperPolicy,
+    "WhisperForConditionalGeneration": WhisperPolicy,
+    "deepseek_v2": DeepseekV2Policy,
+    "deepseek_v3": DeepseekV2Policy,
+    "DeepseekV2ForCausalLM": DeepseekV2Policy,
+    "DecoderLM": DecoderPolicy,
+    "opt": DecoderPolicy,
+    "OPTForCausalLM": DecoderPolicy,
+    "bloom": DecoderPolicy,
+    "BloomForCausalLM": DecoderPolicy,
+    "falcon": DecoderPolicy,
+    "FalconForCausalLM": DecoderPolicy,
+    "gptj": DecoderPolicy,
+    "GPTJForCausalLM": DecoderPolicy,
+    "gpt_neox": DecoderPolicy,
+    "GPTNeoXForCausalLM": DecoderPolicy,
+    "chatglm": DecoderPolicy,
+    "ChatGLMForConditionalGeneration": DecoderPolicy,
+    "phi": DecoderPolicy,
+    "PhiForCausalLM": DecoderPolicy,
+    "gemma": DecoderPolicy,
+    "GemmaForCausalLM": DecoderPolicy,
+    "cohere": DecoderPolicy,
+    "CohereForCausalLM": DecoderPolicy,
+    "baichuan": DecoderPolicy,
+    "BaichuanForCausalLM": DecoderPolicy,
+    "starcoder2": DecoderPolicy,
+    "Starcoder2ForCausalLM": DecoderPolicy,
 }
 
 

@@ -23,7 +23,7 @@ Rule = Tuple[str, Tuple[Any, ...]]
 
 #: param-path components that indicate a scanned layer stack whose leading
 #: axis is the layer dim (sharded over pp when pipelining).
-SCAN_CONTAINERS = ("layers", "h", "blocks", "encoder")
+SCAN_CONTAINERS = ("layers", "h", "blocks", "encoder", "decoder", "dense_layers")
 
 
 class Policy:

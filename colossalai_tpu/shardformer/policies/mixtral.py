@@ -26,3 +26,15 @@ class MixtralPolicy(Policy):
 
 class DeepSeekMoEPolicy(MixtralPolicy):
     """DeepSeek-MoE models share the layout (config differs, not sharding)."""
+
+
+class DeepseekV2Policy(MixtralPolicy):
+    """DeepSeek-V2/V3 MLA + MoE (≙ policies/deepseek_v3.py): the low-rank
+    q_a/kv_a compressions are small and replicate; the per-head expansions
+    (q_b, kv_b) are column parallel; experts follow the mixtral layout."""
+
+    rules = [
+        (r"(q_b_proj|kv_b_proj|q_proj)/kernel$", (None, "tp")),
+        (r"(q_a_proj|kv_a_proj_with_mqa)/kernel$", ()),
+        (r"(q_a_layernorm|kv_a_layernorm)/scale$", ()),
+    ] + MixtralPolicy.rules

@@ -1,0 +1,34 @@
+"""T5 sharding policy (≙ reference ``shardformer/policies/t5.py``).
+
+Megatron layout over both stacks: q/k/v and the MLP in-projections column
+parallel, o/wo row parallel, shared embedding vocab-parallel, the relative
+attention bias tp-sharded on its head dim (it adds to tp-sharded score
+heads), norms replicated.
+"""
+
+from .base_policy import Policy
+
+
+class T5Policy(Policy):
+    rules = [
+        (r"shared/embedding$", ("tp", None)),
+        (r"relative_attention_bias/embedding$", (None, "tp")),
+        (r"(q_proj|k_proj|v_proj|wi|wi_0|wi_1)/kernel$", (None, "tp")),
+        (r"(o_proj|wo)/kernel$", ("tp", None)),
+        (r"lm_head/kernel$", (None, "tp")),
+        (r"(ln_self|ln_cross|ln_mlp|enc_norm|dec_norm)/scale$", ()),
+    ]
+
+
+class WhisperPolicy(Policy):
+    """≙ reference shardformer/policies/whisper.py — same Megatron layout
+    over Whisper names; conv frontend + positions replicated."""
+
+    rules = [
+        (r"embed_tokens/embedding$", ("tp", None)),
+        (r"embed_positions/embedding$", (None, None)),
+        (r"(q_proj|k_proj|v_proj|fc1)/kernel$", (None, "tp")),
+        (r"(q_proj|v_proj|fc1)/bias$", ("tp",)),
+        (r"(out_proj|fc2)/kernel$", ("tp", None)),
+        (r"(conv1|conv2)/kernel$", (None, None, None)),
+    ]

@@ -1,0 +1,26 @@
+"""Sharding policy for the generalized decoder families.
+
+≙ the reference's per-family policies (opt/bloom/falcon/gptj/gpt_neox/
+chatglm2/command/...): all are the same Megatron layout over different
+param names, so one rule set covers the whole ``models/families.py`` matrix:
+
+- q/k/v + gate/up/fc_in: column parallel (tp on the output dim, bias too);
+- o_proj/down_proj/fc_out: row parallel (tp on the input dim);
+- embed_tokens vocab-parallel, lm_head column-parallel on vocab;
+- learned positions, norms, embedding LN: replicated.
+"""
+
+from .base_policy import Policy
+
+
+class DecoderPolicy(Policy):
+    rules = [
+        (r"embed_tokens/embedding$", ("tp", None)),
+        (r"embed_positions/embedding$", (None, None)),
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|fc_in)/kernel$", (None, "tp")),
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|fc_in)/bias$", ("tp",)),
+        (r"(o_proj|down_proj|fc_out)/kernel$", ("tp", None)),
+        (r"(o_proj|down_proj|fc_out)/bias$", ()),
+        (r"lm_head/kernel$", (None, "tp")),
+        (r"(input_layernorm|post_attention_layernorm|embed_layernorm|norm)/(scale|bias)$", ()),
+    ]

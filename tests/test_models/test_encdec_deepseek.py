@@ -1,0 +1,101 @@
+"""Whisper + DeepSeek family tests (enc-dec audio; MLA + MoE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import (
+    Booster,
+    DataParallelPlugin,
+    HybridParallelPlugin,
+    MoeHybridParallelPlugin,
+)
+from colossalai_tpu.models import (
+    DeepseekV2Config,
+    DeepseekV2ForCausalLM,
+    WhisperConfig,
+    WhisperForConditionalGeneration,
+)
+from colossalai_tpu.shardformer.layer.loss import softmax_cross_entropy
+
+
+def test_whisper_forward_shapes():
+    cfg = WhisperConfig.tiny()
+    m = WhisperForConditionalGeneration(cfg)
+    feats = jax.random.normal(jax.random.PRNGKey(0), (2, cfg.num_mel_bins, 24))
+    dec = jnp.ones((2, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(1), feats, dec)
+    out = m.apply(params, feats, dec)
+    # conv2 stride-2 halves the audio frames
+    assert out.encoder_last_hidden_state.shape == (2, 12, cfg.d_model)
+    assert out.logits.shape == (2, 8, cfg.vocab_size)
+    # whisper quirk: k_proj is bias-free, q/v are biased
+    attn = params["params"]["encoder"]["block"]["self_attn"]
+    assert "bias" in attn["q_proj"] and "bias" not in attn["k_proj"]
+
+
+@pytest.mark.slow
+def test_whisper_tp_matches_dp():
+    cfg = WhisperConfig.tiny()
+    m = WhisperForConditionalGeneration(cfg)
+    feats = jax.random.normal(jax.random.PRNGKey(0), (8, cfg.num_mel_bins, 24))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (8, 8), 0, cfg.vocab_size)
+    batch = {"input_features": feats, "decoder_input_ids": labels, "labels": labels}
+    loss_fn = lambda out, b: softmax_cross_entropy(out.logits, b["labels"])
+
+    def losses(plugin, steps=2):
+        b = Booster(plugin=plugin).boost(
+            m, optax.sgd(1e-2), loss_fn=loss_fn,
+            example_batch=batch, rng=jax.random.PRNGKey(0),
+        )
+        state, out = b.state, []
+        for _ in range(steps):
+            state, mt = b.train_step(state, b.shard_batch(batch))
+            out.append(float(mt["loss"]))
+        return out
+
+    base = losses(DataParallelPlugin(precision="fp32"))
+    tp = losses(HybridParallelPlugin(tp_size=2, precision="fp32"))
+    assert np.all(np.isfinite(base)) and base[-1] < base[0]
+    assert np.allclose(tp, base, atol=1e-4), (tp, base)
+
+
+def test_deepseek_mla_shapes():
+    cfg = DeepseekV2Config.tiny(q_lora_rank=24, first_k_dense_replace=1, num_hidden_layers=3)
+    m = DeepseekV2ForCausalLM(cfg)
+    ids = jnp.ones((2, 16), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids)
+    out = m.apply(params, ids)
+    assert out.logits.shape == (2, 16, cfg.vocab_size)
+    assert out.aux_loss is not None
+    moe_attn = params["params"]["layers"]["block"]["self_attn"]
+    assert "q_a_proj" in moe_attn and "kv_a_proj_with_mqa" in moe_attn
+    # dense-replace: first layer has a plain MLP, the rest are MoE
+    assert "mlp" in params["params"]["dense_layers"]["block"]
+    assert "moe" in params["params"]["layers"]["block"]
+
+
+@pytest.mark.slow
+def test_deepseek_tp_ep_match_dp():
+    cfg = DeepseekV2Config.tiny(first_k_dense_replace=1, num_hidden_layers=3)
+    m = DeepseekV2ForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, cfg.vocab_size)
+    batch = {"input_ids": ids}
+
+    def losses(plugin, steps=2):
+        b = Booster(plugin=plugin).boost(
+            m, optax.sgd(1e-2), example_batch=batch, rng=jax.random.PRNGKey(0)
+        )
+        state, out = b.state, []
+        for _ in range(steps):
+            state, mt = b.train_step(state, b.shard_batch(batch))
+            out.append(float(mt["loss"]))
+        return out
+
+    base = losses(DataParallelPlugin(precision="fp32"))
+    tp = losses(HybridParallelPlugin(tp_size=2, precision="fp32"))
+    ep = losses(MoeHybridParallelPlugin(ep_size=2, tp_size=2, precision="fp32"))
+    assert np.allclose(tp, base, atol=1e-4), (tp, base)
+    assert np.allclose(ep, base, atol=1e-4), (ep, base)

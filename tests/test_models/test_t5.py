@@ -1,0 +1,77 @@
+"""T5 encoder-decoder: forward, training, tp equivalence.
+
+≙ reference ``tests/test_shardformer/test_model/test_shard_t5.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.models import T5Config, T5EncoderModel, T5ForConditionalGeneration, shift_right
+from colossalai_tpu.shardformer.layer.loss import softmax_cross_entropy
+
+
+def _batch(cfg, key=3):
+    ks = jax.random.split(jax.random.PRNGKey(key), 2)
+    src = jax.random.randint(ks[0], (8, 12), 0, cfg.vocab_size)
+    labels = jax.random.randint(ks[1], (8, 8), 0, cfg.vocab_size)
+    return {
+        "input_ids": src,
+        "decoder_input_ids": shift_right(labels, cfg.decoder_start_token_id),
+        "labels": labels,
+    }
+
+
+def seq2seq_loss(out, batch):
+    return softmax_cross_entropy(out.logits, batch["labels"])
+
+
+def test_t5_shift_right():
+    labels = jnp.asarray([[5, 6, -100]])
+    dec = shift_right(labels, decoder_start_token_id=0)
+    np.testing.assert_array_equal(np.asarray(dec), [[0, 5, 6]])
+
+
+def test_t5_gated_variant_runs():
+    cfg = T5Config.tiny(feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+    m = T5ForConditionalGeneration(cfg)
+    b = _batch(cfg)
+    params = m.init(jax.random.PRNGKey(0), b["input_ids"], b["decoder_input_ids"])
+    out = m.apply(params, b["input_ids"], b["decoder_input_ids"])
+    assert out.logits.shape == (8, 8, cfg.vocab_size)
+    assert "lm_head" in params["params"]
+
+
+def test_t5_encoder_model():
+    cfg = T5Config.tiny()
+    m = T5EncoderModel(cfg)
+    ids = jnp.ones((2, 12), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids)
+    h = m.apply(params, ids)
+    assert h.shape == (2, 12, cfg.d_model)
+
+
+@pytest.mark.slow
+def test_t5_tp_matches_dp():
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    batch = _batch(cfg)
+
+    def losses(plugin, steps=3):
+        b = Booster(plugin=plugin).boost(
+            model, optax.sgd(1e-2), loss_fn=seq2seq_loss,
+            example_batch=batch, rng=jax.random.PRNGKey(0),
+        )
+        state, out = b.state, []
+        for _ in range(steps):
+            state, m = b.train_step(state, b.shard_batch(batch))
+            out.append(float(m["loss"]))
+        return out
+
+    base = losses(DataParallelPlugin(precision="fp32"))
+    tp = losses(HybridParallelPlugin(tp_size=2, precision="fp32"))
+    assert np.all(np.isfinite(base)) and base[-1] < base[0]
+    assert np.allclose(tp, base, atol=1e-4), (tp, base)
