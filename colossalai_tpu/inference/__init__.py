@@ -1,5 +1,14 @@
 from .engine import GenerationConfig, LLMEngine, Request
+from .kv_cache import (
+    BlockAllocator,
+    OutOfBlocks,
+    PagedKVCache,
+    SequenceTable,
+    init_paged_cache,
+)
 from .modeling import KVCache, decode_step, init_cache, prefill
+from .paged_modeling import decode_paged, prefill_paged
+from .server import make_server
 
 __all__ = [
     "GenerationConfig",
@@ -9,4 +18,12 @@ __all__ = [
     "decode_step",
     "init_cache",
     "prefill",
+    "BlockAllocator",
+    "OutOfBlocks",
+    "PagedKVCache",
+    "SequenceTable",
+    "init_paged_cache",
+    "decode_paged",
+    "prefill_paged",
+    "make_server",
 ]

@@ -1,17 +1,21 @@
-"""Continuous-batching inference engine.
+"""Continuous-batching inference engine over a paged KV cache.
 
 ≙ reference ``LLMEngine`` (``inference/core/llm_engine.py:46``) +
 ``RequestHandler`` scheduler (``request_handler.py:140``) + ``BatchBucket``
 (``batch_bucket.py``) + ``KVCacheManager`` (``kvcache_manager.py:18``).
 Design deltas for TPU/XLA:
 
-- static shapes: a fixed pool of decode slots with a [L, slots, S_max]
-  KV cache (slot cache; paged block tables are a later refinement) —
-  recompiles happen only per prompt-length bucket, not per request;
-- prefill runs per-request (padded to a bucket) and scatters K/V into the
-  request's slot; decode advances ALL running slots in one jitted step —
-  that interleaving is the continuous batching;
-- sampling (greedy / temperature / top-k / top-p) is jitted alongside.
+- static shapes: a fixed page pool [L, n_blocks, Hkv, bs, D] + padded
+  per-slot block tables — recompiles happen only per prompt-length bucket;
+- prefill runs per-request (padded to a bucket) writing whole pages;
+  decode advances ALL running slots in one jitted step through the pages
+  (XLA gather or the Pallas paged kernel) — that interleaving is the
+  continuous batching;
+- host-side BlockAllocator does allocation/free/ref-counting; admission
+  blocks when no pages are free and resumes as finished requests release
+  theirs (≙ the reference's running/waiting queues);
+- optional tensor parallelism: pass a mesh and the engine shards params
+  (auto-policy) and the page pool's head dim over ``tp``.
 """
 
 from __future__ import annotations
@@ -26,7 +30,8 @@ import numpy as np
 
 from colossalai_tpu.models.llama import LlamaConfig
 
-from .modeling import KVCache, decode_step, init_cache, prefill
+from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
+from .paged_modeling import decode_paged, prefill_paged
 
 
 @dataclasses.dataclass
@@ -46,7 +51,10 @@ class Request:
     gen: GenerationConfig
     output_ids: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
+    table: Optional[SequenceTable] = None
     finished: bool = False
+    #: ended early because the page pool ran dry (vs natural EOS/length stop)
+    truncated: bool = False
 
 
 def _sample(logits, rng, gen: GenerationConfig):
@@ -67,7 +75,7 @@ def _sample(logits, rng, gen: GenerationConfig):
 
 
 class LLMEngine:
-    """Slot-based continuous batching over a llama-family model."""
+    """Paged continuous batching over a llama-family model."""
 
     def __init__(
         self,
@@ -75,27 +83,74 @@ class LLMEngine:
         config: LlamaConfig,
         max_batch_size: int = 8,
         max_seq_len: int = 1024,
-        prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024),
+        block_size: int = 64,
+        num_blocks: Optional[int] = None,
+        prefill_buckets: tuple = (64, 128, 256, 512, 1024),
         seed: int = 0,
+        mesh=None,
+        use_kernel: bool = False,
     ):
-        self.params = params
         self.config = config
         self.max_batch = max_batch_size
+        if max_seq_len % block_size:
+            raise ValueError(
+                f"max_seq_len={max_seq_len} must be a multiple of "
+                f"block_size={block_size} (prefill writes whole pages)"
+            )
         self.max_seq = max_seq_len
-        self.buckets = tuple(b for b in sorted(prefill_buckets) if b <= max_seq_len)
+        self.block_size = block_size
+        self.max_blocks_per_seq = (max_seq_len + block_size - 1) // block_size
+        if num_blocks is None:
+            # 1 null block + worst case every slot at max length
+            num_blocks = 1 + max_batch_size * self.max_blocks_per_seq
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.buckets = tuple(
+            b for b in sorted(prefill_buckets)
+            if b <= max_seq_len and b % block_size == 0
+        ) or (max_seq_len,)
+        self.use_kernel = use_kernel
+        self.mesh = mesh
         dtype = config.dtype or jnp.bfloat16
-        self.cache = init_cache(config, max_batch_size, max_seq_len, dtype=dtype)
+        cache = init_paged_cache(config, num_blocks, block_size, dtype=dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
+
+            policy = get_autopolicy("llama")
+            specs = policy.param_specs(params["params"] if "params" in params else params)
+            params_tree = params["params"] if "params" in params else params
+            sharded = jax.tree.map(
+                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+                params_tree, specs,
+                is_leaf=lambda x: not isinstance(x, dict),
+            )
+            params = {"params": sharded} if "params" in params else sharded
+            # pool [L, n_blocks, Hkv, bs, D]: heads over tp
+            kv_spec = NamedSharding(mesh, P(None, None, "tp", None, None))
+            cache = PagedKVCache(
+                k=jax.device_put(cache.k, kv_spec), v=jax.device_put(cache.v, kv_spec)
+            )
+        self.params = params
+        self.cache = cache
         self._rng = jax.random.PRNGKey(seed)
         self._ids = itertools.count()
         self.waiting: List[Request] = []
         self.running: Dict[int, Request] = {}  # slot -> request
         self._slot_tokens = np.zeros((max_batch_size,), np.int64)
+        self._tables: Dict[int, SequenceTable] = {}
 
     # ------------------------------------------------------------- frontend
     def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None) -> int:
         req = Request(next(self._ids), list(map(int, prompt_ids)), gen or GenerationConfig())
         if len(req.prompt_ids) >= self.max_seq:
             raise ValueError(f"prompt length {len(req.prompt_ids)} >= max_seq_len {self.max_seq}")
+        need = self._bucket(len(req.prompt_ids)) // self.block_size
+        if need > self.allocator.num_blocks - 1:
+            raise ValueError(
+                f"prompt needs {need} pages but the pool only has "
+                f"{self.allocator.num_blocks - 1} - raise num_blocks"
+            )
         self.waiting.append(req)
         return req.request_id
 
@@ -119,41 +174,66 @@ class LLMEngine:
         return self.max_seq
 
     def step(self) -> List[Request]:
-        """Admit waiting requests into free slots (prefill), then advance all
-        running slots one token (decode). Returns newly finished requests."""
-        # ---- admission/prefill (≙ RequestHandler.schedule)
+        """Admit waiting requests into free slots (prefill, page-funded),
+        then advance all running slots one token. Returns finished requests."""
         finished_at_prefill: List[Request] = []
         for slot in self._free_slots():
             if not self.waiting:
                 break
-            req = self.waiting.pop(0)
+            req = self.waiting[0]
+            # fund the whole prefill (padded bucket) + one decode page ahead
+            bucket = self._bucket(len(req.prompt_ids))
+            need = bucket // self.block_size
+            if self.allocator.num_free < need:
+                break  # no pages: stay queued until frees arrive
+            self.waiting.pop(0)
             req.slot = slot
-            self._prefill_into_slot(req)
-            # the prefill already produced the first token — it may finish
+            req.table = SequenceTable(self.allocator.allocate(need))
+            self._tables[slot] = req.table
+            self._prefill_into_slot(req, bucket)
             if self._is_finished(req, req.output_ids[-1]):
                 req.finished = True
                 finished_at_prefill.append(req)
-                self.cache = KVCache(
-                    k=self.cache.k, v=self.cache.v,
-                    lengths=self.cache.lengths.at[slot].set(0),
-                )
+                self._release(slot)
             else:
                 self.running[slot] = req
 
         if not self.running:
             return finished_at_prefill
 
-        # ---- decode tick for every running slot (idle slots frozen)
+        # grow tables: slots whose next token starts a fresh page
+        for slot, req in list(self.running.items()):
+            t = req.table
+            if t.length % self.block_size == 0 and len(t.blocks) * self.block_size <= t.length:
+                try:
+                    t.blocks.extend(self.allocator.allocate(1))
+                except OutOfBlocks:
+                    # out of pages mid-flight: truncate this request
+                    req.finished = True
+                    req.truncated = True
+                    self._release(slot)
+                    finished_at_prefill.append(req)
+        if not self.running:
+            return finished_at_prefill
+
         tokens = jnp.asarray(self._slot_tokens, jnp.int32)
+        tables = np.zeros((self.max_batch, self.max_blocks_per_seq), np.int32)
+        lengths = np.zeros((self.max_batch,), np.int32)
         active = np.zeros((self.max_batch,), bool)
-        active[list(self.running)] = True
-        logits, self.cache = decode_step(
-            self.params, self.config, tokens, self.cache, jnp.asarray(active)
+        for slot, req in self.running.items():
+            tables[slot] = req.table.padded(self.max_blocks_per_seq)
+            lengths[slot] = req.table.length
+            active[slot] = True
+        logits, self.cache = decode_paged(
+            self.params, self.config, tokens, jnp.asarray(tables),
+            jnp.asarray(lengths), self.cache, jnp.asarray(active),
+            use_kernel=self.use_kernel,
         )
         next_np = np.asarray(jnp.argmax(logits, axis=-1))
 
         finished: List[Request] = []
         for slot, req in list(self.running.items()):
+            req.table.length += 1
             tok = self._pick_token(logits[slot], next_np[slot], req.gen)
             req.output_ids.append(tok)
             self._slot_tokens[slot] = tok
@@ -180,30 +260,22 @@ class LLMEngine:
         )
 
     # -------------------------------------------------------------- internal
-    def _prefill_into_slot(self, req: Request) -> None:
+    def _prefill_into_slot(self, req: Request, bucket: int) -> None:
         n = len(req.prompt_ids)
-        bucket = self._bucket(n)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
-        mini = init_cache(self.config, 1, self.max_seq, dtype=self.cache.k.dtype)
-        logits, mini = prefill(
-            self.params, self.config, jnp.asarray(ids), mini, jnp.asarray([n], jnp.int32)
+        table = jnp.asarray(req.table.padded(self.max_blocks_per_seq), jnp.int32)
+        logits, self.cache = prefill_paged(
+            self.params, self.config, jnp.asarray(ids),
+            jnp.asarray([n], jnp.int32), self.cache, table,
         )
-        slot = req.slot
-        self.cache = KVCache(
-            k=self.cache.k.at[:, slot].set(mini.k[:, 0]),
-            v=self.cache.v.at[:, slot].set(mini.v[:, 0]),
-            lengths=self.cache.lengths.at[slot].set(n),
-        )
-        # first generated token comes from the prefill logits; honor the
-        # request's sampling config here too
+        req.table.length = n
         tok = self._pick_token(logits[0], int(np.asarray(jnp.argmax(logits[0]))), req.gen)
         req.output_ids.append(tok)
-        self._slot_tokens[slot] = tok
+        self._slot_tokens[req.slot] = tok
 
     def _release(self, slot: int) -> None:
-        del self.running[slot]
-        self.cache = KVCache(
-            k=self.cache.k, v=self.cache.v,
-            lengths=self.cache.lengths.at[slot].set(0),
-        )
+        self.running.pop(slot, None)
+        table = self._tables.pop(slot, None)
+        if table is not None:
+            self.allocator.free(table.blocks)

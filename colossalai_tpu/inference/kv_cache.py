@@ -1,0 +1,104 @@
+"""Paged KV cache: block allocator + device-side page pool.
+
+≙ reference ``inference/kv_cache/kvcache_manager.py:18`` (KVCacheManager:
+physical cache blocks + per-sequence logical block tables, allocation,
+ref-counted sharing and freeing). TPU redesign:
+
+- the page pool is ONE static tensor per stack — [L, n_blocks, block_size,
+  Hkv, D] — so every jit sees a fixed shape; "allocation" is host-side
+  bookkeeping (free list + ref counts) that never touches the device;
+- each slot's pages are named by a padded block table [max_blocks] of
+  physical ids; attention gathers pages through the table (XLA gather or
+  the Pallas paged-decode kernel's scalar-prefetch index map);
+- ref counts enable prefix sharing (fork = bump refs on shared pages,
+  copy-on-write is append-only so only the LAST partial page is copied).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class PagedKVCache(NamedTuple):
+    k: jax.Array  # [L, n_blocks, Hkv, block_size, D]
+    v: jax.Array  # [L, n_blocks, Hkv, block_size, D]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+
+def init_paged_cache(cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16) -> PagedKVCache:
+    # heads BEFORE block_size: pages must be (block_size, head_dim) tiles
+    # for the Pallas paged kernel (Mosaic last-two-dims constraint)
+    shape = (cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads, block_size, cfg.head_dim_)
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+class OutOfBlocks(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class BlockAllocator:
+    """Host-side physical-block bookkeeping (≙ KVCacheManager.allocate_*).
+
+    Block 0 is reserved as the null page every padded table entry points to.
+    """
+
+    num_blocks: int
+    block_size: int
+
+    def __post_init__(self):
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._refs: Dict[int, int] = {}
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.block_size - 1) // self.block_size
+
+    def allocate(self, n_blocks: int) -> List[int]:
+        if n_blocks > len(self._free):
+            raise OutOfBlocks(f"need {n_blocks} blocks, {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n_blocks)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def fork(self, blocks: List[int]) -> None:
+        """Share pages with another sequence (prefix reuse): bump refs."""
+        for b in blocks:
+            self._refs[b] += 1
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+
+    def ref_count(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
+
+@dataclasses.dataclass
+class SequenceTable:
+    """One sequence's logical→physical page mapping."""
+
+    blocks: List[int]
+    length: int = 0
+
+    def padded(self, max_blocks: int) -> List[int]:
+        pad = [0] * (max_blocks - len(self.blocks))
+        return list(self.blocks) + pad

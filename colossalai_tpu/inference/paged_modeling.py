@@ -1,0 +1,151 @@
+"""Cache-aware forwards over the PAGED KV pool.
+
+≙ reference ``modeling/nopadding_llama.py`` backed by the paged kernels
+(context_attn_unpad / flash_decoding / kvcache_memcpy). Static shapes:
+prefill writes whole pages by physical id; decode scatters one token per
+slot at (table[len // bs], len % bs) and attends through the gathered
+pages. The XLA decode path materializes the page gather; the Pallas
+``paged_attention`` kernel (kernel/pallas/paged_attention.py) streams pages
+via scalar-prefetched block tables instead.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
+
+from .kv_cache import PagedKVCache
+from .modeling import _block_step, _project_kv, _rms
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_paged(
+    params, cfg: LlamaConfig, input_ids, n_tokens, cache: PagedKVCache, block_table
+) -> Tuple[jax.Array, PagedKVCache]:
+    """One prompt [1, S_pad] → last-token logits [1, V]; K/V written into
+    the pages named by ``block_table`` (S_pad must be a page multiple)."""
+    p = params["params"] if "params" in params else params
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    b, s = input_ids.shape
+    bs = cache.block_size
+    n_pages = s // bs
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    valid = jnp.arange(s)[None, :] < n_tokens  # [1, S]
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[input_ids]
+
+    def layer(carry, inputs):
+        x, i = carry
+        layer_params, k_pool, v_pool = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)
+        # page scatter: logical page j → physical block_table[j];
+        # pool layout is [n_blocks, Hkv, bs, D]
+        k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
+        v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+        k_pool = k_pool.at[block_table[:n_pages]].set(k_pages)
+        v_pool = v_pool.at[block_table[:n_pages]].set(v_pages)
+        # prompt attention is self-contained (causal over the prompt)
+        x = _block_step(cfg, layer_params, x, k, v, positions, valid)
+        return (x, i + 1), (k_pool, v_pool)
+
+    (x, _), (k_new, v_new) = jax.lax.scan(
+        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+    )
+
+    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    else:
+        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    return last, PagedKVCache(k=k_new, v=v_new)
+
+
+@partial(jax.jit, static_argnames=("cfg", "use_kernel"), donate_argnames=("cache",))
+def decode_paged(
+    params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
+    active, use_kernel: bool = False,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """One token per slot through the paged pool.
+
+    tokens [S]; block_tables [S, max_blocks]; lengths [S] (tokens already in
+    cache); active [S] bool. Returns (logits [S, V], cache).
+    """
+    p = params["params"] if "params" in params else params
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    n_slots = tokens.shape[0]
+    bs = cache.block_size
+    max_blocks = block_tables.shape[1]
+    positions = lengths[:, None]  # [S, 1]
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[tokens][:, None, :]
+    # write coordinates for the new token
+    w_block = jnp.take_along_axis(block_tables, (lengths // bs)[:, None], axis=1)[:, 0]
+    w_off = lengths % bs
+
+    s_max = max_blocks * bs
+    kv_pos = jnp.arange(s_max)[None, :]
+    attend = (kv_pos <= lengths[:, None])  # includes the new token's position
+
+    def layer(carry, inputs):
+        x, i = carry
+        layer_params, k_pool, v_pool = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)  # [S,1,Hkv,D]
+        # masked scatter: inactive slots write to the reserved null page 0
+        # at offset 0 — harmless garbage no table points to for reading
+        wb = jnp.where(active, w_block, 0)
+        wo = jnp.where(active, w_off, 0)
+        # pool [n_blocks, Hkv, bs, D]: advanced indices (wb, :, wo) → [S, Hkv, D]
+        k_new_tok = jnp.where(active[:, None, None], k[:, 0], k_pool[wb, :, wo])
+        v_new_tok = jnp.where(active[:, None, None], v[:, 0], v_pool[wb, :, wo])
+        k_pool = k_pool.at[wb, :, wo].set(k_new_tok)
+        v_pool = v_pool.at[wb, :, wo].set(v_new_tok)
+        if use_kernel:
+            from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
+
+            q = h @ layer_params["self_attn"]["q_proj"]["kernel"].astype(dtype)
+            q = q.reshape(n_slots, cfg.num_attention_heads, cfg.head_dim_)
+            cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+            q = apply_rope(q[:, None], cos, sin)[:, 0]
+            attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1)
+            attn = attn.reshape(n_slots, 1, cfg.num_attention_heads * cfg.head_dim_)
+            x = x + (
+                attn.astype(dtype)
+                @ layer_params["self_attn"]["o_proj"]["kernel"].astype(dtype)
+            )
+            h2 = _rms(x, layer_params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+            gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
+            up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
+            x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
+        else:
+            # XLA path: gather this slot's pages into a contiguous view
+            # [S, max_blocks, Hkv, bs, D] → [S, s_max, Hkv, D]
+            def to_seq(pool):
+                g = pool[block_tables]  # [S, mb, Hkv, bs, D]
+                g = g.transpose(0, 1, 3, 2, 4)
+                return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
+
+            k_seq = to_seq(k_pool)
+            v_seq = to_seq(v_pool)
+            x = _block_step(cfg, layer_params, x, k_seq, v_seq, positions, attend)
+        return (x, i + 1), (k_pool, v_pool)
+
+    (x, _), (k_new, v_new) = jax.lax.scan(
+        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+    )
+
+    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    else:
+        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    return logits[:, 0], PagedKVCache(k=k_new, v=v_new)

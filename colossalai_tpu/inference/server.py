@@ -1,0 +1,126 @@
+"""Minimal HTTP inference server over the paged engine.
+
+≙ reference ``inference/server/api_server.py`` (FastAPI + uvicorn). Zero
+extra dependencies: stdlib ``http.server`` with a background scheduler
+thread draining the engine's continuous-batching step loop.
+
+Endpoints:
+- ``POST /generate``  {"prompt_ids": [...], "max_new_tokens": n, ...}
+  → {"request_id": i, "output_ids": [...]}
+- ``GET /health``     → {"status": "ok", "running": n, "waiting": m}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+from .engine import GenerationConfig, LLMEngine
+
+
+class _Scheduler(threading.Thread):
+    """Drains engine.step() continuously; completions signal per-request
+    events (continuous batching across concurrent HTTP requests)."""
+
+    def __init__(self, engine: LLMEngine):
+        super().__init__(daemon=True)
+        self.engine = engine
+        self.lock = threading.Lock()
+        self.done: Dict[int, list] = {}
+        self.events: Dict[int, threading.Event] = {}
+        self._wake = threading.Event()
+        self._stop = False
+
+    def submit(self, prompt_ids, gen: GenerationConfig) -> int:
+        with self.lock:
+            rid = self.engine.add_request(prompt_ids, gen)
+            self.events[rid] = threading.Event()
+        self._wake.set()
+        return rid
+
+    def wait(self, rid: int, timeout: float = 300.0):
+        self.events[rid].wait(timeout)
+        with self.lock:
+            self.events.pop(rid, None)
+            return self.done.pop(rid, None)
+
+    def run(self):
+        while not self._stop:
+            with self.lock:
+                busy = bool(self.engine.waiting or self.engine.running)
+            if not busy:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            with self.lock:
+                for req in self.engine.step():
+                    ev = self.events.get(req.request_id)
+                    if ev is None:
+                        continue  # client gave up (timeout): drop the result
+                    self.done[req.request_id] = req.output_ids
+                    ev.set()
+
+    def stop(self):
+        self._stop = True
+        self._wake.set()
+
+
+def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000):
+    """Returns (ThreadingHTTPServer, scheduler). Call serve_forever() /
+    shutdown() on the server; scheduler.stop() on teardown."""
+    sched = _Scheduler(engine)
+    sched.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                with sched.lock:
+                    self._json(200, {
+                        "status": "ok",
+                        "running": len(engine.running),
+                        "waiting": len(engine.waiting),
+                        "free_blocks": engine.allocator.num_free,
+                    })
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                gen = GenerationConfig(
+                    max_new_tokens=int(req.get("max_new_tokens", 64)),
+                    temperature=float(req.get("temperature", 1.0)),
+                    top_k=int(req.get("top_k", 0)),
+                    top_p=float(req.get("top_p", 1.0)),
+                    do_sample=bool(req.get("do_sample", False)),
+                    eos_token_id=req.get("eos_token_id"),
+                )
+                rid = sched.submit(req["prompt_ids"], gen)
+                out = sched.wait(rid)
+                if out is None:
+                    self._json(504, {"error": "generation timed out"})
+                else:
+                    self._json(200, {"request_id": rid, "output_ids": out})
+            except Exception as e:  # pragma: no cover - defensive
+                self._json(400, {"error": str(e)})
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server._scheduler = sched
+    return server, sched

@@ -1,0 +1,133 @@
+"""Pallas TPU paged decode attention.
+
+≙ reference ``flash_decoding_attention_kernel.cu`` (831 LoC) over the paged
+KV pool (``kvcache_manager``): one query token per sequence attends to its
+pages WITHOUT materializing the gathered [S, s_max, H, D] view the XLA path
+builds — the block table is a scalar-prefetch operand and each grid step's
+``BlockSpec`` index map dereferences it, so Mosaic's pipeline streams
+exactly the pages a sequence owns from HBM (the map clamps trailing steps
+to the last valid page; consecutive identical origins are fetched once and
+their compute is skipped). Cost is therefore proportional to the ACTUAL
+sequence lengths, not the padded maximum — the XLA gather always reads the
+full padded table.
+
+Layout: q [S, H, D] (grouped per kv head in-kernel), pool
+[n_blocks, Hkv, block_size, D], tables [S, max_blocks], lengths [S].
+Online-softmax accumulation across a sequence's pages (flash-decoding).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e9
+
+
+def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
+            scale, block_size, max_blocks, hkv):
+    """Grid (slots, pages); ALL kv heads per step (static loop) — per-step
+    overhead, not MXU work, dominates single-token decode."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, _NEG_INF)
+        l[:] = jnp.zeros_like(l)
+
+    length = len_ref[s]
+    needed = j * block_size < length
+
+    @pl.when(needed)
+    def _compute():
+        for hh in range(hkv):
+            q = q_ref[0, hh]  # [G, D]
+            k = k_ref[0, hh]  # [block_size, D]
+            v = v_ref[0, hh]
+            sc = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # [G, block_size]
+            pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            in_len = pos < length
+            sc = jnp.where(in_len, sc, _NEG_INF)
+
+            m_prev = m[hh]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(sc - m_new)
+            p = jnp.where(in_len, p, 0.0)
+            l[hh] = alpha * l[hh] + jnp.sum(p, axis=1, keepdims=True)
+            acc[hh] = acc[hh] * alpha + jax.lax.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
+            m[hh] = m_new
+
+    @pl.when(j == max_blocks - 1)
+    def _finalize():
+        safe_l = jnp.where(l[:] == 0.0, 1.0, l[:])
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,            # [S, H, D] one token per slot
+    k_pool: jax.Array,       # [n_blocks, Hkv, block_size, D]
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [S, max_blocks] int32
+    lengths: jax.Array,       # [S] valid tokens INCLUDING the new one
+    *,
+    softmax_scale: float | None = None,
+) -> jax.Array:
+    """Returns [S, H, D]."""
+    n_slots, h, d = q.shape
+    _, hkv, block_size, _ = k_pool.shape
+    group = h // hkv
+    max_blocks = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    qg = q.reshape(n_slots, hkv, group, d)
+
+    def page_map(s, j, bt, ln):
+        # clamp to the last REAL page: steps past a sequence's length keep
+        # the previous origin, so Mosaic never re-fetches for skipped pages
+        last = jnp.maximum((ln[s] + block_size - 1) // block_size - 1, 0)
+        return (bt[s, jnp.minimum(j, last)], 0, 0, 0)
+
+    kernel = functools.partial(
+        _kernel, scale=scale, block_size=block_size, max_blocks=max_blocks,
+        hkv=hkv,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_slots, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, hkv, group, d), lambda s, j, bt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, block_size, d), page_map),
+            pl.BlockSpec((1, hkv, block_size, d), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, group, d), lambda s, j, bt, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, group, d), jnp.float32),
+            pltpu.VMEM((hkv, group, 1), jnp.float32),
+            pltpu.VMEM((hkv, group, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    return out.reshape(n_slots, h, d)
+
+
+def _interpret() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except RuntimeError:
+        return True

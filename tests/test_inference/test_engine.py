@@ -104,6 +104,6 @@ def test_engine_eos_stops(model_and_params):
 
 def test_prompt_too_long(model_and_params):
     cfg, _, params = model_and_params
-    engine = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=16)
+    engine = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=16, block_size=16)
     with pytest.raises(ValueError):
         engine.add_request(list(range(20)))
