@@ -248,6 +248,8 @@ class Plugin(abc.ABC):
         batch_sharding = mesh.sharding(*mesh.batch_spec())
         precision = self.precision
 
+        fp8_comm = getattr(self, "fp8_communication", False)
+
         def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
             inputs = _model_inputs(batch, model)
             if opt_shardings_device is not None:
@@ -258,6 +260,12 @@ class Plugin(abc.ABC):
                 )
 
             def compute_loss(params):
+                if fp8_comm:
+                    from colossalai_tpu.quantization.fp8 import fp8_param_gather
+
+                    params = jax.tree.map(
+                        lambda p: fp8_param_gather(p, mesh.mesh), params
+                    )
                 out = model.apply({"params": params}, **inputs)
                 loss = loss_fn(out, batch)
                 # model-side auxiliary objectives (MoE balancing/z-loss) are

@@ -71,6 +71,9 @@ class GeminiPlugin(Plugin):
     offload_optim: bool = False
     zero_stage: int = 1
     fsdp: bool = True
+    #: all-gather fsdp-sharded params as fp8 (+ scale) in the forward
+    #: (≙ fp8 comm hooks, quantization/fp8.py:408); straight-through grads
+    fp8_communication: bool = False
 
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
         return create_device_mesh(devices=devices)
@@ -95,6 +98,10 @@ class HybridParallelPlugin(Plugin):
     sequence_parallel_mode: str = "none"
     fsdp: bool = False
     enable_flash_attention: bool = True
+    #: run MLP matmuls in scaled fp8 (≙ use_fp8/FP8Hook). Pays off only on
+    #: fp8-capable MXUs (v6e+); on v5e XLA dequantizes and the casts cost
+    #: ~9% (measured) — use for numerics experiments there, not speed.
+    enable_fp8: bool = False
     microbatch_size: Optional[int] = None
     num_microbatches: Optional[int] = None
     #: pipeline schedule: "1f1b" | "interleaved" | "zb" | "gpipe"
@@ -210,6 +217,14 @@ class HybridParallelPlugin(Plugin):
                 updates["pp_chunks"] = self.pp_chunks
         if not self.enable_flash_attention and getattr(model.config, "attention_impl", None) not in (None, "xla"):
             updates["attention_impl"] = "xla"
+        if self.enable_fp8:
+            if not getattr(model, "supports_fp8", False):
+                raise NotImplementedError(
+                    f"{type(model).__name__} has no fp8 matmul path "
+                    "(supports_fp8); currently the llama family implements it"
+                )
+            if not getattr(model.config, "fp8_matmul", False):
+                updates["fp8_matmul"] = True
         mode = {"ring": "split_gather"}.get(self.sequence_parallel_mode, self.sequence_parallel_mode)
         if mode != "none":
             supported = getattr(model, "supports_sp_modes", ("split_gather",))

@@ -41,6 +41,9 @@ class ModelConfig:
     pp_schedule: str = "1f1b"
     # virtual stages per device for the interleaved schedule
     pp_chunks: int = 1
+    # run MLP matmuls through the scaled-fp8 path (≙ FP8Hook/fp8_linear);
+    # set by HybridParallelPlugin(enable_fp8=True)
+    fp8_matmul: bool = False
     # pad embed/lm_head vocab dim to this multiple so tp can shard it
     # (≙ make_vocab_size_divisible_by / padded_tensor). Set by the plugin
     # when vocab_size % tp != 0; phantom logits are masked in the forward.

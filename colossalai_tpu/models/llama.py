@@ -218,9 +218,17 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
+        extra = {}
+        if cfg.fp8_matmul:
+            # same param tree as the bf16 path; only the matmul changes
+            # (≙ FP8Hook patching Linear.forward to fp8_linear)
+            from colossalai_tpu.quantization.fp8 import fp8_dot_general
+
+            extra["dot_general"] = fp8_dot_general
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=False, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name=name,
+            **extra,
         )
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
@@ -251,6 +259,8 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
     #: SP modes this architecture honors (checked by plugins before setting)
     supports_sp_modes = ("split_gather", "all_to_all", "ring_attn")
+    #: fp8 MLP matmuls (enable_fp8) are implemented for this family
+    supports_fp8 = True
     #: streams microbatches over the pp axis when pp_microbatches > 0
     supports_pipeline = True
 

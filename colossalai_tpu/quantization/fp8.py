@@ -12,6 +12,7 @@ wrapper + a flax module patcher.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -68,3 +69,113 @@ class FP8Hook:
         if bias is not None:
             y = y + bias.astype(out_dtype)
         return y
+
+
+def _fp8_dot(a, b, dn, a_dtype, b_dtype):
+    """Scaled fp8 contraction with fp32 accumulation; scales are
+    non-differentiable statistics (stop_gradient), matching fp8_linear."""
+    a8, a_inv = cast_to_fp8(jax.lax.stop_gradient(a), a_dtype)
+    b8, b_inv = cast_to_fp8(jax.lax.stop_gradient(b), b_dtype)
+    out = jax.lax.dot_general(a8, b8, dn, preferred_element_type=jnp.float32)
+    return out * a_inv * b_inv
+
+
+@jax.custom_vjp
+def _fp8_dense_dot(lhs, rhs):
+    """x [..., K] @ w [K, N] in scaled e4m3 (fwd) / e5m2 grads (bwd),
+    fp32 accumulation — the reference fp8_linear's autograd.Function."""
+    dn = (((lhs.ndim - 1,), (0,)), ((), ()))
+    return _fp8_dot(lhs, rhs, dn, E4M3, E4M3)
+
+
+def _fp8_dense_fwd(lhs, rhs):
+    return _fp8_dense_dot(lhs, rhs), (lhs, rhs)
+
+
+def _fp8_dense_bwd(res, g):
+    lhs, rhs = res
+    # dL/dx = g @ w^T ; dL/dw = x^T @ g — gradients travel in e5m2 (wide
+    # exponent range), activations/weights stay e4m3 (≙ fp8.py backward)
+    dn_dx = (((g.ndim - 1,), (1,)), ((), ()))
+    dlhs = _fp8_dot(g, rhs, dn_dx, E5M2, E4M3).astype(lhs.dtype)
+    batch = tuple(range(lhs.ndim - 1))
+    dn_dw = ((batch, batch[: g.ndim - 1]), ((), ()))
+    drhs = _fp8_dot(lhs, g, dn_dw, E4M3, E5M2).astype(rhs.dtype)
+    return dlhs, drhs
+
+
+_fp8_dense_dot.defvjp(_fp8_dense_fwd, _fp8_dense_bwd)
+
+
+def fp8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type=None):
+    """Drop-in ``dot_general`` for flax Dense (≙ FP8Hook patching Linear):
+    forward in scaled e4m3, backward cotangents in e5m2, fp32 accumulation.
+    Only the Dense contraction pattern ([..., K] x [K, N]) is supported."""
+    (lc, rc), (lb, rb) = dimension_numbers
+    if tuple(lc) != (lhs.ndim - 1,) or tuple(rc) != (0,) or lb or rb:
+        raise NotImplementedError(
+            f"fp8_dot_general supports the Dense pattern only, got {dimension_numbers}"
+        )
+    out = _fp8_dense_dot(lhs, rhs)
+    # match lax.dot_general's contract: without preferred_element_type the
+    # result keeps the operand dtype (flax Dense relies on this)
+    return out.astype(preferred_element_type or lhs.dtype)
+
+
+#: leaves below this size skip fp8 gathering: quantizing a norm vector
+#: saves nothing on the wire but adds an amax pass + a fenced collective,
+#: and norm scales are precision-sensitive (reference hooks do the same)
+FP8_GATHER_MIN_SIZE = 65536
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _fp8_gather_roundtrip(p, mesh):
+    p8, inv = cast_to_fp8(p, E4M3)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # barriers on BOTH sides of the resharding: XLA's algebraic
+        # simplifier freely commutes elementwise converts with all-gather,
+        # silently reverting the wire format to full-width bytes — fencing
+        # the f8 tensor pins the collective to f8
+        p8 = jax.lax.optimization_barrier(p8)
+        p8 = jax.lax.with_sharding_constraint(
+            p8, NamedSharding(mesh, PartitionSpec())
+        )
+        p8 = jax.lax.optimization_barrier(p8)
+    return cast_from_fp8(p8, inv, p.dtype)
+
+
+def _fp8_gather_fwd(p, mesh):
+    return _fp8_gather_roundtrip(p, mesh), None
+
+
+def _fp8_gather_bwd(mesh, _, g):
+    # identity backward: the quantized copy is a forward-only artifact, the
+    # optimizer updates the full-precision sharded master. Crucially this
+    # keeps the master param OUT of the forward graph, so no full-width
+    # gather of it is ever needed (an STE a+(b-a) form would re-introduce it)
+    return (g,)
+
+
+_fp8_gather_roundtrip.defvjp(_fp8_gather_fwd, _fp8_gather_bwd)
+
+
+def fp8_param_gather(p: jax.Array, mesh=None) -> jax.Array:
+    """FP8-compressed parameter all-gather for ZeRO-3/FSDP
+    (≙ ``quantization/fp8.py:408`` all_gather_fp8 comm hook).
+
+    The data-sharded master param is cast to e4m3 (+ fp32 scale), a
+    replication constraint is placed ON THE FP8 TENSOR — so XLA's inserted
+    all-gather moves 1 byte/param — and the value is restored after the
+    collective. Gradients pass through as identity (custom_vjp), so the
+    optimizer step sees exact grads on the full-precision master. Small
+    leaves (norm scales) stay full-precision.
+    """
+    from colossalai_tpu.tensor import current_mesh
+
+    if p.size < FP8_GATHER_MIN_SIZE or p.ndim < 2:
+        return p
+    mesh = mesh if mesh is not None else current_mesh()
+    return _fp8_gather_roundtrip(p, mesh)

@@ -1,0 +1,81 @@
+"""FP8 end-to-end wiring (≙ reference quantization/fp8.py:408-616 comm
+hooks + FP8Hook fp8_linear): the flags must actually change the compiled
+program, not just exist."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import (
+    Booster,
+    DataParallelPlugin,
+    GeminiPlugin,
+    HybridParallelPlugin,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.tensor import use_mesh
+
+
+def _losses(plugin, steps=4):
+    cfg = LlamaConfig.tiny()
+    ids = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, cfg.vocab_size)
+    batch = {"input_ids": ids}
+    b = Booster(plugin=plugin).boost(
+        LlamaForCausalLM(cfg), optax.adamw(1e-2),
+        example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    state, out = b.state, []
+    for _ in range(steps):
+        state, m = b.train_step(state, b.shard_batch(batch))
+        out.append(float(m["loss"]))
+    return out, b, batch
+
+
+def test_fp8_matmul_trains():
+    base, _, _ = _losses(DataParallelPlugin(precision="fp32"))
+    fp8, b, batch = _losses(HybridParallelPlugin(tp_size=2, precision="fp32", enable_fp8=True))
+    assert np.all(np.isfinite(fp8)) and fp8[-1] < fp8[0], fp8
+    # same starting point (quantization noise only), same trend
+    assert abs(fp8[0] - base[0]) < 0.1, (fp8[0], base[0])
+    # the compiled program really contains e4m3 contractions
+    with use_mesh(b.mesh):
+        txt = b.train_step._jitted.lower(b.state, b.shard_batch(batch)).compile().as_text()
+    assert "f8e4m3" in txt
+
+
+@pytest.mark.slow
+def test_fp8_comm_compresses_param_gathers(monkeypatch):
+    from colossalai_tpu.quantization import fp8 as fp8mod
+
+    # tiny-model leaves are all below the production size threshold;
+    # drop it so the compression path is exercised
+    monkeypatch.setattr(fp8mod, "FP8_GATHER_MIN_SIZE", 0)
+
+    base, _, _ = _losses(DataParallelPlugin(precision="fp32"))
+    comm, b, batch = _losses(GeminiPlugin(precision="fp32", fp8_communication=True))
+    assert np.all(np.isfinite(comm)) and comm[-1] < comm[0], comm
+    assert abs(comm[0] - base[0]) < 0.1, (comm[0], base[0])
+    with use_mesh(b.mesh):
+        txt = b.train_step._jitted.lower(b.state, b.shard_batch(batch)).compile().as_text()
+    # the param all-gathers must move NARROW bytes. The program requests f8;
+    # the CPU backend's collective promotion widens narrow gathers to f16
+    # (still half the fp32 master's wire bytes) — accept either, reject a
+    # silent fall-back to full-width f32 gathers of the fp8-fenced values.
+    gathers = [l for l in txt.splitlines() if "all-gather" in l and "= f" in l]
+    narrow = [l for l in gathers if " f8" in l or "f8e4m3" in l or " f16" in l]
+    assert narrow, gathers[:5]
+    # and the identity-backward must keep full-width forward gathers of the
+    # fsdp master params OUT of the program: any remaining f32 gathers may
+    # only appear in the backward/optimizer, not feeding the model forward
+    # (1-D norm scales intentionally stay full precision — only matrix
+    # params must not gather wide in the forward)
+    import re
+
+    fwd_f32 = [
+        l for l in gathers
+        if re.search(r"= f32\[\d+,[^\]]*\] all-gather\(", l)
+        and "jvp(LlamaForCausalLM)" in l and "transpose" not in l
+    ]
+    assert not fwd_f32, fwd_f32[:3]
