@@ -335,9 +335,16 @@ class Plugin(abc.ABC):
 
     def _build_eval_step(self, model, loss_fn, mesh, state_shardings):
         batch_sharding = mesh.sharding(*mesh.batch_spec())
+        fp8_comm = getattr(self, "fp8_communication", False)
 
         def step_fn(state: TrainState, batch):
-            out = model.apply({"params": state.params}, **_model_inputs(batch, model))
+            params = state.params
+            if fp8_comm:
+                # eval must see the same quantized gathers training did
+                from colossalai_tpu.quantization.fp8 import fp8_param_gather
+
+                params = jax.tree.map(lambda p: fp8_param_gather(p, mesh.mesh), params)
+            out = model.apply({"params": params}, **_model_inputs(batch, model))
             loss = loss_fn(out, batch)
             if getattr(out, "aux_loss", None) is not None:
                 loss = loss + out.aux_loss

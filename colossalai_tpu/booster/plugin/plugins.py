@@ -72,8 +72,16 @@ class GeminiPlugin(Plugin):
     zero_stage: int = 1
     fsdp: bool = True
     #: all-gather fsdp-sharded params as fp8 (+ scale) in the forward
-    #: (≙ fp8 comm hooks, quantization/fp8.py:408); straight-through grads
+    #: (≙ fp8 comm hooks, quantization/fp8.py:408); identity-backward grads
     fp8_communication: bool = False
+
+    def __post_init__(self):
+        if self.fp8_communication and not self.fsdp:
+            raise ValueError(
+                "fp8_communication compresses the fsdp param all-gathers; "
+                "without fsdp there is no gather to compress (it would only "
+                "quantize replicated params for nothing)"
+            )
 
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
         return create_device_mesh(devices=devices)

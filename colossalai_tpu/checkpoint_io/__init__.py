@@ -1,4 +1,7 @@
 from .checkpoint_io import CheckpointIO
+from .hf_interop import HF_SPECS
+from .hf_interop import hf_to_params as hf_to_params_family
+from .hf_interop import params_to_hf as params_to_hf_family
 from .hf_llama import hf_to_params, params_to_hf
 from .safetensors_io import (
     flatten_params,

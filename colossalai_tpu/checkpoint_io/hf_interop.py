@@ -1,0 +1,231 @@
+"""Map-driven HuggingFace checkpoint interop for multiple families.
+
+≙ reference ``hybrid_parallel_checkpoint_io.py`` HF gather/export paths +
+per-model ``modeling`` name conventions. One declarative spec per family:
+
+- ``top``/``layer`` entries: (hf name/template, our dotted path, kind)
+  where kind is "linear" (HF [out,in] ↔ our [in,out] transpose), "raw"
+  (embeddings, norms, biases), or "conv1d" (GPT-2 Conv1D stores [in,out]
+  like flax — no transpose);
+- optional entries (qkv biases) are skipped when absent on either side;
+- "experts" entries expand our stacked [E, ...] expert tensors to the
+  reference's per-expert HF names (mixtral block_sparse_moe);
+- vocab-dim tensors are unpadded on export / padded on import
+  (``tensor/padded_vocab``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from colossalai_tpu.tensor.padded_vocab import pad_vocab, unpad_vocab
+
+
+@dataclasses.dataclass(frozen=True)
+class FamilySpec:
+    #: scanned-stack container in our tree (e.g. "layers" → layers/block/...)
+    container: str
+    top: List[Tuple[str, str, str]]
+    layer: List[Tuple[str, str, str]]
+    #: our suffixes that may legitimately be absent (config-dependent biases)
+    optional: Tuple[str, ...] = ()
+    #: hf names whose dim-0 is the vocab dim (pad/unpad)
+    vocab_keys: Tuple[str, ...] = ()
+    #: hf names to drop on import when embeddings are tied
+    tied_keys: Tuple[str, ...] = ("lm_head.weight",)
+
+
+_LLAMA = FamilySpec(
+    container="layers",
+    top=[
+        ("model.embed_tokens.weight", "embed_tokens.embedding", "raw"),
+        ("model.norm.weight", "norm.scale", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ],
+    layer=[
+        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
+        ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel", "linear"),
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+    ],
+    optional=(
+        "self_attn.q_proj.bias", "self_attn.k_proj.bias", "self_attn.v_proj.bias",
+        "lm_head.kernel",
+    ),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
+_GPT2 = FamilySpec(
+    container="h",
+    top=[
+        ("wte.weight", "wte.embedding", "raw"),
+        ("wpe.weight", "wpe.embedding", "raw"),
+        ("ln_f.weight", "ln_f.scale", "raw"),
+        ("ln_f.bias", "ln_f.bias", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ],
+    layer=[
+        # HF GPT-2 Conv1D stores [in, out] — flax layout, no transpose
+        ("h.{i}.attn.c_attn.weight", "c_attn.kernel", "conv1d"),
+        ("h.{i}.attn.c_attn.bias", "c_attn.bias", "raw"),
+        ("h.{i}.attn.c_proj.weight", "c_proj.kernel", "conv1d"),
+        ("h.{i}.attn.c_proj.bias", "c_proj.bias", "raw"),
+        ("h.{i}.mlp.c_fc.weight", "c_fc.kernel", "conv1d"),
+        ("h.{i}.mlp.c_fc.bias", "c_fc.bias", "raw"),
+        ("h.{i}.mlp.c_proj.weight", "mlp_c_proj.kernel", "conv1d"),
+        ("h.{i}.mlp.c_proj.bias", "mlp_c_proj.bias", "raw"),
+        ("h.{i}.ln_1.weight", "ln_1.scale", "raw"),
+        ("h.{i}.ln_1.bias", "ln_1.bias", "raw"),
+        ("h.{i}.ln_2.weight", "ln_2.scale", "raw"),
+        ("h.{i}.ln_2.bias", "ln_2.bias", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("wte.weight", "lm_head.weight"),
+)
+
+_MIXTRAL = FamilySpec(
+    container="layers",
+    top=_LLAMA.top,
+    layer=[
+        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.block_sparse_moe.gate.weight", "moe.router/kernel", "linear"),
+        # stacked [E, H, I]/[E, I, H] ↔ per-expert HF tensors (w1=gate,
+        # w3=up, w2=down, each [out, in])
+        ("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight", "moe.experts_gate/kernel", "experts"),
+        ("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", "moe.experts_up/kernel", "experts"),
+        ("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", "moe.experts_down/kernel", "experts"),
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
+HF_SPECS: Dict[str, FamilySpec] = {
+    "llama": _LLAMA,
+    "mistral": _LLAMA,
+    "qwen2": _LLAMA,
+    "gpt2": _GPT2,
+    "mixtral": _MIXTRAL,
+}
+
+
+def _get(tree, dotted):
+    node = tree
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _put(tree, dotted, val):
+    node = tree
+    parts = dotted.split(".")
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = val
+
+
+def params_to_hf(
+    params: Any, family: str, vocab_size: Optional[int] = None
+) -> Dict[str, np.ndarray]:
+    """Our param tree → HF-named numpy state dict."""
+    spec = HF_SPECS[family]
+    p = params["params"] if "params" in params else params
+    out: Dict[str, np.ndarray] = {}
+
+    for hf, ours, kind in spec.top:
+        arr = _get(p, ours)
+        if arr is None:
+            if ours in spec.optional:
+                continue
+            raise KeyError(f"{family}: missing {ours}")
+        arr = np.asarray(arr)
+        arr = arr.T if kind == "linear" else arr
+        if vocab_size is not None and hf in spec.vocab_keys:
+            arr = unpad_vocab(arr, vocab_size, axis=0)
+        out[hf] = arr
+
+    stack = _get(p, f"{spec.container}.block")
+    if stack is None:
+        raise KeyError(f"{family}: no scanned stack {spec.container}/block")
+    n_layers = None
+    for hf_t, ours, kind in spec.layer:
+        node = _get(stack, ours)
+        if node is None:
+            if ours in spec.optional:
+                continue
+            raise KeyError(f"{family}: missing {ours}")
+        arr = np.asarray(node)
+        n_layers = arr.shape[0]
+        for i in range(n_layers):
+            li = arr[i]
+            if kind == "experts":
+                for e in range(li.shape[0]):
+                    out[hf_t.format(i=i, e=e)] = li[e].T
+            elif kind == "linear":
+                out[hf_t.format(i=i)] = li.T
+            else:
+                out[hf_t.format(i=i)] = li
+    return out
+
+
+def hf_to_params(
+    state: Dict[str, np.ndarray],
+    family: str,
+    num_layers: int,
+    num_experts: int = 0,
+    tie_word_embeddings: bool = False,
+    padded_vocab_size: Optional[int] = None,
+) -> Dict[str, Any]:
+    """HF-named state dict → our param tree (numpy leaves, scanned stacks)."""
+    spec = HF_SPECS[family]
+    if num_experts <= 0 and any(kind == "experts" for _, _, kind in spec.layer):
+        raise ValueError(f"{family}: pass num_experts (stacked expert tensors)")
+    p: Dict[str, Any] = {}
+
+    for hf, ours, kind in spec.top:
+        if tie_word_embeddings and hf in spec.tied_keys:
+            continue
+        if hf not in state:
+            if ours in spec.optional:
+                continue
+            raise KeyError(f"{family}: checkpoint missing {hf}")
+        arr = state[hf]
+        if padded_vocab_size is not None and hf in spec.vocab_keys:
+            arr = pad_vocab(arr, padded_vocab_size, axis=0)
+        _put(p, ours, arr.T if kind == "linear" else arr)
+
+    for hf_t, ours, kind in spec.layer:
+        first = hf_t.format(i=0, e=0)
+        if first not in state:
+            if ours in spec.optional:
+                continue
+            raise KeyError(f"{family}: checkpoint missing {first}")
+        per_layer = []
+        for i in range(num_layers):
+            if kind == "experts":
+                per_layer.append(np.stack(
+                    [state[hf_t.format(i=i, e=e)].T for e in range(num_experts)], 0
+                ))
+            elif kind == "linear":
+                per_layer.append(state[hf_t.format(i=i)].T)
+            else:
+                per_layer.append(state[hf_t.format(i=i)])
+        _put(p, f"{spec.container}.block.{ours}", np.stack(per_layer, 0))
+    return p

@@ -1,9 +1,8 @@
-"""HuggingFace LLaMA checkpoint interop.
+"""HuggingFace LLaMA checkpoint interop (compat shims).
 
-≙ reference HF compatibility (``test_plugins_huggingface_compatibility.py``,
-``hybrid_parallel_checkpoint_io.py`` gather-to-HF path): convert between this
-repo's flax layout (scanned layers, [in, out] kernels) and HF transformers'
-``LlamaForCausalLM`` state dict ([out, in] weights, per-layer names).
+The map-driven multi-family converter lives in ``hf_interop.py``; these
+wrappers keep the original llama-only signatures working (scanned and
+unrolled layouts) on top of it.
 """
 
 from __future__ import annotations
@@ -12,78 +11,30 @@ from typing import Any, Dict
 
 import numpy as np
 
-#: (hf template, our suffix) for per-layer weights
-_LAYER_MAP = [
-    ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel"),
-    ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel"),
-    ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel"),
-    ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel"),
-    ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel"),
-    ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel"),
-    ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel"),
-    ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale"),
-    ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale"),
-]
-
-_TOP_MAP = [
-    ("model.embed_tokens.weight", "embed_tokens.embedding"),
-    ("model.norm.weight", "norm.scale"),
-    ("lm_head.weight", "lm_head.kernel"),
-]
-
-
-#: HF names whose dim-0 is the vocab dim (after our kernel→weight transpose)
-_VOCAB_KEYS = ("model.embed_tokens.weight", "lm_head.weight")
+from .hf_interop import HF_SPECS
+from .hf_interop import hf_to_params as _hf_to_params_family
+from .hf_interop import params_to_hf as _params_to_hf_family
 
 
 def params_to_hf(
     params: Dict[str, Any], scanned: bool = True, vocab_size: int | None = None
 ) -> Dict[str, np.ndarray]:
-    """Our llama param tree → HF-named state dict (numpy).
+    """Our llama param tree → HF-named state dict (numpy)."""
+    if scanned:
+        return _params_to_hf_family(params, "llama", vocab_size=vocab_size)
+    # unrolled layers_{i} layout: restack into the scanned form first
+    p = dict(params["params"] if "params" in params else params)
+    stacked: Dict[str, Any] = {}
+    i = 0
+    layers = []
+    while f"layers_{i}" in p:
+        layers.append(p.pop(f"layers_{i}"))
+        i += 1
+    if layers:
+        import jax
 
-    ``vocab_size``: true vocab — phantom rows added by ``vocab_pad_multiple``
-    (tp padding) are sliced off so the export has the real HF shape
-    (≙ to_unpadded_tensor in the reference's gather-to-HF path)."""
-    out: Dict[str, np.ndarray] = {}
-    p = params["params"] if "params" in params else params
-
-    def get(path):
-        node = p
-        for part in path.split("."):
-            node = node[part]
-        return np.asarray(node)
-
-    for hf_name, ours in _TOP_MAP:
-        if _has(p, ours):
-            arr = get(ours)
-            arr = arr.T if ours.endswith("kernel") else arr
-            if vocab_size is not None and hf_name in _VOCAB_KEYS:
-                from colossalai_tpu.tensor.padded_vocab import unpad_vocab
-
-                arr = unpad_vocab(arr, vocab_size, axis=0)
-            out[hf_name] = arr
-
-    if scanned and "layers" in p:
-        stack = p["layers"]["block"]
-        n_layers = np.asarray(next(iter(_leaves(stack)))).shape[0]
-        for i in range(n_layers):
-            for hf_t, ours in _LAYER_MAP:
-                node = stack
-                for part in ours.split("."):
-                    node = node[part]
-                arr = np.asarray(node)[i]
-                out[hf_t.format(i=i)] = arr.T if ours.endswith("kernel") else arr
-    else:
-        i = 0
-        while f"layers_{i}" in p:
-            for hf_t, ours in _LAYER_MAP:
-                node = p[f"layers_{i}"]
-                for part in ours.split("."):
-                    node = node[part]
-                arr = np.asarray(node)
-                out[hf_t.format(i=i)] = arr.T if ours.endswith("kernel") else arr
-            i += 1
-    return out
+        p["layers"] = {"block": jax.tree.map(lambda *xs: np.stack(xs, 0), *layers)}
+    return _params_to_hf_family(p, "llama", vocab_size=vocab_size)
 
 
 def hf_to_params(
@@ -93,58 +44,17 @@ def hf_to_params(
     tie_word_embeddings: bool = False,
     padded_vocab_size: int | None = None,
 ) -> Dict[str, Any]:
-    """HF-named state dict → our llama param tree (numpy leaves).
-
-    ``padded_vocab_size``: zero-pad the vocab dim up to the model's
-    ``padded_vocab_size_`` (tp-divisible) so the tree matches a padded
-    model's shapes (≙ to_padded_tensor on load)."""
-    p: Dict[str, Any] = {}
-
-    def put(path, val):
-        node = p
-        parts = path.split(".")
-        for part in parts[:-1]:
-            node = node.setdefault(part, {})
-        node[parts[-1]] = val
-
-    for hf_name, ours in _TOP_MAP:
-        if hf_name == "lm_head.weight" and tie_word_embeddings:
-            continue
-        arr = state[hf_name]
-        if padded_vocab_size is not None and hf_name in _VOCAB_KEYS:
-            from colossalai_tpu.tensor.padded_vocab import pad_vocab
-
-            arr = pad_vocab(arr, padded_vocab_size, axis=0)
-        put(ours, arr.T if ours.endswith("kernel") else arr)
-
+    """HF-named state dict → our llama param tree (numpy leaves)."""
+    tree = _hf_to_params_family(
+        state, "llama", num_layers,
+        tie_word_embeddings=tie_word_embeddings,
+        padded_vocab_size=padded_vocab_size,
+    )
     if scanned:
-        for _, ours in _LAYER_MAP:
-            per_layer = []
-            for i in range(num_layers):
-                hf_name = [t for t, o in _LAYER_MAP if o == ours][0].format(i=i)
-                arr = state[hf_name]
-                per_layer.append(arr.T if ours.endswith("kernel") else arr)
-            put("layers.block." + ours, np.stack(per_layer, axis=0))
-    else:
-        for i in range(num_layers):
-            for hf_t, ours in _LAYER_MAP:
-                arr = state[hf_t.format(i=i)]
-                put(f"layers_{i}." + ours, arr.T if ours.endswith("kernel") else arr)
-    return p
+        return tree
+    import jax
 
-
-def _has(tree, dotted):
-    node = tree
-    for part in dotted.split("."):
-        if not isinstance(node, dict) or part not in node:
-            return False
-        node = node[part]
-    return True
-
-
-def _leaves(tree):
-    if isinstance(tree, dict):
-        for v in tree.values():
-            yield from _leaves(v)
-    else:
-        yield tree
+    stacked = tree.pop("layers")["block"]
+    for i in range(num_layers):
+        tree[f"layers_{i}"] = jax.tree.map(lambda a: a[i], stacked)
+    return tree
