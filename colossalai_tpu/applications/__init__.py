@@ -1,0 +1,20 @@
+from .eval import evaluate_perplexity, score_choices
+from .rlhf import (
+    DPOTrainer,
+    compute_reference_logprobs,
+    grpo_advantages,
+    make_dpo_loss,
+    make_grpo_loss,
+    sequence_log_probs,
+)
+
+__all__ = [
+    "DPOTrainer",
+    "compute_reference_logprobs",
+    "grpo_advantages",
+    "make_dpo_loss",
+    "make_grpo_loss",
+    "sequence_log_probs",
+    "evaluate_perplexity",
+    "score_choices",
+]

@@ -1,0 +1,59 @@
+"""Evaluation harness: perplexity + log-prob choice scoring.
+
+≙ reference ``applications/ColossalEval`` (dataset runners + metrics): the
+two primitives every eval there reduces to — next-token perplexity over a
+corpus, and multiple-choice answers picked by length-normalized completion
+log-probability (the ARC/MMLU/HellaSwag scoring rule).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colossalai_tpu.shardformer.layer.loss import dist_log_prob
+
+
+def evaluate_perplexity(boosted, batches: Iterable[Dict[str, Any]]) -> Dict[str, float]:
+    """Corpus perplexity via the boosted eval_step (any parallel config)."""
+    total_loss, n = 0.0, 0
+    for batch in batches:
+        metrics = boosted.eval_step(boosted.state, boosted.shard_batch(batch))
+        total_loss += float(metrics["loss"])
+        n += 1
+    mean = total_loss / max(n, 1)
+    return {"loss": mean, "perplexity": math.exp(min(mean, 50.0)), "batches": n}
+
+
+def score_choices(
+    model,
+    params,
+    prompt_ids: Sequence[int],
+    choices_ids: Sequence[Sequence[int]],
+    length_normalize: bool = True,
+) -> List[float]:
+    """Log-prob score of each candidate completion after the prompt
+    (argmax = the model's answer). Pads candidates to one batch; scores
+    only completion positions."""
+    p = params["params"] if "params" in params else params
+    n = len(choices_ids)
+    plen = len(prompt_ids)
+    max_len = plen + max(len(c) for c in choices_ids)
+    ids = np.zeros((n, max_len), np.int32)
+    comp_mask = np.zeros((n, max_len), np.float32)
+    for i, comp in enumerate(choices_ids):
+        ids[i, :plen] = prompt_ids
+        ids[i, plen : plen + len(comp)] = comp
+        comp_mask[i, plen : plen + len(comp)] = 1.0
+
+    out = model.apply({"params": p}, jnp.asarray(ids))
+    lp = dist_log_prob(out.logits[:, :-1], jnp.asarray(ids)[:, 1:])
+    mask = jnp.asarray(comp_mask)[:, 1:]
+    seq_lp = (lp * mask).sum(-1)
+    if length_normalize:
+        seq_lp = seq_lp / jnp.maximum(mask.sum(-1), 1.0)
+    return [float(x) for x in seq_lp]

@@ -1,0 +1,162 @@
+"""RLHF building blocks: DPO / GRPO / PPO-style objectives on the booster.
+
+≙ reference ``applications/ColossalChat`` (DPO/GRPO/PPO trainers,
+``coati/trainer/dpo.py``, ``grpo.py``): there each trainer is a bespoke
+torch loop over actor/critic/ref models; here every objective is a plain
+``loss_fn`` for ``Booster.boost`` — the same fused, sharded train step that
+trains the base model trains the preference objective, under any plugin
+(tp/zero/pp). Reference log-probs are host-side constants carried in the
+batch, so the ref model never enters the compiled training graph.
+
+The chosen/rejected pair rides ONE forward: batches are concatenated
+[chosen; rejected] on the batch dim (≙ coati's duplicated forward, fused).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.loss import dist_log_prob
+
+
+def sequence_log_probs(logits: jax.Array, input_ids: jax.Array,
+                       loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Per-sequence summed next-token log-probs ([B, S, V], [B, S] → [B]).
+
+    ``loss_mask`` [B, S]: 1 on completion tokens (prompt tokens excluded,
+    ≙ the reference's prompt masking in DPO data collators).
+    """
+    lp = dist_log_prob(logits[:, :-1], input_ids[:, 1:])  # [B, S-1]
+    if loss_mask is None:
+        mask = jnp.ones_like(lp)
+    else:
+        mask = loss_mask[:, 1:].astype(lp.dtype)
+    return (lp * mask).sum(-1)
+
+
+def make_dpo_loss(beta: float = 0.1) -> Callable:
+    """DPO objective (≙ coati DpoLoss): batch carries the concatenated
+    [chosen; rejected] ids, a loss_mask, and precomputed ``ref_logp``."""
+
+    def loss_fn(out, batch):
+        seq_lp = sequence_log_probs(
+            out.logits, batch["input_ids"], batch.get("loss_mask")
+        )
+        b = seq_lp.shape[0] // 2
+        pol_c, pol_r = seq_lp[:b], seq_lp[b:]
+        ref = batch["ref_logp"]
+        ref_c, ref_r = ref[:b], ref[b:]
+        margin = beta * ((pol_c - ref_c) - (pol_r - ref_r))
+        return -jax.nn.log_sigmoid(margin).mean()
+
+    return loss_fn
+
+
+def grpo_advantages(rewards: jax.Array, group_size: int) -> jax.Array:
+    """Group-relative advantages (GRPO): normalize rewards within each
+    group of ``group_size`` samples of the same prompt
+    (≙ coati GRPO advantage computation)."""
+    g = rewards.reshape(-1, group_size)
+    mean = g.mean(-1, keepdims=True)
+    std = g.std(-1, keepdims=True)
+    return ((g - mean) / jnp.maximum(std, 1e-6)).reshape(-1)
+
+
+def make_grpo_loss(clip_eps: float = 0.2, kl_coef: float = 0.0) -> Callable:
+    """Clipped-surrogate policy loss with group-relative advantages
+    (GRPO ≙ coati grpo.py; with per-token values it doubles as the PPO
+    actor loss). Batch: input_ids [B,S], loss_mask, old_logp [B],
+    advantages [B], optional ref_logp [B] for the KL penalty."""
+
+    def loss_fn(out, batch):
+        seq_lp = sequence_log_probs(
+            out.logits, batch["input_ids"], batch.get("loss_mask")
+        )
+        ratio = jnp.exp(seq_lp - batch["old_logp"])
+        adv = batch["advantages"]
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
+        loss = -jnp.minimum(unclipped, clipped).mean()
+        if kl_coef > 0.0 and "ref_logp" in batch:
+            loss = loss + kl_coef * (batch["ref_logp"] - seq_lp).mean() * -1.0
+        return loss
+
+    return loss_fn
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _ref_fwd(model):
+    """One compiled reference forward per model object (jit caches are keyed
+    on the function object, so a fresh closure per call would retrace)."""
+
+    @jax.jit
+    def fwd(params, ids, mask):
+        out = model.apply({"params": params}, ids)
+        return sequence_log_probs(out.logits, ids, mask)
+
+    return fwd
+
+
+def compute_reference_logprobs(model, ref_params, batch: Dict[str, Any]) -> jax.Array:
+    """Frozen-reference per-sequence log-probs (≙ the ref-model forward
+    coati keeps on a separate device)."""
+    return _ref_fwd(model)(
+        ref_params["params"] if "params" in ref_params else ref_params,
+        batch["input_ids"], batch.get("loss_mask"),
+    )
+
+
+class DPOTrainer:
+    """Minimal end-to-end DPO loop over the booster stack
+    (≙ coati DPOTrainer._train, minus the torch engine machinery).
+
+    >>> trainer = DPOTrainer(model, optimizer, plugin, example)
+    >>> metrics = trainer.step(chosen_ids, rejected_ids, prompt_lens)
+    """
+
+    def __init__(self, model, optimizer, plugin, example_batch, *,
+                 beta: float = 0.1, rng=None):
+        from colossalai_tpu.booster import Booster
+
+        self.model = model
+        self.beta = beta
+        self.boosted = Booster(plugin=plugin).boost(
+            model, optimizer, loss_fn=make_dpo_loss(beta),
+            example_batch=example_batch, rng=rng or jax.random.PRNGKey(0),
+        )
+        # frozen reference = the initial policy (standard DPO setup).
+        # Real buffer copies: the boosted train step DONATES its state, so
+        # aliases would dangle after the first step.
+        self.ref_params = jax.tree.map(jnp.copy, self.boosted.state.params)
+
+    @staticmethod
+    def build_batch(chosen_ids, rejected_ids, prompt_lens) -> Dict[str, jax.Array]:
+        """[B,S] chosen + [B,S] rejected (+ per-pair prompt lengths) →
+        the concatenated DPO batch (ref_logp filled by the caller/step)."""
+        ids = jnp.concatenate([chosen_ids, rejected_ids], 0)
+        s = ids.shape[1]
+        pl = jnp.concatenate([prompt_lens, prompt_lens], 0)
+        mask = (jnp.arange(s)[None, :] >= pl[:, None]).astype(jnp.float32)
+        return {"input_ids": ids, "loss_mask": mask}
+
+    def step(self, chosen_ids, rejected_ids, prompt_lens) -> Dict[str, float]:
+        batch = self.build_batch(chosen_ids, rejected_ids, prompt_lens)
+        batch["ref_logp"] = compute_reference_logprobs(
+            self.model, self.ref_params, batch
+        )
+        sb = self.boosted.shard_batch(batch)
+        self.boosted.state, metrics = self.boosted.train_step(self.boosted.state, sb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def margins(self, chosen_ids, rejected_ids, prompt_lens) -> float:
+        """Mean (chosen − rejected) policy log-prob margin (reward proxy)."""
+        batch = self.build_batch(chosen_ids, rejected_ids, prompt_lens)
+        lp = compute_reference_logprobs(self.model, self.boosted.state.params, batch)
+        b = lp.shape[0] // 2
+        return float((lp[:b] - lp[b:]).mean())

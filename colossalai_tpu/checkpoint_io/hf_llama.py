@@ -19,12 +19,12 @@ from .hf_interop import params_to_hf as _params_to_hf_family
 def params_to_hf(
     params: Dict[str, Any], scanned: bool = True, vocab_size: int | None = None
 ) -> Dict[str, np.ndarray]:
-    """Our llama param tree → HF-named state dict (numpy)."""
-    if scanned:
-        return _params_to_hf_family(params, "llama", vocab_size=vocab_size)
-    # unrolled layers_{i} layout: restack into the scanned form first
+    """Our llama param tree → HF-named state dict (numpy). Falls back to
+    the unrolled layers_{i} layout when no scanned stack is present."""
     p = dict(params["params"] if "params" in params else params)
-    stacked: Dict[str, Any] = {}
+    if scanned and "layers" in p:
+        return _params_to_hf_family(p, "llama", vocab_size=vocab_size)
+    # unrolled layers_{i} layout (or top-only tree): restack first
     i = 0
     layers = []
     while f"layers_{i}" in p:

@@ -1,0 +1,94 @@
+"""Applications layer: DPO training moves preference margins, GRPO math,
+eval harness (≙ ColossalChat/ColossalEval smoke coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.applications import (
+    DPOTrainer,
+    evaluate_perplexity,
+    grpo_advantages,
+    make_grpo_loss,
+    score_choices,
+    sequence_log_probs,
+)
+from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def pref_data():
+    cfg = LlamaConfig.tiny()
+    key = jax.random.PRNGKey(0)
+    kc, kr = jax.random.split(key)
+    chosen = jax.random.randint(kc, (4, 16), 0, cfg.vocab_size)
+    rejected = jax.random.randint(kr, (4, 16), 0, cfg.vocab_size)
+    prompt_lens = jnp.full((4,), 4, jnp.int32)
+    return cfg, chosen, rejected, prompt_lens
+
+
+@pytest.mark.slow
+def test_dpo_increases_preference_margin(pref_data):
+    cfg, chosen, rejected, plens = pref_data
+    model = LlamaForCausalLM(cfg)
+    example = DPOTrainer.build_batch(chosen, rejected, plens)
+    example["ref_logp"] = jnp.zeros((8,), jnp.float32)
+    trainer = DPOTrainer(
+        model, optax.adamw(5e-3),
+        HybridParallelPlugin(tp_size=2, precision="fp32"), example,
+    )
+    m0 = trainer.margins(chosen, rejected, plens)
+    losses = [trainer.step(chosen, rejected, plens)["loss"] for _ in range(5)]
+    m1 = trainer.margins(chosen, rejected, plens)
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+    assert m1 > m0, (m0, m1)  # chosen completions became more likely
+
+
+def test_grpo_advantages_normalize_per_group():
+    r = jnp.asarray([1.0, 2.0, 3.0, 10.0, 20.0, 30.0])
+    adv = grpo_advantages(r, group_size=3)
+    a = np.asarray(adv).reshape(2, 3)
+    np.testing.assert_allclose(a.mean(1), 0.0, atol=1e-6)
+    # identical ranking pattern in both groups despite scale difference
+    np.testing.assert_allclose(a[0], a[1], atol=1e-5)
+
+
+def test_grpo_loss_runs_and_clips(pref_data):
+    cfg, chosen, _, plens = pref_data
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(1), chosen)
+    out = model.apply(params, chosen)
+    mask = (jnp.arange(16)[None, :] >= plens[:, None]).astype(jnp.float32)
+    lp = sequence_log_probs(out.logits, chosen, mask)
+    batch = {
+        "input_ids": chosen, "loss_mask": mask, "old_logp": lp,
+        "advantages": jnp.asarray([1.0, -1.0, 0.5, -0.5]),
+    }
+    loss = make_grpo_loss(clip_eps=0.2)(out, batch)
+    # at ratio == 1 the surrogate is exactly -mean(adv)
+    np.testing.assert_allclose(float(loss), 0.0, atol=1e-5)
+
+
+def test_eval_harness(pref_data):
+    cfg, chosen, rejected, _ = pref_data
+    ids = jnp.concatenate([chosen, rejected], 0)  # dp=8 mesh wants 8 rows
+    model = LlamaForCausalLM(cfg)
+    b = Booster(plugin=DataParallelPlugin(precision="fp32")).boost(
+        model, optax.sgd(1e-1), example_batch={"input_ids": ids},
+        rng=jax.random.PRNGKey(0),
+    )
+    before = evaluate_perplexity(b, [{"input_ids": ids}])
+    for _ in range(5):
+        b.state, _ = b.train_step(b.state, b.shard_batch({"input_ids": ids}))
+    after = evaluate_perplexity(b, [{"input_ids": ids}])
+    assert after["perplexity"] < before["perplexity"]
+
+    scores = score_choices(
+        model, b.state.params, prompt_ids=[1, 2, 3],
+        choices_ids=[[4, 5], [6, 7, 8], [9]],
+    )
+    assert len(scores) == 3 and all(np.isfinite(scores))
