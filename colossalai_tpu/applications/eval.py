@@ -19,13 +19,18 @@ from colossalai_tpu.shardformer.layer.loss import dist_log_prob
 
 
 def evaluate_perplexity(boosted, batches: Iterable[Dict[str, Any]]) -> Dict[str, float]:
-    """Corpus perplexity via the boosted eval_step (any parallel config)."""
-    total_loss, n = 0.0, 0
+    """Corpus perplexity via the boosted eval_step (any parallel config).
+
+    Batch losses are weighted by token count so ragged final batches do not
+    bias the corpus mean (mean-of-means would)."""
+    total_loss, total_tokens, n = 0.0, 0, 0
     for batch in batches:
         metrics = boosted.eval_step(boosted.state, boosted.shard_batch(batch))
-        total_loss += float(metrics["loss"])
+        tokens = int(np.prod(batch["input_ids"].shape))
+        total_loss += float(metrics["loss"]) * tokens
+        total_tokens += tokens
         n += 1
-    mean = total_loss / max(n, 1)
+    mean = total_loss / max(total_tokens, 1)
     return {"loss": mean, "perplexity": math.exp(min(mean, 50.0)), "batches": n}
 
 

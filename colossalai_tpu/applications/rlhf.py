@@ -14,6 +14,7 @@ The chosen/rejected pair rides ONE forward: batches are concatenated
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -81,13 +82,11 @@ def make_grpo_loss(clip_eps: float = 0.2, kl_coef: float = 0.0) -> Callable:
         clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
         loss = -jnp.minimum(unclipped, clipped).mean()
         if kl_coef > 0.0 and "ref_logp" in batch:
-            loss = loss + kl_coef * (batch["ref_logp"] - seq_lp).mean() * -1.0
+            # k1 estimator of KL(policy || ref)
+            loss = loss + kl_coef * (seq_lp - batch["ref_logp"]).mean()
         return loss
 
     return loss_fn
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=8)
@@ -124,39 +123,68 @@ class DPOTrainer:
                  beta: float = 0.1, rng=None):
         from colossalai_tpu.booster import Booster
 
-        self.model = model
         self.beta = beta
+        example_batch = dict(example_batch)
+        # the loss is traced against the example batch; the placeholder is
+        # replaced with real reference log-probs every step()
+        example_batch.setdefault(
+            "ref_logp",
+            jnp.zeros((example_batch["input_ids"].shape[0],), jnp.float32),
+        )
         self.boosted = Booster(plugin=plugin).boost(
             model, optimizer, loss_fn=make_dpo_loss(beta),
             example_batch=example_batch, rng=rng or jax.random.PRNGKey(0),
         )
+        # the BOOSTED model (precision-cast, plugin-modified — e.g. padded
+        # vocab) must run the reference forward too, or ref_logp comes from
+        # a different function than the policy forward
+        self.model = self.boosted.model
         # frozen reference = the initial policy (standard DPO setup).
         # Real buffer copies: the boosted train step DONATES its state, so
         # aliases would dangle after the first step.
         self.ref_params = jax.tree.map(jnp.copy, self.boosted.state.params)
 
     @staticmethod
-    def build_batch(chosen_ids, rejected_ids, prompt_lens) -> Dict[str, jax.Array]:
+    def build_batch(chosen_ids, rejected_ids, prompt_lens,
+                    total_lens=None) -> Dict[str, jax.Array]:
         """[B,S] chosen + [B,S] rejected (+ per-pair prompt lengths) →
-        the concatenated DPO batch (ref_logp filled by the caller/step)."""
+        the concatenated DPO batch.
+
+        ``total_lens``: per-sequence (prompt+completion) lengths for BOTH
+        halves, [2B] or a (chosen, rejected) pair of [B] — ragged pairs must
+        exclude their right padding from the mask (≙ coati collators mask
+        prompt AND padding)."""
         ids = jnp.concatenate([chosen_ids, rejected_ids], 0)
         s = ids.shape[1]
         pl = jnp.concatenate([prompt_lens, prompt_lens], 0)
-        mask = (jnp.arange(s)[None, :] >= pl[:, None]).astype(jnp.float32)
+        pos = jnp.arange(s)[None, :]
+        mask = (pos >= pl[:, None]).astype(jnp.float32)
+        if total_lens is not None:
+            if isinstance(total_lens, (tuple, list)):
+                total_lens = jnp.concatenate(
+                    [jnp.asarray(total_lens[0]), jnp.asarray(total_lens[1])], 0
+                )
+            mask = mask * (pos < total_lens[:, None]).astype(jnp.float32)
         return {"input_ids": ids, "loss_mask": mask}
 
-    def step(self, chosen_ids, rejected_ids, prompt_lens) -> Dict[str, float]:
-        batch = self.build_batch(chosen_ids, rejected_ids, prompt_lens)
-        batch["ref_logp"] = compute_reference_logprobs(
-            self.model, self.ref_params, batch
-        )
+    def _ref_logp(self, params, batch):
+        from colossalai_tpu.tensor import use_mesh
+
+        with use_mesh(self.boosted.mesh):
+            return compute_reference_logprobs(self.model, params, batch)
+
+    def step(self, chosen_ids, rejected_ids, prompt_lens,
+             total_lens=None) -> Dict[str, float]:
+        batch = self.build_batch(chosen_ids, rejected_ids, prompt_lens, total_lens)
+        batch["ref_logp"] = self._ref_logp(self.ref_params, batch)
         sb = self.boosted.shard_batch(batch)
         self.boosted.state, metrics = self.boosted.train_step(self.boosted.state, sb)
         return {k: float(v) for k, v in metrics.items()}
 
-    def margins(self, chosen_ids, rejected_ids, prompt_lens) -> float:
+    def margins(self, chosen_ids, rejected_ids, prompt_lens,
+                total_lens=None) -> float:
         """Mean (chosen − rejected) policy log-prob margin (reward proxy)."""
-        batch = self.build_batch(chosen_ids, rejected_ids, prompt_lens)
-        lp = compute_reference_logprobs(self.model, self.boosted.state.params, batch)
+        batch = self.build_batch(chosen_ids, rejected_ids, prompt_lens, total_lens)
+        lp = self._ref_logp(self.boosted.state.params, batch)
         b = lp.shape[0] // 2
         return float((lp[:b] - lp[b:]).mean())
