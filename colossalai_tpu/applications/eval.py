@@ -26,7 +26,13 @@ def evaluate_perplexity(boosted, batches: Iterable[Dict[str, Any]]) -> Dict[str,
     total_loss, total_tokens, n = 0.0, 0, 0
     for batch in batches:
         metrics = boosted.eval_step(boosted.state, boosted.shard_batch(batch))
-        tokens = int(np.prod(batch["input_ids"].shape))
+        # weight by VALID token count — the step's loss is a mean over
+        # non-ignored positions, not over the padded shape
+        if "labels" in batch:
+            tokens = int(np.sum(np.asarray(batch["labels"]) != -100))
+        else:
+            b, s_len = batch["input_ids"].shape[:2]
+            tokens = b * (s_len - 1)  # next-token shift drops one per row
         total_loss += float(metrics["loss"]) * tokens
         total_tokens += tokens
         n += 1

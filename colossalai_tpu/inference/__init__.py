@@ -6,9 +6,10 @@ from .kv_cache import (
     SequenceTable,
     init_paged_cache,
 )
-from .modeling import KVCache, decode_step, init_cache, prefill
+from .modeling import KVCache, decode_step, extend_step, init_cache, prefill
 from .paged_modeling import decode_paged, prefill_paged
 from .server import make_server
+from .speculative import SpeculativeEngine, SpecStats
 
 __all__ = [
     "GenerationConfig",
@@ -26,4 +27,7 @@ __all__ = [
     "decode_paged",
     "prefill_paged",
     "make_server",
+    "extend_step",
+    "SpeculativeEngine",
+    "SpecStats",
 ]

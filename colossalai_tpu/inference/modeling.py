@@ -143,6 +143,56 @@ def prefill(params, cfg: LlamaConfig, input_ids, cache: KVCache, slot_lengths) -
     return last, KVCache(k=k_new, v=v_new, lengths=slot_lengths)
 
 
+def _extend_impl(params, cfg: LlamaConfig, tokens, cache: KVCache):
+    """Shared cache-extend forward: tokens [B, K] → (logits [B, K, V],
+    cache with K new positions written). decode_step is the K=1 special
+    case; extend_step the speculative verification window."""
+    p = params["params"] if "params" in params else params
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    k = tokens.shape[1]
+    positions = cache.lengths[:, None] + jnp.arange(k)[None, :]  # [B, K]
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[tokens]  # [B, K, H]
+    s_max = cache.k.shape[2]
+    valid = jnp.arange(s_max)[None, :] < (cache.lengths[:, None] + k)
+
+    def write_at(cache_l, new):  # [B,S_max,...] <- [B,K,...] at per-row lengths
+        return jax.vmap(
+            lambda c, n_, i: jax.lax.dynamic_update_slice(c, n_, (i, 0, 0))
+        )(cache_l, new, cache.lengths)
+
+    def layer(x, inputs):
+        layer_params, k_all, v_all = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k_new, v_new = _project_kv(cfg, layer_params, h, positions)
+        k_l = write_at(k_all, k_new)
+        v_l = write_at(v_all, v_new)
+        x = _block_step(cfg, layer_params, x, k_l, v_l, positions, valid)
+        return x, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x.astype(dtype), (stacked, cache.k, cache.v)
+    )
+
+    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    else:
+        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    return logits, k_new, v_new
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def extend_step(params, cfg: LlamaConfig, tokens, cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """Score K tokens per slot in ONE forward: tokens [B, K] →
+    logits [B, K, V], cache advanced by K — the verification pass of
+    speculative decoding (≙ llm_engine.py:301: the target model scores the
+    whole draft window at once)."""
+    logits, k_new, v_new = _extend_impl(params, cfg, tokens, cache)
+    return logits, KVCache(k=k_new, v=v_new, lengths=cache.lengths + tokens.shape[1])
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def decode_step(
     params, cfg: LlamaConfig, tokens, cache: KVCache, active=None
@@ -152,40 +202,6 @@ def decode_step(
     ``active`` ([B] bool) freezes idle slots: their lengths do not advance,
     so a free slot's stale cache rows are never progressively marked valid
     and lengths can't creep past S_max while the slot sits empty."""
-    p = params["params"] if "params" in params else params
-    stacked = p["layers"]["block"]
-    dtype = cfg.dtype or jnp.bfloat16
-    b = tokens.shape[0]
-    positions = cache.lengths[:, None]  # [B, 1]
-
-    x = p["embed_tokens"]["embedding"].astype(dtype)[tokens][:, None, :]  # [B,1,H]
-    s_max = cache.k.shape[2]
-    valid = jnp.arange(s_max)[None, :] <= cache.lengths[:, None]  # includes new token
-
-    def write_at(cache_l, new):  # [B,S_max,...] <- [B,1,...] at per-row lengths
-        idx = cache.lengths  # [B]
-        return jax.vmap(
-            lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0))
-        )(cache_l, new, idx)
-
-    def layer(carry, inputs):
-        x, i = carry
-        layer_params, k_all, v_all = inputs
-        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        k, v = _project_kv(cfg, layer_params, h, positions)
-        k_l = write_at(k_all, k)
-        v_l = write_at(v_all, v)
-        x = _block_step(cfg, layer_params, x, k_l, v_l, positions, valid)
-        return (x, i + 1), (k_l, v_l)
-
-    (x, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
-    )
-
-    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
-    else:
-        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    logits, k_new, v_new = _extend_impl(params, cfg, tokens[:, None], cache)
     advance = 1 if active is None else active.astype(jnp.int32)
     return logits[:, 0], KVCache(k=k_new, v=v_new, lengths=cache.lengths + advance)

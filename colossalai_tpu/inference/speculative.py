@@ -1,0 +1,132 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+≙ reference ``inference/core/llm_engine.py:301-495`` (enable_spec_dec /
+SpeculativeDecoding with a drafter model, ≙ spec/ GlideDrafter). Greedy
+variant: output is IDENTICAL to target-only greedy decoding (the test
+invariant); the win is wall-clock — the target scores a whole K-token
+draft window in ONE forward (``extend_step``) and accepts the matching
+prefix, so ~(accepted+1) tokens emerge per target pass.
+
+Slot-cache rollback is free on TPU: writes land at position ``lengths``
+and reads mask by it, so rejecting draft tokens = decrementing a length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .modeling import KVCache, decode_step, extend_step, init_cache, prefill
+
+
+@dataclasses.dataclass
+class SpecStats:
+    target_passes: int = 0
+    draft_tokens: int = 0
+    accepted_tokens: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted_tokens / max(self.draft_tokens, 1)
+
+    @property
+    def tokens_per_target_pass(self) -> float:
+        # every pass emits accepted + 1 correction token
+        return (self.accepted_tokens + self.target_passes) / max(self.target_passes, 1)
+
+
+class SpeculativeEngine:
+    """Greedy speculative generation over (draft, target) llama models.
+
+    Both models share the tokenizer/vocab; the draft is typically a few
+    layers of the target or a small distilled model
+    (≙ engine.enable_spec_dec(drafter)).
+    """
+
+    def __init__(self, target_params, target_cfg, draft_params, draft_cfg,
+                 max_seq_len: int = 1024, num_speculative_tokens: int = 4):
+        self.tp, self.tc = target_params, target_cfg
+        self.dp, self.dc = draft_params, draft_cfg
+        self.max_seq = max_seq_len
+        self.k = num_speculative_tokens
+        self.stats = SpecStats()
+
+    def _rollback(self, cache: KVCache, to_length: int) -> KVCache:
+        return KVCache(k=cache.k, v=cache.v,
+                       lengths=jnp.full_like(cache.lengths, to_length))
+
+    def generate(self, prompt_ids: List[int], max_new_tokens: int = 64,
+                 eos_token_id: Optional[int] = None) -> List[int]:
+        n = len(prompt_ids)
+        if n >= self.max_seq:
+            raise ValueError(f"prompt length {n} >= max_seq_len {self.max_seq}")
+        pad = min(1 << (n - 1).bit_length(), self.max_seq)  # pow2 bucket, clamped
+        ids = np.zeros((1, pad), np.int32)
+        ids[0, :n] = prompt_ids
+        lens = jnp.asarray([n], jnp.int32)
+
+        t_cache = init_cache(self.tc, 1, self.max_seq)
+        d_cache = init_cache(self.dc, 1, self.max_seq)
+        t_logits, t_cache = prefill(self.tp, self.tc, jnp.asarray(ids), t_cache, lens)
+        _, d_cache = prefill(self.dp, self.dc, jnp.asarray(ids), d_cache, lens)
+
+        out: List[int] = [int(jnp.argmax(t_logits[0]))]
+        active = jnp.asarray([True])
+
+        while len(out) < max_new_tokens:
+            if eos_token_id is not None and out[-1] == eos_token_id:
+                break
+            base_len = int(np.asarray(t_cache.lengths)[0])
+            k = min(self.k, self.max_seq - base_len - 2, max_new_tokens - len(out))
+            if k <= 0:
+                break
+
+            # ---- draft proposes k tokens (cheap sequential decodes)
+            drafts: List[int] = []
+            tok = out[-1]
+            for _ in range(k):
+                d_logits, d_cache = decode_step(
+                    self.dp, self.dc, jnp.asarray([tok], jnp.int32), d_cache, active
+                )
+                tok = int(jnp.argmax(d_logits[0]))
+                drafts.append(tok)
+
+            # ---- target scores [last_accepted, d_1..d_k] in one pass
+            window = jnp.asarray([[out[-1]] + drafts], jnp.int32)
+            t_logits, t_cache = extend_step(self.tp, self.tc, window, t_cache)
+            targets = np.asarray(jnp.argmax(t_logits[0], axis=-1))  # [k+1]
+
+            accepted = 0
+            while accepted < k and targets[accepted] == drafts[accepted]:
+                accepted += 1
+            emitted = drafts[:accepted] + [int(targets[accepted])]
+            out.extend(emitted)
+            self.stats.target_passes += 1
+            self.stats.draft_tokens += k
+            self.stats.accepted_tokens += accepted
+
+            # ---- roll caches back to the accepted frontier. Target wrote
+            # k+1 positions; only base_len + accepted + 1 are real. The
+            # correction token itself is NOT yet in either cache — it is the
+            # next window's first entry.
+            if accepted == k:
+                # full acceptance: the draft cache lacks d_k (it was the
+                # draft's last OUTPUT, never fed back) — write it, or the
+                # next round would leave a garbage hole at that position
+                _, d_cache = decode_step(
+                    self.dp, self.dc, jnp.asarray([drafts[-1]], jnp.int32),
+                    d_cache, active,
+                )
+            new_len = base_len + accepted + 1
+            t_cache = self._rollback(t_cache, new_len)
+            d_cache = self._rollback(d_cache, new_len)
+            if eos_token_id is not None and eos_token_id in emitted:
+                cut = len(out) - len(emitted) + emitted.index(eos_token_id) + 1
+                out = out[:cut]
+                break
+
+        return out[:max_new_tokens]

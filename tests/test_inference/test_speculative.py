@@ -1,0 +1,69 @@
+"""Speculative decoding (≙ llm_engine.py:301 spec-dec tests): greedy
+spec output must EQUAL target-only greedy output, for any draft model —
+including a bad one (only speed, never content, may change)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference.modeling import decode_step, init_cache, prefill
+from colossalai_tpu.inference.speculative import SpeculativeEngine
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def models():
+    import dataclasses
+
+    tc = LlamaConfig.tiny()
+    dc = dataclasses.replace(tc, num_hidden_layers=1)
+    target = LlamaForCausalLM(tc)
+    draft = LlamaForCausalLM(dc)
+    ids = jnp.ones((1, 8), jnp.int32)
+    tp = target.init(jax.random.PRNGKey(0), ids)
+    dp = draft.init(jax.random.PRNGKey(1), ids)
+    return tp, tc, dp, dc
+
+
+def _target_greedy(tp, tc, prompt, n):
+    """Slot-cache greedy loop — the SAME kernel family extend_step uses, so
+    the bit-equality invariant is well-defined (the paged engine's kernels
+    may differ by a ULP at argmax near-ties)."""
+    cache = init_cache(tc, 1, 128)
+    ids = np.zeros((1, 16), np.int32)
+    ids[0, : len(prompt)] = prompt
+    logits, cache = prefill(tp, tc, jnp.asarray(ids), cache,
+                            jnp.asarray([len(prompt)], jnp.int32))
+    out = [int(jnp.argmax(logits[0]))]
+    for _ in range(n - 1):
+        logits, cache = decode_step(tp, tc, jnp.asarray([out[-1]], jnp.int32),
+                                    cache, jnp.asarray([True]))
+        out.append(int(jnp.argmax(logits[0])))
+    return out
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_spec_matches_target_greedy(models, k):
+    tp, tc, dp, dc = models
+    prompt = [3, 14, 15, 9, 2, 6]
+    ref = _target_greedy(tp, tc, prompt, 12)
+    spec = SpeculativeEngine(tp, tc, dp, dc, max_seq_len=128,
+                             num_speculative_tokens=k)
+    out = spec.generate(prompt, max_new_tokens=12)
+    assert out == ref, (k, out, ref)
+    assert spec.stats.target_passes > 0
+
+
+def test_self_draft_accepts_everything(models):
+    """Draft == target ⇒ every proposal accepted: the acceptance-rate
+    telemetry and the ~k+1 tokens/pass speedup accounting must show it."""
+    tp, tc, _, _ = models
+    spec = SpeculativeEngine(tp, tc, tp, tc, max_seq_len=128,
+                             num_speculative_tokens=4)
+    prompt = [3, 14, 15, 9, 2, 6]
+    ref = _target_greedy(tp, tc, prompt, 12)
+    out = spec.generate(prompt, max_new_tokens=12)
+    assert out == ref
+    assert spec.stats.acceptance_rate == 1.0
+    assert spec.stats.tokens_per_target_pass == pytest.approx(5.0, abs=1.0)
