@@ -159,8 +159,19 @@ class Plugin(abc.ABC):
             mesh,
             shard_over_data=(self.zero_stage >= 1 and not self.fsdp),
         )
+        offload_optim = getattr(self, "offload_optim", False)
+        if getattr(self, "placement_policy", "static") == "auto" and not offload_optim:
+            # ≙ AutoPlacementPolicy (zero/gemini/placement_policy.py:128):
+            # there, a runtime mem tracer steers per-chunk placement; here
+            # the decision is made once from the traced state sizes vs HBM —
+            # offload optimizer states when the resident state would crowd
+            # out the working set.
+            offload_optim = _auto_offload_decision(
+                params_shape["params"], param_specs, opt_state_shape, opt_specs, mesh
+            )
+
         opt_memory_kind = None
-        if getattr(self, "offload_optim", False):
+        if offload_optim:
             # host-offloaded optimizer states (≙ HybridAdam/Gemini offload):
             # states live in pinned host memory; XLA streams them through the
             # update. Probe with a real jitted transfer — some backends accept
@@ -360,6 +371,47 @@ class Plugin(abc.ABC):
 
 
 # ---------------------------------------------------------------- utilities
+
+
+def _sharded_bytes(shapes, specs, mesh_shape) -> int:
+    """Per-device bytes of a pytree given its PartitionSpecs."""
+    import math
+
+    total = 0
+    flat_shapes = jax.tree_util.tree_leaves(shapes)
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    for shp, spec in zip(flat_shapes, flat_specs):
+        nbytes = math.prod(shp.shape) * jnp.dtype(shp.dtype).itemsize if shp.shape else jnp.dtype(shp.dtype).itemsize
+        div = 1
+        for entry in spec:
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax is not None:
+                    div *= mesh_shape.get(ax, 1)
+        total += nbytes // max(div, 1)
+    return total
+
+
+def _auto_offload_decision(params_shape, param_specs, opt_state_shape, opt_specs, mesh) -> bool:
+    """True when resident params+opt-state would exceed ~60% of HBM,
+    leaving too little for grads + activations."""
+    from colossalai_tpu.accelerator import get_accelerator
+    from colossalai_tpu.logging import get_dist_logger
+
+    hbm = get_accelerator().hbm_bytes_per_device()
+    if not hbm:
+        return False
+    mesh_shape = dict(mesh.mesh.shape)
+    p_bytes = _sharded_bytes(params_shape, param_specs, mesh_shape)
+    o_bytes = _sharded_bytes(opt_state_shape, opt_specs, mesh_shape)
+    offload = (p_bytes + o_bytes) > 0.6 * hbm
+    get_dist_logger().info(
+        f"auto placement: params {p_bytes / 1e9:.2f} GB + opt state "
+        f"{o_bytes / 1e9:.2f} GB per device vs {hbm / 1e9:.1f} GB HBM -> "
+        f"{'HOST offload' if offload else 'device'} optimizer states"
+    )
+    return offload
 
 
 def _warn_if_hf_label_convention(batch) -> None:

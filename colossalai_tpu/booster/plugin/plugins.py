@@ -62,13 +62,16 @@ class GeminiPlugin(Plugin):
 
     ``offload_optim``: place optimizer state in host memory
     (≙ Gemini placement policy offload fractions); requires a runtime with
-    host memory spaces.
+    host memory spaces. ``placement_policy="auto"`` decides it from the
+    traced state sizes vs HBM (≙ AutoPlacementPolicy, placement_policy.py:128).
     """
 
     precision: str = "bf16"
     max_norm: float = 0.0
     grad_accum_steps: int = 1
     offload_optim: bool = False
+    #: "static" (respect offload_optim as given) | "auto" (size-driven)
+    placement_policy: str = "static"
     zero_stage: int = 1
     fsdp: bool = True
     #: all-gather fsdp-sharded params as fp8 (+ scale) in the forward

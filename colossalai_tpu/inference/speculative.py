@@ -2,10 +2,12 @@
 
 ≙ reference ``inference/core/llm_engine.py:301-495`` (enable_spec_dec /
 SpeculativeDecoding with a drafter model, ≙ spec/ GlideDrafter). Greedy
-variant: output is IDENTICAL to target-only greedy decoding (the test
-invariant); the win is wall-clock — the target scores a whole K-token
-draft window in ONE forward (``extend_step``) and accepts the matching
-prefix, so ~(accepted+1) tokens emerge per target pass.
+variant: output matches target-only greedy decoding exactly whenever the
+two paths' logits agree bitwise (guaranteed on the CPU test mesh; on TPU
+differently-shaped compiled forwards may differ by a ULP at argmax
+near-ties). The win is wall-clock — the target scores a whole K-token
+draft window in ONE fixed-shape forward (``extend_step``) and accepts the
+matching prefix, so ~(accepted+1) tokens emerge per target pass.
 
 Slot-cache rollback is free on TPU: writes land at position ``lengths``
 and reads mask by it, so rejecting draft tokens = decrementing a length.
@@ -81,8 +83,20 @@ class SpeculativeEngine:
             if eos_token_id is not None and out[-1] == eos_token_id:
                 break
             base_len = int(np.asarray(t_cache.lengths)[0])
-            k = min(self.k, self.max_seq - base_len - 2, max_new_tokens - len(out))
-            if k <= 0:
+            k = min(self.k, max_new_tokens - len(out))
+            if base_len + self.k + 1 > self.max_seq or k <= 0:
+                # near the context end the fixed window no longer fits:
+                # finish with plain single-token decodes (never silently
+                # truncate the completion)
+                while len(out) < max_new_tokens and base_len < self.max_seq - 1:
+                    t_logits1, t_cache = decode_step(
+                        self.tp, self.tc, jnp.asarray([out[-1]], jnp.int32),
+                        t_cache, active,
+                    )
+                    out.append(int(jnp.argmax(t_logits1[0])))
+                    base_len += 1
+                    if eos_token_id is not None and out[-1] == eos_token_id:
+                        break
                 break
 
             # ---- draft proposes k tokens (cheap sequential decodes)
@@ -95,10 +109,14 @@ class SpeculativeEngine:
                 tok = int(jnp.argmax(d_logits[0]))
                 drafts.append(tok)
 
-            # ---- target scores [last_accepted, d_1..d_k] in one pass
-            window = jnp.asarray([[out[-1]] + drafts], jnp.int32)
+            # ---- target scores [last_accepted, d_1..d_k] in one pass.
+            # FIXED window width self.k+1 (padded when k shrank near the
+            # token budget) so exactly ONE compiled program exists —
+            # otherwise every distinct k recompiles the full target model.
+            padded = drafts + [0] * (self.k - k)
+            window = jnp.asarray([[out[-1]] + padded], jnp.int32)
             t_logits, t_cache = extend_step(self.tp, self.tc, window, t_cache)
-            targets = np.asarray(jnp.argmax(t_logits[0], axis=-1))  # [k+1]
+            targets = np.asarray(jnp.argmax(t_logits[0], axis=-1))  # [K+1]
 
             accepted = 0
             while accepted < k and targets[accepted] == drafts[accepted]:

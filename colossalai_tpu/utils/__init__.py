@@ -5,6 +5,7 @@ from .performance_evaluator import (
     count_params,
     peak_flops_per_device,
 )
+from .profiler import annotate, profile, step_annotation
 
 __all__ = [
     "TokenDataLoader",
@@ -13,4 +14,7 @@ __all__ = [
     "causal_lm_flops_per_token",
     "count_params",
     "peak_flops_per_device",
+    "annotate",
+    "profile",
+    "step_annotation",
 ]

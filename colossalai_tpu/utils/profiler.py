@@ -1,0 +1,44 @@
+"""Profiling / tracing integration.
+
+≙ the reference's tracing subsystem (SURVEY §5: torch.profiler wrappers in
+examples + memory tracer): on TPU the native story is ``jax.profiler`` —
+XLA-level traces viewable in TensorBoard/XProf/Perfetto, with named step
+and op annotations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Trace everything in the block into ``log_dir``.
+
+    >>> with profile("/tmp/trace"):
+    ...     for i in range(3):
+    ...         with step_annotation(i):
+    ...             state, m = boosted.train_step(state, batch)
+    ...         float(m["loss"])   # sync INSIDE the trace on tunneled TPUs
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def step_annotation(step: int) -> Iterator[None]:
+    """Mark one training step in the trace (≙ torch.profiler.step())."""
+    with jax.profiler.StepTraceAnnotation("train_step", step_num=step):
+        yield
+
+
+def annotate(name: str):
+    """Named region inside a trace — context manager or decorator
+    (≙ torch.profiler.record_function)."""
+    return jax.profiler.TraceAnnotation(name)

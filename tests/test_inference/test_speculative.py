@@ -55,6 +55,19 @@ def test_spec_matches_target_greedy(models, k):
     assert spec.stats.target_passes > 0
 
 
+def test_context_end_falls_back_to_plain_decode(models):
+    """Near max_seq the fixed window no longer fits: generation must finish
+    with single-token decodes, not silently truncate."""
+    tp, tc, dp, dc = models
+    prompt = [3, 14, 15, 9, 2, 6, 7, 8, 9, 10, 11, 12]  # 12 of 24
+    spec = SpeculativeEngine(tp, tc, dp, dc, max_seq_len=24,
+                             num_speculative_tokens=4)
+    out = spec.generate(prompt, max_new_tokens=16)
+    # positions 12..22 are writable → 11 cached tokens after the prompt,
+    # plus the final prediction never cached
+    assert len(out) >= 10, out
+
+
 def test_self_draft_accepts_everything(models):
     """Draft == target ⇒ every proposal accepted: the acceptance-rate
     telemetry and the ~k+1 tokens/pass speedup accounting must show it."""
