@@ -79,6 +79,10 @@ class GeminiPlugin(Plugin):
     fp8_communication: bool = False
 
     def __post_init__(self):
+        if self.placement_policy not in ("static", "auto"):
+            raise ValueError(
+                f"placement_policy={self.placement_policy!r} not in ('static', 'auto')"
+            )
         if self.fp8_communication and not self.fsdp:
             raise ValueError(
                 "fp8_communication compresses the fsdp param all-gathers; "

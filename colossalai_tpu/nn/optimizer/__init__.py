@@ -148,7 +148,11 @@ def came(
 
 DistributedCAME = came
 
+from .disk_offload import DiskOffloadedAdamW, DiskTensorStore
+
 __all__ = [
+    "DiskOffloadedAdamW",
+    "DiskTensorStore",
     "FusedAdam",
     "FusedAdamW",
     "FusedSGD",

@@ -1,0 +1,183 @@
+// Async disk tensor store: the NVMe offload tier.
+//
+// Capability analog of the reference's tensornvme extension
+// (colossalai/nn/optimizer/nvme_optimizer.py backend): optimizer states too
+// large for HBM+RAM live in a file; writes are queued to a background
+// thread (overlapping the next parameter's update), reads block only on
+// that key's pending writes.
+//
+// C API (ctypes-friendly): ts_open / ts_put / ts_get / ts_flush /
+// ts_bytes / ts_close. Keys are caller-assigned int64 ids; the store
+// allocates file extents on first put and requires a stable size per key.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pending {
+  int64_t key;
+  std::vector<char> data;
+  off_t offset;
+};
+
+struct Store {
+  int fd = -1;
+  off_t tail = 0;  // next free byte
+  std::unordered_map<int64_t, std::pair<off_t, size_t>> extents;
+  std::unordered_map<int64_t, int> pending_count;
+
+  std::deque<Pending> queue;
+  size_t queued_bytes = 0;
+  // producer blocks above this much in-flight data: peak host RAM stays
+  // O(cap), not O(total state) — the point of the disk tier
+  size_t max_queued_bytes = 64ull << 20;
+  bool io_error = false;
+  std::mutex mu;
+  std::condition_variable cv_push;   // producer -> worker
+  std::condition_variable cv_drain;  // worker -> waiters/producer
+  bool stop = false;
+  std::thread worker;
+
+  void run() {
+    for (;;) {
+      Pending job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_push.wait(lk, [&] { return stop || !queue.empty(); });
+        if (queue.empty()) {
+          if (stop) return;
+          continue;
+        }
+        job = std::move(queue.front());
+        queue.pop_front();
+      }
+      size_t done = 0;
+      while (done < job.data.size()) {
+        ssize_t n = pwrite(fd, job.data.data() + done, job.data.size() - done,
+                           job.offset + (off_t)done);
+        if (n <= 0) break;
+        done += (size_t)n;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (done < job.data.size()) io_error = true;  // sticky: surfaced by
+        if (--pending_count[job.key] == 0) pending_count.erase(job.key);  // get/flush
+        queued_bytes -= job.data.size();
+        cv_drain.notify_all();
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_open(const char* path) {
+  auto* s = new Store();
+  s->fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (s->fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  s->worker = std::thread([s] { s->run(); });
+  return s;
+}
+
+// Queue an async write of `nbytes` for `key`. Returns 0 on success,
+// -1 if the key was previously put with a different size.
+int ts_put(void* h, int64_t key, const void* ptr, int64_t nbytes) {
+  auto* s = static_cast<Store*>(h);
+  Pending job;
+  job.key = key;
+  job.data.assign((const char*)ptr, (const char*)ptr + nbytes);
+  {
+    std::unique_lock<std::mutex> lk(s->mu);
+    auto it = s->extents.find(key);
+    if (it == s->extents.end()) {
+      s->extents[key] = {s->tail, (size_t)nbytes};
+      job.offset = s->tail;
+      s->tail += nbytes;
+    } else {
+      if (it->second.second != (size_t)nbytes) return -1;
+      job.offset = it->second.first;
+    }
+    // backpressure: keep in-flight bytes bounded
+    s->cv_drain.wait(lk, [&] {
+      return s->queued_bytes + (size_t)nbytes <= s->max_queued_bytes ||
+             s->queue.empty();
+    });
+    s->pending_count[key]++;
+    s->queued_bytes += (size_t)nbytes;
+    s->queue.push_back(std::move(job));
+    s->cv_push.notify_one();
+  }
+  return 0;
+}
+
+// Blocking read: waits for this key's pending writes, then preads.
+// Returns 0 on success, -1 on unknown key / size mismatch / IO error.
+int ts_get(void* h, int64_t key, void* ptr, int64_t nbytes) {
+  auto* s = static_cast<Store*>(h);
+  off_t offset;
+  {
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->cv_drain.wait(lk, [&] { return s->pending_count.count(key) == 0; });
+    auto it = s->extents.find(key);
+    if (it == s->extents.end() || it->second.second != (size_t)nbytes) return -1;
+    offset = it->second.first;
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (s->io_error) return -2;  // a write-back failed: data untrustworthy
+  }
+  size_t done = 0;
+  while (done < (size_t)nbytes) {
+    ssize_t n = pread(s->fd, (char*)ptr + done, (size_t)nbytes - done,
+                      offset + (off_t)done);
+    if (n <= 0) return -1;
+    done += (size_t)n;
+  }
+  return 0;
+}
+
+// Drain ALL pending writes and fsync. Returns 0, or -2 if any write failed.
+int ts_flush(void* h) {
+  auto* s = static_cast<Store*>(h);
+  {
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->cv_drain.wait(lk, [&] { return s->pending_count.empty(); });
+    if (s->io_error) return -2;
+  }
+  fsync(s->fd);
+  return 0;
+}
+
+int64_t ts_bytes(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return (int64_t)s->tail;
+}
+
+void ts_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->stop = true;
+    s->cv_push.notify_all();
+  }
+  s->worker.join();
+  ::close(s->fd);
+  delete s;
+}
+
+}  // extern "C"
