@@ -17,56 +17,24 @@ The memory hierarchy on TPU:
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import tempfile
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from colossalai_tpu.utils.native import jit_build
+
 _LIB = None
 _LIB_ERR: Optional[str] = None
-
-
-def _csrc_path() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))),
-        "csrc", "tensor_store.cpp",
-    )
 
 
 def _build_lib() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_ERR
     if _LIB is not None or _LIB_ERR is not None:
         return _LIB
-    src = _csrc_path()
-    cache_dir = os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "colossalai_tpu"
-    )
-    os.makedirs(cache_dir, exist_ok=True)
-    lib_path = os.path.join(cache_dir, "libtensorstore.so")
-    tmp = None
-    try:
-        stale = not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(src)
-        if stale:
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
-            os.close(fd)
-            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", tmp]
-            subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(tmp, lib_path)
-            tmp = None
-    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
-        if not os.path.exists(lib_path):
-            _LIB_ERR = f"native tensor store build failed: {e}"
-            return None
-    finally:
-        if tmp is not None and os.path.exists(tmp):
-            os.unlink(tmp)
-    try:
-        lib = ctypes.CDLL(lib_path)
-    except OSError as e:
-        _LIB_ERR = f"native tensor store load failed: {e}"
+    lib, err = jit_build("tensor_store.cpp", "libtensorstore")
+    if lib is None:
+        _LIB_ERR = err
         return None
     lib.ts_open.restype = ctypes.c_void_p
     lib.ts_open.argtypes = [ctypes.c_char_p]
@@ -95,17 +63,22 @@ class DiskTensorStore:
         if not self._h:
             raise OSError(f"cannot open tensor store at {path}")
 
+    def _handle(self):
+        if not self._h:
+            raise ValueError("tensor store is closed")
+        return self._h
+
     def put(self, key: int, arr: np.ndarray) -> None:
         """Async write (returns immediately; the C++ worker persists it)."""
         arr = np.ascontiguousarray(arr)
-        rc = self._lib.ts_put(self._h, key, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+        rc = self._lib.ts_put(self._handle(), key, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
         if rc != 0:
             raise ValueError(f"size mismatch for key {key}")
 
     def get(self, key: int, shape, dtype) -> np.ndarray:
         """Blocking read (waits only for THIS key's pending writes)."""
         out = np.empty(shape, dtype)
-        rc = self._lib.ts_get(self._h, key, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+        rc = self._lib.ts_get(self._handle(), key, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
         if rc == -2:
             raise OSError("tensor store write-back failed (disk full?); state is untrustworthy")
         if rc != 0:
@@ -113,12 +86,12 @@ class DiskTensorStore:
         return out
 
     def flush(self) -> None:
-        if self._lib.ts_flush(self._h) != 0:
+        if self._lib.ts_flush(self._handle()) != 0:
             raise OSError("tensor store write-back failed (disk full?); state is untrustworthy")
 
     @property
     def nbytes(self) -> int:
-        return int(self._lib.ts_bytes(self._h))
+        return int(self._lib.ts_bytes(self._handle()))
 
     def close(self) -> None:
         if self._h:
@@ -149,12 +122,9 @@ class DiskOffloadedAdamW:
         self.step_count = 0
         self._initialized = False
 
-    def _leaves(self, tree):
-        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-        return [(i, leaf) for i, (_, leaf) in enumerate(flat)]
-
     def init(self, params: Any) -> None:
-        for i, leaf in self._leaves(params):
+        # keying by tree_leaves order — the SAME order step() flattens with
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
             z = np.zeros_like(np.asarray(leaf, np.float32))
             self.store.put(2 * i, z)      # m
             self.store.put(2 * i + 1, z)  # v

@@ -14,8 +14,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 from typing import Iterator, Optional
 
 import numpy as np
@@ -24,46 +22,15 @@ _LIB = None
 _LIB_ERR: Optional[str] = None
 
 
-def _csrc_path() -> str:
-    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc", "dataloader.cpp")
-
-
 def _build_lib() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_ERR
     if _LIB is not None or _LIB_ERR is not None:
         return _LIB
-    src = _csrc_path()
-    cache_dir = os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "colossalai_tpu"
-    )
-    os.makedirs(cache_dir, exist_ok=True)
-    lib_path = os.path.join(cache_dir, "libdataloader.so")
-    tmp = None
-    try:
-        stale = not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(src)
-        if stale:
-            # build atomically: compile to a temp file, rename into place, so
-            # concurrent processes never CDLL a half-written .so
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
-            os.close(fd)
-            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", tmp]
-            subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(tmp, lib_path)
-            tmp = None
-    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
-        if not os.path.exists(lib_path):
-            _LIB_ERR = f"native dataloader build failed: {e}"
-            return None
-        # a previously-built lib exists; use it even if the source is missing
-        # (pip-installed layout without csrc/)
-    finally:
-        if tmp is not None and os.path.exists(tmp):
-            os.unlink(tmp)
-    try:
-        lib = ctypes.CDLL(lib_path)
-    except OSError as e:
-        # corrupt/foreign-arch cached .so: fall back rather than crash
-        _LIB_ERR = f"native dataloader load failed: {e}"
+    from colossalai_tpu.utils.native import jit_build
+
+    lib, err = jit_build("dataloader.cpp", "libdataloader")
+    if lib is None:
+        _LIB_ERR = err
         return None
     lib.dl_open.restype = ctypes.c_void_p
     lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long]

@@ -1,0 +1,54 @@
+"""Shared JIT build-and-cache for the native (C++) components.
+
+All ``csrc/*.cpp`` libraries (dataloader, tensor store) compile on first
+use with g++ into the user cache, atomically (mkstemp + rename) so
+concurrent processes never dlopen a half-written .so; staleness is
+detected by source mtime. Callers bind their own symbols.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+
+def csrc_path(src_name: str) -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "csrc", src_name,
+    )
+
+
+def jit_build(src_name: str, lib_name: str) -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
+    """Compile csrc/{src_name} → cached lib_name.so; returns (lib, error)."""
+    src = csrc_path(src_name)
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "colossalai_tpu"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    lib_path = os.path.join(cache_dir, f"{lib_name}.so")
+    tmp = None
+    try:
+        stale = not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(src)
+        if stale:
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, lib_path)
+            tmp = None
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        if not os.path.exists(lib_path):
+            return None, f"native build of {src_name} failed: {e}"
+        # a previously-built lib exists; use it even if the source is missing
+        # (pip-installed layout without csrc/)
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)
+    try:
+        return ctypes.CDLL(lib_path), None
+    except OSError as e:
+        return None, f"native load of {lib_name} failed: {e}"

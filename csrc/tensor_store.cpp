@@ -158,7 +158,7 @@ int ts_flush(void* h) {
     s->cv_drain.wait(lk, [&] { return s->pending_count.empty(); });
     if (s->io_error) return -2;
   }
-  fsync(s->fd);
+  if (fsync(s->fd) != 0) return -2;  // durability contract: surface it
   return 0;
 }
 

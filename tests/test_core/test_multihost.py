@@ -1,0 +1,86 @@
+"""True multi-controller bring-up: 2 separate processes join the
+coordination service and run cross-process collectives
+(≙ reference tests spawning real torch.distributed process groups,
+``testing/utils.py:229``). The round-1 gap: launch()'s multi-host path
+had no test at all.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_CHILD = textwrap.dedent(
+    """
+    import sys, os
+    sys.path.insert(0, {repo!r})
+    rank = int(sys.argv[1]); port = sys.argv[2]
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    try:
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    except Exception:
+        pass
+    import numpy as np
+    import colossalai_tpu as clt
+    from colossalai_tpu.cluster import DistCoordinator
+
+    key = clt.launch(coordinator_address=f'localhost:{{port}}',
+                     num_processes=2, process_id=rank, seed=7)
+    assert jax.process_count() == 2
+
+    coord = DistCoordinator()
+    assert coord.world_size == 2 and coord.rank == rank
+    assert coord.is_master() == (rank == 0)
+
+    from jax.experimental import multihost_utils
+    got = multihost_utils.process_allgather(np.asarray([rank], np.int32))
+    assert sorted(got.ravel().tolist()) == [0, 1], got
+
+    # a cross-process device collective over the global mesh
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ('dp',))
+    x = jax.device_put(jnp.ones((len(devs),)), NamedSharding(mesh, P('dp')))
+    s = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(x)
+    assert float(np.asarray(s.addressable_shards[0].data)) == float(len(devs))
+
+    coord.block_all()  # the barrier itself is a cross-process collective
+    print(f'rank {{rank}} OK', flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_coordinator_bringup(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(repo=repo))
+    port = _free_port()
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # children configure themselves
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank} OK" in out
