@@ -8,6 +8,12 @@ from .base import CausalLMOutput, ModelConfig
 from .bert import BertConfig, BertModel, BertOutput
 from .deepseek import DeepseekV2Config, DeepseekV2ForCausalLM
 from .families import (
+    GPTBigCodeConfig,
+    GPTBigCodeForCausalLM,
+    MptConfig,
+    MptForCausalLM,
+    StableLmConfig,
+    StableLmForCausalLM,
     FAMILY_MODELS,
     BaichuanConfig,
     BaichuanForCausalLM,
@@ -50,6 +56,10 @@ MODEL_REGISTRY = {
     "bert": (BertModel, BertConfig),
     "vit": (ViTForImageClassification, ViTConfig),
     "t5": (T5ForConditionalGeneration, T5Config),
+    # llama-architecture clones (≙ the reference's per-clone policy entries)
+    "yi": (LlamaForCausalLM, LlamaConfig),
+    "internlm2": (LlamaForCausalLM, LlamaConfig),
+    "deepseek_llm": (LlamaForCausalLM, LlamaConfig),
     "deepseek_v2": (DeepseekV2ForCausalLM, DeepseekV2Config),
     "deepseek_v3": (DeepseekV2ForCausalLM, DeepseekV2Config),
     "whisper": (WhisperForConditionalGeneration, WhisperConfig),
@@ -113,6 +123,12 @@ __all__ = [
     "WhisperForConditionalGeneration",
     "DeepseekV2Config",
     "DeepseekV2ForCausalLM",
+    "StableLmConfig",
+    "StableLmForCausalLM",
+    "MptConfig",
+    "MptForCausalLM",
+    "GPTBigCodeConfig",
+    "GPTBigCodeForCausalLM",
     "MODEL_REGISTRY",
     "get_model_cls",
     "FAMILY_MODELS",

@@ -25,6 +25,12 @@ class ModelConfig:
     dtype: Any = None  # computation dtype; None = fp32
     param_dtype: Any = None  # storage dtype; None = fp32
     remat: bool = False  # jax.checkpoint each block (≙ gradient checkpointing)
+    # what remat SAVES (≙ grad_ckpt_config.py per-stage ratios, expressed the
+    # XLA way as a rematerialization policy): "none" saves only block inputs
+    # (max memory savings); "dots" keeps matmul outputs (recompute only
+    # elementwise - cheaper backward, more memory); "everything" disables
+    # recompute inside checkpointed blocks.
+    remat_policy: str = "none"
     scan_layers: bool = True  # lax.scan over decoder blocks (fast compiles, PP-friendly)
     attention_impl: str = "auto"  # see shardformer.layer.attention
     # sequence-parallel mode (≙ reference's 4 SP modes, shard_config.py:13):

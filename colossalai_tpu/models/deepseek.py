@@ -154,7 +154,9 @@ class _DenseBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids):
-        cls = nn.remat(DeepseekBlock, prevent_cse=False) if self.config.remat else DeepseekBlock
+        from .stack import remat_block
+
+        cls = remat_block(DeepseekBlock, self.config) if self.config.remat else DeepseekBlock
         x, aux = cls(self.config, use_moe=False, name="block")(x, positions, segment_ids)
         return x, aux
 
@@ -164,7 +166,9 @@ class _MoeBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids):
-        cls = nn.remat(DeepseekBlock, prevent_cse=False) if self.config.remat else DeepseekBlock
+        from .stack import remat_block
+
+        cls = remat_block(DeepseekBlock, self.config) if self.config.remat else DeepseekBlock
         x, aux = cls(self.config, use_moe=True, name="block")(x, positions, segment_ids)
         return x, aux
 

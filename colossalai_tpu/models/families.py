@@ -378,6 +378,96 @@ class Starcoder2ForCausalLM(DecoderLM):
     pass
 
 
+# ------------------------------------------------------------- StableLM
+@dataclasses.dataclass(unsafe_hash=True)
+class StableLmConfig(DecoderConfig):
+    """StableLM-2: LayerNorm + SiLU-GLU + partial rotary (pct 0.25),
+    qkv biases (use_qkv_bias), bias-free out/mlp."""
+
+    glu: bool = True
+    act_fn: str = "silu"
+    pos_embedding: str = "rope"
+    rotary_pct: float = 0.25
+    attention_bias: bool = True
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+
+    @classmethod
+    def stablelm_2_1_6b(cls, **kw):
+        return cls(
+            vocab_size=100352, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=24, num_attention_heads=32,
+            max_position_embeddings=4096, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class StableLmForCausalLM(DecoderLM):
+    pass
+
+
+# ----------------------------------------------------------------- MPT
+@dataclasses.dataclass(unsafe_hash=True)
+class MptConfig(DecoderConfig):
+    """MPT: ALiBi, bias-free LayerNorm blocks, plain GELU MLP, no
+    positional embeddings beyond the attention bias."""
+
+    pos_embedding: str = "alibi"
+    act_fn: str = "gelu"
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    norm_bias: bool = False
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def mpt_7b(cls, **kw):
+        return cls(
+            vocab_size=50432, hidden_size=4096, intermediate_size=16384,
+            num_hidden_layers=32, num_attention_heads=32,
+            max_position_embeddings=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class MptForCausalLM(DecoderLM):
+    pass
+
+
+# ---------------------------------------------------------- GPTBigCode
+@dataclasses.dataclass(unsafe_hash=True)
+class GPTBigCodeConfig(DecoderConfig):
+    """SantaCoder/StarCoder-1 (gpt_bigcode): GPT-2 body with multi-query
+    attention (1 kv head), learned positions, gelu."""
+
+    pos_embedding: str = "learned"
+    act_fn: str = "gelu_new"
+    num_key_value_heads: Optional[int] = 1
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def starcoderbase(cls, **kw):
+        return cls(
+            vocab_size=49152, hidden_size=6144, intermediate_size=24576,
+            num_hidden_layers=40, num_attention_heads=48,
+            num_key_value_heads=1, max_position_embeddings=8192, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(num_key_value_heads=1, **kw))
+
+
+class GPTBigCodeForCausalLM(DecoderLM):
+    pass
+
+
 FAMILY_MODELS = {
     "opt": (OPTForCausalLM, OPTConfig),
     "bloom": (BloomForCausalLM, BloomConfig),
@@ -390,4 +480,7 @@ FAMILY_MODELS = {
     "cohere": (CohereForCausalLM, CohereConfig),
     "baichuan": (BaichuanForCausalLM, BaichuanConfig),
     "starcoder2": (Starcoder2ForCausalLM, StarCoder2Config),
+    "stablelm": (StableLmForCausalLM, StableLmConfig),
+    "mpt": (MptForCausalLM, MptConfig),
+    "gpt_bigcode": (GPTBigCodeForCausalLM, GPTBigCodeConfig),
 }

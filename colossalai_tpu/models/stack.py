@@ -11,7 +11,30 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple, Type
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+def checkpoint_policy(cfg):
+    """``cfg.remat_policy`` name → jax checkpoint policy (validated)."""
+    policies = {
+        "none": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "everything": jax.checkpoint_policies.everything_saveable,
+    }
+    name = getattr(cfg, "remat_policy", "none")
+    if name not in policies:
+        raise ValueError(f"remat_policy={name!r} not in {sorted(policies)}")
+    return policies[name]
+
+
+def remat_block(block_cls, cfg):
+    """Wrap a block class in nn.remat honoring ``cfg.remat_policy``."""
+    policy = checkpoint_policy(cfg)
+    kw = {"prevent_cse": False}
+    if policy is not None:
+        kw["policy"] = policy
+    return nn.remat(block_cls, **kw)
 
 
 class _ScanBody(nn.Module):
@@ -21,7 +44,7 @@ class _ScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids):
-        cls = nn.remat(self.block_cls, prevent_cse=False) if self.remat else self.block_cls
+        cls = remat_block(self.block_cls, self.config) if self.remat else self.block_cls
         out = cls(self.config, name="block")(x, positions, segment_ids)
         if isinstance(out, tuple):
             x, aux = out
@@ -76,6 +99,7 @@ def apply_decoder_stack(
             x = pipeline_blocks(
                 block_apply, stacked, x, mesh, cfg.pp_microbatches,
                 aux=aux_in, remat=cfg.remat,
+                remat_policy=checkpoint_policy(cfg),
             )
             return x, None
 
@@ -87,6 +111,7 @@ def apply_decoder_stack(
             block_apply, stacked, x, mesh, cfg.pp_microbatches,
             aux=aux_in, remat=cfg.remat, chunks=chunks,
             split_dw=(schedule == "zb"), has_aux=has_aux,
+            remat_policy=checkpoint_policy(cfg),
         )
         if has_aux:
             return out
@@ -106,7 +131,7 @@ def apply_decoder_stack(
         )
         return x, (jnp.sum(aux_per_layer) if has_aux else None)
 
-    cls = nn.remat(block_cls, prevent_cse=False) if cfg.remat else block_cls
+    cls = remat_block(block_cls, cfg) if cfg.remat else block_cls
     aux_total = jnp.zeros((), jnp.float32)
     for i in range(cfg.num_hidden_layers):
         out = cls(cfg, name=f"{name}_{i}")(x, positions, segment_ids)

@@ -220,7 +220,9 @@ class _ScanEnc(nn.Module):
 
     @nn.compact
     def __call__(self, x, bias):
-        cls = nn.remat(T5EncoderBlock, prevent_cse=False) if self.config.remat else T5EncoderBlock
+        from .stack import remat_block
+
+        cls = remat_block(T5EncoderBlock, self.config) if self.config.remat else T5EncoderBlock
         return cls(self.config, name="block")(x, bias), None
 
 
@@ -229,7 +231,9 @@ class _ScanDec(nn.Module):
 
     @nn.compact
     def __call__(self, x, enc, bias):
-        cls = nn.remat(T5DecoderBlock, prevent_cse=False) if self.config.remat else T5DecoderBlock
+        from .stack import remat_block
+
+        cls = remat_block(T5DecoderBlock, self.config) if self.config.remat else T5DecoderBlock
         return cls(self.config, name="block")(x, enc, bias), None
 
 

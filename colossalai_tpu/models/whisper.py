@@ -149,7 +149,9 @@ class _ScanEnc(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        cls = nn.remat(WhisperEncoderBlock, prevent_cse=False) if self.config.remat else WhisperEncoderBlock
+        from .stack import remat_block
+
+        cls = remat_block(WhisperEncoderBlock, self.config) if self.config.remat else WhisperEncoderBlock
         return cls(self.config, name="block")(x), None
 
 
@@ -158,7 +160,9 @@ class _ScanDec(nn.Module):
 
     @nn.compact
     def __call__(self, x, enc):
-        cls = nn.remat(WhisperDecoderBlock, prevent_cse=False) if self.config.remat else WhisperDecoderBlock
+        from .stack import remat_block
+
+        cls = remat_block(WhisperDecoderBlock, self.config) if self.config.remat else WhisperDecoderBlock
         return cls(self.config, name="block")(x, enc), None
 
 

@@ -45,12 +45,16 @@ from .common import fp32_boundary as _fp32_boundary
 from .common import mb_split as _mb_split
 
 
-def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool):
+def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool,
+                   remat_policy=None):
     """(p_c [Lv, ...], h, aux_t) -> (h, aux_scalar): scan of one stage's blocks."""
 
     body_fn = block_apply
     if remat:
-        body_fn = jax.checkpoint(block_apply, prevent_cse=False)
+        kw = {"prevent_cse": False}
+        if remat_policy is not None:
+            kw["policy"] = remat_policy
+        body_fn = jax.checkpoint(block_apply, **kw)
 
     def stage_fn(p_c, h, aux_t):
         def body(carry, p_layer):
@@ -68,12 +72,12 @@ def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool):
 
 
 # custom_vjp: static config first (nondiff), then diff args (params, x, aux).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
 def _pipe(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw, has_aux,
-          stacked_params, x, aux):
+          remat_policy, stacked_params, x, aux):
     out, aux_total, _ = _pipe_fwd_impl(
         block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw, has_aux,
-        stacked_params, x, aux,
+        remat_policy, stacked_params, x, aux,
     )
     return out, aux_total
 
@@ -91,7 +95,7 @@ def _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks):
 
 
 def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
-                   has_aux, stacked_params, x, aux):
+                   has_aux, remat_policy, stacked_params, x, aux):
     pp, V, Lv = _shapes(mesh, pp_axis, stacked_params, x, n_micro, chunks)
     n = n_micro
     cast = _fp32_boundary(mesh)
@@ -104,7 +108,7 @@ def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
     if cast:
         x_mb = x_mb.astype(jnp.float32)
     aux_mb = jax.tree.map(lambda a: _mb_split(a, n), aux)
-    stage_fn = _make_stage_fn(block_apply, remat, has_aux)
+    stage_fn = _make_stage_fn(block_apply, remat, has_aux, remat_policy)
 
     def local_fn(params_l, x_mb_l, aux_mb_l):
         s = jax.lax.axis_index(pp_axis)
@@ -186,16 +190,16 @@ def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
 
 
 def _pipe_fwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
-              has_aux, stacked_params, x, aux):
+              has_aux, remat_policy, stacked_params, x, aux):
     out, aux_total, res = _pipe_fwd_impl(
         block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw, has_aux,
-        stacked_params, x, aux,
+        remat_policy, stacked_params, x, aux,
     )
     return (out, aux_total), res
 
 
 def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
-              has_aux, res, cotangents):
+              has_aux, remat_policy, res, cotangents):
     """Recompute-interleaved backward: forward re-stream + cotangent ring
     2(V-1) ticks behind, ring stash of stage inputs (depth O(pp))."""
     dout, daux = cotangents
@@ -214,7 +218,7 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
         x_mb = x_mb.astype(jnp.float32)
         dout_mb = dout_mb.astype(jnp.float32)
     aux_mb = jax.tree.map(lambda a: _mb_split(a, n), aux)
-    stage_fn = _make_stage_fn(block_apply, remat, has_aux)
+    stage_fn = _make_stage_fn(block_apply, remat, has_aux, remat_policy)
 
     Dw = V if split_dw else 0      # dW deferral distance (ZB weight store)
     R = min(n, 2 * V - 1 + Dw)     # input-stash ring depth: O(pp), not O(n)
@@ -410,13 +414,14 @@ def pipeline_blocks_vjp(
     chunks: int = 1,
     split_dw: bool = False,
     has_aux: bool = False,
+    remat_policy=None,
 ):
     """Run a stack of L blocks as a memory-bounded pp pipeline (see module
     docstring). Returns ``x_out`` or ``(x_out, aux_total)`` if ``has_aux``."""
     aux = aux if aux is not None else {}
     out, aux_total = _pipe(
         block_apply, mesh, num_microbatches, pp_axis, bool(remat), int(chunks),
-        bool(split_dw), bool(has_aux), stacked_params, x, aux,
+        bool(split_dw), bool(has_aux), remat_policy, stacked_params, x, aux,
     )
     if has_aux:
         return out, aux_total

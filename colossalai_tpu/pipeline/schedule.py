@@ -41,6 +41,7 @@ def pipeline_blocks(
     *,
     pp_axis: str = "pp",
     remat: bool = True,
+    remat_policy=None,
 ):
     """Run a stack of L identical blocks as a pp-stage pipeline.
 
@@ -58,7 +59,10 @@ def pipeline_blocks(
 
     stage_body = block_apply
     if remat:
-        stage_body = jax.checkpoint(block_apply, prevent_cse=False)
+        kw = {"prevent_cse": False}
+        if remat_policy is not None:
+            kw["policy"] = remat_policy
+        stage_body = jax.checkpoint(block_apply, **kw)
 
     if pp == 1:
         def body(h, p):

@@ -41,6 +41,9 @@ POLICY_REGISTRY = {
     "deepseek_v2": DeepseekV2Policy,
     "deepseek_v3": DeepseekV2Policy,
     "DeepseekV2ForCausalLM": DeepseekV2Policy,
+    "yi": LlamaPolicy,
+    "internlm2": LlamaPolicy,
+    "deepseek_llm": LlamaPolicy,
     "DecoderLM": DecoderPolicy,
     "opt": DecoderPolicy,
     "OPTForCausalLM": DecoderPolicy,
@@ -64,6 +67,12 @@ POLICY_REGISTRY = {
     "BaichuanForCausalLM": DecoderPolicy,
     "starcoder2": DecoderPolicy,
     "Starcoder2ForCausalLM": DecoderPolicy,
+    "stablelm": DecoderPolicy,
+    "StableLmForCausalLM": DecoderPolicy,
+    "mpt": DecoderPolicy,
+    "MptForCausalLM": DecoderPolicy,
+    "gpt_bigcode": DecoderPolicy,
+    "GPTBigCodeForCausalLM": DecoderPolicy,
 }
 
 

@@ -16,6 +16,8 @@ import ctypes
 import os
 from typing import Iterator, Optional
 
+from colossalai_tpu.utils.native import jit_build
+
 import numpy as np
 
 _LIB = None
@@ -26,8 +28,6 @@ def _build_lib() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_ERR
     if _LIB is not None or _LIB_ERR is not None:
         return _LIB
-    from colossalai_tpu.utils.native import jit_build
-
     lib, err = jit_build("dataloader.cpp", "libdataloader")
     if lib is None:
         _LIB_ERR = err

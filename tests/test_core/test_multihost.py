@@ -78,9 +78,15 @@ def test_two_process_coordinator_bringup(tmp_path):
         for rank in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=180)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank} OK" in out
