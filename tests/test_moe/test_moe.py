@@ -119,3 +119,26 @@ def test_ep_size_validation():
         MoeHybridParallelPlugin(ep_size=2, precision="fp32").configure(
             LlamaForCausalLM(LlamaConfig.tiny()), optax.adamw(1e-3), example_batch=batch,
         )
+
+
+def test_skewed_routing_drop_rate():
+    """Capacity behavior under adversarial skew (round-1 gap: untested).
+
+    All tokens forced onto one expert: exactly ``capacity`` slots survive
+    per top-k column; balanced routing drops (almost) nothing at
+    capacity_factor >= 1."""
+    n, e, k, cap = 64, 4, 2, 20
+    # skew: expert 0 dominates every token's top-1, expert 1 its top-2
+    logits = jnp.tile(jnp.asarray([[4.0, 2.0, 0.0, -2.0]]), (n, 1))
+    r = top_k_routing(logits, k, cap)
+    routed = float(r.dispatch.sum())  # tokens x experts that got a slot
+    assert routed == 2 * cap, routed  # cap for expert 0 + cap for expert 1
+    # the aux loss must scream under this skew: >> the balanced value of k
+    assert float(r.aux_loss) > 1.5 * k
+
+    # balanced routing with capacity_factor 1.25 (cap = 1.25*n*k/e) drops
+    # (almost) nothing
+    key = jax.random.PRNGKey(0)
+    balanced = jax.random.normal(key, (n, e)) * 0.01
+    rb = top_k_routing(balanced, k, int(1.25 * n * k / e))
+    assert float(rb.dispatch.sum()) >= 0.9 * n * k
