@@ -1,0 +1,63 @@
+"""Serve a llama-family model over HTTP with the paged engine.
+
+≙ reference ``applications/ColossalQA`` / ``inference/server`` examples.
+
+    python examples/inference/serve.py --port 8000
+    curl -s localhost:8000/health
+    curl -s -X POST localhost:8000/generate \
+         -d '{"prompt_ids": [1, 2, 3], "max_new_tokens": 16}'
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.inference import LLMEngine, make_server
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=1024)
+    ap.add_argument("--block-size", type=int, default=64)
+    ap.add_argument("--checkpoint", default=None,
+                    help="safetensors dir written by this library's "
+                         "Booster.save_model for THIS config (for real HF "
+                         "checkpoints convert via checkpoint_io.hf_to_params)")
+    args = ap.parse_args()
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=512, intermediate_size=1408,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=args.max_seq, dtype=jnp.bfloat16,
+    )
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    if args.checkpoint:
+        from colossalai_tpu.checkpoint_io import load_sharded
+
+        params = {"params": load_sharded(args.checkpoint, target=params["params"])}
+
+    engine = LLMEngine(
+        params, cfg, max_batch_size=args.max_batch, max_seq_len=args.max_seq,
+        block_size=args.block_size,
+    )
+    server, sched = make_server(engine, port=args.port)
+    print(f"serving on http://127.0.0.1:{args.port} "
+          f"(pool: {engine.allocator.num_free} pages x {args.block_size} tokens)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        sched.stop()
+
+
+if __name__ == "__main__":
+    main()
