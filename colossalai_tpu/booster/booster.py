@@ -34,8 +34,14 @@ class Booster:
         rng: Optional[jax.Array] = None,
         policy: Optional[Policy] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        lora: Optional[Any] = None,
     ) -> Boosted:
-        """Wrap model + optimizer into a sharded, compiled training bundle."""
+        """Wrap model + optimizer into a sharded, compiled training bundle.
+
+        ``lora``: a :class:`colossalai_tpu.peft.LoraConfig` — only the adapter
+        tree trains (≙ reference ``booster.enable_lora``); pretrained base
+        weights can then be swapped in via :meth:`load_model`.
+        """
         return self.plugin.configure(
             model=model,
             optimizer=optimizer,
@@ -44,6 +50,7 @@ class Booster:
             rng=rng,
             policy=policy,
             devices=devices,
+            lora=lora,
         )
 
     # Checkpoint entry points (≙ booster/booster.py:121-124)
@@ -56,16 +63,58 @@ class Booster:
         return self._checkpoint_io
 
     def save_model(self, boosted: Boosted, path: str, **kw) -> None:
-        """Weights only, sharded safetensors (HF-style layout on disk)."""
-        self.checkpoint_io.save_model(boosted.state.params, path, **kw)
+        """Weights only, sharded safetensors (HF-style layout on disk).
+
+        With LoRA active this saves the MERGED weights — a deployable
+        standalone model (≙ peft merge_and_unload)."""
+        self.checkpoint_io.save_model(self._export_params(boosted), path, **kw)
 
     def load_model(self, boosted: Boosted, path: str, **kw) -> Boosted:
-        params = self.checkpoint_io.load_model(
-            path, target=boosted.state.params,
-            shardings=boosted.state_shardings.params, **kw,
-        )
+        """With LoRA active this loads into the frozen BASE tree (the
+        pretrained-weights path of ``enable_lora``)."""
+        if boosted.lora_config is not None:
+            base = self.checkpoint_io.load_model(
+                path, target=boosted.state.params["base"],
+                shardings=boosted.state_shardings.params["base"], **kw,
+            )
+            params = dict(boosted.state.params, base=base)
+        else:
+            params = self.checkpoint_io.load_model(
+                path, target=boosted.state.params,
+                shardings=boosted.state_shardings.params, **kw,
+            )
         boosted.state = boosted.state.replace(params=params)
         return boosted
+
+    def save_lora(self, boosted: Boosted, path: str, **kw) -> None:
+        """Adapter weights only (≙ save_lora_as_pretrained)."""
+        if boosted.lora_config is None:
+            raise ValueError("save_lora on a booster without lora enabled")
+        self.checkpoint_io.save_model(boosted.state.params["lora"], path, **kw)
+
+    def load_lora(self, boosted: Boosted, path: str, **kw) -> Boosted:
+        if boosted.lora_config is None:
+            raise ValueError("load_lora on a booster without lora enabled")
+        adapters = self.checkpoint_io.load_model(
+            path, target=boosted.state.params["lora"],
+            shardings=boosted.state_shardings.params["lora"], **kw,
+        )
+        boosted.state = boosted.state.replace(
+            params=dict(boosted.state.params, lora=adapters)
+        )
+        return boosted
+
+    def _export_params(self, boosted: Boosted):
+        if boosted.lora_config is None:
+            return boosted.state.params
+        from colossalai_tpu.peft.lora import merge_lora
+        from colossalai_tpu.tensor import use_mesh
+
+        with use_mesh(boosted.mesh):
+            merged = jax.jit(
+                lambda base, adapters: merge_lora(base, adapters, boosted.lora_config)
+            )(boosted.state.params["base"], boosted.state.params["lora"])
+        return merged
 
     def save(self, boosted: Boosted, directory: str, **kw) -> None:
         """Full resumable state (params + optimizer + step), async orbax."""

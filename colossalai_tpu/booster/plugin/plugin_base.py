@@ -62,11 +62,15 @@ class Boosted:
     param_specs: Any
     plugin: "Plugin"
     model: Any = None
+    lora_config: Any = None
 
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
-        """Place a host batch onto the mesh with the data-parallel layout."""
-        sharding = self.mesh.sharding(*self.mesh.batch_spec())
-        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+        """Place a host batch onto the mesh with the data-parallel layout.
+
+        Optional: ``train_step``/``eval_step`` place their batch themselves
+        (device_put on an already-placed array is a no-op); call this to
+        overlap host→device transfer ahead of the step."""
+        return _place_batch(self.mesh, batch)
 
 
 class Plugin(abc.ABC):
@@ -100,6 +104,7 @@ class Plugin(abc.ABC):
         rng: Optional[jax.Array] = None,
         policy: Optional[Policy] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        lora: Optional[Any] = None,
     ) -> Boosted:
         if example_batch is None:
             raise ValueError("configure() needs example_batch to trace shapes")
@@ -146,16 +151,32 @@ class Plugin(abc.ABC):
             param_specs = tree_add_pp_axis(param_specs, params_shape["params"])
         if self.fsdp:
             param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh)
+        # ---- LoRA (≙ booster.enable_lora / peft): the trainable state is a
+        # parallel adapter tree; base params are frozen cargo in TrainState.
+        lora_shape = None
+        if lora is not None:
+            from colossalai_tpu.peft.lora import init_lora_params, lora_param_specs
+
+            lora_shape = jax.eval_shape(
+                lambda r: init_lora_params(params_shape["params"], lora, r), rng
+            )
+            lora_specs = lora_param_specs(
+                param_specs, params_shape["params"], lora_shape, lora
+            )
+            param_specs = {"base": param_specs, "lora": lora_specs}
+
         param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh.mesh, s), param_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
 
-        opt_state_shape = jax.eval_shape(optimizer.init, params_shape["params"])
+        train_shape = params_shape["params"] if lora is None else lora_shape
+        train_specs = param_specs if lora is None else param_specs["lora"]
+        opt_state_shape = jax.eval_shape(optimizer.init, train_shape)
         opt_specs = _opt_state_specs(
             opt_state_shape,
-            params_shape["params"],
-            param_specs,
+            train_shape,
+            train_specs,
             mesh,
             shard_over_data=(self.zero_stage >= 1 and not self.fsdp),
         )
@@ -166,8 +187,12 @@ class Plugin(abc.ABC):
             # the decision is made once from the traced state sizes vs HBM —
             # offload optimizer states when the resident state would crowd
             # out the working set.
+            all_shapes = (
+                params_shape["params"] if lora is None
+                else {"base": params_shape["params"], "lora": lora_shape}
+            )
             offload_optim = _auto_offload_decision(
-                params_shape["params"], param_specs, opt_state_shape, opt_specs, mesh
+                all_shapes, param_specs, opt_state_shape, opt_specs, mesh
             )
 
         opt_memory_kind = None
@@ -216,6 +241,18 @@ class Plugin(abc.ABC):
         # (≙ LazyInitContext + sharder materialize: params are never built
         # unsharded on one device)
         def _init_state(rng):
+            if lora is not None:
+                from colossalai_tpu.peft.lora import init_lora_params
+
+                base_rng, lora_rng = jax.random.split(rng)
+                base = model.init(base_rng, **example_inputs)["params"]
+                adapters = init_lora_params(base, lora, lora_rng)
+                return TrainState(
+                    step=jnp.zeros((), jnp.int32),
+                    params={"base": base, "lora": adapters},
+                    opt_state=optimizer.init(adapters),
+                    scaler=scaler,
+                )
             variables = model.init(rng, **example_inputs)
             params = variables["params"]
             return TrainState(
@@ -230,7 +267,7 @@ class Plugin(abc.ABC):
 
         grad_shardings = None
         if self.zero_stage >= 2 and not self.fsdp:
-            grad_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh)
+            grad_specs = tree_add_data_axis(train_specs, train_shape, mesh)
             grad_shardings = jax.tree.map(
                 lambda s: NamedSharding(mesh.mesh, s), grad_specs,
                 is_leaf=lambda x: isinstance(x, PartitionSpec),
@@ -238,9 +275,9 @@ class Plugin(abc.ABC):
 
         train_step = self._build_train_step(
             model, optimizer, loss_fn, mesh, state_shardings, grad_shardings,
-            opt_shardings_device,
+            opt_shardings_device, lora_cfg=lora,
         )
-        eval_step = self._build_eval_step(model, loss_fn, mesh, state_shardings)
+        eval_step = self._build_eval_step(model, loss_fn, mesh, state_shardings, lora_cfg=lora)
 
         return Boosted(
             state=state,
@@ -252,11 +289,11 @@ class Plugin(abc.ABC):
             param_specs=param_specs,
             plugin=self,
             model=model,
+            lora_config=lora,
         )
 
     # ------------------------------------------------------------ train step
-    def _build_train_step(self, model, optimizer, loss_fn, mesh, state_shardings, grad_shardings=None, opt_shardings_device=None):
-        batch_sharding = mesh.sharding(*mesh.batch_spec())
+    def _build_train_step(self, model, optimizer, loss_fn, mesh, state_shardings, grad_shardings=None, opt_shardings_device=None, lora_cfg=None):
         precision = self.precision
 
         fp8_comm = getattr(self, "fp8_communication", False)
@@ -269,8 +306,17 @@ class Plugin(abc.ABC):
                 state = state.replace(
                     opt_state=jax.device_put(state.opt_state, opt_shardings_device)
                 )
+            # trainable view: with LoRA only the adapter tree gets grads /
+            # optimizer updates; base params ride through donated-in-place
+            train_view = state.params["lora"] if lora_cfg else state.params
 
-            def compute_loss(params):
+            def compute_loss(train_params):
+                if lora_cfg:
+                    from colossalai_tpu.peft.lora import merge_lora
+
+                    params = merge_lora(state.params["base"], train_params, lora_cfg)
+                else:
+                    params = train_params
                 if fp8_comm:
                     from colossalai_tpu.quantization.fp8 import fp8_param_gather
 
@@ -288,7 +334,7 @@ class Plugin(abc.ABC):
                     return loss * state.scaler.scale, loss
                 return loss, loss
 
-            grads, loss = jax.grad(compute_loss, has_aux=True)(state.params)
+            grads, loss = jax.grad(compute_loss, has_aux=True)(train_view)
 
             if grad_shardings is not None:
                 # ZeRO-2: grads take the optimizer-state layout early → XLA
@@ -300,11 +346,11 @@ class Plugin(abc.ABC):
                 grads = unscale(grads, state.scaler)
                 finite = all_finite(grads)
                 safe_grads = jax.tree.map(lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
-                updates, new_opt = optimizer.update(safe_grads, state.opt_state, state.params)
-                new_params = optax.apply_updates(state.params, updates)
+                updates, new_opt = optimizer.update(safe_grads, state.opt_state, train_view)
+                new_params = optax.apply_updates(train_view, updates)
                 # overflow step: keep old params/opt state
                 new_params = jax.tree.map(
-                    lambda new, old: jnp.where(finite, new, old), new_params, state.params
+                    lambda new, old: jnp.where(finite, new, old), new_params, train_view
                 )
                 new_opt = jax.tree.map(
                     lambda new, old: jnp.where(finite, new, old) if new.shape == old.shape else new,
@@ -317,39 +363,42 @@ class Plugin(abc.ABC):
                     "loss_scale": state.scaler.scale,
                     "overflow": (~finite).astype(jnp.float32),
                 }
-                new_state = TrainState(
-                    step=state.step + 1, params=new_params, opt_state=new_opt, scaler=new_scaler
-                )
             else:
-                updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-                new_params = optax.apply_updates(state.params, updates)
+                updates, new_opt = optimizer.update(grads, state.opt_state, train_view)
+                new_params = optax.apply_updates(train_view, updates)
+                new_scaler = None
                 metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
-                new_state = TrainState(
-                    step=state.step + 1, params=new_params, opt_state=new_opt, scaler=None
-                )
+            if lora_cfg:
+                new_params = {"base": state.params["base"], "lora": new_params}
+            new_state = TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt, scaler=new_scaler
+            )
             return new_state, metrics
 
         jitted = jax.jit(
             step_fn,
-            in_shardings=(state_shardings, batch_sharding),
+            in_shardings=(state_shardings, None),
             out_shardings=(state_shardings, None),
             donate_argnums=(0,),
         )
 
         def train_step(state, batch):
             with use_mesh(mesh):
-                return jitted(state, batch)
+                return jitted(state, _place_batch(mesh, batch))
 
         train_step._jitted = jitted  # for HLO inspection (tests assert ZeRO-2
         train_step._mesh = mesh      # lowers the dp grad sync to reduce-scatter)
         return train_step
 
-    def _build_eval_step(self, model, loss_fn, mesh, state_shardings):
-        batch_sharding = mesh.sharding(*mesh.batch_spec())
+    def _build_eval_step(self, model, loss_fn, mesh, state_shardings, lora_cfg=None):
         fp8_comm = getattr(self, "fp8_communication", False)
 
         def step_fn(state: TrainState, batch):
             params = state.params
+            if lora_cfg:
+                from colossalai_tpu.peft.lora import merge_lora
+
+                params = merge_lora(params["base"], params["lora"], lora_cfg)
             if fp8_comm:
                 # eval must see the same quantized gathers training did
                 from colossalai_tpu.quantization.fp8 import fp8_param_gather
@@ -361,16 +410,29 @@ class Plugin(abc.ABC):
                 loss = loss + out.aux_loss
             return {"loss": loss, "logits": out.logits}
 
-        jitted = jax.jit(step_fn, in_shardings=(state_shardings, batch_sharding))
+        jitted = jax.jit(step_fn, in_shardings=(state_shardings, None))
 
         def eval_step(state, batch):
             with use_mesh(mesh):
-                return jitted(state, batch)
+                return jitted(state, _place_batch(mesh, batch))
 
         return eval_step
 
 
 # ---------------------------------------------------------------- utilities
+
+
+def _place_batch(mesh: "DeviceMesh", batch: Any) -> Any:
+    """dp-shard array leaves along dim 0; replicate scalars (per-batch
+    constants like KTO's kl_ref baseline)."""
+    dp = mesh.sharding(*mesh.batch_spec())
+    rep = mesh.replicated()
+
+    def place(x):
+        x = jnp.asarray(x)
+        return jax.device_put(x, dp if x.ndim >= 1 else rep)
+
+    return jax.tree.map(place, batch)
 
 
 def _sharded_bytes(shapes, specs, mesh_shape) -> int:
@@ -502,7 +564,14 @@ def _apply_precision(model: Any, precision: str) -> Any:
         raise ValueError(f"unknown precision {precision!r} (fp32|bf16|fp16)")
     if model.config.dtype == dtype:
         return model
-    new_cfg = dataclasses.replace(model.config, dtype=dtype)
+    return rebuild_with_config(model, dataclasses.replace(model.config, dtype=dtype))
+
+
+def rebuild_with_config(model: Any, new_cfg: Any) -> Any:
+    """Reconstruct a module with a new config; wrappers (RewardModel) define
+    ``with_config`` to rebuild their inner backbone instead."""
+    if hasattr(model, "with_config"):
+        return model.with_config(new_cfg)
     return type(model)(new_cfg)
 
 

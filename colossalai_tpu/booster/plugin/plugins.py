@@ -169,7 +169,7 @@ class HybridParallelPlugin(Plugin):
         )
 
     def configure(self, model, optimizer, loss_fn=None, example_batch=None,
-                  rng=None, policy=None, devices=None):
+                  rng=None, policy=None, devices=None, lora=None):
         self._resolved_microbatches = self.num_microbatches
         if self.pp_size > 1 and example_batch is not None:
             batch_size = example_batch["input_ids"].shape[0]
@@ -188,7 +188,7 @@ class HybridParallelPlugin(Plugin):
                 self._resolved_microbatches = from_size
         return super().configure(
             model, optimizer, loss_fn=loss_fn, example_batch=example_batch,
-            rng=rng, policy=policy, devices=devices,
+            rng=rng, policy=policy, devices=devices, lora=lora,
         )
 
     def modify_model(self, model):
@@ -251,5 +251,7 @@ class HybridParallelPlugin(Plugin):
             if getattr(model.config, "sp_mode", "none") != mode:
                 updates["sp_mode"] = mode
         if updates:
-            model = type(model)(_dc.replace(model.config, **updates))
+            from .plugin_base import rebuild_with_config
+
+            model = rebuild_with_config(model, _dc.replace(model.config, **updates))
         return model

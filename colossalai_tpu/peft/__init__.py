@@ -1,0 +1,15 @@
+from .lora import (
+    LoraConfig,
+    init_lora_params,
+    lora_param_specs,
+    merge_lora,
+    split_lora_state,
+)
+
+__all__ = [
+    "LoraConfig",
+    "init_lora_params",
+    "lora_param_specs",
+    "merge_lora",
+    "split_lora_state",
+]

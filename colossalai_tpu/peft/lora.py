@@ -1,0 +1,171 @@
+"""LoRA parameter-efficient finetuning, TPU-first.
+
+≙ reference ``booster.enable_lora`` (``booster/booster.py`` peft path) and the
+LoRA support inside ``LowLevelZeroPlugin``/``TorchDDPPlugin``
+(``booster/plugin/low_level_zero_plugin.py:539``). The reference performs
+module surgery via the peft package; under JAX the natural formulation is a
+*parameter-space* adapter: a parallel pytree holding ``(A, B)`` factor pairs
+for every targeted kernel, merged as ``W + (alpha/r) * A @ B`` inside the
+jitted step. XLA fuses the rank-r matmul into the surrounding graph, so the
+merged weight is never materialized in HBM outside the step.
+
+Training takes gradients with respect to the adapter tree only — the base
+parameters are carried through the train step untouched (donated, so XLA
+aliases them in place) and no optimizer state exists for them. That is the
+whole memory story of LoRA, and it falls out of the functional design for
+free.
+
+Scanned layer stacks (leading layer dim, see ``policies/base_policy.py``
+SCAN_CONTAINERS) get per-layer factors ``(L, in, r) x (L, r, out)`` merged
+with a batched einsum, so LoRA composes with pipeline parallelism (the layer
+dim is pp-sharded like any other scanned param).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from colossalai_tpu.shardformer.policies.base_policy import path_str
+
+#: default targets: attention projections, the classic LoRA placement
+DEFAULT_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """≙ peft.LoraConfig surface (r / lora_alpha / target_modules).
+
+    ``target_modules`` entries are regexes searched against the flattened
+    param path (e.g. ``model/layers/block/attn/q_proj/kernel``); only
+    kernel-like leaves with ndim >= 2 are adapted.
+    """
+
+    r: int = 8
+    lora_alpha: float = 16.0
+    target_modules: Tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / self.r
+
+    def matches(self, path: str) -> bool:
+        if not path.endswith("kernel"):
+            return False
+        return any(re.search(t, path) for t in self.target_modules)
+
+
+def _target_leaves(params: Any, cfg: LoraConfig):
+    """(keypath, leaf) pairs the config adapts; leading layer dim allowed."""
+    out = []
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if leaf.ndim in (2, 3) and cfg.matches(path_str(kp)):
+            out.append((kp, leaf))
+    return out
+
+
+def _nest(flat: dict) -> dict:
+    tree: dict = {}
+    for path, leaf in flat.items():
+        node = tree
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def init_lora_params(params: Any, cfg: LoraConfig, rng: jax.Array) -> Any:
+    """Adapter tree mirroring ``params``: each targeted ``.../kernel`` leaf
+    becomes ``.../lora_a`` (in, r) gaussian and ``.../lora_b`` (r, out) zeros
+    — the standard init making the adapted model exactly equal the base model
+    at step 0."""
+    targets = _target_leaves(params, cfg)
+    if not targets:
+        raise ValueError(
+            f"LoraConfig{cfg.target_modules} matched no kernels; check "
+            "target_modules against the model's param paths"
+        )
+    flat = {}
+    keys = jax.random.split(rng, len(targets))
+    for key, (kp, leaf) in zip(keys, targets):
+        path = path_str(kp)
+        prefix = path.rsplit("/", 1)[0]
+        if leaf.ndim == 2:
+            d_in, d_out = leaf.shape
+            a_shape, b_shape = (d_in, cfg.r), (cfg.r, d_out)
+        else:  # scanned: (L, in, out)
+            L, d_in, d_out = leaf.shape
+            a_shape, b_shape = (L, d_in, cfg.r), (L, cfg.r, d_out)
+        flat[f"{prefix}/lora_a"] = (
+            jax.random.normal(key, a_shape, jnp.float32) / jnp.sqrt(d_in)
+        ).astype(leaf.dtype)
+        flat[f"{prefix}/lora_b"] = jnp.zeros(b_shape, leaf.dtype)
+    return _nest(flat)
+
+
+def _flat_by_path(tree: Any, is_leaf=None) -> dict:
+    return {
+        path_str(kp): leaf
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)[0]
+    }
+
+
+def merge_lora(base: Any, lora: Any, cfg: LoraConfig) -> Any:
+    """``W_eff = W + scaling * A @ B`` for every adapted kernel (batched over
+    the layer dim for scanned stacks). Call inside jit — the delta fuses."""
+    lora_flat = _flat_by_path(lora)
+    prefixes = {p.rsplit("/", 1)[0] for p in lora_flat}
+
+    def visit(kp, leaf):
+        path = path_str(kp)
+        prefix = path.rsplit("/", 1)[0]
+        if not path.endswith("kernel") or prefix not in prefixes:
+            return leaf
+        a = lora_flat[f"{prefix}/lora_a"]
+        b = lora_flat[f"{prefix}/lora_b"]
+        if leaf.ndim == 2:
+            delta = a @ b
+        else:
+            delta = jnp.einsum("lir,lro->lio", a, b)
+        return (leaf + cfg.scaling * delta.astype(leaf.dtype)).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(visit, base)
+
+
+def lora_param_specs(param_specs: Any, params_shape: Any, lora_shape: Any, cfg: LoraConfig) -> Any:
+    """PartitionSpecs for the adapter tree, derived from the base kernel's
+    spec: for W spec (..., s_in, s_out), A gets (..., s_in, None) and B gets
+    (..., None, s_out) — the rank dim replicates (r is tiny), the sharded
+    model dims stay sharded so the delta matmul is local."""
+    spec_flat = _flat_by_path(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+
+    def spec_for(path: str, leaf):
+        prefix, name = path.rsplit("/", 1)
+        # the adapter leaf has the same rank as its kernel ((L,in,r) vs
+        # (L,in,out)); pad the kernel spec to that rank before splitting
+        w_spec = tuple(spec_flat.get(f"{prefix}/kernel", PartitionSpec()))
+        w_spec = w_spec + (None,) * (leaf.ndim - len(w_spec))
+        lead = w_spec[:-2] if leaf.ndim == 3 else ()
+        s_in, s_out = w_spec[-2], w_spec[-1]
+        if name == "lora_a":
+            return PartitionSpec(*lead, s_in, None)
+        return PartitionSpec(*lead, None, s_out)
+
+    flat = _flat_by_path(lora_shape)
+    return _nest({p: spec_for(p, leaf) for p, leaf in flat.items()})
+
+
+def split_lora_state(params: Any) -> Tuple[Any, Optional[Any]]:
+    """Split a combined ``{"base":..., "lora":...}`` param tree; passthrough
+    for non-LoRA states."""
+    if isinstance(params, dict) and set(params) == {"base", "lora"}:
+        return params["base"], params["lora"]
+    return params, None

@@ -1,0 +1,64 @@
+"""LoRA finetune: adapter-only training over a frozen base model.
+
+≙ reference ``booster.enable_lora`` examples (``examples/language/llama``
+peft path): enable with one argument to ``boost``; the optimizer state is
+adapter-sized, the merged model exports as a standalone checkpoint.
+
+    python examples/language/lora_finetune.py --steps 20 --tp 2 --rank 8
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.peft import LoraConfig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--export", type=str, default="")
+    args = ap.parse_args()
+
+    cfg = LlamaConfig.tiny(vocab_size=512)
+    plugin = (
+        HybridParallelPlugin(tp_size=args.tp, precision="bf16")
+        if args.tp > 1 else DataParallelPlugin(precision="bf16")
+    )
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 64)))}
+
+    booster = Booster(plugin=plugin)
+    boosted = booster.boost(
+        LlamaForCausalLM(cfg), optax.adamw(1e-3), example_batch=batch,
+        rng=jax.random.PRNGKey(0), lora=LoraConfig(r=args.rank),
+    )
+    # (load pretrained base weights here: booster.load_model(boosted, path))
+
+    n_lora = sum(x.size for x in jax.tree.leaves(boosted.state.params["lora"]))
+    n_base = sum(x.size for x in jax.tree.leaves(boosted.state.params["base"]))
+    print(f"trainable {n_lora:,} / frozen {n_base:,} "
+          f"({100 * n_lora / n_base:.2f}% of base)")
+
+    for step in range(args.steps):
+        boosted.state, m = boosted.train_step(boosted.state, batch)
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(m['loss']):.4f}")
+
+    if args.export:
+        booster.save_lora(boosted, args.export + "-adapter")
+        booster.save_model(boosted, args.export + "-merged")
+        print(f"saved adapter + merged model under {args.export}-*")
+
+
+if __name__ == "__main__":
+    main()

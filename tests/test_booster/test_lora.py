@@ -1,0 +1,131 @@
+"""LoRA adapter training (≙ reference tests/test_lora/test_lora.py +
+booster.enable_lora): adapters train, base stays frozen, optimizer state is
+adapter-sized, merge equals base+delta, and TP composes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.peft import LoraConfig, init_lora_params, merge_lora
+
+
+def _batch(vocab, bs=8, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": jnp.asarray(rng.randint(0, vocab, size=(bs, seq)))}
+
+
+def _boost_lora(plugin, lora=None, **cfg_kw):
+    cfg = LlamaConfig.tiny(**cfg_kw)
+    model = LlamaForCausalLM(cfg)
+    batch = _batch(cfg.vocab_size)
+    boosted = Booster(plugin=plugin).boost(
+        model, optax.adamw(1e-2), example_batch=batch,
+        rng=jax.random.PRNGKey(0), lora=lora or LoraConfig(r=4),
+    )
+    return boosted, batch
+
+
+def test_lora_trains_adapters_only():
+    boosted, batch = _boost_lora(DataParallelPlugin(precision="fp32"))
+    state = boosted.state
+    base0 = jax.tree.map(np.asarray, state.params["base"])
+    losses = []
+    for _ in range(6):
+        state, metrics = boosted.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    # base params bit-identical after training
+    for p0, p1 in zip(
+        jax.tree.leaves(base0), jax.tree.leaves(jax.tree.map(np.asarray, state.params["base"]))
+    ):
+        np.testing.assert_array_equal(p0, p1)
+    # lora_b started at zero and must have moved
+    flat = jax.tree_util.tree_flatten_with_path(state.params["lora"])[0]
+    b_leaves = [np.asarray(l) for kp, l in flat if "lora_b" in str(kp)]
+    assert b_leaves and any(np.abs(b).max() > 0 for b in b_leaves)
+
+
+def test_lora_opt_state_is_adapter_sized():
+    boosted, _ = _boost_lora(DataParallelPlugin(precision="fp32"))
+    n_opt = sum(x.size for x in jax.tree.leaves(boosted.state.opt_state))
+    n_base = sum(x.size for x in jax.tree.leaves(boosted.state.params["base"]))
+    n_lora = sum(x.size for x in jax.tree.leaves(boosted.state.params["lora"]))
+    # adam: ~2x adapter params (+ counts); nowhere near base size
+    assert n_opt < 3 * n_lora
+    assert n_opt < n_base // 10
+
+
+def test_merge_is_identity_at_init_and_adds_delta():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = _batch(cfg.vocab_size)["input_ids"]
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    lcfg = LoraConfig(r=4, lora_alpha=8.0)
+    adapters = init_lora_params(params, lcfg, jax.random.PRNGKey(1))
+    merged = merge_lora(params, adapters, lcfg)
+    # B = 0 at init -> merged == base exactly
+    out0 = model.apply({"params": params}, ids).logits
+    out1 = model.apply({"params": merged}, ids).logits
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1), rtol=0, atol=0)
+    # perturb B -> targeted kernels move by scaling * A @ B
+    bumped = jax.tree_util.tree_map_with_path(
+        lambda kp, x: x + 0.01 if "lora_b" in str(kp) else x, adapters
+    )
+    merged2 = merge_lora(params, bumped, lcfg)
+    q0 = params["layers"]["block"]["self_attn"]["q_proj"]["kernel"]
+    q2 = merged2["layers"]["block"]["self_attn"]["q_proj"]["kernel"]
+    a = bumped["layers"]["block"]["self_attn"]["q_proj"]["lora_a"]
+    b = bumped["layers"]["block"]["self_attn"]["q_proj"]["lora_b"]
+    want = np.asarray(q0) + lcfg.scaling * np.asarray(
+        jnp.einsum("lir,lro->lio", a, b)
+    )
+    np.testing.assert_allclose(np.asarray(q2), want, rtol=1e-5, atol=1e-6)
+
+
+def test_lora_tp2_matches_dp():
+    lora = LoraConfig(r=4)
+    b_dp, batch = _boost_lora(DataParallelPlugin(precision="fp32"), lora=lora)
+    b_tp, _ = _boost_lora(HybridParallelPlugin(tp_size=2, precision="fp32"), lora=lora)
+    s_dp, s_tp = b_dp.state, b_tp.state
+    for _ in range(3):
+        s_dp, m_dp = b_dp.train_step(s_dp, batch)
+        s_tp, m_tp = b_tp.train_step(s_tp, b_tp.shard_batch(batch))
+    np.testing.assert_allclose(
+        float(m_dp["loss"]), float(m_tp["loss"]), rtol=2e-4,
+        err_msg="tp2 LoRA diverged from dp baseline",
+    )
+
+
+def test_lora_save_export_roundtrip(tmp_path):
+    booster = Booster(plugin=DataParallelPlugin(precision="fp32"))
+    cfg = LlamaConfig.tiny()
+    batch = _batch(cfg.vocab_size)
+    boosted = booster.boost(
+        LlamaForCausalLM(cfg), optax.adamw(1e-2), example_batch=batch,
+        rng=jax.random.PRNGKey(0), lora=LoraConfig(r=4),
+    )
+    state, _ = boosted.train_step(boosted.state, batch)
+    boosted.state = state
+    booster.save_lora(boosted, str(tmp_path / "adapter"))
+    # zero the adapters, reload, get training state back
+    boosted.state = state.replace(
+        params=dict(state.params, lora=jax.tree.map(jnp.zeros_like, state.params["lora"]))
+    )
+    booster.load_lora(boosted, str(tmp_path / "adapter"))
+    for a, b in zip(jax.tree.leaves(state.params["lora"]), jax.tree.leaves(boosted.state.params["lora"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # merged export: standalone model reproduces adapted logits
+    booster.save_model(boosted, str(tmp_path / "merged"))
+    merged = booster.checkpoint_io.load_model(
+        str(tmp_path / "merged"), target=state.params["base"]
+    )
+    model = boosted.model
+    out_merged = model.apply({"params": merged}, batch["input_ids"]).logits
+    out_eval = boosted.eval_step(boosted.state, batch)["logits"]
+    np.testing.assert_allclose(
+        np.asarray(out_merged), np.asarray(out_eval), rtol=2e-5, atol=2e-5
+    )
