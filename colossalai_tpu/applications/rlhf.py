@@ -89,6 +89,253 @@ def make_grpo_loss(clip_eps: float = 0.2, kl_coef: float = 0.0) -> Callable:
     return loss_fn
 
 
+def make_sft_loss() -> Callable:
+    """Supervised finetune objective (≙ coati SFTTrainer): CE over completion
+    tokens only, prompt/padding masked by ``loss_mask``."""
+
+    def loss_fn(out, batch):
+        lp = dist_log_prob(out.logits[:, :-1], batch["input_ids"][:, 1:])
+        mask = batch["loss_mask"][:, 1:].astype(lp.dtype)
+        return -(lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    return loss_fn
+
+
+def make_reward_loss() -> Callable:
+    """Bradley–Terry pairwise reward objective (≙ coati LogSigLoss over the
+    RewardModel): batch is [chosen; rejected] with per-sequence ``lengths``;
+    the model is a :class:`colossalai_tpu.models.RewardModel` whose
+    ``.logits`` are per-position values."""
+    from colossalai_tpu.models.reward import reward_at_last_token
+
+    def loss_fn(out, batch):
+        r = reward_at_last_token(out.logits, batch["lengths"])
+        b = r.shape[0] // 2
+        return -jax.nn.log_sigmoid(r[:b] - r[b:]).mean()
+
+    return loss_fn
+
+
+def make_kto_loss(beta: float = 0.1,
+                  desirable_weight: float = 1.0,
+                  undesirable_weight: float = 1.0) -> Callable:
+    """KTO objective (≙ coati KTOLoss): unpaired thumbs-up/down data. Batch:
+    input_ids, loss_mask, ref_logp [B], label [B] in {1 desirable, 0 not},
+    and ``kl_ref`` — the batch-level KL baseline z0 (policy-vs-ref logp mean
+    over a reference slice, computed host-side like ref_logp)."""
+
+    def loss_fn(out, batch):
+        seq_lp = sequence_log_probs(
+            out.logits, batch["input_ids"], batch.get("loss_mask")
+        )
+        rewards = beta * (seq_lp - batch["ref_logp"])
+        # the KL baseline enters beta-scaled and clamped at 0, matching
+        # KTO: 1 - sigmoid(beta * (logratio - max(KL, 0)))
+        z0 = beta * jnp.maximum(batch.get("kl_ref", jnp.zeros(())), 0.0)
+        lab = batch["label"].astype(rewards.dtype)
+        desirable = 1.0 - jax.nn.sigmoid(rewards - z0)
+        undesirable = 1.0 - jax.nn.sigmoid(z0 - rewards)
+        losses = lab * desirable_weight * desirable + (1.0 - lab) * undesirable_weight * undesirable
+        return losses.mean()
+
+    return loss_fn
+
+
+def make_orpo_loss(lam: float = 0.1) -> Callable:
+    """ORPO (≙ coati OddsRatioLoss + SFT term): reference-free — SFT CE on
+    the chosen half plus the log-odds-ratio penalty between halves."""
+
+    def loss_fn(out, batch):
+        ids, mask = batch["input_ids"], batch["loss_mask"]
+        lp = dist_log_prob(out.logits[:, :-1], ids[:, 1:])
+        m = mask[:, 1:].astype(lp.dtype)
+        b = lp.shape[0] // 2
+        # length-normalized per-sequence mean logp for the odds ratio
+        mean_lp = (lp * m).sum(-1) / jnp.maximum(m.sum(-1), 1.0)
+        p_c = jnp.minimum(jnp.exp(mean_lp[:b]), 1.0 - 1e-6)
+        p_r = jnp.minimum(jnp.exp(mean_lp[b:]), 1.0 - 1e-6)
+        log_odds = (mean_lp[:b] - mean_lp[b:]) - (jnp.log1p(-p_c) - jnp.log1p(-p_r))
+        ratio_term = -jax.nn.log_sigmoid(log_odds).mean()
+        sft_term = -(lp[:b] * m[:b]).sum() / jnp.maximum(m[:b].sum(), 1.0)
+        return sft_term + lam * ratio_term
+
+    return loss_fn
+
+
+def make_simpo_loss(beta: float = 2.0, gamma: float = 0.5) -> Callable:
+    """SimPO: reference-free DPO with length-normalized rewards and a target
+    margin gamma (≙ coati simpo variant of DpoLoss)."""
+
+    def loss_fn(out, batch):
+        ids, mask = batch["input_ids"], batch["loss_mask"]
+        lp = dist_log_prob(out.logits[:, :-1], ids[:, 1:])
+        m = mask[:, 1:].astype(lp.dtype)
+        mean_lp = (lp * m).sum(-1) / jnp.maximum(m.sum(-1), 1.0)
+        b = mean_lp.shape[0] // 2
+        margin = beta * (mean_lp[:b] - mean_lp[b:]) - gamma
+        return -jax.nn.log_sigmoid(margin).mean()
+
+    return loss_fn
+
+
+# ------------------------------------------------------------------- PPO
+
+
+def compute_gae(rewards: jax.Array, values: jax.Array, mask: jax.Array,
+                gamma: float = 1.0, lam: float = 0.95):
+    """Generalized advantage estimation over [B, S] token-level rewards and
+    values (≙ coati NaiveExperienceMaker GAE). ``mask`` is 1 on completion
+    tokens. Returns (advantages, returns), both [B, S], zero outside mask.
+
+    Runs host-side or jitted; the scan is over the (static) sequence axis.
+    """
+    s = rewards.shape[1]
+    next_values = jnp.concatenate([values[:, 1:], jnp.zeros_like(values[:, :1])], 1)
+    # bootstrap only from positions that are themselves real completion
+    # tokens — the value at the first padding position is garbage
+    next_mask = jnp.concatenate([mask[:, 1:], jnp.zeros_like(mask[:, :1])], 1)
+    deltas = (rewards + gamma * next_values * next_mask - values) * mask
+
+    def step(carry, t):
+        adv = deltas[:, t] + gamma * lam * mask[:, t] * carry
+        return adv, adv
+
+    _, advs = jax.lax.scan(step, jnp.zeros(rewards.shape[0]), jnp.arange(s - 1, -1, -1))
+    advantages = jnp.flip(advs.T, axis=1) * mask
+    return advantages, (advantages + values) * mask
+
+
+def make_ppo_actor_loss(clip_eps: float = 0.2) -> Callable:
+    """Token-level PPO clipped surrogate (≙ coati PolicyLoss). Batch:
+    input_ids, loss_mask, old_logp_tok [B, S-1], advantages_tok [B, S-1]."""
+
+    def loss_fn(out, batch):
+        lp = dist_log_prob(out.logits[:, :-1], batch["input_ids"][:, 1:])
+        m = batch["loss_mask"][:, 1:].astype(lp.dtype)
+        ratio = jnp.exp(lp - batch["old_logp_tok"])
+        adv = batch["advantages_tok"]
+        surr = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
+        )
+        return -(surr * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    return loss_fn
+
+
+def make_ppo_critic_loss(clip_eps: float = 0.2) -> Callable:
+    """Clipped value regression (≙ coati ValueLoss) for a RewardModel-style
+    critic whose ``.logits`` are per-position values. Batch: old_values
+    [B, S], returns [B, S], loss_mask [B, S]."""
+
+    def loss_fn(out, batch):
+        v = out.logits
+        m = batch["loss_mask"].astype(v.dtype)
+        v_clip = batch["old_values"] + jnp.clip(
+            v - batch["old_values"], -clip_eps, clip_eps
+        )
+        err = jnp.maximum(
+            jnp.square(v - batch["returns"]), jnp.square(v_clip - batch["returns"])
+        )
+        return 0.5 * (err * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    return loss_fn
+
+
+class PPOTrainer:
+    """Actor-critic PPO over two boosted models (≙ coati PPOTrainer minus
+    the ray/vllm rollout machinery: experience arrives as arrays).
+
+    ``step(batch)`` expects a rollout batch with input_ids [B,S], loss_mask
+    [B,S] (1 on generated tokens), rewards [B] (sequence-level, from a reward
+    model or verifier) and optional per-token kl penalties; it computes
+    values/GAE and applies one actor + one critic update.
+    """
+
+    def __init__(self, actor, critic, actor_opt, critic_opt, plugin_actor,
+                 plugin_critic, example_batch, *, clip_eps: float = 0.2,
+                 gamma: float = 1.0, lam: float = 0.95, rng=None):
+        from colossalai_tpu.booster import Booster
+
+        self.gamma, self.lam = gamma, lam
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        b, s = example_batch["input_ids"].shape
+        actor_example = dict(example_batch)
+        actor_example.setdefault("old_logp_tok", jnp.zeros((b, s - 1), jnp.float32))
+        actor_example.setdefault("advantages_tok", jnp.zeros((b, s - 1), jnp.float32))
+        self.actor = Booster(plugin=plugin_actor).boost(
+            actor, actor_opt, loss_fn=make_ppo_actor_loss(clip_eps),
+            example_batch=actor_example, rng=rng,
+        )
+        critic_example = dict(example_batch)
+        critic_example.setdefault("old_values", jnp.zeros((b, s), jnp.float32))
+        critic_example.setdefault("returns", jnp.zeros((b, s), jnp.float32))
+        self.critic = Booster(plugin=plugin_critic).boost(
+            critic, critic_opt, loss_fn=make_ppo_critic_loss(clip_eps),
+            example_batch=critic_example, rng=jax.random.split(rng)[0],
+        )
+        self._old_logp_fn = None
+
+    def _policy_logp(self, batch):
+        from colossalai_tpu.tensor import use_mesh
+
+        model = self.actor.model
+        if self._old_logp_fn is None:
+            @jax.jit
+            def fwd(params, ids):
+                out = model.apply({"params": params}, ids)
+                return dist_log_prob(out.logits[:, :-1], ids[:, 1:])
+
+            self._old_logp_fn = fwd
+        with use_mesh(self.actor.mesh):
+            return self._old_logp_fn(self.actor.state.params, batch["input_ids"])
+
+    def _values(self, batch):
+        from colossalai_tpu.tensor import use_mesh
+
+        with use_mesh(self.critic.mesh):
+            out = self.critic.eval_step(self.critic.state, self.critic.shard_batch(
+                {k: batch[k] for k in ("input_ids", "loss_mask") if k in batch}
+                | {"old_values": jnp.zeros_like(batch["loss_mask"], dtype=jnp.float32),
+                   "returns": jnp.zeros_like(batch["loss_mask"], dtype=jnp.float32)}
+            ))
+        return out["logits"]
+
+    def step(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        ids = jnp.asarray(batch["input_ids"])
+        mask = jnp.asarray(batch["loss_mask"]).astype(jnp.float32)
+        rewards_seq = jnp.asarray(batch["rewards"])  # [B]
+        values = self._values(batch)  # [B, S]
+        # sequence reward lands on the last completion token
+        lengths = mask.sum(-1).astype(jnp.int32) + (mask.argmax(-1)).astype(jnp.int32)
+        last_idx = jnp.clip(lengths - 1, 0, ids.shape[1] - 1)
+        rewards_tok = jnp.zeros_like(values).at[
+            jnp.arange(ids.shape[0]), last_idx
+        ].set(rewards_seq)
+        advantages, returns = compute_gae(
+            rewards_tok, values, mask, self.gamma, self.lam
+        )
+        old_logp = self._policy_logp(batch)
+
+        actor_batch = {
+            "input_ids": ids, "loss_mask": mask,
+            "old_logp_tok": old_logp, "advantages_tok": advantages[:, 1:],
+        }
+        self.actor.state, am = self.actor.train_step(
+            self.actor.state, self.actor.shard_batch(actor_batch)
+        )
+        critic_batch = {
+            "input_ids": ids, "loss_mask": mask,
+            "old_values": values, "returns": returns,
+        }
+        self.critic.state, cm = self.critic.train_step(
+            self.critic.state, self.critic.shard_batch(critic_batch)
+        )
+        return {
+            "actor_loss": float(am["loss"]), "critic_loss": float(cm["loss"]),
+            "reward_mean": float(rewards_seq.mean()),
+        }
+
+
 @functools.lru_cache(maxsize=8)
 def _ref_fwd(model):
     """One compiled reference forward per model object (jit caches are keyed

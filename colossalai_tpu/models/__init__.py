@@ -41,6 +41,7 @@ from .families import (
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM, MistralConfig, Qwen2Config
 from .mixtral import MixtralConfig, MixtralForCausalLM
+from .reward import RewardModel, reward_at_last_token
 from .t5 import Seq2SeqOutput, T5Config, T5EncoderModel, T5ForConditionalGeneration, shift_right
 from .transformer import DecoderConfig, DecoderLM
 from .whisper import WhisperConfig, WhisperForConditionalGeneration
@@ -75,6 +76,8 @@ def get_model_cls(name: str):
 
 __all__ = [
     "CausalLMOutput",
+    "RewardModel",
+    "reward_at_last_token",
     "ModelConfig",
     "DecoderConfig",
     "DecoderLM",

@@ -220,4 +220,4 @@ class DeepseekV2ForCausalLM(nn.Module):
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
-        return CausalLMOutput(logits=logits, aux_loss=aux_total)
+        return CausalLMOutput(logits=logits, hidden_states=x, aux_loss=aux_total)

@@ -109,4 +109,4 @@ class GPT2LMHeadModel(nn.Module):
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
-        return CausalLMOutput(logits=logits)
+        return CausalLMOutput(logits=logits, hidden_states=x)

@@ -294,4 +294,4 @@ class LlamaForCausalLM(nn.Module):
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
-        return CausalLMOutput(logits=logits)
+        return CausalLMOutput(logits=logits, hidden_states=x)

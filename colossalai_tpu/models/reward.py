@@ -1,0 +1,81 @@
+"""Reward / critic model: a scalar value head over any causal-LM backbone.
+
+≙ reference ``applications/ColossalChat/coati/models/reward_model.py`` and
+``critic.py`` (value head over the transformer's last hidden states). The
+backbone is reused as a child module, so every sharding policy, SP mode and
+pipeline layout of the base family applies unchanged; only the tiny
+``value_head`` is new (replicated — it is [H, 1]).
+
+Outputs per-position values [B, S] in ``.logits`` so the generic booster
+machinery (eval_step, loss plumbing) works; RLHF losses index the position
+they need (last completion token for a reward model, every token for a PPO
+critic).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .base import CausalLMOutput
+
+
+class RewardModel(nn.Module):
+    """Wrap a causal-LM module with a scalar head.
+
+    >>> rm = RewardModel(lm=LlamaForCausalLM(cfg))
+    """
+
+    lm: nn.Module
+
+    @property
+    def config(self):
+        return self.lm.config
+
+    # plugin hooks delegate to the backbone's capability surface
+    @property
+    def supports_pipeline(self):
+        return getattr(self.lm, "supports_pipeline", False)
+
+    @property
+    def supports_sp_modes(self):
+        return getattr(self.lm, "supports_sp_modes", ("split_gather",))
+
+    @property
+    def supports_fp8(self):
+        return getattr(self.lm, "supports_fp8", False)
+
+    @property
+    def supports_ep(self):
+        return getattr(self.lm, "supports_ep", False)
+
+    def with_config(self, cfg):
+        """Rebuild with a new backbone config (precision cast, plugin
+        feature flags) keeping the wrapper."""
+        return type(self)(lm=type(self.lm)(cfg))
+
+    @nn.compact
+    def __call__(self, input_ids, positions: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None):
+        out = self.lm(input_ids, positions=positions, segment_ids=segment_ids)
+        h = out.hidden_states
+        if h is None:
+            raise ValueError(
+                f"{type(self.lm).__name__} does not expose hidden_states; "
+                "RewardModel needs a backbone returning them"
+            )
+        values = nn.Dense(
+            1, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="value_head",
+        )(h.astype(jnp.float32))[..., 0]  # [B, S]
+        return CausalLMOutput(logits=values, aux_loss=out.aux_loss)
+
+
+def reward_at_last_token(values: jax.Array, lengths: jax.Array) -> jax.Array:
+    """[B, S] per-position values + [B] sequence lengths → [B] rewards at the
+    final real token (≙ coati reward models scoring the last token)."""
+    idx = jnp.clip(lengths - 1, 0, values.shape[1] - 1)
+    return jnp.take_along_axis(values, idx[:, None], axis=1)[:, 0]

@@ -303,4 +303,4 @@ class DecoderLM(nn.Module):
             logits = logits * cfg.logit_scale
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
-        return CausalLMOutput(logits=logits)
+        return CausalLMOutput(logits=logits, hidden_states=x)

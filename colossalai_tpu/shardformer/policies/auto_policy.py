@@ -80,7 +80,11 @@ def get_autopolicy(model_or_name: Union[str, object]) -> Policy:
     if isinstance(model_or_name, str):
         name = model_or_name
     else:
-        name = type(model_or_name).__name__
+        # head wrappers (RewardModel) dispatch on their backbone: rules are
+        # regex-searched over param paths, so the wrapper prefix is harmless
+        inner = getattr(model_or_name, "lm", None)
+        target = inner if inner is not None and hasattr(inner, "config") else model_or_name
+        name = type(target).__name__
     if name not in POLICY_REGISTRY:
         raise KeyError(
             f"no sharding policy for {name!r}; available: {sorted(POLICY_REGISTRY)}. "
