@@ -7,12 +7,23 @@ the fallback is plain jnp, which XLA still fuses well.
 """
 
 from .loader import KernelLoader
-from .ops import flash_attention, fused_rms_norm, fused_softmax, rope_embed
+from .ops import (
+    flash_attention,
+    fused_layer_norm,
+    fused_rms_norm,
+    fused_softmax,
+    rope_and_cache_update,
+    rope_embed,
+    silu_and_mul,
+)
 
 __all__ = [
     "KernelLoader",
     "flash_attention",
+    "fused_layer_norm",
     "fused_rms_norm",
     "fused_softmax",
+    "rope_and_cache_update",
     "rope_embed",
+    "silu_and_mul",
 ]

@@ -86,11 +86,40 @@ def fused_rms_norm(x, scale, eps: float = 1e-5, residual=None):
     return KernelLoader.load("rms_norm")(x, scale, eps=eps, residual=residual)
 
 
+# ---------------------------------------------------------------- LayerNorm
+# ≙ layer_norm_kernel.cu (683 LoC, Apex lineage)
+
+
+def _layer_norm_xla(x, scale, bias, eps: float = 1e-5, residual=None):
+    if residual is not None:
+        x = x + residual
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = ((x32 - mean) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+    return (out, x) if residual is not None else out
+
+
+def _layer_norm_pallas(x, scale, bias, eps: float = 1e-5, residual=None):
+    from .pallas.layer_norm import layer_norm as ln
+
+    return ln(x, scale, bias, eps=eps, residual=residual)
+
+
+KernelLoader.register("layer_norm", "pallas", _pallas_module("layer_norm"), _layer_norm_pallas)
+KernelLoader.register("layer_norm", "xla", lambda: True, _layer_norm_xla)
+
+
+def fused_layer_norm(x, scale, bias, eps: float = 1e-5, residual=None):
+    """LayerNorm; with ``residual`` returns (normed, x+residual)."""
+    return KernelLoader.load("layer_norm")(x, scale, bias, eps=eps, residual=residual)
+
+
 # ------------------------------------------------------------ fused softmax
 # ≙ scaled_masked_softmax_kernel.cu / scaled_upper_triang_masked_softmax_kernel.cu
 
 
-def fused_softmax(scores, scale: float = 1.0, causal: bool = False, mask=None):
+def _fused_softmax_xla(scores, scale: float = 1.0, causal: bool = False, mask=None):
     s = scores.astype(jnp.float32) * scale
     if causal:
         q_len, kv_len = scores.shape[-2:]
@@ -101,15 +130,62 @@ def fused_softmax(scores, scale: float = 1.0, causal: bool = False, mask=None):
     return jax.nn.softmax(s, axis=-1).astype(scores.dtype)
 
 
+def _fused_softmax_pallas(scores, scale: float = 1.0, causal: bool = False, mask=None):
+    from .pallas.softmax import scaled_masked_softmax, scaled_upper_triang_masked_softmax
+
+    if causal and mask is None and scores.shape[-1] == scores.shape[-2]:
+        return scaled_upper_triang_masked_softmax(scores, scale)
+    if causal:
+        q_len, kv_len = scores.shape[-2:]
+        cm = jnp.arange(q_len)[:, None] < jnp.arange(kv_len)[None, :]
+        mask = cm if mask is None else (cm | ~mask)
+    elif mask is not None:
+        mask = ~mask  # public API: mask True = keep; kernel: nonzero = masked
+    return scaled_masked_softmax(scores, mask=mask, scale=scale)
+
+
+KernelLoader.register("fused_softmax", "pallas", _pallas_module("softmax"), _fused_softmax_pallas)
+KernelLoader.register("fused_softmax", "xla", lambda: True, _fused_softmax_xla)
+
+
+def fused_softmax(scores, scale: float = 1.0, causal: bool = False, mask=None):
+    """softmax(scale * scores) with optional causal/boolean mask
+    (mask True = attend, matching ``xla_attention``)."""
+    return KernelLoader.load("fused_softmax")(scores, scale=scale, causal=causal, mask=mask)
+
+
 # --------------------------------------------------------------------- RoPE
 # ≙ fused_rotary_emb_and_cache_kernel.cu / get_cos_and_sin_kernel.cu
 
 
-def rope_embed(q, k, positions, theta: float = 10000.0):
+def _rope_embed_xla(q, k, positions, theta: float = 10000.0):
     from colossalai_tpu.models.llama import apply_rope, rope_table
 
     cos, sin = rope_table(positions, q.shape[-1], theta)
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+
+
+def _rope_embed_pallas(q, k, positions, theta: float = 10000.0):
+    from .pallas.rope import fused_rope
+
+    return fused_rope(q, k, positions, theta)
+
+
+KernelLoader.register("rope_embed", "pallas", _pallas_module("rope"), _rope_embed_pallas)
+KernelLoader.register("rope_embed", "xla", lambda: True, _rope_embed_xla)
+
+
+def rope_embed(q, k, positions, theta: float = 10000.0):
+    """Rotate q/k by RoPE at ``positions`` (in-kernel cos/sin tables)."""
+    return KernelLoader.load("rope_embed")(q, k, positions, theta=theta)
+
+
+def rope_and_cache_update(q, k, v, k_cache, v_cache, lengths, theta: float = 10000.0):
+    """Decode-step RoPE + KV-cache write fusion
+    (≙ fused_rotary_emb_and_cache + decode_kv_cache_memcpy)."""
+    from .pallas.rope import rope_and_cache_update as impl
+
+    return impl(q, k, v, k_cache, v_cache, lengths, theta)
 
 
 # ------------------------------------------------------------- silu_and_mul

@@ -1,0 +1,13 @@
+"""Shared helpers for the Pallas kernel modules."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Run kernels in interpret mode off-TPU (CPU tests, virtual meshes)."""
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except RuntimeError:
+        return True

@@ -455,11 +455,7 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
 # ------------------------------------------------------------- public entry
 
 
-def _interpret() -> bool:
-    try:
-        return jax.devices()[0].platform != "tpu"
-    except RuntimeError:
-        return True
+from ._common import interpret_mode as _interpret
 
 
 # (q, k, v, qpos, kpos, qseg, kseg) diff/nondiff: mask inputs get zero

@@ -1,0 +1,99 @@
+"""Pallas fused LayerNorm (+ optional residual add).
+
+≙ reference ``layer_norm_kernel.cu`` (683 LoC, Apex lineage: fused
+mean/variance + affine in one pass). Row-tiled over VMEM, fp32 statistics,
+custom VJP with the analytic LayerNorm gradient. The residual-add fusion
+mirrors ``fused_add_rms_layernorm``'s shape for the LayerNorm case.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_ROWS = 256
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(jnp.square(xc), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    o = xhat * scale_ref[:].astype(jnp.float32) + bias_ref[:].astype(jnp.float32)
+    o_ref[:] = o.astype(o_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _run_fwd(x2d, scale, bias, eps):
+    n, h = x2d.shape
+    rows = min(_BLOCK_ROWS, n)
+    if n % rows:
+        rows = n
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(n, rows),),
+        in_specs=[
+            pl.BlockSpec((rows, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, scale, bias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _layer_norm_2d(x2d, scale, bias, eps):
+    out, _, _ = _run_fwd(x2d, scale, bias, eps)
+    return out
+
+
+def _ln_fwd(x2d, scale, bias, eps):
+    out, mean, rstd = _run_fwd(x2d, scale, bias, eps)
+    return out, (x2d, scale, mean, rstd)
+
+
+def _ln_bwd(eps, res, g):
+    x2d, scale, mean, rstd = res
+    x = x2d.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    s = scale.astype(jnp.float32)
+    xhat = (x - mean) * rstd
+    gs = g * s
+    m1 = jnp.mean(gs, axis=-1, keepdims=True)
+    m2 = jnp.mean(gs * xhat, axis=-1, keepdims=True)
+    dx = rstd * (gs - m1 - xhat * m2)
+    dscale = jnp.sum(g * xhat, axis=0)
+    dbias = jnp.sum(g, axis=0)
+    return dx.astype(x2d.dtype), dscale.astype(scale.dtype), dbias.astype(scale.dtype)
+
+
+_layer_norm_2d.defvjp(_ln_fwd, _ln_bwd)
+
+
+def layer_norm(x, scale, bias, eps: float = 1e-5, residual=None):
+    """LayerNorm over the last dim; with residual returns (normed, x+residual)."""
+    if residual is not None:
+        x = x + residual
+    shape = x.shape
+    out = _layer_norm_2d(x.reshape(-1, shape[-1]), scale, bias, eps).reshape(shape)
+    return (out, x) if residual is not None else out

@@ -126,8 +126,4 @@ def paged_attention(
     return out.reshape(n_slots, h, d)
 
 
-def _interpret() -> bool:
-    try:
-        return jax.devices()[0].platform != "tpu"
-    except RuntimeError:
-        return True
+from ._common import interpret_mode as _interpret

@@ -18,11 +18,7 @@ from jax.experimental.pallas import tpu as pltpu
 _BLOCK_ROWS = 256
 
 
-def _interpret() -> bool:
-    try:
-        return jax.devices()[0].platform != "tpu"
-    except RuntimeError:
-        return True
+from ._common import interpret_mode as _interpret
 
 
 def _fwd_kernel(x_ref, scale_ref, o_ref, rstd_ref, *, eps):

@@ -1,0 +1,118 @@
+"""Pallas fused rotary position embedding (+ decode KV-cache write).
+
+≙ reference ``fused_rotary_emb_and_cache_kernel.cu`` (526 LoC),
+``get_cos_and_sin_kernel.cu`` (218) and ``decode_kv_cache_memcpy_kernel.cu``
+(216): one pass rotates q and k and, in the decode variant, scatters the
+rotated k (and v) into the KV cache at each sequence's current length.
+
+The cos/sin tables are computed in-kernel from positions (a [S, D/2] outer
+product — cheaper than streaming a precomputed table from HBM, the
+"get_cos_and_sin" fusion). HF half-split rotation convention, matching the
+models in ``colossalai_tpu.models``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _rope_kernel(q_ref, k_ref, pos_ref, o_q_ref, o_k_ref, *, theta):
+    # block: q [1, S, Hq, D], k [1, S, Hk, D], pos [1, S]
+    q = q_ref[:].astype(jnp.float32)
+    k = k_ref[:].astype(jnp.float32)
+    pos = pos_ref[:].astype(jnp.float32)  # [1, S]
+    d = q.shape[-1]
+    half = d // 2
+    inv_freq = jnp.exp(
+        jnp.arange(0, half, dtype=jnp.float32) * (-jnp.log(theta) / half)
+    )  # [half]
+    angles = pos[..., None] * inv_freq[None, None, :]  # [1, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [1, S, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+
+    o_q_ref[:] = rot(q).astype(o_q_ref.dtype)
+    o_k_ref[:] = rot(k).astype(o_k_ref.dtype)
+
+
+def _run_rope(q, k, positions, theta):
+    b, s, hq, d = q.shape
+    hk = k.shape[2]
+    spec = lambda h: pl.BlockSpec((1, s, h, d), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, theta=float(theta)),
+        grid=(b,),
+        in_specs=[
+            spec(hq),
+            spec(hk),
+            pl.BlockSpec((1, s), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[spec(hq), spec(hk)],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, positions)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_rope(q: jax.Array, k: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+    """Rotate q [B,S,Hq,D] and k [B,S,Hk,D] by RoPE at ``positions`` [B,S]."""
+    return tuple(_run_rope(q, k, positions, theta))
+
+
+def _rope_fwd(q, k, positions, theta):
+    return tuple(_run_rope(q, k, positions, theta)), positions
+
+
+def _rope_bwd(theta, positions, grads):
+    # rotation is orthogonal: the VJP is rotation by -pos
+    gq, gk = grads
+    dq, dk = _run_rope(gq, gk, -positions, theta)
+    return dq, dk, None
+
+
+fused_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+def rope_and_cache_update(
+    q: jax.Array,              # [B, 1, Hq, D] decode-step query
+    k: jax.Array,              # [B, 1, Hk, D]
+    v: jax.Array,              # [B, 1, Hk, D]
+    k_cache: jax.Array,        # [B, S_max, Hk, D]
+    v_cache: jax.Array,        # [B, S_max, Hk, D]
+    lengths: jax.Array,        # [B] current sequence lengths (write position)
+    theta: float = 10000.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Decode-step fusion: RoPE-rotate q/k at position ``lengths`` and write
+    the rotated k and v into the caches at that slot
+    (≙ fused_rotary_emb_and_cache + decode_kv_cache_memcpy).
+
+    Returns (q_rot, k_cache', v_cache'). The scatter is a dynamic-slice
+    update along the seq dim — XLA keeps it in-place under jit thanks to
+    buffer donation of the caches by the inference engine.
+    """
+    pos = lengths[:, None].astype(jnp.int32)  # [B, 1]
+    q_rot, k_rot = fused_rope(q, k, pos, theta)
+
+    def write(cache, val):
+        def one(c, x, l):
+            return jax.lax.dynamic_update_slice(c, x.astype(c.dtype), (l, 0, 0))
+
+        return jax.vmap(one)(cache, val, lengths.astype(jnp.int32))
+
+    return q_rot, write(k_cache, k_rot), write(v_cache, v)

@@ -1,0 +1,123 @@
+"""Pallas fused scaled masked softmax.
+
+≙ reference ``scaled_masked_softmax_kernel.cu`` (533 LoC) and
+``scaled_upper_triang_masked_softmax_kernel.cu`` (563 LoC): the Megatron
+fused-softmax pair used on attention scores when flash attention is off.
+One kernel serves both — the causal (upper-triangular) variant is the
+``causal=True`` path computing its mask from row/col ids instead of loading
+a mask tensor. Row-tiled, fp32 math, custom VJP (softmax backward fused the
+same way).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e9
+_BLOCK_ROWS = 256
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _fwd_kernel(x_ref, o_ref, *, scale, causal, rows, sq):
+    x = x_ref[:].astype(jnp.float32) * scale  # [rows, s]
+    if causal:
+        i = pl.program_id(0)
+        # row index within the [sq, s] square this flat row belongs to:
+        # tiles may straddle square boundaries, the modulo keeps it exact
+        row = (i * rows + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)) % sq
+        col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(col <= row, x, _NEG_INF)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    p = jnp.exp(x - m)
+    o_ref[:] = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _masked_fwd_kernel(x_ref, mask_ref, o_ref, *, scale):
+    x = x_ref[:].astype(jnp.float32) * scale
+    x = jnp.where(mask_ref[:] != 0, _NEG_INF, x)  # mask==1 means MASKED (≙ ref)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    p = jnp.exp(x - m)
+    o_ref[:] = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _run_fwd(x2d, mask2d, scale, causal, sq):
+    import math
+
+    n, s = x2d.shape
+    # tile over the FLAT row count (leading dims x S_q) — s_q need not equal
+    # s_k, and the tile size must divide n, not s
+    rows = math.gcd(n, _BLOCK_ROWS)
+    grid = (n // rows,)
+    spec = pl.BlockSpec((rows, s), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    if mask2d is None:
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel, scale=scale, causal=causal, rows=rows, sq=sq),
+            grid=grid,
+            in_specs=[spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            interpret=_interpret(),
+        )(x2d)
+    return pl.pallas_call(
+        functools.partial(_masked_fwd_kernel, scale=scale),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, mask2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _softmax_2d(x2d, mask2d, scale, causal, s):
+    return _run_fwd(x2d, mask2d, scale, causal, s)
+
+
+def _sm_fwd(x2d, mask2d, scale, causal, s):
+    p = _run_fwd(x2d, mask2d, scale, causal, s)
+    return p, p
+
+
+def _sm_bwd(scale, causal, s, p, g):
+    pf = p.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    dx = pf * (gf - jnp.sum(pf * gf, axis=-1, keepdims=True)) * scale
+    return dx.astype(p.dtype), None
+
+
+_softmax_2d.defvjp(_sm_fwd, _sm_bwd)
+
+
+def scaled_masked_softmax(x: jax.Array, mask: Optional[jax.Array] = None,
+                          scale: float = 1.0) -> jax.Array:
+    """softmax(scale * x) with optional additive mask tensor.
+
+    ``x``: [..., S_q, S_k]; ``mask``: broadcastable [..., S_q, S_k] with
+    nonzero = masked (the reference kernel's convention).
+    """
+    shape = x.shape
+    s = shape[-1]
+    x2d = x.reshape(-1, s)
+    mask2d = None
+    if mask is not None:
+        mask2d = jnp.broadcast_to(mask, shape).reshape(-1, s).astype(jnp.int32)
+    sq = shape[-2] if x.ndim >= 2 else 1
+    return _softmax_2d(x2d, mask2d, float(scale), False, sq).reshape(shape)
+
+
+def scaled_upper_triang_masked_softmax(x: jax.Array, scale: float = 1.0) -> jax.Array:
+    """Causal softmax(scale * x) for square score matrices [..., S, S]
+    (≙ scaled_upper_triang_masked_softmax_kernel.cu)."""
+    shape = x.shape
+    if shape[-1] != shape[-2]:
+        raise ValueError(f"causal fused softmax needs square scores, got {shape}")
+    s = shape[-1]
+    return _softmax_2d(x.reshape(-1, s), None, float(scale), True, s).reshape(shape)

@@ -1,0 +1,146 @@
+"""LayerNorm / fused softmax / fused RoPE Pallas kernels vs XLA references
+(interpret mode on CPU), forward and backward.
+
+≙ reference kernel unit tests for layer_norm_kernel.cu,
+scaled_(upper_triang_)masked_softmax_kernel.cu and
+fused_rotary_emb_and_cache_kernel.cu.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.kernel.ops import (
+    _fused_softmax_xla,
+    _layer_norm_xla,
+    _rope_embed_xla,
+)
+from colossalai_tpu.kernel.pallas.layer_norm import layer_norm
+from colossalai_tpu.kernel.pallas.rope import fused_rope, rope_and_cache_update
+from colossalai_tpu.kernel.pallas.softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+def test_layer_norm_matches_xla_fwd_bwd():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 64, 256), jnp.float32)
+    scale = jax.random.normal(jax.random.PRNGKey(1), (256,)) * 0.1 + 1.0
+    bias = jax.random.normal(jax.random.PRNGKey(2), (256,)) * 0.1
+
+    out_p = layer_norm(x, scale, bias)
+    out_x = _layer_norm_xla(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), rtol=2e-5, atol=2e-5)
+
+    def loss_p(x, s, b):
+        return jnp.sum(jnp.square(layer_norm(x, s, b)))
+
+    def loss_x(x, s, b):
+        return jnp.sum(jnp.square(_layer_norm_xla(x, s, b)))
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(x, scale, bias)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_ in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_residual_variant():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 128), jnp.float32)
+    r = jax.random.normal(jax.random.PRNGKey(1), (8, 128), jnp.float32)
+    scale, bias = jnp.ones((128,)), jnp.zeros((128,))
+    normed, resid = layer_norm(x, scale, bias, residual=r)
+    np.testing.assert_allclose(np.asarray(resid), np.asarray(x + r), rtol=1e-6)
+    want, _ = layer_norm(x + r, scale, bias, residual=jnp.zeros_like(r))
+    np.testing.assert_allclose(np.asarray(normed), np.asarray(want), rtol=1e-6)
+
+
+def test_causal_softmax_matches_xla_fwd_bwd():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 256, 256), jnp.float32)
+    scale = 0.125
+    out_p = scaled_upper_triang_masked_softmax(x, scale)
+    out_x = _fused_softmax_xla(x, scale=scale, causal=True)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), rtol=2e-5, atol=2e-5)
+
+    gp = jax.grad(lambda a: jnp.sum(scaled_upper_triang_masked_softmax(a, scale) ** 2))(x)
+    gx = jax.grad(lambda a: jnp.sum(_fused_softmax_xla(a, scale=scale, causal=True) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx), rtol=1e-4, atol=1e-4)
+
+
+def test_nonsquare_softmax_matches_xla():
+    """Cross-attention / decode shapes: S_q != S_k (regression: the grid
+    must tile the flat row count, not assume square scores)."""
+    for shape in [(1, 4, 8), (3, 6, 8), (2, 2, 96, 160)]:
+        x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+        out_p = scaled_masked_softmax(x, scale=0.7)
+        out_x = _fused_softmax_xla(x, scale=0.7)
+        np.testing.assert_allclose(
+            np.asarray(out_p), np.asarray(out_x), rtol=2e-5, atol=2e-5,
+            err_msg=f"shape {shape}",
+        )
+
+
+def test_masked_softmax_matches_xla():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 128, 128), jnp.float32)
+    keep = jax.random.bernoulli(jax.random.PRNGKey(1), 0.8, (2, 1, 128, 128))
+    # kernel convention: nonzero = masked OUT
+    out_p = scaled_masked_softmax(x, mask=~keep, scale=0.5)
+    out_x = _fused_softmax_xla(x, scale=0.5, mask=keep)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), rtol=2e-5, atol=2e-5)
+
+
+def test_fused_rope_matches_xla_fwd_bwd():
+    b, s, hq, hk, d = 2, 64, 4, 2, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 2)
+    q = jax.random.normal(ks[0], (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, hk, d), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    qp, kp = fused_rope(q, k, pos)
+    qx, kx = _rope_embed_xla(q, k, pos)
+    np.testing.assert_allclose(np.asarray(qp), np.asarray(qx), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kp), np.asarray(kx), rtol=2e-5, atol=2e-5)
+
+    def lp(q, k):
+        a, b_ = fused_rope(q, k, pos)
+        return jnp.sum(a * a) + jnp.sum(b_ * b_)
+
+    def lx(q, k):
+        a, b_ = _rope_embed_xla(q, k, pos)
+        return jnp.sum(a * a) + jnp.sum(b_ * b_)
+
+    gp = jax.grad(lp, argnums=(0, 1))(q, k)
+    gx = jax.grad(lx, argnums=(0, 1))(q, k)
+    for a, b_ in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
+
+
+def test_rope_offset_positions():
+    """Decode-style single position offsets rotate exactly like the table."""
+    b, hq, d = 3, 2, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, 1, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, 1, 1, d), jnp.float32)
+    pos = jnp.asarray([[5], [17], [0]], jnp.int32)
+    qp, kp = fused_rope(q, k, pos)
+    qx, kx = _rope_embed_xla(q, k, pos)
+    np.testing.assert_allclose(np.asarray(qp), np.asarray(qx), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kp), np.asarray(kx), rtol=2e-5, atol=2e-5)
+
+
+def test_rope_and_cache_update_scatters_at_lengths():
+    b, s_max, hk, d = 2, 32, 2, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, 1, 4, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, 1, hk, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, 1, hk, d), jnp.float32)
+    k_cache = jnp.zeros((b, s_max, hk, d))
+    v_cache = jnp.zeros((b, s_max, hk, d))
+    lengths = jnp.asarray([3, 7], jnp.int32)
+    q_rot, kc, vc = rope_and_cache_update(q, k, v, k_cache, v_cache, lengths)
+    _, k_want = _rope_embed_xla(q, k, lengths[:, None])
+    for i, l in enumerate([3, 7]):
+        np.testing.assert_allclose(np.asarray(kc[i, l]), np.asarray(k_want[i, 0]), rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(vc[i, l]), np.asarray(v[i, 0]), rtol=1e-6)
+        # untouched rows stay zero
+        assert float(jnp.abs(kc[i, :l]).max()) == 0.0
+        assert float(jnp.abs(vc[i, l + 1 :]).max()) == 0.0
