@@ -1,0 +1,3 @@
+from .trainer import ElasticTrainer, PreemptionGuard
+
+__all__ = ["ElasticTrainer", "PreemptionGuard"]

@@ -1,0 +1,154 @@
+"""Elastic training: periodic async checkpoints, preemption-aware exit,
+crash auto-resume.
+
+The reference has NO elastic layer (SURVEY §5: "no elastic agent; recovery =
+checkpoint/resume" — test-level retries only, ``testing/utils.py:71``). This
+closes that gap the TPU way: a functional train state makes resume exact —
+restore the last durable ``TrainState`` and replay from its ``step``. On
+TPU pods, preemption arrives as SIGTERM well before the kill; the guard
+turns it into a final synchronous checkpoint and clean exit, so the next
+incarnation of the job resumes losslessly.
+
+Restart semantics are deterministic: data is drawn from ``data_fn(step)``
+(step-indexed, not an opaque iterator), so a resumed run consumes exactly
+the batches the lost run would have.
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from colossalai_tpu.logging import get_dist_logger
+
+
+class PreemptionGuard:
+    """Converts SIGTERM/SIGINT into a cooperative "stop now" flag
+    (≙ TPU maintenance-event notice; GCE preemption sends SIGTERM)."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = signals
+        self._previous: Dict[int, Any] = {}
+        self.triggered = False
+
+    def __enter__(self):
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def _handler(self, signum, frame):
+        self.triggered = True
+
+    def __exit__(self, *exc):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        return False
+
+
+class ElasticTrainer:
+    """Checkpointed train loop with bounded crash-retry.
+
+    >>> trainer = ElasticTrainer(booster, boosted, ckpt_dir, save_every=50)
+    >>> metrics = trainer.fit(data_fn, total_steps=1000)
+
+    ``data_fn(step) -> batch``: step-indexed batch source. On entry, the
+    latest checkpoint in ``ckpt_dir`` (if any) is restored and training
+    continues from its step — running the same command after ANY interruption
+    (crash, preemption, requeue) resumes the run.
+    """
+
+    def __init__(self, booster, boosted, ckpt_dir: str, *,
+                 save_every: int = 100, max_restarts: int = 3,
+                 log_every: int = 0):
+        self.booster = booster
+        self.boosted = boosted
+        self.ckpt_dir = ckpt_dir
+        self.save_every = save_every
+        self.max_restarts = max_restarts
+        self.log_every = log_every
+        self.logger = get_dist_logger()
+        self.restarts = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def _latest_step(self) -> Optional[int]:
+        mgr = self.booster.checkpoint_io._manager(self.ckpt_dir)
+        return mgr.latest_step()
+
+    def _resume_if_possible(self) -> int:
+        latest = self._latest_step()
+        if latest is None:
+            return int(jax.device_get(self.boosted.state.step))
+        self.booster.checkpoint_io.wait()
+        self.boosted.state = self.booster.checkpoint_io.load_state(
+            self.boosted.state, self.ckpt_dir, step=latest
+        )
+        step = int(jax.device_get(self.boosted.state.step))
+        self.logger.info(f"elastic: resumed from checkpoint step {step}")
+        return step
+
+    def _checkpoint(self, step: int) -> None:
+        self.booster.save(self.boosted, self.ckpt_dir, step=step)
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data_fn: Callable[[int], Dict[str, Any]], total_steps: int,
+            on_step: Optional[Callable[[int, Dict], None]] = None) -> List[float]:
+        """Run to ``total_steps``, checkpointing every ``save_every`` steps;
+        crashes inside the loop retry from the last durable state up to
+        ``max_restarts`` times. Returns the loss per executed step (keyed by
+        step — a replayed step overwrites its first attempt's entry)."""
+        loss_by_step: Dict[int, float] = {}
+        with PreemptionGuard() as guard:
+            while True:
+                try:
+                    if self._latest_step() is None:
+                        # durable recovery point BEFORE any step runs: the
+                        # train step donates its input state, so after a
+                        # mid-step failure the in-memory state is unusable —
+                        # retries must always have a checkpoint to restore
+                        step0 = int(jax.device_get(self.boosted.state.step))
+                        self._checkpoint(step0)
+                        self.booster.wait()
+                    step = self._resume_if_possible()
+                    while step < total_steps:
+                        batch = data_fn(step)
+                        self.boosted.state, metrics = self.boosted.train_step(
+                            self.boosted.state, batch
+                        )
+                        # scalar fetch = real sync point on tunneled TPUs
+                        loss = float(metrics["loss"])
+                        loss_by_step[step] = loss
+                        step += 1
+                        if self.log_every and step % self.log_every == 0:
+                            self.logger.info(f"step {step}: loss {loss:.4f}")
+                        if on_step is not None:
+                            on_step(step, metrics)
+                        if guard.triggered:
+                            self.logger.warning(
+                                f"elastic: preemption signal at step {step}; "
+                                "writing final checkpoint"
+                            )
+                            self._checkpoint(step)
+                            self.booster.wait()
+                            return [loss_by_step[k] for k in sorted(loss_by_step)]
+                        if self.save_every and step % self.save_every == 0:
+                            self._checkpoint(step)
+                    self._checkpoint(step)
+                    self.booster.wait()
+                    return [loss_by_step[k] for k in sorted(loss_by_step)]
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as exc:  # crash path: bounded resume
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        self.logger.error(
+                            f"elastic: giving up after {self.max_restarts} restarts"
+                        )
+                        raise
+                    self.logger.warning(
+                        f"elastic: step failed ({type(exc).__name__}: {exc}); "
+                        f"restart {self.restarts}/{self.max_restarts} from last checkpoint"
+                    )
+                    time.sleep(0.1)
