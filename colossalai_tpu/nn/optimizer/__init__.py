@@ -149,8 +149,15 @@ def came(
 DistributedCAME = came
 
 from .disk_offload import DiskOffloadedAdamW, DiskTensorStore
+from .galore import GaLoreState, galore_adamw
+
+#: ≙ DistGaloreAwamW (distributed_galore.py:21) — sharding distributes it
+DistGaloreAwamW = galore_adamw
 
 __all__ = [
+    "DistGaloreAwamW",
+    "GaLoreState",
+    "galore_adamw",
     "DiskOffloadedAdamW",
     "DiskTensorStore",
     "FusedAdam",

@@ -1,0 +1,130 @@
+"""GaLore: gradient low-rank projection optimizer.
+
+≙ reference ``DistGaloreAwamW`` (``nn/optimizer/distributed_galore.py:21``,
+bnb 8-bit AdamW over GaLore-projected gradients). The memory story is the
+rank-r projection: AdamW moments live in the projected space (r x n instead
+of m x n), an order-of-magnitude optimizer-state cut for large matrices.
+The reference adds bnb 8-bit block quantization of those (already small)
+moments; here states are fp32 — on TPU the projection is the win and the
+states shard over dp (ZeRO) like any optax state.
+
+Projector refresh (every ``update_proj_gap`` steps) runs an SVD of the
+current gradient under ``lax.cond``, so the train step stays a single jit:
+XLA compiles both branches, executes one — refresh cost is paid only on
+refresh steps. Distribution falls out of GSPMD: projected moments inherit
+the un-projected dim's sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class _GaloreLeaf(NamedTuple):
+    proj: jax.Array  # projector, (small_dim, r)
+    mu: jax.Array    # projected first moment
+    nu: jax.Array    # projected second moment
+
+
+class GaLoreState(NamedTuple):
+    count: jax.Array
+    leaves: Any      # _GaloreLeaf for projected params; (mu, nu) for others
+
+
+def _projectable(shape, rank) -> bool:
+    return len(shape) == 2 and min(shape) > rank
+
+
+def galore_adamw(
+    learning_rate: float = 1e-3,
+    rank: int = 128,
+    update_proj_gap: int = 200,
+    scale: float = 0.25,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW on rank-``rank`` projected gradients for 2-D params; plain AdamW
+    for everything else (embeddings stay full-rank in the reference too).
+
+    For W [m, n] with m <= n: P [m, r] from the left singular vectors,
+    projected grad P^T g is [r, n]; mirrored for m > n. The update is
+    projected back with ``scale`` (GaLore's alpha).
+    """
+
+    def init_fn(params):
+        def leaf(p):
+            if _projectable(p.shape, rank):
+                m, n = p.shape
+                if m <= n:
+                    proj = jnp.zeros((m, rank), jnp.float32)
+                    lowrank = (rank, n)
+                else:
+                    proj = jnp.zeros((n, rank), jnp.float32)
+                    lowrank = (m, rank)
+                return _GaloreLeaf(
+                    proj=proj,
+                    mu=jnp.zeros(lowrank, jnp.float32),
+                    nu=jnp.zeros(lowrank, jnp.float32),
+                )
+            return (jnp.zeros_like(p, jnp.float32), jnp.zeros_like(p, jnp.float32))
+
+        return GaLoreState(
+            count=jnp.zeros((), jnp.int32),
+            leaves=jax.tree.map(leaf, params),
+        )
+
+    def update_fn(grads, state, params=None):
+        count = state.count + 1
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+
+        def leaf(g, p, st):
+            g32 = g.astype(jnp.float32)
+            if isinstance(st, _GaloreLeaf):
+                m, n = g32.shape
+                left = m <= n
+
+                def refresh(_):
+                    # projector from the dominant singular subspace of g
+                    u, _, vt = jnp.linalg.svd(g32, full_matrices=False)
+                    return u[:, :rank] if left else vt[:rank, :].T
+
+                first = count == 1
+                due = (state.count % update_proj_gap == 0) | first
+                proj = jax.lax.cond(due, refresh, lambda _: st.proj, None)
+                g_lr = proj.T @ g32 if left else g32 @ proj
+                mu = b1 * st.mu + (1 - b1) * g_lr
+                nu = b2 * st.nu + (1 - b2) * jnp.square(g_lr)
+                upd_lr = (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+                upd = proj @ upd_lr if left else upd_lr @ proj.T
+                upd = scale * upd
+                if weight_decay > 0 and p is not None:
+                    upd = upd + weight_decay * p.astype(jnp.float32)
+                return (-learning_rate * upd).astype(g.dtype), _GaloreLeaf(proj, mu, nu)
+            mu, nu = st
+            mu = b1 * mu + (1 - b1) * g32
+            nu = b2 * nu + (1 - b2) * jnp.square(g32)
+            upd = (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+            if weight_decay > 0 and p is not None:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return (-learning_rate * upd).astype(g.dtype), (mu, nu)
+
+        g_flat, treedef = jax.tree_util.tree_flatten(grads)
+        p_flat = (
+            treedef.flatten_up_to(params) if params is not None
+            else [None] * len(g_flat)
+        )
+        # per-param state nodes (a _GaloreLeaf or (mu, nu) tuple each)
+        s_flat = treedef.flatten_up_to(state.leaves)
+        out = [leaf(g, p, st) for g, p, st in zip(g_flat, p_flat, s_flat)]
+        updates = jax.tree_util.tree_unflatten(treedef, [u for u, _ in out])
+        new_leaves = jax.tree_util.tree_unflatten(treedef, [s for _, s in out])
+        return updates, GaLoreState(count=count, leaves=new_leaves)
+
+    return optax.GradientTransformation(init_fn, update_fn)
