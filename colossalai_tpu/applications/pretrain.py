@@ -1,0 +1,158 @@
+"""Continued-pretraining pipeline: tokenizer/vocab expansion, dedup, packing.
+
+≙ reference ``applications/Colossal-LLaMA`` (continued pretraining of llama
+with an expanded tokenizer and deduplicated domain data): there a torch
+script resizes ``embed_tokens``/``lm_head`` and preprocesses jsonl corpora.
+Here the same three capabilities, functional:
+
+- :func:`expand_vocab` grows the embedding and LM head rows of a param tree
+  (new rows = mean of old embeddings + small noise, the Colossal-LLaMA
+  init), returning params + the config to rebuild the model.
+- :func:`dedup_exact` / :func:`dedup_minhash` drop duplicate documents
+  (hash + MinHash-Jaccard, ≙ the dedup stage of its data pipeline).
+- :func:`pack_sequences` packs tokenized documents into fixed-length rows
+  with segment ids, so attention stays per-document (the models' packed
+  ``segment_ids`` path) and no compute is wasted on padding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EMBED_NAMES = ("embed_tokens", "wte", "embedding", "shared", "word_embeddings")
+_HEAD_NAMES = ("lm_head",)
+
+
+def expand_vocab(params: Any, config: Any, new_vocab_size: int,
+                 rng: Optional[jax.Array] = None, noise: float = 0.02):
+    """Grow vocab rows of every embedding/LM-head leaf to ``new_vocab_size``.
+
+    Returns ``(new_params, new_config)``; rebuild the model with
+    ``type(model)(new_config)``. New rows are initialized to the mean of the
+    existing embeddings plus gaussian noise — the Colossal-LLaMA recipe, so
+    new tokens start as "average words" instead of random vectors.
+    """
+    old_vocab = config.vocab_size
+    if new_vocab_size < old_vocab:
+        raise ValueError(f"cannot shrink vocab {old_vocab} -> {new_vocab_size}")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def visit(kp, leaf):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp)
+        parts = path.split("/")
+        is_embed = any(n in parts for n in _EMBED_NAMES) and leaf.ndim == 2 and leaf.shape[0] == old_vocab
+        is_head = any(n in parts for n in _HEAD_NAMES) and leaf.ndim == 2 and leaf.shape[-1] == old_vocab
+        if is_embed:
+            mean = leaf.mean(0, keepdims=True)
+            extra = mean + noise * jax.random.normal(
+                jax.random.fold_in(rng, zlib.crc32(path.encode()) % (2**31)),
+                (new_vocab_size - old_vocab, leaf.shape[1]), leaf.dtype,
+            )
+            return jnp.concatenate([leaf, extra.astype(leaf.dtype)], 0)
+        if is_head:
+            mean = leaf.mean(-1, keepdims=True)
+            extra = mean + noise * jax.random.normal(
+                jax.random.fold_in(rng, zlib.crc32(path.encode()) % (2**31)),
+                leaf.shape[:-1] + (new_vocab_size - old_vocab,), leaf.dtype,
+            )
+            return jnp.concatenate([leaf, extra.astype(leaf.dtype)], -1)
+        return leaf
+
+    new_params = jax.tree_util.tree_map_with_path(visit, params)
+    new_config = dataclasses.replace(config, vocab_size=new_vocab_size)
+    return new_params, new_config
+
+
+# ------------------------------------------------------------------- dedup
+
+
+def dedup_exact(docs: Iterable[str]) -> List[str]:
+    """Drop exact duplicates (normalized whitespace), keeping first
+    occurrence — the cheap first stage of the Colossal-LLaMA dedup."""
+    seen, out = set(), []
+    for d in docs:
+        key = hashlib.sha1(" ".join(d.split()).encode()).hexdigest()
+        if key not in seen:
+            seen.add(key)
+            out.append(d)
+    return out
+
+
+def _minhash_sig(tokens: Sequence[str], num_perm: int, n: int = 3) -> np.ndarray:
+    """MinHash signature over word n-gram shingles."""
+    shingles = {" ".join(tokens[i:i + n]) for i in range(max(1, len(tokens) - n + 1))}
+    hashes = np.array(
+        [[int(hashlib.md5(f"{p}:{s}".encode()).hexdigest()[:8], 16)
+          for s in shingles] for p in range(num_perm)],
+        np.uint32,
+    )
+    return hashes.min(axis=1)
+
+
+def dedup_minhash(docs: Sequence[str], threshold: float = 0.8,
+                  num_perm: int = 32) -> List[str]:
+    """Near-duplicate removal by MinHash-estimated Jaccard similarity
+    (quadratic scan — for the corpus sizes of a finetune run; the reference
+    app shells out to a similar datasketch pass)."""
+    kept: List[str] = []
+    sigs: List[np.ndarray] = []
+    for d in docs:
+        sig = _minhash_sig(d.split(), num_perm)
+        dup = any(float((sig == s).mean()) >= threshold for s in sigs)
+        if not dup:
+            kept.append(d)
+            sigs.append(sig)
+    return kept
+
+
+# ----------------------------------------------------------------- packing
+
+
+def pack_sequences(token_lists: Sequence[Sequence[int]], seq_len: int,
+                   pad_id: int = 0) -> Dict[str, np.ndarray]:
+    """Greedy first-fit packing of tokenized documents into [N, seq_len]
+    rows with per-document ``segment_ids`` (1-based; 0 = padding) and
+    pre-shifted ``labels`` masked (-100) at padding AND document boundaries
+    (the next-token target across a boundary is meaningless).
+    """
+    bins: List[List[int]] = []          # token ids per row
+    seg_bins: List[List[int]] = []      # segment ids per row
+    space: List[int] = []               # free space per row
+    counts: List[int] = []              # docs per row
+    for toks in token_lists:
+        toks = list(toks)[:seq_len]
+        placed = False
+        for i in range(len(bins)):
+            if space[i] >= len(toks):
+                counts[i] += 1
+                seg_bins[i].extend([counts[i]] * len(toks))
+                bins[i].extend(toks)
+                space[i] -= len(toks)
+                placed = True
+                break
+        if not placed:
+            bins.append(list(toks))
+            seg_bins.append([1] * len(toks))
+            counts.append(1)
+            space.append(seq_len - len(toks))
+
+    n = len(bins)
+    ids = np.full((n, seq_len), pad_id, np.int32)
+    segs = np.zeros((n, seq_len), np.int32)
+    for i, (row, seg) in enumerate(zip(bins, seg_bins)):
+        ids[i, : len(row)] = row
+        segs[i, : len(seg)] = seg
+    labels = np.full((n, seq_len), -100, np.int64)
+    labels[:, :-1] = ids[:, 1:]
+    # mask targets that cross a document boundary or land on padding
+    same_doc = (segs[:, :-1] == segs[:, 1:]) & (segs[:, :-1] != 0)
+    labels[:, :-1] = np.where(same_doc, labels[:, :-1], -100)
+    labels[:, -1] = -100
+    return {"input_ids": ids, "segment_ids": segs, "labels": labels}

@@ -1,0 +1,110 @@
+"""Retrieval-augmented QA: vector store + RAG pipeline.
+
+≙ reference ``applications/ColossalQA`` (RAG chatbot: langchain retriever +
+vector store + conversation memory over a Colossal-served LLM). TPU-native,
+dependency-free equivalent:
+
+- :class:`VectorStore` — document embeddings in one device array; top-k by
+  a single jitted matmul (the MXU IS the vector index at these sizes).
+- :func:`embed_texts` — mean-pooled hidden states from any backbone in this
+  repo (the reference uses an external sentence-transformer).
+- :class:`RAGPipeline` — retrieve → prompt assembly → generate via the
+  inference engine, with a sliding conversation memory
+  (≙ ConversationBufferWithSummary, minus the summarizer model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def embed_texts(model, params, token_batches: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Mean-pooled final hidden states as document embeddings, L2-normalized.
+    ``token_batches``: list of [1, S_i] id arrays (ragged docs)."""
+    outs = []
+    for ids in token_batches:
+        out = model.apply({"params": params}, jnp.asarray(ids))
+        h = out.hidden_states
+        if h is None:
+            raise ValueError("backbone must return hidden_states for embedding")
+        outs.append(jnp.mean(h[0].astype(jnp.float32), axis=0))
+    emb = jnp.stack(outs)
+    return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+
+class VectorStore:
+    """Cosine-similarity store over a single [N, D] device array."""
+
+    def __init__(self):
+        self._embs: Optional[jnp.ndarray] = None
+        self._docs: List[str] = []
+
+    def add(self, docs: Sequence[str], embeddings: jnp.ndarray) -> None:
+        embeddings = jnp.asarray(embeddings, jnp.float32)
+        norm = jnp.linalg.norm(embeddings, axis=-1, keepdims=True).clip(1e-6)
+        embeddings = embeddings / norm
+        self._docs.extend(docs)
+        self._embs = (
+            embeddings if self._embs is None
+            else jnp.concatenate([self._embs, embeddings], 0)
+        )
+
+    def __len__(self) -> int:
+        return len(self._docs)
+
+    def search(self, query_emb: jnp.ndarray, k: int = 4) -> List[Tuple[str, float]]:
+        if self._embs is None:
+            return []
+        q = jnp.asarray(query_emb, jnp.float32).reshape(-1)
+        q = q / jnp.linalg.norm(q).clip(1e-6)
+        scores = self._embs @ q  # one matvec — the whole "index"
+        k = min(k, len(self._docs))
+        top = jax.lax.top_k(scores, k)
+        idx = np.asarray(top[1])
+        val = np.asarray(top[0])
+        return [(self._docs[i], float(s)) for i, s in zip(idx, val)]
+
+
+_PROMPT = (
+    "Use the context to answer the question.\n"
+    "{history}Context:\n{context}\n\nQuestion: {question}\nAnswer:"
+)
+
+
+@dataclasses.dataclass
+class RAGPipeline:
+    """retrieve → assemble → generate (≙ ColossalQA RetrievalQA chain).
+
+    ``generate_fn(prompt) -> str``: any text-in/text-out callable — the
+    inference engine's generate, or a stub in tests.
+    ``embed_fn(text) -> [D]`` embedding for queries and documents.
+    """
+
+    embed_fn: Callable[[str], jnp.ndarray]
+    generate_fn: Callable[[str], str]
+    store: VectorStore = dataclasses.field(default_factory=VectorStore)
+    top_k: int = 4
+    memory_turns: int = 4
+
+    def __post_init__(self):
+        self._history: List[Tuple[str, str]] = []
+
+    def add_documents(self, docs: Sequence[str]) -> None:
+        embs = jnp.stack([self.embed_fn(d) for d in docs])
+        self.store.add(docs, embs)
+
+    def ask(self, question: str) -> dict:
+        hits = self.store.search(self.embed_fn(question), self.top_k)
+        context = "\n---\n".join(doc for doc, _ in hits)
+        history = "".join(
+            f"Q: {q}\nA: {a}\n" for q, a in self._history[-self.memory_turns:]
+        )
+        prompt = _PROMPT.format(history=history, context=context, question=question)
+        answer = self.generate_fn(prompt)
+        self._history.append((question, answer))
+        return {"answer": answer, "sources": hits, "prompt": prompt}

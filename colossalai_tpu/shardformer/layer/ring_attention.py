@@ -19,6 +19,14 @@ splitting. The TPU design:
   sees the same causal workload. Correctness is position-based — each chunk
   carries global position ids, so the mask is exact regardless of layout;
   sliding windows and packed segment ids ride the same masks.
+- **double-ring is deliberately absent**: the reference splits the sp group
+  into inner/inter rings (``get_double_ring_groups``, ``attn.py:445``)
+  because NCCL P2P must keep NVLink AND the NIC busy simultaneously. On TPU
+  every ``ppermute`` hop is a nearest-neighbour ICI transfer (the compiler
+  routes the torus); there is no second fabric to saturate inside a slice,
+  so a two-level ring would only add latency. Multi-pod DCN scaling is
+  handled above this layer by keeping ``sp`` inside a slice (mesh
+  construction orders axes so sp rides ICI, ``device/device_mesh.py``).
 - the flash path has a hand-written ring backward (``custom_vjp``): probs
   are recomputed against the GLOBAL lse, which linearizes the merge — each
   ring step runs the flash backward and dk/dv accumulators travel around

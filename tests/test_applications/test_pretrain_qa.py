@@ -1,0 +1,118 @@
+"""Continued-pretraining pipeline (vocab expansion, dedup, packing) and the
+RAG QA stack (≙ Colossal-LLaMA + ColossalQA smoke coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.applications.pretrain import (
+    dedup_exact,
+    dedup_minhash,
+    expand_vocab,
+    pack_sequences,
+)
+from colossalai_tpu.applications.qa import RAGPipeline, VectorStore, embed_texts
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def test_expand_vocab_preserves_old_rows_and_logits():
+    cfg = LlamaConfig.tiny(tie_word_embeddings=False)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    new_params, new_cfg = expand_vocab(params, cfg, cfg.vocab_size + 32)
+    assert new_cfg.vocab_size == cfg.vocab_size + 32
+    emb_old = params["embed_tokens"]["embedding"]
+    emb_new = new_params["embed_tokens"]["embedding"]
+    assert emb_new.shape[0] == cfg.vocab_size + 32
+    np.testing.assert_array_equal(np.asarray(emb_old), np.asarray(emb_new[: cfg.vocab_size]))
+    # old-token logits unchanged under the grown model
+    grown = LlamaForCausalLM(new_cfg)
+    out_old = model.apply({"params": params}, ids).logits
+    out_new = grown.apply({"params": new_params}, ids).logits
+    np.testing.assert_allclose(
+        np.asarray(out_old), np.asarray(out_new[..., : cfg.vocab_size]),
+        rtol=1e-5, atol=1e-5,
+    )
+    # new rows start near the mean embedding, not at random scale
+    mean = np.asarray(emb_old).mean(0)
+    spread = np.abs(np.asarray(emb_new[cfg.vocab_size:]) - mean).max()
+    assert spread < 0.2
+
+
+def test_dedup():
+    docs = ["the cat sat on the mat", "the cat  sat on the mat", "dogs are great"]
+    assert len(dedup_exact(docs)) == 2
+    near = [
+        "alpha beta gamma delta epsilon zeta eta theta",
+        "alpha beta gamma delta epsilon zeta eta iota",  # near-dup
+        "completely different text about tpus and compilers here",
+    ]
+    kept = dedup_minhash(near, threshold=0.5)
+    assert len(kept) == 2 and kept[0] == near[0] and kept[1] == near[2]
+
+
+def test_pack_sequences_segments_and_labels():
+    docs = [[1, 2, 3, 4, 5], [6, 7, 8], [9, 10], [11, 12, 13, 14, 15, 16]]
+    out = pack_sequences(docs, seq_len=8, pad_id=0)
+    ids, segs, labels = out["input_ids"], out["segment_ids"], out["labels"]
+    assert ids.shape == segs.shape == labels.shape
+    # every document's tokens contiguous under one segment id
+    for d, doc in enumerate(docs):
+        found = False
+        for i in range(ids.shape[0]):
+            for s in range(ids.shape[1] - len(doc) + 1):
+                if list(ids[i, s : s + len(doc)]) == doc and len(set(segs[i, s : s + len(doc)])) == 1:
+                    found = True
+        assert found, f"doc {doc} not packed intact"
+    # no label crosses a boundary: target segment must match source segment
+    same = (segs[:, :-1] == segs[:, 1:]) & (segs[:, :-1] != 0)
+    assert np.all(labels[:, :-1][~same] == -100)
+    assert np.all(labels[:, :-1][same] == ids[:, 1:][same])
+    # packing actually packs: fewer rows than docs
+    assert ids.shape[0] < len(docs)
+
+
+def test_rag_pipeline_retrieves_and_answers():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+
+    def tokenize(text):  # toy hash tokenizer
+        return jnp.asarray([[hash(w) % cfg.vocab_size for w in text.split()]], jnp.int32)
+
+    def embed_fn(text):
+        return embed_texts(model, params, [tokenize(text)])[0]
+
+    seen_prompts = []
+
+    def generate_fn(prompt):
+        seen_prompts.append(prompt)
+        return "out: " + prompt.splitlines()[-2]
+
+    rag = RAGPipeline(embed_fn=embed_fn, generate_fn=generate_fn, top_k=2)
+    docs = [
+        "TPUs use a systolic array for matrix multiplication",
+        "The capital of France is Paris",
+        "JAX traces python functions to XLA",
+    ]
+    rag.add_documents(docs)
+    assert len(rag.store) == 3
+    res = rag.ask("TPUs use a systolic array for what")
+    # the most similar doc must be retrieved and enter the prompt
+    assert docs[0] in [d for d, _ in res["sources"]]
+    assert docs[0] in res["prompt"]
+    # memory: second turn carries the first Q/A
+    res2 = rag.ask("What about France")
+    assert "TPUs use a systolic array for what" in res2["prompt"]
+
+
+def test_vector_store_topk_ordering():
+    vs = VectorStore()
+    embs = jnp.eye(4)
+    vs.add(["a", "b", "c", "d"], embs)
+    hits = vs.search(jnp.asarray([1.0, 0.2, 0.0, 0.0]), k=2)
+    assert hits[0][0] == "a" and hits[1][0] == "b"
+    assert hits[0][1] > hits[1][1]
