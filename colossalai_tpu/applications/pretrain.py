@@ -43,30 +43,33 @@ def expand_vocab(params: Any, config: Any, new_vocab_size: int,
     if new_vocab_size < old_vocab:
         raise ValueError(f"cannot shrink vocab {old_vocab} -> {new_vocab_size}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    new_config = dataclasses.replace(config, vocab_size=new_vocab_size)
+    # models build their embeddings at the PADDED size (TP vocab padding);
+    # match and rebuild against the padded row counts, keeping phantom rows 0
+    old_rows = getattr(config, "padded_vocab_size_", old_vocab)
+    new_rows = getattr(new_config, "padded_vocab_size_", new_vocab_size)
+
+    def grow(leaf, path: str, axis: int):
+        key = jax.random.fold_in(rng, zlib.crc32(path.encode()) % (2**31))
+        live = jnp.moveaxis(leaf, axis, 0)[:old_vocab]
+        mean = live.mean(0, keepdims=True)
+        extra = mean + noise * jax.random.normal(
+            key, (new_vocab_size - old_vocab,) + live.shape[1:], jnp.float32
+        )
+        pad = jnp.zeros((new_rows - new_vocab_size,) + live.shape[1:], leaf.dtype)
+        grown = jnp.concatenate([live, extra.astype(leaf.dtype), pad], 0)
+        return jnp.moveaxis(grown, 0, axis)
 
     def visit(kp, leaf):
         path = "/".join(str(getattr(k, "key", k)) for k in kp)
         parts = path.split("/")
-        is_embed = any(n in parts for n in _EMBED_NAMES) and leaf.ndim == 2 and leaf.shape[0] == old_vocab
-        is_head = any(n in parts for n in _HEAD_NAMES) and leaf.ndim == 2 and leaf.shape[-1] == old_vocab
-        if is_embed:
-            mean = leaf.mean(0, keepdims=True)
-            extra = mean + noise * jax.random.normal(
-                jax.random.fold_in(rng, zlib.crc32(path.encode()) % (2**31)),
-                (new_vocab_size - old_vocab, leaf.shape[1]), leaf.dtype,
-            )
-            return jnp.concatenate([leaf, extra.astype(leaf.dtype)], 0)
-        if is_head:
-            mean = leaf.mean(-1, keepdims=True)
-            extra = mean + noise * jax.random.normal(
-                jax.random.fold_in(rng, zlib.crc32(path.encode()) % (2**31)),
-                leaf.shape[:-1] + (new_vocab_size - old_vocab,), leaf.dtype,
-            )
-            return jnp.concatenate([leaf, extra.astype(leaf.dtype)], -1)
+        if any(n in parts for n in _EMBED_NAMES) and leaf.ndim == 2 and leaf.shape[0] == old_rows:
+            return grow(leaf, path, 0)
+        if any(n in parts for n in _HEAD_NAMES) and leaf.ndim == 2 and leaf.shape[-1] == old_rows:
+            return grow(leaf, path, leaf.ndim - 1)
         return leaf
 
     new_params = jax.tree_util.tree_map_with_path(visit, params)
-    new_config = dataclasses.replace(config, vocab_size=new_vocab_size)
     return new_params, new_config
 
 

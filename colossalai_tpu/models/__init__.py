@@ -25,8 +25,12 @@ from .families import (
     CohereForCausalLM,
     FalconConfig,
     FalconForCausalLM,
+    Gemma2Config,
+    Gemma2ForCausalLM,
     GemmaConfig,
     GemmaForCausalLM,
+    Qwen3Config,
+    Qwen3ForCausalLM,
     GPTJConfig,
     GPTJForCausalLM,
     GPTNeoXConfig,
@@ -132,6 +136,10 @@ __all__ = [
     "MptForCausalLM",
     "GPTBigCodeConfig",
     "GPTBigCodeForCausalLM",
+    "Gemma2Config",
+    "Gemma2ForCausalLM",
+    "Qwen3Config",
+    "Qwen3ForCausalLM",
     "MODEL_REGISTRY",
     "get_model_cls",
     "FAMILY_MODELS",

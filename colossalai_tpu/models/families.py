@@ -281,6 +281,75 @@ class GemmaForCausalLM(DecoderLM):
     pass
 
 
+# ------------------------------------------------------------------ Gemma-2
+@dataclasses.dataclass(unsafe_hash=True)
+class Gemma2Config(GemmaConfig):
+    """Gemma-2 (≙ policies entries for gemma2): everything Gemma plus
+    sandwich norms (pre+post each sublayer), attention/final logit
+    softcapping, and alternating local/global attention (every 2nd layer
+    global, the rest in a 4096 window)."""
+
+    sandwich_norms: bool = True
+    attn_logit_softcap: Optional[float] = 50.0
+    final_logit_softcap: Optional[float] = 30.0
+    sliding_window: Optional[int] = 4096
+    sliding_window_pattern: int = 2
+
+    @classmethod
+    def gemma2_9b(cls, **kw):
+        return cls(
+            vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+            num_hidden_layers=42, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("sliding_window", 8)  # < test seq so locality bites
+        return cls(**_tiny_fields(**kw))
+
+
+class Gemma2ForCausalLM(DecoderLM):
+    pass
+
+
+# ------------------------------------------------------------------- Qwen3
+@dataclasses.dataclass(unsafe_hash=True)
+class Qwen3Config(DecoderConfig):
+    """Qwen3 (≙ policies/qwen3.py): llama layout with per-head QK RMSNorm
+    and NO attention biases (unlike qwen2's q/k/v biases)."""
+
+    norm_type: str = "rmsnorm"
+    norm_eps: float = 1e-6
+    glu: bool = True
+    act_fn: str = "silu"
+    pos_embedding: str = "rope"
+    rope_theta: float = 1000000.0
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    qk_norm: bool = True
+    tie_word_embeddings: bool = False
+
+    @classmethod
+    def qwen3_8b(cls, **kw):
+        return cls(
+            vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+            num_hidden_layers=36, num_attention_heads=32,
+            num_key_value_heads=8, head_dim=128,
+            max_position_embeddings=32768, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**_tiny_fields(**kw))
+
+
+class Qwen3ForCausalLM(DecoderLM):
+    pass
+
+
 # ------------------------------------------------------------------ Cohere
 @dataclasses.dataclass(unsafe_hash=True)
 class CohereConfig(DecoderConfig):
@@ -477,6 +546,8 @@ FAMILY_MODELS = {
     "chatglm": (ChatGLMForConditionalGeneration, ChatGLMConfig),
     "phi": (PhiForCausalLM, PhiConfig),
     "gemma": (GemmaForCausalLM, GemmaConfig),
+    "gemma2": (Gemma2ForCausalLM, Gemma2Config),
+    "qwen3": (Qwen3ForCausalLM, Qwen3Config),
     "cohere": (CohereForCausalLM, CohereConfig),
     "baichuan": (BaichuanForCausalLM, BaichuanConfig),
     "starcoder2": (Starcoder2ForCausalLM, StarCoder2Config),

@@ -52,6 +52,29 @@ class MixtralConfig(LlamaConfig):
         )
 
     @classmethod
+    def qwen2_moe_a14b(cls, **kw) -> "MixtralConfig":
+        """Qwen2-MoE-57B-A14B (≙ policies/qwen2.py MoE entries): many narrow
+        experts + a shared expert, k=8."""
+        return cls(
+            vocab_size=151936, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=64, num_experts_per_tok=8,
+            moe_intermediate_size=2560, n_shared_experts=8, **kw,
+        )
+
+    @classmethod
+    def qwen3_moe_a3b(cls, **kw) -> "MixtralConfig":
+        """Qwen3-MoE-30B-A3B: narrow experts, no shared expert, k=8."""
+        return cls(
+            vocab_size=151936, hidden_size=2048, intermediate_size=6144,
+            num_hidden_layers=48, num_attention_heads=32, num_key_value_heads=4,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=128, num_experts_per_tok=8,
+            moe_intermediate_size=768, **kw,
+        )
+
+    @classmethod
     def tiny(cls, **kw) -> "MixtralConfig":
         kw.setdefault("num_experts", 4)
         kw.setdefault("num_experts_per_tok", 2)

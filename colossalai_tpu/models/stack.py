@@ -41,11 +41,15 @@ class _ScanBody(nn.Module):
     block_cls: Type[nn.Module]
     config: Any
     remat: bool = False
+    pass_layer_id: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids):
+    def __call__(self, x, positions, segment_ids, layer_id):
         cls = remat_block(self.block_cls, self.config) if self.remat else self.block_cls
-        out = cls(self.config, name="block")(x, positions, segment_ids)
+        args = (x, positions, segment_ids)
+        if self.pass_layer_id:
+            args = args + (layer_id,)
+        out = cls(self.config, name="block")(*args)
         if isinstance(out, tuple):
             x, aux = out
         else:
@@ -117,27 +121,44 @@ def apply_decoder_stack(
             return out
         return out, None
 
+    pass_layer_id = _block_takes_layer_id(block_cls)
+
     if cfg.scan_layers:
         Scanned = nn.scan(
             _ScanBody,
             variable_axes={"params": 0},
             split_rngs={"params": True},
-            in_axes=(nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, 0),
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: name},
         )
-        x, aux_per_layer = Scanned(block_cls, cfg, remat=cfg.remat, name=name)(
-            x, positions, segment_ids
-        )
+        layer_ids = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+        x, aux_per_layer = Scanned(
+            block_cls, cfg, remat=cfg.remat, pass_layer_id=pass_layer_id, name=name
+        )(x, positions, segment_ids, layer_ids)
         return x, (jnp.sum(aux_per_layer) if has_aux else None)
 
     cls = remat_block(block_cls, cfg) if cfg.remat else block_cls
     aux_total = jnp.zeros((), jnp.float32)
     for i in range(cfg.num_hidden_layers):
-        out = cls(cfg, name=f"{name}_{i}")(x, positions, segment_ids)
+        args = (x, positions, segment_ids)
+        if pass_layer_id:
+            # plain int: blocks can resolve per-layer structure statically
+            # (e.g. window parity stays a flash-eligible kernel mask)
+            args = args + (i,)
+        out = cls(cfg, name=f"{name}_{i}")(*args)
         if isinstance(out, tuple):
             x, aux = out
             aux_total = aux_total + aux
         else:
             x = out
     return x, (aux_total if has_aux else None)
+
+
+def _block_takes_layer_id(block_cls) -> bool:
+    import inspect
+
+    try:
+        return "layer_id" in inspect.signature(block_cls.__call__).parameters
+    except (TypeError, ValueError):
+        return False

@@ -77,6 +77,14 @@ class DecoderConfig(ModelConfig):
     logit_scale: Optional[float] = None  # Cohere
     tie_word_embeddings: bool = False
     sliding_window: Optional[int] = None
+    #: every Nth layer attends globally, the rest within sliding_window
+    #: (Gemma-2 alternating local/global; 1 = window on every layer)
+    sliding_window_pattern: int = 1
+    qk_norm: bool = False  # Qwen3: per-head RMSNorm on q and k before RoPE
+    attn_logit_softcap: Optional[float] = None   # Gemma-2: 50.0
+    final_logit_softcap: Optional[float] = None  # Gemma-2: 30.0
+    #: Gemma-2 sandwich: norms BOTH before and after each sublayer
+    sandwich_norms: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -161,7 +169,7 @@ class DecoderAttention(nn.Module):
     config: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, layer_id=None):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         hd = cfg.head_dim_
@@ -177,6 +185,10 @@ class DecoderAttention(nn.Module):
         q = q.reshape(b, s, cfg.num_attention_heads, hd)
         k = k.reshape(b, s, kvh, hd)
         v = v.reshape(b, s, kvh, hd)
+        if cfg.qk_norm:
+            # Qwen3: per-head RMSNorm over head_dim before RoPE
+            q = RMSNorm(eps=cfg.norm_eps, dtype=dtype, name="q_norm")(q)
+            k = RMSNorm(eps=cfg.norm_eps, dtype=dtype, name="k_norm")(k)
         sp = cfg.sp_mode
         if sp == "all_to_all":
             spec = (("dp", "ep"), None, ("tp", "sp"), None)
@@ -200,9 +212,34 @@ class DecoderAttention(nn.Module):
             dist = (positions[:, :, None] - positions[:, None, :]).astype(jnp.float32)
             bias = -slopes[None, :, None, None] * dist[:, None, :, :]
 
+        window = cfg.sliding_window
+        extra_mask = None
+        if window is not None and cfg.sliding_window_pattern > 1:
+            # Gemma-2 alternating local/global: every Nth layer is global.
+            if layer_id is None:
+                raise NotImplementedError(
+                    "sliding_window_pattern > 1 needs per-layer ids; not "
+                    "available under pipeline parallelism yet"
+                )
+            if isinstance(layer_id, int):
+                # unrolled stack: parity is static — keep the window a
+                # static kernel mask (flash-eligible), or drop it entirely
+                if (layer_id + 1) % cfg.sliding_window_pattern == 0:
+                    window = None
+            else:
+                # scanned stack: layer id is traced, so locality becomes a
+                # HARD boolean mask (ANDed after softcap — a -1e9 bias would
+                # be crushed to -cap by tanh and leak attention)
+                is_global = (layer_id + 1) % cfg.sliding_window_pattern == 0
+                dist = positions[:, :, None] - positions[:, None, :]  # [b,s,s]
+                inside = dist < window
+                extra_mask = jnp.logical_or(is_global, inside)
+                window = None
+
         out = dot_product_attention(
             q, k, v, causal=True, bias=bias, segment_ids=segment_ids,
-            impl=cfg.attention_impl, sliding_window=cfg.sliding_window,
+            impl=cfg.attention_impl, sliding_window=window,
+            logit_softcap=cfg.attn_logit_softcap, extra_mask=extra_mask,
         )
         out = out.reshape(b, s, cfg.num_attention_heads * hd)
         out = dense(cfg.hidden_size, "o_proj", cfg.attention_out_bias)(out)
@@ -238,7 +275,7 @@ class DecoderBlock(nn.Module):
     config: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, layer_id=None):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         if cfg.parallel_block:
@@ -246,11 +283,19 @@ class DecoderBlock(nn.Module):
             h2 = h1 if cfg.parallel_norm_shared else make_norm(
                 cfg, "post_attention_layernorm", dtype
             )(x)
-            attn = DecoderAttention(cfg, name="self_attn")(h1, positions, segment_ids)
+            attn = DecoderAttention(cfg, name="self_attn")(h1, positions, segment_ids, layer_id)
             mlp = DecoderMLP(cfg, name="mlp")(h2)
             return x + attn + mlp
+        if cfg.sandwich_norms:
+            # Gemma-2: norm before AND after each sublayer
+            h = make_norm(cfg, "input_layernorm", dtype)(x)
+            a = DecoderAttention(cfg, name="self_attn")(h, positions, segment_ids, layer_id)
+            x = x + make_norm(cfg, "post_attention_layernorm", dtype)(a)
+            h = make_norm(cfg, "pre_feedforward_layernorm", dtype)(x)
+            m = DecoderMLP(cfg, name="mlp")(h)
+            return x + make_norm(cfg, "post_feedforward_layernorm", dtype)(m)
         h = make_norm(cfg, "input_layernorm", dtype)(x)
-        x = x + DecoderAttention(cfg, name="self_attn")(h, positions, segment_ids)
+        x = x + DecoderAttention(cfg, name="self_attn")(h, positions, segment_ids, layer_id)
         h = make_norm(cfg, "post_attention_layernorm", dtype)(x)
         return x + DecoderMLP(cfg, name="mlp")(h)
 
@@ -301,6 +346,9 @@ class DecoderLM(nn.Module):
             )(x)
         if cfg.logit_scale is not None:
             logits = logits * cfg.logit_scale
+        if cfg.final_logit_softcap is not None:
+            cap = cfg.final_logit_softcap
+            logits = cap * jnp.tanh(logits / cap)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits, hidden_states=x)

@@ -47,12 +47,17 @@ def xla_attention(
     softmax_scale: Optional[float] = None,
     q_offset: int = 0,
     sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
+    extra_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Numerically-stable attention on the MXU via two einsums.
 
     ``segment_ids`` ([B, Sq]) enables packed-varlen attention
     (≙ reference padded/varlen mask types, ``attn.py:54``).
     ``sliding_window`` limits each query to the last W keys (Mistral-style).
+    ``logit_softcap``: Gemma-2-style cap*tanh(scores/cap) before masking.
+    ``extra_mask``: boolean [B, Sq, Skv], True = attend — a HARD mask ANDed
+    with causal/window/segment (applied after softcap, unlike ``bias``).
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -77,12 +82,17 @@ def xla_attention(
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         seg = (segment_ids[:, :, None] == kv_seg[:, None, :])[:, None, None]
         mask = seg if mask is None else (mask & seg)
+    if extra_mask is not None:
+        em = extra_mask[:, None, None]
+        mask = em if mask is None else (mask & em)
     if bias is not None:
         # bias is per-query-head [B, Hq, Sq, Skv]; fold to kv-head groups.
         # Applied BEFORE masking so a positive bias can never un-mask a
         # forbidden position.
         bias_g = rearrange(bias, "b (h g) s t -> b h g s t", g=group)
         scores = scores + bias_g.astype(scores.dtype)
+    if logit_softcap is not None:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     if mask is not None:
         scores = jnp.where(mask, scores, _NEG_INF)
 
@@ -102,6 +112,8 @@ def dot_product_attention(
     softmax_scale: Optional[float] = None,
     impl: str = "auto",
     sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
+    extra_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention entry point used by all model forwards.
 
@@ -111,12 +123,20 @@ def dot_product_attention(
     only an additive bias forces the XLA path.
     """
     if impl == "auto":
-        impl = "pallas" if _pallas_eligible(q, k, bias) else "xla"
+        impl = "pallas" if (
+            _pallas_eligible(q, k, bias) and logit_softcap is None and extra_mask is None
+        ) else "xla"
     if impl == "pallas":
         if bias is not None:
             raise ValueError(
                 "the pallas flash kernel does not support an additive bias; "
                 "use impl='xla' (or 'auto', which falls back automatically)"
+            )
+        if logit_softcap is not None or extra_mask is not None:
+            raise ValueError(
+                "the pallas flash kernel does not support logit softcapping "
+                "or extra masks; use impl='xla' (or 'auto', which falls back "
+                "automatically)"
             )
         from colossalai_tpu.kernel import flash_attention
 
@@ -127,6 +147,7 @@ def dot_product_attention(
     return xla_attention(
         q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
         softmax_scale=softmax_scale, sliding_window=sliding_window,
+        logit_softcap=logit_softcap, extra_mask=extra_mask,
     )
 
 

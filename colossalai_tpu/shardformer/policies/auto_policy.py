@@ -61,6 +61,12 @@ POLICY_REGISTRY = {
     "PhiForCausalLM": DecoderPolicy,
     "gemma": DecoderPolicy,
     "GemmaForCausalLM": DecoderPolicy,
+    "gemma2": DecoderPolicy,
+    "Gemma2ForCausalLM": DecoderPolicy,
+    "qwen3": DecoderPolicy,
+    "Qwen3ForCausalLM": DecoderPolicy,
+    "qwen2_moe": MixtralPolicy,
+    "qwen3_moe": MixtralPolicy,
     "cohere": DecoderPolicy,
     "CohereForCausalLM": DecoderPolicy,
     "baichuan": DecoderPolicy,

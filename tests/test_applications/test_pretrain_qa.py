@@ -41,6 +41,34 @@ def test_expand_vocab_preserves_old_rows_and_logits():
     assert spread < 0.2
 
 
+def test_expand_vocab_with_padding():
+    """TP vocab padding: leaves are built at padded_vocab_size_; expansion
+    must grow the LIVE rows and keep phantom padding rows zero."""
+    import dataclasses
+
+    cfg = LlamaConfig.tiny(tie_word_embeddings=False)
+    cfg = dataclasses.replace(cfg, vocab_size=250, vocab_pad_multiple=64)
+    assert cfg.padded_vocab_size_ == 256 != cfg.vocab_size
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    new_params, new_cfg = expand_vocab(params, cfg, cfg.vocab_size + 10)
+    emb = new_params["embed_tokens"]["embedding"]
+    assert emb.shape[0] == new_cfg.padded_vocab_size_
+    # old live rows preserved; new live rows initialized; padding rows zero
+    old = params["embed_tokens"]["embedding"]
+    np.testing.assert_array_equal(np.asarray(old[: cfg.vocab_size]),
+                                  np.asarray(emb[: cfg.vocab_size]))
+    assert np.abs(np.asarray(emb[cfg.vocab_size : new_cfg.vocab_size])).max() > 0
+    assert np.abs(np.asarray(emb[new_cfg.vocab_size :])).max() == 0
+    grown = LlamaForCausalLM(new_cfg)
+    out = grown.apply({"params": new_params}, ids)
+    np.testing.assert_allclose(
+        np.asarray(model.apply({"params": params}, ids).logits[..., : cfg.vocab_size]),
+        np.asarray(out.logits[..., : cfg.vocab_size]), rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_dedup():
     docs = ["the cat sat on the mat", "the cat  sat on the mat", "dogs are great"]
     assert len(dedup_exact(docs)) == 2
