@@ -35,8 +35,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
+#: measured on v5e at 16k seq (fwd 53 / bwd 64 TF/s, ~5% over 512/1024):
+#: 1024x1024 tiles win; larger tiles exceed VMEM
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
+
+
+def pick_block(seq: int, cap: int) -> int:
+    """Largest tile <= cap dividing ``seq`` (tiles must divide the seq)."""
+    for b in (cap, 512, 256, 128):
+        if b <= cap and b <= seq and seq % b == 0:
+            return b
+    return seq
 _NEG_INF = -1e9
 
 
@@ -538,8 +548,8 @@ def flash_attention_with_lse(
     for its rescaled merge (≙ ``attn.py:376`` _rescale_out_lse)."""
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, skv = q.shape[1], k.shape[1]
-    block_q = min(block_q, sq)
-    block_kv = min(block_kv, skv)
+    block_q = pick_block(sq, block_q)
+    block_kv = pick_block(skv, block_kv)
     if sq % block_q or skv % block_kv:
         raise ValueError(
             f"sequence lengths ({sq}, {skv}) must be multiples of blocks ({block_q}, {block_kv})"
@@ -568,5 +578,5 @@ def supports(q_shape, k_shape, block_q: int = DEFAULT_BLOCK_Q, block_kv: int = D
     sq, skv, d = q_shape[1], k_shape[1], q_shape[-1]
     if d % 128 != 0 or q_shape[2] % k_shape[2] != 0:
         return False
-    bq, bkv = min(block_q, sq), min(block_kv, skv)
+    bq, bkv = pick_block(sq, block_q), pick_block(skv, block_kv)
     return sq % bq == 0 and skv % bkv == 0 and sq % 128 == 0 and skv % 128 == 0

@@ -5,8 +5,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
+import flax.linen as nn
 import flax.struct
 import jax
+import jax.numpy as jnp
 
 
 @flax.struct.dataclass
@@ -63,3 +65,43 @@ class ModelConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
+
+
+class LMHead(nn.Module):
+    """MXU-rate LM head: bf16-input matmul with fp32 ACCUMULATION.
+
+    flax ``nn.Dense(dtype=fp32)`` promotes inputs and kernel to fp32, which
+    runs the [tokens, H] x [H, V] matmul at the TPU's fp32 rate (~1/4 of
+    bf16). When params are stored bf16 (the training configuration), fp32
+    INPUTS add nothing — CE stability needs fp32 ACCUMULATION, which
+    ``preferred_element_type`` provides at full MXU rate. fp32-stored params
+    keep the exact fp32 matmul (no silent precision change in fp32 runs).
+
+    Drop-in for ``nn.Dense(V, use_bias=False, name="lm_head")``: same
+    ``{name}/kernel`` param path and init, so policies/checkpoints/HF maps
+    are unaffected.
+    """
+
+    features: int
+    param_dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), self.param_dtype or jnp.float32,
+        )
+        return lm_head_matmul(x, kernel)
+
+
+def lm_head_matmul(x, kernel):
+    """bf16 matmul + fp32 accumulate when either side computes in bf16;
+    exact fp32 matmul for pure-fp32 runs (bit-compatible equivalence tests).
+    Also serves the tied-embedding path (``kernel`` = transposed table)."""
+    if jnp.bfloat16 in (x.dtype, kernel.dtype):
+        return jax.lax.dot_general(
+            x.astype(jnp.bfloat16), kernel.astype(jnp.bfloat16),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return x.astype(jnp.float32) @ kernel.astype(jnp.float32)

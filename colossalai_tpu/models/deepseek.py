@@ -28,7 +28,7 @@ from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput
+from .base import CausalLMOutput, LMHead, lm_head_matmul
 from .llama import LlamaConfig, LlamaMLP, RMSNorm, apply_rope, rope_table
 from .mixtral import MixtralConfig, MoEMLP
 
@@ -212,11 +212,10 @@ class DeepseekV2ForCausalLM(nn.Module):
 
         x = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
+            logits = lm_head_matmul(x, embed.embedding.T)
         else:
-            logits = nn.Dense(
-                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
-                param_dtype=cfg.param_dtype or jnp.float32, name="lm_head",
+            logits = LMHead(
+                cfg.padded_vocab_size_, cfg.param_dtype, name="lm_head"
             )(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)

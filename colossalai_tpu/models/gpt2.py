@@ -16,7 +16,7 @@ from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput, ModelConfig
+from .base import CausalLMOutput, LMHead, ModelConfig, lm_head_matmul
 
 
 @dataclasses.dataclass(unsafe_hash=True)
@@ -102,11 +102,9 @@ class GPT2LMHeadModel(nn.Module):
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:
-            logits = wte.attend(x.astype(jnp.float32))
+            logits = lm_head_matmul(x, wte.embedding.T)
         else:
-            logits = nn.Dense(
-                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32, param_dtype=pdtype, name="lm_head"
-            )(x)
+            logits = LMHead(cfg.padded_vocab_size_, pdtype, name="lm_head")(x)
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits, hidden_states=x)

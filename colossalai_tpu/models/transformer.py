@@ -34,7 +34,7 @@ from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput, ModelConfig
+from .base import CausalLMOutput, LMHead, ModelConfig, lm_head_matmul
 from .llama import RMSNorm
 
 
@@ -338,12 +338,9 @@ class DecoderLM(nn.Module):
 
         x = make_norm(cfg, "norm", dtype)(x)
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
+            logits = lm_head_matmul(x, embed.embedding.T)
         else:
-            logits = nn.Dense(
-                cfg.padded_vocab_size_, use_bias=False, dtype=jnp.float32,
-                param_dtype=pdtype, name="lm_head",
-            )(x)
+            logits = LMHead(cfg.padded_vocab_size_, pdtype, name="lm_head")(x)
         if cfg.logit_scale is not None:
             logits = logits * cfg.logit_scale
         if cfg.final_logit_softcap is not None:

@@ -176,6 +176,7 @@ def _ring_flash_bwd(mesh, sp_axis, causal, window, scale, res, do):
     differentiation. dk/dv accumulators travel the full ring (sp rotations)
     back to their owners."""
     from colossalai_tpu.kernel.pallas.flash_attention import _bwd
+    from colossalai_tpu.kernel.pallas.flash_attention import pick_block as _pick_block
 
     q, k, v, pos, seg, out, lse = res
     sp_size = mesh.shape[sp_axis]
@@ -200,8 +201,8 @@ def _ring_flash_bwd(mesh, sp_axis, causal, window, scale, res, do):
                 i32(pos_l), i32(pos_c), i32(seg_l),
                 i32(seg_c) if has_seg else None,
                 scale=scale, causal=causal, window=window,
-                block_q=512 if qt.shape[2] >= 512 else qt.shape[2],
-                block_kv=1024 if k_c.shape[1] >= 1024 else k_c.shape[1],
+                block_q=_pick_block(qt.shape[2], 1024),
+                block_kv=_pick_block(k_c.shape[1], 1024),
                 delta=delta,
             )
 
