@@ -42,11 +42,14 @@ DEFAULT_BLOCK_KV = 1024
 
 
 def pick_block(seq: int, cap: int) -> int:
-    """Largest tile <= cap dividing ``seq`` (tiles must divide the seq)."""
+    """Largest tile <= cap dividing ``seq``. When no standard tile divides
+    it (seq not 128-aligned), return min(seq, cap) so the caller's
+    divisibility check fails LOUDLY instead of attempting an over-cap tile;
+    sub-128 sequences tile whole (interpret-mode tests)."""
     for b in (cap, 512, 256, 128):
         if b <= cap and b <= seq and seq % b == 0:
             return b
-    return seq
+    return min(seq, cap)
 _NEG_INF = -1e9
 
 

@@ -95,10 +95,12 @@ class LMHead(nn.Module):
 
 
 def lm_head_matmul(x, kernel):
-    """bf16 matmul + fp32 accumulate when either side computes in bf16;
-    exact fp32 matmul for pure-fp32 runs (bit-compatible equivalence tests).
+    """bf16 matmul + fp32 accumulate for bf16-STORED kernels; fp32-stored
+    kernels keep the exact fp32 matmul (the logits matmul is loss-critical,
+    so master-weight precision is never silently dropped — only runs that
+    opted into bf16 params take the fast path).
     Also serves the tied-embedding path (``kernel`` = transposed table)."""
-    if jnp.bfloat16 in (x.dtype, kernel.dtype):
+    if kernel.dtype == jnp.bfloat16:
         return jax.lax.dot_general(
             x.astype(jnp.bfloat16), kernel.astype(jnp.bfloat16),
             (((x.ndim - 1,), (0,)), ((), ())),

@@ -65,7 +65,10 @@ def test_supports_shapes():
     assert supports((2, 256, 4, 128), (2, 256, 4, 128))
     assert not supports((2, 200, 4, 128), (2, 200, 4, 128))  # not 128-multiple
     assert not supports((2, 256, 4, 64), (2, 256, 4, 64))  # head_dim < 128
-    assert not supports((2, 2048 + 128, 16, 128), (2, 2048 + 128, 8, 128))  # not block-divisible
+    # adaptive tiling: 128-multiples that don't divide the default tile now
+    # fall back to smaller tiles instead of being rejected
+    assert supports((2, 2048 + 128, 16, 128), (2, 2048 + 128, 8, 128))
+    assert not supports((2, 2048 + 64, 16, 128), (2, 2048 + 64, 8, 128))  # not 128-aligned
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
