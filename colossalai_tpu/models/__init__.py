@@ -50,6 +50,8 @@ from .t5 import Seq2SeqOutput, T5Config, T5EncoderModel, T5ForConditionalGenerat
 from .transformer import DecoderConfig, DecoderLM
 from .whisper import WhisperConfig, WhisperForConditionalGeneration
 from .vit import ViTConfig, ViTForImageClassification, ViTOutput
+from .blip2 import Blip2Config, Blip2ForConditionalGeneration, Blip2Output
+from .sam import SamConfig, SamModel, SamOutput
 
 MODEL_REGISTRY = {
     "llama": (LlamaForCausalLM, LlamaConfig),
@@ -68,6 +70,8 @@ MODEL_REGISTRY = {
     "deepseek_v2": (DeepseekV2ForCausalLM, DeepseekV2Config),
     "deepseek_v3": (DeepseekV2ForCausalLM, DeepseekV2Config),
     "whisper": (WhisperForConditionalGeneration, WhisperConfig),
+    "blip2": (Blip2ForConditionalGeneration, Blip2Config),
+    "sam": (SamModel, SamConfig),
     **FAMILY_MODELS,
 }
 
@@ -99,6 +103,12 @@ __all__ = [
     "ViTConfig",
     "ViTForImageClassification",
     "ViTOutput",
+    "Blip2Config",
+    "Blip2ForConditionalGeneration",
+    "Blip2Output",
+    "SamConfig",
+    "SamModel",
+    "SamOutput",
     "OPTConfig",
     "OPTForCausalLM",
     "BloomConfig",

@@ -1,0 +1,246 @@
+"""BLIP-2 vision-language model (≙ reference ``shardformer/policies/blip2.py``
++ HF ``Blip2ForConditionalGeneration``).
+
+Three towers, trained end-to-end here (the reference shards all three):
+
+- vision encoder: ViT trunk (patchify + cls + learned pos, pre-LN blocks —
+  reuses :class:`~colossalai_tpu.models.vit.ViTBlock`)
+- Q-Former: learned query tokens run through BERT-style post-LN layers with
+  cross-attention into the frozen image features every
+  ``cross_attention_frequency`` layers
+- language model: OPT-style causal decoder (reuses
+  :class:`~colossalai_tpu.models.transformer.DecoderBlock`) over
+  ``[projected queries ; text embeddings]`` with one causal mask — HF's
+  Blip2 concatenates exactly this way, so captioning loss applies to the
+  text positions only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+
+from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
+
+from .base import LMHead, ModelConfig
+from .transformer import DecoderBlock, DecoderConfig
+from .vit import ViTConfig
+
+
+@flax.struct.dataclass
+class Blip2Output:
+    logits: jax.Array  # [b, text_len, vocab] — text positions only
+    query_output: jax.Array  # [b, num_query_tokens, qformer_hidden]
+    vision_embeds: jax.Array  # [b, patches+1, vision_hidden]
+    aux_loss: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class Blip2Config(ModelConfig):
+    # vision tower (EVA-CLIP ViT-g in the published model)
+    image_size: int = 224
+    patch_size: int = 14
+    num_channels: int = 3
+    vision_hidden_size: int = 1408
+    vision_layers: int = 39
+    vision_heads: int = 16
+    vision_intermediate_size: int = 6144
+    # Q-Former
+    qformer_hidden_size: int = 768
+    qformer_layers: int = 12
+    qformer_heads: int = 12
+    qformer_intermediate_size: int = 3072
+    num_query_tokens: int = 32
+    cross_attention_frequency: int = 2
+    # language model (OPT-2.7b shape in the published model)
+    vocab_size: int = 50272
+    hidden_size: int = 2560
+    intermediate_size: int = 10240
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-6
+
+    @classmethod
+    def tiny(cls, **kw) -> "Blip2Config":
+        return cls(
+            image_size=32, patch_size=8, vision_hidden_size=64,
+            vision_layers=2, vision_heads=4, vision_intermediate_size=128,
+            qformer_hidden_size=64, qformer_layers=2, qformer_heads=4,
+            qformer_intermediate_size=128, num_query_tokens=8,
+            cross_attention_frequency=2, vocab_size=256, hidden_size=64,
+            intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128, **kw,
+        )
+
+    def vision_config_(self) -> ViTConfig:
+        return ViTConfig(
+            dtype=self.dtype, param_dtype=self.param_dtype, remat=self.remat,
+            remat_policy=self.remat_policy, scan_layers=self.scan_layers,
+            attention_impl=self.attention_impl,
+            image_size=self.image_size, patch_size=self.patch_size,
+            num_channels=self.num_channels, hidden_size=self.vision_hidden_size,
+            num_hidden_layers=self.vision_layers,
+            num_attention_heads=self.vision_heads,
+            intermediate_size=self.vision_intermediate_size,
+            layer_norm_eps=self.layer_norm_eps,
+        )
+
+    def text_config_(self) -> DecoderConfig:
+        return DecoderConfig(
+            dtype=self.dtype, param_dtype=self.param_dtype, remat=self.remat,
+            remat_policy=self.remat_policy, scan_layers=self.scan_layers,
+            attention_impl=self.attention_impl,
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            max_position_embeddings=self.max_position_embeddings
+            + self.num_query_tokens,
+            act_fn="relu", pos_embedding="learned",
+        )
+
+
+class _VisionTower(nn.Module):
+    """ViT trunk returning all patch states (no classifier head)."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        from .vit import apply_vit_trunk
+
+        return apply_vit_trunk(self, self.config, pixel_values)
+
+
+class QFormerLayer(nn.Module):
+    """BERT-style post-LN layer over the query tokens; ``cross=True`` layers
+    additionally cross-attend into the image features."""
+
+    config: Blip2Config
+    cross: bool
+
+    @nn.compact
+    def __call__(self, q_states, image_embeds):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        hd = cfg.qformer_hidden_size // cfg.qformer_heads
+        b, nq, _ = q_states.shape
+        dense = lambda feats, name: nn.Dense(feats, dtype=dtype, param_dtype=pdtype, name=name)
+        heads = lambda t, s: t.reshape(b, s, cfg.qformer_heads, hd)
+
+        # self-attention over queries (bidirectional)
+        q = heads(dense(cfg.qformer_hidden_size, "query")(q_states), nq)
+        k = heads(dense(cfg.qformer_hidden_size, "key")(q_states), nq)
+        v = heads(dense(cfg.qformer_hidden_size, "value")(q_states), nq)
+        q = constrain(q, ("dp", "ep"), None, "tp", None)
+        attn = dot_product_attention(q, k, v, causal=False, impl=cfg.attention_impl)
+        h = dense(cfg.qformer_hidden_size, "attn_out")(attn.reshape(b, nq, -1))
+        q_states = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="attn_norm")(
+            q_states + h
+        )
+
+        if self.cross:
+            si = image_embeds.shape[1]
+            q = heads(dense(cfg.qformer_hidden_size, "c_query")(q_states), nq)
+            k = heads(dense(cfg.qformer_hidden_size, "c_key")(image_embeds), si)
+            v = heads(dense(cfg.qformer_hidden_size, "c_value")(image_embeds), si)
+            q = constrain(q, ("dp", "ep"), None, "tp", None)
+            attn = dot_product_attention(q, k, v, causal=False, impl=cfg.attention_impl)
+            h = dense(cfg.qformer_hidden_size, "c_out")(attn.reshape(b, nq, -1))
+            q_states = nn.LayerNorm(
+                epsilon=cfg.layer_norm_eps, dtype=dtype, name="cross_norm"
+            )(q_states + h)
+
+        h = nn.gelu(dense(cfg.qformer_intermediate_size, "ffn_in")(q_states))
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        h = dense(cfg.qformer_hidden_size, "ffn_out")(h)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ffn_norm")(
+            q_states + h
+        )
+
+
+class _TextDecoder(nn.Module):
+    """OPT-style causal stack over pre-computed embeddings."""
+
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        from .stack import apply_decoder_stack
+
+        x, _ = apply_decoder_stack(self, DecoderBlock, x, positions, None)
+        return nn.LayerNorm(
+            epsilon=self.config.norm_eps, dtype=self.config.dtype or jnp.float32,
+            name="final_norm",
+        )(x)
+
+
+class Blip2ForConditionalGeneration(nn.Module):
+    config: Blip2Config
+    # three towers with distinct shapes — no pipeline/SP staging yet
+    supports_sp_modes = ()
+
+    @nn.compact
+    def __call__(self, pixel_values, input_ids, positions=None, segment_ids=None):
+        del segment_ids
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, s = input_ids.shape
+        nq = cfg.num_query_tokens
+
+        vision_embeds = _VisionTower(cfg.vision_config_(), name="vision")(pixel_values)
+
+        queries = self.param(
+            "query_tokens", nn.initializers.normal(0.02),
+            (1, nq, cfg.qformer_hidden_size), pdtype,
+        )
+        q_states = jnp.broadcast_to(
+            queries.astype(dtype), (b, nq, cfg.qformer_hidden_size)
+        )
+        for i in range(cfg.qformer_layers):
+            q_states = QFormerLayer(
+                cfg, cross=(i % cfg.cross_attention_frequency == 0),
+                name=f"qformer_{i}",
+            )(q_states, vision_embeds)
+
+        text_cfg = cfg.text_config_()
+        prefix = nn.Dense(
+            cfg.hidden_size, dtype=dtype, param_dtype=pdtype,
+            name="language_projection",
+        )(q_states)
+        embed = nn.Embed(
+            cfg.padded_vocab_size_, cfg.hidden_size, dtype=dtype,
+            param_dtype=pdtype, name="embed_tokens",
+        )
+        x = jnp.concatenate([prefix, embed(input_ids)], axis=1)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        # queries sit at positions 0..nq-1; text continues after them
+        full_pos = jnp.concatenate(
+            [jnp.broadcast_to(jnp.arange(nq), (b, nq)), positions + nq], axis=1
+        )
+        wpe = nn.Embed(
+            text_cfg.max_position_embeddings, cfg.hidden_size, dtype=dtype,
+            param_dtype=pdtype, name="embed_positions",
+        )
+        x = x + wpe(full_pos)
+        x = constrain(x, ("dp", "ep"), None, None)
+
+        x = _TextDecoder(text_cfg, name="text")(x, full_pos)
+        logits = LMHead(cfg.padded_vocab_size_, pdtype, name="lm_head")(x[:, nq:])
+        logits = constrain(logits, ("dp", "ep"), None, "tp")
+        logits = mask_padded_logits(logits, cfg.vocab_size)
+        return Blip2Output(
+            logits=logits, query_output=q_states, vision_embeds=vision_embeds
+        )

@@ -1,0 +1,398 @@
+"""Segment Anything Model (≙ reference ``shardformer/policies/sam.py`` +
+HF ``SamModel``).
+
+Three stages, all TPU-shaped (windowed attention reshapes are static; every
+matmul is batched for the MXU):
+
+- vision encoder: ViTDet trunk — patchify with NO cls token, per-layer
+  windowed attention except ``global_attn_indexes`` layers, decomposed
+  relative position bias, conv neck down to ``prompt_embed_dim`` channels
+- prompt encoder: random-Fourier positional encoding of point prompts plus
+  learned per-label embeddings
+- mask decoder: two-way transformer (token self-attn, token→image cross,
+  MLP, image→token cross), transposed-conv upscaler, per-mask-token
+  hypernetwork MLPs, IoU prediction head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+
+from .base import ModelConfig
+
+
+@flax.struct.dataclass
+class SamOutput:
+    #: [b, num_multimask_outputs + 1, mask_h, mask_w] low-res mask logits
+    pred_masks: jax.Array
+    #: [b, num_multimask_outputs + 1] predicted mask IoU scores
+    iou_scores: jax.Array
+    #: [b, grid, grid, prompt_embed_dim] encoder features
+    image_embeddings: jax.Array
+    aux_loss: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class SamConfig(ModelConfig):
+    image_size: int = 1024
+    patch_size: int = 16
+    num_channels: int = 3
+    vision_hidden_size: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    vision_intermediate_size: int = 3072
+    window_size: int = 14
+    global_attn_indexes: Tuple[int, ...] = (2, 5, 8, 11)
+    prompt_embed_dim: int = 256
+    decoder_layers: int = 2
+    decoder_heads: int = 8
+    decoder_intermediate_size: int = 2048
+    num_multimask_outputs: int = 3
+    layer_norm_eps: float = 1e-6
+
+    @classmethod
+    def tiny(cls, **kw) -> "SamConfig":
+        base = dict(
+            image_size=64, patch_size=8, vision_hidden_size=64,
+            vision_layers=2, vision_heads=4, vision_intermediate_size=128,
+            window_size=4, global_attn_indexes=(1,), prompt_embed_dim=32,
+            decoder_layers=2, decoder_heads=4, decoder_intermediate_size=64,
+            num_multimask_outputs=3,
+        )
+        base.update(kw)
+        return cls(**base)
+
+    @property
+    def grid_(self) -> int:
+        return self.image_size // self.patch_size
+
+
+def _decomposed_rel_pos_bias(q, rel_h, rel_w, qhw, khw):
+    """SAM's decomposed relative position bias (Li et al., ViTDet):
+    ``bias[..., qy, qx, ky, kx] = q·rel_h[qy-ky] + q·rel_w[qx-kx]``.
+
+    q: [b, heads, qh*qw, hd]; rel_h/rel_w: [2*size-1, hd].
+    Returns [b, heads, qh*qw, kh*kw].
+    """
+    qh, qw = qhw
+    kh, kw = khw
+    ridx_h = jnp.arange(qh)[:, None] - jnp.arange(kh)[None, :] + (kh - 1)
+    ridx_w = jnp.arange(qw)[:, None] - jnp.arange(kw)[None, :] + (kw - 1)
+    Rh = rel_h[ridx_h]  # [qh, kh, hd]
+    Rw = rel_w[ridx_w]  # [qw, kw, hd]
+    b, h, _, hd = q.shape
+    r_q = q.reshape(b, h, qh, qw, hd)
+    bias_h = jnp.einsum("bhywd,ykd->bhywk", r_q, Rh)  # [b,h,qh,qw,kh]
+    bias_w = jnp.einsum("bhywd,wkd->bhywk", r_q, Rw)  # [b,h,qh,qw,kw]
+    bias = bias_h[..., :, None] + bias_w[..., None, :]  # [b,h,qh,qw,kh,kw]
+    return bias.reshape(b, h, qh * qw, kh * kw)
+
+
+class SamVisionBlock(nn.Module):
+    """Pre-LN ViTDet block; windowed unless this layer index is global."""
+
+    config: SamConfig
+    layer_idx: int
+
+    @nn.compact
+    def __call__(self, x):  # x: [b, gh, gw, c]
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        heads = cfg.vision_heads
+        hd = cfg.vision_hidden_size // heads
+        b, gh, gw, c = x.shape
+        is_global = self.layer_idx in cfg.global_attn_indexes
+        win = gh if is_global else cfg.window_size
+        dense = lambda feats, name: nn.Dense(feats, dtype=dtype, param_dtype=pdtype, name=name)
+
+        shortcut = x
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm1")(x)
+        # window partition: [b*nw, win*win, c] — static reshapes, one big
+        # batched attention for the MXU. Grids not divisible by the window
+        # are zero-padded and cropped after, exactly HF's window_partition
+        # (padded tokens participate in edge-window attention there too).
+        ph = (-gh) % win
+        pw = (-gw) % win
+        if ph or pw:
+            h = jnp.pad(h, ((0, 0), (0, ph), (0, pw), (0, 0)))
+        fh, fw = gh + ph, gw + pw
+        nh, nw = fh // win, fw // win
+        h = h.reshape(b, nh, win, nw, win, c).transpose(0, 1, 3, 2, 4, 5)
+        h = h.reshape(b * nh * nw, win * win, c)
+
+        qkv = dense(3 * c, "qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        bw, s, _ = q.shape
+        shape = (bw, s, heads, hd)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = constrain(q, None, None, "tp", None)
+
+        rel_h = self.param("rel_pos_h", nn.initializers.zeros, (2 * win - 1, hd), pdtype)
+        rel_w = self.param("rel_pos_w", nn.initializers.zeros, (2 * win - 1, hd), pdtype)
+        # decomposed rel-pos enters as an additive bias in post-scale logit
+        # units (HF adds it after the 1/sqrt(d) scaling, exactly the shared
+        # impl's bias convention); the shared attention impl owns the
+        # fp32-accumulation softmax.
+        bias = _decomposed_rel_pos_bias(
+            q.transpose(0, 2, 1, 3).astype(jnp.float32),
+            rel_h.astype(jnp.float32), rel_w.astype(jnp.float32),
+            (win, win), (win, win),
+        )
+        attn = dot_product_attention(
+            q, k, v, causal=False, bias=bias, impl=cfg.attention_impl
+        )
+        h = dense(c, "proj")(attn.reshape(bw, s, c))
+
+        # un-window (+ crop any window padding)
+        h = h.reshape(b, nh, nw, win, win, c).transpose(0, 1, 3, 2, 4, 5)
+        h = h.reshape(b, fh, fw, c)[:, :gh, :gw]
+        x = shortcut + h
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm2")(x)
+        h = nn.gelu(dense(cfg.vision_intermediate_size, "lin1")(h))
+        h = constrain(h, None, None, None, "tp")
+        return x + dense(c, "lin2")(h)
+
+
+class SamVisionEncoder(nn.Module):
+    config: SamConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        x = nn.Conv(
+            cfg.vision_hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), dtype=dtype,
+            param_dtype=pdtype, name="patch_embed",
+        )(pixel_values)  # [b, gh, gw, c]
+        g = cfg.grid_
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, g, g, cfg.vision_hidden_size), pdtype,
+        )
+        x = x + pos.astype(dtype)
+        x = constrain(x, ("dp", "ep"), None, None, None)
+        for i in range(cfg.vision_layers):
+            x = SamVisionBlock(cfg, layer_idx=i, name=f"block_{i}")(x)
+        # neck: 1x1 conv -> LN -> 3x3 conv -> LN, down to prompt_embed_dim
+        x = nn.Conv(cfg.prompt_embed_dim, (1, 1), use_bias=False, dtype=dtype,
+                    param_dtype=pdtype, name="neck_conv1")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, param_dtype=pdtype, name="neck_norm1")(x)
+        x = nn.Conv(cfg.prompt_embed_dim, (3, 3), padding="SAME", use_bias=False,
+                    dtype=dtype, param_dtype=pdtype, name="neck_conv2")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, param_dtype=pdtype, name="neck_norm2")(x)
+
+
+def _fourier_pe(coords, gaussian):  # coords in [0,1], gaussian [2, d/2]
+    proj = (2.0 * coords - 1.0) @ (2.0 * jnp.pi * gaussian)
+    return jnp.concatenate([jnp.sin(proj), jnp.cos(proj)], axis=-1)
+
+
+class SamPromptEncoder(nn.Module):
+    """Point prompts → sparse embeddings; labels: 1 pos, 0 neg, -1 pad."""
+
+    config: SamConfig
+
+    @nn.compact
+    def __call__(self, points, labels, grid: int):
+        """points [b,n,2] in [0,1]; labels [b,n].
+
+        Returns (sparse_embeddings [b,n,d], image_grid_pe [grid,grid,d]).
+        """
+        cfg = self.config
+        pdtype = cfg.param_dtype or jnp.float32
+        dtype = cfg.dtype or jnp.float32
+        gaussian = self.param(
+            "pe_gaussian", nn.initializers.normal(1.0),
+            (2, cfg.prompt_embed_dim // 2), pdtype,
+        ).astype(jnp.float32)
+        pe = _fourier_pe(points.astype(jnp.float32), gaussian)
+        # label embeddings: 0=neg, 1=pos, 2=pad (replaces pe entirely)
+        label_embed = nn.Embed(
+            3, cfg.prompt_embed_dim, dtype=dtype, param_dtype=pdtype,
+            name="label_embed",
+        )
+        idx = jnp.where(labels < 0, 2, labels)
+        emb = label_embed(idx)
+        pe = jnp.where((labels < 0)[..., None], 0.0, pe)
+
+        coords = (jnp.arange(grid, dtype=jnp.float32) + 0.5) / grid
+        yy, xx = jnp.meshgrid(coords, coords, indexing="ij")
+        pts = jnp.stack([xx, yy], axis=-1)  # [g, g, 2]
+        grid_pe = _fourier_pe(pts, gaussian)
+        return pe.astype(dtype) + emb, grid_pe
+
+
+class _Attention(nn.Module):
+    """Plain multi-head attention with optional internal downsampling
+    (SAM's two-way blocks halve the channel dim inside attention)."""
+
+    config: SamConfig
+    downsample: int = 1
+
+    @nn.compact
+    def __call__(self, q_in, k_in, v_in):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        d = cfg.prompt_embed_dim // self.downsample
+        heads = cfg.decoder_heads
+        hd = d // heads
+        dense = lambda feats, name: nn.Dense(feats, dtype=dtype, param_dtype=pdtype, name=name)
+        b = q_in.shape[0]
+        q = dense(d, "q_proj")(q_in).reshape(b, -1, heads, hd)
+        k = dense(d, "k_proj")(k_in).reshape(b, -1, heads, hd)
+        v = dense(d, "v_proj")(v_in).reshape(b, -1, heads, hd)
+        q = constrain(q, ("dp", "ep"), None, "tp", None)
+        out = dot_product_attention(q, k, v, causal=False, impl=cfg.attention_impl)
+        return dense(cfg.prompt_embed_dim, "out_proj")(out.reshape(b, -1, d))
+
+
+class TwoWayBlock(nn.Module):
+    config: SamConfig
+    skip_first_pe: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, image, token_pe, image_pe):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name=name)
+
+        # HF SamTwoWayAttentionBlock: the first layer's self-attention output
+        # REPLACES the tokens (no residual — tokens are pure embeddings
+        # there); later layers use pe-augmented queries with a residual.
+        if self.skip_first_pe:
+            tokens = _Attention(cfg, name="self_attn")(tokens, tokens, tokens)
+        else:
+            q = tokens + token_pe
+            tokens = tokens + _Attention(cfg, name="self_attn")(q, q, tokens)
+        tokens = ln("norm1")(tokens)
+
+        q = tokens + token_pe
+        k = image + image_pe
+        tokens = ln("norm2")(
+            tokens + _Attention(cfg, downsample=2, name="cross_attn_token_to_image")(q, k, image)
+        )
+
+        h = nn.Dense(cfg.decoder_intermediate_size, dtype=dtype,
+                     param_dtype=cfg.param_dtype or jnp.float32, name="lin1")(tokens)
+        h = nn.relu(h)
+        h = nn.Dense(cfg.prompt_embed_dim, dtype=dtype,
+                     param_dtype=cfg.param_dtype or jnp.float32, name="lin2")(h)
+        tokens = ln("norm3")(tokens + h)
+
+        q = tokens + token_pe
+        k = image + image_pe
+        image = ln("norm4")(
+            image + _Attention(cfg, downsample=2, name="cross_attn_image_to_token")(k, q, tokens)
+        )
+        return tokens, image
+
+
+class _MLP(nn.Module):
+    hidden: int
+    out: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    layers: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        for i in range(self.layers - 1):
+            x = nn.relu(dense(self.hidden, f"fc{i}")(x))
+        return dense(self.out, f"fc{self.layers - 1}")(x)
+
+
+class SamMaskDecoder(nn.Module):
+    config: SamConfig
+
+    @nn.compact
+    def __call__(self, image_embeddings, image_pe, sparse_prompts):
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, g, _, d = image_embeddings.shape
+        n_mask = cfg.num_multimask_outputs + 1
+
+        iou_token = self.param("iou_token", nn.initializers.normal(0.02), (1, 1, d), pdtype)
+        mask_tokens = self.param(
+            "mask_tokens", nn.initializers.normal(0.02), (1, n_mask, d), pdtype
+        )
+        fixed = jnp.concatenate([iou_token, mask_tokens], axis=1).astype(dtype)
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(fixed, (b,) + fixed.shape[1:]), sparse_prompts], axis=1
+        )
+
+        image = image_embeddings.reshape(b, g * g, d)
+        pe = jnp.broadcast_to(image_pe.reshape(1, g * g, d).astype(dtype), image.shape)
+        token_pe = tokens  # SAM uses the prompt tokens themselves as query pe
+        for i in range(cfg.decoder_layers):
+            tokens, image = TwoWayBlock(
+                cfg, skip_first_pe=(i == 0), name=f"layer_{i}"
+            )(tokens, image, token_pe, pe)
+        # pe-augmented queries for the final attention only — the residual
+        # stream feeding the IoU/hypernetwork heads stays pe-free (HF SamModel)
+        attn_out = _Attention(cfg, downsample=2, name="final_attn_token_to_image")(
+            tokens + token_pe, image + pe, image
+        )
+        tokens = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="final_norm")(
+            tokens + attn_out
+        )
+
+        # upscale image features 4x: two stride-2 transposed convs
+        img = image.reshape(b, g, g, d)
+        img = nn.ConvTranspose(d // 4, (2, 2), strides=(2, 2), dtype=dtype,
+                               param_dtype=pdtype, name="upscale_conv1")(img)
+        img = nn.gelu(nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, param_dtype=pdtype, name="upscale_norm")(img))
+        img = nn.ConvTranspose(d // 8, (2, 2), strides=(2, 2), dtype=dtype,
+                               param_dtype=pdtype, name="upscale_conv2")(img)
+        img = nn.gelu(img)  # [b, 4g, 4g, d/8]
+
+        iou_out = tokens[:, 0]
+        mask_out = tokens[:, 1 : 1 + n_mask]
+        hyper = jnp.stack(
+            [
+                _MLP(d, d // 8, dtype=dtype, param_dtype=pdtype, name=f"hyper_mlp_{i}")(mask_out[:, i])
+                for i in range(n_mask)
+            ],
+            axis=1,
+        )  # [b, n_mask, d/8]
+        masks = jnp.einsum("bnc,bhwc->bnhw", hyper, img)
+        iou_scores = _MLP(d, n_mask, dtype=dtype, param_dtype=pdtype, name="iou_head")(iou_out)
+        return masks, iou_scores
+
+
+class SamModel(nn.Module):
+    config: SamConfig
+    supports_sp_modes = ()
+
+    @nn.compact
+    def __call__(self, pixel_values, input_points, input_labels, positions=None, segment_ids=None):
+        del positions, segment_ids
+        cfg = self.config
+        image_embeddings = SamVisionEncoder(cfg, name="vision")(pixel_values)
+        sparse, image_pe = SamPromptEncoder(cfg, name="prompt")(
+            input_points, input_labels, cfg.grid_
+        )
+        masks, iou_scores = SamMaskDecoder(cfg, name="decoder")(
+            image_embeddings, image_pe, sparse
+        )
+        return SamOutput(
+            pred_masks=masks, iou_scores=iou_scores,
+            image_embeddings=image_embeddings,
+        )

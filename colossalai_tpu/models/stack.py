@@ -84,8 +84,27 @@ def apply_decoder_stack(
         stacked = parent.scope.get_variable("params", name)["block"]
         block = block_cls(cfg)
 
-        def block_apply(p, h, aux_in):
-            return block.apply({"params": p}, h, aux_in["positions"], aux_in.get("segment_ids"))
+        if _block_takes_layer_id(block_cls):
+            # global layer ids ride the stacked tree: every schedule reshapes
+            # leaves to (chunks, pp, Lv, ...) and scans the Lv dim, so each
+            # block sees its own id with zero pipeline-code changes. float32
+            # so the custom_vjp cotangent is an ordinary zero (discarded).
+            n_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+            stacked = {
+                "w": stacked,
+                "_layer_id": jnp.arange(n_layers, dtype=jnp.float32),
+            }
+
+            def block_apply(p, h, aux_in):
+                return block.apply(
+                    {"params": p["w"]}, h, aux_in["positions"],
+                    aux_in.get("segment_ids"), p["_layer_id"].astype(jnp.int32),
+                )
+
+        else:
+
+            def block_apply(p, h, aux_in):
+                return block.apply({"params": p}, h, aux_in["positions"], aux_in.get("segment_ids"))
 
         aux_in = {"positions": positions}
         if segment_ids is not None:

@@ -217,9 +217,10 @@ class DecoderAttention(nn.Module):
         if window is not None and cfg.sliding_window_pattern > 1:
             # Gemma-2 alternating local/global: every Nth layer is global.
             if layer_id is None:
-                raise NotImplementedError(
-                    "sliding_window_pattern > 1 needs per-layer ids; not "
-                    "available under pipeline parallelism yet"
+                raise ValueError(
+                    "sliding_window_pattern > 1 needs per-layer ids; the "
+                    "stack/pipeline machinery passes them — direct block "
+                    "callers must supply layer_id"
                 )
             if isinstance(layer_id, int):
                 # unrolled stack: parity is static — keep the window a

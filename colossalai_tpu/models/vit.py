@@ -73,6 +73,39 @@ class ViTBlock(nn.Module):
         return x + dense(cfg.hidden_size, "fc2")(h)
 
 
+def apply_vit_trunk(module: nn.Module, cfg: ViTConfig, pixel_values) -> jax.Array:
+    """Patchify + cls + pos embed + blocks + final norm, building params on
+    ``module``'s scope (param paths identical wherever the trunk is used —
+    ViT classifier and the BLIP-2 vision tower share this).
+
+    Must be called from the owner's ``@nn.compact`` ``__call__``; ``module``
+    must expose ``config`` compatible with the decoder-stack machinery.
+    """
+    dtype = cfg.dtype or jnp.float32
+    pdtype = cfg.param_dtype or jnp.float32
+    b = pixel_values.shape[0]
+    # patchify: conv with stride = patch (maps to MXU as one matmul)
+    x = nn.Conv(
+        cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+        strides=(cfg.patch_size, cfg.patch_size), dtype=dtype,
+        param_dtype=pdtype, name="patch_embed",
+    )(pixel_values)
+    x = x.reshape(b, -1, cfg.hidden_size)
+    n = x.shape[1]
+    cls_tok = module.param("cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size), pdtype)
+    x = jnp.concatenate([jnp.broadcast_to(cls_tok.astype(dtype), (b, 1, cfg.hidden_size)), x], axis=1)
+    pos = module.param(
+        "pos_embed", nn.initializers.normal(0.02), (1, n + 1, cfg.hidden_size), pdtype
+    )
+    x = x + pos.astype(dtype)
+    x = constrain(x, ("dp", "ep"), None, None)
+
+    from .stack import apply_decoder_stack
+
+    x, _ = apply_decoder_stack(module, ViTBlock, x, None, None, name="blocks")
+    return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm")(x)
+
+
 class ViTForImageClassification(nn.Module):
     config: ViTConfig
     # seq length is patches+cls (odd) and blocks carry no sp constraints —
@@ -82,29 +115,7 @@ class ViTForImageClassification(nn.Module):
     @nn.compact
     def __call__(self, pixel_values, positions=None, segment_ids=None):
         cfg = self.config
-        dtype = cfg.dtype or jnp.float32
         pdtype = cfg.param_dtype or jnp.float32
-        b = pixel_values.shape[0]
-        # patchify: conv with stride = patch (maps to MXU as one matmul)
-        x = nn.Conv(
-            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
-            strides=(cfg.patch_size, cfg.patch_size), dtype=dtype,
-            param_dtype=pdtype, name="patch_embed",
-        )(pixel_values)
-        x = x.reshape(b, -1, cfg.hidden_size)
-        n = x.shape[1]
-        cls_tok = self.param("cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size), pdtype)
-        x = jnp.concatenate([jnp.broadcast_to(cls_tok.astype(dtype), (b, 1, cfg.hidden_size)), x], axis=1)
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (1, n + 1, cfg.hidden_size), pdtype
-        )
-        x = x + pos.astype(dtype)
-        x = constrain(x, ("dp", "ep"), None, None)
-
-        from .stack import apply_decoder_stack
-
-        x, _ = apply_decoder_stack(self, ViTBlock, x, None, None, name="blocks")
-
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm")(x)
+        x = apply_vit_trunk(self, cfg, pixel_values)
         logits = nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=pdtype, name="head")(x[:, 0])
         return ViTOutput(last_hidden_state=x, logits=logits)

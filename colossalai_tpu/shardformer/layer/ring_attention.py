@@ -48,13 +48,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e9
 
 
-def _attn_with_lse(q, k, v, q_pos, kv_pos, causal: bool):
+def _attn_with_lse(q, k, v, q_pos, kv_pos, causal: bool, window=None,
+                   q_seg=None, kv_seg=None):
     """Masked attention returning (out [B,S,H,D] fp32, lse [B,H,S] fp32).
 
     ``q_pos``/``kv_pos`` are per-row global position ids [B, S], so
     chunk-vs-chunk causal masks are exact for any layout (zigzag, padded
-    offsets). Fully-masked rows yield lse≈-inf and out=0, vanishing in the
-    merge.
+    offsets). ``window`` adds a sliding-window bound and ``q_seg``/``kv_seg``
+    packed-sequence isolation — the same mask semantics as the flash kernel.
+    Fully-masked rows yield lse≈-inf and out=0, vanishing in the merge.
     """
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
@@ -65,8 +67,16 @@ def _attn_with_lse(q, k, v, q_pos, kv_pos, causal: bool):
     scores = jnp.einsum(
         "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
     ) * scale
+    mask = None
     if causal:
         mask = q_pos[:, :, None] >= kv_pos[:, None, :]  # [b, sq, skv]
+    if window is not None:
+        inside = (q_pos[:, :, None] - kv_pos[:, None, :]) < window
+        mask = inside if mask is None else jnp.logical_and(mask, inside)
+    if q_seg is not None:
+        same = q_seg[:, :, None] == kv_seg[:, None, :]
+        mask = same if mask is None else jnp.logical_and(mask, same)
+    if mask is not None:
         scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
 
     m = jnp.max(scores, axis=-1, keepdims=True)
@@ -299,58 +309,60 @@ def ring_attention(
             q, k, v, positions, segment_ids,
         )
 
-    if sliding_window is not None or segment_ids is not None:
-        if sp_size == 1:
+    if sp_size == 1:
+        if sliding_window is not None or segment_ids is not None:
             from .attention import xla_attention
 
             return xla_attention(
                 q, k, v, causal=causal, segment_ids=segment_ids,
                 sliding_window=sliding_window,
             )
-        raise NotImplementedError(
-            "sliding_window/segment_ids under ring attention need "
-            "flash-eligible shapes (s_local and head_dim multiples of 128)"
-        )
-
-    if sp_size == 1:
         out, _ = _attn_with_lse(q, k, v, positions, positions, causal)
         return out.astype(q.dtype)
 
     qkv_spec = P(None, sp_axis, None, None)
     pos_spec = P(None, sp_axis)
+    has_seg = segment_ids is not None
 
-    def local_fn(q_l, k_l, v_l, pos_l):
+    def local_fn(q_l, k_l, v_l, pos_l, *rest):
         # local shapes: [b_l, s_l, h_l, d], pos [b_l, s_l]
-        out0, lse0 = _attn_with_lse(q_l, k_l, v_l, pos_l, pos_l, causal)
+        seg_l = rest[0] if has_seg else None
+        attn = lambda k_c, v_c, pos_c, seg_c: _attn_with_lse(
+            q_l, k_c, v_c, pos_l, pos_c, causal, window=sliding_window,
+            q_seg=seg_l, kv_seg=seg_c,
+        )
+        out0, lse0 = attn(k_l, v_l, pos_l, seg_l)
 
         def body(carry, _):
-            out, lse, k_c, v_c, pos_c = carry
+            out, lse, k_c, v_c, pos_c, seg_c = carry
             # rotate kv + their positions to the next ring neighbour
             perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
             k_c = jax.lax.ppermute(k_c, sp_axis, perm)
             v_c = jax.lax.ppermute(v_c, sp_axis, perm)
             pos_c = jax.lax.ppermute(pos_c, sp_axis, perm)
-            o_i, lse_i = _attn_with_lse(q_l, k_c, v_c, pos_l, pos_c, causal)
+            if has_seg:
+                seg_c = jax.lax.ppermute(seg_c, sp_axis, perm)
+            o_i, lse_i = attn(k_c, v_c, pos_c, seg_c)
             out, lse = _merge(out, lse, o_i, lse_i)
-            return (out, lse, k_c, v_c, pos_c), None
+            return (out, lse, k_c, v_c, pos_c, seg_c), None
 
+        seg0 = seg_l if has_seg else jnp.zeros((), jnp.int32)
         (out, lse, *_), _ = jax.lax.scan(
-            body, (out0, lse0, k_l, v_l, pos_l), None, length=sp_size - 1
+            body, (out0, lse0, k_l, v_l, pos_l, seg0), None, length=sp_size - 1
         )
         return out.astype(q_l.dtype)
 
-    # inside another (partial-)manual region the context mesh must be used
-    ctx = jax.sharding.get_abstract_mesh()
-    mesh_arg = ctx if (ctx is not None and sp_axis in getattr(ctx, "shape", {})) else mesh
+    in_specs = (qkv_spec, qkv_spec, qkv_spec, pos_spec) + ((pos_spec,) if has_seg else ())
     fn = jax.shard_map(
         local_fn,
         mesh=mesh_arg,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec),
+        in_specs=in_specs,
         out_specs=qkv_spec,
         axis_names={sp_axis},
         check_vma=False,
     )
-    return fn(q, k, v, positions)
+    args = (q, k, v, positions) + ((segment_ids,) if has_seg else ())
+    return fn(*args)
 
 
 # ------------------------------------------------------------ zigzag layout

@@ -13,6 +13,7 @@ from .gpt2 import GPT2Policy
 from .llama import LlamaPolicy, MistralPolicy
 from .bert_vit import BertPolicy, ViTPolicy
 from .mixtral import DeepSeekMoEPolicy, DeepseekV2Policy, MixtralPolicy
+from .multimodal import Blip2Policy, SamPolicy
 from .t5 import T5Policy, WhisperPolicy
 from .transformer import DecoderPolicy
 
@@ -79,6 +80,10 @@ POLICY_REGISTRY = {
     "MptForCausalLM": DecoderPolicy,
     "gpt_bigcode": DecoderPolicy,
     "GPTBigCodeForCausalLM": DecoderPolicy,
+    "blip2": Blip2Policy,
+    "Blip2ForConditionalGeneration": Blip2Policy,
+    "sam": SamPolicy,
+    "SamModel": SamPolicy,
 }
 
 

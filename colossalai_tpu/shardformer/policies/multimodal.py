@@ -1,0 +1,57 @@
+"""BLIP-2 / SAM sharding policies (≙ reference ``policies/blip2.py``,
+``policies/sam.py``).
+
+The reference shards every attention/MLP linear in all three towers of each
+model (vision encoder, Q-Former / two-way decoder, language model); the same
+surface here as regex → PartitionSpec rules.
+"""
+
+from .base_policy import Policy
+
+
+class Blip2Policy(Policy):
+    rules = [
+        # vision tower (ViT block names)
+        (r"vision/.*(qkv|fc1)/kernel$", (None, "tp")),
+        (r"vision/.*(qkv|fc1)/bias$", ("tp",)),
+        (r"vision/.*(proj|fc2)/kernel$", ("tp", None)),
+        (r"vision/(patch_embed/kernel|cls_token|pos_embed)$", ()),
+        # Q-Former: self + cross attention in/out, MLP in/out
+        (r"qformer_\d+/(query|key|value|c_query|c_key|c_value|ffn_in)/kernel$", (None, "tp")),
+        (r"qformer_\d+/(query|key|value|c_query|c_key|c_value|ffn_in)/bias$", ("tp",)),
+        (r"qformer_\d+/(attn_out|c_out|ffn_out)/kernel$", ("tp", None)),
+        (r"query_tokens$", ()),
+        # language model (DecoderBlock names)
+        (r"text/.*(q_proj|k_proj|v_proj|fc_in|gate_proj|up_proj)/kernel$", (None, "tp")),
+        (r"text/.*(q_proj|k_proj|v_proj|fc_in|gate_proj|up_proj)/bias$", ("tp",)),
+        (r"text/.*(o_proj|fc_out|down_proj)/kernel$", ("tp", None)),
+        (r"embed_tokens/embedding$", ("tp", None)),
+        (r"embed_positions/embedding$", ()),
+        (r"language_projection/kernel$", ()),
+        (r"lm_head/kernel$", (None, "tp")),
+        (r"norm.*/(scale|bias)$", ()),
+    ]
+
+
+class SamPolicy(Policy):
+    rules = [
+        # two-way transformer attention FIRST (self, both cross directions,
+        # final): *_proj must win before the bare-`proj` vision rule below
+        # (rules are first-match; `proj/kernel$` would otherwise shadow them)
+        (r"(q_proj|k_proj|v_proj)/kernel$", (None, "tp")),
+        (r"(q_proj|k_proj|v_proj)/bias$", ("tp",)),
+        (r"out_proj/kernel$", ("tp", None)),
+        # vision encoder (ViTDet block names); lin1/lin2 also cover the
+        # two-way decoder MLPs — same column/row layout
+        (r"(qkv|lin1)/kernel$", (None, "tp")),
+        (r"(qkv|lin1)/bias$", ("tp",)),
+        (r"(^|/)(proj|lin2)/kernel$", ("tp", None)),
+        (r"rel_pos_[hw]$", ()),
+        (r"(patch_embed|neck_conv\d)/kernel$", ()),
+        # prompt encoder + heads stay replicated (tiny)
+        (r"(pe_gaussian|iou_token|mask_tokens)$", ()),
+        (r"label_embed/embedding$", ()),
+        (r"(hyper_mlp_\d+|iou_head)/fc\d+/(kernel|bias)$", ()),
+        (r"upscale_conv\d/kernel$", ()),
+        (r"norm.*/(scale|bias)$", ()),
+    ]

@@ -1,0 +1,198 @@
+"""BLIP-2 / SAM multimodal coverage: forward shapes, architecture sanity,
+tp-vs-dp training equivalence (≙ reference
+``tests/test_shardformer/test_model/test_shard_blip2.py`` / ``test_shard_sam.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.models import (
+    Blip2Config,
+    Blip2ForConditionalGeneration,
+    SamConfig,
+    SamModel,
+)
+from colossalai_tpu.shardformer.layer.loss import softmax_cross_entropy
+
+RNG = np.random.RandomState(0)
+
+
+def _blip2_batch(cfg, b=8, s=16):
+    return {
+        "pixel_values": jnp.asarray(
+            RNG.randn(b, cfg.image_size, cfg.image_size, 3), jnp.float32
+        ),
+        "input_ids": jnp.asarray(RNG.randint(0, cfg.vocab_size, (b, s))),
+        "labels": jnp.asarray(RNG.randint(0, cfg.vocab_size, (b, s))),
+    }
+
+
+def _blip2_loss(out, batch):
+    return softmax_cross_entropy(out.logits, batch["labels"])
+
+
+def _sam_batch(cfg, b=8, n=3):
+    mask_hw = 4 * cfg.grid_
+    return {
+        "pixel_values": jnp.asarray(
+            RNG.randn(b, cfg.image_size, cfg.image_size, 3), jnp.float32
+        ),
+        "input_points": jnp.asarray(RNG.rand(b, n, 2), jnp.float32),
+        "input_labels": jnp.asarray(RNG.randint(0, 2, (b, n))),
+        "mask_labels": jnp.asarray(RNG.randint(0, 2, (b, mask_hw, mask_hw)), jnp.float32),
+    }
+
+
+def _sam_loss(out, batch):
+    # supervise the first mask token against the label mask + IoU head to 0.5
+    bce = optax.sigmoid_binary_cross_entropy(
+        out.pred_masks[:, 0], batch["mask_labels"]
+    ).mean()
+    return bce + 0.1 * (out.iou_scores**2).mean()
+
+
+def test_blip2_forward_shapes():
+    cfg = Blip2Config.tiny()
+    m = Blip2ForConditionalGeneration(cfg)
+    b = _blip2_batch(cfg, b=2)
+    params = m.init(jax.random.PRNGKey(0), b["pixel_values"], b["input_ids"])
+    out = jax.jit(m.apply)(params, b["pixel_values"], b["input_ids"])
+    assert out.logits.shape == (2, 16, cfg.vocab_size)
+    assert out.query_output.shape == (2, cfg.num_query_tokens, cfg.qformer_hidden_size)
+    n_patches = (cfg.image_size // cfg.patch_size) ** 2
+    assert out.vision_embeds.shape == (2, n_patches + 1, cfg.vision_hidden_size)
+
+
+def test_blip2_image_conditions_text():
+    """The text logits must depend on the image (through the Q-Former)."""
+    cfg = Blip2Config.tiny()
+    m = Blip2ForConditionalGeneration(cfg)
+    b = _blip2_batch(cfg, b=1)
+    params = m.init(jax.random.PRNGKey(0), b["pixel_values"], b["input_ids"])
+    out1 = m.apply(params, b["pixel_values"], b["input_ids"])
+    out2 = m.apply(params, b["pixel_values"] + 1.0, b["input_ids"])
+    assert not np.allclose(np.asarray(out1.logits), np.asarray(out2.logits))
+
+
+def test_blip2_text_is_causal():
+    """Within the text stream, later tokens must not affect earlier logits."""
+    cfg = Blip2Config.tiny()
+    m = Blip2ForConditionalGeneration(cfg)
+    b = _blip2_batch(cfg, b=1)
+    params = m.init(jax.random.PRNGKey(0), b["pixel_values"], b["input_ids"])
+    ids2 = b["input_ids"].at[0, 12].set((int(b["input_ids"][0, 12]) + 1) % cfg.vocab_size)
+    out1 = m.apply(params, b["pixel_values"], b["input_ids"])
+    out2 = m.apply(params, b["pixel_values"], ids2)
+    np.testing.assert_allclose(
+        np.asarray(out1.logits[0, :12]), np.asarray(out2.logits[0, :12]), atol=1e-5
+    )
+
+
+def test_blip2_tp_matches_dp():
+    cfg = Blip2Config.tiny()
+    model = Blip2ForConditionalGeneration(cfg)
+    batch = _blip2_batch(cfg)
+
+    def losses(plugin, steps=3):
+        b = Booster(plugin=plugin).boost(
+            model, optax.sgd(1e-2), loss_fn=_blip2_loss,
+            example_batch=batch, rng=jax.random.PRNGKey(0),
+        )
+        state, out = b.state, []
+        for _ in range(steps):
+            state, m = b.train_step(state, b.shard_batch(batch))
+            out.append(float(m["loss"]))
+        return out
+
+    base = losses(DataParallelPlugin(precision="fp32"))
+    tp = losses(HybridParallelPlugin(tp_size=2, precision="fp32"))
+    assert np.all(np.isfinite(base)) and base[-1] < base[0], base
+    assert np.allclose(tp, base, atol=1e-4), (tp, base)
+
+
+def test_sam_forward_shapes():
+    cfg = SamConfig.tiny()
+    m = SamModel(cfg)
+    b = _sam_batch(cfg, b=2)
+    params = m.init(
+        jax.random.PRNGKey(0), b["pixel_values"], b["input_points"], b["input_labels"]
+    )
+    out = jax.jit(m.apply)(
+        params, b["pixel_values"], b["input_points"], b["input_labels"]
+    )
+    n_mask = cfg.num_multimask_outputs + 1
+    g = cfg.grid_
+    assert out.pred_masks.shape == (2, n_mask, 4 * g, 4 * g)
+    assert out.iou_scores.shape == (2, n_mask)
+    assert out.image_embeddings.shape == (2, g, g, cfg.prompt_embed_dim)
+
+
+def test_sam_window_padding():
+    """Grids not divisible by the window (the published ViT-B shape:
+    64 % 14 != 0) must pad+crop like HF's window_partition."""
+    cfg = SamConfig.tiny(window_size=3)  # grid 8 % 3 != 0
+    m = SamModel(cfg)
+    b = _sam_batch(cfg, b=1)
+    params = m.init(
+        jax.random.PRNGKey(0), b["pixel_values"], b["input_points"], b["input_labels"]
+    )
+    out = m.apply(params, b["pixel_values"], b["input_points"], b["input_labels"])
+    g = cfg.grid_
+    assert out.pred_masks.shape == (1, 4, 4 * g, 4 * g)
+    assert np.all(np.isfinite(np.asarray(out.pred_masks)))
+
+
+def test_sam_prompts_condition_masks():
+    """Moving the point prompt must change the predicted masks."""
+    cfg = SamConfig.tiny()
+    m = SamModel(cfg)
+    b = _sam_batch(cfg, b=1)
+    params = m.init(
+        jax.random.PRNGKey(0), b["pixel_values"], b["input_points"], b["input_labels"]
+    )
+    out1 = m.apply(params, b["pixel_values"], b["input_points"], b["input_labels"])
+    out2 = m.apply(
+        params, b["pixel_values"], 1.0 - b["input_points"], b["input_labels"]
+    )
+    assert not np.allclose(np.asarray(out1.pred_masks), np.asarray(out2.pred_masks))
+
+
+def test_sam_padded_prompts_are_inert():
+    """label -1 prompts must not influence the output (pad semantics)."""
+    cfg = SamConfig.tiny()
+    m = SamModel(cfg)
+    b = _sam_batch(cfg, b=1, n=2)
+    labels_pad = jnp.asarray([[1, -1]])
+    params = m.init(jax.random.PRNGKey(0), b["pixel_values"], b["input_points"], labels_pad)
+    out1 = m.apply(params, b["pixel_values"], b["input_points"], labels_pad)
+    moved = b["input_points"].at[0, 1].set(jnp.asarray([0.9, 0.9]))
+    out2 = m.apply(params, b["pixel_values"], moved, labels_pad)
+    np.testing.assert_allclose(
+        np.asarray(out1.pred_masks), np.asarray(out2.pred_masks), atol=1e-6
+    )
+
+
+def test_sam_tp_matches_dp():
+    cfg = SamConfig.tiny()
+    model = SamModel(cfg)
+    batch = _sam_batch(cfg)
+
+    def losses(plugin, steps=3):
+        b = Booster(plugin=plugin).boost(
+            model, optax.sgd(1e-2), loss_fn=_sam_loss,
+            example_batch=batch, rng=jax.random.PRNGKey(0),
+        )
+        state, out = b.state, []
+        for _ in range(steps):
+            state, m = b.train_step(state, b.shard_batch(batch))
+            out.append(float(m["loss"]))
+        return out
+
+    base = losses(DataParallelPlugin(precision="fp32"))
+    tp = losses(HybridParallelPlugin(tp_size=2, precision="fp32"))
+    assert np.all(np.isfinite(base)) and base[-1] < base[0], base
+    assert np.allclose(tp, base, atol=1e-4), (tp, base)

@@ -55,6 +55,28 @@ def test_pp_schedule_matches_dp_baseline(llama4, schedule, chunks):
     assert np.allclose(losses, base, atol=1e-4), (schedule, chunks, losses, base)
 
 
+def test_layer_ids_flow_through_pipeline():
+    """Gemma-2 alternating local/global windows need per-layer ids; the
+    stacked-tree layer ids must reach every block under pp (previously
+    raised NotImplementedError)."""
+    from colossalai_tpu.models import Gemma2Config, Gemma2ForCausalLM
+
+    cfg = dataclasses.replace(
+        Gemma2Config.tiny(), num_hidden_layers=4, sliding_window=8,
+        sliding_window_pattern=2,
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(5), (8, 16), 0, cfg.vocab_size)
+    batch = {"input_ids": ids}
+    base = _losses(Gemma2ForCausalLM, cfg, DataParallelPlugin(precision="fp32"), batch)
+    pp = _losses(
+        Gemma2ForCausalLM, cfg,
+        HybridParallelPlugin(pp_size=2, num_microbatches=4, precision="fp32"),
+        batch,
+    )
+    assert np.all(np.isfinite(base)) and base[-1] < base[0], base
+    assert np.allclose(pp, base, atol=1e-4), (pp, base)
+
+
 @pytest.mark.slow
 def test_moe_aux_streams_through_pipeline(llama4):
     """MoE aux-loss collection under pp (reference composes EP×PP,

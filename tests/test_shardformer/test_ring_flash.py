@@ -104,3 +104,49 @@ def test_flash_ring_matches_dense(data, kw):
     assert float(jnp.abs(out[:, inv] - ref).max()) < 2e-3
     for a, b in zip(g, gx):
         assert float(jnp.abs(a[:, inv] - b).max()) < 2e-3
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [{"sliding_window": 40}, {"segment_ids": True}],
+    ids=["window", "segments"],
+)
+def test_jnp_ring_fallback_masks(kw):
+    """Non-flash-eligible shapes (head_dim 32) must still honor
+    sliding-window and packed-segment masks through the jnp ring."""
+    b, s, h, d, sp = 2, 128, 2, 32, 4
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    idx = zigzag_indices(s, sp)
+    inv = jnp.argsort(idx)
+    pos = jnp.broadcast_to(jnp.arange(s), (b, s))[:, idx]
+    kw = dict(kw)
+    seg = None
+    if kw.pop("segment_ids", False):
+        seg = jnp.concatenate(
+            [jnp.zeros((b, s // 2), jnp.int32), jnp.ones((b, s // 2), jnp.int32)], 1
+        )
+
+    def ring_loss(q_, k_, v_):
+        out = ring_attention(
+            q_, k_, v_, pos, mesh, causal=True,
+            segment_ids=None if seg is None else seg[:, idx], **kw,
+        )
+        return (out.astype(jnp.float32) ** 2).mean(), out
+
+    def dense_loss(q_, k_, v_):
+        out = xla_attention(q_, k_, v_, causal=True, segment_ids=seg, **kw)
+        return (out.astype(jnp.float32) ** 2).mean(), out
+
+    (lv, out), g = jax.jit(
+        lambda a, c, w: jax.value_and_grad(ring_loss, argnums=(0, 1, 2), has_aux=True)(a, c, w)
+    )(q[:, idx], k[:, idx], v[:, idx])
+    (lx, ref), gx = jax.value_and_grad(dense_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+
+    assert abs(float(lv) - float(lx)) < 1e-5
+    assert float(jnp.abs(out[:, inv] - ref).max()) < 2e-3
+    for a, bb in zip(g, gx):
+        assert float(jnp.abs(a[:, inv] - bb).max()) < 2e-3

@@ -32,6 +32,7 @@ def _fresh(cfg, ckpt_dir):
     return booster, ElasticTrainer(booster, boosted, str(ckpt_dir), save_every=4)
 
 
+@pytest.mark.slow
 def test_crash_resume_matches_uninterrupted(tmp_path):
     cfg = LlamaConfig.tiny()
     data = _data_fn(cfg)
@@ -93,6 +94,7 @@ def test_crash_budget_exhausts(tmp_path):
     assert tr.restarts == 3  # 1 initial + 2 retries
 
 
+@pytest.mark.slow
 def test_preemption_checkpoints_and_resumes(tmp_path):
     cfg = LlamaConfig.tiny()
     data = _data_fn(cfg)
