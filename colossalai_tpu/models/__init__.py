@@ -51,6 +51,7 @@ from .transformer import DecoderConfig, DecoderLM
 from .whisper import WhisperConfig, WhisperForConditionalGeneration
 from .vit import ViTConfig, ViTForImageClassification, ViTOutput
 from .blip2 import Blip2Config, Blip2ForConditionalGeneration, Blip2Output
+from .dit import DiTConfig, DiTModel, DiTOutput
 from .sam import SamConfig, SamModel, SamOutput
 
 MODEL_REGISTRY = {
@@ -72,6 +73,7 @@ MODEL_REGISTRY = {
     "whisper": (WhisperForConditionalGeneration, WhisperConfig),
     "blip2": (Blip2ForConditionalGeneration, Blip2Config),
     "sam": (SamModel, SamConfig),
+    "dit": (DiTModel, DiTConfig),
     **FAMILY_MODELS,
 }
 
@@ -109,6 +111,9 @@ __all__ = [
     "SamConfig",
     "SamModel",
     "SamOutput",
+    "DiTConfig",
+    "DiTModel",
+    "DiTOutput",
     "OPTConfig",
     "OPTForCausalLM",
     "BloomConfig",

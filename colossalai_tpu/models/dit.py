@@ -1,0 +1,207 @@
+"""DiT — Diffusion Transformer (≙ reference diffusion support:
+``colossalai/inference/modeling/layers/distrifusion.py`` patch-parallel DiT
+inference + the diffusion examples; architecture per Peebles & Xie, "Scalable
+Diffusion Models with Transformers").
+
+TPU shape notes: patchify is one strided conv (a single MXU matmul); adaLN
+conditioning is a per-block [B, 6H] projection modulating attention/MLP —
+all batched matmuls; blocks run under the shared decoder-stack machinery
+(scan / remat / pipeline), with the conditioning vector riding the
+``positions`` slot (same [B, ...] microbatch semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+from colossalai_tpu.tensor import constrain
+
+from .base import ModelConfig
+
+
+@flax.struct.dataclass
+class DiTOutput:
+    #: [b, h, w, out_channels] predicted noise (and optionally sigma)
+    sample: jax.Array
+    aux_loss: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class DiTConfig(ModelConfig):
+    input_size: int = 32  # latent spatial size (32 = 256px images / VAE 8x)
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152  # DiT-XL/2
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    #: classifier-free guidance: probability slot — class `num_classes` is
+    #: the learned unconditional embedding
+    learn_sigma: bool = True
+    layer_norm_eps: float = 1e-6
+
+    @classmethod
+    def dit_xl_2(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "DiTConfig":
+        base = dict(
+            input_size=8, patch_size=2, in_channels=4, hidden_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_classes=10,
+        )
+        base.update(kw)
+        return cls(**base)
+
+    @property
+    def out_channels_(self) -> int:
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+
+def timestep_embedding(t, dim: int, max_period: int = 10000):
+    """Sinusoidal timestep embedding [B] -> [B, dim] (fp32)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.concatenate([emb, jnp.zeros_like(emb[:, :1])], axis=-1)
+    return emb
+
+
+def _modulate(x, shift, scale):
+    return x * (1.0 + scale[:, None]) + shift[:, None]
+
+
+class DiTBlock(nn.Module):
+    """adaLN-Zero block: conditioning produces 6 modulation vectors; the
+    gate projections start at zero so every block begins as identity."""
+
+    config: DiTConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None, layer_id=None):
+        # `positions` carries the conditioning vector c [B, H] (stack
+        # machinery threads it like positions; unused slots stay None)
+        del segment_ids, layer_id
+        cfg = self.config
+        c = positions
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        b, s, _ = x.shape
+        dense = lambda feats, name, init=None: nn.Dense(
+            feats, dtype=dtype, param_dtype=pdtype, name=name,
+            **({"kernel_init": init} if init else {}),
+        )
+
+        mod = dense(6 * cfg.hidden_size, "adaLN", nn.initializers.zeros)(
+            nn.silu(c)
+        )
+        sh_a, sc_a, g_a, sh_m, sc_m, g_m = jnp.split(mod, 6, axis=-1)
+
+        h = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, use_bias=False, use_scale=False,
+            dtype=dtype, name="norm1",
+        )(x)
+        h = _modulate(h, sh_a, sc_a)
+        qkv = dense(3 * cfg.hidden_size, "qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rs = lambda t: t.reshape(b, s, cfg.num_attention_heads, hd)
+        q = constrain(rs(q), ("dp", "ep"), None, "tp", None)
+        attn = dot_product_attention(
+            q, rs(k), rs(v), causal=False, impl=cfg.attention_impl
+        )
+        attn = dense(cfg.hidden_size, "proj")(attn.reshape(b, s, cfg.hidden_size))
+        x = x + g_a[:, None] * attn
+
+        h = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, use_bias=False, use_scale=False,
+            dtype=dtype, name="norm2",
+        )(x)
+        h = _modulate(h, sh_m, sc_m)
+        h = dense(cfg.mlp_ratio * cfg.hidden_size, "fc1")(h)
+        h = nn.gelu(h, approximate=True)
+        h = constrain(h, ("dp", "ep"), None, "tp")
+        h = dense(cfg.hidden_size, "fc2")(h)
+        return x + g_m[:, None] * h
+
+
+class DiTModel(nn.Module):
+    """Class-conditional DiT predicting noise from (noised latent, t, y).
+
+    Inputs: pixel_values [B, H, W, C] noised latents, positions [B]
+    timesteps, input_ids [B] class labels (pass ``num_classes`` for the
+    unconditional/classifier-free slot).
+    """
+
+    config: DiTConfig
+    supports_sp_modes = ()
+    supports_pipeline = True
+
+    @nn.compact
+    def __call__(self, pixel_values, input_ids, positions, segment_ids=None):
+        del segment_ids
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        b, hh, ww, _ = pixel_values.shape
+        p = cfg.patch_size
+        gh, gw = hh // p, ww // p
+
+        x = nn.Conv(
+            cfg.hidden_size, (p, p), strides=(p, p), dtype=dtype,
+            param_dtype=pdtype, name="patch_embed",
+        )(pixel_values)
+        x = x.reshape(b, gh * gw, cfg.hidden_size)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, gh * gw, cfg.hidden_size), pdtype,
+        )
+        x = x + pos.astype(dtype)
+        x = constrain(x, ("dp", "ep"), None, None)
+
+        t_emb = timestep_embedding(positions, 256)
+        t_emb = nn.Dense(cfg.hidden_size, dtype=dtype, param_dtype=pdtype,
+                         name="t_fc1")(t_emb.astype(dtype))
+        t_emb = nn.Dense(cfg.hidden_size, dtype=dtype, param_dtype=pdtype,
+                         name="t_fc2")(nn.silu(t_emb))
+        y_emb = nn.Embed(
+            cfg.num_classes + 1, cfg.hidden_size, dtype=dtype,
+            param_dtype=pdtype, name="label_embed",
+        )(input_ids)
+        c = t_emb + y_emb  # [B, H]
+
+        from .stack import apply_decoder_stack
+
+        x, _ = apply_decoder_stack(self, DiTBlock, x, c, None)
+
+        h = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, use_bias=False, use_scale=False,
+            dtype=dtype, name="final_norm",
+        )(x)
+        mod = nn.Dense(
+            2 * cfg.hidden_size, dtype=dtype, param_dtype=pdtype,
+            kernel_init=nn.initializers.zeros, name="final_adaLN",
+        )(nn.silu(c))
+        shift, scale = jnp.split(mod, 2, axis=-1)
+        h = _modulate(h, shift, scale)
+        h = nn.Dense(
+            p * p * cfg.out_channels_, dtype=jnp.float32, param_dtype=pdtype,
+            kernel_init=nn.initializers.zeros, name="final_proj",
+        )(h)
+        # unpatchify: [b, gh*gw, p*p*c] -> [b, gh*p, gw*p, c]
+        h = h.reshape(b, gh, gw, p, p, cfg.out_channels_)
+        h = h.transpose(0, 1, 3, 2, 4, 5).reshape(b, gh * p, gw * p, cfg.out_channels_)
+        return DiTOutput(sample=h)

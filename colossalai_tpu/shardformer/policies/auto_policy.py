@@ -13,7 +13,7 @@ from .gpt2 import GPT2Policy
 from .llama import LlamaPolicy, MistralPolicy
 from .bert_vit import BertPolicy, ViTPolicy
 from .mixtral import DeepSeekMoEPolicy, DeepseekV2Policy, MixtralPolicy
-from .multimodal import Blip2Policy, SamPolicy
+from .multimodal import Blip2Policy, DiTPolicy, SamPolicy
 from .t5 import T5Policy, WhisperPolicy
 from .transformer import DecoderPolicy
 
@@ -84,6 +84,8 @@ POLICY_REGISTRY = {
     "Blip2ForConditionalGeneration": Blip2Policy,
     "sam": SamPolicy,
     "SamModel": SamPolicy,
+    "dit": DiTPolicy,
+    "DiTModel": DiTPolicy,
 }
 
 

@@ -33,6 +33,19 @@ class Blip2Policy(Policy):
     ]
 
 
+class DiTPolicy(Policy):
+    rules = [
+        # packed qkv / MLP-in column-sharded, proj / MLP-out row-sharded;
+        # adaLN's 6H modulation output shards like packed qkv
+        (r"(^|/)(qkv|fc1|adaLN)/kernel$", (None, "tp")),
+        (r"(^|/)(qkv|fc1|adaLN)/bias$", ("tp",)),
+        (r"(^|/)(proj|fc2)/kernel$", ("tp", None)),
+        (r"(patch_embed|t_fc\d|final_adaLN|final_proj)/kernel$", ()),
+        (r"(pos_embed|label_embed/embedding)$", ()),
+        (r"norm\d?/(scale|bias)$", ()),
+    ]
+
+
 class SamPolicy(Policy):
     rules = [
         # two-way transformer attention FIRST (self, both cross directions,
