@@ -532,7 +532,7 @@ def default_seq2seq_loss(out, batch):
 _MODEL_INPUT_KEYS = (
     "input_ids", "decoder_input_ids", "positions", "segment_ids",
     "token_type_ids", "pixel_values", "input_features",
-    "input_points", "input_labels",
+    "input_points", "input_labels", "lengths",
 )
 
 

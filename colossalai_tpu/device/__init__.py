@@ -1,3 +1,19 @@
+from .alpha_beta import (
+    AlphaBeta,
+    AlphaBetaProfiler,
+    collective_costs,
+    default_alpha_beta,
+)
 from .device_mesh import DATA_AXES, MESH_AXES, DeviceMesh, MeshConfig, create_device_mesh
 
-__all__ = ["DATA_AXES", "MESH_AXES", "DeviceMesh", "MeshConfig", "create_device_mesh"]
+__all__ = [
+    "DATA_AXES",
+    "MESH_AXES",
+    "DeviceMesh",
+    "MeshConfig",
+    "create_device_mesh",
+    "AlphaBeta",
+    "AlphaBetaProfiler",
+    "collective_costs",
+    "default_alpha_beta",
+]

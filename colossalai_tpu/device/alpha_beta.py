@@ -1,0 +1,175 @@
+"""α-β collective cost model + empirical link profiler.
+
+≙ reference ``device/alpha_beta_profiler.py`` (AlphaBetaProfiler) and the
+DeviceMesh cost model (``device/device_mesh.py:500-524``): there, per-axis
+(α latency, β inverse-bandwidth) pairs are measured with timed NCCL
+broadcasts and fed to all-gather/all-reduce/reduce-scatter/all-to-all cost
+formulas that the auto-parallel solver consumes.
+
+TPU redesign: ICI links are printed-circuit neighbours with known shapes, so
+the *model* half needs no discovery — per-generation link bandwidths ship as
+defaults and the classic ring formulas apply per mesh axis. The *profiler*
+half measures real α/β on the live mesh by timing ``psum`` over one axis at
+two payload sizes (two-point fit), which also captures DCN axes where the
+defaults don't apply. Costs inform parallelism layout choices (e.g. tp
+inside a slice, dp across DCN) the same way the reference feeds its solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: per-direction ICI link bandwidth, bytes/s (public figures; both
+#: directions of the torus ring are used by XLA's bidirectional collectives)
+_ICI_LINK_BYTES_PER_S = {
+    "v4": 2 * 45e9,
+    "v5e": 2 * 45e9,
+    "v5p": 2 * 90e9,
+    "v6e": 2 * 90e9,
+    "cpu": 10e9,  # virtual-device testing stand-in
+}
+_DEFAULT_ALPHA_S = 1e-6  # ICI hop latency is ~µs-scale
+_DCN_BYTES_PER_S = 25e9  # conservative per-host DCN
+
+
+def _detect_generation() -> str:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # backend not initialized
+        return "cpu"
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if gen in kind.replace(" ", "").replace("tpu", ""):
+            return gen
+    if "tpu" in kind:
+        return "v5e"
+    return "cpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class AlphaBeta:
+    """Latency (s) + inverse bandwidth (s/byte) of one mesh axis."""
+
+    alpha: float
+    beta: float
+
+    # ---------------------------------------------------------- ring costs
+    # n = axis size, nbytes = GLOBAL payload. Standard ring formulas
+    # (≙ reference DeviceMesh.all_gather_cost etc., device_mesh.py:500-524).
+    def all_gather(self, nbytes: int, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return (n - 1) * self.alpha + (n - 1) / n * nbytes * self.beta
+
+    def reduce_scatter(self, nbytes: int, n: int) -> float:
+        return self.all_gather(nbytes, n)
+
+    def all_reduce(self, nbytes: int, n: int) -> float:
+        # reduce-scatter + all-gather
+        return 2.0 * self.all_gather(nbytes, n)
+
+    def all_to_all(self, nbytes: int, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return (n - 1) * self.alpha + (n - 1) / (n * n) * nbytes * self.beta
+
+    def ppermute(self, nbytes: int) -> float:
+        """One neighbour hop (ring attention / pipeline stage transfer)."""
+        return self.alpha + nbytes * self.beta
+
+
+def default_alpha_beta(*, dcn: bool = False,
+                       generation: Optional[str] = None) -> AlphaBeta:
+    """Model-only α-β for a link (no measurement): ICI unless ``dcn``."""
+    if dcn:
+        return AlphaBeta(alpha=10e-6, beta=1.0 / _DCN_BYTES_PER_S)
+    gen = generation or _detect_generation()
+    bw = _ICI_LINK_BYTES_PER_S.get(gen, _ICI_LINK_BYTES_PER_S["v5e"])
+    return AlphaBeta(alpha=_DEFAULT_ALPHA_S, beta=1.0 / bw)
+
+
+class AlphaBetaProfiler:
+    """Measure per-axis α/β on the live mesh (≙ AlphaBetaProfiler).
+
+    Times a jitted ``psum`` along one axis at a small and a large payload;
+    the two-point fit separates latency from bandwidth. On the tunneled
+    single-chip/axon setup, timings synchronize via scalar fetch (device
+    ``block_until_ready`` is documented as unreliable there).
+    """
+
+    def __init__(self, mesh):
+        self.mesh = mesh  # colossalai_tpu DeviceMesh (has .mesh jax Mesh)
+
+    def _time_psum(self, axis: str, n_elems: int, iters: int = 5) -> float:
+        from jax.sharding import PartitionSpec as P
+
+        jmesh = getattr(self.mesh, "mesh", self.mesh)
+
+        def fn(x):
+            return jax.lax.psum(x, axis)
+
+        shard = jax.jit(jax.shard_map(
+            fn, mesh=jmesh, in_specs=P(axis), out_specs=P(), check_vma=False,
+        ))
+        n = jmesh.shape[axis]
+        x = jnp.ones((n * n_elems,), jnp.float32)
+        out = shard(x)
+        float(out[0])  # warm up (compile) + sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = shard(x)
+        float(out[0])
+        return (time.perf_counter() - t0) / iters
+
+    def profile(self, axis: str, small: int = 1024,
+                large: int = 4 * 1024 * 1024) -> AlphaBeta:
+        n = getattr(self.mesh, "mesh", self.mesh).shape[axis]
+        if n <= 1:
+            return AlphaBeta(alpha=0.0, beta=0.0)
+        t_small = self._time_psum(axis, small)
+        t_large = self._time_psum(axis, large)
+        # psum of a B-byte per-device buffer is a ring all-reduce:
+        #   t(B) = 2(n-1)·alpha + 2(n-1)/n · B · beta
+        # so the payload slope is 2(n-1)/n · beta — invert that factor to
+        # keep measured values on the same scale as the model formulas.
+        slope = max(t_large - t_small, 1e-12) / (4 * (large - small))
+        beta = slope * n / (2 * (n - 1))
+        alpha = max(
+            t_small - 2 * (n - 1) / n * 4 * small * beta, 0.0
+        ) / (2 * (n - 1))
+        return AlphaBeta(alpha=alpha, beta=beta)
+
+    def profile_all(self) -> Dict[str, AlphaBeta]:
+        jmesh = getattr(self.mesh, "mesh", self.mesh)
+        return {
+            ax: self.profile(ax)
+            for ax, size in jmesh.shape.items()
+            if size > 1
+        }
+
+
+def collective_costs(
+    mesh, nbytes: int, *, measured: Optional[Dict[str, AlphaBeta]] = None
+) -> Dict[str, Dict[str, float]]:
+    """Per-axis cost table for a payload: the numbers a layout search
+    compares (e.g. "does tp=4 all-reduce beat dp=4 reduce-scatter here").
+    """
+    jmesh = getattr(mesh, "mesh", mesh)
+    out = {}
+    for ax, n in jmesh.shape.items():
+        if n <= 1:
+            continue
+        ab = (measured or {}).get(ax) or default_alpha_beta()
+        out[ax] = {
+            "all_gather": ab.all_gather(nbytes, n),
+            "reduce_scatter": ab.reduce_scatter(nbytes, n),
+            "all_reduce": ab.all_reduce(nbytes, n),
+            "all_to_all": ab.all_to_all(nbytes, n),
+            "ppermute": ab.ppermute(nbytes // n),
+        }
+    return out

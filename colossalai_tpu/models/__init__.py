@@ -45,6 +45,7 @@ from .families import (
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM, MistralConfig, Qwen2Config
 from .mixtral import MixtralConfig, MixtralForCausalLM
+from .heads import QuestionAnswering, SequenceClassifier, TokenClassifier
 from .reward import RewardModel, reward_at_last_token
 from .t5 import Seq2SeqOutput, T5Config, T5EncoderModel, T5ForConditionalGeneration, shift_right
 from .transformer import DecoderConfig, DecoderLM
@@ -88,6 +89,9 @@ __all__ = [
     "CausalLMOutput",
     "RewardModel",
     "reward_at_last_token",
+    "SequenceClassifier",
+    "TokenClassifier",
+    "QuestionAnswering",
     "ModelConfig",
     "DecoderConfig",
     "DecoderLM",

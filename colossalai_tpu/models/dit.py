@@ -43,9 +43,10 @@ class DiTConfig(ModelConfig):
     num_hidden_layers: int = 28
     num_attention_heads: int = 16
     mlp_ratio: int = 4
+    #: label embedding has num_classes + 1 rows: class id ``num_classes`` is
+    #: the learned unconditional slot for classifier-free guidance
     num_classes: int = 1000
-    #: classifier-free guidance: probability slot — class `num_classes` is
-    #: the learned unconditional embedding
+    #: predict (epsilon, sigma) — doubles the output channels
     learn_sigma: bool = True
     layer_norm_eps: float = 1e-6
 
@@ -91,10 +92,10 @@ class DiTBlock(nn.Module):
     config: DiTConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, layer_id=None):
+    def __call__(self, x, positions, segment_ids=None):
         # `positions` carries the conditioning vector c [B, H] (stack
         # machinery threads it like positions; unused slots stay None)
-        del segment_ids, layer_id
+        del segment_ids
         cfg = self.config
         c = positions
         dtype = cfg.dtype or jnp.float32

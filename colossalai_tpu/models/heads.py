@@ -1,0 +1,123 @@
+"""Per-task heads over any backbone (≙ the reference's ``*ForSequence-
+Classification`` / ``*ForTokenClassification`` / ``*ForQuestionAnswering``
+policy entries — ~20 of ``auto_policy.py:28``'s 73 rows are task heads over
+a shared trunk).
+
+One generic wrapper per task, reusing the backbone module unchanged: every
+sharding policy, SP mode and pipeline layout of the base family applies (the
+policy auto-dispatch resolves through ``.lm``); only the tiny replicated
+head is new — exactly how :class:`~colossalai_tpu.models.reward.RewardModel`
+works.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .base import CausalLMOutput
+
+
+class _HeadBase(nn.Module):
+    lm: nn.Module
+
+    @property
+    def config(self):
+        return self.lm.config
+
+    @property
+    def supports_pipeline(self):
+        return getattr(self.lm, "supports_pipeline", False)
+
+    @property
+    def supports_sp_modes(self):
+        return getattr(self.lm, "supports_sp_modes", ("split_gather",))
+
+    @property
+    def supports_fp8(self):
+        return getattr(self.lm, "supports_fp8", False)
+
+    @property
+    def supports_ep(self):
+        return getattr(self.lm, "supports_ep", False)
+
+    def with_config(self, cfg):
+        return type(self)(lm=type(self.lm)(cfg), **self._head_kwargs())
+
+    def _head_kwargs(self):
+        return {"num_labels": self.num_labels}
+
+    def _hidden(self, input_ids, positions, segment_ids):
+        out = self.lm(input_ids, positions=positions, segment_ids=segment_ids)
+        if out.hidden_states is None:
+            raise ValueError(
+                f"{type(self.lm).__name__} does not expose hidden_states; "
+                "task heads need a backbone returning them"
+            )
+        return out
+
+
+class SequenceClassifier(_HeadBase):
+    """Sequence-level classification (≙ ``*ForSequenceClassification``).
+
+    Pools the LAST real token for causal backbones (HF convention: the last
+    non-pad position carries the sequence summary under a causal mask).
+    Right-padded batches must carry ``lengths`` (a model-input key — the
+    booster forwards it); without it pooling uses the final position.
+    """
+
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, positions: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None,
+                 lengths: Optional[jax.Array] = None):
+        out = self._hidden(input_ids, positions, segment_ids)
+        h = out.hidden_states.astype(jnp.float32)
+        if lengths is None:
+            pooled = h[:, -1]
+        else:
+            idx = jnp.clip(lengths - 1, 0, h.shape[1] - 1)
+            pooled = jnp.take_along_axis(h, idx[:, None, None].repeat(h.shape[-1], -1), 1)[:, 0]
+        logits = nn.Dense(
+            self.num_labels, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="score",
+        )(pooled)
+        return CausalLMOutput(logits=logits, aux_loss=out.aux_loss)
+
+
+class TokenClassifier(_HeadBase):
+    """Per-token classification, e.g. NER (≙ ``*ForTokenClassification``)."""
+
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, positions: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None):
+        out = self._hidden(input_ids, positions, segment_ids)
+        logits = nn.Dense(
+            self.num_labels, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="classifier",
+        )(out.hidden_states.astype(jnp.float32))
+        return CausalLMOutput(logits=logits, aux_loss=out.aux_loss)
+
+
+class QuestionAnswering(_HeadBase):
+    """Extractive QA span head (≙ ``*ForQuestionAnswering``): two logits per
+    token (answer start / end) — the task fixes the head width, so there is
+    no ``num_labels`` knob."""
+
+    def _head_kwargs(self):
+        return {}
+
+    @nn.compact
+    def __call__(self, input_ids, positions: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None):
+        out = self._hidden(input_ids, positions, segment_ids)
+        logits = nn.Dense(
+            2, dtype=jnp.float32, param_dtype=jnp.float32, name="qa_outputs",
+        )(out.hidden_states.astype(jnp.float32))  # [B, S, 2]
+        return CausalLMOutput(logits=logits, aux_loss=out.aux_loss)

@@ -110,31 +110,11 @@ def apply_decoder_stack(
         if segment_ids is not None:
             aux_in["segment_ids"] = segment_ids
 
-        schedule = getattr(cfg, "pp_schedule", "1f1b")
-        if schedule == "gpipe":
-            if has_aux:
-                raise NotImplementedError(
-                    "MoE aux loss under the gpipe schedule: use pp_schedule="
-                    "'1f1b'/'interleaved'/'zb', which stream aux natively"
-                )
-            from colossalai_tpu.pipeline import pipeline_blocks
-
-            x = pipeline_blocks(
-                block_apply, stacked, x, mesh, cfg.pp_microbatches,
-                aux=aux_in, remat=cfg.remat,
-                remat_policy=checkpoint_policy(cfg),
-            )
-            return x, None
-
-        from colossalai_tpu.pipeline import pipeline_blocks_vjp
+        from colossalai_tpu.pipeline import run_pipeline
 
         # pp_chunks is validated against the schedule by the plugin
-        chunks = getattr(cfg, "pp_chunks", 1)
-        out = pipeline_blocks_vjp(
-            block_apply, stacked, x, mesh, cfg.pp_microbatches,
-            aux=aux_in, remat=cfg.remat, chunks=chunks,
-            split_dw=(schedule == "zb"), has_aux=has_aux,
-            remat_policy=checkpoint_policy(cfg),
+        out = run_pipeline(
+            block_apply, stacked, x, mesh, cfg, aux_in, has_aux=has_aux
         )
         if has_aux:
             return out

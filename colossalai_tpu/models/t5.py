@@ -250,13 +250,56 @@ def _scan_stack(body_cls, cfg, length, name):
 
 class T5ForConditionalGeneration(nn.Module):
     config: T5Config
-    supports_pipeline = False  # enc-dec staging lands with the pp seq2seq path
+    # enc-dec staging: each pp stage holds a slice of BOTH stacks; the
+    # encoder streams first, then the decoder streams with the encoder
+    # output riding the pipeline's differentiable aux (daux flows back).
+    supports_pipeline = True
     supports_sp_modes = ("split_gather",)
+
+    def _pp_stream(self, name, block_apply, x, aux):
+        """Stream one stack (encoder or decoder) over the pp mesh axis."""
+        from colossalai_tpu.pipeline import run_pipeline
+        from colossalai_tpu.tensor import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError("pipeline parallelism requires an ambient mesh")
+        stacked = self.scope.get_variable("params", name)["block"]
+        return run_pipeline(block_apply, stacked, x, mesh, self.config, aux)
+
+    def _rel_bias_pieces(self, name, b, sq, bidirectional):
+        """(per-example bucket table [B, nb, H], static bucket ids [sq, sq]).
+
+        Under pp the [1, H, S, S] bias must NOT ride aux (it would be stored
+        per-microbatch in residuals and the fp32 daux accumulator); the tiny
+        embedding table does instead, and blocks expand it on the fly. The
+        bucket ids fold to a constant at trace time (pure arange math).
+        """
+        cfg = self.config
+        table = self.scope.get_variable("params", name)[
+            "relative_attention_bias"]["embedding"]  # [nb, H]
+        rel = jnp.arange(sq)[None, :] - jnp.arange(sq)[:, None]
+        buckets = relative_position_bucket(
+            rel, bidirectional, cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance,
+        )  # concrete [sq, sq]
+        return jnp.broadcast_to(table[None], (b,) + table.shape), buckets
+
+    @staticmethod
+    def _bias_from_table(table_t, buckets):
+        """[b, nb, H] per-microbatch table + [sq, skv] ids → [b, H, sq, skv]."""
+        bias = jnp.take(table_t, buckets, axis=1)  # [b, sq, skv, H]
+        return jnp.transpose(bias, (0, 3, 1, 2)).astype(jnp.float32)
 
     @nn.compact
     def __call__(self, input_ids, decoder_input_ids, positions=None, segment_ids=None):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
+        b = input_ids.shape[0]
+        use_pp = (
+            cfg.pp_microbatches > 0 and cfg.scan_layers
+            and not self.is_initializing()
+        )
         embed = nn.Embed(
             cfg.padded_vocab_size_, cfg.d_model, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name="shared",
@@ -265,19 +308,49 @@ class T5ForConditionalGeneration(nn.Module):
         # ---------------- encoder
         x = embed(input_ids)
         x = constrain(x, ("dp", "ep"), "sp", None)
-        enc_bias = RelativeBias(cfg, bidirectional=True, name="enc_rel_bias")(
-            input_ids.shape[1], input_ids.shape[1]
-        )
-        x, _ = _scan_stack(_ScanEnc, cfg, cfg.num_layers, "encoder")(x, enc_bias)
+        if use_pp:
+            # the tiny rel-bias table rides aux (differentiable via daux);
+            # blocks expand it to [b, H, S, S] transiently
+            table_b, buckets = self._rel_bias_pieces(
+                "enc_rel_bias", b, input_ids.shape[1], bidirectional=True
+            )
+            enc_block = T5EncoderBlock(cfg)
+
+            # bind buckets NOW: the custom-vjp backward re-invokes this after
+            # the decoder rebinds the local name (late-binding closure trap)
+            def enc_apply(p, h, aux_t, _buckets=buckets):
+                bias = self._bias_from_table(aux_t["bias_table"], _buckets)
+                return enc_block.apply({"params": p}, h, bias)
+
+            x = self._pp_stream("encoder", enc_apply, x, {"bias_table": table_b})
+        else:
+            enc_bias = RelativeBias(cfg, bidirectional=True, name="enc_rel_bias")(
+                input_ids.shape[1], input_ids.shape[1]
+            )
+            x, _ = _scan_stack(_ScanEnc, cfg, cfg.num_layers, "encoder")(x, enc_bias)
         enc = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="enc_norm")(x)
 
         # ---------------- decoder
         y = embed(decoder_input_ids)
         y = constrain(y, ("dp", "ep"), "sp", None)
-        dec_bias = RelativeBias(cfg, bidirectional=False, name="dec_rel_bias")(
-            decoder_input_ids.shape[1], decoder_input_ids.shape[1]
-        )
-        y, _ = _scan_stack(_ScanDec, cfg, self.config.decoder_layers_, "decoder")(y, enc, dec_bias)
+        if use_pp:
+            table_b, buckets = self._rel_bias_pieces(
+                "dec_rel_bias", b, decoder_input_ids.shape[1], bidirectional=False
+            )
+            dec_block = T5DecoderBlock(cfg)
+
+            def dec_apply(p, h, aux_t, _buckets=buckets):
+                bias = self._bias_from_table(aux_t["bias_table"], _buckets)
+                return dec_block.apply({"params": p}, h, aux_t["enc"], bias)
+
+            y = self._pp_stream(
+                "decoder", dec_apply, y, {"bias_table": table_b, "enc": enc}
+            )
+        else:
+            dec_bias = RelativeBias(cfg, bidirectional=False, name="dec_rel_bias")(
+                decoder_input_ids.shape[1], decoder_input_ids.shape[1]
+            )
+            y, _ = _scan_stack(_ScanDec, cfg, self.config.decoder_layers_, "decoder")(y, enc, dec_bias)
         y = RMSNorm(eps=cfg.layer_norm_epsilon, dtype=dtype, name="dec_norm")(y)
 
         if cfg.tie_word_embeddings:

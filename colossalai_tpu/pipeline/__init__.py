@@ -1,5 +1,11 @@
+from .dispatch import run_pipeline
 from .one_f_one_b import pipeline_blocks_vjp
 from .schedule import pipeline_blocks
 from .stage_manager import PipelineStageManager
 
-__all__ = ["pipeline_blocks", "pipeline_blocks_vjp", "PipelineStageManager"]
+__all__ = [
+    "pipeline_blocks",
+    "pipeline_blocks_vjp",
+    "run_pipeline",
+    "PipelineStageManager",
+]

@@ -1,0 +1,53 @@
+"""Schedule dispatch shared by every pipelined stack.
+
+One place maps ``cfg.pp_schedule`` to the engine call (gpipe autodiff stream
+vs the 1f1b/interleaved/zb custom-vjp engine) so decoder-only stacks
+(``models/stack.py``) and the encoder-decoder path (``models/t5.py``) cannot
+drift apart on chunks/split_dw/remat plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+def run_pipeline(
+    block_apply: Callable,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh,
+    cfg,
+    aux: Any = None,
+    *,
+    has_aux: bool = False,
+):
+    """Stream ``x`` through the stacked blocks per ``cfg``'s pp settings.
+
+    ``block_apply(layer_params, h, aux_t) -> h`` (or ``(h, aux_scalar)``
+    with ``has_aux``). Returns ``x_out`` or ``(x_out, aux_total)``.
+    Float leaves of ``aux`` are differentiable through every schedule.
+    """
+    from colossalai_tpu.models.stack import checkpoint_policy
+
+    from .one_f_one_b import pipeline_blocks_vjp
+    from .schedule import pipeline_blocks
+
+    schedule = getattr(cfg, "pp_schedule", "1f1b")
+    if schedule == "gpipe":
+        if has_aux:
+            raise NotImplementedError(
+                "MoE aux loss under the gpipe schedule: use pp_schedule="
+                "'1f1b'/'interleaved'/'zb', which stream aux natively"
+            )
+        return pipeline_blocks(
+            block_apply, stacked_params, x, mesh, cfg.pp_microbatches,
+            aux=aux, remat=cfg.remat, remat_policy=checkpoint_policy(cfg),
+        )
+    return pipeline_blocks_vjp(
+        block_apply, stacked_params, x, mesh, cfg.pp_microbatches,
+        aux=aux, remat=cfg.remat, chunks=getattr(cfg, "pp_chunks", 1),
+        split_dw=(schedule == "zb"), has_aux=has_aux,
+        remat_policy=checkpoint_policy(cfg),
+    )

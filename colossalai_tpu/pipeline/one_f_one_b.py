@@ -260,22 +260,32 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
             return h.astype(x_mb_l.dtype)
 
         def bwd_compute(c, valid, h_in, g_out, b):
-            """vjp of stage c on stashed input; returns (dp_c, dx)."""
+            """vjp of stage c on stashed input; returns (dp_c, dx, da_t).
+
+            aux enters the vjp as an argument so float aux inputs (e.g. an
+            encoder output cross-attended by every decoder block) get real
+            cotangents; integer aux (positions, segment ids) comes back as
+            float0 and is dropped by the accumulator.
+            """
             p_c = p_at(c)
             aux_t = aux_at(b)
             h_in = h_in.astype(x_dtype)
             g = (g_out.astype(x_dtype), daux_l.astype(jnp.float32))
 
             if split_dw:
-                # dX only: params closed over (≙ ZB's B pass)
-                _, vjp = jax.vjp(lambda hh: stage_fn(p_c, hh, aux_t), h_in)
-                dx = vjp(g)[0]
-                return None, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype)
+                # dX (+dAux) only: params closed over (≙ ZB's B pass)
+                _, vjp = jax.vjp(
+                    lambda hh, at: stage_fn(p_c, hh, at), h_in, aux_t
+                )
+                dx, da = vjp(g)
+                return None, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype), da
 
-            _, vjp = jax.vjp(lambda p, hh: stage_fn(p, hh, aux_t), p_c, h_in)
-            dp, dx = vjp(g)
+            _, vjp = jax.vjp(
+                lambda p, hh, at: stage_fn(p, hh, at), p_c, h_in, aux_t
+            )
+            dp, dx, da = vjp(g)
             dp = jax.tree.map(lambda g_: jnp.where(valid, g_, 0.0), dp)
-            return dp, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype)
+            return dp, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype), da
 
         def w_compute(c, valid, h_in, g_out, b):
             """deferred dW (≙ WeightGradStore.flush): params-grad only."""
@@ -286,8 +296,17 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
             dp = vjp(g)[0]
             return jax.tree.map(lambda g_: jnp.where(valid, g_, 0.0), dp)
 
+        def acc_daux(acc, a, g_, valid, idx):
+            """Add one stage's aux cotangent for microbatch ``idx``; float0
+            (integer aux) and invalid ticks leave the buffer untouched."""
+            if not jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact):
+                return acc
+            g_ = jnp.where(valid, g_.astype(acc.dtype), 0.0)
+            prev = jax.lax.dynamic_index_in_dim(acc, idx, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(acc, prev + g_, idx, 0)
+
         def tick(carry, t):
-            send_f, send_b, stash, wstash, dparams, dx_acc = carry
+            send_f, send_b, stash, wstash, dparams, dx_acc, daux_acc = carry
             recv_f = jax.lax.ppermute(send_f, pp_axis, fwd_perm)
             recv_b = jax.lax.ppermute(send_b, pp_axis, rev_perm)
             lanes_f, lanes_b = [], []
@@ -324,8 +343,13 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
                     g_out = jnp.where(s == pp - 1, recv_b[c + 1], recv_b[c])
                 bslot = jnp.where(valid_b, jnp.mod(b_i, R), 0)
                 h_in = jax.lax.dynamic_index_in_dim(stash[c], bslot, keepdims=False)
-                dp, dx = bwd_compute(c, valid_b, h_in, g_out, b_i)
+                dp, dx, da = bwd_compute(c, valid_b, h_in, g_out, b_i)
                 lanes_b.append(dx)
+                bi_idx = jnp.clip(b_i, 0, n - 1)
+                daux_acc = jax.tree.map(
+                    lambda acc, a, g_: acc_daux(acc, a, g_, valid_b, bi_idx),
+                    daux_acc, aux_mb_l, da,
+                )
                 if dp is not None:
                     dparams = jax.tree.map(
                         lambda acc, g_: acc.at[c].add(g_), dparams, dp
@@ -361,20 +385,44 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
                     )
             return (
                 jnp.stack(lanes_f), jnp.stack(lanes_b), stash, wstash,
-                dparams, dx_acc,
+                dparams, dx_acc, daux_acc,
             ), None
 
         send0 = jnp.zeros((chunks,) + mb_shape, x_mb_l.dtype)
         stash0 = jnp.zeros((chunks, R) + mb_shape, x_mb_l.dtype)
         wstash0 = jnp.zeros((chunks, Rw) + mb_shape, x_mb_l.dtype)
-        carry0 = (send0, send0, stash0, wstash0, dparams0, jnp.zeros_like(x_mb_l))
-        (_, _, _, _, dparams, dx_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        # integer aux (positions, segment ids) has a statically-zero
+        # cotangent: carry a scalar sentinel instead of a dead full-size
+        # buffer (and skip its psum below)
+        daux0 = jax.tree.map(
+            lambda a: (
+                jnp.zeros(a.shape, jnp.float32)
+                if jnp.issubdtype(a.dtype, jnp.inexact)
+                else jnp.zeros((), jnp.float32)
+            ),
+            aux_mb_l,
+        )
+        carry0 = (
+            send0, send0, stash0, wstash0, dparams0, jnp.zeros_like(x_mb_l), daux0,
+        )
+        (_, _, _, _, dparams, dx_acc, daux_acc), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
 
-        # dx lives only on stage 0 → replicate; dparams stay pp-local
+        # dx lives only on stage 0 → replicate; dparams stay pp-local;
+        # daux contributions are spread over stages → sum the ring
         mask = (s == 0).astype(dx_acc.dtype)
         dx_acc = jax.lax.psum(dx_acc * mask, pp_axis)
+        daux_acc = jax.tree.map(
+            lambda g, a: (
+                jax.lax.psum(g, pp_axis)
+                if jnp.issubdtype(a.dtype, jnp.inexact)
+                else g
+            ),
+            daux_acc, aux_mb_l,
+        )
         dparams = jax.tree.map(lambda g: g[:, None], dparams)  # [chunks,1,Lv,...]
-        return dparams, dx_acc
+        return dparams, dx_acc, daux_acc
 
     param_specs = jax.tree.map(
         lambda l: P(None, pp_axis, *([None] * (l.ndim - 2))), params_r
@@ -383,19 +431,28 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb), P(), P()),
-        out_specs=(param_specs, P()),
+        out_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb)),
         axis_names={pp_axis},
         check_vma=False,
     )
     # the fwd averaged aux over microbatches, so each per-mb vjp seed is 1/n
     daux_in = jnp.asarray(daux, jnp.float32) / n
-    dparams_r, dx_mb = fn(params_r, x_mb, aux_mb, dout_mb, daux_in)
+    dparams_r, dx_mb, daux_mb = fn(params_r, x_mb, aux_mb, dout_mb, daux_in)
     dparams = jax.tree.map(
         lambda g, l: g.reshape(l.shape).astype(l.dtype), dparams_r, stacked_params
     )
     dx = dx_mb.reshape(x.shape).astype(x.dtype)
-    daux_zeros = jax.tree.map(lambda a: jnp.zeros_like(a), aux)
-    return dparams, dx, daux_zeros
+    # [n, b/n, ...] microbatch layout back to the full aux shape; integer
+    # aux keeps zero cotangents (float0-equivalent for the outer autodiff)
+    daux_out = jax.tree.map(
+        lambda g, a: (
+            g.reshape(a.shape).astype(a.dtype)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+            else jnp.zeros_like(a)
+        ),
+        daux_mb, aux,
+    )
+    return dparams, dx, daux_out
 
 
 _pipe.defvjp(_pipe_fwd, _pipe_bwd)

@@ -46,8 +46,18 @@ def constrain(x: jax.Array, *spec) -> jax.Array:
 
     Axis names not present in the mesh (or sized 1) are legal — GSPMD treats
     them as unsharded, so the same model code runs under every parallel config.
+
+    Inside a (partially-)manual region (a ``shard_map`` body, e.g. the pp
+    pipeline), a NamedSharding pinned to the concrete all-Auto mesh no longer
+    matches the context's axis types — most visibly when the region is
+    TRANSPOSED (differentiable pipeline aux). A bare PartitionSpec resolves
+    against whatever abstract mesh is current, so it is correct in both
+    worlds; manual axes (pp/sp) never appear in activation specs.
     """
     mesh = _CURRENT_MESH
     if mesh is None or mesh.size == 1:
         return x
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and not ctx.empty and not ctx.are_all_axes_auto:
+        return jax.lax.with_sharding_constraint(x, PartitionSpec(*spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*spec)))

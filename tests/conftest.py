@@ -20,13 +20,11 @@ import pytest  # noqa: E402
 # pinned; config.update before first backend use still wins.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite's cost is dominated by XLA
-# compiles of the same tiny shapes on a single-core host — warm runs skip
-# them entirely (the cache key covers backend/flags, so it is safe).
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# NOTE: do NOT enable jax_compilation_cache_dir here. On this jaxlib/CPU
+# build, deserializing cached executables aborts the process (first, cache-
+# writing run passes; the warm run dies with "Fatal Python error: Aborted"
+# inside Array._value). Reproduce: enable it, run
+# tests/test_models/test_bert_vit_fp8.py twice.
 
 
 @pytest.fixture(autouse=True)

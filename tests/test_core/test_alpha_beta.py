@@ -1,0 +1,73 @@
+"""α-β cost model + profiler (≙ reference tests for AlphaBetaProfiler /
+DeviceMesh cost model)."""
+
+import numpy as np
+import pytest
+
+from colossalai_tpu.device import (
+    AlphaBeta,
+    AlphaBetaProfiler,
+    collective_costs,
+    create_device_mesh,
+    default_alpha_beta,
+)
+
+
+def test_ring_cost_formulas():
+    ab = AlphaBeta(alpha=1e-6, beta=1e-9)
+    n, b = 4, 1 << 20
+    ag = ab.all_gather(b, n)
+    # ring all-gather: (n-1) hops, (n-1)/n of the payload over the link
+    assert ag == pytest.approx((n - 1) * 1e-6 + (n - 1) / n * b * 1e-9)
+    assert ab.reduce_scatter(b, n) == pytest.approx(ag)
+    assert ab.all_reduce(b, n) == pytest.approx(2 * ag)
+    # all-to-all moves 1/n of the all-gather volume
+    assert ab.all_to_all(b, n) < ag
+    # single-device axes are free
+    assert ab.all_gather(b, 1) == 0.0
+    # bigger payloads cost more
+    assert ab.all_reduce(2 * b, n) > ab.all_reduce(b, n)
+
+
+def test_default_alpha_beta_dcn_slower_than_ici():
+    ici = default_alpha_beta(generation="v5p")
+    dcn = default_alpha_beta(dcn=True)
+    assert dcn.beta > ici.beta
+    assert dcn.alpha > ici.alpha
+
+
+def test_collective_costs_table(mesh8):
+    costs = collective_costs(mesh8, nbytes=1 << 20)
+    # dp=2, tp=2, sp=2 are the non-trivial axes of the fixture mesh
+    assert set(costs) == {"dp", "tp", "sp"}
+    for ax in costs:
+        assert costs[ax]["all_reduce"] == pytest.approx(2 * costs[ax]["all_gather"])
+        assert costs[ax]["all_to_all"] < costs[ax]["all_gather"]
+
+
+def test_profiler_measures_positive_beta(mesh8):
+    prof = AlphaBetaProfiler(mesh8)
+    ab = prof.profile("tp", small=256, large=1 << 16)
+    assert ab.beta > 0.0
+    assert np.isfinite(ab.alpha) and ab.alpha >= 0.0
+    # measured numbers must plug into the model
+    assert ab.all_reduce(1 << 20, 2) > 0.0
+
+
+def test_profiler_beta_fit_inverts_ring_slope():
+    """The two-point fit must divide out the 2(n-1)/n ring slope so measured
+    betas are comparable across axis sizes and with default_alpha_beta."""
+
+    class _FakeProf(AlphaBetaProfiler):
+        def _time_psum(self, axis, n_elems, iters=5):
+            n = getattr(self.mesh, "mesh", self.mesh).shape[axis]
+            ab = AlphaBeta(alpha=2e-6, beta=1e-9)
+            return ab.all_reduce(4 * n_elems, n)  # exact model time
+
+    class _FakeMesh:
+        class mesh:
+            shape = {"x": 8}
+
+    ab = _FakeProf(_FakeMesh()).profile("x")
+    assert ab.beta == pytest.approx(1e-9, rel=1e-3)
+    assert ab.alpha == pytest.approx(2e-6, rel=1e-2)
