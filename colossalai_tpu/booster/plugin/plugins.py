@@ -172,7 +172,17 @@ class HybridParallelPlugin(Plugin):
                   rng=None, policy=None, devices=None, lora=None):
         self._resolved_microbatches = self.num_microbatches
         if self.pp_size > 1 and example_batch is not None:
-            batch_size = example_batch["input_ids"].shape[0]
+            # batch size from whichever model input the batch carries
+            # (input_features for audio models, pixel_values for vision)
+            for key in ("input_ids", "input_features", "pixel_values"):
+                if key in example_batch:
+                    batch_size = example_batch[key].shape[0]
+                    break
+            else:
+                raise ValueError(
+                    "pp needs example_batch with input_ids/input_features/"
+                    f"pixel_values to infer batch size; got {sorted(example_batch)}"
+                )
             if self.microbatch_size is not None:
                 if batch_size % self.microbatch_size:
                     raise ValueError(

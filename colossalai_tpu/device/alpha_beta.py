@@ -43,9 +43,14 @@ def _detect_generation() -> str:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:  # backend not initialized
         return "cpu"
-    for gen in ("v6e", "v5p", "v5e", "v4"):
-        if gen in kind.replace(" ", "").replace("tpu", ""):
-            return gen
+    # real device_kind strings spell lite parts out: "TPU v5 lite",
+    # "TPU v6 lite" — not "v5e"/"v6e"
+    if "v6" in kind:
+        return "v6e"
+    if "v5" in kind:
+        return "v5e" if "lite" in kind or "v5e" in kind else "v5p"
+    if "v4" in kind:
+        return "v4"
     if "tpu" in kind:
         return "v5e"
     return "cpu"

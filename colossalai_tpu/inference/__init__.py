@@ -1,3 +1,4 @@
+from .diffusion import ddim_sample, ddim_schedule
 from .engine import GenerationConfig, LLMEngine, Request
 from .kv_cache import (
     BlockAllocator,
@@ -12,6 +13,8 @@ from .server import make_server
 from .speculative import SpeculativeEngine, SpecStats
 
 __all__ = [
+    "ddim_sample",
+    "ddim_schedule",
     "GenerationConfig",
     "LLMEngine",
     "Request",

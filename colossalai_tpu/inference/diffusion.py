@@ -1,0 +1,93 @@
+"""Diffusion sampling for DiT (≙ reference ``inference/modeling/layers/
+distrifusion.py`` — patch-parallel DiT inference, plus its diffusion
+pipelines).
+
+The reference splits image patches across GPUs with displaced async patch
+parallelism (hand-managed halo comm). Here patch parallelism is the mesh's
+``sp`` axis: DiT constrains its token dim over ``sp``, the sampler jits one
+denoise step over the mesh, and XLA inserts the gathers around global
+attention. The whole sampling loop is one compiled program per step shape —
+no per-step dispatch, no halo bookkeeping.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def ddim_schedule(n_train: int = 1000, n_steps: int = 50):
+    """(timesteps [n_steps], alpha_bar [n_train]) — cosine schedule."""
+    t = np.linspace(n_train - 1, 0, n_steps).round().astype(np.int32)
+    x = np.arange(n_train + 1) / n_train
+    abar = np.cos((x + 0.008) / 1.008 * np.pi / 2) ** 2
+    return jnp.asarray(t), jnp.asarray(abar[:-1] / abar[0], jnp.float32)
+
+
+def ddim_sample(
+    model,
+    params,
+    rng: jax.Array,
+    labels: jax.Array,
+    *,
+    mesh=None,
+    n_steps: int = 50,
+    n_train: int = 1000,
+    guidance_scale: float = 4.0,
+    eta: float = 0.0,
+):
+    """Class-conditional DDIM sampling with classifier-free guidance.
+
+    ``labels`` [B] class ids; returns latents [B, H, W, C]. With ``mesh``,
+    the batch shards over the data axes and patches over ``sp`` (the model's
+    internal constraints do the patch split — pass the mesh the params were
+    built under).
+    """
+    cfg = model.config
+    b = labels.shape[0]
+    shape = (b, cfg.input_size, cfg.input_size, cfg.in_channels)
+    ts, abar = ddim_schedule(n_train, n_steps)
+    uncond = jnp.full_like(labels, cfg.num_classes)
+
+    def eps_at(x, t_scalar, y):
+        t_b = jnp.full((b,), t_scalar, jnp.int32)
+        out = model.apply(params, x, y, t_b).sample
+        return out[..., : cfg.in_channels].astype(jnp.float32)
+
+    def step(x, args):
+        t_cur, t_next, key = args
+        # classifier-free guidance: uncond + s * (cond - uncond)
+        e_c = eps_at(x, t_cur, labels)
+        e_u = eps_at(x, t_cur, uncond)
+        eps = e_u + guidance_scale * (e_c - e_u)
+        a_t = abar[t_cur]
+        a_n = jnp.where(t_next >= 0, abar[jnp.maximum(t_next, 0)], 1.0)
+        x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+        sigma = eta * jnp.sqrt((1 - a_n) / (1 - a_t)) * jnp.sqrt(1 - a_t / a_n)
+        dir_xt = jnp.sqrt(jnp.maximum(1.0 - a_n - sigma**2, 0.0)) * eps
+        noise = sigma * jax.random.normal(key, x.shape)
+        x = jnp.sqrt(a_n) * x0 + dir_xt + noise
+        return x.astype(jnp.float32), None
+
+    keys = jax.random.split(rng, n_steps + 1)
+    x0 = jax.random.normal(keys[0], shape, jnp.float32)
+    t_next = jnp.concatenate([ts[1:], jnp.asarray([-1])])
+
+    def run(x0):
+        x, _ = jax.lax.scan(step, x0, (ts, t_next, keys[1:]))
+        return x
+
+    if mesh is not None:
+        from colossalai_tpu.tensor import use_mesh
+
+        jmesh = getattr(mesh, "mesh", mesh)
+        with use_mesh(jmesh):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            x0 = jax.device_put(x0, NamedSharding(jmesh, P(("dp", "ep"))))
+            return jax.jit(run)(x0)
+    return jax.jit(run)(x0)

@@ -49,7 +49,11 @@ from .heads import QuestionAnswering, SequenceClassifier, TokenClassifier
 from .reward import RewardModel, reward_at_last_token
 from .t5 import Seq2SeqOutput, T5Config, T5EncoderModel, T5ForConditionalGeneration, shift_right
 from .transformer import DecoderConfig, DecoderLM
-from .whisper import WhisperConfig, WhisperForConditionalGeneration
+from .whisper import (
+    WhisperConfig,
+    WhisperForAudioClassification,
+    WhisperForConditionalGeneration,
+)
 from .vit import ViTConfig, ViTForImageClassification, ViTOutput
 from .blip2 import Blip2Config, Blip2ForConditionalGeneration, Blip2Output
 from .dit import DiTConfig, DiTModel, DiTOutput
@@ -146,6 +150,7 @@ __all__ = [
     "Seq2SeqOutput",
     "shift_right",
     "WhisperConfig",
+    "WhisperForAudioClassification",
     "WhisperForConditionalGeneration",
     "DeepseekV2Config",
     "DeepseekV2ForCausalLM",

@@ -125,7 +125,10 @@ class DiTBlock(nn.Module):
             q, rs(k), rs(v), causal=False, impl=cfg.attention_impl
         )
         attn = dense(cfg.hidden_size, "proj")(attn.reshape(b, s, cfg.hidden_size))
-        x = x + g_a[:, None] * attn
+        # patch (sequence) parallelism over sp — the distrifusion analog:
+        # tokens stay sp-sharded between blocks, GSPMD gathers k/v for the
+        # global attention (split_gather semantics)
+        x = constrain(x + g_a[:, None] * attn, ("dp", "ep"), "sp", None)
 
         h = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, use_bias=False, use_scale=False,
@@ -134,9 +137,9 @@ class DiTBlock(nn.Module):
         h = _modulate(h, sh_m, sc_m)
         h = dense(cfg.mlp_ratio * cfg.hidden_size, "fc1")(h)
         h = nn.gelu(h, approximate=True)
-        h = constrain(h, ("dp", "ep"), None, "tp")
+        h = constrain(h, ("dp", "ep"), "sp", "tp")
         h = dense(cfg.hidden_size, "fc2")(h)
-        return x + g_m[:, None] * h
+        return constrain(x + g_m[:, None] * h, ("dp", "ep"), "sp", None)
 
 
 class DiTModel(nn.Module):
@@ -148,7 +151,9 @@ class DiTModel(nn.Module):
     """
 
     config: DiTConfig
-    supports_sp_modes = ()
+    # split_gather: patch tokens shard over sp between blocks (GSPMD gathers
+    # around the global attention) — the distrifusion patch-parallel analog
+    supports_sp_modes = ("split_gather",)
     supports_pipeline = True
 
     @nn.compact
@@ -171,7 +176,7 @@ class DiTModel(nn.Module):
             (1, gh * gw, cfg.hidden_size), pdtype,
         )
         x = x + pos.astype(dtype)
-        x = constrain(x, ("dp", "ep"), None, None)
+        x = constrain(x, ("dp", "ep"), "sp", None)
 
         t_emb = timestep_embedding(positions, 256)
         t_emb = nn.Dense(cfg.hidden_size, dtype=dtype, param_dtype=pdtype,

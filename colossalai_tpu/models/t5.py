@@ -256,17 +256,6 @@ class T5ForConditionalGeneration(nn.Module):
     supports_pipeline = True
     supports_sp_modes = ("split_gather",)
 
-    def _pp_stream(self, name, block_apply, x, aux):
-        """Stream one stack (encoder or decoder) over the pp mesh axis."""
-        from colossalai_tpu.pipeline import run_pipeline
-        from colossalai_tpu.tensor import current_mesh
-
-        mesh = current_mesh()
-        if mesh is None:
-            raise RuntimeError("pipeline parallelism requires an ambient mesh")
-        stacked = self.scope.get_variable("params", name)["block"]
-        return run_pipeline(block_apply, stacked, x, mesh, self.config, aux)
-
     def _rel_bias_pieces(self, name, b, sq, bidirectional):
         """(per-example bucket table [B, nb, H], static bucket ids [sq, sq]).
 
@@ -296,10 +285,9 @@ class T5ForConditionalGeneration(nn.Module):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         b = input_ids.shape[0]
-        use_pp = (
-            cfg.pp_microbatches > 0 and cfg.scan_layers
-            and not self.is_initializing()
-        )
+        from colossalai_tpu.pipeline import stream_module_stack, wants_pipeline
+
+        use_pp = wants_pipeline(self)
         embed = nn.Embed(
             cfg.padded_vocab_size_, cfg.d_model, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name="shared",
@@ -322,7 +310,7 @@ class T5ForConditionalGeneration(nn.Module):
                 bias = self._bias_from_table(aux_t["bias_table"], _buckets)
                 return enc_block.apply({"params": p}, h, bias)
 
-            x = self._pp_stream("encoder", enc_apply, x, {"bias_table": table_b})
+            x = stream_module_stack(self, "encoder", enc_apply, x, {"bias_table": table_b})
         else:
             enc_bias = RelativeBias(cfg, bidirectional=True, name="enc_rel_bias")(
                 input_ids.shape[1], input_ids.shape[1]
@@ -343,8 +331,8 @@ class T5ForConditionalGeneration(nn.Module):
                 bias = self._bias_from_table(aux_t["bias_table"], _buckets)
                 return dec_block.apply({"params": p}, h, aux_t["enc"], bias)
 
-            y = self._pp_stream(
-                "decoder", dec_apply, y, {"bias_table": table_b, "enc": enc}
+            y = stream_module_stack(
+                self, "decoder", dec_apply, y, {"bias_table": table_b, "enc": enc}
             )
         else:
             dec_bias = RelativeBias(cfg, bidirectional=False, name="dec_rel_bias")(

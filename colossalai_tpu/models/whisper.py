@@ -168,7 +168,9 @@ class _ScanDec(nn.Module):
 
 class WhisperForConditionalGeneration(nn.Module):
     config: WhisperConfig
-    supports_pipeline = False
+    # enc-dec staging (same design as T5): each pp stage holds a slice of
+    # both stacks; the encoder output rides the differentiable pipeline aux
+    supports_pipeline = True
     supports_sp_modes = ()
 
     @nn.compact
@@ -176,6 +178,9 @@ class WhisperForConditionalGeneration(nn.Module):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         pdtype = cfg.param_dtype or jnp.float32
+        from colossalai_tpu.pipeline import stream_module_stack, wants_pipeline
+
+        use_pp = wants_pipeline(self)
 
         # -------------- encoder: [B, n_mels, T] conv frontend
         x = jnp.swapaxes(input_features.astype(dtype), 1, 2)  # [B, T, mels]
@@ -184,10 +189,18 @@ class WhisperForConditionalGeneration(nn.Module):
         pos_table = jnp.asarray(sinusoids(cfg.max_source_positions, cfg.d_model), dtype)
         x = x + pos_table[: x.shape[1]][None]
         x = constrain(x, ("dp", "ep"), None, None)
-        enc, _ = nn.scan(
-            _ScanEnc, variable_axes={"params": 0}, split_rngs={"params": True},
-            length=cfg.encoder_layers, metadata_params={nn.PARTITION_NAME: "encoder"},
-        )(cfg, name="encoder")(x)
+        if use_pp:
+            enc_block = WhisperEncoderBlock(cfg)
+            enc = stream_module_stack(
+                self, "encoder",
+                lambda p, h, aux_t: enc_block.apply({"params": p}, h),
+                x, {},
+            )
+        else:
+            enc, _ = nn.scan(
+                _ScanEnc, variable_axes={"params": 0}, split_rngs={"params": True},
+                length=cfg.encoder_layers, metadata_params={nn.PARTITION_NAME: "encoder"},
+            )(cfg, name="encoder")(x)
         enc = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="encoder_layer_norm")(enc)
 
         # -------------- decoder
@@ -204,14 +217,66 @@ class WhisperForConditionalGeneration(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         y = y + wpe(positions)
-        y, _ = nn.scan(
-            _ScanDec, variable_axes={"params": 0}, split_rngs={"params": True},
-            in_axes=(nn.broadcast,), length=cfg.decoder_layers,
-            metadata_params={nn.PARTITION_NAME: "decoder"},
-        )(cfg, name="decoder")(y, enc)
+        if use_pp:
+            dec_block = WhisperDecoderBlock(cfg)
+            y = stream_module_stack(
+                self, "decoder",
+                lambda p, h, aux_t: dec_block.apply({"params": p}, h, aux_t["enc"]),
+                y, {"enc": enc},
+            )
+        else:
+            y, _ = nn.scan(
+                _ScanDec, variable_axes={"params": 0}, split_rngs={"params": True},
+                in_axes=(nn.broadcast,), length=cfg.decoder_layers,
+                metadata_params={nn.PARTITION_NAME: "decoder"},
+            )(cfg, name="decoder")(y, enc)
         y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="decoder_layer_norm")(y)
 
         logits = embed.attend(y.astype(jnp.float32))
         logits = constrain(logits, ("dp", "ep"), None, "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
         return Seq2SeqOutput(logits=logits, encoder_last_hidden_state=enc)
+
+
+class WhisperForAudioClassification(nn.Module):
+    """Encoder + mean-pool + classifier (≙ HF WhisperForAudioClassification
+    in the reference's policy table). Reuses the conv frontend + encoder
+    stack param layout of the seq2seq model (names match, so the policy and
+    HF interop maps apply)."""
+
+    config: WhisperConfig
+    num_labels: int = 2
+    supports_sp_modes = ()
+
+    def with_config(self, cfg):
+        """Keep num_labels across plugin config rebuilds (precision cast,
+        feature flags) — the generic rebuild would reset it to the default."""
+        return type(self)(cfg, num_labels=self.num_labels)
+
+    @nn.compact
+    def __call__(self, input_features, positions=None, segment_ids=None):
+        del positions, segment_ids
+        cfg = self.config
+        dtype = cfg.dtype or jnp.float32
+        pdtype = cfg.param_dtype or jnp.float32
+        x = jnp.swapaxes(input_features.astype(dtype), 1, 2)
+        x = nn.gelu(nn.Conv(cfg.d_model, (3,), padding=1, dtype=dtype, param_dtype=pdtype, name="conv1")(x))
+        x = nn.gelu(nn.Conv(cfg.d_model, (3,), strides=(2,), padding=1, dtype=dtype, param_dtype=pdtype, name="conv2")(x))
+        pos_table = jnp.asarray(sinusoids(cfg.max_source_positions, cfg.d_model), dtype)
+        x = x + pos_table[: x.shape[1]][None]
+        x = constrain(x, ("dp", "ep"), None, None)
+        enc, _ = nn.scan(
+            _ScanEnc, variable_axes={"params": 0}, split_rngs={"params": True},
+            length=cfg.encoder_layers, metadata_params={nn.PARTITION_NAME: "encoder"},
+        )(cfg, name="encoder")(x)
+        enc = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="encoder_layer_norm")(enc)
+        # HF pools with a learned projector then mean over frames
+        h = nn.Dense(cfg.d_model, dtype=dtype, param_dtype=pdtype, name="projector")(enc)
+        pooled = h.mean(axis=1)
+        logits = nn.Dense(
+            self.num_labels, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="classifier",
+        )(pooled.astype(jnp.float32))
+        from .base import CausalLMOutput
+
+        return CausalLMOutput(logits=logits, hidden_states=enc)

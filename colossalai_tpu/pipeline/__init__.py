@@ -1,4 +1,4 @@
-from .dispatch import run_pipeline
+from .dispatch import run_pipeline, stream_module_stack, wants_pipeline
 from .one_f_one_b import pipeline_blocks_vjp
 from .schedule import pipeline_blocks
 from .stage_manager import PipelineStageManager
@@ -7,5 +7,7 @@ __all__ = [
     "pipeline_blocks",
     "pipeline_blocks_vjp",
     "run_pipeline",
+    "stream_module_stack",
+    "wants_pipeline",
     "PipelineStageManager",
 ]

@@ -51,3 +51,26 @@ def run_pipeline(
         split_dw=(schedule == "zb"), has_aux=has_aux,
         remat_policy=checkpoint_policy(cfg),
     )
+
+
+def wants_pipeline(module) -> bool:
+    """The shared pp gate for models that stream stacks themselves."""
+    cfg = module.config
+    return (
+        getattr(cfg, "pp_microbatches", 0) > 0
+        and cfg.scan_layers
+        and not module.is_initializing()
+    )
+
+
+def stream_module_stack(module, name: str, block_apply: Callable, x, aux):
+    """Stream one named scanned stack of ``module`` over the pp mesh axis
+    (the enc-dec entry point — used by both T5 and Whisper so the mesh
+    lookup / param read / dispatch cannot drift apart)."""
+    from colossalai_tpu.tensor import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        raise RuntimeError("pipeline parallelism requires an ambient mesh")
+    stacked = module.scope.get_variable("params", name)["block"]
+    return run_pipeline(block_apply, stacked, x, mesh, module.config, aux)

@@ -39,6 +39,7 @@ POLICY_REGISTRY = {
     "T5EncoderModel": T5Policy,
     "whisper": WhisperPolicy,
     "WhisperForConditionalGeneration": WhisperPolicy,
+    "WhisperForAudioClassification": WhisperPolicy,
     "deepseek_v2": DeepseekV2Policy,
     "deepseek_v3": DeepseekV2Policy,
     "DeepseekV2ForCausalLM": DeepseekV2Policy,
