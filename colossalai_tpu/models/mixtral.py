@@ -19,7 +19,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from colossalai_tpu.moe.router import top_k_routing
+from colossalai_tpu.moe.router import (
+    combine_sorted,
+    dispatch_sorted,
+    top_k_routing,
+    top_k_routing_sorted,
+)
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
@@ -41,6 +46,11 @@ class MixtralConfig(LlamaConfig):
     aux_loss_coef: float = 0.01
     router_z_coef: float = 0.001
     n_shared_experts: int = 0  # DeepSeek-MoE style always-on experts
+    #: "einsum": [N,E,C] dispatch tensors — GSPMD turns them into ep
+    #: all-to-alls (the EP path). "sort": argsort+scatter bookkeeping,
+    #: O(N·k) instead of O(N·E·C) — the large-E path (≙ moe_kernel.cu's
+    #: sort/cumsum strategy); same routing semantics, same drops.
+    router_impl: str = "einsum"
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
@@ -115,9 +125,6 @@ class MoEMLP(nn.Module):
         )
         xg = x.reshape(n_groups, g, h)
         logits = (xg @ router_w.astype(dtype)).astype(jnp.float32)  # [G, g, E]
-        routing = jax.vmap(
-            lambda lg: top_k_routing(lg, cfg.num_experts_per_tok, cap)
-        )(logits)
 
         init = nn.initializers.lecun_normal()
         moe_i = cfg.moe_intermediate_size or cfg.intermediate_size
@@ -125,16 +132,40 @@ class MoEMLP(nn.Module):
         w_up = self.param("experts_up/kernel", init, (e, h, moe_i), pdtype)
         w_down = self.param("experts_down/kernel", init, (e, moe_i, h), pdtype)
 
-        # dispatch: [G,g,E,C] x [G,g,H] -> [G,E,C,H]  (GSPMD: all-to-all over ep)
-        expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), xg)
-        expert_in = constrain(expert_in, ("dp",), "ep", None, None)
-        gate = jnp.einsum("bech,ehi->beci", expert_in, w_gate.astype(dtype))
-        up = jnp.einsum("bech,ehi->beci", expert_in, w_up.astype(dtype))
-        act = nn.silu(gate) * up
-        expert_out = jnp.einsum("beci,eih->bech", act, w_down.astype(dtype))
-        expert_out = constrain(expert_out, ("dp",), "ep", None, None)
-        # combine: [G,g,E,C] x [G,E,C,H] -> [G,g,H]   (all-to-all back)
-        y = jnp.einsum("bsec,bech->bsh", routing.combine.astype(dtype), expert_out).reshape(b, s, h)
+        def expert_ffn(expert_in):  # [G, E, C, H] -> [G, E, C, H]
+            gate = jnp.einsum("bech,ehi->beci", expert_in, w_gate.astype(dtype))
+            up = jnp.einsum("bech,ehi->beci", expert_in, w_up.astype(dtype))
+            act = nn.silu(gate) * up
+            return jnp.einsum("beci,eih->bech", act, w_down.astype(dtype))
+
+        if cfg.router_impl not in ("einsum", "sort"):
+            raise ValueError(
+                f"router_impl={cfg.router_impl!r} not in ('einsum', 'sort')"
+            )
+        if cfg.router_impl == "sort":
+            routing = jax.vmap(
+                lambda lg: top_k_routing_sorted(lg, cfg.num_experts_per_tok, cap)
+            )(logits)
+            expert_in = jax.vmap(lambda xi, ri: dispatch_sorted(xi, ri, e, cap))(
+                xg, routing
+            )
+            expert_in = constrain(expert_in, ("dp",), "ep", None, None)
+            expert_out = expert_ffn(expert_in)
+            expert_out = constrain(expert_out, ("dp",), "ep", None, None)
+            y = jax.vmap(lambda eo, ri: combine_sorted(eo, ri, g))(
+                expert_out, routing
+            ).reshape(b, s, h).astype(dtype)
+        else:
+            routing = jax.vmap(
+                lambda lg: top_k_routing(lg, cfg.num_experts_per_tok, cap)
+            )(logits)
+            # dispatch: [G,g,E,C] x [G,g,H] -> [G,E,C,H]  (GSPMD: all-to-all over ep)
+            expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), xg)
+            expert_in = constrain(expert_in, ("dp",), "ep", None, None)
+            expert_out = expert_ffn(expert_in)
+            expert_out = constrain(expert_out, ("dp",), "ep", None, None)
+            # combine: [G,g,E,C] x [G,E,C,H] -> [G,g,H]   (all-to-all back)
+            y = jnp.einsum("bsec,bech->bsh", routing.combine.astype(dtype), expert_out).reshape(b, s, h)
         # DeepSeek-V2 scales the routed output (routed_scaling_factor)
         scale = getattr(cfg, "routed_scaling_factor", 1.0)
         if scale != 1.0:

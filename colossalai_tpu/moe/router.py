@@ -25,17 +25,35 @@ class RoutingResult(NamedTuple):
     router_z_loss: jax.Array  # logit magnitude regularizer
 
 
+def _topk_gates(router_logits: jax.Array, num_selected: int):
+    """(probs [N,E], gate_vals [N,k], expert_idx [N,k]) — shared prologue:
+    softmax, top-k, renormalized selected gates (mixtral convention)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    return probs, gate_vals, expert_idx
+
+
+def _router_losses(router_logits, probs, expert_idx, num_experts):
+    """Load-balancing loss: E * sum_e f_e * p_e, with f_e summed over ALL
+    top-k selections (matches HF Mixtral's load_balancing_loss_func:
+    loss == k at perfect balance) — top-1-only would leave half the
+    routing mass invisible at k=2. Plus the router z-loss."""
+    sel = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)  # [N, k, E]
+    frac_tokens = sel.mean(axis=0).sum(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux_loss = num_experts * jnp.sum(frac_tokens * frac_probs)
+    z = jax.scipy.special.logsumexp(router_logits.astype(jnp.float32), axis=-1)
+    return aux_loss, jnp.mean(z**2)
+
+
 def top_k_routing(
     router_logits: jax.Array,  # [N, E]
     num_selected: int,
     capacity: int,
 ) -> RoutingResult:
     n, e = router_logits.shape
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-
-    gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)  # [N, k]
-    # renormalize the selected gates (mixtral convention)
-    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    probs, gate_vals, expert_idx = _topk_gates(router_logits, num_selected)
 
     # slot assignment: fill slot-0 choices first, then slot-1, ... so the
     # higher-priority expert choice wins capacity (≙ moe_cumsum kernel)
@@ -57,14 +75,67 @@ def top_k_routing(
         combine = combine + disp_k * gate_vals[:, k][:, None, None]
         counts = counts + jnp.sum(mask_k, axis=0)
 
-    # Load-balancing loss: E * sum_e f_e * p_e, with f_e summed over ALL
-    # top-k selections (matches HF Mixtral's load_balancing_loss_func:
-    # loss == k at perfect balance) — top-1-only would leave half the
-    # routing mass invisible at k=2.
-    sel = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, k, E]
-    frac_tokens = sel.mean(axis=0).sum(axis=0)
-    frac_probs = probs.mean(axis=0)
-    aux_loss = e * jnp.sum(frac_tokens * frac_probs)
-    z = jax.scipy.special.logsumexp(router_logits.astype(jnp.float32), axis=-1)
-    router_z_loss = jnp.mean(z**2)
+    aux_loss, router_z_loss = _router_losses(router_logits, probs, expert_idx, e)
     return RoutingResult(dispatch, combine, aux_loss, router_z_loss)
+
+
+class SortedRouting(NamedTuple):
+    """Sort-based routing bookkeeping: O(N·k) indices, no [N, E, C] tensor
+    (≙ the reference's sort/cumsum kernel strategy in ``moe_kernel.cu``)."""
+
+    dest: jax.Array  # [N*k] flat slot id e*C + pos, or E*C for dropped
+    tok: jax.Array  # [N*k] source token index
+    gate: jax.Array  # [N*k] gate weight (0 for dropped)
+    aux_loss: jax.Array
+    router_z_loss: jax.Array
+
+
+def top_k_routing_sorted(
+    router_logits: jax.Array,  # [N, E]
+    num_selected: int,
+    capacity: int,
+) -> SortedRouting:
+    """Same routing semantics as :func:`top_k_routing` (slot-0 choices win
+    capacity, then slot-1, ...; same drops, same losses) with sort-based
+    bookkeeping: memory is O(N·k) int32 instead of O(N·E·C) float — the
+    large-E path (DeepSeek-V3-class expert counts).
+    """
+    n, e = router_logits.shape
+    k = num_selected
+    probs, gate_vals, expert_idx = _topk_gates(router_logits, k)
+
+    # k-major flattening + stable sort: every slot-0 entry of an expert
+    # sorts before its slot-1 entries, reproducing the einsum path's
+    # capacity priority; within a slot, token order is preserved.
+    flat_e = expert_idx.T.reshape(-1)  # [k*N]
+    flat_tok = jnp.tile(jnp.arange(n), k)
+    flat_gate = gate_vals.T.reshape(-1)
+    order = jnp.argsort(flat_e, stable=True)
+    se = flat_e[order]
+    st = flat_tok[order]
+    sg = flat_gate[order]
+    group_start = jnp.searchsorted(se, jnp.arange(e))  # [E]
+    pos = jnp.arange(k * n) - group_start[se]
+    keep = pos < capacity
+    dest = jnp.where(keep, se * capacity + pos, e * capacity)
+
+    aux_loss, router_z_loss = _router_losses(router_logits, probs, expert_idx, e)
+    return SortedRouting(dest, st, sg * keep, aux_loss, router_z_loss)
+
+
+def dispatch_sorted(x: jax.Array, r: SortedRouting, num_experts: int,
+                    capacity: int) -> jax.Array:
+    """[N, H] tokens → [E, C, H] expert inputs (dropped tokens land in a
+    discarded overflow row)."""
+    h = x.shape[-1]
+    buf = jnp.zeros((num_experts * capacity + 1, h), x.dtype)
+    buf = buf.at[r.dest].set(x[r.tok])
+    return buf[:-1].reshape(num_experts, capacity, h)
+
+
+def combine_sorted(expert_out: jax.Array, r: SortedRouting, n_tokens: int) -> jax.Array:
+    """[E, C, H] expert outputs → [N, H] gate-weighted scatter-add back."""
+    e, c, h = expert_out.shape
+    flat = expert_out.reshape(e * c, h)
+    vals = flat[jnp.minimum(r.dest, e * c - 1)] * r.gate[:, None].astype(flat.dtype)
+    return jnp.zeros((n_tokens, h), flat.dtype).at[r.tok].add(vals)
