@@ -125,6 +125,9 @@ class HybridParallelPlugin(Plugin):
     #: virtual stages per device when pp_schedule == "interleaved"
     #: (≙ num_model_chunks)
     pp_chunks: int = 1
+    #: checkpoint only this fraction of each stage's layers when the model
+    #: remats (≙ PipelineGradientCheckpointConfig per-stage ckpt ratios)
+    pp_remat_ratio: float = 1.0
 
     PP_SCHEDULES = ("1f1b", "interleaved", "zb", "gpipe")
 
@@ -149,6 +152,11 @@ class HybridParallelPlugin(Plugin):
         if self.pp_schedule not in self.PP_SCHEDULES:
             raise ValueError(
                 f"pp_schedule={self.pp_schedule!r} not in {self.PP_SCHEDULES}"
+            )
+        if not 0.0 < self.pp_remat_ratio <= 1.0:
+            raise ValueError(
+                f"pp_remat_ratio={self.pp_remat_ratio} must be in (0, 1] "
+                "(disable rematerialization with the model's remat=False)"
             )
         # chunked virtual stages: required by interleaved, optional for zb
         # (≙ ZBV's V-shaped chunking), meaningless for 1f1b/gpipe
@@ -240,6 +248,8 @@ class HybridParallelPlugin(Plugin):
                 updates["pp_schedule"] = self.pp_schedule
             if getattr(model.config, "pp_chunks", 1) != self.pp_chunks:
                 updates["pp_chunks"] = self.pp_chunks
+            if getattr(model.config, "pp_remat_ratio", 1.0) != self.pp_remat_ratio:
+                updates["pp_remat_ratio"] = self.pp_remat_ratio
         if not self.enable_flash_attention and getattr(model.config, "attention_impl", None) not in (None, "xla"):
             updates["attention_impl"] = "xla"
         if self.enable_fp8:

@@ -49,6 +49,11 @@ class ModelConfig:
     pp_schedule: str = "1f1b"
     # virtual stages per device for the interleaved schedule
     pp_chunks: int = 1
+    # fraction of each pp stage's layers to checkpoint when remat=True
+    # (≙ PipelineGradientCheckpointConfig per-stage ckpt ratios): 1.0 =
+    # checkpoint everything; smaller trades backward-tick memory for less
+    # recompute
+    pp_remat_ratio: float = 1.0
     # run MLP matmuls through the scaled-fp8 path (≙ FP8Hook/fp8_linear);
     # set by HybridParallelPlugin(enable_fp8=True)
     fp8_matmul: bool = False

@@ -41,13 +41,23 @@ def run_pipeline(
                 "MoE aux loss under the gpipe schedule: use pp_schedule="
                 "'1f1b'/'interleaved'/'zb', which stream aux natively"
             )
+        if getattr(cfg, "pp_remat_ratio", 1.0) != 1.0:
+            raise NotImplementedError(
+                "pp_remat_ratio < 1 applies to the 1f1b/interleaved/zb "
+                "engine; gpipe full-checkpoints every layer"
+            )
         return pipeline_blocks(
             block_apply, stacked_params, x, mesh, cfg.pp_microbatches,
             aux=aux, remat=cfg.remat, remat_policy=checkpoint_policy(cfg),
         )
+    # checkpoint ratio: remat=True + pp_remat_ratio r checkpoints the first
+    # ceil(r * Lv) layers per stage (≙ per-stage grad-ckpt ratios)
+    remat = (
+        float(getattr(cfg, "pp_remat_ratio", 1.0)) if cfg.remat else 0.0
+    )
     return pipeline_blocks_vjp(
         block_apply, stacked_params, x, mesh, cfg.pp_microbatches,
-        aux=aux, remat=cfg.remat, chunks=getattr(cfg, "pp_chunks", 1),
+        aux=aux, remat=remat, chunks=getattr(cfg, "pp_chunks", 1),
         split_dw=(schedule == "zb"), has_aux=has_aux,
         remat_policy=checkpoint_policy(cfg),
     )

@@ -34,6 +34,7 @@ flow can diverge per stage without deadlocking the ring.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable
 
 import jax
@@ -45,18 +46,24 @@ from .common import fp32_boundary as _fp32_boundary
 from .common import mb_split as _mb_split
 
 
-def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool,
+def _make_stage_fn(block_apply: Callable, remat, has_aux: bool,
                    remat_policy=None):
-    """(p_c [Lv, ...], h, aux_t) -> (h, aux_scalar): scan of one stage's blocks."""
+    """(p_c [Lv, ...], h, aux_t) -> (h, aux_scalar): scan of one stage's blocks.
 
-    body_fn = block_apply
-    if remat:
-        kw = {"prevent_cse": False}
-        if remat_policy is not None:
-            kw["policy"] = remat_policy
-        body_fn = jax.checkpoint(block_apply, **kw)
+    ``remat`` is a checkpoint RATIO in [0, 1] (bool accepted: True == 1.0):
+    ratios < 1 checkpoint only the first ``ceil(ratio * Lv)`` layers of each
+    stage (≙ the reference's per-stage ckpt ratios,
+    ``shard/grad_ckpt_config.py``) — the split is static, two scans instead
+    of one; non-checkpointed layers store their intermediates only
+    transiently inside the backward tick's vjp.
+    """
+    ratio = 1.0 if remat is True else max(0.0, min(1.0, float(remat)))
+    kw = {"prevent_cse": False}
+    if remat_policy is not None:
+        kw["policy"] = remat_policy
+    ckpt_fn = jax.checkpoint(block_apply, **kw)
 
-    def stage_fn(p_c, h, aux_t):
+    def scan_over(body_fn, p_part, h, aux, aux_t):
         def body(carry, p_layer):
             h, aux = carry
             out = body_fn(p_layer, h, aux_t)
@@ -65,8 +72,21 @@ def _make_stage_fn(block_apply: Callable, remat: bool, has_aux: bool,
                 return (h2, aux + a), None
             return (out, aux), None
 
-        (h, aux), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)), p_c)
+        (h, aux), _ = jax.lax.scan(body, (h, aux), p_part)
         return h, aux
+
+    def stage_fn(p_c, h, aux_t):
+        aux = jnp.zeros((), jnp.float32)
+        if ratio <= 0.0:
+            return scan_over(block_apply, p_c, h, aux, aux_t)
+        lv = jax.tree_util.tree_leaves(p_c)[0].shape[0]
+        n_ckpt = lv if ratio >= 1.0 else max(1, math.ceil(ratio * lv))
+        if n_ckpt >= lv:
+            return scan_over(ckpt_fn, p_c, h, aux, aux_t)
+        p_a = jax.tree.map(lambda l: l[:n_ckpt], p_c)
+        p_b = jax.tree.map(lambda l: l[n_ckpt:], p_c)
+        h, aux = scan_over(ckpt_fn, p_a, h, aux, aux_t)
+        return scan_over(block_apply, p_b, h, aux, aux_t)
 
     return stage_fn
 
@@ -477,7 +497,8 @@ def pipeline_blocks_vjp(
     docstring). Returns ``x_out`` or ``(x_out, aux_total)`` if ``has_aux``."""
     aux = aux if aux is not None else {}
     out, aux_total = _pipe(
-        block_apply, mesh, num_microbatches, pp_axis, bool(remat), int(chunks),
+        block_apply, mesh, num_microbatches, pp_axis,
+        float(remat) if remat is not True else 1.0, int(chunks),
         bool(split_dw), bool(has_aux), remat_policy, stacked_params, x, aux,
     )
     if has_aux:

@@ -55,6 +55,23 @@ def test_pp_schedule_matches_dp_baseline(llama4, schedule, chunks):
     assert np.allclose(losses, base, atol=1e-4), (schedule, chunks, losses, base)
 
 
+def test_pp_remat_ratio_matches_baseline():
+    """Partial per-stage checkpointing (≙ per-stage ckpt ratios) must not
+    change the math — only the memory/recompute tradeoff."""
+    cfg = dataclasses.replace(LlamaConfig.tiny(), num_hidden_layers=4, remat=True)
+    ids = jax.random.randint(jax.random.PRNGKey(7), (8, 16), 0, cfg.vocab_size)
+    batch = {"input_ids": ids}
+    base = _losses(LlamaForCausalLM, cfg, DataParallelPlugin(precision="fp32"), batch)
+    pp = _losses(
+        LlamaForCausalLM, cfg,
+        HybridParallelPlugin(
+            pp_size=2, num_microbatches=4, precision="fp32", pp_remat_ratio=0.5,
+        ),
+        batch,
+    )
+    assert np.allclose(pp, base, atol=1e-4), (pp, base)
+
+
 def test_layer_ids_flow_through_pipeline():
     """Gemma-2 alternating local/global windows need per-layer ids; the
     stacked-tree layer ids must reach every block under pp (previously
