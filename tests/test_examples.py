@@ -1,0 +1,50 @@
+"""Example smoke tests (≙ reference ``examples/**/test_ci.sh`` run by
+``example_check_on_pr.yml``): every shipped example must run end-to-end on
+the virtual mesh with tiny settings."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable] + args, cwd=REPO, env=env, timeout=timeout,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (args, proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_example_gpt2_train():
+    out = _run(["examples/language/gpt2/train.py"])
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_example_lora_finetune():
+    out = _run(["examples/language/lora_finetune.py", "--steps", "4"])
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_example_dit_diffusion():
+    out = _run(["examples/diffusion/train_dit.py", "--steps", "4", "--tp", "2"])
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_example_dpo():
+    out = _run(["examples/rlhf/dpo_train.py", "--steps", "4"])
+    assert "loss" in out.lower()
