@@ -7,31 +7,50 @@ Llama-3-8B on v5p; no published TPU baseline exists in the reference).
 Primary config on a 16G v5e: a 1.26B llama (bf16 params+opt, remat, flash
 attention) at seq 16384 — the long-context regime ring attention / the
 flash kernel exist for. Extra configs (seq 4096 / 8192) ride along in the
-same JSON line; the README carries the full table.
+same JSON line; the README carries the full table. MFU is reported under
+both attention-flop conventions: "value" halves the causal attention term
+(those flops are never issued), "mfu_full_attn" counts the full matrix
+(the common published convention).
+
+Robustness (r02 post-mortem: one transient `UNAVAILABLE: TPU backend
+setup/compile error` erased the round's number): the measurement runs in a
+CHILD process; this supervisor retries with backoff in a FRESH process each
+time (jax caches a failed backend init for the life of the process), and if
+the backend never comes up it still emits a structured failure JSON line
+instead of dying with a bare traceback.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-from colossalai_tpu.booster import Booster, HybridParallelPlugin
-from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
-from colossalai_tpu.utils import (
-    causal_lm_flops_per_token,
-    count_params,
-    peak_flops_per_device,
-)
 
 TARGET_MFU = 0.45
 
+#: stderr substrings that mean "the backend may come back — keep retrying"
+_RETRYABLE = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+    "Connection reset",
+    "Socket closed",
+)
 
-def model_for(hbm_bytes: int, seq: int) -> LlamaConfig:
+
+# --------------------------------------------------------------- measurement
+# Everything below the supervisor runs only in the --child process; jax and
+# the framework are imported lazily so the supervisor never touches a backend.
+
+
+def model_for(hbm_bytes: int, seq: int):
+    import jax.numpy as jnp
+
+    from colossalai_tpu.models import LlamaConfig
+
     if hbm_bytes >= 64 * 1024**3:  # v5p-class
         return LlamaConfig(
             vocab_size=32000, hidden_size=4096, intermediate_size=11008,
@@ -48,7 +67,20 @@ def model_for(hbm_bytes: int, seq: int) -> LlamaConfig:
     )
 
 
-def measure(cfg: LlamaConfig, bs: int, seq: int, n_dev: int, steps: int):
+def measure(cfg, bs: int, seq: int, n_dev: int, steps: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from colossalai_tpu.booster import Booster, HybridParallelPlugin
+    from colossalai_tpu.models import LlamaForCausalLM
+    from colossalai_tpu.utils import (
+        causal_lm_flops_per_token,
+        count_params,
+        peak_flops_per_device,
+    )
+
     batch = {
         "input_ids": jnp.asarray(
             np.random.RandomState(0).randint(0, cfg.vocab_size, size=(bs * max(n_dev, 1), seq))
@@ -73,10 +105,14 @@ def measure(cfg: LlamaConfig, bs: int, seq: int, n_dev: int, steps: int):
     loss = float(m["loss"])  # scalar fetch = the only reliable sync
     dt = (time.perf_counter() - t0) / steps
     fpt = causal_lm_flops_per_token(n_params, cfg.num_hidden_layers, cfg.hidden_size, seq)
+    fpt_full = causal_lm_flops_per_token(
+        n_params, cfg.num_hidden_layers, cfg.hidden_size, seq, causal=False
+    )
     tokens = batch["input_ids"].size
-    mfu = fpt * tokens / dt / (peak_flops_per_device() * max(n_dev, 1))
+    denom = dt * peak_flops_per_device() * max(n_dev, 1)
     return {
-        "mfu": round(mfu, 4),
+        "mfu": round(fpt * tokens / denom, 4),
+        "mfu_full_attn": round(fpt_full * tokens / denom, 4),
         "tokens_per_second_per_device": round(tokens / dt / max(n_dev, 1), 1),
         "step_ms": round(dt * 1e3, 1),
         "n_params_b": round(n_params / 1e9, 2),
@@ -84,10 +120,13 @@ def measure(cfg: LlamaConfig, bs: int, seq: int, n_dev: int, steps: int):
     }
 
 
-def main():
-    n_dev = len(jax.devices())
-    from colossalai_tpu.accelerator import get_accelerator
+def child_main():
+    import jax
 
+    from colossalai_tpu.accelerator import get_accelerator
+    from colossalai_tpu.utils import peak_flops_per_device
+
+    n_dev = len(jax.devices())
     hbm = get_accelerator().hbm_bytes_per_device() or 16 * 1024**3
 
     # primary: 1B-class model at 16k context (flash attention regime)
@@ -100,8 +139,6 @@ def main():
             r = measure(model_for(hbm, eseq), ebs, eseq, n_dev, steps=5)
             extras[f"mfu_bs{ebs}_seq{eseq}"] = r["mfu"]
         except Exception as e:  # smaller chips may not fit every extra config
-            import sys
-
             print(f"extra config bs{ebs}/seq{eseq} failed: {e}", file=sys.stderr)
 
     result = {
@@ -109,6 +146,7 @@ def main():
         "value": primary["mfu"],
         "unit": "MFU",
         "vs_baseline": round(primary["mfu"] / TARGET_MFU, 4),
+        "mfu_full_attn": primary["mfu_full_attn"],
         "tokens_per_second_per_device": primary["tokens_per_second_per_device"],
         "step_ms": primary["step_ms"],
         "peak_tflops": peak_flops_per_device() / 1e12,
@@ -119,5 +157,98 @@ def main():
     print(json.dumps(result))
 
 
+# --------------------------------------------------------------- supervisor
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj
+    return None
+
+
+def _backend_responds(timeout_s: float = 120.0) -> bool:
+    """Cheap probe in a throwaway process: a hung tunnel (jax.devices()
+    blocking forever) must cost one probe timeout, not a full attempt."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); import jax.numpy as jnp; "
+             "print(float(jnp.ones(()).sum()))"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def supervise():
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S", "2400"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1500"))
+    delay, attempt, soft_failures = 10.0, 0, 0
+    last_err = "no attempts ran"
+    probe_first = False  # set after a failure: don't burn a full attempt
+    while True:
+        if probe_first and not _backend_responds():
+            last_err = "attempt-gate: backend probe timed out / failed"
+            print(last_err, file=sys.stderr)
+            if time.monotonic() + delay > deadline:
+                attempt += 1  # count the probe as the failed attempt
+                break
+            time.sleep(delay)
+            delay = min(delay * 2, 120.0)
+            continue
+        attempt += 1
+        budget = deadline - time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True,
+                timeout=max(60.0, min(attempt_timeout, budget)),
+            )
+        except subprocess.TimeoutExpired as e:
+            last_err = f"attempt {attempt}: child timed out after {e.timeout:.0f}s"
+            retryable = True
+        else:
+            found = _last_json_line(proc.stdout or "")
+            if proc.returncode == 0 and found is not None:
+                if attempt > 1:
+                    found["bench_attempts"] = attempt
+                print(json.dumps(found))
+                return
+            err_tail = ((proc.stderr or "") + (proc.stdout or "")).strip()[-2000:]
+            last_err = f"attempt {attempt}: rc={proc.returncode}: {err_tail}"
+            retryable = any(s in err_tail for s in _RETRYABLE)
+        print(last_err, file=sys.stderr)
+        probe_first = True  # cheap-gate further retries against a hung tunnel
+        if not retryable:
+            # a deterministic failure (bad config, OOM) won't heal — allow one
+            # re-run for flakes, then stop burning the deadline
+            soft_failures += 1
+            if soft_failures >= 2:
+                break
+        if time.monotonic() + delay > deadline:
+            break
+        time.sleep(delay)
+        delay = min(delay * 2, 120.0)
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu",
+        "value": 0.0,
+        "unit": "MFU",
+        "vs_baseline": 0.0,
+        "error": last_err[-1200:],
+        "bench_attempts": attempt,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        supervise()

@@ -37,12 +37,25 @@ def peak_flops_per_device() -> float:
 
 
 def causal_lm_flops_per_token(
-    n_params: int, n_layers: int, hidden: int, seq_len: int, with_backward: bool = True
+    n_params: int,
+    n_layers: int,
+    hidden: int,
+    seq_len: int,
+    with_backward: bool = True,
+    causal: bool = True,
 ) -> float:
-    """Training flops/token: 6N for fwd+bwd matmuls + 12·L·h·s attention."""
+    """Training flops/token: 6N for fwd+bwd matmuls + 12·L·h·s attention.
+
+    ``causal=True`` halves the attention term (the flash kernel skips masked
+    tiles, so those flops are never issued); ``causal=False`` counts the full
+    s×s matrix — the convention most published MFU numbers use. Report both
+    when the attention term is material (long sequences).
+    """
     mult = 6.0 if with_backward else 2.0
     dense = mult * n_params
-    attn = (mult / 2.0) * 12 * n_layers * hidden * seq_len / 2  # causal: half the matrix
+    attn = (mult / 2.0) * 12 * n_layers * hidden * seq_len
+    if causal:
+        attn /= 2
     return dense + attn
 
 
