@@ -3,13 +3,22 @@
 ≙ reference ``hybrid_parallel_checkpoint_io.py`` HF gather/export paths +
 per-model ``modeling`` name conventions. One declarative spec per family:
 
-- ``top``/``layer`` entries: (hf name/template, our dotted path, kind)
-  where kind is "linear" (HF [out,in] ↔ our [in,out] transpose), "raw"
-  (embeddings, norms, biases), or "conv1d" (GPT-2 Conv1D stores [in,out]
-  like flax — no transpose);
-- optional entries (qkv biases) are skipped when absent on either side;
-- "experts" entries expand our stacked [E, ...] expert tensors to the
-  reference's per-expert HF names (mixtral block_sparse_moe);
+- ``top`` entries and per-stack ``entries``: (hf name/template, our dotted
+  path, kind). Kinds:
+  - "linear": HF [out, in] ↔ our [in, out] transpose
+  - "raw": embeddings, norms, biases — no transform
+  - "conv1d": GPT-2 Conv1D stores [in, out] like flax — no transpose
+  - "conv_t": torch Conv1d [out, in, k] ↔ flax [k, in, out]
+  - "experts": our stacked [E, ...] expert tensors ↔ per-expert HF names
+  - "qkv_interleaved": BLOOM fused query_key_value, per-head [q k v]
+    interleaving ↔ our split q/k/v (needs ``heads``)
+  - "qkv_grouped": Falcon fused query_key_value, per-kv-group
+    [q…q k v] layout (MQA = 1 group) ↔ our split q/k/v (needs ``heads``)
+- multiple scanned stacks (T5/Whisper encoder+decoder, DeepSeek
+  dense_layers+layers) with per-stack HF layer-index offsets;
+- optional entries (qkv biases, lm_head) are skipped when absent on either
+  side; ``ignore_hf`` names (tied copies, computed sinusoidal tables) are
+  dropped on import;
 - vocab-dim tensors are unpadded on export / padded on import
   (``tensor/padded_vocab``).
 """
@@ -17,87 +26,146 @@ per-model ``modeling`` name conventions. One declarative spec per family:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from colossalai_tpu.tensor.padded_vocab import pad_vocab, unpad_vocab
 
+Entry = Tuple[str, str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class StackSpec:
+    """One scanned layer stack (flax ``nn.scan`` container)."""
+
+    entries: Tuple[Entry, ...]
+    #: HF layer index of stack element 0 (DeepSeek MoE stack starts at
+    #: first_k_dense_replace)
+    hf_base: int = 0
+
 
 @dataclasses.dataclass(frozen=True)
 class FamilySpec:
-    #: scanned-stack container in our tree (e.g. "layers" → layers/block/...)
-    container: str
-    top: List[Tuple[str, str, str]]
-    layer: List[Tuple[str, str, str]]
+    top: Tuple[Entry, ...]
+    #: container name in our tree → its stack spec
+    stacks: Dict[str, StackSpec]
     #: our suffixes that may legitimately be absent (config-dependent biases)
     optional: Tuple[str, ...] = ()
     #: hf names whose dim-0 is the vocab dim (pad/unpad)
     vocab_keys: Tuple[str, ...] = ()
     #: hf names to drop on import when embeddings are tied
     tied_keys: Tuple[str, ...] = ("lm_head.weight",)
+    #: hf names a checkpoint may carry that the spec deliberately never
+    #: consumes (tied aliases, computed sinusoidal tables) — exempted from
+    #: the ``strict`` leftover-keys check in :func:`hf_to_params`
+    ignore_hf: Tuple[str, ...] = ()
+    #: stacks that share ONE HF layer namespace consecutively (deepseek:
+    #: dense_layers then layers). When set and no explicit ``stack_bases``
+    #: is given, each stack's HF base is derived from the preceding stacks'
+    #: actual lengths instead of the static ``hf_base``.
+    chained_stacks: Tuple[str, ...] = ()
 
 
-_LLAMA = FamilySpec(
-    container="layers",
-    top=[
-        ("model.embed_tokens.weight", "embed_tokens.embedding", "raw"),
-        ("model.norm.weight", "norm.scale", "raw"),
-        ("lm_head.weight", "lm_head.kernel", "linear"),
-    ],
-    layer=[
-        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
-        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
-        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
-        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
-        ("model.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
-        ("model.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
-        ("model.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
-        ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel", "linear"),
-        ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel", "linear"),
-        ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel", "linear"),
-        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
-        ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
-    ],
-    optional=(
-        "self_attn.q_proj.bias", "self_attn.k_proj.bias", "self_attn.v_proj.bias",
-        "lm_head.kernel",
-    ),
+def _spec(container: str, top, layer, **kw) -> FamilySpec:
+    """Single-stack shorthand (most decoder-only families)."""
+    return FamilySpec(
+        top=tuple(top), stacks={container: StackSpec(tuple(layer))}, **kw
+    )
+
+
+_LLAMA_LAYER: List[Entry] = [
+    ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
+    ("model.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
+    ("model.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
+    ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel", "linear"),
+    ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel", "linear"),
+    ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel", "linear"),
+    ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+    ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+]
+
+_LLAMA_TOP: List[Entry] = [
+    ("model.embed_tokens.weight", "embed_tokens.embedding", "raw"),
+    ("model.norm.weight", "norm.scale", "raw"),
+    ("lm_head.weight", "lm_head.kernel", "linear"),
+]
+
+_LLAMA_OPTIONAL = (
+    "self_attn.q_proj.bias", "self_attn.k_proj.bias", "self_attn.v_proj.bias",
+    "lm_head.kernel",
+)
+
+_LLAMA = _spec(
+    "layers",
+    _LLAMA_TOP,
+    _LLAMA_LAYER,
+    optional=_LLAMA_OPTIONAL,
     vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
-_GPT2 = FamilySpec(
-    container="h",
-    top=[
-        ("wte.weight", "wte.embedding", "raw"),
-        ("wpe.weight", "wpe.embedding", "raw"),
-        ("ln_f.weight", "ln_f.scale", "raw"),
-        ("ln_f.bias", "ln_f.bias", "raw"),
-        ("lm_head.weight", "lm_head.kernel", "linear"),
+_QWEN3 = _spec(
+    "layers",
+    _LLAMA_TOP,
+    _LLAMA_LAYER + [
+        ("model.layers.{i}.self_attn.q_norm.weight", "self_attn.q_norm.scale", "raw"),
+        ("model.layers.{i}.self_attn.k_norm.weight", "self_attn.k_norm.scale", "raw"),
     ],
-    layer=[
-        # HF GPT-2 Conv1D stores [in, out] — flax layout, no transpose
-        ("h.{i}.attn.c_attn.weight", "c_attn.kernel", "conv1d"),
-        ("h.{i}.attn.c_attn.bias", "c_attn.bias", "raw"),
-        ("h.{i}.attn.c_proj.weight", "c_proj.kernel", "conv1d"),
-        ("h.{i}.attn.c_proj.bias", "c_proj.bias", "raw"),
-        ("h.{i}.mlp.c_fc.weight", "c_fc.kernel", "conv1d"),
-        ("h.{i}.mlp.c_fc.bias", "c_fc.bias", "raw"),
-        ("h.{i}.mlp.c_proj.weight", "mlp_c_proj.kernel", "conv1d"),
-        ("h.{i}.mlp.c_proj.bias", "mlp_c_proj.bias", "raw"),
-        ("h.{i}.ln_1.weight", "ln_1.scale", "raw"),
-        ("h.{i}.ln_1.bias", "ln_1.bias", "raw"),
-        ("h.{i}.ln_2.weight", "ln_2.scale", "raw"),
-        ("h.{i}.ln_2.bias", "ln_2.bias", "raw"),
-    ],
-    optional=("lm_head.kernel",),
-    vocab_keys=("wte.weight", "lm_head.weight"),
+    optional=_LLAMA_OPTIONAL,
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
-_MIXTRAL = FamilySpec(
-    container="layers",
-    top=_LLAMA.top,
-    layer=[
+# gemma stores zero-centered rms weights; our models keep the HF storage
+# convention (rms_scale_offset applied in forward), so norms map "raw"
+_GEMMA = _LLAMA
+
+_GEMMA2 = _spec(
+    "layers",
+    _LLAMA_TOP,
+    _LLAMA_LAYER + [
+        ("model.layers.{i}.pre_feedforward_layernorm.weight", "pre_feedforward_layernorm.scale", "raw"),
+        ("model.layers.{i}.post_feedforward_layernorm.weight", "post_feedforward_layernorm.scale", "raw"),
+    ],
+    optional=_LLAMA_OPTIONAL,
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
+_GPT2 = _spec(
+    "h",
+    [
+        ("transformer.wte.weight", "wte.embedding", "raw"),
+        ("transformer.wpe.weight", "wpe.embedding", "raw"),
+        ("transformer.ln_f.weight", "ln_f.scale", "raw"),
+        ("transformer.ln_f.bias", "ln_f.bias", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ],
+    [
+        # HF GPT-2 Conv1D stores [in, out] — flax layout, no transpose
+        ("transformer.h.{i}.attn.c_attn.weight", "c_attn.kernel", "conv1d"),
+        ("transformer.h.{i}.attn.c_attn.bias", "c_attn.bias", "raw"),
+        ("transformer.h.{i}.attn.c_proj.weight", "c_proj.kernel", "conv1d"),
+        ("transformer.h.{i}.attn.c_proj.bias", "c_proj.bias", "raw"),
+        ("transformer.h.{i}.mlp.c_fc.weight", "c_fc.kernel", "conv1d"),
+        ("transformer.h.{i}.mlp.c_fc.bias", "c_fc.bias", "raw"),
+        ("transformer.h.{i}.mlp.c_proj.weight", "mlp_c_proj.kernel", "conv1d"),
+        ("transformer.h.{i}.mlp.c_proj.bias", "mlp_c_proj.bias", "raw"),
+        ("transformer.h.{i}.ln_1.weight", "ln_1.scale", "raw"),
+        ("transformer.h.{i}.ln_1.bias", "ln_1.bias", "raw"),
+        ("transformer.h.{i}.ln_2.weight", "ln_2.scale", "raw"),
+        ("transformer.h.{i}.ln_2.bias", "ln_2.bias", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("transformer.wte.weight", "lm_head.weight"),
+)
+
+_MIXTRAL = _spec(
+    "layers",
+    _LLAMA_TOP,
+    [
         ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
         ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
         ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
@@ -115,12 +183,249 @@ _MIXTRAL = FamilySpec(
     vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
+# DeepSeek-V2(-Lite) MLA attention, shared by the dense and MoE stacks.
+# q_proj covers V2-Lite (q_lora_rank=None); q_a/q_b cover full V2.
+_DEEPSEEK_ATTN: List[Entry] = [
+    ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.q_a_proj.weight", "self_attn.q_a_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.q_a_layernorm.weight", "self_attn.q_a_layernorm.scale", "raw"),
+    ("model.layers.{i}.self_attn.q_b_proj.weight", "self_attn.q_b_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight", "self_attn.kv_a_proj_with_mqa.kernel", "linear"),
+    ("model.layers.{i}.self_attn.kv_a_layernorm.weight", "self_attn.kv_a_layernorm.scale", "raw"),
+    ("model.layers.{i}.self_attn.kv_b_proj.weight", "self_attn.kv_b_proj.kernel", "linear"),
+    ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+    ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+    ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+]
+
+_DEEPSEEK = FamilySpec(
+    top=tuple(_LLAMA_TOP),
+    stacks={
+        "dense_layers": StackSpec(tuple(_DEEPSEEK_ATTN + [
+            ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel", "linear"),
+            ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel", "linear"),
+            ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel", "linear"),
+        ])),
+        "layers": StackSpec(tuple(_DEEPSEEK_ATTN + [
+            ("model.layers.{i}.mlp.gate.weight", "moe.router/kernel", "linear"),
+            ("model.layers.{i}.mlp.experts.{e}.gate_proj.weight", "moe.experts_gate/kernel", "experts"),
+            ("model.layers.{i}.mlp.experts.{e}.up_proj.weight", "moe.experts_up/kernel", "experts"),
+            ("model.layers.{i}.mlp.experts.{e}.down_proj.weight", "moe.experts_down/kernel", "experts"),
+            ("model.layers.{i}.mlp.shared_experts.gate_proj.weight", "moe.shared_expert.gate_proj.kernel", "linear"),
+            ("model.layers.{i}.mlp.shared_experts.up_proj.weight", "moe.shared_expert.up_proj.kernel", "linear"),
+            ("model.layers.{i}.mlp.shared_experts.down_proj.weight", "moe.shared_expert.down_proj.kernel", "linear"),
+        ])),
+    },
+    optional=(
+        "lm_head.kernel",
+        # V2-Lite has q_proj; full V2 has the q LoRA pair — one side is
+        # always absent
+        "self_attn.q_proj.kernel", "self_attn.q_a_proj.kernel",
+        "self_attn.q_a_layernorm.scale", "self_attn.q_b_proj.kernel",
+        "moe.shared_expert.gate_proj.kernel", "moe.shared_expert.up_proj.kernel",
+        "moe.shared_expert.down_proj.kernel",
+    ),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+    chained_stacks=("dense_layers", "layers"),
+)
+
+_OPT = _spec(
+    "layers",
+    [
+        ("model.decoder.embed_tokens.weight", "embed_tokens.embedding", "raw"),
+        # HF table is [max_pos + 2, h] (offset-2 convention) — ours matches
+        ("model.decoder.embed_positions.weight", "embed_positions.embedding", "raw"),
+        ("model.decoder.final_layer_norm.weight", "norm.scale", "raw"),
+        ("model.decoder.final_layer_norm.bias", "norm.bias", "raw"),
+    ],
+    [
+        ("model.decoder.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.decoder.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
+        ("model.decoder.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.decoder.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
+        ("model.decoder.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.decoder.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
+        ("model.decoder.layers.{i}.self_attn.out_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.decoder.layers.{i}.self_attn.out_proj.bias", "self_attn.o_proj.bias", "raw"),
+        ("model.decoder.layers.{i}.self_attn_layer_norm.weight", "input_layernorm.scale", "raw"),
+        ("model.decoder.layers.{i}.self_attn_layer_norm.bias", "input_layernorm.bias", "raw"),
+        ("model.decoder.layers.{i}.fc1.weight", "mlp.fc_in.kernel", "linear"),
+        ("model.decoder.layers.{i}.fc1.bias", "mlp.fc_in.bias", "raw"),
+        ("model.decoder.layers.{i}.fc2.weight", "mlp.fc_out.kernel", "linear"),
+        ("model.decoder.layers.{i}.fc2.bias", "mlp.fc_out.bias", "raw"),
+        ("model.decoder.layers.{i}.final_layer_norm.weight", "post_attention_layernorm.scale", "raw"),
+        ("model.decoder.layers.{i}.final_layer_norm.bias", "post_attention_layernorm.bias", "raw"),
+    ],
+    vocab_keys=("model.decoder.embed_tokens.weight", "lm_head.weight"),
+)
+
+_BLOOM = _spec(
+    "layers",
+    [
+        ("transformer.word_embeddings.weight", "embed_tokens.embedding", "raw"),
+        ("transformer.word_embeddings_layernorm.weight", "embed_layernorm.scale", "raw"),
+        ("transformer.word_embeddings_layernorm.bias", "embed_layernorm.bias", "raw"),
+        ("transformer.ln_f.weight", "norm.scale", "raw"),
+        ("transformer.ln_f.bias", "norm.bias", "raw"),
+    ],
+    [
+        ("transformer.h.{i}.self_attention.query_key_value.weight", "self_attn", "qkv_interleaved"),
+        ("transformer.h.{i}.self_attention.query_key_value.bias", "self_attn", "qkv_interleaved_bias"),
+        ("transformer.h.{i}.self_attention.dense.weight", "self_attn.o_proj.kernel", "linear"),
+        ("transformer.h.{i}.self_attention.dense.bias", "self_attn.o_proj.bias", "raw"),
+        ("transformer.h.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("transformer.h.{i}.input_layernorm.bias", "input_layernorm.bias", "raw"),
+        ("transformer.h.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+        ("transformer.h.{i}.post_attention_layernorm.bias", "post_attention_layernorm.bias", "raw"),
+        ("transformer.h.{i}.mlp.dense_h_to_4h.weight", "mlp.fc_in.kernel", "linear"),
+        ("transformer.h.{i}.mlp.dense_h_to_4h.bias", "mlp.fc_in.bias", "raw"),
+        ("transformer.h.{i}.mlp.dense_4h_to_h.weight", "mlp.fc_out.kernel", "linear"),
+        ("transformer.h.{i}.mlp.dense_4h_to_h.bias", "mlp.fc_out.bias", "raw"),
+    ],
+    vocab_keys=("transformer.word_embeddings.weight", "lm_head.weight"),
+)
+
+_FALCON = _spec(
+    "layers",
+    [
+        ("transformer.word_embeddings.weight", "embed_tokens.embedding", "raw"),
+        ("transformer.ln_f.weight", "norm.scale", "raw"),
+        ("transformer.ln_f.bias", "norm.bias", "raw"),
+    ],
+    [
+        ("transformer.h.{i}.self_attention.query_key_value.weight", "self_attn", "qkv_grouped"),
+        ("transformer.h.{i}.self_attention.dense.weight", "self_attn.o_proj.kernel", "linear"),
+        # falcon-7b parallel attn+mlp share one input_layernorm
+        ("transformer.h.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("transformer.h.{i}.input_layernorm.bias", "input_layernorm.bias", "raw"),
+        ("transformer.h.{i}.mlp.dense_h_to_4h.weight", "mlp.fc_in.kernel", "linear"),
+        ("transformer.h.{i}.mlp.dense_4h_to_h.weight", "mlp.fc_out.kernel", "linear"),
+    ],
+    vocab_keys=("transformer.word_embeddings.weight", "lm_head.weight"),
+)
+
+_T5 = FamilySpec(
+    top=(
+        ("shared.weight", "shared.embedding", "raw"),
+        ("encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+         "enc_rel_bias.relative_attention_bias.embedding", "raw"),
+        ("decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+         "dec_rel_bias.relative_attention_bias.embedding", "raw"),
+        ("encoder.final_layer_norm.weight", "enc_norm.scale", "raw"),
+        ("decoder.final_layer_norm.weight", "dec_norm.scale", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ),
+    stacks={
+        "encoder": StackSpec((
+            ("encoder.block.{i}.layer.0.SelfAttention.q.weight", "self_attn.q_proj.kernel", "linear"),
+            ("encoder.block.{i}.layer.0.SelfAttention.k.weight", "self_attn.k_proj.kernel", "linear"),
+            ("encoder.block.{i}.layer.0.SelfAttention.v.weight", "self_attn.v_proj.kernel", "linear"),
+            ("encoder.block.{i}.layer.0.SelfAttention.o.weight", "self_attn.o_proj.kernel", "linear"),
+            ("encoder.block.{i}.layer.0.layer_norm.weight", "ln_self.scale", "raw"),
+            ("encoder.block.{i}.layer.1.DenseReluDense.wi.weight", "mlp.wi.kernel", "linear"),
+            ("encoder.block.{i}.layer.1.DenseReluDense.wo.weight", "mlp.wo.kernel", "linear"),
+            ("encoder.block.{i}.layer.1.layer_norm.weight", "ln_mlp.scale", "raw"),
+        )),
+        "decoder": StackSpec((
+            ("decoder.block.{i}.layer.0.SelfAttention.q.weight", "self_attn.q_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.0.SelfAttention.k.weight", "self_attn.k_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.0.SelfAttention.v.weight", "self_attn.v_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.0.SelfAttention.o.weight", "self_attn.o_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.0.layer_norm.weight", "ln_self.scale", "raw"),
+            ("decoder.block.{i}.layer.1.EncDecAttention.q.weight", "cross_attn.q_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.1.EncDecAttention.k.weight", "cross_attn.k_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.1.EncDecAttention.v.weight", "cross_attn.v_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.1.EncDecAttention.o.weight", "cross_attn.o_proj.kernel", "linear"),
+            ("decoder.block.{i}.layer.1.layer_norm.weight", "ln_cross.scale", "raw"),
+            ("decoder.block.{i}.layer.2.DenseReluDense.wi.weight", "mlp.wi.kernel", "linear"),
+            ("decoder.block.{i}.layer.2.DenseReluDense.wo.weight", "mlp.wo.kernel", "linear"),
+            ("decoder.block.{i}.layer.2.layer_norm.weight", "ln_mlp.scale", "raw"),
+        )),
+    },
+    optional=("lm_head.kernel",
+              "dec_rel_bias.relative_attention_bias.embedding"),
+    vocab_keys=("shared.weight", "lm_head.weight"),
+    tied_keys=("lm_head.weight",),
+    # tied aliases of shared.weight
+    ignore_hf=("encoder.embed_tokens.weight", "decoder.embed_tokens.weight"),
+)
+
+
+def _whisper_attn(prefix: str, ours: str) -> List[Entry]:
+    # whisper k_proj is bias-free by architecture
+    return [
+        (f"{prefix}.{{i}}.{ours}.q_proj.weight", f"{ours}.q_proj.kernel", "linear"),
+        (f"{prefix}.{{i}}.{ours}.q_proj.bias", f"{ours}.q_proj.bias", "raw"),
+        (f"{prefix}.{{i}}.{ours}.k_proj.weight", f"{ours}.k_proj.kernel", "linear"),
+        (f"{prefix}.{{i}}.{ours}.v_proj.weight", f"{ours}.v_proj.kernel", "linear"),
+        (f"{prefix}.{{i}}.{ours}.v_proj.bias", f"{ours}.v_proj.bias", "raw"),
+        (f"{prefix}.{{i}}.{ours}.out_proj.weight", f"{ours}.out_proj.kernel", "linear"),
+        (f"{prefix}.{{i}}.{ours}.out_proj.bias", f"{ours}.out_proj.bias", "raw"),
+    ]
+
+
+def _whisper_common(prefix: str) -> List[Entry]:
+    return [
+        (f"{prefix}.{{i}}.self_attn_layer_norm.weight", "self_attn_layer_norm.scale", "raw"),
+        (f"{prefix}.{{i}}.self_attn_layer_norm.bias", "self_attn_layer_norm.bias", "raw"),
+        (f"{prefix}.{{i}}.fc1.weight", "mlp.fc1.kernel", "linear"),
+        (f"{prefix}.{{i}}.fc1.bias", "mlp.fc1.bias", "raw"),
+        (f"{prefix}.{{i}}.fc2.weight", "mlp.fc2.kernel", "linear"),
+        (f"{prefix}.{{i}}.fc2.bias", "mlp.fc2.bias", "raw"),
+        (f"{prefix}.{{i}}.final_layer_norm.weight", "final_layer_norm.scale", "raw"),
+        (f"{prefix}.{{i}}.final_layer_norm.bias", "final_layer_norm.bias", "raw"),
+    ]
+
+
+_WHISPER = FamilySpec(
+    top=(
+        ("model.encoder.conv1.weight", "conv1.kernel", "conv_t"),
+        ("model.encoder.conv1.bias", "conv1.bias", "raw"),
+        ("model.encoder.conv2.weight", "conv2.kernel", "conv_t"),
+        ("model.encoder.conv2.bias", "conv2.bias", "raw"),
+        ("model.encoder.layer_norm.weight", "encoder_layer_norm.scale", "raw"),
+        ("model.encoder.layer_norm.bias", "encoder_layer_norm.bias", "raw"),
+        ("model.decoder.embed_tokens.weight", "embed_tokens.embedding", "raw"),
+        ("model.decoder.embed_positions.weight", "embed_positions.embedding", "raw"),
+        ("model.decoder.layer_norm.weight", "decoder_layer_norm.scale", "raw"),
+        ("model.decoder.layer_norm.bias", "decoder_layer_norm.bias", "raw"),
+    ),
+    stacks={
+        "encoder": StackSpec(tuple(
+            _whisper_attn("model.encoder.layers", "self_attn")
+            + _whisper_common("model.encoder.layers")
+        )),
+        "decoder": StackSpec(tuple(
+            _whisper_attn("model.decoder.layers", "self_attn")
+            + _whisper_attn("model.decoder.layers", "encoder_attn")
+            + [
+                ("model.decoder.layers.{i}.encoder_attn_layer_norm.weight", "encoder_attn_layer_norm.scale", "raw"),
+                ("model.decoder.layers.{i}.encoder_attn_layer_norm.bias", "encoder_attn_layer_norm.bias", "raw"),
+            ]
+            + _whisper_common("model.decoder.layers")
+        )),
+    },
+    vocab_keys=("model.decoder.embed_tokens.weight", "proj_out.weight"),
+    tied_keys=("proj_out.weight",),
+    # the encoder position table is sinusoidal — computed, not a parameter
+    ignore_hf=("model.encoder.embed_positions.weight",),
+)
+
 HF_SPECS: Dict[str, FamilySpec] = {
     "llama": _LLAMA,
     "mistral": _LLAMA,
     "qwen2": _LLAMA,
+    "qwen3": _QWEN3,
+    "gemma": _GEMMA,
+    "gemma2": _GEMMA2,
     "gpt2": _GPT2,
     "mixtral": _MIXTRAL,
+    "deepseek": _DEEPSEEK,
+    "opt": _OPT,
+    "bloom": _BLOOM,
+    "falcon": _FALCON,
+    "t5": _T5,
+    "whisper": _WHISPER,
 }
 
 
@@ -141,10 +446,101 @@ def _put(tree, dotted, val):
     node[parts[-1]] = val
 
 
+def _need_heads(heads, family, kind):
+    if heads is None:
+        raise ValueError(
+            f"{family}: kind {kind!r} needs heads=(num_heads, num_kv_heads, "
+            f"head_dim)"
+        )
+    return heads
+
+
+# ---- fused-qkv layout converters (import: HF fused → (q, k, v) in our
+# [in, out] kernel layout; export is the exact inverse)
+
+def _split_qkv(arr, kind, heads, family):
+    nh, nkv, hd = _need_heads(heads, family, kind)
+    bias = arr.ndim == 1
+    if kind.startswith("qkv_interleaved"):
+        # bloom: rows grouped per head as [q k v] blocks of head_dim
+        lead = arr.reshape(nh, 3, hd) if bias else arr.reshape(nh, 3, hd, -1)
+        q, k, v = lead[:, 0], lead[:, 1], lead[:, 2]
+    else:
+        # falcon: per kv-group [q…q k v]; MQA = one group
+        g = nh // nkv
+        lead = (arr.reshape(nkv, g + 2, hd) if bias
+                else arr.reshape(nkv, g + 2, hd, -1))
+        q = lead[:, :g].reshape((nh, hd) if bias else (nh, hd, -1))
+        k, v = lead[:, g], lead[:, g + 1]
+
+    def flat(x):
+        n = x.shape[0]
+        return x.reshape(n * hd) if bias else x.reshape(n * hd, -1).T
+
+    return flat(q), flat(k), flat(v)
+
+
+def _join_qkv(q, k, v, kind, heads, family):
+    nh, nkv, hd = _need_heads(heads, family, kind)
+    bias = q.ndim == 1
+
+    def lead(x, n):  # → [n, hd] (bias) or [n, hd, hidden]
+        return x.reshape(n, hd) if bias else x.T.reshape(n, hd, -1)
+
+    q, k, v = lead(q, nh), lead(k, nkv), lead(v, nkv)
+    if kind.startswith("qkv_interleaved"):
+        out = np.stack([q, k, v], axis=1)  # [nh, 3, hd, ...]
+    else:
+        g = nh // nkv
+        out = np.concatenate(
+            [q.reshape((nkv, g) + q.shape[1:]), k[:, None], v[:, None]], axis=1
+        )
+    return out.reshape((-1,) if bias else (-1, out.shape[-1]))
+
+
+def _qkv_paths(ours: str, is_bias: bool):
+    sfx = "bias" if is_bias else "kernel"
+    return [f"{ours}.{p}_proj.{sfx}" for p in ("q", "k", "v")]
+
+
+def _stack_len(stack, stack_spec) -> int:
+    """Layer count of a scanned stack = dim 0 of any resolvable entry."""
+    if stack is None:
+        return 0
+    for _, ours, kind in stack_spec.entries:
+        node = _get(
+            stack, _qkv_paths(ours, False)[0] if kind.startswith("qkv_") else ours
+        )
+        if node is not None:
+            return int(np.asarray(node).shape[0])
+    return 0
+
+
+def _effective_bases(spec, stack_bases, lengths: Dict[str, int]) -> Dict[str, int]:
+    """Explicit ``stack_bases`` wins; else chained stacks get cumulative
+    bases from the given lengths; else each stack's static ``hf_base``."""
+    if stack_bases is not None:
+        return dict(stack_bases)
+    bases = {c: s.hf_base for c, s in spec.stacks.items()}
+    running = 0
+    for c in spec.chained_stacks:
+        bases[c] = running
+        running += lengths.get(c, 0)
+    return bases
+
+
 def params_to_hf(
-    params: Any, family: str, vocab_size: Optional[int] = None
+    params: Any,
+    family: str,
+    vocab_size: Optional[int] = None,
+    heads: Optional[Tuple[int, int, int]] = None,
+    stack_bases: Optional[Dict[str, int]] = None,
 ) -> Dict[str, np.ndarray]:
-    """Our param tree → HF-named numpy state dict."""
+    """Our param tree → HF-named numpy state dict.
+
+    ``stack_bases`` overrides a stack's HF layer-index offset when it is
+    config-dependent (deepseek: {"layers": first_k_dense_replace}).
+    """
     spec = HF_SPECS[family]
     p = params["params"] if "params" in params else params
     out: Dict[str, np.ndarray] = {}
@@ -156,48 +552,113 @@ def params_to_hf(
                 continue
             raise KeyError(f"{family}: missing {ours}")
         arr = np.asarray(arr)
-        arr = arr.T if kind == "linear" else arr
+        if kind == "linear":
+            arr = arr.T
+        elif kind == "conv_t":
+            arr = arr.transpose(2, 1, 0)
         if vocab_size is not None and hf in spec.vocab_keys:
             arr = unpad_vocab(arr, vocab_size, axis=0)
         out[hf] = arr
 
-    stack = _get(p, f"{spec.container}.block")
-    if stack is None:
-        raise KeyError(f"{family}: no scanned stack {spec.container}/block")
-    n_layers = None
-    for hf_t, ours, kind in spec.layer:
-        node = _get(stack, ours)
-        if node is None:
-            if ours in spec.optional:
+    present = {c for c in spec.stacks if _get(p, f"{c}.block") is not None}
+    if not present:
+        raise KeyError(
+            f"{family}: no scanned stack found (expected one of "
+            f"{sorted(spec.stacks)}, each as '<name>.block')"
+        )
+    lengths = {
+        c: _stack_len(_get(p, f"{c}.block"), s) for c, s in spec.stacks.items()
+    }
+    bases = _effective_bases(spec, stack_bases, lengths)
+    for container, stack_spec in spec.stacks.items():
+        base = bases[container]
+        stack = _get(p, f"{container}.block")
+        if stack is None:
+            # a configured-away stack (deepseek first_k_dense_replace=0) is
+            # only legitimate when a sibling stack exists — guarded above
+            continue
+        for hf_t, ours, kind in stack_spec.entries:
+            if kind.startswith("qkv_"):
+                is_bias = kind.endswith("_bias")
+                qp, kp, vp = (_get(stack, x) for x in _qkv_paths(ours, is_bias))
+                if qp is None:
+                    if is_bias:
+                        continue  # bias-free config
+                    raise KeyError(f"{family}: missing {ours} q/k/v")
+                qp, kp, vp = np.asarray(qp), np.asarray(kp), np.asarray(vp)
+                for j in range(qp.shape[0]):
+                    out[hf_t.format(i=j + base)] = _join_qkv(
+                        qp[j], kp[j], vp[j], kind, heads, family
+                    )
                 continue
-            raise KeyError(f"{family}: missing {ours}")
-        arr = np.asarray(node)
-        n_layers = arr.shape[0]
-        for i in range(n_layers):
-            li = arr[i]
-            if kind == "experts":
-                for e in range(li.shape[0]):
-                    out[hf_t.format(i=i, e=e)] = li[e].T
-            elif kind == "linear":
-                out[hf_t.format(i=i)] = li.T
-            else:
-                out[hf_t.format(i=i)] = li
+            node = _get(stack, ours)
+            if node is None:
+                if ours in spec.optional:
+                    continue
+                raise KeyError(f"{family}: missing {container}/{ours}")
+            arr = np.asarray(node)
+            for j in range(arr.shape[0]):
+                i = j + base
+                li = arr[j]
+                if kind == "experts":
+                    for e in range(li.shape[0]):
+                        out[hf_t.format(i=i, e=e)] = li[e].T
+                elif kind == "linear":
+                    out[hf_t.format(i=i)] = li.T
+                elif kind == "conv_t":
+                    out[hf_t.format(i=i)] = li.transpose(2, 1, 0)
+                else:
+                    out[hf_t.format(i=i)] = li
     return out
 
 
 def hf_to_params(
     state: Dict[str, np.ndarray],
     family: str,
-    num_layers: int,
+    num_layers: Union[int, Dict[str, int]],
     num_experts: int = 0,
     tie_word_embeddings: bool = False,
     padded_vocab_size: Optional[int] = None,
+    heads: Optional[Tuple[int, int, int]] = None,
+    stack_bases: Optional[Dict[str, int]] = None,
+    strict: bool = False,
 ) -> Dict[str, Any]:
-    """HF-named state dict → our param tree (numpy leaves, scanned stacks)."""
+    """HF-named state dict → our param tree (numpy leaves, scanned stacks).
+
+    ``num_layers``: one int (every stack the same length — the common case)
+    or {container: length} for multi-stack families with differing depths
+    (t5/whisper enc vs dec, deepseek dense vs moe stacks). ``stack_bases``
+    as in :func:`params_to_hf`. ``strict`` raises if the checkpoint carries
+    keys the spec never consumed (excluding ``ignore_hf`` and tied keys) —
+    the guard against importing from a layout the spec doesn't actually
+    cover.
+    """
     spec = HF_SPECS[family]
-    if num_experts <= 0 and any(kind == "experts" for _, _, kind in spec.layer):
+    needs_experts = any(
+        kind == "experts" for s in spec.stacks.values() for _, _, kind in s.entries
+    )
+    if num_experts <= 0 and needs_experts:
         raise ValueError(f"{family}: pass num_experts (stacked expert tensors)")
+    if isinstance(num_layers, int):
+        num_layers = {c: num_layers for c in spec.stacks}
+    elif set(num_layers) != set(spec.stacks):
+        # a typo'd or forgotten container would silently skip a whole stack
+        raise ValueError(
+            f"{family}: num_layers keys {sorted(num_layers)} must exactly "
+            f"match the spec's stacks {sorted(spec.stacks)} (use 0 for an "
+            f"empty stack)"
+        )
     p: Dict[str, Any] = {}
+    consumed: set = set()
+
+    if family == "gpt2" and "wte.weight" in state \
+            and "transformer.wte.weight" not in state:
+        # canonical Hub gpt2 checkpoints were saved from the bare GPT2Model
+        # and carry unprefixed keys; normalize to the LMHeadModel layout
+        state = {
+            (k if k.startswith(("transformer.", "lm_head.")) else f"transformer.{k}"): v
+            for k, v in state.items()
+        }
 
     for hf, ours, kind in spec.top:
         if tie_word_embeddings and hf in spec.tied_keys:
@@ -206,26 +667,78 @@ def hf_to_params(
             if ours in spec.optional:
                 continue
             raise KeyError(f"{family}: checkpoint missing {hf}")
+        consumed.add(hf)
         arr = state[hf]
         if padded_vocab_size is not None and hf in spec.vocab_keys:
             arr = pad_vocab(arr, padded_vocab_size, axis=0)
-        _put(p, ours, arr.T if kind == "linear" else arr)
+        if kind == "linear":
+            arr = arr.T
+        elif kind == "conv_t":
+            arr = arr.transpose(2, 1, 0)
+        _put(p, ours, arr)
 
-    for hf_t, ours, kind in spec.layer:
-        first = hf_t.format(i=0, e=0)
-        if first not in state:
-            if ours in spec.optional:
+    bases = _effective_bases(spec, stack_bases, num_layers)
+    for container, stack_spec in spec.stacks.items():
+        n = num_layers.get(container, 0)
+        base = bases[container]
+        if n <= 0:
+            continue
+        for hf_t, ours, kind in stack_spec.entries:
+            if kind.startswith("qkv_"):
+                is_bias = kind.endswith("_bias")
+                if hf_t.format(i=base) not in state:
+                    if is_bias:
+                        continue  # bias-free config
+                    raise KeyError(
+                        f"{family}: checkpoint missing {hf_t.format(i=base)}"
+                    )
+                qs, ks, vs = [], [], []
+                for j in range(n):
+                    key = hf_t.format(i=j + base)
+                    consumed.add(key)
+                    q, k, v = _split_qkv(state[key], kind, heads, family)
+                    qs.append(q)
+                    ks.append(k)
+                    vs.append(v)
+                for path, stacked in zip(
+                    _qkv_paths(ours, is_bias),
+                    (np.stack(qs, 0), np.stack(ks, 0), np.stack(vs, 0)),
+                ):
+                    _put(p, f"{container}.block.{path}", stacked)
                 continue
-            raise KeyError(f"{family}: checkpoint missing {first}")
-        per_layer = []
-        for i in range(num_layers):
-            if kind == "experts":
-                per_layer.append(np.stack(
-                    [state[hf_t.format(i=i, e=e)].T for e in range(num_experts)], 0
-                ))
-            elif kind == "linear":
-                per_layer.append(state[hf_t.format(i=i)].T)
-            else:
-                per_layer.append(state[hf_t.format(i=i)])
-        _put(p, f"{spec.container}.block.{ours}", np.stack(per_layer, 0))
+            first = hf_t.format(i=base, e=0)
+            if first not in state:
+                if ours in spec.optional:
+                    continue
+                raise KeyError(f"{family}: checkpoint missing {first}")
+            per_layer = []
+            for j in range(n):
+                i = j + base
+                if kind == "experts":
+                    keys = [hf_t.format(i=i, e=e) for e in range(num_experts)]
+                    consumed.update(keys)
+                    per_layer.append(np.stack([state[k].T for k in keys], 0))
+                    continue
+                key = hf_t.format(i=i)
+                consumed.add(key)
+                if kind == "linear":
+                    per_layer.append(state[key].T)
+                elif kind == "conv_t":
+                    per_layer.append(state[key].transpose(2, 1, 0))
+                else:
+                    per_layer.append(state[key])
+            _put(p, f"{container}.block.{ours}", np.stack(per_layer, 0))
+
+    if strict:
+        leftovers = sorted(
+            k for k in state
+            if k not in consumed
+            and k not in spec.ignore_hf
+            and not (tie_word_embeddings and k in spec.tied_keys)
+        )
+        if leftovers:
+            raise ValueError(
+                f"{family}: {len(leftovers)} checkpoint key(s) not consumed "
+                f"by the spec: {leftovers[:8]}{'…' if len(leftovers) > 8 else ''}"
+            )
     return p

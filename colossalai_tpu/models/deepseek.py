@@ -35,6 +35,10 @@ from .mixtral import MixtralConfig, MoEMLP
 
 @dataclasses.dataclass(unsafe_hash=True)
 class DeepseekV2Config(MixtralConfig):
+    #: HF DeepSeek-V2 keeps the llama base, NOT Mixtral's 1e6
+    rope_theta: float = 10000.0
+    #: HF DeepSeek-V2 default: raw softmax mass on the selected experts
+    norm_topk_prob: bool = False
     # MLA dims (HF DeepseekV2Config names)
     q_lora_rank: Optional[int] = None  # None = plain q_proj (V2-Lite)
     kv_lora_rank: int = 512
@@ -111,10 +115,18 @@ class MLAttention(nn.Module):
         kv = constrain(kv, ("dp", "ep"), None, "tp", None)
         k_nope, v = kv[..., :dn], kv[..., dn:]
 
-        # ---- rope on the pe dims (k_pe is ONE head broadcast to all)
+        # ---- rope on the pe dims (k_pe is ONE head broadcast to all).
+        # HF DeepSeek-V2 stores the rope dims with adjacent pairs (2i, 2i+1)
+        # as the rotation pairs and de-interleaves before rotate-half
+        # (modeling_deepseek_v2.apply_rotary_pos_emb); mirror that reorder on
+        # BOTH q and k — the q·k dot product is invariant to the shared
+        # permutation, so no inverse is needed after attention.
+        def _deinterleave(t):
+            return jnp.concatenate([t[..., 0::2], t[..., 1::2]], axis=-1)
+
         cos, sin = rope_table(positions, dr, cfg.rope_theta)
-        q_pe = apply_rope(q_pe, cos, sin)
-        k_pe = apply_rope(k_pe[:, :, None, :], cos, sin)
+        q_pe = apply_rope(_deinterleave(q_pe), cos, sin)
+        k_pe = apply_rope(_deinterleave(k_pe)[:, :, None, :], cos, sin)
         k_pe = jnp.broadcast_to(k_pe, (b, s, nh, dr))
 
         q_full = jnp.concatenate([q_nope, q_pe], axis=-1)

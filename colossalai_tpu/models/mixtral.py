@@ -36,6 +36,7 @@ from .llama import LlamaAttention, LlamaConfig, LlamaMLP, RMSNorm
 class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     num_experts_per_tok: int = 2
+    rope_theta: float = 1e6  # Mixtral-8x7B / HF MixtralConfig default
     capacity_factor: float = 1.25
     #: per-expert FFN width; None = intermediate_size (Mixtral). DeepSeekMoE
     #: uses many NARROW experts (e.g. 1408 vs dense 10944).
@@ -51,6 +52,9 @@ class MixtralConfig(LlamaConfig):
     #: O(N·k) instead of O(N·E·C) — the large-E path (≙ moe_kernel.cu's
     #: sort/cumsum strategy); same routing semantics, same drops.
     router_impl: str = "einsum"
+    #: renormalize selected top-k gates to sum to 1 (HF norm_topk_prob;
+    #: mixtral True, DeepSeek-V2 False)
+    norm_topk_prob: bool = True
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
@@ -144,7 +148,9 @@ class MoEMLP(nn.Module):
             )
         if cfg.router_impl == "sort":
             routing = jax.vmap(
-                lambda lg: top_k_routing_sorted(lg, cfg.num_experts_per_tok, cap)
+                lambda lg: top_k_routing_sorted(
+                    lg, cfg.num_experts_per_tok, cap, cfg.norm_topk_prob
+                )
             )(logits)
             expert_in = jax.vmap(lambda xi, ri: dispatch_sorted(xi, ri, e, cap))(
                 xg, routing
@@ -157,7 +163,9 @@ class MoEMLP(nn.Module):
             ).reshape(b, s, h).astype(dtype)
         else:
             routing = jax.vmap(
-                lambda lg: top_k_routing(lg, cfg.num_experts_per_tok, cap)
+                lambda lg: top_k_routing(
+                    lg, cfg.num_experts_per_tok, cap, cfg.norm_topk_prob
+                )
             )(logits)
             # dispatch: [G,g,E,C] x [G,g,H] -> [G,E,C,H]  (GSPMD: all-to-all over ep)
             expert_in = jnp.einsum("bsec,bsh->bech", routing.dispatch.astype(dtype), xg)

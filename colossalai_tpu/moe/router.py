@@ -25,12 +25,15 @@ class RoutingResult(NamedTuple):
     router_z_loss: jax.Array  # logit magnitude regularizer
 
 
-def _topk_gates(router_logits: jax.Array, num_selected: int):
+def _topk_gates(router_logits: jax.Array, num_selected: int, norm_topk: bool = True):
     """(probs [N,E], gate_vals [N,k], expert_idx [N,k]) — shared prologue:
-    softmax, top-k, renormalized selected gates (mixtral convention)."""
+    softmax + top-k. ``norm_topk`` renormalizes the selected gates to sum
+    to 1 (mixtral convention / HF norm_topk_prob=True); DeepSeek-V2 keeps
+    the raw softmax mass (norm_topk_prob=False)."""
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)
-    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    if norm_topk:
+        gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
     return probs, gate_vals, expert_idx
 
 
@@ -51,9 +54,10 @@ def top_k_routing(
     router_logits: jax.Array,  # [N, E]
     num_selected: int,
     capacity: int,
+    norm_topk: bool = True,
 ) -> RoutingResult:
     n, e = router_logits.shape
-    probs, gate_vals, expert_idx = _topk_gates(router_logits, num_selected)
+    probs, gate_vals, expert_idx = _topk_gates(router_logits, num_selected, norm_topk)
 
     # slot assignment: fill slot-0 choices first, then slot-1, ... so the
     # higher-priority expert choice wins capacity (≙ moe_cumsum kernel)
@@ -94,6 +98,7 @@ def top_k_routing_sorted(
     router_logits: jax.Array,  # [N, E]
     num_selected: int,
     capacity: int,
+    norm_topk: bool = True,
 ) -> SortedRouting:
     """Same routing semantics as :func:`top_k_routing` (slot-0 choices win
     capacity, then slot-1, ...; same drops, same losses) with sort-based
@@ -102,7 +107,7 @@ def top_k_routing_sorted(
     """
     n, e = router_logits.shape
     k = num_selected
-    probs, gate_vals, expert_idx = _topk_gates(router_logits, k)
+    probs, gate_vals, expert_idx = _topk_gates(router_logits, k, norm_topk)
 
     # k-major flattening + stable sort: every slot-0 entry of an expert
     # sorts before its slot-1 entries, reproducing the einsum path's

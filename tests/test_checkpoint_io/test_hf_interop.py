@@ -20,11 +20,11 @@ from colossalai_tpu.models import (
 )
 
 
-def _roundtrip(family, model, cfg, **kw):
+def _roundtrip(family, model, cfg, heads=None, **kw):
     ids = jnp.ones((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids)
-    hf = params_to_hf(params, family)
-    back = hf_to_params(hf, family, cfg.num_hidden_layers, **kw)
+    hf = params_to_hf(params, family, heads=heads)
+    back = hf_to_params(hf, family, cfg.num_hidden_layers, heads=heads, **kw)
     flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
     flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
     for kp, leaf in flat_a:
@@ -57,8 +57,8 @@ def test_gpt2_conv1d_roundtrip():
     hf = _roundtrip("gpt2", GPT2LMHeadModel(cfg), cfg,
                     tie_word_embeddings=cfg.tie_word_embeddings)
     # Conv1D keeps [in, out] — c_attn is hidden x 3*hidden, NOT transposed
-    assert hf["h.0.attn.c_attn.weight"].shape == (cfg.hidden_size, 3 * cfg.hidden_size)
-    assert "wpe.weight" in hf
+    assert hf["transformer.h.0.attn.c_attn.weight"].shape == (cfg.hidden_size, 3 * cfg.hidden_size)
+    assert "transformer.wpe.weight" in hf
 
 
 def test_mixtral_experts_roundtrip():
@@ -83,3 +83,208 @@ def test_padded_vocab_export_import():
     back = hf_to_params(hf, "llama", cfg.num_hidden_layers,
                         padded_vocab_size=cfg.padded_vocab_size_)
     assert back["embed_tokens"]["embedding"].shape[0] == 256
+
+
+# ---- round-2 widened families (qwen3/gemma2/opt/bloom/falcon/deepseek/t5/
+# whisper): export → import must be bit-exact for every leaf
+
+
+def test_qwen3_gemma2_opt_roundtrip():
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    for family in ("qwen3", "gemma2", "opt", "gemma"):
+        model_cls, cfg_cls = FAMILY_MODELS[family]
+        cfg = cfg_cls.tiny()
+        hf = _roundtrip(family, model_cls(cfg), cfg)
+        assert hf, family
+
+
+def test_bloom_fused_qkv_roundtrip():
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["bloom"]
+    cfg = cfg_cls.tiny()
+    heads = (cfg.num_attention_heads, cfg.num_attention_heads,
+             cfg.hidden_size // cfg.num_attention_heads)
+    hf = _roundtrip("bloom", model_cls(cfg), cfg, heads=heads)
+    # the fused tensor is [(H*3*D), hidden] with per-head [q k v] blocks
+    fused = hf["transformer.h.0.self_attention.query_key_value.weight"]
+    assert fused.shape == (3 * cfg.hidden_size, cfg.hidden_size)
+    assert "transformer.h.0.self_attention.query_key_value.bias" in hf
+
+
+def test_falcon_grouped_qkv_roundtrip():
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["falcon"]
+    cfg = cfg_cls.tiny()
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    heads = (cfg.num_attention_heads, cfg.num_key_value_heads, hd)
+    hf = _roundtrip("falcon", model_cls(cfg), cfg, heads=heads)
+    fused = hf["transformer.h.0.self_attention.query_key_value.weight"]
+    assert fused.shape == (
+        (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * hd,
+        cfg.hidden_size,
+    )
+
+
+def test_deepseek_roundtrip():
+    from colossalai_tpu.models import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config.tiny()  # first_k_dense_replace=0: all-MoE stack
+    model = DeepseekV2ForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    hf = params_to_hf(params, "deepseek")
+    back = hf_to_params(
+        hf, "deepseek", {"dense_layers": 0, "layers": cfg.num_hidden_layers},
+        num_experts=cfg.num_experts,
+    )
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        assert kp in flat_b, kp
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+    assert "model.layers.0.self_attn.kv_a_proj_with_mqa.weight" in hf
+    assert "model.layers.1.mlp.experts.3.down_proj.weight" in hf
+    assert "model.layers.0.mlp.shared_experts.up_proj.weight" in hf
+
+
+def test_deepseek_dense_prefix_roundtrip():
+    """first_k_dense_replace=1: HF indices split across our two stacks."""
+    from colossalai_tpu.models import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config.tiny(first_k_dense_replace=1, num_hidden_layers=3)
+    model = DeepseekV2ForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    bases = {"dense_layers": 0, "layers": 1}
+    hf = params_to_hf(params, "deepseek", stack_bases=bases)
+    # HF layer 0 is dense, layers 1..2 are MoE
+    assert "model.layers.0.mlp.gate_proj.weight" in hf
+    assert "model.layers.1.mlp.experts.0.gate_proj.weight" in hf
+    assert "model.layers.2.mlp.experts.0.gate_proj.weight" in hf
+    back = hf_to_params(
+        hf, "deepseek", {"dense_layers": 1, "layers": 2},
+        num_experts=cfg.num_experts, stack_bases=bases,
+    )
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+
+
+def test_t5_roundtrip():
+    from colossalai_tpu.models import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, decoder_input_ids=ids)
+    hf = params_to_hf(params, "t5")
+    assert "encoder.block.1.layer.1.DenseReluDense.wi.weight" in hf
+    assert "decoder.block.0.layer.1.EncDecAttention.q.weight" in hf
+    back = hf_to_params(hf, "t5", cfg.num_hidden_layers,
+                        tie_word_embeddings=cfg.tie_word_embeddings)
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        assert kp in flat_b, kp
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+
+
+def test_whisper_roundtrip():
+    from colossalai_tpu.models import WhisperConfig, WhisperForConditionalGeneration
+
+    cfg = WhisperConfig.tiny()
+    model = WhisperForConditionalGeneration(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        input_features=jnp.ones((1, cfg.num_mel_bins, 16), jnp.float32),
+        decoder_input_ids=jnp.ones((1, 8), jnp.int32),
+    )
+    hf = params_to_hf(params, "whisper")
+    # torch Conv1d layout [out, in, k]
+    assert hf["model.encoder.conv1.weight"].shape[0] == cfg.hidden_size
+    assert "model.decoder.layers.1.encoder_attn.out_proj.weight" in hf
+    back = hf_to_params(
+        hf, "whisper",
+        {"encoder": cfg.encoder_layers, "decoder": cfg.decoder_layers},
+        tie_word_embeddings=True,
+    )
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        assert kp in flat_b, kp
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+
+
+def test_deepseek_chained_bases_are_automatic():
+    """Default export of a first_k_dense_replace>=1 config must place the
+    MoE stack at HF index first_k WITHOUT an explicit stack_bases — the
+    chained_stacks derivation (a silent-corruption fix: both stacks used to
+    default to base 0 and overwrite each other)."""
+    from colossalai_tpu.models import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config.tiny(first_k_dense_replace=1, num_hidden_layers=3)
+    model = DeepseekV2ForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    hf = params_to_hf(params, "deepseek")  # NO stack_bases
+    assert "model.layers.0.mlp.gate_proj.weight" in hf       # dense layer 0
+    assert "model.layers.1.mlp.experts.0.gate_proj.weight" in hf
+    assert "model.layers.2.mlp.experts.0.gate_proj.weight" in hf
+    assert "model.layers.0.mlp.experts.0.gate_proj.weight" not in hf
+    back = hf_to_params(
+        hf, "deepseek", {"dense_layers": 1, "layers": 2},
+        num_experts=cfg.num_experts,  # NO stack_bases on import either
+    )
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+
+
+def test_gpt2_unprefixed_hub_layout_imports():
+    """Canonical Hub gpt2 checkpoints carry bare keys (wte.weight, h.0.*);
+    import must normalize them to the LMHeadModel layout."""
+    cfg_model = None
+    from colossalai_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    hf = params_to_hf(params, "gpt2")
+    bare = {
+        (k[len("transformer."):] if k.startswith("transformer.") else k): v
+        for k, v in hf.items()
+    }
+    back = hf_to_params(bare, "gpt2", cfg.num_hidden_layers,
+                        tie_word_embeddings=cfg.tie_word_embeddings)
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+
+
+def test_num_layers_dict_keys_validated():
+    from colossalai_tpu.models import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, decoder_input_ids=ids)
+    hf = params_to_hf(params, "t5")
+    with pytest.raises(ValueError, match="must exactly match"):
+        hf_to_params(hf, "t5", {"encoder": cfg.num_layers},  # forgot decoder
+                     tie_word_embeddings=True)
+
+
+def test_strict_rejects_unconsumed_keys():
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    hf = params_to_hf(params, "llama")
+    hf["model.layers.0.self_attn.rotary_emb.inv_freq"] = np.zeros(4)
+    hf_to_params(hf, "llama", cfg.num_hidden_layers)  # lenient: fine
+    with pytest.raises(ValueError, match="not consumed"):
+        hf_to_params(hf, "llama", cfg.num_hidden_layers, strict=True)
