@@ -120,6 +120,37 @@ def measure(cfg, bs: int, seq: int, n_dev: int, steps: int):
     }
 
 
+def measure_decode(cfg, bs: int = 8, prompt_len: int = 128, steps: int = 24):
+    """Paged-engine decode throughput (tokens/s across the running batch)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    engine = LLMEngine(params, cfg, max_batch_size=bs, max_seq_len=1024,
+                       block_size=64)
+    prompts = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(bs, prompt_len)
+    )
+    gen = GenerationConfig(max_new_tokens=steps + 16)
+    for p in prompts:
+        engine.add_request(list(p), gen)
+    engine.step()  # admit + prefill every slot
+    for _ in range(4):  # warm the decode program
+        engine.step()
+    t0 = time.perf_counter()
+    n_tokens = 0
+    for _ in range(steps):
+        engine.step()
+        n_tokens += len(engine.running)
+    dt = time.perf_counter() - t0
+    return round(n_tokens / dt, 1)
+
+
 def child_main():
     import jax
 
@@ -140,6 +171,11 @@ def child_main():
             extras[f"mfu_bs{ebs}_seq{eseq}"] = r["mfu"]
         except Exception as e:  # smaller chips may not fit every extra config
             print(f"extra config bs{ebs}/seq{eseq} failed: {e}", file=sys.stderr)
+    try:
+        # serving: paged-engine decode throughput on the same 1B-class model
+        extras["decode_tokens_per_s_bs8"] = measure_decode(model_for(hbm, 1024))
+    except Exception as e:
+        print(f"decode bench failed: {e}", file=sys.stderr)
 
     result = {
         "metric": f"llama_{primary['n_params_b']}B_pretrain_mfu_bs{bs}_seq{seq}",

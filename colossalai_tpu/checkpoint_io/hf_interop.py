@@ -517,15 +517,17 @@ def _stack_len(stack, stack_spec) -> int:
 
 
 def _effective_bases(spec, stack_bases, lengths: Dict[str, int]) -> Dict[str, int]:
-    """Explicit ``stack_bases`` wins; else chained stacks get cumulative
-    bases from the given lengths; else each stack's static ``hf_base``."""
-    if stack_bases is not None:
-        return dict(stack_bases)
+    """Per-stack HF index bases: static ``hf_base`` defaults, overridden by
+    chained-stack cumulative lengths, overridden by any explicit
+    ``stack_bases`` entries (a PARTIAL dict overlays — unlisted stacks keep
+    their derived base)."""
     bases = {c: s.hf_base for c, s in spec.stacks.items()}
     running = 0
     for c in spec.chained_stacks:
         bases[c] = running
         running += lengths.get(c, 0)
+    if stack_bases:
+        bases.update(stack_bases)
     return bases
 
 

@@ -15,7 +15,14 @@ Design deltas for TPU/XLA:
   blocks when no pages are free and resumes as finished requests release
   theirs (≙ the reference's running/waiting queues);
 - optional tensor parallelism: pass a mesh and the engine shards params
-  (auto-policy) and the page pool's head dim over ``tp``.
+  (auto-policy) and the page pool's head dim over ``tp``;
+- optional pipeline parallelism: a mesh with a ``pp`` axis distributes
+  layer stages — weights and their KV pages — across device groups with a
+  ppermute activation relay (pp_decode.py ≙ schedule/generate.py);
+- multi-host story: the single controller drives the same jitted programs
+  over a mesh that spans hosts (``jax.distributed`` + ICI/DCN
+  collectives) — the XLA runtime replaces the reference's rpc_worker
+  executor processes (≙ inference/executor/rpc_worker.py).
 """
 
 from __future__ import annotations
@@ -57,21 +64,33 @@ class Request:
     truncated: bool = False
 
 
-def _sample(logits, rng, gen: GenerationConfig):
-    if not gen.do_sample:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / max(gen.temperature, 1e-5)
-    if gen.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[..., -gen.top_k][..., None]
-        logits = jnp.where(logits < kth, -1e9, logits)
-    if gen.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        cutoff_idx = jnp.sum(cum < gen.top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, -1e9, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+@jax.jit
+def _sample_slots(logits, rng, temperature, top_k, top_p, do_sample):
+    """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
+    generation params [S] → tokens [S]. One compiled program per tick; the
+    host fetches S ints, never the [S, V] logits (the r02 review's
+    host-bound-decode fix). top_k=0 / top_p=1 disable those filters.
+    Filters compose sequentially (HF convention): the top-p nucleus is
+    measured on the top-k-RENORMALIZED distribution, not the full vocab."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_eff = jnp.where(top_k > 0, top_k, vocab).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1).clip(0, vocab - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -1e9, scaled)
+    # top-p over the POST-top-k distribution (already sorted: prefix of
+    # sorted_desc survives the k filter, the tail is -1e9)
+    sorted_masked = jnp.where(
+        jnp.arange(vocab)[None, :] < k_eff[:, None], sorted_desc, -1e9
+    )
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx.clip(0, vocab - 1), axis=-1)
+    masked = jnp.where(scaled < cutoff, -1e9, masked)
+    sampled = jax.random.categorical(rng, masked, axis=-1)
+    return jnp.where(do_sample, sampled, greedy)
 
 
 class LLMEngine:
@@ -112,6 +131,31 @@ class LLMEngine:
         self.mesh = mesh
         dtype = config.dtype or jnp.bfloat16
         cache = init_paged_cache(config, num_blocks, block_size, dtype=dtype)
+        self._pp = 0
+        if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
+            # pipeline-parallel decode: layers (weights AND pages) live on
+            # their stage; activations relay via ppermute (pp_decode.py)
+            others = {a: n for a, n in dict(mesh.shape).items() if a != "pp" and n > 1}
+            if others:
+                raise NotImplementedError(
+                    f"pp inference does not compose with {others} — use a "
+                    f"pp-only mesh (tp-only runs through the GSPMD path)"
+                )
+            if use_kernel:
+                raise NotImplementedError(
+                    "use_kernel (Pallas paged attention) has no pp relay "
+                    "path yet — drop use_kernel or the pp mesh"
+                )
+            from .pp_decode import build_pp_paged, shard_params_pp
+
+            self._pp = dict(mesh.shape)["pp"]
+            self._pp_top, self._pp_stacked, cache = shard_params_pp(
+                params, cache, mesh, config.num_hidden_layers
+            )
+            self._pp_prefill, self._pp_decode = build_pp_paged(
+                mesh, config, block_size, self.max_blocks_per_seq
+            )
+            mesh = None  # skip the GSPMD tp placement below
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -131,7 +175,9 @@ class LLMEngine:
             cache = PagedKVCache(
                 k=jax.device_put(cache.k, kv_spec), v=jax.device_put(cache.v, kv_spec)
             )
-        self.params = params
+        # pp mode only ever reads _pp_top/_pp_stacked — don't pin a second
+        # full copy of the weights for the engine's lifetime
+        self.params = None if self._pp else params
         self.cache = cache
         self._rng = jax.random.PRNGKey(seed)
         self._ids = itertools.count()
@@ -139,6 +185,11 @@ class LLMEngine:
         self.running: Dict[int, Request] = {}  # slot -> request
         self._slot_tokens = np.zeros((max_batch_size,), np.int64)
         self._tables: Dict[int, SequenceTable] = {}
+        # per-slot generation params mirrored as arrays for _sample_slots
+        self._gen_temp = np.ones((max_batch_size,), np.float32)
+        self._gen_topk = np.zeros((max_batch_size,), np.int32)
+        self._gen_topp = np.ones((max_batch_size,), np.float32)
+        self._gen_sample = np.zeros((max_batch_size,), bool)
 
     # ------------------------------------------------------------- frontend
     def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None) -> int:
@@ -224,17 +275,25 @@ class LLMEngine:
             tables[slot] = req.table.padded(self.max_blocks_per_seq)
             lengths[slot] = req.table.length
             active[slot] = True
-        logits, self.cache = decode_paged(
-            self.params, self.config, tokens, jnp.asarray(tables),
-            jnp.asarray(lengths), self.cache, jnp.asarray(active),
-            use_kernel=self.use_kernel,
-        )
-        next_np = np.asarray(jnp.argmax(logits, axis=-1))
+        if self._pp:
+            logits, self.cache = self._pp_decode(
+                self._pp_top, self._pp_stacked, tokens, jnp.asarray(tables),
+                jnp.asarray(lengths), self.cache, jnp.asarray(active),
+            )
+        else:
+            logits, self.cache = decode_paged(
+                self.params, self.config, tokens, jnp.asarray(tables),
+                jnp.asarray(lengths), self.cache, jnp.asarray(active),
+                use_kernel=self.use_kernel,
+            )
+        # ALL slots sample on device with their own params; the host fetches
+        # S ints, never the [S, V] logits
+        next_np = self._sample_all(logits)
 
         finished: List[Request] = []
         for slot, req in list(self.running.items()):
             req.table.length += 1
-            tok = self._pick_token(logits[slot], next_np[slot], req.gen)
+            tok = int(next_np[slot])
             req.output_ids.append(tok)
             self._slot_tokens[slot] = tok
             if self._is_finished(req, tok):
@@ -243,12 +302,13 @@ class LLMEngine:
                 self._release(slot)
         return finished_at_prefill + finished
 
-    def _pick_token(self, row_logits, greedy_tok, gen: GenerationConfig) -> int:
-        """Per-request sampling with the request's OWN config."""
-        if not gen.do_sample:
-            return int(greedy_tok)
+    def _sample_all(self, logits) -> np.ndarray:
         self._rng, key = jax.random.split(self._rng)
-        return int(np.asarray(_sample(row_logits[None], key, gen)[0]))
+        return np.asarray(_sample_slots(
+            logits, key,
+            jnp.asarray(self._gen_temp), jnp.asarray(self._gen_topk),
+            jnp.asarray(self._gen_topp), jnp.asarray(self._gen_sample),
+        ))
 
     def _is_finished(self, req: Request, last_tok: int) -> bool:
         total = len(req.prompt_ids) + len(req.output_ids)
@@ -262,15 +322,33 @@ class LLMEngine:
     # -------------------------------------------------------------- internal
     def _prefill_into_slot(self, req: Request, bucket: int) -> None:
         n = len(req.prompt_ids)
+        g = req.gen
+        self._gen_temp[req.slot] = g.temperature
+        self._gen_topk[req.slot] = g.top_k
+        self._gen_topp[req.slot] = g.top_p
+        self._gen_sample[req.slot] = g.do_sample
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
         table = jnp.asarray(req.table.padded(self.max_blocks_per_seq), jnp.int32)
-        logits, self.cache = prefill_paged(
-            self.params, self.config, jnp.asarray(ids),
-            jnp.asarray([n], jnp.int32), self.cache, table,
-        )
+        if self._pp:
+            logits, self.cache = self._pp_prefill(
+                self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                jnp.asarray([n], jnp.int32), self.cache, table,
+            )
+        else:
+            logits, self.cache = prefill_paged(
+                self.params, self.config, jnp.asarray(ids),
+                jnp.asarray([n], jnp.int32), self.cache, table,
+            )
         req.table.length = n
-        tok = self._pick_token(logits[0], int(np.asarray(jnp.argmax(logits[0]))), req.gen)
+        self._rng, key = jax.random.split(self._rng)
+        tok = int(np.asarray(_sample_slots(
+            logits, key,
+            jnp.full((1,), g.temperature, jnp.float32),
+            jnp.full((1,), g.top_k, jnp.int32),
+            jnp.full((1,), g.top_p, jnp.float32),
+            jnp.full((1,), g.do_sample, bool),
+        ))[0])
         req.output_ids.append(tok)
         self._slot_tokens[req.slot] = tok
 

@@ -1,0 +1,214 @@
+"""Pipeline-parallel paged inference: layer stages distributed over ``pp``.
+
+≙ reference ``pipeline/schedule/generate.py`` (GenerateSchedule: stage-to-
+stage hidden-state relay + p2p metadata) and ``inference/executor``'s
+multi-device story. TPU redesign: ONE jitted program per tick under
+``shard_map`` over the ``pp`` mesh axis —
+
+- weights and KV pages are resharded once at engine init to
+  ``[pp, L/pp, ...]`` with dim 0 over ``pp``: each stage group owns its
+  layers' weights AND their pages (no weight motion ever);
+- a tick runs a pp-step relay: every stage applies its local layer block,
+  then ``ppermute`` shifts the hidden state to the next stage. The token's
+  activation visits the stages in order — the p2p "send" is one ICI
+  collective inside the compiled program, not host RPC like the
+  reference's torch.distributed pipeline;
+- non-active stages compute on don't-care data and mask their cache
+  commits (`where(stage==s)`), so the relay stays a single static program
+  — no data-dependent control flow for XLA to choke on. With continuous
+  batching feeding every tick, consecutive ticks overlap stage use the
+  same way the reference's microbatch ring does.
+
+The relay supports any decoder the paged engine runs (llama family).
+tp inside a pp stage is not composed here (the GSPMD tp path covers
+tp-only); the engine raises if both are requested.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from colossalai_tpu.models.llama import LlamaConfig
+
+from .kv_cache import PagedKVCache
+from .modeling import _block_step, _project_kv, _rms
+
+
+def shard_params_pp(params, cache: PagedKVCache, mesh, num_layers: int):
+    """Reshape the scanned stack and the page pool to [pp, L/pp, ...] and
+    place them: stacked dim 0 over ``pp``, top-level params replicated."""
+    pp = mesh.shape["pp"]
+    if num_layers % pp:
+        raise ValueError(f"num_layers={num_layers} not divisible by pp={pp}")
+    per = num_layers // pp
+    p = params["params"] if "params" in params else params
+    top = {k: v for k, v in p.items() if k != "layers"}
+    stacked = jax.tree.map(
+        lambda a: jnp.asarray(a).reshape((pp, per) + a.shape[1:]),
+        p["layers"]["block"],
+    )
+    stage_sharding = NamedSharding(mesh, P("pp"))
+    repl = NamedSharding(mesh, P())
+    top = jax.device_put(top, jax.tree.map(lambda _: repl, top))
+    stacked = jax.device_put(
+        stacked, jax.tree.map(lambda _: stage_sharding, stacked)
+    )
+    ck = jax.device_put(
+        cache.k.reshape((pp, per) + cache.k.shape[1:]), stage_sharding
+    )
+    cv = jax.device_put(
+        cache.v.reshape((pp, per) + cache.v.shape[1:]), stage_sharding
+    )
+    return top, stacked, PagedKVCache(k=ck, v=cv)
+
+
+def _relay(mesh, stage_fn, x, stacked, ck, cv, extras):
+    """Run ``stage_fn`` through the pp stages sequentially inside shard_map.
+
+    ``stage_fn(x, local_stacked, local_k, local_v, extras)`` →
+    (y, k_new, v_new) with local stack shapes [L/pp, ...]; ``extras`` is a
+    pytree of replicated operands (shard_map cannot close over tracers).
+    Returns (x broadcast to all stages, updated pools). Cost note: inactive
+    stages compute on don't-care inputs — the relay trades pp-1 idle-stage
+    FLOPs for one static XLA program; with a full continuous batch every
+    tick, stage utilization comes from consecutive ticks, not within one.
+    """
+    pp = mesh.shape["pp"]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def shard_fn(x, stacked, ck, cv, extras):
+        local = jax.tree.map(lambda a: a[0], stacked)
+        kl, vl = ck[0], cv[0]
+        stage = jax.lax.axis_index("pp")
+        # the carry becomes device-varying after the first masked select;
+        # mark it varying up front so the fori_loop carry type is stable
+        if hasattr(jax.lax, "pcast"):
+            x = jax.lax.pcast(x, ("pp",), to="varying")
+        else:  # older jax spells it pvary
+            x = jax.lax.pvary(x, ("pp",))
+
+        def body(s, carry):
+            x, kl, vl = carry
+            y, k_new, v_new = stage_fn(x, local, kl, vl, extras)
+            mine = stage == s
+            kl = jnp.where(mine, k_new, kl)
+            vl = jnp.where(mine, v_new, vl)
+            x = jnp.where(mine, y, x)
+            return (jax.lax.ppermute(x, "pp", perm), kl, vl)
+
+        x, kl, vl = jax.lax.fori_loop(0, pp, body, (x, kl, vl))
+        # after pp hops the finished activation is back on stage 0 — psum
+        # with a stage-0 mask broadcasts it everywhere
+        x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
+        return x, kl[None], vl[None]
+
+    stack_specs = jax.tree.map(lambda _: P("pp"), stacked)
+    extra_specs = jax.tree.map(lambda _: P(), extras)
+    return shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), stack_specs, P("pp"), P("pp"), extra_specs),
+        out_specs=(P(), P("pp"), P("pp")),
+    )(x, stacked, ck, cv, extras)
+
+
+def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
+    """(prefill_fn, decode_fn) — pp variants of prefill_paged/decode_paged.
+
+    Signatures mirror the single-stage functions but take (top, stacked)
+    from :func:`shard_params_pp` and the [pp, L/pp, ...] cache.
+    """
+    dtype = cfg.dtype or jnp.bfloat16
+    bs = block_size
+
+    def _head(top, x):
+        x = _rms(x, top["norm"]["scale"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            return x.astype(jnp.float32) @ top["embed_tokens"]["embedding"].T.astype(jnp.float32)
+        return x.astype(jnp.float32) @ top["lm_head"]["kernel"].astype(jnp.float32)
+
+    @partial(jax.jit, donate_argnames=("cache",))
+    def prefill_fn(top, stacked, input_ids, n_tokens, cache: PagedKVCache, block_table):
+        b, s = input_ids.shape
+        n_pages = s // bs
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        valid = jnp.arange(s)[None, :] < n_tokens
+        x = top["embed_tokens"]["embedding"].astype(dtype)[input_ids].astype(dtype)
+
+        def stage_fn(x, local, k_pool_stack, v_pool_stack, extras):
+            positions, valid, block_table = extras
+
+            def layer(carry, inputs):
+                x, = carry
+                lp, k_pool, v_pool = inputs
+                h = _rms(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+                k, v = _project_kv(cfg, lp, h, positions)
+                k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
+                v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+                k_pool = k_pool.at[block_table[:n_pages]].set(k_pages)
+                v_pool = v_pool.at[block_table[:n_pages]].set(v_pages)
+                x = _block_step(cfg, lp, x, k, v, positions, valid)
+                return (x,), (k_pool, v_pool)
+
+            (x,), (k_new, v_new) = jax.lax.scan(
+                layer, (x,), (local, k_pool_stack, v_pool_stack)
+            )
+            return x, k_new, v_new
+
+        x, k_new, v_new = _relay(
+            mesh, stage_fn, x, stacked, cache.k, cache.v,
+            (positions, valid, block_table),
+        )
+        logits = _head(top, x)
+        last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+        return last, PagedKVCache(k=k_new, v=v_new)
+
+    @partial(jax.jit, donate_argnames=("cache",))
+    def decode_fn(top, stacked, tokens, block_tables, lengths, cache: PagedKVCache, active):
+        n_slots = tokens.shape[0]
+        positions = lengths[:, None]
+        x = top["embed_tokens"]["embedding"].astype(dtype)[tokens][:, None, :].astype(dtype)
+        w_block = jnp.take_along_axis(block_tables, (lengths // bs)[:, None], axis=1)[:, 0]
+        w_off = lengths % bs
+        s_max = max_blocks * bs
+        attend = jnp.arange(s_max)[None, :] <= lengths[:, None]
+
+        def stage_fn(x, local, k_pool_stack, v_pool_stack, extras):
+            positions, block_tables, active, w_block, w_off, attend = extras
+
+            def layer(carry, inputs):
+                x, = carry
+                lp, k_pool, v_pool = inputs
+                h = _rms(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+                k, v = _project_kv(cfg, lp, h, positions)
+                wb = jnp.where(active, w_block, 0)
+                wo = jnp.where(active, w_off, 0)
+                k_tok = jnp.where(active[:, None, None], k[:, 0], k_pool[wb, :, wo])
+                v_tok = jnp.where(active[:, None, None], v[:, 0], v_pool[wb, :, wo])
+                k_pool = k_pool.at[wb, :, wo].set(k_tok)
+                v_pool = v_pool.at[wb, :, wo].set(v_tok)
+
+                def to_seq(pool):
+                    g = pool[block_tables]
+                    g = g.transpose(0, 1, 3, 2, 4)
+                    return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
+
+                x = _block_step(cfg, lp, x, to_seq(k_pool), to_seq(v_pool), positions, attend)
+                return (x,), (k_pool, v_pool)
+
+            (x,), (k_new, v_new) = jax.lax.scan(
+                layer, (x,), (local, k_pool_stack, v_pool_stack)
+            )
+            return x, k_new, v_new
+
+        x, k_new, v_new = _relay(
+            mesh, stage_fn, x, stacked, cache.k, cache.v,
+            (positions, block_tables, active, w_block, w_off, attend),
+        )
+        return _head(top, x)[:, 0], PagedKVCache(k=k_new, v=v_new)
+
+    return prefill_fn, decode_fn

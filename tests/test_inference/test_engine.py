@@ -107,3 +107,88 @@ def test_prompt_too_long(model_and_params):
     engine = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=16, block_size=16)
     with pytest.raises(ValueError):
         engine.add_request(list(range(20)))
+
+
+def test_engine_pp2_matches_single_device(model_and_params):
+    """Pipeline-parallel decode (layer stages over a pp-axis mesh, activation
+    relay via ppermute) must produce the same greedy tokens as the
+    single-device engine — the pp-inference gate (≙ reference
+    pipeline/schedule/generate.py)."""
+    from jax.sharding import Mesh
+
+    cfg, model, params = model_and_params
+    prompts = [list(RNG.randint(0, cfg.vocab_size, size=(n,))) for n in (5, 9)]
+    gen = GenerationConfig(max_new_tokens=6)
+
+    ref_engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                           block_size=16)
+    ref = ref_engine.generate([list(p) for p in prompts], gen)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    pp_engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                          block_size=16, mesh=mesh)
+    assert pp_engine._pp == 2
+    out = pp_engine.generate([list(p) for p in prompts], gen)
+    assert out == ref, (out, ref)
+
+
+def test_engine_pp_rejects_tp_mix(model_and_params):
+    from jax.sharding import Mesh
+
+    cfg, model, params = model_and_params
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "tp"))
+    with pytest.raises(NotImplementedError, match="pp inference"):
+        LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                  block_size=16, mesh=mesh)
+
+
+def test_engine_per_slot_sampling_configs(model_and_params):
+    """Slots with different sampling configs coexist in one tick: greedy
+    slots stay deterministic while a sampling slot draws from the filtered
+    distribution — all on device."""
+    cfg, model, params = model_and_params
+    engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                       block_size=16, seed=7)
+    p1 = list(RNG.randint(0, cfg.vocab_size, size=(6,)))
+    p2 = list(RNG.randint(0, cfg.vocab_size, size=(6,)))
+    greedy = GenerationConfig(max_new_tokens=8)
+    sampled = GenerationConfig(max_new_tokens=8, do_sample=True,
+                               temperature=0.9, top_k=50, top_p=0.95)
+    out = engine.generate([p1, p2], None)  # warm pool
+    engine2 = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                        block_size=16, seed=7)
+    a = engine2.add_request(p1, greedy)
+    b = engine2.add_request(p2, sampled)
+    done = {}
+    while engine2.waiting or engine2.running:
+        for req in engine2.step():
+            done[req.request_id] = req
+    # greedy slot must equal the pure-greedy reference run
+    ref = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=128,
+                    block_size=16).generate([p1], greedy)[0]
+    assert done[a].output_ids == ref
+    assert len(done[b].output_ids) == 8
+
+
+def test_sampler_topk_topp_sequential_semantics():
+    """top-p must be measured on the top-k-renormalized distribution (HF
+    sequential-filter convention), not the full vocab."""
+    from colossalai_tpu.inference.engine import _sample_slots
+
+    # 5-token vocab: probs ~ [0.4, 0.3, 0.2, 0.07, 0.03]
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.07, 0.03]], jnp.float32))
+    # top_k=2 renormalizes to [4/7, 3/7]; top_p=0.6 then keeps ONLY token 0
+    # (4/7 ≈ 0.571 < 0.6 → cutoff lands on token 1? cum=[0.571, 1.0];
+    # sum(cum < 0.6) = 1 → cutoff at sorted idx 1 → keeps tokens 0 and 1).
+    # Measured on the FULL vocab instead, cum=[0.4, 0.7, ...] → sum<0.6 = 1
+    # as well — so distinguish via top_p=0.5: post-k cum=[0.571] ≥ 0.5 keeps
+    # only token 0; full-vocab cum=[0.4, 0.7] keeps tokens 0 AND 1.
+    outs = set()
+    for seed in range(40):
+        tok = int(np.asarray(_sample_slots(
+            logits, jax.random.PRNGKey(seed),
+            jnp.ones((1,), jnp.float32), jnp.full((1,), 2, jnp.int32),
+            jnp.full((1,), 0.5, jnp.float32), jnp.ones((1,), bool),
+        ))[0])
+        outs.add(tok)
+    assert outs == {0}, outs
