@@ -119,8 +119,10 @@ class HybridParallelPlugin(Plugin):
     enable_fp8: bool = False
     microbatch_size: Optional[int] = None
     num_microbatches: Optional[int] = None
-    #: pipeline schedule: "1f1b" | "interleaved" | "zb" | "gpipe"
-    #: (≙ reference pp_style one_f_one_b / interleaved / zbv)
+    #: pipeline schedule: "1f1b" | "interleaved" | "zb" | "gpipe" | "auto"
+    #: (≙ reference pp_style one_f_one_b / interleaved / zbv). "auto" picks
+    #: the family by simulated makespan (pipeline/schedule_sim.py ≙ the
+    #: v_schedule cost search) once num_microbatches is resolved.
     pp_schedule: str = "1f1b"
     #: virtual stages per device when pp_schedule == "interleaved"
     #: (≙ num_model_chunks)
@@ -129,7 +131,7 @@ class HybridParallelPlugin(Plugin):
     #: remats (≙ PipelineGradientCheckpointConfig per-stage ckpt ratios)
     pp_remat_ratio: float = 1.0
 
-    PP_SCHEDULES = ("1f1b", "interleaved", "zb", "gpipe")
+    PP_SCHEDULES = ("1f1b", "interleaved", "zb", "gpipe", "auto")
 
     #: the reference's four SP modes (shard_config.py:13) + none.
     #: "ring" is the ring-matmul variant of split_gather — under XLA the
@@ -204,6 +206,22 @@ class HybridParallelPlugin(Plugin):
                         f"(implies {from_size})"
                     )
                 self._resolved_microbatches = from_size
+        # per-configure resolution lives in _resolved_* (like
+        # _resolved_microbatches) so a reused plugin re-runs the auto search
+        # with the next model's shapes instead of baking in the first answer
+        self._resolved_schedule = self.pp_schedule
+        self._resolved_chunks = self.pp_chunks
+        if self.pp_schedule == "auto":
+            if self.pp_size > 1 and self._resolved_microbatches:
+                from colossalai_tpu.pipeline.schedule_sim import choose_schedule
+
+                best = choose_schedule(self.pp_size, self._resolved_microbatches)
+                name = {"one_f_one_b": "1f1b"}.get(best.schedule, best.schedule)
+                self._resolved_schedule, self._resolved_chunks = name, best.chunks
+            else:
+                # no microbatch count yet: fall through so plugin_base's
+                # clear 'needs example_batch' error (or pp_size==1) wins
+                self._resolved_schedule, self._resolved_chunks = "1f1b", 1
         return super().configure(
             model, optimizer, loss_fn=loss_fn, example_batch=example_batch,
             rng=rng, policy=policy, devices=devices, lora=lora,
@@ -244,10 +262,12 @@ class HybridParallelPlugin(Plugin):
         if self.pp_size > 1 and model.config.pp_microbatches != n_micro:
             updates["pp_microbatches"] = n_micro
         if self.pp_size > 1:
-            if getattr(model.config, "pp_schedule", "1f1b") != self.pp_schedule:
-                updates["pp_schedule"] = self.pp_schedule
-            if getattr(model.config, "pp_chunks", 1) != self.pp_chunks:
-                updates["pp_chunks"] = self.pp_chunks
+            sched = getattr(self, "_resolved_schedule", None) or self.pp_schedule
+            chunks = getattr(self, "_resolved_chunks", None) or self.pp_chunks
+            if getattr(model.config, "pp_schedule", "1f1b") != sched:
+                updates["pp_schedule"] = sched
+            if getattr(model.config, "pp_chunks", 1) != chunks:
+                updates["pp_chunks"] = chunks
             if getattr(model.config, "pp_remat_ratio", 1.0) != self.pp_remat_ratio:
                 updates["pp_remat_ratio"] = self.pp_remat_ratio
         if not self.enable_flash_attention and getattr(model.config, "attention_impl", None) not in (None, "xla"):

@@ -64,6 +64,9 @@ class Request:
     truncated: bool = False
 
 
+_greedy_slots = jax.jit(lambda logits: jnp.argmax(logits, axis=-1))
+
+
 @jax.jit
 def _sample_slots(logits, rng, temperature, top_k, top_p, do_sample):
     """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
@@ -303,11 +306,22 @@ class LLMEngine:
         return finished_at_prefill + finished
 
     def _sample_all(self, logits) -> np.ndarray:
+        return self._sample_rows(
+            logits, self._gen_temp, self._gen_topk,
+            self._gen_topp, self._gen_sample,
+        )
+
+    def _sample_rows(self, logits, temp, topk, topp, sample_mask) -> np.ndarray:
+        """One on-device sampling dispatch for [n, V] logits + per-row
+        params; all-greedy rows take a bare-argmax program (the benchmarked
+        default path skips the sort/softmax machinery entirely)."""
+        if not np.any(sample_mask):
+            return np.asarray(_greedy_slots(logits))
         self._rng, key = jax.random.split(self._rng)
         return np.asarray(_sample_slots(
-            logits, key,
-            jnp.asarray(self._gen_temp), jnp.asarray(self._gen_topk),
-            jnp.asarray(self._gen_topp), jnp.asarray(self._gen_sample),
+            logits, key, jnp.asarray(temp, jnp.float32),
+            jnp.asarray(topk, jnp.int32), jnp.asarray(topp, jnp.float32),
+            jnp.asarray(sample_mask, bool),
         ))
 
     def _is_finished(self, req: Request, last_tok: int) -> bool:
@@ -341,14 +355,10 @@ class LLMEngine:
                 jnp.asarray([n], jnp.int32), self.cache, table,
             )
         req.table.length = n
-        self._rng, key = jax.random.split(self._rng)
-        tok = int(np.asarray(_sample_slots(
-            logits, key,
-            jnp.full((1,), g.temperature, jnp.float32),
-            jnp.full((1,), g.top_k, jnp.int32),
-            jnp.full((1,), g.top_p, jnp.float32),
-            jnp.full((1,), g.do_sample, bool),
-        ))[0])
+        tok = int(self._sample_rows(
+            logits, np.asarray([g.temperature]), np.asarray([g.top_k]),
+            np.asarray([g.top_p]), np.asarray([g.do_sample]),
+        )[0])
         req.output_ids.append(tok)
         self._slot_tokens[req.slot] = tok
 

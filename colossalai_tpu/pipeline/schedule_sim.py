@@ -1,0 +1,192 @@
+"""Pipeline-schedule cost model: simulate, compare, and choose schedules.
+
+≙ reference ``pipeline/schedule/v_schedule.py:46-449`` (PipelineGraph: derive
+a zero-bubble node list from (f, b, w, comm) costs). The reference searches
+an explicit per-rank node list that its torch runtime then replays; our
+runtime compiles ONE lockstep XLA program per schedule family
+(one_f_one_b.py), so what the cost model owes the user is different:
+predict step time / bubble fraction / peak in-flight activations for each
+schedule family from measured per-microbatch costs, and pick the best
+family + chunk count for a (pp, n_micro) config.
+
+The simulator is event-driven over the pipeline dependency DAG:
+
+- F(u, m): forward of microbatch m on virtual stage u (u = chunk·pp + s,
+  physical stage u % pp) — needs F(u-1, m);
+- Bx(u, m): input-gradient backward — needs Bx(u+1, m) and F(u, m);
+- Bw(u, m): weight-gradient work — needs Bx(u, m), schedulable ANY time
+  after (the zero-bubble freedom, ≙ WeightGradStore);
+- each physical stage runs one op at a time; greedy dispatch with
+  per-schedule priorities and the 1F1B in-flight cap reproduces the
+  classic schedules:
+  * gpipe:       all-F-then-all-B priority, no cap, Bw fused into Bx
+  * one_f_one_b: B-over-F priority + in-flight cap, Bw fused
+  * interleaved: same with chunks > 1 virtual stages per physical stage
+  * zb (split_dw): Bx on the critical path, Bw lowest priority — it
+    drains into fill/cooldown bubbles exactly like ZB-H1's deferral.
+
+Costs default to this repo's recompute-interleaved backward (backward tick
+re-runs the forward): t_b ≈ t_f (dX chain) + t_f (recompute), t_w ≈ the
+parameter-gradient matmuls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleCosts:
+    """Per-microbatch per-virtual-stage op costs (arbitrary time unit)."""
+
+    t_f: float = 1.0
+    #: input-grad backward tick (includes recompute under full remat)
+    t_b: float = 2.0
+    #: weight-grad work deferred by split_dw (part of t_b when fused)
+    t_w: float = 1.0
+    t_comm: float = 0.05
+
+
+@dataclasses.dataclass
+class ScheduleReport:
+    schedule: str
+    chunks: int
+    makespan: float
+    #: 1 - busy/(pp * makespan): fraction of stage-time spent idle
+    bubble_fraction: float
+    #: max concurrently-live forward activations on any physical stage
+    peak_inflight: int
+
+    def __repr__(self):
+        return (
+            f"ScheduleReport({self.schedule}, chunks={self.chunks}, "
+            f"makespan={self.makespan:.2f}, bubble={self.bubble_fraction:.3f}, "
+            f"peak_inflight={self.peak_inflight})"
+        )
+
+
+def simulate(
+    pp: int,
+    n_micro: int,
+    schedule: str = "one_f_one_b",
+    chunks: int = 1,
+    costs: ScheduleCosts = ScheduleCosts(),
+) -> ScheduleReport:
+    """Event-driven simulation of one pipeline step. See module docstring."""
+    if schedule not in ("gpipe", "one_f_one_b", "interleaved", "zb"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule != "interleaved" and chunks != 1:
+        raise ValueError("chunks > 1 is the interleaved/zb-interleaved family")
+    split_dw = schedule == "zb"
+    v = pp * chunks
+    # costs are per PHYSICAL stage pass at chunks=1; a virtual stage runs
+    # 1/chunks of the stage's layers
+    t_f = costs.t_f / chunks
+    t_w = costs.t_w / chunks
+    t_b_fused = (costs.t_b if split_dw else costs.t_b + costs.t_w) / chunks
+
+    # op table: deps + durations ------------------------------------------
+    ops: Dict[Tuple[str, int, int], float] = {}
+    deps: Dict[Tuple[str, int, int], List[Tuple[str, int, int]]] = {}
+    for u in range(v):
+        for m in range(n_micro):
+            ops[("F", u, m)] = t_f
+            deps[("F", u, m)] = [("F", u - 1, m)] if u > 0 else []
+            ops[("Bx", u, m)] = t_b_fused
+            deps[("Bx", u, m)] = [("F", u, m)] + (
+                [("Bx", u + 1, m)] if u < v - 1 else []
+            )
+            if split_dw:
+                ops[("Bw", u, m)] = t_w
+                deps[("Bw", u, m)] = [("Bx", u, m)]
+
+    def stage_of(u: int) -> int:
+        return u % pp
+
+    # in-flight cap: classic 1F1B admission — virtual stage u may hold at
+    # most v - u live forward activations (gpipe: no cap)
+    cap = {u: (n_micro if schedule == "gpipe" else v - u) for u in range(v)}
+
+    def priority(kind: str, u: int, m: int) -> Tuple:
+        if schedule == "gpipe":
+            order = {"F": 0, "Bx": 1, "Bw": 1}
+        else:
+            order = {"Bx": 0, "F": 1, "Bw": 2}  # Bw: fills idle time only
+        return (order[kind], m, -u if kind != "F" else u)
+
+    finish: Dict[Tuple[str, int, int], float] = {}
+    stage_free = [0.0] * pp
+    live = {u: 0 for u in range(v)}  # forward activations not yet consumed
+    busy = [0.0] * pp
+    peak = [0] * pp
+    pending = set(ops)
+
+    while pending:
+        # candidate per stage: highest-priority runnable op
+        best: List[Tuple[float, Tuple, Tuple[str, int, int]]] = []
+        for op in pending:
+            kind, u, m = op
+            if any(d not in finish for d in deps[op]):
+                continue
+            if kind == "F" and live[u] >= cap[u]:
+                continue
+            ready = max((finish[d] + costs.t_comm for d in deps[op]), default=0.0)
+            s = stage_of(u)
+            start = max(ready, stage_free[s])
+            heapq.heappush(best, (start, priority(kind, u, m), op))
+        if not best:
+            raise RuntimeError("deadlock in schedule simulation (cap too tight)")
+        # commit ONE op: the globally earliest-start (ties by priority) —
+        # committing one at a time keeps dispatch decisions causal
+        start, _, op = heapq.heappop(best)
+        kind, u, m = op
+        s = stage_of(u)
+        end = start + ops[op]
+        finish[op] = end
+        stage_free[s] = end
+        busy[s] += ops[op]
+        pending.discard(op)
+        if kind == "F":
+            live[u] += 1
+            peak[s] = max(peak[s], sum(live[x] for x in range(v) if stage_of(x) == s))
+        elif kind == "Bx":
+            live[u] -= 1
+
+    makespan = max(finish.values())
+    bubble = 1.0 - sum(busy) / (pp * makespan)
+    return ScheduleReport(schedule, chunks, makespan, bubble, max(peak))
+
+
+def compare(
+    pp: int,
+    n_micro: int,
+    costs: ScheduleCosts = ScheduleCosts(),
+    chunk_options: Tuple[int, ...] = (1, 2),
+) -> List[ScheduleReport]:
+    """All schedule families at the given config, best (lowest makespan)
+    first — the v_schedule 'search' collapsed to the families our lockstep
+    runtime actually compiles."""
+    reports = [
+        simulate(pp, n_micro, "gpipe", 1, costs),
+        simulate(pp, n_micro, "one_f_one_b", 1, costs),
+        simulate(pp, n_micro, "zb", 1, costs),
+    ]
+    for c in chunk_options:
+        if c > 1 and pp * c <= n_micro:
+            reports.append(simulate(pp, n_micro, "interleaved", c, costs))
+    return sorted(reports, key=lambda r: r.makespan)
+
+
+def choose_schedule(
+    pp: int,
+    n_micro: int,
+    costs: Optional[ScheduleCosts] = None,
+    max_chunks: int = 2,
+) -> ScheduleReport:
+    """Best schedule family for the config (used by pp_schedule='auto')."""
+    return compare(
+        pp, n_micro, costs or ScheduleCosts(),
+        chunk_options=tuple(range(2, max_chunks + 1)),
+    )[0]
