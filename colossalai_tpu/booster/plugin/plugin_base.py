@@ -154,15 +154,28 @@ class Plugin(abc.ABC):
         # ---- LoRA (≙ booster.enable_lora / peft): the trainable state is a
         # parallel adapter tree; base params are frozen cargo in TrainState.
         lora_shape = None
+        base_shape = params_shape["params"]
         if lora is not None:
             from colossalai_tpu.peft.lora import init_lora_params, lora_param_specs
 
             lora_shape = jax.eval_shape(
-                lambda r: init_lora_params(params_shape["params"], lora, r), rng
+                lambda r: init_lora_params(base_shape, lora, r), rng
             )
             lora_specs = lora_param_specs(
-                param_specs, params_shape["params"], lora_shape, lora
+                param_specs, base_shape, lora_shape, lora
             )
+            if getattr(lora, "base_quant_bits", None):
+                # QLoRA: the frozen base is stored quantized ({"q","scale"}
+                # dict nodes); reshape the base template + specs to match
+                from colossalai_tpu.quantization.weight_only import (
+                    quantize_tree,
+                    quantized_param_specs,
+                )
+
+                base_shape = jax.eval_shape(
+                    lambda t: quantize_tree(t, lora.base_quant_bits), base_shape
+                )
+                param_specs = quantized_param_specs(param_specs, base_shape)
             param_specs = {"base": param_specs, "lora": lora_specs}
 
         param_shardings = jax.tree.map(
@@ -187,9 +200,11 @@ class Plugin(abc.ABC):
             # the decision is made once from the traced state sizes vs HBM —
             # offload optimizer states when the resident state would crowd
             # out the working set.
+            # base_shape is the QUANTIZED tree under QLoRA — it must stay
+            # leaf-aligned with param_specs for the byte estimate
             all_shapes = (
                 params_shape["params"] if lora is None
-                else {"base": params_shape["params"], "lora": lora_shape}
+                else {"base": base_shape, "lora": lora_shape}
             )
             offload_optim = _auto_offload_decision(
                 all_shapes, param_specs, opt_state_shape, opt_specs, mesh
@@ -247,6 +262,10 @@ class Plugin(abc.ABC):
                 base_rng, lora_rng = jax.random.split(rng)
                 base = model.init(base_rng, **example_inputs)["params"]
                 adapters = init_lora_params(base, lora, lora_rng)
+                if getattr(lora, "base_quant_bits", None):
+                    from colossalai_tpu.quantization.weight_only import quantize_tree
+
+                    base = quantize_tree(base, lora.base_quant_bits)
                 return TrainState(
                     step=jnp.zeros((), jnp.int32),
                     params={"base": base, "lora": adapters},

@@ -364,6 +364,12 @@ class LLMEngine:
 
     def _release(self, slot: int) -> None:
         self.running.pop(slot, None)
+        # reset sampling params so a freed sampling slot doesn't pin the
+        # all-greedy fast path off for the engine's lifetime
+        self._gen_temp[slot] = 1.0
+        self._gen_topk[slot] = 0
+        self._gen_topp[slot] = 1.0
+        self._gen_sample[slot] = False
         table = self._tables.pop(slot, None)
         if table is not None:
             self.allocator.free(table.blocks)

@@ -49,6 +49,10 @@ class LoraConfig:
     r: int = 8
     lora_alpha: float = 16.0
     target_modules: Tuple[str, ...] = DEFAULT_TARGETS
+    #: quantize the FROZEN base weights to int8/int4 (None = full precision)
+    #: — the QLoRA path (≙ bnb.py Linear8bitLt/Linear4bit under
+    #: enable_lora(quantize=True)); see quantization/weight_only.py
+    base_quant_bits: Optional[int] = None
 
     @property
     def scaling(self) -> float:
@@ -118,7 +122,13 @@ def _flat_by_path(tree: Any, is_leaf=None) -> dict:
 
 def merge_lora(base: Any, lora: Any, cfg: LoraConfig) -> Any:
     """``W_eff = W + scaling * A @ B`` for every adapted kernel (batched over
-    the layer dim for scanned stacks). Call inside jit — the delta fuses."""
+    the layer dim for scanned stacks). Call inside jit — the delta fuses.
+    A weight-only-quantized base (base_quant_bits) dequantizes here, also
+    inside jit: HBM keeps the integers, consumers see the cast."""
+    if getattr(cfg, "base_quant_bits", None):
+        from colossalai_tpu.quantization.weight_only import dequantize_tree
+
+        base = dequantize_tree(base, jax.tree_util.tree_leaves(lora)[0].dtype)
     lora_flat = _flat_by_path(lora)
     prefixes = {p.rsplit("/", 1)[0] for p in lora_flat}
 

@@ -1,0 +1,111 @@
+"""Weight-only int8/int4 quantization for frozen base weights (QLoRA path).
+
+≙ reference ``quantization/bnb.py`` (bitsandbytes Linear8bitLt/Linear4bit
+module surgery under ``booster.enable_lora(quantize=True)``). TPU redesign:
+no custom kernels — the base param tree is quantized ONCE at boost into
+per-output-channel symmetric integers, stored as plain ``{"q", "scale"}``
+dict nodes in place of each kernel leaf (so shardings, checkpointing, and
+donation all keep working on an ordinary pytree), and dequantized INSIDE
+the jitted step right before the LoRA merge. XLA fuses the
+``q.astype(bf16) * scale`` into the consumer matmul; HBM holds int8/int4.
+
+int4 uses jax's native ``jnp.int4`` dtype (packed on TPU). The LoRA
+gradient flow is untouched: the base — quantized or not — is carried as a
+non-differentiated constant through the step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+_QUANT_KEYS = frozenset({"q", "scale"})
+
+#: param-path fragments never quantized (≙ bnb llm_int8_skip_modules:
+#: embeddings and the lm head stay full precision)
+_SKIP = ("embed", "lm_head", "wte", "wpe", "shared", "norm")
+
+_QMAX = {8: 127.0, 4: 7.0}
+_QDTYPE = {8: jnp.int8, 4: jnp.int4}
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and set(x) == _QUANT_KEYS
+
+
+def _should_quantize(path: str, leaf) -> bool:
+    if not path.endswith("kernel") or leaf.ndim not in (2, 3):
+        return False
+    return not any(s in path for s in _SKIP)
+
+
+def quantize_tree(params: Any, bits: int = 8) -> Any:
+    """Per-output-channel symmetric quantization of every eligible kernel:
+    W [in, out] → q int{bits} [in, out] + scale fp32 [out] (scanned stacks
+    [L, in, out] → scale [L, out])."""
+    if bits not in _QMAX:
+        raise ValueError(f"bits={bits} not in {sorted(_QMAX)}")
+    qmax = _QMAX[bits]
+    qdtype = _QDTYPE[bits]
+
+    from colossalai_tpu.shardformer.policies.base_policy import path_str
+
+    def visit(kp, leaf):
+        if not _should_quantize(path_str(kp), leaf):
+            return leaf
+        w = jnp.asarray(leaf, jnp.float32)
+        scale = jnp.max(jnp.abs(w), axis=-2) / qmax  # [.., out]
+        scale = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(jnp.round(w / scale[..., None, :]), -qmax, qmax).astype(qdtype)
+        return {"q": q, "scale": scale}
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def _dequant(node, dtype):
+    q, scale = node["q"], node["scale"]
+    return (q.astype(jnp.float32) * scale[..., None, :]).astype(dtype)
+
+
+def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Collapse every {"q", "scale"} node back to a dense kernel. Call
+    inside jit — XLA keeps the integer tensor in HBM and fuses the cast
+    into consumers. Identity for unquantized trees."""
+    return jax.tree.map(
+        lambda x: _dequant(x, dtype) if is_quantized_leaf(x) else x,
+        params, is_leaf=is_quantized_leaf,
+    )
+
+
+def quantized_param_specs(param_specs: Any, quant_shape: Any) -> Any:
+    """PartitionSpecs for a quantized base tree: q inherits the kernel's
+    spec; scale (per-out-channel) keeps the lead + out dims of that spec."""
+    from colossalai_tpu.peft.lora import _flat_by_path, _nest
+
+    spec_flat = _flat_by_path(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+
+    def spec_for(path: str, leaf):
+        if path.endswith("kernel/q"):
+            w = tuple(spec_flat.get(path[: -len("/q")], PartitionSpec()))
+            w = w + (None,) * (leaf.ndim - len(w))
+            return PartitionSpec(*w)
+        if path.endswith("kernel/scale"):
+            # kernel [lead..., in, out] → scale [lead..., out]
+            w = tuple(spec_flat.get(path[: -len("/scale")], PartitionSpec()))
+            w = w + (None,) * (leaf.ndim + 1 - len(w))
+            return PartitionSpec(*(w[: leaf.ndim - 1] + (w[leaf.ndim],)))
+        return spec_flat.get(path, PartitionSpec())
+
+    flat = _flat_by_path(quant_shape)
+    return _nest({p: spec_for(p, leaf) for p, leaf in flat.items()})
+
+
+def quantization_error_bound(bits: int) -> float:
+    """Max elementwise |W - deq(q)| relative to the channel max: half an
+    integer step."""
+    return 0.5 / _QMAX[bits]

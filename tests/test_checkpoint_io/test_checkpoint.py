@@ -132,3 +132,55 @@ def test_hf_interop_roundtrip():
     np.testing.assert_allclose(
         np.asarray(out_orig.logits), np.asarray(out_back.logits), atol=1e-6
     )
+
+
+def test_moe_checkpoint_ep_reshard_roundtrip(tmp_path):
+    """≙ reference MoECheckpointIO (moe_checkpoint.py:44): save a MoE run on
+    ep2·tp2, restore on ep4 AND on a single device — optimizer state
+    included — and continue training with identical trajectories. Under
+    GSPMD the ep gather/scatter is orbax restoring into each target's
+    sharded template; this test is the proof the reference needs 920 LoC
+    for."""
+    from colossalai_tpu.booster import DataParallelPlugin, MoeHybridParallelPlugin
+    from colossalai_tpu.models import MixtralConfig, MixtralForCausalLM
+
+    batch = {"input_ids": jnp.asarray(RNG.randint(0, 256, size=(8, 16)))}
+
+    def make(plugin, devices=None):
+        return Booster(plugin=plugin).boost(
+            MixtralForCausalLM(MixtralConfig.tiny()), optax.adamw(1e-3),
+            example_batch=batch, rng=jax.random.PRNGKey(0), devices=devices,
+        )
+
+    src = make(MoeHybridParallelPlugin(ep_size=2, tp_size=2, zero_stage=1,
+                                       precision="fp32"))
+    state, _ = src.train_step(src.state, src.shard_batch(batch))
+    io = CheckpointIO(async_save=False)
+    io.save_state(state, str(tmp_path / "moe_state"))
+    io.wait()
+    cont, cont_m = src.train_step(state, src.shard_batch(batch))
+    cont_leaf = np.asarray(jax.tree_util.tree_leaves(cont.params)[0])
+    cont_loss = float(cont_m["loss"])
+
+    def check(boosted):
+        restored = io.load_state(boosted.state, str(tmp_path / "moe_state"))
+        assert int(jax.device_get(restored.step)) == 1
+        # expert tensors and adam moments came through the reshard
+        experts = restored.params["layers"]["block"]["moe"]["experts_gate/kernel"]
+        assert experts.shape[1] == MixtralConfig.tiny().num_experts
+        assert len(jax.tree_util.tree_leaves(restored.opt_state)) == len(
+            jax.tree_util.tree_leaves(boosted.state.opt_state)
+        )
+        resumed, m = boosted.train_step(restored, boosted.shard_batch(batch))
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(resumed.params)[0]),
+            cont_leaf, rtol=2e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(float(m["loss"]), cont_loss, rtol=1e-4)
+
+    # ep4: experts split 4-ways instead of 2
+    check(make(MoeHybridParallelPlugin(ep_size=4, tp_size=1, zero_stage=1,
+                                       precision="fp32")))
+    # single device: everything gathered
+    check(make(DataParallelPlugin(precision="fp32"),
+               devices=jax.devices()[:1]))
