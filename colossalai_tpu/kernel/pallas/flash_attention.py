@@ -87,7 +87,9 @@ def _tile_mask(qi, ki, qpos_ref, kpos_ref, qseg_ref, kseg_ref, *, causal,
         if causal:
             mask = qp >= kp
         if window is not None:
-            w = (qp - kp) < window
+            # "last W keys": bound past AND future, matching xla_attention
+            # and the jnp ring fallback for non-causal windows
+            w = ((qp - kp) < window) & (qp >= kp)
             mask = w if mask is None else mask & w
     if qseg_ref is not None:
         seg = _q_col(qseg_ref) == _kv_row(kseg_ref)

@@ -75,7 +75,9 @@ def xla_attention(
     if sliding_window is not None:
         q_pos = jnp.arange(sq)[:, None] + q_offset
         kv_pos = jnp.arange(skv)[None, :]
-        win = (q_pos - kv_pos) < sliding_window
+        # "last W keys": bound the past AND the future, so window-only
+        # (non-causal) callers don't silently attend ahead
+        win = ((q_pos - kv_pos) < sliding_window) & (q_pos >= kv_pos)
         win = win[None, None, None]
         mask = win if mask is None else (mask & win)
     if segment_ids is not None:

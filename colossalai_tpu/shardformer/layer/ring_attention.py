@@ -71,7 +71,10 @@ def _attn_with_lse(q, k, v, q_pos, kv_pos, causal: bool, window=None,
     if causal:
         mask = q_pos[:, :, None] >= kv_pos[:, None, :]  # [b, sq, skv]
     if window is not None:
-        inside = (q_pos[:, :, None] - kv_pos[:, None, :]) < window
+        # "last W keys": also bound the future so the window-only
+        # (non-causal) case matches the docstring
+        diff = q_pos[:, :, None] - kv_pos[:, None, :]
+        inside = (diff < window) & (diff >= 0)
         mask = inside if mask is None else jnp.logical_and(mask, inside)
     if q_seg is not None:
         same = q_seg[:, :, None] == kv_seg[:, None, :]

@@ -71,3 +71,35 @@ def test_profiler_beta_fit_inverts_ring_slope():
     ab = _FakeProf(_FakeMesh()).profile("x")
     assert ab.beta == pytest.approx(1e-9, rel=1e-3)
     assert ab.alpha == pytest.approx(2e-6, rel=1e-2)
+
+
+def test_dcn_axes_classified_from_process_index():
+    """An axis crosses DCN iff process_index varies along it — computed
+    from the device array, not guessed from axis names (ADVICE r02)."""
+    import dataclasses
+
+    import numpy as np
+
+    from colossalai_tpu.device.alpha_beta import collective_costs, default_alpha_beta
+
+    @dataclasses.dataclass
+    class FakeDev:
+        process_index: int
+
+    # 2 hosts x 4 chips arranged (pp=2) x (tp=4): pp crosses hosts, tp local
+    devs = np.array([[FakeDev(0)] * 4, [FakeDev(1)] * 4])
+
+    @dataclasses.dataclass
+    class FakeMesh:
+        devices: object
+        axis_names: tuple
+        shape: dict
+
+    mesh = FakeMesh(devices=devs, axis_names=("pp", "tp"),
+                    shape={"pp": 2, "tp": 4})
+    costs = collective_costs(mesh, 1 << 20)
+    assert costs["pp"]["all_reduce"] == default_alpha_beta(dcn=True).all_reduce(1 << 20, 2)
+    assert costs["tp"]["all_reduce"] == default_alpha_beta().all_reduce(1 << 20, 4)
+    # explicit override still wins
+    forced = collective_costs(mesh, 1 << 20, dcn_axes=set())
+    assert forced["pp"]["all_reduce"] == default_alpha_beta().all_reduce(1 << 20, 2)
