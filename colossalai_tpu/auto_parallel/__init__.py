@@ -1,0 +1,21 @@
+"""Practical auto-parallelization: plan tp/sp/pp/zero from model + mesh + HBM.
+
+≙ reference ``auto_parallel/`` (15.8k LoC: strategy generators + ILP solver
+over an op graph). That solver is dormant in practice; what users need from
+it is the DECISION: "for this model on this many chips with this much HBM,
+which plugin config trains fastest without OOMing". This module answers
+exactly that by composing the three cost models the framework already has:
+
+- α-β collective costs per mesh axis (``device/alpha_beta.py``),
+- pipeline bubble/makespan simulation (``pipeline/schedule_sim.py``),
+- analytic per-device memory accounting (params/grads/optimizer/activations
+  under tp·sp·pp·zero sharding).
+
+``plan_parallelism`` enumerates mesh factorizations and returns ranked
+:class:`Plan` objects; ``Plan.to_plugin()`` yields the ready
+HybridParallelPlugin.
+"""
+
+from .advisor import MemoryBreakdown, Plan, plan_parallelism
+
+__all__ = ["Plan", "MemoryBreakdown", "plan_parallelism"]

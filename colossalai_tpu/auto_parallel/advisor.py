@@ -1,0 +1,222 @@
+"""The parallelism advisor: enumerate, cost, rank. See package docstring."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from colossalai_tpu.device.alpha_beta import AlphaBeta, default_alpha_beta
+from colossalai_tpu.pipeline.schedule_sim import ScheduleCosts, simulate
+
+_ADAM_STATE_FACTOR = 2  # m + v
+_MXU_EFFICIENCY = 0.5   # sustained fraction of peak for dense transformer steps
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """What the advisor needs to know about the model (derivable from any
+    of this repo's configs via :func:`ModelSpec.from_config`)."""
+
+    n_params: int
+    num_layers: int
+    hidden_size: int
+    vocab_size: int
+    #: bytes per param for compute weights (bf16=2)
+    param_bytes: int = 2
+    #: bytes per optimizer-state element (fp32 adam = 4)
+    opt_bytes: int = 4
+    #: full rematerialization (backward recomputes the forward)
+    remat: bool = True
+
+    @classmethod
+    def from_config(cls, cfg, n_params: Optional[int] = None, **kw) -> "ModelSpec":
+        if n_params is None:
+            # dense decoder estimate: embeddings + per-layer matmuls
+            h = cfg.hidden_size
+            inter = getattr(cfg, "intermediate_size", 4 * h)
+            kv = getattr(cfg, "num_key_value_heads", None) or cfg.num_attention_heads
+            head = h // cfg.num_attention_heads
+            attn = h * h + 2 * h * kv * head + h * h  # q, kv, o
+            mlp_mult = 3 if getattr(cfg, "glu", True) else 2
+            n_params = (
+                cfg.vocab_size * h * 2  # embed + lm head
+                + cfg.num_hidden_layers * (attn + mlp_mult * h * inter)
+            )
+        return cls(
+            n_params=n_params, num_layers=cfg.num_hidden_layers,
+            hidden_size=cfg.hidden_size, vocab_size=cfg.vocab_size, **kw,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBreakdown:
+    params: float
+    grads: float
+    opt_states: float
+    activations: float
+
+    @property
+    def total(self) -> float:
+        return self.params + self.grads + self.opt_states + self.activations
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    dp: int
+    tp: int
+    sp: int
+    pp: int
+    zero_stage: int
+    num_microbatches: int
+    memory: MemoryBreakdown
+    #: predicted step time, seconds (coarse — for RANKING, not reporting)
+    step_time_s: float
+    fits: bool
+    hbm_bytes: int
+
+    def describe(self) -> str:
+        m = self.memory
+        return (
+            f"dp{self.dp}·tp{self.tp}·sp{self.sp}·pp{self.pp} zero{self.zero_stage}"
+            f" (micro={self.num_microbatches}): "
+            f"{m.total / 2**30:.2f} GiB/device "
+            f"(P {m.params / 2**30:.2f} + G {m.grads / 2**30:.2f} + "
+            f"O {m.opt_states / 2**30:.2f} + A {m.activations / 2**30:.2f})"
+            f" — est step {self.step_time_s * 1e3:.0f} ms"
+            f" {'OK' if self.fits else 'OOM'}"
+        )
+
+    def to_plugin(self, precision: str = "bf16", **kw):
+        from colossalai_tpu.booster import HybridParallelPlugin
+
+        return HybridParallelPlugin(
+            tp_size=self.tp, sp_size=self.sp, pp_size=self.pp,
+            zero_stage=self.zero_stage, precision=precision,
+            num_microbatches=self.num_microbatches if self.pp > 1 else None,
+            sequence_parallel_mode="ring_attn" if self.sp > 1 else "none",
+            **kw,
+        )
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int, int]]:
+    """(dp, tp, sp, pp) with dp·tp·sp·pp == n, all powers dividing n."""
+    divs = [d for d in range(1, n + 1) if n % d == 0]
+    out = []
+    for tp in divs:
+        for sp in [d for d in divs if (n // tp) % d == 0]:
+            for pp in [d for d in divs if (n // tp // sp) % d == 0]:
+                out.append((n // tp // sp // pp, tp, sp, pp))
+    return out
+
+
+def _memory(spec: ModelSpec, dp, tp, sp, pp, zero, micro_tokens, inflight) -> MemoryBreakdown:
+    shard = tp * pp  # kernels over tp, layers over pp
+    params = spec.n_params * spec.param_bytes / shard
+    grads = spec.n_params * spec.param_bytes / shard
+    if zero >= 2:
+        grads /= dp
+    opt = spec.n_params * spec.opt_bytes * _ADAM_STATE_FACTOR / shard
+    if zero >= 1:
+        opt /= dp
+    # live activations: boundary tensors per layer (full remat keeps ~2
+    # hidden-vectors per layer per token; no remat ~16) × in-flight
+    # microbatches (pipeline stash) ÷ tp·sp sharding of the token dim
+    per_token_layer = (2 if spec.remat else 16) * spec.hidden_size * spec.param_bytes
+    acts = (
+        per_token_layer * (spec.num_layers / pp) * micro_tokens / (tp * sp)
+        * max(inflight, 1)
+    )
+    # logits buffer for the loss microbatch: tokens × vocab fp32 ÷ tp·sp
+    acts += micro_tokens * spec.vocab_size * 4 / (tp * sp)
+    return MemoryBreakdown(params, grads, opt, acts)
+
+
+def _step_time(
+    spec: ModelSpec, dp, tp, sp, pp, zero, global_tokens, n_micro,
+    peak_flops: float, ab_ici: AlphaBeta, ab_dcn: Optional[AlphaBeta],
+) -> float:
+    n_dev = dp * tp * sp * pp
+    # compute: 6·N flops/token (+ remat recompute ≈ +2N)
+    flops = (8.0 if spec.remat else 6.0) * spec.n_params * global_tokens
+    compute = flops / (n_dev * peak_flops * _MXU_EFFICIENCY)
+    if pp > 1:
+        rep = simulate(pp, n_micro, "zb", 1, ScheduleCosts(t_comm=0.02))
+        compute /= max(1e-9, 1.0 - rep.bubble_fraction)
+    # tp: ~4 collectives/layer (fwd+bwd) over the activation shard
+    comm = 0.0
+    micro_tokens = global_tokens / max(dp * n_micro, 1)
+    if tp > 1:
+        act_bytes = micro_tokens / sp * spec.hidden_size * spec.param_bytes
+        comm += 4 * spec.num_layers * n_micro * ab_ici.all_reduce(act_bytes, tp)
+    if sp > 1:
+        act_bytes = micro_tokens / sp * spec.hidden_size * spec.param_bytes
+        comm += 2 * spec.num_layers * n_micro * ab_ici.all_gather(act_bytes, sp)
+    if dp > 1:
+        grad_bytes = spec.n_params * spec.param_bytes / (tp * pp)
+        ab = ab_dcn or ab_ici
+        sync = (
+            ab.reduce_scatter(grad_bytes, dp) if zero >= 1
+            else ab.all_reduce(grad_bytes, dp)
+        )
+        comm += 0.5 * sync  # largely overlapped with the backward
+    return compute + comm
+
+
+def plan_parallelism(
+    config_or_spec,
+    n_devices: int,
+    hbm_bytes: int,
+    global_batch: int,
+    seq_len: int,
+    peak_flops: float = 197e12,
+    n_params: Optional[int] = None,
+    zero_stages: Tuple[int, ...] = (0, 1, 2),
+    multi_host_dp: bool = False,
+    top_k: int = 5,
+) -> List[Plan]:
+    """Ranked plans: every mesh factorization × zero stage, costed for
+    memory and step time; fitting plans first (by predicted step time),
+    then non-fitting ones (by memory headroom deficit).
+
+    ``multi_host_dp``: cost the dp gradient sync at DCN rates (dp crosses
+    hosts — the standard pod layout).
+    """
+    spec = (
+        config_or_spec if isinstance(config_or_spec, ModelSpec)
+        else ModelSpec.from_config(config_or_spec, n_params=n_params)
+    )
+    ab_ici = default_alpha_beta()
+    ab_dcn = default_alpha_beta(dcn=True) if multi_host_dp else None
+    global_tokens = global_batch * seq_len
+
+    plans: List[Plan] = []
+    for dp, tp, sp, pp in _factorizations(n_devices):
+        if global_batch % dp or spec.num_layers % pp:
+            continue
+        if tp > spec.hidden_size or sp > seq_len:
+            continue
+        n_micro = max(2 * pp, 1) if pp > 1 else 1
+        if pp > 1 and (global_batch // dp) % n_micro:
+            continue
+        micro_tokens = global_tokens / dp / n_micro
+        inflight = min(n_micro, pp) if pp > 1 else 1
+        for zero in zero_stages:
+            if zero >= 1 and dp == 1:
+                continue  # nothing to shard
+            mem = _memory(spec, dp, tp, sp, pp, zero, micro_tokens, inflight)
+            t = _step_time(
+                spec, dp, tp, sp, pp, zero, global_tokens, n_micro,
+                peak_flops, ab_ici, ab_dcn,
+            )
+            plans.append(Plan(
+                dp=dp, tp=tp, sp=sp, pp=pp, zero_stage=zero,
+                num_microbatches=n_micro, memory=mem, step_time_s=t,
+                fits=mem.total <= 0.9 * hbm_bytes, hbm_bytes=hbm_bytes,
+            ))
+
+    plans.sort(key=lambda p: (
+        not p.fits,
+        p.step_time_s if p.fits else p.memory.total,
+        p.memory.total,  # tie-break equal step times toward headroom
+    ))
+    return plans[:top_k]

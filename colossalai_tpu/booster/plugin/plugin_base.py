@@ -72,6 +72,24 @@ class Boosted:
         overlap host→device transfer ahead of the step."""
         return _place_batch(self.mesh, batch)
 
+    def memory_stats(self, example_batch: Dict[str, Any]) -> Dict[str, int]:
+        """Compiled-train-step memory report from XLA's analysis (≙ the
+        reference Gemini memory tracer's chunk report): bytes for
+        arguments / temps / output and the device peak."""
+        ma = _lowered_memory_analysis(
+            self.train_step, self.mesh, self.state, example_batch
+        )
+        if ma is None:
+            raise RuntimeError(
+                "this backend does not report compiled memory statistics"
+            )
+        return {
+            "argument_bytes": ma.argument_size_in_bytes,
+            "temp_bytes": ma.temp_size_in_bytes,
+            "output_bytes": ma.output_size_in_bytes,
+            "peak_bytes": ma.peak_memory_in_bytes,
+        }
+
 
 class Plugin(abc.ABC):
     """Capability flags ≙ reference Plugin (control_precision etc. collapse
@@ -210,47 +228,7 @@ class Plugin(abc.ABC):
                 all_shapes, param_specs, opt_state_shape, opt_specs, mesh
             )
 
-        opt_memory_kind = None
-        if offload_optim:
-            # host-offloaded optimizer states (≙ HybridAdam/Gemini offload):
-            # states live in pinned host memory; XLA streams them through the
-            # update. Probe with a real jitted transfer — some backends accept
-            # the sharding but cannot compile host-memory placement.
-            try:
-                host = NamedSharding(mesh.mesh, PartitionSpec(), memory_kind="pinned_host")
-                probe = jax.jit(lambda: jnp.zeros((8,)), out_shardings=host)
-                jax.device_get(probe())
-                opt_memory_kind = "pinned_host"
-            except Exception:
-                from colossalai_tpu.logging import get_dist_logger
-
-                get_dist_logger().warning(
-                    "offload_optim requested but this runtime cannot place "
-                    "arrays in pinned host memory; optimizer states stay in "
-                    "device memory"
-                )
-        opt_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh.mesh, s, memory_kind=opt_memory_kind),
-            opt_specs,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
-        opt_shardings_device = None
-        if opt_memory_kind:
-            # device-resident twin layout: the train step streams host states
-            # through these before the update and back out via out_shardings
-            opt_shardings_device = jax.tree.map(
-                lambda s: s.with_memory_kind("device"), opt_shardings,
-                is_leaf=lambda x: isinstance(x, NamedSharding),
-            )
-
         scaler = init_grad_scaler() if self.precision == "fp16" else None
-        replicated = NamedSharding(mesh.mesh, PartitionSpec())
-        state_shardings = TrainState(
-            step=replicated,
-            params=param_shardings,
-            opt_state=opt_shardings,
-            scaler=None if scaler is None else jax.tree.map(lambda _: replicated, scaler),
-        )
 
         # ---- materialize state directly into its sharded layout
         # (≙ LazyInitContext + sharder materialize: params are never built
@@ -281,22 +259,89 @@ class Plugin(abc.ABC):
                 scaler=scaler,
             )
 
-        with use_mesh(mesh):
-            state = jax.jit(_init_state, out_shardings=state_shardings)(rng)
+        def _assemble(with_offload: bool):
+            """Shardings + state + compiled steps for one placement choice.
+            Called once normally; a second time when the compiled-memory
+            check flips the auto placement to host offload."""
+            opt_memory_kind = None
+            if with_offload:
+                # host-offloaded optimizer states (≙ HybridAdam/Gemini
+                # offload): states live in pinned host memory; XLA streams
+                # them through the update.
+                if _pinned_host_available(mesh):
+                    opt_memory_kind = "pinned_host"
+                else:
+                    from colossalai_tpu.logging import get_dist_logger
 
-        grad_shardings = None
-        if self.zero_stage >= 2 and not self.fsdp:
-            grad_specs = tree_add_data_axis(train_specs, train_shape, mesh)
-            grad_shardings = jax.tree.map(
-                lambda s: NamedSharding(mesh.mesh, s), grad_specs,
+                    get_dist_logger().warning(
+                        "offload_optim requested but this runtime cannot "
+                        "place arrays in pinned host memory; optimizer "
+                        "states stay in device memory"
+                    )
+            opt_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh.mesh, s, memory_kind=opt_memory_kind),
+                opt_specs,
                 is_leaf=lambda x: isinstance(x, PartitionSpec),
             )
+            opt_shardings_device = None
+            if opt_memory_kind:
+                # device-resident twin layout: the train step streams host
+                # states through these before the update and back out
+                opt_shardings_device = jax.tree.map(
+                    lambda s: s.with_memory_kind("device"), opt_shardings,
+                    is_leaf=lambda x: isinstance(x, NamedSharding),
+                )
+            replicated = NamedSharding(mesh.mesh, PartitionSpec())
+            state_shardings = TrainState(
+                step=replicated,
+                params=param_shardings,
+                opt_state=opt_shardings,
+                scaler=None if scaler is None else jax.tree.map(lambda _: replicated, scaler),
+            )
+            with use_mesh(mesh):
+                state = jax.jit(_init_state, out_shardings=state_shardings)(rng)
+            grad_shardings = None
+            if self.zero_stage >= 2 and not self.fsdp:
+                grad_specs = tree_add_data_axis(train_specs, train_shape, mesh)
+                grad_shardings = jax.tree.map(
+                    lambda s: NamedSharding(mesh.mesh, s), grad_specs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec),
+                )
+            train_step = self._build_train_step(
+                model, optimizer, loss_fn, mesh, state_shardings, grad_shardings,
+                opt_shardings_device, lora_cfg=lora,
+            )
+            eval_step = self._build_eval_step(
+                model, loss_fn, mesh, state_shardings, lora_cfg=lora
+            )
+            return state, state_shardings, train_step, eval_step
 
-        train_step = self._build_train_step(
-            model, optimizer, loss_fn, mesh, state_shardings, grad_shardings,
-            opt_shardings_device, lora_cfg=lora,
-        )
-        eval_step = self._build_eval_step(model, loss_fn, mesh, state_shardings, lora_cfg=lora)
+        state, state_shardings, train_step, eval_step = _assemble(offload_optim)
+
+        if getattr(self, "placement_policy", "static") == "auto" and not offload_optim:
+            # ≙ the Gemini warmup memory tracer, the XLA way: the static
+            # estimate above never sees activation/temp peaks, but the
+            # compiled executable's memory analysis does. AOT-compile the
+            # train step (reused by the first real step — no extra cost on
+            # the happy path) and flip to host offload when the true peak
+            # would not fit.
+            peak = _compiled_peak_bytes(train_step, mesh, state, example_batch)
+            from colossalai_tpu.accelerator import get_accelerator
+
+            hbm = get_accelerator().hbm_bytes_per_device()
+            if peak and hbm and peak > 0.95 * hbm and _pinned_host_available(mesh):
+                from colossalai_tpu.logging import get_dist_logger
+
+                get_dist_logger().info(
+                    f"auto placement: compiled peak {peak / 1e9:.2f} GB "
+                    f"exceeds {hbm / 1e9:.1f} GB HBM -> retrying with host-"
+                    "offloaded optimizer states"
+                )
+                # free the first materialized state BEFORE the second init —
+                # holding both would double resident params exactly when
+                # memory is tight
+                state = train_step = eval_step = state_shardings = None
+                state, state_shardings, train_step, eval_step = _assemble(True)
 
         return Boosted(
             state=state,
@@ -472,6 +517,37 @@ def _sharded_bytes(shapes, specs, mesh_shape) -> int:
                     div *= mesh_shape.get(ax, 1)
         total += nbytes // max(div, 1)
     return total
+
+
+def _lowered_memory_analysis(train_step, mesh, state, example_batch):
+    """AOT lower + compile the train step against the real placed operands
+    (the executable is cached for the first actual step) and return XLA's
+    memory analysis, or None when the backend doesn't report stats.
+    MUST trace under the ambient mesh — ``constrain()`` hints silently
+    no-op without it and the poisoned trace would be reused by training."""
+    try:
+        batch = _place_batch(mesh, example_batch)
+        with use_mesh(mesh):
+            ma = train_step._jitted.lower(state, batch).compile().memory_analysis()
+        return ma if hasattr(ma, "peak_memory_in_bytes") else None
+    except Exception:
+        return None
+
+
+def _pinned_host_available(mesh) -> bool:
+    """Can this runtime compile pinned-host placements? (Some backends
+    accept the sharding but fail at compile.)"""
+    try:
+        host = NamedSharding(mesh.mesh, PartitionSpec(), memory_kind="pinned_host")
+        jax.device_get(jax.jit(lambda: jnp.zeros((8,)), out_shardings=host)())
+        return True
+    except Exception:
+        return False
+
+
+def _compiled_peak_bytes(train_step, mesh, state, example_batch):
+    ma = _lowered_memory_analysis(train_step, mesh, state, example_batch)
+    return None if ma is None else ma.peak_memory_in_bytes
 
 
 def _auto_offload_decision(params_shape, param_specs, opt_state_shape, opt_specs, mesh) -> bool:

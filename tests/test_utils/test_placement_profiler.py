@@ -74,3 +74,63 @@ def test_profiler_trace_writes_files(tmp_path):
         float(x.sum())
     produced = glob.glob(os.path.join(str(tmp_path), "**", "*"), recursive=True)
     assert any(os.path.isfile(f) for f in produced), produced
+
+
+def test_memory_stats_surface():
+    """Boosted.memory_stats: compiled-executable memory report (≙ the
+    Gemini memory tracer's chunk report, the XLA way)."""
+    cfg = LlamaConfig.tiny()
+    batch = {"input_ids": jnp.ones((8, 16), jnp.int32)}
+    boosted = Booster(plugin=GeminiPlugin(precision="fp32")).boost(
+        LlamaForCausalLM(cfg), optax.adamw(1e-3),
+        example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    stats = boosted.memory_stats(batch)
+    assert stats["peak_bytes"] > 0
+    assert stats["argument_bytes"] > 0
+    # the report accounts at least the resident fp32 params
+    n_params = sum(x.size for x in jax.tree.leaves(boosted.state.params))
+    assert stats["peak_bytes"] >= n_params * 4 / 8  # sharded over 8 devices
+
+
+def test_compiled_peak_refines_auto_placement(monkeypatch, caplog):
+    """The static estimate can pass while the COMPILED peak (activations +
+    temps) exceeds HBM — the refinement must flip to host offload. The CPU
+    backend under-reports temp peaks, so the peak is stubbed; the flip is
+    observed via the retry log message (the dist logger doesn't propagate,
+    so the getter is spied directly)."""
+    import colossalai_tpu.logging as clt_logging
+    from colossalai_tpu.accelerator import api
+    from colossalai_tpu.booster.plugin import plugin_base
+
+    cfg = LlamaConfig.tiny()
+    batch = {"input_ids": jnp.ones((8, 16), jnp.int32)}
+
+    messages = []
+
+    class SpyLogger:
+        def info(self, msg, *a, **k):
+            messages.append(str(msg))
+
+        warning = error = debug = info
+
+    monkeypatch.setattr(
+        type(api.get_accelerator()), "hbm_bytes_per_device",
+        lambda self: 16 * 1024**3,  # static 60% check passes comfortably
+    )
+    monkeypatch.setattr(
+        plugin_base, "_compiled_peak_bytes", lambda *a, **k: 32 * 1024**3
+    )
+    # CPU has no pinned-host memory space; answer True for the retry-gate
+    # probe so the rebuild runs, then False inside _assemble(True) so it
+    # takes its documented device-fallback path instead of a CPU crash
+    probes = iter([True, False])
+    monkeypatch.setattr(
+        plugin_base, "_pinned_host_available", lambda mesh: next(probes, False)
+    )
+    monkeypatch.setattr(clt_logging, "get_dist_logger", lambda *a, **k: SpyLogger())
+    Booster(plugin=GeminiPlugin(placement_policy="auto", precision="fp32")).boost(
+        LlamaForCausalLM(cfg), optax.adamw(1e-3),
+        example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    assert any("compiled peak" in m and "retrying" in m for m in messages), messages
