@@ -411,6 +411,32 @@ _WHISPER = FamilySpec(
     ignore_hf=("model.encoder.embed_positions.weight",),
 )
 
+_QWEN2_MOE = _spec(
+    "layers",
+    _LLAMA_TOP,
+    [
+        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.gate.weight", "moe.router/kernel", "linear"),
+        ("model.layers.{i}.mlp.experts.{e}.gate_proj.weight", "moe.experts_gate/kernel", "experts"),
+        ("model.layers.{i}.mlp.experts.{e}.up_proj.weight", "moe.experts_up/kernel", "experts"),
+        ("model.layers.{i}.mlp.experts.{e}.down_proj.weight", "moe.experts_down/kernel", "experts"),
+        ("model.layers.{i}.mlp.shared_expert.gate_proj.weight", "moe.shared_expert.gate_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.shared_expert.up_proj.weight", "moe.shared_expert.up_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.shared_expert.down_proj.weight", "moe.shared_expert.down_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.shared_expert_gate.weight", "moe.shared_expert_gate/kernel", "linear"),
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
 HF_SPECS: Dict[str, FamilySpec] = {
     "llama": _LLAMA,
     "mistral": _LLAMA,
@@ -420,6 +446,7 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "gemma2": _GEMMA2,
     "gpt2": _GPT2,
     "mixtral": _MIXTRAL,
+    "qwen2_moe": _QWEN2_MOE,
     "deepseek": _DEEPSEEK,
     "opt": _OPT,
     "bloom": _BLOOM,

@@ -44,7 +44,7 @@ from .families import (
 )
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM, MistralConfig, Qwen2Config
-from .mixtral import MixtralConfig, MixtralForCausalLM
+from .mixtral import MixtralConfig, MixtralForCausalLM, Qwen2MoeConfig, Qwen2MoeForCausalLM
 from .heads import QuestionAnswering, SequenceClassifier, TokenClassifier
 from .reward import RewardModel, reward_at_last_token
 from .t5 import Seq2SeqOutput, T5Config, T5EncoderModel, T5ForConditionalGeneration, shift_right
@@ -106,6 +106,8 @@ __all__ = [
     "MistralConfig",
     "Qwen2Config",
     "MixtralConfig",
+    "Qwen2MoeConfig",
+    "Qwen2MoeForCausalLM",
     "MixtralForCausalLM",
     "BertConfig",
     "BertModel",

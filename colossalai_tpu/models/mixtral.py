@@ -47,6 +47,10 @@ class MixtralConfig(LlamaConfig):
     aux_loss_coef: float = 0.01
     router_z_coef: float = 0.001
     n_shared_experts: int = 0  # DeepSeek-MoE style always-on experts
+    #: explicit shared-expert FFN width (None = moe_i * n_shared_experts)
+    shared_expert_intermediate_size: "int | None" = None
+    #: Qwen2-MoE: learned sigmoid gate scaling the shared-expert output
+    shared_expert_gate: bool = False
     #: "einsum": [N,E,C] dispatch tensors — GSPMD turns them into ep
     #: all-to-alls (the EP path). "sort": argsort+scatter bookkeeping,
     #: O(N·k) instead of O(N·E·C) — the large-E path (≙ moe_kernel.cu's
@@ -63,18 +67,6 @@ class MixtralConfig(LlamaConfig):
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
             max_position_embeddings=32768, rope_theta=1e6,
             num_experts=8, num_experts_per_tok=2, **kw,
-        )
-
-    @classmethod
-    def qwen2_moe_a14b(cls, **kw) -> "MixtralConfig":
-        """Qwen2-MoE-57B-A14B (≙ policies/qwen2.py MoE entries): many narrow
-        experts + a shared expert, k=8."""
-        return cls(
-            vocab_size=151936, hidden_size=3584, intermediate_size=18944,
-            num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
-            max_position_embeddings=32768, rope_theta=1e6,
-            num_experts=64, num_experts_per_tok=8,
-            moe_intermediate_size=2560, n_shared_experts=8, **kw,
         )
 
     @classmethod
@@ -180,10 +172,17 @@ class MoEMLP(nn.Module):
             y = y * jnp.asarray(scale, y.dtype)
 
         if cfg.n_shared_experts > 0:
-            shared_cfg = dataclasses.replace(
-                cfg, intermediate_size=moe_i * cfg.n_shared_experts
-            )
-            y = y + LlamaMLP(shared_cfg, name="shared_expert")(x)
+            shared_i = cfg.shared_expert_intermediate_size or moe_i * cfg.n_shared_experts
+            shared_cfg = dataclasses.replace(cfg, intermediate_size=shared_i)
+            shared_out = LlamaMLP(shared_cfg, name="shared_expert")(x)
+            if cfg.shared_expert_gate:
+                # Qwen2-MoE: scalar sigmoid gate per token on the shared path
+                gate_w = self.param(
+                    "shared_expert_gate/kernel", nn.initializers.lecun_normal(),
+                    (h, 1), pdtype,
+                )
+                shared_out = jax.nn.sigmoid(x @ gate_w.astype(dtype)) * shared_out
+            y = y + shared_out
 
         aux = cfg.aux_loss_coef * jnp.mean(routing.aux_loss) + cfg.router_z_coef * jnp.mean(
             routing.router_z_loss
@@ -245,3 +244,39 @@ class MixtralForCausalLM(nn.Module):
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits, hidden_states=x, aux_loss=aux_total)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class Qwen2MoeConfig(MixtralConfig):
+    """Qwen2-MoE / Qwen1.5-MoE (≙ policies/qwen2_moe): qwen2 attention
+    (qkv biases), narrow routed experts WITHOUT top-k renormalization, and
+    a sigmoid-gated always-on shared expert."""
+
+    attention_bias: bool = True
+    norm_topk_prob: bool = False
+    rope_theta: float = 10000.0  # HF Qwen2MoeConfig default (not Mixtral 1e6)
+    n_shared_experts: int = 1
+    shared_expert_gate: bool = True
+
+    @classmethod
+    def tiny(cls, **kw) -> "Qwen2MoeConfig":
+        kw.setdefault("moe_intermediate_size", 96)
+        kw.setdefault("shared_expert_intermediate_size", 160)
+        return super().tiny(**kw)
+
+    @classmethod
+    def qwen2_moe_a14b(cls, **kw) -> "Qwen2MoeConfig":
+        """Qwen2-MoE-57B-A14B (≙ policies/qwen2.py MoE entries): many
+        narrow experts + a sigmoid-gated shared expert, k=8."""
+        return cls(
+            vocab_size=151936, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=64, num_experts_per_tok=8,
+            moe_intermediate_size=2560,
+            shared_expert_intermediate_size=20480, **kw,
+        )
+
+
+class Qwen2MoeForCausalLM(MixtralForCausalLM):
+    pass

@@ -288,3 +288,15 @@ def test_strict_rejects_unconsumed_keys():
     hf_to_params(hf, "llama", cfg.num_hidden_layers)  # lenient: fine
     with pytest.raises(ValueError, match="not consumed"):
         hf_to_params(hf, "llama", cfg.num_hidden_layers, strict=True)
+
+
+def test_qwen2_moe_roundtrip():
+    from colossalai_tpu.models import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    cfg = Qwen2MoeConfig.tiny()
+    hf = _roundtrip("qwen2_moe", Qwen2MoeForCausalLM(cfg), cfg,
+                    num_experts=cfg.num_experts)
+    assert "model.layers.0.mlp.shared_expert_gate.weight" in hf
+    assert hf["model.layers.0.mlp.shared_expert_gate.weight"].shape == (1, cfg.hidden_size)
+    assert "model.layers.1.mlp.experts.3.up_proj.weight" in hf
+    assert "model.layers.0.self_attn.q_proj.bias" in hf

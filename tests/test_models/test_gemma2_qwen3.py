@@ -99,9 +99,12 @@ def test_gemma2_alternating_window_masks_only_local_layers():
 
 
 def test_qwen_moe_presets_build():
+    from colossalai_tpu.models import Qwen2MoeConfig
+
     # full-size presets construct (shapes resolved at dataclass level)
-    big = MixtralConfig.qwen2_moe_a14b()
-    assert big.n_shared_experts == 8 and big.moe_intermediate_size == 2560
+    big = Qwen2MoeConfig.qwen2_moe_a14b()
+    assert big.shared_expert_gate and big.moe_intermediate_size == 2560
+    assert big.shared_expert_intermediate_size == 20480
     assert MixtralConfig.qwen3_moe_a3b().num_experts == 128
     # tiny qwen-moe-shaped config trains the same narrow+shared layout
     cfg = MixtralConfig.tiny(

@@ -390,3 +390,36 @@ def test_deepseek_matches_hf():
         theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
     ours = _our_logits_unsharded(DeepseekV2ForCausalLM(cfg), params, ids)
     _assert_close(ours, theirs, "deepseek logits vs HF torch")
+
+
+def test_qwen2_moe_matches_hf():
+    from colossalai_tpu.models import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    cfg = dataclasses.replace(Qwen2MoeConfig.tiny(), capacity_factor=8.0)
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        moe_intermediate_size=cfg.moe_intermediate_size,
+        shared_expert_intermediate_size=cfg.shared_expert_intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        num_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        norm_topk_prob=False, decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, tie_word_embeddings=False,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        attn_implementation="eager", router_aux_loss_coef=0.0,
+    )
+    torch.manual_seed(12)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg)
+    hf.eval()
+    params = hf_to_params(
+        _hf_state(hf), "qwen2_moe", cfg.num_hidden_layers,
+        num_experts=cfg.num_experts,
+    )
+    ids = _ids(cfg.vocab_size)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
+    ours = _our_logits_unsharded(Qwen2MoeForCausalLM(cfg), params, ids)
+    _assert_close(ours, theirs, "qwen2_moe logits vs HF torch")
