@@ -151,6 +151,50 @@ def measure_decode(cfg, bs: int = 8, prompt_len: int = 128, steps: int = 24):
     return round(n_tokens / dt, 1)
 
 
+def measure_moe(n_dev: int, steps: int = 5):
+    """MoE pretraining throughput: a ~0.8B-active mixtral-shaped model
+    (tokens/s/device — MoE MFU accounting is convention-laden, so the raw
+    rate is the published number)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from colossalai_tpu.booster import Booster, MoeHybridParallelPlugin
+    from colossalai_tpu.models import MixtralConfig, MixtralForCausalLM
+
+    cfg = MixtralConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=4,
+        num_experts=8, num_experts_per_tok=2, max_position_embeddings=4096,
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, remat=True,
+    )
+    bs, seq = 4, 4096
+    batch = {
+        "input_ids": jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, size=(bs * max(n_dev, 1), seq))
+        )
+    }
+    ep = 2 if n_dev % 2 == 0 else 1
+    boosted = Booster(
+        plugin=MoeHybridParallelPlugin(ep_size=ep, zero_stage=1 if n_dev > 1 else 0,
+                                       precision="bf16")
+    ).boost(
+        MixtralForCausalLM(cfg), optax.adamw(3e-4),
+        example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    state = boosted.state
+    sharded = boosted.shard_batch(batch)
+    state, m = boosted.train_step(state, sharded)
+    float(m["loss"])  # sync (block_until_ready is a no-op on axon)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = boosted.train_step(state, sharded)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    return round(batch["input_ids"].size / dt / max(n_dev, 1), 1)
+
+
 def child_main():
     import jax
 
@@ -176,6 +220,10 @@ def child_main():
         extras["decode_tokens_per_s_bs8"] = measure_decode(model_for(hbm, 1024))
     except Exception as e:
         print(f"decode bench failed: {e}", file=sys.stderr)
+    try:
+        extras["moe_tokens_per_s_per_device"] = measure_moe(n_dev, steps=5)
+    except Exception as e:
+        print(f"moe bench failed: {e}", file=sys.stderr)
 
     result = {
         "metric": f"llama_{primary['n_params_b']}B_pretrain_mfu_bs{bs}_seq{seq}",

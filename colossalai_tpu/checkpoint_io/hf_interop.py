@@ -437,6 +437,17 @@ _QWEN2_MOE = _spec(
     vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
+_DEEPSEEK_V3 = dataclasses.replace(
+    _DEEPSEEK,
+    stacks={
+        "dense_layers": _DEEPSEEK.stacks["dense_layers"],
+        "layers": StackSpec(_DEEPSEEK.stacks["layers"].entries + (
+            ("model.layers.{i}.mlp.gate.e_score_correction_bias",
+             "moe.router/e_score_correction_bias", "raw"),
+        )),
+    },
+)
+
 HF_SPECS: Dict[str, FamilySpec] = {
     "llama": _LLAMA,
     "mistral": _LLAMA,
@@ -448,6 +459,7 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "mixtral": _MIXTRAL,
     "qwen2_moe": _QWEN2_MOE,
     "deepseek": _DEEPSEEK,
+    "deepseek_v3": _DEEPSEEK_V3,
     "opt": _OPT,
     "bloom": _BLOOM,
     "falcon": _FALCON,

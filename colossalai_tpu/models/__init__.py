@@ -6,7 +6,7 @@
 
 from .base import CausalLMOutput, ModelConfig
 from .bert import BertConfig, BertModel, BertOutput
-from .deepseek import DeepseekV2Config, DeepseekV2ForCausalLM
+from .deepseek import DeepseekV2Config, DeepseekV2ForCausalLM, DeepseekV3Config, DeepseekV3ForCausalLM
 from .families import (
     GPTBigCodeConfig,
     GPTBigCodeForCausalLM,
@@ -155,6 +155,8 @@ __all__ = [
     "WhisperForAudioClassification",
     "WhisperForConditionalGeneration",
     "DeepseekV2Config",
+    "DeepseekV3Config",
+    "DeepseekV3ForCausalLM",
     "DeepseekV2ForCausalLM",
     "StableLmConfig",
     "StableLmForCausalLM",

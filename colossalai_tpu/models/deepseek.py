@@ -232,3 +232,43 @@ class DeepseekV2ForCausalLM(nn.Module):
         logits = constrain(logits, ("dp", "ep"), "sp", "tp")
         logits = mask_padded_logits(logits, cfg.vocab_size)
         return CausalLMOutput(logits=logits, hidden_states=x, aux_loss=aux_total)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class DeepseekV3Config(DeepseekV2Config):
+    """DeepSeek-V3/R1 (≙ reference DeepseekV3ForCausalLMPolicy): V2's MLA
+    attention plus "noaux_tc" routing — sigmoid expert scores, a learned
+    e_score_correction_bias steering expert SELECTION only, group-limited
+    top-k, renormalized selected gates, and a routed scaling factor."""
+
+    scoring_func: str = "sigmoid"
+    use_score_correction_bias: bool = True
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 2.5
+    n_group: int = 8
+    topk_group: int = 4
+    q_lora_rank: Optional[int] = 1536
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("n_group", 2)
+        kw.setdefault("topk_group", 1)
+        kw.setdefault("q_lora_rank", 16)
+        return super().tiny(**kw)
+
+    @classmethod
+    def deepseek_v3(cls, **kw):
+        return cls(
+            vocab_size=129280, hidden_size=7168, intermediate_size=18432,
+            num_hidden_layers=61, num_attention_heads=128, num_key_value_heads=128,
+            q_lora_rank=1536, kv_lora_rank=512, qk_nope_head_dim=128,
+            qk_rope_head_dim=64, v_head_dim=128,
+            num_experts=256, num_experts_per_tok=8, n_shared_experts=1,
+            moe_intermediate_size=2048, first_k_dense_replace=3,
+            n_group=8, topk_group=4, routed_scaling_factor=2.5,
+            max_position_embeddings=163840, router_impl="sort", **kw,
+        )
+
+
+class DeepseekV3ForCausalLM(DeepseekV2ForCausalLM):
+    pass

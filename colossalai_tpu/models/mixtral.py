@@ -51,6 +51,17 @@ class MixtralConfig(LlamaConfig):
     shared_expert_intermediate_size: "int | None" = None
     #: Qwen2-MoE: learned sigmoid gate scaling the shared-expert output
     shared_expert_gate: bool = False
+    #: router scoring: "softmax" (mixtral/v2) | "sigmoid" (DeepSeek-V3)
+    scoring_func: str = "softmax"
+    #: DeepSeek-V3 noaux_tc: e_score_correction_bias steers expert
+    #: SELECTION (not weights). Gradient-free by construction — faithful
+    #: for checkpoints/inference; its online update rule is not wired into
+    #: the train step (balancing there uses the aux loss)
+    use_score_correction_bias: bool = False
+    #: group-limited routing (V3: experts in n_group groups, only the
+    #: topk_group best groups eligible); 1 = off
+    n_group: int = 1
+    topk_group: int = 1
     #: "einsum": [N,E,C] dispatch tensors — GSPMD turns them into ep
     #: all-to-alls (the EP path). "sort": argsort+scatter bookkeeping,
     #: O(N·k) instead of O(N·E·C) — the large-E path (≙ moe_kernel.cu's
@@ -119,6 +130,17 @@ class MoEMLP(nn.Module):
         router_w = self.param(
             "router/kernel", nn.initializers.lecun_normal(), (h, e), pdtype
         )
+        gate_kw = {}
+        if cfg.scoring_func != "softmax" or cfg.n_group > 1:
+            gate_kw = dict(
+                scoring=cfg.scoring_func, n_group=cfg.n_group,
+                topk_group=cfg.topk_group,
+            )
+        if cfg.use_score_correction_bias:
+            gate_kw["selection_bias"] = self.param(
+                "router/e_score_correction_bias", nn.initializers.zeros, (e,),
+                jnp.float32,
+            )
         xg = x.reshape(n_groups, g, h)
         logits = (xg @ router_w.astype(dtype)).astype(jnp.float32)  # [G, g, E]
 
@@ -141,7 +163,8 @@ class MoEMLP(nn.Module):
         if cfg.router_impl == "sort":
             routing = jax.vmap(
                 lambda lg: top_k_routing_sorted(
-                    lg, cfg.num_experts_per_tok, cap, cfg.norm_topk_prob
+                    lg, cfg.num_experts_per_tok, cap, cfg.norm_topk_prob,
+                    **gate_kw,
                 )
             )(logits)
             expert_in = jax.vmap(lambda xi, ri: dispatch_sorted(xi, ri, e, cap))(
@@ -156,7 +179,8 @@ class MoEMLP(nn.Module):
         else:
             routing = jax.vmap(
                 lambda lg: top_k_routing(
-                    lg, cfg.num_experts_per_tok, cap, cfg.norm_topk_prob
+                    lg, cfg.num_experts_per_tok, cap, cfg.norm_topk_prob,
+                    **gate_kw,
                 )
             )(logits)
             # dispatch: [G,g,E,C] x [G,g,H] -> [G,E,C,H]  (GSPMD: all-to-all over ep)

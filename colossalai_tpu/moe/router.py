@@ -25,13 +25,46 @@ class RoutingResult(NamedTuple):
     router_z_loss: jax.Array  # logit magnitude regularizer
 
 
-def _topk_gates(router_logits: jax.Array, num_selected: int, norm_topk: bool = True):
-    """(probs [N,E], gate_vals [N,k], expert_idx [N,k]) — shared prologue:
-    softmax + top-k. ``norm_topk`` renormalizes the selected gates to sum
-    to 1 (mixtral convention / HF norm_topk_prob=True); DeepSeek-V2 keeps
-    the raw softmax mass (norm_topk_prob=False)."""
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)
+def _topk_gates(
+    router_logits: jax.Array,
+    num_selected: int,
+    norm_topk: bool = True,
+    scoring: str = "softmax",
+    selection_bias: jax.Array = None,  # [E] e_score_correction_bias
+    n_group: int = 1,
+    topk_group: int = 1,
+):
+    """(probs [N,E], gate_vals [N,k], expert_idx [N,k]) — shared prologue.
+
+    ``norm_topk`` renormalizes the selected gates to sum to 1 (mixtral
+    convention / HF norm_topk_prob=True); DeepSeek-V2 keeps the raw mass.
+    DeepSeek-V3's "noaux_tc" routing composes three extras: sigmoid
+    ``scoring``; a per-expert ``selection_bias`` used for CHOOSING experts
+    but not for weighting them; and group-limited top-k (experts in
+    ``n_group`` groups, only the ``topk_group`` best groups — scored by
+    their top-2 experts — are eligible). NOTE: the bias feeds only the
+    (non-differentiable) top-k selection, so it gets no gradient — V3
+    trains it with an out-of-band load-feedback rule the train step does
+    not wire up; here it is checkpoint/inference-exact, and from-scratch
+    balancing comes from the Switch aux loss."""
+    if scoring == "sigmoid":
+        probs = jax.nn.sigmoid(router_logits.astype(jnp.float32))
+    else:
+        probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    select = probs if selection_bias is None else probs + selection_bias[None, :]
+    if n_group > 1:
+        n, e = select.shape
+        grouped = select.reshape(n, n_group, e // n_group)
+        group_score = jax.lax.top_k(grouped, 2)[0].sum(-1)  # [N, G]
+        _, keep = jax.lax.top_k(group_score, topk_group)  # [N, topk_group]
+        group_ok = jnp.zeros((n, n_group), bool).at[
+            jnp.arange(n)[:, None], keep
+        ].set(True)
+        select = jnp.where(
+            jnp.repeat(group_ok, e // n_group, axis=1), select, -jnp.inf
+        )
+    _, expert_idx = jax.lax.top_k(select, num_selected)
+    gate_vals = jnp.take_along_axis(probs, expert_idx, axis=-1)
     if norm_topk:
         gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
     return probs, gate_vals, expert_idx
@@ -55,9 +88,12 @@ def top_k_routing(
     num_selected: int,
     capacity: int,
     norm_topk: bool = True,
+    **gate_kw,
 ) -> RoutingResult:
     n, e = router_logits.shape
-    probs, gate_vals, expert_idx = _topk_gates(router_logits, num_selected, norm_topk)
+    probs, gate_vals, expert_idx = _topk_gates(
+        router_logits, num_selected, norm_topk, **gate_kw
+    )
 
     # slot assignment: fill slot-0 choices first, then slot-1, ... so the
     # higher-priority expert choice wins capacity (≙ moe_cumsum kernel)
@@ -99,6 +135,7 @@ def top_k_routing_sorted(
     num_selected: int,
     capacity: int,
     norm_topk: bool = True,
+    **gate_kw,
 ) -> SortedRouting:
     """Same routing semantics as :func:`top_k_routing` (slot-0 choices win
     capacity, then slot-1, ...; same drops, same losses) with sort-based
@@ -107,7 +144,7 @@ def top_k_routing_sorted(
     """
     n, e = router_logits.shape
     k = num_selected
-    probs, gate_vals, expert_idx = _topk_gates(router_logits, k, norm_topk)
+    probs, gate_vals, expert_idx = _topk_gates(router_logits, k, norm_topk, **gate_kw)
 
     # k-major flattening + stable sort: every slot-0 entry of an expert
     # sorts before its slot-1 entries, reproducing the einsum path's

@@ -24,9 +24,9 @@ POLICY_REGISTRY = {
     "qwen2": MistralPolicy,
     "gpt2": GPT2Policy,
     "mixtral": MixtralPolicy,
-    "qwen2_moe": MixtralPolicy,
     "MixtralForCausalLM": MixtralPolicy,
     "Qwen2MoeForCausalLM": MixtralPolicy,
+    "DeepseekV3ForCausalLM": DeepseekV2Policy,
     "deepseek_moe": DeepSeekMoEPolicy,
     "bert": BertPolicy,
     "BertModel": BertPolicy,

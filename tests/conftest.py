@@ -20,11 +20,15 @@ import pytest  # noqa: E402
 # pinned; config.update before first backend use still wins.
 jax.config.update("jax_platforms", "cpu")
 
-# NOTE: do NOT enable jax_compilation_cache_dir here. On this jaxlib/CPU
-# build, deserializing cached executables aborts the process (first, cache-
-# writing run passes; the warm run dies with "Fatal Python error: Aborted"
-# inside Array._value). Reproduce: enable it, run
-# tests/test_models/test_bert_vit_fp8.py twice.
+# NOTE: do NOT enable jax_compilation_cache_dir here, despite the ~7x warm
+# speedup it gives per boosted config (measured on jax 0.9). Root cause of
+# the r02-documented crash, narrowed this round: executables containing a
+# CollectivePermute inside a WhileThunk (scanned layers + GSPMD collectives
+# — most tp-trained models here) hit an XLA:CPU AOT-reload bug where the
+# in-process communicator's rendezvous never completes — AwaitAndLogIfStuck
+# aborts the process. Plain matmul/conv programs reload fine; the tp train
+# steps do not. Reproduce: enable the cache, run
+# tests/test_models/test_bert_vit_fp8.py::test_vit_training twice.
 
 
 @pytest.fixture(autouse=True)

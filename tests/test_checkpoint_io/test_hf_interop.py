@@ -300,3 +300,23 @@ def test_qwen2_moe_roundtrip():
     assert hf["model.layers.0.mlp.shared_expert_gate.weight"].shape == (1, cfg.hidden_size)
     assert "model.layers.1.mlp.experts.3.up_proj.weight" in hf
     assert "model.layers.0.self_attn.q_proj.bias" in hf
+
+
+def test_deepseek_v3_roundtrip():
+    from colossalai_tpu.models import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    cfg = DeepseekV3Config.tiny()
+    model = DeepseekV3ForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    hf = params_to_hf(params, "deepseek_v3")
+    assert "model.layers.0.mlp.gate.e_score_correction_bias" in hf
+    assert "model.layers.1.self_attn.q_a_proj.weight" in hf  # full-rank-q MLA
+    back = hf_to_params(
+        hf, "deepseek_v3", {"dense_layers": 0, "layers": cfg.num_hidden_layers},
+        num_experts=cfg.num_experts,
+    )
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        assert kp in flat_b, kp
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))

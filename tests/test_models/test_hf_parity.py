@@ -423,3 +423,44 @@ def test_qwen2_moe_matches_hf():
         theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
     ours = _our_logits_unsharded(Qwen2MoeForCausalLM(cfg), params, ids)
     _assert_close(ours, theirs, "qwen2_moe logits vs HF torch")
+
+
+def test_deepseek_v3_matches_hf():
+    """V3 'noaux_tc' routing: sigmoid scores, selection bias, group-limited
+    top-k, renormalized gates, routed scaling — plus full-rank-q MLA."""
+    from colossalai_tpu.models import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    cfg = dataclasses.replace(DeepseekV3Config.tiny(), capacity_factor=8.0)
+    hf_cfg = transformers.DeepseekV3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        moe_intermediate_size=cfg.moe_intermediate_size or cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        n_routed_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        n_shared_experts=cfg.n_shared_experts,
+        n_group=cfg.n_group, topk_group=cfg.topk_group,
+        routed_scaling_factor=cfg.routed_scaling_factor,
+        norm_topk_prob=True, first_k_dense_replace=0,
+        q_lora_rank=cfg.q_lora_rank, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim, v_head_dim=cfg.v_head_dim,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        rope_interleave=True, attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    hf = transformers.DeepseekV3ForCausalLM(hf_cfg)
+    hf.eval()
+    params = hf_to_params(
+        _hf_state(hf), "deepseek_v3",
+        {"dense_layers": 0, "layers": cfg.num_hidden_layers},
+        num_experts=cfg.num_experts,
+    )
+    ids = _ids(cfg.vocab_size)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
+    ours = _our_logits_unsharded(DeepseekV3ForCausalLM(cfg), params, ids)
+    _assert_close(ours, theirs, "deepseek_v3 logits vs HF torch")
