@@ -13,11 +13,15 @@ Usage:
     # path is passed to the script verbatim
     python -m colossalai_tpu.cli run --num-processes 4 \
         --coordinator host0:7777 --process-id 0 script.py --script-arg ...
+    # parallelism advisor (auto_parallel.plan_parallelism)
+    python -m colossalai_tpu.cli plan --preset llama3_8b --devices 8 \
+        --hbm-gib 16 --batch 32 --seq 4096
 """
 
 from __future__ import annotations
 
 import argparse
+import inspect
 import os
 import subprocess
 import sys
@@ -73,6 +77,29 @@ def _cmd_run(args) -> int:
     return next((r for r in rcs if r), 0)
 
 
+def _cmd_plan(args) -> int:
+    from colossalai_tpu.auto_parallel import plan_parallelism
+    from colossalai_tpu.models import LlamaConfig
+
+    # presets are the no-arg classmethod constructors; plain attributes
+    # (vocab_size) and instance methods (to_dict) must hit the error branch
+    known = [n for n in dir(LlamaConfig) if not n.startswith("_")
+             and isinstance(inspect.getattr_static(LlamaConfig, n), classmethod)]
+    if args.preset not in known:
+        print(f"unknown preset {args.preset!r}; try one of {known}", file=sys.stderr)
+        return 2
+    cfg = getattr(LlamaConfig, args.preset)()
+    plans = plan_parallelism(
+        cfg, args.devices, int(args.hbm_gib * 2**30), args.batch, args.seq,
+        peak_flops=args.peak_tflops * 1e12, multi_host_dp=args.multi_host,
+    )
+    print(f"{args.preset} on {args.devices} x {args.hbm_gib:.0f} GiB, "
+          f"batch {args.batch} x seq {args.seq}:")
+    for p in plans:
+        print("  " + p.describe())
+    return 0 if plans and plans[0].fits else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="colossalai_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -90,6 +117,20 @@ def main(argv=None) -> int:
     p_run.add_argument("script")
     p_run.add_argument("script_args", nargs=argparse.REMAINDER)
     p_run.set_defaults(fn=_cmd_run)
+
+    p_plan = sub.add_parser(
+        "plan", help="rank parallelism configs for a model preset"
+    )
+    p_plan.add_argument("--preset", default="llama3_8b",
+                        help="LlamaConfig classmethod name (e.g. llama3_8b)")
+    p_plan.add_argument("--devices", type=int, required=True)
+    p_plan.add_argument("--hbm-gib", type=float, required=True)
+    p_plan.add_argument("--batch", type=int, required=True)
+    p_plan.add_argument("--seq", type=int, required=True)
+    p_plan.add_argument("--peak-tflops", type=float, default=197.0)
+    p_plan.add_argument("--multi-host", action="store_true",
+                        help="cost the dp gradient sync at DCN rates")
+    p_plan.set_defaults(fn=_cmd_plan)
 
     args = parser.parse_args(argv)
     if args.command == "run":

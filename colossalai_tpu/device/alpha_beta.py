@@ -40,8 +40,42 @@ _DCN_BYTES_PER_S = 25e9  # conservative per-host DCN
 
 def _detect_generation() -> str:
     try:
-        kind = jax.devices()[0].device_kind.lower()
-    except Exception:  # backend not initialized
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            # backend never initialized: initializing one just to read a
+            # device name can BLOCK FOREVER on an unreachable tunneled TPU
+            # (the r02 multichip-gate failure mode) — probe in a DAEMON
+            # thread with a hard timeout (an executor thread would be
+            # joined at interpreter exit and hang the process instead)
+            import queue
+            import threading
+
+            box: "queue.Queue[str]" = queue.Queue(1)
+
+            def _probe():
+                try:
+                    box.put(jax.devices()[0].device_kind.lower())
+                except Exception:
+                    box.put("cpu")
+
+            threading.Thread(target=_probe, daemon=True).start()
+            try:
+                kind = box.get(timeout=10)
+            except queue.Empty:
+                # a slow-but-healthy pod init also lands here; warn so an
+                # 18x ICI-vs-cpu bandwidth miscosting isn't silent
+                import warnings
+
+                warnings.warn(
+                    "backend probe timed out after 10s; assuming cpu-class "
+                    "interconnect costs — pass alpha_beta/generation "
+                    "explicitly if a real TPU backend is still initializing"
+                )
+                return "cpu"
+        else:
+            kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # unavailable backend
         return "cpu"
     # real device_kind strings spell lite parts out: "TPU v5 lite",
     # "TPU v6 lite" — not "v5e"/"v6e"
