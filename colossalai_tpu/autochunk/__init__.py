@@ -1,0 +1,222 @@
+"""Automatic chunked execution: cap activation memory by running a function
+over slices of a batch-like axis inside one compiled loop.
+
+≙ reference ``colossalai/autochunk/`` (``autochunk_codegen.py``,
+``search_chunk.py:1``, ``estimate_memory.py:1``, ``select_chunk.py``): the
+reference traces a torch.fx graph, hand-estimates per-node memory, searches
+chunkable regions, and regenerates Python code with explicit loops. Under
+XLA there is no graph to rewrite and no need for a hand-built memory model —
+the same capability is a function transform:
+
+- :func:`chunked` wraps ``fn`` in ``lax.map`` over slices of the chunk axis.
+  ``lax.map`` is a compiled ``scan`` loop, so one chunk's activations are
+  live at a time; the transform is exact (same values, same dtype, not an
+  approximation) whenever ``fn`` treats chunk-axis rows independently —
+  the per-token LM head / loss / MLP shapes the reference chunks too.
+  Differentiable (scan has a VJP) and jit/shard_map-composable.
+- :func:`plan_chunks` replaces ``estimate_memory.py`` with the compiler's
+  own numbers: AOT-compile the wrapped fn at increasing chunk counts and
+  return the first whose ``memory_analysis().peak_memory_in_bytes`` fits
+  the budget. XLA's buffer assignment is the ground truth the reference's
+  estimator approximates.
+- :func:`autochunk` = plan + wrap.
+
+Use it for the classic blow-ups: seq x vocab logits+loss at long context,
+per-frame vision towers, pairwise interaction maps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["chunked", "plan_chunks", "autochunk", "ChunkPlan",
+           "measured_peak_bytes"]
+
+
+def _axis_of(leaf_axes, args):
+    """Broadcast an in_axes spec (int | None | per-arg sequence) per arg."""
+    if leaf_axes is None or isinstance(leaf_axes, int):
+        return [leaf_axes] * len(args)
+    axes = list(leaf_axes)
+    if len(axes) != len(args):
+        raise ValueError(
+            f"in_axes has {len(axes)} entries for {len(args)} arguments"
+        )
+    return axes
+
+
+def chunked(
+    fn: Callable,
+    chunks: int,
+    in_axes: Any = 0,
+    out_axes: Any = 0,
+) -> Callable:
+    """Return ``fn`` evaluated in ``chunks`` sequential slices.
+
+    Every argument whose ``in_axes`` entry is an int is split into ``chunks``
+    equal slices along that axis (the axis size must divide evenly — pad
+    upstream if it doesn't; silent padding here would corrupt reductions
+    inside ``fn``); ``None`` entries are passed whole to every chunk (closed
+    over, like ``vmap``'s broadcast). Every output leaf is concatenated
+    along ``out_axes`` (one int for all leaves).
+
+    Exactness contract: values are bit-identical to the unchunked call iff
+    ``fn`` computes each chunk-axis row independently. Cross-row reductions
+    (a mean over the chunk axis) must live OUTSIDE ``fn``.
+    """
+    if chunks < 1:
+        raise ValueError(f"chunks must be >= 1, got {chunks}")
+    if chunks == 1:
+        return fn
+
+    def wrapped(*args):
+        axes = _axis_of(in_axes, args)
+        mapped, static = [], []
+        for a, ax in zip(args, axes):
+            (mapped if ax is not None else static).append((a, ax))
+        if not mapped:
+            raise ValueError("chunked: every in_axes entry is None")
+        sizes = {jnp.shape(a)[ax] for a, ax in mapped}
+        if len(sizes) != 1:
+            raise ValueError(f"chunk-axis sizes disagree: {sorted(sizes)}")
+        (n,) = sizes
+        if n % chunks:
+            raise ValueError(
+                f"axis size {n} not divisible by chunks={chunks}; pad the "
+                "batch or pick a divisor"
+            )
+        per = n // chunks
+
+        def stack(a, ax):
+            a = jnp.moveaxis(a, ax, 0)
+            return a.reshape((chunks, per) + a.shape[1:])
+
+        stacked = [stack(a, ax) for a, ax in mapped]
+
+        def body(slices):
+            it = iter(slices)
+            si = 0
+            call = []
+            for ax in axes:
+                if ax is None:
+                    call.append(static[si][0])
+                    si += 1
+                else:
+                    call.append(jnp.moveaxis(next(it), 0, ax))
+            return fn(*call)
+
+        out = lax.map(body, stacked)
+
+        def unstack(leaf):
+            # leaf is (chunks,) + out_leaf_shape with the per-chunk rows at
+            # out_axes of out_leaf_shape, i.e. at axis out_axes+1 here —
+            # bring them next to the chunk axis before merging
+            leaf = jnp.moveaxis(leaf, out_axes + 1, 1)
+            leaf = leaf.reshape((chunks * per,) + leaf.shape[2:])
+            return jnp.moveaxis(leaf, 0, out_axes)
+
+        return jax.tree.map(unstack, out)
+
+    return wrapped
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """Result of :func:`plan_chunks`."""
+
+    chunks: int
+    peak_bytes: Optional[int]  # None when the backend reports no stats
+    fits: bool
+    tried: tuple  # ((chunks, peak_bytes), ...) in search order
+
+    def describe(self) -> str:
+        if self.peak_bytes is None:
+            return f"chunks={self.chunks} (no compiler memory stats; unsplit)"
+        return (
+            f"chunks={self.chunks}: peak {self.peak_bytes / 2**20:.1f} MiB "
+            f"{'OK' if self.fits else 'over budget'}"
+        )
+
+
+def measured_peak_bytes(fn, example_args) -> Optional[int]:
+    """AOT-compile ``fn`` and return its peak memory per XLA's buffer
+    assignment, with the XLA:CPU peak-excludes-temps correction
+    (:func:`colossalai_tpu.analyzer.corrected_peak_bytes`). Compile errors
+    PROPAGATE — a plan built on an uncompilable fn must fail here, not at
+    the first real call. Returns None only when the backend compiles fine
+    but reports no memory stats."""
+    from colossalai_tpu.analyzer import corrected_peak_bytes
+
+    compiled = jax.jit(fn).lower(*example_args).compile()
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    return corrected_peak_bytes(ma)
+
+
+def plan_chunks(
+    fn: Callable,
+    example_args: Sequence[Any],
+    budget_bytes: int,
+    in_axes: Any = 0,
+    out_axes: Any = 0,
+    max_chunks: Optional[int] = None,
+) -> ChunkPlan:
+    """Search the smallest chunk count whose compiled peak memory fits.
+
+    ≙ ``search_chunk.py``'s region search + ``estimate_memory.py``'s cost
+    model, collapsed: candidates are the divisors of the chunk-axis size in
+    increasing order (1, 2, ...), each AOT-compiled and measured with XLA's
+    buffer assignment. Returns the first candidate under ``budget_bytes``,
+    else the candidate with the smallest peak. Each probed candidate pays
+    one compile here, and the chosen wrapper compiles once more at its
+    first real (jitted) call — plan at startup, not per step.
+    """
+    axes = _axis_of(in_axes, example_args)
+    sizes = [jnp.shape(a)[ax]
+             for a, ax in zip(example_args, axes) if ax is not None]
+    if not sizes:
+        raise ValueError("plan_chunks: every in_axes entry is None")
+    n = sizes[0]
+    if n < 1:
+        raise ValueError(f"plan_chunks: chunk axis has size {n}")
+    limit = min(n, max_chunks or n)
+    candidates = [c for c in range(1, limit + 1) if n % c == 0]
+
+    tried = []
+    best = None  # (peak, chunks)
+    for c in candidates:
+        peak = measured_peak_bytes(chunked(fn, c, in_axes, out_axes), example_args)
+        tried.append((c, peak))
+        if peak is None:
+            # no stats from this backend: measuring more candidates is
+            # pointless — run unsplit rather than guess
+            return ChunkPlan(chunks=1, peak_bytes=None, fits=True,
+                             tried=tuple(tried))
+        if peak <= budget_bytes:
+            return ChunkPlan(chunks=c, peak_bytes=peak, fits=True,
+                             tried=tuple(tried))
+        if best is None or peak < best[0]:
+            best = (peak, c)
+    peak, c = best
+    return ChunkPlan(chunks=c, peak_bytes=peak, fits=False, tried=tuple(tried))
+
+
+def autochunk(
+    fn: Callable,
+    example_args: Sequence[Any],
+    budget_bytes: int,
+    in_axes: Any = 0,
+    out_axes: Any = 0,
+    max_chunks: Optional[int] = None,
+):
+    """Plan and wrap in one call; returns ``(wrapped_fn, plan)``."""
+    plan = plan_chunks(fn, example_args, budget_bytes, in_axes, out_axes,
+                       max_chunks)
+    return chunked(fn, plan.chunks, in_axes, out_axes), plan

@@ -1,0 +1,148 @@
+"""Autochunk: chunked execution is exact, differentiable, and actually
+reduces XLA's compiled peak memory.
+
+≙ reference ``tests/test_autochunk/`` (``test_autochunk_codegen.py``: chunked
+codegen output equals the unchunked module; memory bound respected). There
+the evidence is a regenerated fx module; here it is ``lax.map`` equivalence
+plus the compiler's own ``memory_analysis`` numbers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.autochunk import (ChunkPlan, autochunk, chunked,
+                                      measured_peak_bytes, plan_chunks)
+
+SEQ, HID, VOCAB = 64, 32, 512
+
+
+def _logits_loss(h, w):
+    """The classic blow-up: [seq, hid] @ [hid, vocab] -> log-softmax picks.
+    Per-row independent, so chunking over seq is exact."""
+    logits = (h @ w).astype(jnp.float32)
+    return logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+
+
+def test_chunked_exact_forward_and_grad():
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(SEQ, HID), jnp.float32)
+    w = jnp.asarray(rng.randn(HID, VOCAB), jnp.float32)
+
+    full = _logits_loss(h, w)
+    for chunks in (2, 4, 8):
+        part = chunked(_logits_loss, chunks, in_axes=(0, None))(h, w)
+        np.testing.assert_allclose(np.asarray(part), np.asarray(full),
+                                   rtol=1e-6, atol=1e-6)
+
+    loss = lambda fn: lambda h, w: fn(h, w).sum()
+    g_full = jax.grad(loss(_logits_loss), argnums=(0, 1))(h, w)
+    g_part = jax.grad(
+        loss(chunked(_logits_loss, 4, in_axes=(0, None))), argnums=(0, 1)
+    )(h, w)
+    for a, b in zip(g_part, g_full):
+        # w-grad sums per-chunk contributions in a different order than the
+        # single big matmul — f32 accumulation noise, not a defect
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_pytree_output_and_jit():
+    def f(x):
+        return {"double": x * 2, "sq": x * x}
+
+    x = jnp.arange(24.0).reshape(12, 2)
+    out = jax.jit(chunked(f, 3))(x)
+    np.testing.assert_allclose(np.asarray(out["double"]), np.asarray(x) * 2)
+    np.testing.assert_allclose(np.asarray(out["sq"]), np.asarray(x) ** 2)
+
+
+def test_chunked_nonzero_out_axes():
+    """Transposed output: chunk rows land on out axis 1, with a distinct
+    leading axis so a wrong merge is a shape error, not silent."""
+    x = jnp.arange(8.0 * 3).reshape(8, 3)
+    out = chunked(lambda a: a.T, 2, in_axes=0, out_axes=1)(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x.T))
+
+    # nonzero IN axis too: rows arrive on axis 1 and leave on axis 1
+    y = jnp.arange(3.0 * 8).reshape(3, 8)
+    out = chunked(lambda a: a * 2, 4, in_axes=1, out_axes=1)(y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(y) * 2)
+
+
+def test_chunked_rejects_bad_sizes():
+    x = jnp.ones((10, 4))
+    with pytest.raises(ValueError, match="not divisible"):
+        chunked(lambda a: a, 3)(x)
+    with pytest.raises(ValueError, match="every in_axes entry is None"):
+        chunked(lambda a: a, 2, in_axes=(None,))(x)
+    with pytest.raises(ValueError, match="every in_axes entry is None"):
+        plan_chunks(lambda a: a, (x,), 1 << 30, in_axes=(None,))
+
+
+def test_plan_chunks_propagates_compile_errors():
+    """An uncompilable fn must fail at planning time, not hand back a
+    ChunkPlan that pretends the budget is met."""
+    bad = lambda a: a @ a  # (10, 4) @ (10, 4): contraction mismatch
+    with pytest.raises(Exception):
+        plan_chunks(bad, (jnp.ones((10, 4)),), 1 << 30)
+
+
+def _per_token_ce(h, w):
+    """Per-token CE against gold id 0: the [rows, vocab] logits are reduced
+    INSIDE the chunk, so chunking keeps them from ever materializing whole
+    — the shape the reference's autochunk exists for."""
+    logits = (h @ w).astype(jnp.float32)
+    return jax.nn.logsumexp(logits, axis=-1) - logits[:, 0]
+
+
+def test_peak_memory_shrinks_with_chunks():
+    """The whole point: XLA's buffer assignment must report a smaller peak
+    for the chunked program (one [rows/c, vocab] logits buffer live at a
+    time instead of [rows, vocab])."""
+    rng = np.random.RandomState(1)
+    h = jnp.asarray(rng.randn(1024, HID), jnp.float32)
+    w = jnp.asarray(rng.randn(HID, 8192), jnp.float32)
+
+    p1 = measured_peak_bytes(_per_token_ce, (h, w))
+    p8 = measured_peak_bytes(chunked(_per_token_ce, 8, in_axes=(0, None)),
+                             (h, w))
+    assert p8 < p1, f"chunked peak {p8} not below unchunked {p1}"
+    # the dominant buffer is 1024x8192 fp32 logits (32 MiB); at 8 chunks it
+    # should drop by ~a factor of chunks, not a rounding error
+    assert p8 < 0.5 * p1, (p1, p8)
+
+
+def test_plan_chunks_meets_budget():
+    rng = np.random.RandomState(2)
+    h = jnp.asarray(rng.randn(1024, HID), jnp.float32)
+    w = jnp.asarray(rng.randn(HID, 8192), jnp.float32)
+
+    unchunked_peak = measured_peak_bytes(_per_token_ce, (h, w))
+    budget = unchunked_peak // 3
+    plan = plan_chunks(_per_token_ce, (h, w), budget, in_axes=(0, None))
+    assert isinstance(plan, ChunkPlan)
+    assert plan.fits and plan.chunks > 1
+    assert plan.peak_bytes <= budget
+    # search order is increasing, so the choice is the SMALLEST fitting count
+    for c, p in plan.tried[:-1]:
+        assert p > budget
+
+    fn, plan2 = autochunk(_per_token_ce, (h, w), budget, in_axes=(0, None))
+    full = _per_token_ce(h, w)
+    np.testing.assert_allclose(np.asarray(fn(h, w)), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+    assert plan2.chunks == plan.chunks
+
+
+def test_plan_unsatisfiable_budget_returns_best_effort():
+    rng = np.random.RandomState(3)
+    h = jnp.asarray(rng.randn(64, HID), jnp.float32)
+    w = jnp.asarray(rng.randn(HID, 1024), jnp.float32)
+    plan = plan_chunks(_per_token_ce, (h, w), budget_bytes=1,
+                       in_axes=(0, None), max_chunks=8)
+    assert not plan.fits
+    assert plan.chunks == min(c for c, p in plan.tried
+                              if p == min(p for _, p in plan.tried))
+    assert "over budget" in plan.describe()
