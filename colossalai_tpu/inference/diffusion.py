@@ -67,7 +67,13 @@ def ddim_sample(
         a_t = abar[t_cur]
         a_n = jnp.where(t_next >= 0, abar[jnp.maximum(t_next, 0)], 1.0)
         x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
-        sigma = eta * jnp.sqrt((1 - a_n) / (1 - a_t)) * jnp.sqrt(1 - a_t / a_n)
+        # the final step has t_cur=0 where a_t == abar[0] == 1 exactly:
+        # (1-a_n)/(1-a_t) is 0/0 = NaN there, and eta*NaN poisons x even
+        # with eta=0 — guard the ratio (sigma is genuinely 0 at that step)
+        sigma = (eta
+                 * jnp.sqrt(jnp.maximum(1 - a_n, 0.0)
+                            / jnp.maximum(1 - a_t, 1e-12))
+                 * jnp.sqrt(jnp.maximum(1 - a_t / a_n, 0.0)))
         dir_xt = jnp.sqrt(jnp.maximum(1.0 - a_n - sigma**2, 0.0)) * eps
         noise = sigma * jax.random.normal(key, x.shape)
         x = jnp.sqrt(a_n) * x0 + dir_xt + noise

@@ -19,6 +19,28 @@ from .logging import get_dist_logger
 _DIST_INITIALIZED = False
 
 
+def _enforce_env_platform() -> None:
+    """Make ``JAX_PLATFORMS`` from the environment BINDING.
+
+    A site plugin (e.g. a tunneled-TPU sitecustomize) can pre-import jax and
+    re-pin the platform after the user's environment was read; the observed
+    failure is a child process launched with ``JAX_PLATFORMS=cpu`` whose
+    first ``jax.devices()`` still dials the (possibly unreachable) tunneled
+    backend and blocks forever at 0% CPU. ``jax.config.update`` wins over
+    any import-time pinning, so the launcher re-asserts the user's choice
+    before the first backend touch. No-op when the env var is unset or the
+    backend is already initialized (too late to change — jax raises).
+    """
+    plats = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not plats:
+        return
+    try:
+        if jax.config.jax_platforms != plats:
+            jax.config.update("jax_platforms", plats)
+    except Exception:  # backend already up: keep whatever is running
+        pass
+
+
 def launch(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -34,6 +56,7 @@ def launch(
     of the reference's ``dist.init_process_group`` at ``initialize.py:59``).
     """
     global _DIST_INITIALIZED
+    _enforce_env_platform()
     if coordinator_address is not None and not _DIST_INITIALIZED:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -78,8 +101,15 @@ def launch_from_env(seed: int = 1024, verbose: bool = True) -> jax.Array:
         )
     # Single-host or auto-detectable environment.
     global _DIST_INITIALIZED
-    if not _DIST_INITIALIZED and any(
-        k in os.environ for k in ("MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES")
+    _enforce_env_platform()
+    # a single-entry TPU_WORKER_HOSTNAMES (e.g. "localhost", set by a
+    # tunneled single-chip sitecustomize in EVERY child process) is not a
+    # cluster — auto-init would dial a coordination service that isn't there
+    tpu_hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if not _DIST_INITIALIZED and (
+        "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+        or "SLURM_JOB_ID" in os.environ
+        or len(tpu_hosts) > 1
     ):
         try:
             jax.distributed.initialize()

@@ -72,6 +72,15 @@ class ModelConfig:
         return dataclasses.asdict(self)
 
 
+def preset(cls, overrides, **defaults):
+    """Back a config-preset classmethod: ``defaults`` are the preset's
+    values, ``overrides`` the caller's ``**kw`` — the caller wins. The
+    naive ``cls(a=1, **kw)`` form raises "multiple values for keyword
+    argument" the moment a caller overrides a preset-set field (e.g.
+    ``LlamaConfig.tiny(vocab_size=512)``)."""
+    return cls(**{**defaults, **overrides})
+
+
 class LMHead(nn.Module):
     """MXU-rate LM head: bf16-input matmul with fp32 ACCUMULATION.
 

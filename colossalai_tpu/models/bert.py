@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 
-from .base import ModelConfig
+from .base import ModelConfig, preset
 
 
 @flax.struct.dataclass
@@ -41,10 +41,11 @@ class BertConfig(ModelConfig):
 
     @classmethod
     def tiny(cls, **kw) -> "BertConfig":
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256, hidden_size=64, num_hidden_layers=2,
             num_attention_heads=4, intermediate_size=128,
-            max_position_embeddings=64, **kw,
+            max_position_embeddings=64,
         )
 
 

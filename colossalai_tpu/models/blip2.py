@@ -30,7 +30,7 @@ from colossalai_tpu.tensor import constrain
 
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import LMHead, ModelConfig
+from .base import LMHead, ModelConfig, preset
 from .transformer import DecoderBlock, DecoderConfig
 from .vit import ViTConfig
 
@@ -71,14 +71,15 @@ class Blip2Config(ModelConfig):
 
     @classmethod
     def tiny(cls, **kw) -> "Blip2Config":
-        return cls(
+        return preset(
+            cls, kw,
             image_size=32, patch_size=8, vision_hidden_size=64,
             vision_layers=2, vision_heads=4, vision_intermediate_size=128,
             qformer_hidden_size=64, qformer_layers=2, qformer_heads=4,
             qformer_intermediate_size=128, num_query_tokens=8,
             cross_attention_frequency=2, vocab_size=256, hidden_size=64,
             intermediate_size=128, num_hidden_layers=2,
-            num_attention_heads=4, max_position_embeddings=128, **kw,
+            num_attention_heads=4, max_position_embeddings=128,
         )
 
     def vision_config_(self) -> ViTConfig:

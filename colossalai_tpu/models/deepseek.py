@@ -28,7 +28,7 @@ from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput, LMHead, lm_head_matmul
+from .base import CausalLMOutput, LMHead, lm_head_matmul, preset
 from .llama import LlamaConfig, LlamaMLP, RMSNorm, apply_rope, rope_table
 from .mixtral import MixtralConfig, MoEMLP
 
@@ -51,14 +51,15 @@ class DeepseekV2Config(MixtralConfig):
 
     @classmethod
     def deepseek_v2_lite(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=102400, hidden_size=2048, intermediate_size=10944,
             num_hidden_layers=27, num_attention_heads=16, num_key_value_heads=16,
             q_lora_rank=None, kv_lora_rank=512, qk_nope_head_dim=128,
             qk_rope_head_dim=64, v_head_dim=128,
             num_experts=64, num_experts_per_tok=6, n_shared_experts=2,
             moe_intermediate_size=1408,  # narrow DeepSeekMoE experts
-            first_k_dense_replace=1, max_position_embeddings=163840, **kw,
+            first_k_dense_replace=1, max_position_embeddings=163840,
         )
 
     @classmethod
@@ -258,7 +259,8 @@ class DeepseekV3Config(DeepseekV2Config):
 
     @classmethod
     def deepseek_v3(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=129280, hidden_size=7168, intermediate_size=18432,
             num_hidden_layers=61, num_attention_heads=128, num_key_value_heads=128,
             q_lora_rank=1536, kv_lora_rank=512, qk_nope_head_dim=128,
@@ -266,7 +268,7 @@ class DeepseekV3Config(DeepseekV2Config):
             num_experts=256, num_experts_per_tok=8, n_shared_experts=1,
             moe_intermediate_size=2048, first_k_dense_replace=3,
             n_group=8, topk_group=4, routed_scaling_factor=2.5,
-            max_position_embeddings=163840, router_impl="sort", **kw,
+            max_position_embeddings=163840, router_impl="sort",
         )
 
 

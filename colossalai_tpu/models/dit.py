@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 
-from .base import ModelConfig
+from .base import ModelConfig, preset
 
 
 @flax.struct.dataclass
@@ -52,7 +52,7 @@ class DiTConfig(ModelConfig):
 
     @classmethod
     def dit_xl_2(cls, **kw):
-        return cls(**kw)
+        return cls(**kw)  # dataclass defaults ARE this preset
 
     @classmethod
     def tiny(cls, **kw) -> "DiTConfig":

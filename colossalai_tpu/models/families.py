@@ -15,6 +15,7 @@ import math
 from typing import Optional
 
 from .transformer import DecoderConfig, DecoderLM
+from .base import preset
 
 
 def _tiny_fields(**kw):
@@ -40,10 +41,11 @@ class OPTConfig(DecoderConfig):
 
     @classmethod
     def opt_6b7(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=50272, hidden_size=4096, intermediate_size=16384,
             num_hidden_layers=32, num_attention_heads=32,
-            max_position_embeddings=2048, **kw,
+            max_position_embeddings=2048,
         )
 
     @classmethod
@@ -68,9 +70,10 @@ class BloomConfig(DecoderConfig):
 
     @classmethod
     def bloom_7b1(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=250880, hidden_size=4096, intermediate_size=16384,
-            num_hidden_layers=30, num_attention_heads=32, **kw,
+            num_hidden_layers=30, num_attention_heads=32,
         )
 
     @classmethod
@@ -100,15 +103,17 @@ class FalconConfig(DecoderConfig):
 
     @classmethod
     def falcon_7b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=65024, hidden_size=4544, intermediate_size=18176,
             num_hidden_layers=32, num_attention_heads=71,
-            max_position_embeddings=2048, **kw,
+            max_position_embeddings=2048,
         )
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(**_tiny_fields(num_key_value_heads=1, **kw))
+        kw.setdefault("num_key_value_heads", 1)
+        return cls(**_tiny_fields(**kw))
 
 
 class FalconForCausalLM(DecoderLM):
@@ -133,10 +138,11 @@ class GPTJConfig(DecoderConfig):
 
     @classmethod
     def gptj_6b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=50400, hidden_size=4096, intermediate_size=16384,
             num_hidden_layers=28, num_attention_heads=16,
-            max_position_embeddings=2048, **kw,
+            max_position_embeddings=2048,
         )
 
     @classmethod
@@ -162,10 +168,11 @@ class GPTNeoXConfig(DecoderConfig):
 
     @classmethod
     def gpt_neox_20b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=50432, hidden_size=6144, intermediate_size=24576,
             num_hidden_layers=44, num_attention_heads=64,
-            max_position_embeddings=2048, **kw,
+            max_position_embeddings=2048,
         )
 
     @classmethod
@@ -197,15 +204,17 @@ class ChatGLMConfig(DecoderConfig):
 
     @classmethod
     def chatglm3_6b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=65024, hidden_size=4096, intermediate_size=13696,
             num_hidden_layers=28, num_attention_heads=32,
-            num_key_value_heads=2, max_position_embeddings=32768, **kw,
+            num_key_value_heads=2, max_position_embeddings=32768,
         )
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(**_tiny_fields(num_key_value_heads=2, **kw))
+        kw.setdefault("num_key_value_heads", 2)
+        return cls(**_tiny_fields(**kw))
 
 
 class ChatGLMForConditionalGeneration(DecoderLM):
@@ -226,10 +235,11 @@ class PhiConfig(DecoderConfig):
 
     @classmethod
     def phi_2(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=51200, hidden_size=2560, intermediate_size=10240,
             num_hidden_layers=32, num_attention_heads=32,
-            max_position_embeddings=2048, **kw,
+            max_position_embeddings=2048,
         )
 
     @classmethod
@@ -265,10 +275,11 @@ class GemmaConfig(DecoderConfig):
 
     @classmethod
     def gemma_7b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256000, hidden_size=3072, intermediate_size=24576,
             num_hidden_layers=28, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=8192, **kw,
+            num_key_value_heads=16, max_position_embeddings=8192,
         )
 
     @classmethod
@@ -297,10 +308,11 @@ class Gemma2Config(GemmaConfig):
 
     @classmethod
     def gemma2_9b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256000, hidden_size=3584, intermediate_size=14336,
             num_hidden_layers=42, num_attention_heads=16,
-            num_key_value_heads=8, max_position_embeddings=8192, **kw,
+            num_key_value_heads=8, max_position_embeddings=8192,
         )
 
     @classmethod
@@ -334,11 +346,12 @@ class Qwen3Config(DecoderConfig):
 
     @classmethod
     def qwen3_8b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=151936, hidden_size=4096, intermediate_size=12288,
             num_hidden_layers=36, num_attention_heads=32,
             num_key_value_heads=8, head_dim=128,
-            max_position_embeddings=32768, **kw,
+            max_position_embeddings=32768,
         )
 
     @classmethod
@@ -371,10 +384,11 @@ class CohereConfig(DecoderConfig):
 
     @classmethod
     def command_r(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256000, hidden_size=8192, intermediate_size=22528,
             num_hidden_layers=40, num_attention_heads=64,
-            max_position_embeddings=8192, rope_theta=8e6, **kw,
+            max_position_embeddings=8192, rope_theta=8e6,
         )
 
     @classmethod
@@ -402,10 +416,11 @@ class BaichuanConfig(DecoderConfig):
 
     @classmethod
     def baichuan_13b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=64000, hidden_size=5120, intermediate_size=13696,
             num_hidden_layers=40, num_attention_heads=40,
-            max_position_embeddings=4096, **kw,
+            max_position_embeddings=4096,
         )
 
     @classmethod
@@ -430,17 +445,19 @@ class StarCoder2Config(DecoderConfig):
 
     @classmethod
     def starcoder2_7b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=49152, hidden_size=4608, intermediate_size=18432,
             num_hidden_layers=32, num_attention_heads=36,
             num_key_value_heads=4, max_position_embeddings=16384,
-            rope_theta=1e6, **kw,
+            rope_theta=1e6,
         )
 
     @classmethod
     def tiny(cls, **kw):
         kw.setdefault("sliding_window", 32)
-        return cls(**_tiny_fields(num_key_value_heads=2, **kw))
+        kw.setdefault("num_key_value_heads", 2)
+        return cls(**_tiny_fields(**kw))
 
 
 class Starcoder2ForCausalLM(DecoderLM):
@@ -463,10 +480,11 @@ class StableLmConfig(DecoderConfig):
 
     @classmethod
     def stablelm_2_1_6b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=100352, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=24, num_attention_heads=32,
-            max_position_embeddings=4096, **kw,
+            max_position_embeddings=4096,
         )
 
     @classmethod
@@ -494,10 +512,11 @@ class MptConfig(DecoderConfig):
 
     @classmethod
     def mpt_7b(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=50432, hidden_size=4096, intermediate_size=16384,
             num_hidden_layers=32, num_attention_heads=32,
-            max_position_embeddings=2048, **kw,
+            max_position_embeddings=2048,
         )
 
     @classmethod
@@ -522,15 +541,17 @@ class GPTBigCodeConfig(DecoderConfig):
 
     @classmethod
     def starcoderbase(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=49152, hidden_size=6144, intermediate_size=24576,
             num_hidden_layers=40, num_attention_heads=48,
-            num_key_value_heads=1, max_position_embeddings=8192, **kw,
+            num_key_value_heads=1, max_position_embeddings=8192,
         )
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(**_tiny_fields(num_key_value_heads=1, **kw))
+        kw.setdefault("num_key_value_heads", 1)
+        return cls(**_tiny_fields(**kw))
 
 
 class GPTBigCodeForCausalLM(DecoderLM):

@@ -16,7 +16,7 @@ from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput, LMHead, ModelConfig, lm_head_matmul
+from .base import CausalLMOutput, LMHead, ModelConfig, lm_head_matmul, preset
 
 
 @dataclasses.dataclass(unsafe_hash=True)
@@ -32,13 +32,14 @@ class GPT2Config(ModelConfig):
 
     @classmethod
     def gpt2_125m(cls, **kw) -> "GPT2Config":
-        return cls(**kw)
+        return cls(**kw)  # dataclass defaults ARE this preset
 
     @classmethod
     def tiny(cls, **kw) -> "GPT2Config":
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256, hidden_size=64, num_hidden_layers=2,
-            num_attention_heads=4, max_position_embeddings=128, **kw,
+            num_attention_heads=4, max_position_embeddings=128,
         )
 
 

@@ -29,7 +29,7 @@ from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput, LMHead, ModelConfig, lm_head_matmul
+from .base import CausalLMOutput, LMHead, ModelConfig, lm_head_matmul, preset
 
 
 @dataclasses.dataclass(unsafe_hash=True)
@@ -56,49 +56,54 @@ class LlamaConfig(ModelConfig):
 
     @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=128256, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
-            max_position_embeddings=8192, rope_theta=500000.0, **kw,
+            max_position_embeddings=8192, rope_theta=500000.0,
         )
 
     @classmethod
     def llama2_7b(cls, **kw) -> "LlamaConfig":
-        return cls(**kw)
+        return cls(**kw)  # dataclass defaults ARE this preset
 
     @classmethod
     def llama3_70b(cls, **kw) -> "LlamaConfig":
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=128256, hidden_size=8192, intermediate_size=28672,
             num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8,
-            max_position_embeddings=8192, rope_theta=500000.0, **kw,
+            max_position_embeddings=8192, rope_theta=500000.0,
         )
 
     @classmethod
     def mistral_7b(cls, **kw) -> "LlamaConfig":
         kw.setdefault("sliding_window", 4096)
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=32000, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
-            max_position_embeddings=32768, rope_theta=10000.0, **kw,
+            max_position_embeddings=32768, rope_theta=10000.0,
         )
 
     @classmethod
     def qwen2_7b(cls, **kw) -> "LlamaConfig":
         kw.setdefault("attention_bias", True)  # Qwen2 has q/k/v biases
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=152064, hidden_size=3584, intermediate_size=18944,
             num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
-            max_position_embeddings=32768, rope_theta=1e6, **kw,
+            max_position_embeddings=32768, rope_theta=1e6,
         )
 
     @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
         """Test-size config (≙ reference model-zoo tiny builders)."""
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-            max_position_embeddings=128, **kw,
+            max_position_embeddings=128,
         )
 
 

@@ -28,7 +28,7 @@ from colossalai_tpu.moe.router import (
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import CausalLMOutput, LMHead, lm_head_matmul
+from .base import CausalLMOutput, LMHead, lm_head_matmul, preset
 from .llama import LlamaAttention, LlamaConfig, LlamaMLP, RMSNorm
 
 
@@ -73,32 +73,35 @@ class MixtralConfig(LlamaConfig):
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=32000, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
             max_position_embeddings=32768, rope_theta=1e6,
-            num_experts=8, num_experts_per_tok=2, **kw,
+            num_experts=8, num_experts_per_tok=2,
         )
 
     @classmethod
     def qwen3_moe_a3b(cls, **kw) -> "MixtralConfig":
         """Qwen3-MoE-30B-A3B: narrow experts, no shared expert, k=8."""
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=151936, hidden_size=2048, intermediate_size=6144,
             num_hidden_layers=48, num_attention_heads=32, num_key_value_heads=4,
             max_position_embeddings=32768, rope_theta=1e6,
             num_experts=128, num_experts_per_tok=8,
-            moe_intermediate_size=768, **kw,
+            moe_intermediate_size=768,
         )
 
     @classmethod
     def tiny(cls, **kw) -> "MixtralConfig":
         kw.setdefault("num_experts", 4)
         kw.setdefault("num_experts_per_tok", 2)
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-            max_position_embeddings=128, **kw,
+            max_position_embeddings=128,
         )
 
 
@@ -292,13 +295,14 @@ class Qwen2MoeConfig(MixtralConfig):
     def qwen2_moe_a14b(cls, **kw) -> "Qwen2MoeConfig":
         """Qwen2-MoE-57B-A14B (≙ policies/qwen2.py MoE entries): many
         narrow experts + a sigmoid-gated shared expert, k=8."""
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=151936, hidden_size=3584, intermediate_size=18944,
             num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
             max_position_embeddings=32768, rope_theta=1e6,
             num_experts=64, num_experts_per_tok=8,
             moe_intermediate_size=2560,
-            shared_expert_intermediate_size=20480, **kw,
+            shared_expert_intermediate_size=20480,
         )
 
 

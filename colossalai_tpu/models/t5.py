@@ -31,7 +31,7 @@ from colossalai_tpu.shardformer.layer.attention import xla_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import ModelConfig
+from .base import ModelConfig, preset
 from .llama import RMSNorm
 
 import flax.struct
@@ -75,19 +75,20 @@ class T5Config(ModelConfig):
 
     @classmethod
     def t5_base(cls, **kw):
-        return cls(d_model=768, d_ff=3072, num_layers=12, num_heads=12, **kw)
+        return preset(cls, kw, d_model=768, d_ff=3072, num_layers=12, num_heads=12)
 
     @classmethod
     def t5_v1_1_large(cls, **kw):
         kw.setdefault("feed_forward_proj", "gated-gelu")
         kw.setdefault("tie_word_embeddings", False)
-        return cls(d_model=1024, d_kv=64, d_ff=2816, num_layers=24, num_heads=16, **kw)
+        return preset(cls, kw, d_model=1024, d_kv=64, d_ff=2816, num_layers=24, num_heads=16)
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256, d_model=64, d_kv=16, d_ff=128,
-            num_layers=2, num_heads=4, **kw,
+            num_layers=2, num_heads=4,
         )
 
 

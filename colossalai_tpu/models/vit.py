@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from colossalai_tpu.shardformer.layer.attention import dot_product_attention
 from colossalai_tpu.tensor import constrain
 
-from .base import ModelConfig
+from .base import ModelConfig, preset
 
 
 @flax.struct.dataclass
@@ -39,9 +39,10 @@ class ViTConfig(ModelConfig):
 
     @classmethod
     def tiny(cls, **kw) -> "ViTConfig":
-        return cls(
+        return preset(
+            cls, kw,
             image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
-            num_attention_heads=4, intermediate_size=128, num_labels=10, **kw,
+            num_attention_heads=4, intermediate_size=128, num_labels=10,
         )
 
 

@@ -25,7 +25,7 @@ from colossalai_tpu.shardformer.layer.attention import xla_attention
 from colossalai_tpu.tensor import constrain
 from colossalai_tpu.tensor.padded_vocab import mask_padded_logits
 
-from .base import ModelConfig
+from .base import ModelConfig, preset
 from .t5 import Seq2SeqOutput
 
 
@@ -53,17 +53,19 @@ class WhisperConfig(ModelConfig):
 
     @classmethod
     def whisper_small(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             d_model=768, encoder_layers=12, decoder_layers=12,
-            num_heads=12, ffn_dim=3072, **kw,
+            num_heads=12, ffn_dim=3072,
         )
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(
+        return preset(
+            cls, kw,
             vocab_size=256, num_mel_bins=8, d_model=64,
             encoder_layers=2, decoder_layers=2, num_heads=4, ffn_dim=128,
-            max_source_positions=32, max_target_positions=32, **kw,
+            max_source_positions=32, max_target_positions=32,
         )
 
 

@@ -17,6 +17,26 @@ import jax
 import jax.numpy as jnp
 
 
+def _per_token_nll(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: int,
+    label_smoothing: float,
+) -> jax.Array:
+    """Per-position NLL [...]; positions with ``ignore_index`` get the
+    gold-id-0 value (masked by the callers). The single source of the CE
+    math for both the materialized and the fused/chunked path."""
+    logits = logits.astype(jnp.float32)
+    safe_labels = jnp.where(labels == ignore_index, 0, labels)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logit
+    if label_smoothing > 0.0:
+        smooth = lse - jnp.mean(logits, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    return nll
+
+
 def softmax_cross_entropy(
     logits: jax.Array,
     labels: jax.Array,
@@ -24,15 +44,8 @@ def softmax_cross_entropy(
     label_smoothing: float = 0.0,
 ) -> jax.Array:
     """Mean CE over valid positions. logits [..., V] fp32, labels [...] int."""
-    logits = logits.astype(jnp.float32)
+    nll = _per_token_nll(logits, labels, ignore_index, label_smoothing)
     valid = labels != ignore_index
-    safe_labels = jnp.where(valid, labels, 0)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    label_logit = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
-    nll = lse - label_logit
-    if label_smoothing > 0.0:
-        smooth = lse - jnp.mean(logits, axis=-1)
-        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
     nll = jnp.where(valid, nll, 0.0)
     denom = jnp.maximum(valid.sum(), 1)
     return nll.sum() / denom
@@ -59,3 +72,80 @@ def dist_log_prob(logits: jax.Array, labels: jax.Array) -> jax.Array:
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     return label_logit - lse
+
+
+def _largest_divisor_leq(n: int, c: int) -> int:
+    c = max(1, min(n, c))
+    while n % c:
+        c -= 1
+    return c
+
+
+def fused_linear_cross_entropy(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    labels: jax.Array,
+    bias: Optional[jax.Array] = None,
+    vocab_size: Optional[int] = None,
+    chunks: int = 8,
+    ignore_index: int = -100,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean CE straight from hidden states — the ``[N, V]`` logits tensor is
+    never materialized whole.
+
+    The LM-head matmul + log-sum-exp run in ``chunks`` sequential slices of
+    the token axis (:func:`colossalai_tpu.autochunk.chunked`), so one
+    ``[N/chunks, V]`` tile is live at a time: at seq 16k x vocab 128k fp32
+    that is the difference between ~8 GiB of logits and whatever one chunk
+    costs. Exact (not approximate; per-token rows are independent) and
+    differentiable. ≙ the memory goal of the reference's ``DistCrossEntropy``
+    (``shardformer/layer/loss.py:25``) by chunking instead of vocab-sharding
+    — and it composes with vocab sharding: under GSPMD a ``tp``-sharded
+    ``kernel`` keeps the chunk matmul and reduction partitioned.
+
+    ``hidden`` is ``[..., H]``, ``labels`` ``[...]``; leading axes are
+    flattened. With a padded vocab pass the true ``vocab_size``: phantom
+    columns are sliced off before the reduction (≙
+    ``tensor/padded_vocab.py`` masking, exactly). ``chunks`` is rounded
+    down to the largest divisor of the token count.
+    """
+    from colossalai_tpu.autochunk import chunked
+    from colossalai_tpu.models.base import lm_head_matmul
+
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    y1 = labels.reshape(-1)
+    if h2.shape[0] != y1.shape[0]:
+        raise ValueError(
+            f"{h2.shape[0]} hidden rows vs {y1.shape[0]} labels"
+        )
+
+    # jax.checkpoint is what makes the memory claim hold in TRAINING: the
+    # logsumexp backward otherwise saves a [per, V] residual per chunk and
+    # lax.map stacks them right back to the full [N, V] footprint. With
+    # remat only the [per, H] chunk inputs are saved; the tile matmul + lse
+    # recompute during backward (Liger-style fused CE earns it the same way).
+    @jax.checkpoint
+    def _rows(h, y):
+        # lm_head_matmul, not `@`: bf16 kernels must keep fp32 accumulation
+        logits = lm_head_matmul(h, kernel)
+        if bias is not None:
+            logits = logits + bias
+        if vocab_size is not None and logits.shape[-1] != vocab_size:
+            logits = logits[:, :vocab_size]
+        return _per_token_nll(logits, y, ignore_index, label_smoothing)
+
+    c = _largest_divisor_leq(h2.shape[0], chunks)
+    if chunks > 1 and c < max(2, chunks // 2):
+        import warnings
+
+        warnings.warn(
+            f"fused_linear_cross_entropy: token count {h2.shape[0]} has no "
+            f"divisor near chunks={chunks} (using {c}); the full logits "
+            "tile this API exists to avoid may materialize — pad the "
+            "sequence to a composite length"
+        )
+    nll = chunked(_rows, c, in_axes=(0, 0))(h2, y1)
+    valid = y1 != ignore_index
+    denom = jnp.maximum(valid.sum(), 1)
+    return jnp.where(valid, nll, 0.0).sum() / denom

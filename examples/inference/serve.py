@@ -15,11 +15,13 @@ import argparse
 import jax
 import jax.numpy as jnp
 
+import colossalai_tpu as clt
 from colossalai_tpu.inference import LLMEngine, make_server
 from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
 
 
 def main():
+    clt.launch_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-batch", type=int, default=8)

@@ -12,9 +12,12 @@ from colossalai_tpu.models import GPT2Config, GPT2LMHeadModel
 from colossalai_tpu.nn.lr_scheduler import cosine_annealing_lr
 
 
-def main(steps: int = 20, batch_size: int = 8, seq_len: int = 128):
+def main(steps: int = 20, batch_size: int = 8, seq_len: int = 128,
+         tiny: bool = False):
     clt.launch_from_env()
-    cfg = GPT2Config.gpt2_125m(dtype=jnp.bfloat16)
+    # --tiny exists for CI smoke on weak hosts: same code path, toy widths
+    preset = GPT2Config.tiny if tiny else GPT2Config.gpt2_125m
+    cfg = preset(dtype=jnp.bfloat16)
     model = GPT2LMHeadModel(cfg)
 
     plugin = LowLevelZeroPlugin(stage=1, precision="bf16", max_norm=1.0)
@@ -41,4 +44,14 @@ def main(steps: int = 20, batch_size: int = 8, seq_len: int = 128):
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--tiny", action="store_true",
+                   help="toy model widths for smoke testing")
+    a = p.parse_args()
+    main(steps=a.steps, batch_size=a.batch_size, seq_len=a.seq_len,
+         tiny=a.tiny)

@@ -16,12 +16,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import colossalai_tpu as clt
 from colossalai_tpu.booster import Booster, DataParallelPlugin, HybridParallelPlugin
 from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
 from colossalai_tpu.peft import LoraConfig
 
 
 def main():
+    clt.launch_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--tp", type=int, default=1)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+import colossalai_tpu as clt
 from colossalai_tpu.applications import DPOTrainer
 from colossalai_tpu.booster import HybridParallelPlugin
 from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -30,6 +31,7 @@ def synthetic_pairs(key, n_pairs: int, seq: int, vocab: int):
 
 
 def main():
+    clt.launch_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--pairs", type=int, default=8)

@@ -17,12 +17,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import colossalai_tpu as clt
 from colossalai_tpu.applications import PPOTrainer
 from colossalai_tpu.booster import DataParallelPlugin, HybridParallelPlugin
 from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM, RewardModel
 
 
 def main():
+    clt.launch_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--batch", type=int, default=8)
