@@ -516,3 +516,69 @@ def test_t5_tp2_matches_hf():
         {"input_ids": ids, "decoder_input_ids": dec_ids},
     )
     _assert_close(sharded, theirs, "t5 tp2 logits vs HF torch")
+
+
+def test_llama_sequence_classification_head_matches_hf():
+    """Task heads (≙ *ForSequenceClassification policy rows): our generic
+    SequenceClassifier over the llama backbone must reproduce HF's
+    LlamaForSequenceClassification logits."""
+    from colossalai_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        SequenceClassifier,
+    )
+
+    cfg = LlamaConfig.tiny()
+    n_labels = 5
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        num_labels=n_labels, pad_token_id=0, attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    hf = transformers.LlamaForSequenceClassification(hf_cfg)
+    hf.eval()
+
+    state = _hf_state(hf)
+    score_w = state.pop("score.weight")  # [num_labels, hidden], bias-free
+    # complete the causal-LM map with a dummy head; the hidden-state path
+    # the classifier reads never touches it
+    state["lm_head.weight"] = np.zeros(
+        (cfg.vocab_size, cfg.hidden_size), np.float32
+    )
+    backbone = hf_to_params(state, "llama", cfg.num_hidden_layers, strict=True)
+
+    model = SequenceClassifier(lm=LlamaForCausalLM(cfg), num_labels=n_labels)
+    # the module's only params are the backbone and the score head, both
+    # hand-built here (HF's score is bias-free; ours zeroes the bias)
+    params = {
+        "lm": backbone,
+        "score": {"kernel": jnp.asarray(score_w.T),
+                  "bias": jnp.zeros((n_labels,), jnp.float32)},
+    }
+
+    # ids in [1, vocab): no pad tokens, so HF pools the FINAL position —
+    # exactly our lengths=None convention
+    ids = np.random.RandomState(17).randint(1, cfg.vocab_size, size=(BATCH, SEQ))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)).logits)
+    _assert_close(ours, theirs, "seq-cls logits vs HF torch")
+
+    # right-padded batch: HF pools the last NON-PAD token; ours must agree
+    # through the lengths path (the branch with real convention risk)
+    lengths = np.array([SEQ - 5, SEQ - 2])
+    padded = ids.copy()
+    for row, n in enumerate(lengths):
+        padded[row, n:] = 0  # pad_token_id
+    with torch.no_grad():
+        theirs_pad = hf(torch.from_numpy(padded)).logits.float().numpy()
+    ours_pad = np.asarray(
+        model.apply({"params": params}, jnp.asarray(padded),
+                    lengths=jnp.asarray(lengths)).logits
+    )
+    _assert_close(ours_pad, theirs_pad, "seq-cls padded pooling vs HF torch")
