@@ -6,6 +6,7 @@ had no test at all.
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -90,3 +91,65 @@ def test_two_process_coordinator_bringup(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank} OK" in out
+
+
+_CLI_CHILD = textwrap.dedent(
+    """
+    import os
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import colossalai_tpu as clt
+    key = clt.launch_from_env(verbose=False)   # env contract set by cli run
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pid = jax.process_index()
+    n = jax.device_count()
+    assert jax.process_count() == 2, jax.process_count()
+    mesh = jax.make_mesh((n,), ('dp',))
+    sh = NamedSharding(mesh, P('dp'))
+    nloc = jax.local_device_count()
+    local = np.full((nloc,), float(pid + 1), np.float32)
+    x = jax.make_array_from_process_local_data(sh, local, (n,))
+    total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+    # every process contributes nloc * (pid+1); device counts may vary with
+    # the inherited environment (JAX_NUM_CPU_DEVICES), so derive the target
+    expect = nloc * (1.0 + 2.0)
+    assert float(total) == expect, (float(total), expect)
+    print(f'cli-rank {pid} OK', flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_cli_run_two_processes(tmp_path):
+    """The user-facing launcher end-to-end: ``colossalai_tpu run
+    --num-processes 2`` must spawn workers whose env lands them in one
+    2-process jax.distributed runtime with working cross-process
+    collectives (≙ reference ``colossalai run`` fabricating torchrun
+    commands, ``cli/launcher/run.py:212``)."""
+    script = tmp_path / "cli_child.py"
+    script.write_text(_CLI_CHILD)
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # own session so a timeout can kill the WHOLE tree: the cli's worker
+    # grandchildren inherit the captured pipes, and killing only the cli
+    # would leave communicate() blocked on their open write ends
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "colossalai_tpu.cli", "run",
+         "--num-processes", "2", "--port", str(_free_port()), str(script)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, err = proc.communicate()
+        pytest.fail(f"cli run timed out:\n{out[-2000:]}{err[-2000:]}")
+    assert proc.returncode == 0, out[-2000:] + err[-2000:]
+    for rank in range(2):
+        assert f"cli-rank {rank} OK" in out, out[-2000:]
