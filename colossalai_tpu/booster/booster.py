@@ -53,6 +53,106 @@ class Booster:
             lora=lora,
         )
 
+    def prepare_dataloader(
+        self,
+        dataset: Any,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        seq_len: Optional[int] = None,
+    ):
+        """Iterate per-PROCESS batches of a dataset, sharded for data
+        parallelism (≙ reference ``Plugin.prepare_dataloader`` wiring a
+        ``DistributedSampler``; the JAX form is an index shard per
+        ``jax.process_index``). Feed each yielded batch through
+        ``boosted.shard_batch`` — within one process the plugin's GSPMD
+        shardings place it across local devices.
+
+        ``dataset``: a path string (token file → native
+        :class:`~colossalai_tpu.utils.TokenDataLoader`, requires
+        ``seq_len``; inherently shuffled random crops, seeded per process)
+        or an array / dict-of-arrays with a leading sample axis
+        (epoch-shuffled generator, reshuffled each epoch like a sampler
+        with ``set_epoch``).
+
+        SPMD invariants (the part of ``DistributedSampler`` that matters
+        here): the index set is padded by wrapping so every process yields
+        the SAME number of identically-shaped batches per epoch — ranks
+        can never drift onto different epochs, and shapes stay static so
+        the jitted train step never retraces. With ``drop_last=False`` the
+        final short batch is likewise padded by wrapping (samples repeat)
+        rather than shrinking.
+        """
+        import numpy as np
+
+        if isinstance(dataset, str):
+            if seq_len is None:
+                raise ValueError("token-file datasets need seq_len")
+            if not shuffle:
+                raise ValueError(
+                    "token-file datasets are random-crop loaders; "
+                    "shuffle=False is not supported"
+                )
+            from colossalai_tpu.utils import TokenDataLoader
+
+            tok = TokenDataLoader(
+                dataset, seq_len, batch_size,
+                seed=seed + jax.process_index(),
+            )
+
+            def _tok_batches():
+                for b in tok:
+                    yield {"input_ids": np.asarray(b)}
+
+            return _tok_batches()
+
+        arrays = dataset if isinstance(dataset, dict) else {"input_ids": dataset}
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        lens = {k: v.shape[0] for k, v in arrays.items()}
+        if not lens:
+            raise ValueError("empty dataset dict")
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"leading dims disagree across keys: {lens}")
+        n = next(iter(lens.values()))
+        if n == 0:
+            raise ValueError("dataset has zero samples")
+        rank, world = jax.process_index(), jax.process_count()
+        # per-rank shard length after wrap-padding the epoch to `world`
+        per_rank = -(-n // world)
+        if drop_last and per_rank < batch_size:
+            raise ValueError(
+                f"dataset of {n} samples yields {per_rank} per process — "
+                f"fewer than batch_size={batch_size}; with drop_last=True "
+                "every epoch would produce ZERO batches (use "
+                "drop_last=False to wrap-pad, or shrink the batch)"
+            )
+
+        def _epochs():
+            epoch = 0
+            while True:
+                idx = np.arange(n)
+                if shuffle:
+                    np.random.RandomState(seed + epoch).shuffle(idx)
+                # pad by wrapping so every rank gets an equal shard
+                # (np.resize tiles, so datasets smaller than world work)
+                idx = np.resize(idx, len(idx) + (-len(idx)) % world)
+                local = idx[rank::world]
+                if drop_last:
+                    stop = len(local) // batch_size * batch_size
+                else:
+                    # keep the tail, padded by wrapping to a full batch
+                    local = np.resize(
+                        local, len(local) + (-len(local)) % batch_size
+                    )
+                    stop = len(local)
+                for i in range(0, stop, batch_size):
+                    sel = local[i:i + batch_size]
+                    yield {k: v[sel] for k, v in arrays.items()}
+                epoch += 1
+
+        return _epochs()
+
     # Checkpoint entry points (≙ booster/booster.py:121-124)
     @property
     def checkpoint_io(self):

@@ -22,21 +22,36 @@ _DIST_INITIALIZED = False
 def _enforce_env_platform() -> None:
     """Make ``JAX_PLATFORMS`` from the environment BINDING.
 
-    A site plugin (e.g. a tunneled-TPU sitecustomize) can pre-import jax and
-    re-pin the platform after the user's environment was read; the observed
-    failure is a child process launched with ``JAX_PLATFORMS=cpu`` whose
-    first ``jax.devices()`` still dials the (possibly unreachable) tunneled
-    backend and blocks forever at 0% CPU. ``jax.config.update`` wins over
-    any import-time pinning, so the launcher re-asserts the user's choice
-    before the first backend touch. No-op when the env var is unset or the
+    A site plugin (e.g. a tunneled-TPU sitecustomize) can pre-import jax
+    and PREPEND its platform to the config after the user's environment was
+    read — measured: a child launched with ``JAX_PLATFORMS=cpu`` boots with
+    ``jax.config.jax_platforms == 'axon,cpu'``, so the first
+    ``jax.devices()`` dials the (possibly unreachable) tunneled backend and
+    blocks forever at 0% CPU. The launcher therefore narrows the config
+    back to the env value before the first backend touch — but ONLY when
+    every platform the env names is already in the current config list
+    (the plugin-padded-superset shape). If the user explicitly moved to a
+    platform the env doesn't sanction (``jax.config.update('jax_platforms',
+    'cpu')`` under an ambient ``JAX_PLATFORMS=tpu``), the config and env
+    are disjoint and the user's in-process choice is left alone — most
+    recent explicit intent wins. No-op when the env var is unset or the
     backend is already initialized (too late to change — jax raises).
     """
     plats = os.environ.get("JAX_PLATFORMS", "").strip()
     if not plats:
         return
+    cur = getattr(jax.config, "jax_platforms", None) or ""
+    cur_list = [p.strip() for p in cur.split(",") if p.strip()]
+    want = [p.strip() for p in plats.split(",") if p.strip()]
+    if cur == plats:
+        return
+    # empty config = no explicit in-process choice exists: enforce the env;
+    # non-empty and NOT a superset of the env = the user moved elsewhere
+    # deliberately: respect it
+    if cur_list and not all(p in cur_list for p in want):
+        return
     try:
-        if jax.config.jax_platforms != plats:
-            jax.config.update("jax_platforms", plats)
+        jax.config.update("jax_platforms", plats)
     except Exception:  # backend already up: keep whatever is running
         pass
 
