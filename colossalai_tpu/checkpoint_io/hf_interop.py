@@ -304,6 +304,34 @@ _FALCON = _spec(
     vocab_keys=("transformer.word_embeddings.weight", "lm_head.weight"),
 )
 
+_GPT_NEOX = _spec(
+    "layers",
+    [
+        ("gpt_neox.embed_in.weight", "embed_tokens.embedding", "raw"),
+        ("gpt_neox.final_layer_norm.weight", "norm.scale", "raw"),
+        ("gpt_neox.final_layer_norm.bias", "norm.bias", "raw"),
+        ("embed_out.weight", "lm_head.kernel", "linear"),
+    ],
+    [
+        # per-head-interleaved fused qkv, the bloom layout
+        ("gpt_neox.layers.{i}.attention.query_key_value.weight", "self_attn", "qkv_interleaved"),
+        ("gpt_neox.layers.{i}.attention.query_key_value.bias", "self_attn", "qkv_interleaved_bias"),
+        ("gpt_neox.layers.{i}.attention.dense.weight", "self_attn.o_proj.kernel", "linear"),
+        ("gpt_neox.layers.{i}.attention.dense.bias", "self_attn.o_proj.bias", "raw"),
+        # parallel residual with SEPARATE norms: ln1 feeds attn, ln2 feeds mlp
+        ("gpt_neox.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("gpt_neox.layers.{i}.input_layernorm.bias", "input_layernorm.bias", "raw"),
+        ("gpt_neox.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+        ("gpt_neox.layers.{i}.post_attention_layernorm.bias", "post_attention_layernorm.bias", "raw"),
+        ("gpt_neox.layers.{i}.mlp.dense_h_to_4h.weight", "mlp.fc_in.kernel", "linear"),
+        ("gpt_neox.layers.{i}.mlp.dense_h_to_4h.bias", "mlp.fc_in.bias", "raw"),
+        ("gpt_neox.layers.{i}.mlp.dense_4h_to_h.weight", "mlp.fc_out.kernel", "linear"),
+        ("gpt_neox.layers.{i}.mlp.dense_4h_to_h.bias", "mlp.fc_out.bias", "raw"),
+    ],
+    vocab_keys=("gpt_neox.embed_in.weight", "embed_out.weight"),
+    tied_keys=("embed_out.weight",),  # neox names its head embed_out
+)
+
 _T5 = FamilySpec(
     top=(
         ("shared.weight", "shared.embedding", "raw"),
@@ -463,6 +491,7 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "opt": _OPT,
     "bloom": _BLOOM,
     "falcon": _FALCON,
+    "gpt_neox": _GPT_NEOX,
     "t5": _T5,
     "whisper": _WHISPER,
 }

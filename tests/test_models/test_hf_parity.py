@@ -582,3 +582,33 @@ def test_llama_sequence_classification_head_matches_hf():
                     lengths=jnp.asarray(lengths)).logits
     )
     _assert_close(ours_pad, theirs_pad, "seq-cls padded pooling vs HF torch")
+
+
+def test_gpt_neox_matches_hf():
+    """Parallel-residual + separate norms + partial rotary (0.25) + fused
+    interleaved qkv — the pythia/neox shape of the feature matrix."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["gpt_neox"]
+    cfg = cfg_cls.tiny()
+    heads = (cfg.num_attention_heads, cfg.num_attention_heads,
+             cfg.hidden_size // cfg.num_attention_heads)
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        max_position_embeddings=128,
+        rotary_pct=cfg.rotary_pct, rotary_emb_base=10000,
+        use_parallel_residual=True, layer_norm_eps=cfg.norm_eps,
+        hidden_act="gelu", tie_word_embeddings=False,
+        attention_dropout=0.0, hidden_dropout=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(21)
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg)
+    params = hf_to_params(
+        _hf_state(hf), "gpt_neox", cfg.num_hidden_layers,
+        heads=heads, strict=True,
+    )
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
