@@ -67,6 +67,10 @@ def expand_vocab(params: Any, config: Any, new_vocab_size: int,
             return grow(leaf, path, 0)
         if any(n in parts for n in _HEAD_NAMES) and leaf.ndim == 2 and leaf.shape[-1] == old_rows:
             return grow(leaf, path, leaf.ndim - 1)
+        if (any(n in parts for n in _HEAD_NAMES) and leaf.ndim == 1
+                and leaf.shape[0] == old_rows):
+            # phi/gpt-j lm_head bias: a vocab-dim vector grows too
+            return grow(leaf, path, 0)
         return leaf
 
     new_params = jax.tree_util.tree_map_with_path(visit, params)

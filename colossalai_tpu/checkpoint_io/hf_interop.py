@@ -332,6 +332,60 @@ _GPT_NEOX = _spec(
     tied_keys=("embed_out.weight",),  # neox names its head embed_out
 )
 
+_PHI = _spec(
+    "layers",
+    [
+        ("model.embed_tokens.weight", "embed_tokens.embedding", "raw"),
+        ("model.final_layernorm.weight", "norm.scale", "raw"),
+        ("model.final_layernorm.bias", "norm.bias", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+        ("lm_head.bias", "lm_head.bias", "raw"),
+    ],
+    [
+        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.dense.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.dense.bias", "self_attn.o_proj.bias", "raw"),
+        # parallel attn+mlp sharing ONE layernorm
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("model.layers.{i}.input_layernorm.bias", "input_layernorm.bias", "raw"),
+        ("model.layers.{i}.mlp.fc1.weight", "mlp.fc_in.kernel", "linear"),
+        ("model.layers.{i}.mlp.fc1.bias", "mlp.fc_in.bias", "raw"),
+        ("model.layers.{i}.mlp.fc2.weight", "mlp.fc_out.kernel", "linear"),
+        ("model.layers.{i}.mlp.fc2.bias", "mlp.fc_out.bias", "raw"),
+    ],
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight", "lm_head.bias"),
+)
+
+_GPTJ = _spec(
+    "layers",
+    [
+        ("transformer.wte.weight", "embed_tokens.embedding", "raw"),
+        ("transformer.ln_f.weight", "norm.scale", "raw"),
+        ("transformer.ln_f.bias", "norm.bias", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+        ("lm_head.bias", "lm_head.bias", "raw"),
+    ],
+    [
+        ("transformer.h.{i}.attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("transformer.h.{i}.attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("transformer.h.{i}.attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("transformer.h.{i}.attn.out_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        # parallel attn+mlp sharing ONE layernorm (ln_1)
+        ("transformer.h.{i}.ln_1.weight", "input_layernorm.scale", "raw"),
+        ("transformer.h.{i}.ln_1.bias", "input_layernorm.bias", "raw"),
+        ("transformer.h.{i}.mlp.fc_in.weight", "mlp.fc_in.kernel", "linear"),
+        ("transformer.h.{i}.mlp.fc_in.bias", "mlp.fc_in.bias", "raw"),
+        ("transformer.h.{i}.mlp.fc_out.weight", "mlp.fc_out.kernel", "linear"),
+        ("transformer.h.{i}.mlp.fc_out.bias", "mlp.fc_out.bias", "raw"),
+    ],
+    vocab_keys=("transformer.wte.weight", "lm_head.weight", "lm_head.bias"),
+)
+
 _T5 = FamilySpec(
     top=(
         ("shared.weight", "shared.embedding", "raw"),
@@ -492,6 +546,8 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "bloom": _BLOOM,
     "falcon": _FALCON,
     "gpt_neox": _GPT_NEOX,
+    "phi": _PHI,
+    "gptj": _GPTJ,
     "t5": _T5,
     "whisper": _WHISPER,
 }

@@ -98,6 +98,7 @@ class LMHead(nn.Module):
 
     features: int
     param_dtype: Any = None
+    use_bias: bool = False  # phi/gpt-j carry an lm_head bias
 
     @nn.compact
     def __call__(self, x):
@@ -105,7 +106,14 @@ class LMHead(nn.Module):
             "kernel", nn.initializers.lecun_normal(),
             (x.shape[-1], self.features), self.param_dtype or jnp.float32,
         )
-        return lm_head_matmul(x, kernel)
+        logits = lm_head_matmul(x, kernel)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,),
+                self.param_dtype or jnp.float32,
+            )
+            logits = logits + bias.astype(logits.dtype)
+        return logits
 
 
 def lm_head_matmul(x, kernel):

@@ -135,6 +135,7 @@ class GPTJConfig(DecoderConfig):
     attention_out_bias: bool = False
     mlp_bias: bool = True
     act_fn: str = "gelu_new"
+    lm_head_bias: bool = True
 
     @classmethod
     def gptj_6b(cls, **kw):
@@ -232,6 +233,7 @@ class PhiConfig(DecoderConfig):
     parallel_block: bool = True
     parallel_norm_shared: bool = True
     act_fn: str = "gelu_new"
+    lm_head_bias: bool = True
 
     @classmethod
     def phi_2(cls, **kw):

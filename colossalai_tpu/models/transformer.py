@@ -76,6 +76,7 @@ class DecoderConfig(ModelConfig):
     embedding_scale: Optional[float] = None  # Gemma sqrt(hidden)
     logit_scale: Optional[float] = None  # Cohere
     tie_word_embeddings: bool = False
+    lm_head_bias: bool = False  # phi / gpt-j head bias (untied head only)
     sliding_window: Optional[int] = None
     #: every Nth layer attends globally, the rest within sliding_window
     #: (Gemma-2 alternating local/global; 1 = window on every layer)
@@ -341,7 +342,8 @@ class DecoderLM(nn.Module):
         if cfg.tie_word_embeddings:
             logits = lm_head_matmul(x, embed.embedding.T)
         else:
-            logits = LMHead(cfg.padded_vocab_size_, pdtype, name="lm_head")(x)
+            logits = LMHead(cfg.padded_vocab_size_, pdtype,
+                            use_bias=cfg.lm_head_bias, name="lm_head")(x)
         if cfg.logit_scale is not None:
             logits = logits * cfg.logit_scale
         if cfg.final_logit_softcap is not None:

@@ -22,5 +22,6 @@ class DecoderPolicy(Policy):
         (r"(o_proj|down_proj|fc_out)/kernel$", ("tp", None)),
         (r"(o_proj|down_proj|fc_out)/bias$", ()),
         (r"lm_head/kernel$", (None, "tp")),
+        (r"lm_head/bias$", ("tp",)),  # vocab dim, follows the kernel
         (r"(input_layernorm|post_attention_layernorm|embed_layernorm|norm)/(scale|bias)$", ()),
     ]

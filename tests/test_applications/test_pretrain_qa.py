@@ -144,3 +144,25 @@ def test_vector_store_topk_ordering():
     hits = vs.search(jnp.asarray([1.0, 0.2, 0.0, 0.0]), k=2)
     assert hits[0][0] == "a" and hits[1][0] == "b"
     assert hits[0][1] > hits[1][1]
+
+
+def test_expand_vocab_grows_head_bias():
+    """phi/gpt-j carry a vocab-dim lm_head bias; expansion must grow it in
+    lockstep with the kernel or the rebuilt model fails shape-checking."""
+    import jax
+    import jax.numpy as jnp
+
+    from colossalai_tpu.applications.pretrain import expand_vocab
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["phi"]
+    cfg = cfg_cls.tiny()
+    params = model_cls(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    new_params, new_cfg = expand_vocab(params, cfg, cfg.vocab_size + 7)
+    assert new_params["lm_head"]["bias"].shape == (new_cfg.vocab_size,)
+    out = model_cls(new_cfg).apply(
+        {"params": new_params}, jnp.ones((1, 8), jnp.int32)
+    )
+    assert out.logits.shape[-1] == new_cfg.vocab_size

@@ -612,3 +612,53 @@ def test_gpt_neox_matches_hf():
         heads=heads, strict=True,
     )
     _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_phi_matches_hf():
+    """Phi: parallel attn+MLP under ONE shared layernorm, partial rotary
+    (0.4, half-split), biased lm_head."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["phi"]
+    cfg = cfg_cls.tiny()
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        max_position_embeddings=128,
+        partial_rotary_factor=cfg.rotary_pct, rope_theta=cfg.rope_theta,
+        layer_norm_eps=cfg.norm_eps, hidden_act="gelu_new",
+        tie_word_embeddings=False, qk_layernorm=False,
+        attention_dropout=0.0, hidden_dropout=0.0, resid_pdrop=0.0,
+        embd_pdrop=0.0, attn_implementation="eager",
+    )
+    torch.manual_seed(23)
+    hf = transformers.PhiForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "phi", cfg.num_hidden_layers,
+                          strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_gptj_matches_hf():
+    """GPT-J: INTERLEAVED partial rotary (rotate-every-two), parallel block
+    with one LN, bias-free attention, biased MLP and lm_head."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["gptj"]
+    cfg = cfg_cls.tiny()
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=cfg.vocab_size, n_embd=cfg.hidden_size,
+        n_inner=cfg.intermediate_size, n_layer=cfg.num_hidden_layers,
+        n_head=cfg.num_attention_heads, n_positions=128,
+        rotary_dim=int(hd * cfg.rotary_pct),
+        layer_norm_epsilon=cfg.norm_eps, activation_function="gelu_new",
+        tie_word_embeddings=False, resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0, attn_implementation="eager",
+    )
+    torch.manual_seed(24)
+    hf = transformers.GPTJForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "gptj", cfg.num_hidden_layers,
+                          strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
