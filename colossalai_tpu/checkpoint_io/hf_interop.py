@@ -386,6 +386,38 @@ _GPTJ = _spec(
     vocab_keys=("transformer.wte.weight", "lm_head.weight", "lm_head.bias"),
 )
 
+# Command-R: parallel block under ONE bias-free LayerNorm, tied embeddings
+_COHERE = _spec(
+    "layers",
+    _LLAMA_TOP,
+    [
+        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel", "linear"),
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
+# StableLM-2: llama MLP + LayerNorm with biases + optional qkv biases
+_STABLELM = _spec(
+    "layers",
+    _LLAMA_TOP + [
+        ("model.norm.bias", "norm.bias", "raw"),
+    ],
+    _LLAMA_LAYER + [
+        ("model.layers.{i}.input_layernorm.bias", "input_layernorm.bias", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.bias", "post_attention_layernorm.bias", "raw"),
+    ],
+    optional=_LLAMA_OPTIONAL,
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
 _T5 = FamilySpec(
     top=(
         ("shared.weight", "shared.embedding", "raw"),
@@ -548,6 +580,8 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "gpt_neox": _GPT_NEOX,
     "phi": _PHI,
     "gptj": _GPTJ,
+    "cohere": _COHERE,
+    "stablelm": _STABLELM,
     "t5": _T5,
     "whisper": _WHISPER,
 }

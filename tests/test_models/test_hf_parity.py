@@ -662,3 +662,79 @@ def test_gptj_matches_hf():
     params = hf_to_params(_hf_state(hf), "gptj", cfg.num_hidden_layers,
                           strict=True)
     _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_gemma_matches_hf():
+    """Gemma-1: (1+scale) RMSNorm, GeGLU, sqrt(hidden) embedding scale,
+    wide head_dim, tied embeddings."""
+    from colossalai_tpu.models import GemmaConfig, GemmaForCausalLM
+
+    cfg = GemmaConfig.tiny()
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads or cfg.num_attention_heads,
+        head_dim=cfg.head_dim, max_position_embeddings=128,
+        rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+        hidden_act="gelu_pytorch_tanh", tie_word_embeddings=True,
+        attention_dropout=0.0, attn_implementation="eager",
+    )
+    torch.manual_seed(25)
+    hf = transformers.GemmaForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "gemma", cfg.num_hidden_layers,
+                          tie_word_embeddings=True, strict=True)
+    _check_parity(hf, GemmaForCausalLM(cfg), params, cfg.vocab_size)
+
+
+def test_cohere_matches_hf():
+    """Command-R: parallel attn+MLP under one bias-free LayerNorm,
+    interleaved rotary, logit scale, tied embeddings."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["cohere"]
+    cfg = cfg_cls.tiny()
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_attention_heads,
+        max_position_embeddings=128, rope_theta=cfg.rope_theta,
+        layer_norm_eps=cfg.norm_eps, logit_scale=cfg.logit_scale,
+        use_qk_norm=False, tie_word_embeddings=True,
+        attention_dropout=0.0, attn_implementation="eager",
+    )
+    torch.manual_seed(26)
+    hf = transformers.CohereForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "cohere", cfg.num_hidden_layers,
+                          tie_word_embeddings=True, strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_stablelm_matches_hf():
+    """StableLM-2: LayerNorm(+bias) + SiLU-GLU + partial rotary 0.25 +
+    qkv biases."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["stablelm"]
+    cfg = cfg_cls.tiny()
+    hf_cfg = transformers.StableLmConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads or cfg.num_attention_heads,
+        max_position_embeddings=128, rope_theta=cfg.rope_theta,
+        partial_rotary_factor=cfg.rotary_pct, layer_norm_eps=cfg.norm_eps,
+        use_qkv_bias=True, use_parallel_residual=False,
+        qk_layernorm=False, tie_word_embeddings=False,
+        attention_dropout=0.0, hidden_dropout=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(27)
+    hf = transformers.StableLmForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "stablelm", cfg.num_hidden_layers,
+                          strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
