@@ -14,6 +14,8 @@ per-model ``modeling`` name conventions. One declarative spec per family:
     interleaving ↔ our split q/k/v (needs ``heads``)
   - "qkv_grouped": Falcon fused query_key_value, per-kv-group
     [q…q k v] layout (MQA = 1 group) ↔ our split q/k/v (needs ``heads``)
+  - "qkv_concat": MPT Wqkv, plain [q_all; k_all; v_all] block concat
+    ↔ our split q/k/v (needs ``heads``)
 - multiple scanned stacks (T5/Whisper encoder+decoder, DeepSeek
   dense_layers+layers) with per-stack HF layer-index offsets;
 - optional entries (qkv biases, lm_head) are skipped when absent on either
@@ -418,6 +420,58 @@ _STABLELM = _spec(
     vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
+# StarCoder2: GPT-2-ish body (LayerNorm+bias, plain-gelu MLP, biases
+# everywhere) with RoPE + GQA + sliding window
+_STARCODER2 = _spec(
+    "layers",
+    [
+        ("model.embed_tokens.weight", "embed_tokens.embedding", "raw"),
+        ("model.norm.weight", "norm.scale", "raw"),
+        ("model.norm.bias", "norm.bias", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ],
+    [
+        ("model.layers.{i}.self_attn.q_proj.weight", "self_attn.q_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.q_proj.bias", "self_attn.q_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.k_proj.weight", "self_attn.k_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.k_proj.bias", "self_attn.k_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.v_proj.weight", "self_attn.v_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.v_proj.bias", "self_attn.v_proj.bias", "raw"),
+        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.self_attn.o_proj.bias", "self_attn.o_proj.bias", "raw"),
+        ("model.layers.{i}.mlp.c_fc.weight", "mlp.fc_in.kernel", "linear"),
+        ("model.layers.{i}.mlp.c_fc.bias", "mlp.fc_in.bias", "raw"),
+        ("model.layers.{i}.mlp.c_proj.weight", "mlp.fc_out.kernel", "linear"),
+        ("model.layers.{i}.mlp.c_proj.bias", "mlp.fc_out.bias", "raw"),
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("model.layers.{i}.input_layernorm.bias", "input_layernorm.bias", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.bias", "post_attention_layernorm.bias", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
+# MPT: ALiBi, bias-free everything, block-concat fused Wqkv, tied head
+_MPT = _spec(
+    "layers",
+    [
+        ("transformer.wte.weight", "embed_tokens.embedding", "raw"),
+        ("transformer.norm_f.weight", "norm.scale", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ],
+    [
+        ("transformer.blocks.{i}.attn.Wqkv.weight", "self_attn", "qkv_concat"),
+        ("transformer.blocks.{i}.attn.out_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("transformer.blocks.{i}.norm_1.weight", "input_layernorm.scale", "raw"),
+        ("transformer.blocks.{i}.norm_2.weight", "post_attention_layernorm.scale", "raw"),
+        ("transformer.blocks.{i}.ffn.up_proj.weight", "mlp.fc_in.kernel", "linear"),
+        ("transformer.blocks.{i}.ffn.down_proj.weight", "mlp.fc_out.kernel", "linear"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("transformer.wte.weight", "lm_head.weight"),
+)
+
 _T5 = FamilySpec(
     top=(
         ("shared.weight", "shared.embedding", "raw"),
@@ -582,6 +636,8 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "gptj": _GPTJ,
     "cohere": _COHERE,
     "stablelm": _STABLELM,
+    "starcoder2": _STARCODER2,
+    "mpt": _MPT,
     "t5": _T5,
     "whisper": _WHISPER,
 }
@@ -619,6 +675,17 @@ def _need_heads(heads, family, kind):
 def _split_qkv(arr, kind, heads, family):
     nh, nkv, hd = _need_heads(heads, family, kind)
     bias = arr.ndim == 1
+    if kind.startswith("qkv_concat"):
+        # mpt Wqkv: plain [q_all; k_all; v_all] block concat, no per-head
+        # interleaving
+        qr, kvr = nh * hd, nkv * hd
+        if arr.shape[0] != qr + 2 * kvr:
+            raise ValueError(
+                f"{family}: fused qkv has {arr.shape[0]} rows, expected "
+                f"{qr + 2 * kvr} from heads=({nh}, {nkv}, {hd})"
+            )
+        q, k, v = arr[:qr], arr[qr:qr + kvr], arr[qr + kvr:]
+        return (q, k, v) if bias else (q.T, k.T, v.T)
     if kind.startswith("qkv_interleaved"):
         # bloom: rows grouped per head as [q k v] blocks of head_dim
         lead = arr.reshape(nh, 3, hd) if bias else arr.reshape(nh, 3, hd, -1)
@@ -641,6 +708,9 @@ def _split_qkv(arr, kind, heads, family):
 def _join_qkv(q, k, v, kind, heads, family):
     nh, nkv, hd = _need_heads(heads, family, kind)
     bias = q.ndim == 1
+    if kind.startswith("qkv_concat"):
+        return (np.concatenate([q, k, v]) if bias
+                else np.concatenate([q.T, k.T, v.T], axis=0))
 
     def lead(x, n):  # → [n, hd] (bias) or [n, hd, hidden]
         return x.reshape(n, hd) if bias else x.T.reshape(n, hd, -1)

@@ -320,3 +320,39 @@ def test_deepseek_v3_roundtrip():
     for kp, leaf in flat_a:
         assert kp in flat_b, kp
         np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp], err_msg=str(kp))
+
+
+def test_new_decoder_families_roundtrip():
+    """gpt_neox/phi/gptj/cohere/stablelm/starcoder2: export -> import must
+    be bit-exact for every leaf (covers the lm_head bias and the
+    per-family layout quirks)."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    for family in ("phi", "gptj", "cohere", "stablelm", "starcoder2"):
+        model_cls, cfg_cls = FAMILY_MODELS[family]
+        cfg = cfg_cls.tiny()
+        kw = {}
+        if cfg.tie_word_embeddings:
+            kw["tie_word_embeddings"] = True
+        hf = _roundtrip(family, model_cls(cfg), cfg, **kw)
+        assert hf, family
+
+
+def test_gpt_neox_and_mpt_fused_qkv_roundtrip():
+    """The two remaining fused layouts: neox per-head interleaved and mpt
+    block-concat — the EXPORT (join) direction is only reachable here."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    for family, fused_key in (
+        ("gpt_neox", "gpt_neox.layers.0.attention.query_key_value.weight"),
+        ("mpt", "transformer.blocks.0.attn.Wqkv.weight"),
+    ):
+        model_cls, cfg_cls = FAMILY_MODELS[family]
+        cfg = cfg_cls.tiny()
+        heads = (cfg.num_attention_heads, cfg.num_attention_heads,
+                 cfg.hidden_size // cfg.num_attention_heads)
+        kw = {"heads": heads}
+        if cfg.tie_word_embeddings:
+            kw["tie_word_embeddings"] = True
+        hf = _roundtrip(family, model_cls(cfg), cfg, **kw)
+        assert hf[fused_key].shape == (3 * cfg.hidden_size, cfg.hidden_size)

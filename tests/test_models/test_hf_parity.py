@@ -738,3 +738,57 @@ def test_stablelm_matches_hf():
     params = hf_to_params(_hf_state(hf), "stablelm", cfg.num_hidden_layers,
                           strict=True)
     _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_starcoder2_matches_hf():
+    """StarCoder2: RoPE + GQA + sliding window on a GPT-2-ish biased body."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["starcoder2"]
+    cfg = cfg_cls.tiny()
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=128, rope_theta=cfg.rope_theta,
+        sliding_window=cfg.sliding_window, norm_epsilon=cfg.norm_eps,
+        hidden_act="gelu_pytorch_tanh", use_bias=True,
+        tie_word_embeddings=False, residual_dropout=0.0,
+        embedding_dropout=0.0, attention_dropout=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(28)
+    hf = transformers.Starcoder2ForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "starcoder2", cfg.num_hidden_layers,
+                          strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_mpt_matches_hf():
+    """MPT: ALiBi attention bias, bias-free LayerNorm body, block-concat
+    fused Wqkv, tied head."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["mpt"]
+    # HF's MptMLP hardcodes 4*d_model, ignoring expansion_ratio — match it
+    cfg = cfg_cls.tiny(intermediate_size=256)
+    heads = (cfg.num_attention_heads, cfg.num_attention_heads,
+             cfg.hidden_size // cfg.num_attention_heads)
+    hf_cfg = transformers.MptConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.hidden_size,
+        n_heads=cfg.num_attention_heads, n_layers=cfg.num_hidden_layers,
+        expansion_ratio=cfg.intermediate_size // cfg.hidden_size,
+        max_seq_len=128, layer_norm_epsilon=cfg.norm_eps,
+        attn_config=transformers.models.mpt.configuration_mpt.MptAttentionConfig(
+            attn_pdrop=0.0, alibi=True, qk_ln=False,
+        ),
+        emb_pdrop=0.0, resid_pdrop=0.0, no_bias=True,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(29)
+    hf = transformers.MptForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "mpt", cfg.num_hidden_layers,
+                          heads=heads, tie_word_embeddings=True, strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
