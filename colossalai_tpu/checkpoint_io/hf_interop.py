@@ -420,6 +420,36 @@ _STABLELM = _spec(
     vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
+# SantaCoder/StarCoder-1: GPT-2 body (learned positions, torch Linear not
+# Conv1D) with multi-query attention — fused c_attn is [q_all; k; v] block
+# concat with ONE kv head
+_GPT_BIGCODE = _spec(
+    "layers",
+    [
+        ("transformer.wte.weight", "embed_tokens.embedding", "raw"),
+        ("transformer.wpe.weight", "embed_positions.embedding", "raw"),
+        ("transformer.ln_f.weight", "norm.scale", "raw"),
+        ("transformer.ln_f.bias", "norm.bias", "raw"),
+        ("lm_head.weight", "lm_head.kernel", "linear"),
+    ],
+    [
+        ("transformer.h.{i}.attn.c_attn.weight", "self_attn", "qkv_concat"),
+        ("transformer.h.{i}.attn.c_attn.bias", "self_attn", "qkv_concat_bias"),
+        ("transformer.h.{i}.attn.c_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("transformer.h.{i}.attn.c_proj.bias", "self_attn.o_proj.bias", "raw"),
+        ("transformer.h.{i}.ln_1.weight", "input_layernorm.scale", "raw"),
+        ("transformer.h.{i}.ln_1.bias", "input_layernorm.bias", "raw"),
+        ("transformer.h.{i}.ln_2.weight", "post_attention_layernorm.scale", "raw"),
+        ("transformer.h.{i}.ln_2.bias", "post_attention_layernorm.bias", "raw"),
+        ("transformer.h.{i}.mlp.c_fc.weight", "mlp.fc_in.kernel", "linear"),
+        ("transformer.h.{i}.mlp.c_fc.bias", "mlp.fc_in.bias", "raw"),
+        ("transformer.h.{i}.mlp.c_proj.weight", "mlp.fc_out.kernel", "linear"),
+        ("transformer.h.{i}.mlp.c_proj.bias", "mlp.fc_out.bias", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("transformer.wte.weight", "lm_head.weight"),
+)
+
 # StarCoder2: GPT-2-ish body (LayerNorm+bias, plain-gelu MLP, biases
 # everywhere) with RoPE + GQA + sliding window
 _STARCODER2 = _spec(
@@ -638,6 +668,7 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "stablelm": _STABLELM,
     "starcoder2": _STARCODER2,
     "mpt": _MPT,
+    "gpt_bigcode": _GPT_BIGCODE,
     "t5": _T5,
     "whisper": _WHISPER,
 }

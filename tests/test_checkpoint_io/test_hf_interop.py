@@ -338,21 +338,27 @@ def test_new_decoder_families_roundtrip():
         assert hf, family
 
 
-def test_gpt_neox_and_mpt_fused_qkv_roundtrip():
-    """The two remaining fused layouts: neox per-head interleaved and mpt
-    block-concat — the EXPORT (join) direction is only reachable here."""
+def test_fused_qkv_export_roundtrip():
+    """Fused-qkv EXPORT (join) coverage: neox per-head interleaved, mpt
+    block-concat, and bigcode MQA block-concat (+bias) — the join
+    direction is only reachable here."""
     from colossalai_tpu.models import FAMILY_MODELS
 
     for family, fused_key in (
         ("gpt_neox", "gpt_neox.layers.0.attention.query_key_value.weight"),
         ("mpt", "transformer.blocks.0.attn.Wqkv.weight"),
+        ("gpt_bigcode", "transformer.h.0.attn.c_attn.weight"),
     ):
         model_cls, cfg_cls = FAMILY_MODELS[family]
         cfg = cfg_cls.tiny()
-        heads = (cfg.num_attention_heads, cfg.num_attention_heads,
-                 cfg.hidden_size // cfg.num_attention_heads)
+        nkv = cfg.num_key_value_heads or cfg.num_attention_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        heads = (cfg.num_attention_heads, nkv, hd)
         kw = {"heads": heads}
         if cfg.tie_word_embeddings:
             kw["tie_word_embeddings"] = True
         hf = _roundtrip(family, model_cls(cfg), cfg, **kw)
-        assert hf[fused_key].shape == (3 * cfg.hidden_size, cfg.hidden_size)
+        # rows = q (all heads) + 2 * kv groups (mqa: nkv=1 for bigcode)
+        assert hf[fused_key].shape == (
+            cfg.hidden_size + 2 * nkv * hd, cfg.hidden_size
+        )

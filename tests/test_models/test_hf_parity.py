@@ -792,3 +792,29 @@ def test_mpt_matches_hf():
     params = hf_to_params(_hf_state(hf), "mpt", cfg.num_hidden_layers,
                           heads=heads, tie_word_embeddings=True, strict=True)
     _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
+
+
+def test_gpt_bigcode_matches_hf():
+    """SantaCoder/StarCoder-1: multi-query attention (one kv head) with a
+    [q_all; k; v] fused c_attn, learned positions, tied head."""
+    from colossalai_tpu.models import FAMILY_MODELS
+
+    model_cls, cfg_cls = FAMILY_MODELS["gpt_bigcode"]
+    cfg = cfg_cls.tiny()
+    heads = (cfg.num_attention_heads, cfg.num_key_value_heads,
+             cfg.hidden_size // cfg.num_attention_heads)
+    hf_cfg = transformers.GPTBigCodeConfig(
+        vocab_size=cfg.vocab_size, n_embd=cfg.hidden_size,
+        n_inner=cfg.intermediate_size, n_layer=cfg.num_hidden_layers,
+        n_head=cfg.num_attention_heads, n_positions=128,
+        multi_query=True, layer_norm_epsilon=cfg.norm_eps,
+        activation_function="gelu_pytorch_tanh", tie_word_embeddings=True,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(30)
+    hf = transformers.GPTBigCodeForCausalLM(hf_cfg)
+    params = hf_to_params(_hf_state(hf), "gpt_bigcode",
+                          cfg.num_hidden_layers, heads=heads,
+                          tie_word_embeddings=True, strict=True)
+    _check_parity(hf, model_cls(cfg), params, cfg.vocab_size)
