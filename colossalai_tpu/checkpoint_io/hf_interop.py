@@ -420,6 +420,40 @@ _STABLELM = _spec(
     vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
 )
 
+# BERT encoder (maps the bare HF BertModel; task heads are generic
+# wrappers on our side, not per-task specs)
+_BERT = _spec(
+    "encoder",
+    [
+        ("embeddings.word_embeddings.weight", "word_embeddings.embedding", "raw"),
+        ("embeddings.position_embeddings.weight", "position_embeddings.embedding", "raw"),
+        ("embeddings.token_type_embeddings.weight", "token_type_embeddings.embedding", "raw"),
+        ("embeddings.LayerNorm.weight", "embeddings_norm.scale", "raw"),
+        ("embeddings.LayerNorm.bias", "embeddings_norm.bias", "raw"),
+        ("pooler.dense.weight", "pooler.kernel", "linear"),
+        ("pooler.dense.bias", "pooler.bias", "raw"),
+    ],
+    [
+        ("encoder.layer.{i}.attention.self.query.weight", "query.kernel", "linear"),
+        ("encoder.layer.{i}.attention.self.query.bias", "query.bias", "raw"),
+        ("encoder.layer.{i}.attention.self.key.weight", "key.kernel", "linear"),
+        ("encoder.layer.{i}.attention.self.key.bias", "key.bias", "raw"),
+        ("encoder.layer.{i}.attention.self.value.weight", "value.kernel", "linear"),
+        ("encoder.layer.{i}.attention.self.value.bias", "value.bias", "raw"),
+        ("encoder.layer.{i}.attention.output.dense.weight", "attn_out.kernel", "linear"),
+        ("encoder.layer.{i}.attention.output.dense.bias", "attn_out.bias", "raw"),
+        ("encoder.layer.{i}.attention.output.LayerNorm.weight", "attn_norm.scale", "raw"),
+        ("encoder.layer.{i}.attention.output.LayerNorm.bias", "attn_norm.bias", "raw"),
+        ("encoder.layer.{i}.intermediate.dense.weight", "ffn_in.kernel", "linear"),
+        ("encoder.layer.{i}.intermediate.dense.bias", "ffn_in.bias", "raw"),
+        ("encoder.layer.{i}.output.dense.weight", "ffn_out.kernel", "linear"),
+        ("encoder.layer.{i}.output.dense.bias", "ffn_out.bias", "raw"),
+        ("encoder.layer.{i}.output.LayerNorm.weight", "ffn_norm.scale", "raw"),
+        ("encoder.layer.{i}.output.LayerNorm.bias", "ffn_norm.bias", "raw"),
+    ],
+    vocab_keys=("embeddings.word_embeddings.weight",),
+)
+
 # SantaCoder/StarCoder-1: GPT-2 body (learned positions, torch Linear not
 # Conv1D) with multi-query attention — fused c_attn is [q_all; k; v] block
 # concat with ONE kv head
@@ -669,6 +703,7 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "starcoder2": _STARCODER2,
     "mpt": _MPT,
     "gpt_bigcode": _GPT_BIGCODE,
+    "bert": _BERT,
     "t5": _T5,
     "whisper": _WHISPER,
 }
@@ -919,6 +954,15 @@ def hf_to_params(
         state = {
             (k if k.startswith(("transformer.", "lm_head.")) else f"transformer.{k}"): v
             for k, v in state.items()
+        }
+
+    if family == "bert" and "bert.embeddings.word_embeddings.weight" in state:
+        # canonical Hub BERTs (bert-base-uncased etc.) were saved from
+        # *ForPreTraining/MaskedLM: strip the "bert." prefix and drop the
+        # cls.* MLM/NSP head (our task heads are generic wrappers)
+        state = {
+            k[len("bert."):] if k.startswith("bert.") else k: v
+            for k, v in state.items() if not k.startswith("cls.")
         }
 
     for hf, ours, kind in spec.top:

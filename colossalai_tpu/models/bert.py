@@ -73,7 +73,9 @@ class BertLayer(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="attn_norm")(x + attn)
 
         h = dense(cfg.intermediate_size, "ffn_in")(x)
-        h = nn.gelu(h)
+        # exact erf GELU — HF BERT's "gelu"; flax's default tanh approx
+        # drifts ~5e-4/element at |x|~2.7, breaking parity at real scales
+        h = nn.gelu(h, approximate=False)
         h = constrain(h, ("dp", "ep"), None, "tp")
         h = dense(cfg.hidden_size, "ffn_out")(h)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="ffn_norm")(x + h)

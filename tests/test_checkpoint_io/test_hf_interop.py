@@ -362,3 +362,24 @@ def test_fused_qkv_export_roundtrip():
         assert hf[fused_key].shape == (
             cfg.hidden_size + 2 * nkv * hd, cfg.hidden_size
         )
+
+
+def test_bert_roundtrip_and_hub_layout():
+    """Encoder spec: export -> import bit-exact, and canonical Hub
+    checkpoints ("bert."-prefixed with cls.* heads) import cleanly."""
+    from colossalai_tpu.models import BertConfig, BertModel
+
+    cfg = BertConfig.tiny()
+    model = BertModel(cfg)
+    hf = _roundtrip("bert", model, cfg)
+    assert "encoder.layer.0.attention.self.query.weight" in hf
+    assert "pooler.dense.bias" in hf
+
+    # the *ForPreTraining layout every Hub BERT actually ships
+    hub = {f"bert.{k}": v for k, v in hf.items()}
+    hub["cls.predictions.transform.dense.weight"] = hf["pooler.dense.weight"]
+    back = hf_to_params(hub, "bert", cfg.num_hidden_layers, strict=True)
+    np.testing.assert_array_equal(
+        back["word_embeddings"]["embedding"],
+        hf["embeddings.word_embeddings.weight"],
+    )
