@@ -9,6 +9,9 @@ per-model ``modeling`` name conventions. One declarative spec per family:
   - "raw": embeddings, norms, biases — no transform
   - "conv1d": GPT-2 Conv1D stores [in, out] like flax — no transpose
   - "conv_t": torch Conv1d [out, in, k] ↔ flax [k, in, out]
+  - "conv2d_t": torch Conv2d [out, in, kh, kw] ↔ flax [kh, kw, in, out]
+  - "fuse3": HF SPLIT q/k/v ({f} placeholder) ↔ our FUSED [.., 3h] dense
+    (the inverse direction of the qkv_* kinds; vit-style trunks)
   - "experts": our stacked [E, ...] expert tensors ↔ per-expert HF names
   - "qkv_interleaved": BLOOM fused query_key_value, per-head [q k v]
     interleaving ↔ our split q/k/v (needs ``heads``)
@@ -454,6 +457,34 @@ _BERT = _spec(
     vocab_keys=("embeddings.word_embeddings.weight",),
 )
 
+# ViT encoder (maps the bare HF ViTModel, add_pooling_layer=False); the
+# same trunk param names back our image classifier and the BLIP-2 tower
+_VIT = _spec(
+    "blocks",
+    [
+        ("embeddings.cls_token", "cls_token", "raw"),
+        ("embeddings.position_embeddings", "pos_embed", "raw"),
+        ("embeddings.patch_embeddings.projection.weight", "patch_embed.kernel", "conv2d_t"),
+        ("embeddings.patch_embeddings.projection.bias", "patch_embed.bias", "raw"),
+        ("layernorm.weight", "norm.scale", "raw"),
+        ("layernorm.bias", "norm.bias", "raw"),
+    ],
+    [
+        ("encoder.layer.{i}.attention.attention.{f}.weight", "qkv.kernel", "fuse3"),
+        ("encoder.layer.{i}.attention.attention.{f}.bias", "qkv.bias", "fuse3_bias"),
+        ("encoder.layer.{i}.attention.output.dense.weight", "proj.kernel", "linear"),
+        ("encoder.layer.{i}.attention.output.dense.bias", "proj.bias", "raw"),
+        ("encoder.layer.{i}.layernorm_before.weight", "norm1.scale", "raw"),
+        ("encoder.layer.{i}.layernorm_before.bias", "norm1.bias", "raw"),
+        ("encoder.layer.{i}.layernorm_after.weight", "norm2.scale", "raw"),
+        ("encoder.layer.{i}.layernorm_after.bias", "norm2.bias", "raw"),
+        ("encoder.layer.{i}.intermediate.dense.weight", "fc1.kernel", "linear"),
+        ("encoder.layer.{i}.intermediate.dense.bias", "fc1.bias", "raw"),
+        ("encoder.layer.{i}.output.dense.weight", "fc2.kernel", "linear"),
+        ("encoder.layer.{i}.output.dense.bias", "fc2.bias", "raw"),
+    ],
+)
+
 # SantaCoder/StarCoder-1: GPT-2 body (learned positions, torch Linear not
 # Conv1D) with multi-query attention — fused c_attn is [q_all; k; v] block
 # concat with ONE kv head
@@ -704,6 +735,7 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "mpt": _MPT,
     "gpt_bigcode": _GPT_BIGCODE,
     "bert": _BERT,
+    "vit": _VIT,
     "t5": _T5,
     "whisper": _WHISPER,
 }
@@ -852,6 +884,8 @@ def params_to_hf(
             arr = arr.T
         elif kind == "conv_t":
             arr = arr.transpose(2, 1, 0)
+        elif kind == "conv2d_t":
+            arr = arr.transpose(3, 2, 0, 1)
         if vocab_size is not None and hf in spec.vocab_keys:
             arr = unpad_vocab(arr, vocab_size, axis=0)
         out[hf] = arr
@@ -874,6 +908,20 @@ def params_to_hf(
             # only legitimate when a sibling stack exists — guarded above
             continue
         for hf_t, ours, kind in stack_spec.entries:
+            if kind.startswith("fuse3"):
+                is_bias = kind.endswith("_bias")
+                node = _get(stack, ours)
+                if node is None:
+                    raise KeyError(f"{family}: missing {container}/{ours}")
+                arr = np.asarray(node)  # [L, in, 3h] or [L, 3h]
+                thirds = np.split(arr, 3, axis=-1)
+                for f, part in zip(("query", "key", "value"), thirds):
+                    for j in range(arr.shape[0]):
+                        li = part[j]
+                        out[hf_t.format(i=j + base, f=f)] = (
+                            li if is_bias else li.T
+                        )
+                continue
             if kind.startswith("qkv_"):
                 is_bias = kind.endswith("_bias")
                 qp, kp, vp = (_get(stack, x) for x in _qkv_paths(ours, is_bias))
@@ -903,6 +951,8 @@ def params_to_hf(
                     out[hf_t.format(i=i)] = li.T
                 elif kind == "conv_t":
                     out[hf_t.format(i=i)] = li.transpose(2, 1, 0)
+                elif kind == "conv2d_t":
+                    out[hf_t.format(i=i)] = li.transpose(3, 2, 0, 1)
                 else:
                     out[hf_t.format(i=i)] = li
     return out
@@ -980,6 +1030,9 @@ def hf_to_params(
             arr = arr.T
         elif kind == "conv_t":
             arr = arr.transpose(2, 1, 0)
+        elif kind == "conv2d_t":
+            # torch Conv2d [out, in, kh, kw] → flax [kh, kw, in, out]
+            arr = arr.transpose(2, 3, 1, 0)
         _put(p, ours, arr)
 
     bases = _effective_bases(spec, stack_bases, num_layers)
@@ -989,6 +1042,25 @@ def hf_to_params(
         if n <= 0:
             continue
         for hf_t, ours, kind in stack_spec.entries:
+            if kind.startswith("fuse3"):
+                # HF split q/k/v ({f} placeholder) → OUR fused [.., 3h]
+                # concat (the inverse direction of the qkv_* kinds)
+                is_bias = kind.endswith("_bias")
+                per_layer = []
+                for j in range(n):
+                    parts = []
+                    for f in ("query", "key", "value"):
+                        key = hf_t.format(i=j + base, f=f)
+                        if key not in state:
+                            raise KeyError(
+                                f"{family}: checkpoint missing {key}"
+                            )
+                        consumed.add(key)
+                        arr = state[key]
+                        parts.append(arr if is_bias else arr.T)
+                    per_layer.append(np.concatenate(parts, axis=-1))
+                _put(p, f"{container}.block.{ours}", np.stack(per_layer, 0))
+                continue
             if kind.startswith("qkv_"):
                 is_bias = kind.endswith("_bias")
                 if hf_t.format(i=base) not in state:
@@ -1030,6 +1102,8 @@ def hf_to_params(
                     per_layer.append(state[key].T)
                 elif kind == "conv_t":
                     per_layer.append(state[key].transpose(2, 1, 0))
+                elif kind == "conv2d_t":
+                    per_layer.append(state[key].transpose(2, 3, 1, 0))
                 else:
                     per_layer.append(state[key])
             _put(p, f"{container}.block.{ours}", np.stack(per_layer, 0))

@@ -69,7 +69,7 @@ class ViTBlock(nn.Module):
 
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype, name="norm2")(x)
         h = dense(cfg.intermediate_size, "fc1")(h)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # HF ViT's exact-erf "gelu"
         h = constrain(h, ("dp", "ep"), None, "tp")
         return x + dense(cfg.hidden_size, "fc2")(h)
 

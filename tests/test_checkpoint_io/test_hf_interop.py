@@ -383,3 +383,31 @@ def test_bert_roundtrip_and_hub_layout():
         back["word_embeddings"]["embedding"],
         hf["embeddings.word_embeddings.weight"],
     )
+
+
+def test_vit_roundtrip():
+    """Encoder with OUR-side fused qkv (fuse3) and a 2D patchify conv
+    (conv2d_t): export -> import bit-exact."""
+    from colossalai_tpu.models import ViTConfig, ViTForImageClassification
+
+    cfg = ViTConfig.tiny()
+    model = ViTForImageClassification(cfg)
+    pixels = jnp.asarray(np.zeros(
+        (1, cfg.image_size, cfg.image_size, cfg.num_channels), np.float32))
+    params = model.init(jax.random.PRNGKey(0), pixels)
+    hf = params_to_hf(params, "vit")
+    assert hf["embeddings.patch_embeddings.projection.weight"].shape == (
+        cfg.hidden_size, cfg.num_channels, cfg.patch_size, cfg.patch_size
+    )
+    assert "encoder.layer.0.attention.attention.query.weight" in hf
+    assert "encoder.layer.1.attention.attention.value.bias" in hf
+    back = hf_to_params(hf, "vit", cfg.num_hidden_layers)
+    flat_a = jax.tree_util.tree_flatten_with_path(params["params"])[0]
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(back)[0])
+    for kp, leaf in flat_a:
+        path = str(kp)
+        if "head" in path:  # classifier head is ours alone, not in the spec
+            continue
+        assert kp in flat_b, kp
+        np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp],
+                                      err_msg=path)

@@ -887,3 +887,41 @@ def test_bert_matches_hf():
     _assert_close(np.asarray(sharded),
                   out.last_hidden_state.float().numpy(),
                   "bert tp2-sp2 hidden")
+
+
+def test_vit_matches_hf():
+    """ViT encoder: patchify conv, cls token, pre-LN blocks with fused qkv
+    on our side vs split q/k/v on HF's — hidden states must match the bare
+    HF ViTModel."""
+    from colossalai_tpu.models import ViTConfig, ViTForImageClassification
+
+    cfg = ViTConfig.tiny()
+    hf_cfg = transformers.ViTConfig(
+        image_size=cfg.image_size, patch_size=cfg.patch_size,
+        num_channels=cfg.num_channels, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        layer_norm_eps=cfg.layer_norm_eps, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(32)
+    hf = transformers.ViTModel(hf_cfg, add_pooling_layer=False)
+    hf.eval()
+    params = hf_to_params(_hf_state(hf), "vit", cfg.num_hidden_layers,
+                          strict=True)
+
+    rng = np.random.RandomState(7)
+    pixels = rng.randn(2, cfg.image_size, cfg.image_size,
+                       cfg.num_channels).astype(np.float32)
+    with torch.no_grad():
+        theirs = hf(
+            torch.from_numpy(pixels.transpose(0, 3, 1, 2))  # NCHW
+        ).last_hidden_state.float().numpy()
+
+    model = ViTForImageClassification(cfg)
+    init = model.init(jax.random.PRNGKey(0), jnp.asarray(pixels))["params"]
+    merged = {**init, **params}  # classifier head stays fresh (HF has none)
+    ours = model.apply({"params": merged}, jnp.asarray(pixels))
+    _assert_close(np.asarray(ours.last_hidden_state), theirs, "vit hidden")
