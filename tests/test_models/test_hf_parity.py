@@ -162,8 +162,8 @@ def test_mixtral_matches_hf():
 
 
 # ---- widened families: qwen3 / gemma2 / opt / bloom / falcon (decoder-only,
-# checked unsharded AND tp2-sp2), t5 (unsharded AND tp2), and
-# whisper / deepseek (unsharded)
+# checked unsharded AND tp2-sp2), t5 / whisper (unsharded AND tp2), and
+# deepseek (unsharded)
 
 
 def test_qwen3_matches_hf():
@@ -322,8 +322,10 @@ def test_t5_matches_hf():
     _assert_close(ours, theirs, "t5 logits vs HF torch")
 
 
-def test_whisper_matches_hf():
-    from colossalai_tpu.models import WhisperConfig, WhisperForConditionalGeneration
+def _whisper_tiny_hf(seed):
+    """Build the tiny HF whisper + ported params once for both parity
+    tests (mirrors _t5_tiny_hf)."""
+    from colossalai_tpu.models import WhisperConfig
 
     cfg = WhisperConfig.tiny()
     n_frames = 16
@@ -340,7 +342,7 @@ def test_whisper_matches_hf():
         pad_token_id=0, bos_token_id=1, eos_token_id=2,
         decoder_start_token_id=3, attn_implementation="eager",
     )
-    torch.manual_seed(10)
+    torch.manual_seed(seed)
     hf = transformers.WhisperForConditionalGeneration(hf_cfg)
     hf.eval()
     params = hf_to_params(
@@ -348,6 +350,13 @@ def test_whisper_matches_hf():
         {"encoder": cfg.encoder_layers, "decoder": cfg.decoder_layers},
         tie_word_embeddings=True, strict=True,
     )
+    return cfg, n_frames, hf, params
+
+
+def test_whisper_matches_hf():
+    from colossalai_tpu.models import WhisperForConditionalGeneration
+
+    cfg, n_frames, hf, params = _whisper_tiny_hf(seed=10)
     feats = np.random.RandomState(6).randn(BATCH, cfg.num_mel_bins, n_frames)
     dec_ids = np.random.RandomState(7).randint(0, cfg.vocab_size, size=(BATCH, 8))
     with torch.no_grad():
@@ -925,3 +934,25 @@ def test_vit_matches_hf():
     merged = {**init, **params}  # classifier head stays fresh (HF has none)
     ours = model.apply({"params": merged}, jnp.asarray(pixels))
     _assert_close(np.asarray(ours.last_hidden_state), theirs, "vit hidden")
+
+
+def test_whisper_tp2_matches_hf():
+    """The sharded audio enc-dec path (tp2) must reproduce HF too — closes
+    whisper's 'unsharded-only' parity caveat (t5 got the same treatment)."""
+    from colossalai_tpu.models import WhisperForConditionalGeneration
+
+    cfg, n_frames, hf, params = _whisper_tiny_hf(seed=15)
+    # tp2 on 8 devices leaves dp=4: batch must divide it
+    feats = np.random.RandomState(8).randn(8, cfg.num_mel_bins, n_frames)
+    dec_ids = np.random.RandomState(9).randint(0, cfg.vocab_size, size=(8, 8))
+    with torch.no_grad():
+        theirs = hf(
+            input_features=torch.from_numpy(feats).float(),
+            decoder_input_ids=torch.from_numpy(dec_ids),
+        ).logits.float().numpy()
+    sharded = _our_encdec_logits_tp(
+        WhisperForConditionalGeneration(cfg), params,
+        {"input_features": feats.astype(np.float32),
+         "decoder_input_ids": dec_ids},
+    )
+    _assert_close(sharded, theirs, "whisper tp2 logits vs HF torch")
