@@ -161,9 +161,9 @@ def test_mixtral_matches_hf():
     _check_parity(hf, MixtralForCausalLM(cfg), params, cfg.vocab_size)
 
 
-# ---- widened families: qwen3 / gemma2 / opt / bloom / falcon (decoder-only,
-# checked unsharded AND tp2-sp2), t5 / whisper (unsharded AND tp2), and
-# deepseek (unsharded)
+# ---- widened families: every LANGUAGE family below is checked unsharded
+# AND under tensor (+sequence) parallelism against the same HF reference;
+# vit's encoder is unsharded-only (no sp/tp eval path for pixel inputs yet)
 
 
 def test_qwen3_matches_hf():
@@ -403,11 +403,7 @@ def test_deepseek_matches_hf():
         {"dense_layers": 0, "layers": cfg.num_hidden_layers},
         num_experts=cfg.num_experts,
     )
-    ids = _ids(cfg.vocab_size)
-    with torch.no_grad():
-        theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
-    ours = _our_logits_unsharded(DeepseekV2ForCausalLM(cfg), params, ids)
-    _assert_close(ours, theirs, "deepseek logits vs HF torch")
+    _check_parity(hf, DeepseekV2ForCausalLM(cfg), params, cfg.vocab_size)
 
 
 def test_qwen2_moe_matches_hf():
@@ -436,11 +432,7 @@ def test_qwen2_moe_matches_hf():
         _hf_state(hf), "qwen2_moe", cfg.num_hidden_layers,
         num_experts=cfg.num_experts,
     )
-    ids = _ids(cfg.vocab_size)
-    with torch.no_grad():
-        theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
-    ours = _our_logits_unsharded(Qwen2MoeForCausalLM(cfg), params, ids)
-    _assert_close(ours, theirs, "qwen2_moe logits vs HF torch")
+    _check_parity(hf, Qwen2MoeForCausalLM(cfg), params, cfg.vocab_size)
 
 
 def test_deepseek_v3_matches_hf():
@@ -477,11 +469,7 @@ def test_deepseek_v3_matches_hf():
         {"dense_layers": 0, "layers": cfg.num_hidden_layers},
         num_experts=cfg.num_experts,
     )
-    ids = _ids(cfg.vocab_size)
-    with torch.no_grad():
-        theirs = hf(torch.from_numpy(ids)).logits.float().numpy()
-    ours = _our_logits_unsharded(DeepseekV3ForCausalLM(cfg), params, ids)
-    _assert_close(ours, theirs, "deepseek_v3 logits vs HF torch")
+    _check_parity(hf, DeepseekV3ForCausalLM(cfg), params, cfg.vocab_size)
 
 
 def _our_encdec_logits_tp(model, params, batch_np):
