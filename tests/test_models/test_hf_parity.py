@@ -161,9 +161,9 @@ def test_mixtral_matches_hf():
     _check_parity(hf, MixtralForCausalLM(cfg), params, cfg.vocab_size)
 
 
-# ---- widened families: every LANGUAGE family below is checked unsharded
-# AND under tensor (+sequence) parallelism against the same HF reference;
-# vit's encoder is unsharded-only (no sp/tp eval path for pixel inputs yet)
+# ---- widened families: every family below is checked unsharded AND under
+# tensor parallelism (language families also under sequence parallelism)
+# against the same HF reference
 
 
 def test_qwen3_matches_hf():
@@ -922,6 +922,29 @@ def test_vit_matches_hf():
     merged = {**init, **params}  # classifier head stays fresh (HF has none)
     ours = model.apply({"params": merged}, jnp.asarray(pixels))
     _assert_close(np.asarray(ours.last_hidden_state), theirs, "vit hidden")
+
+    # sharded leg (same pattern as bert): tp2 through the Booster's
+    # shardings, comparing hidden states against HF; the dummy mean loss
+    # exists only so boost() can trace a scalar
+    batch = {"pixel_values": jnp.asarray(np.concatenate([pixels] * 4))}
+    boosted = Booster(
+        plugin=HybridParallelPlugin(tp_size=2, precision="fp32")
+    ).boost(
+        model, optax.sgd(1e-2),
+        loss_fn=lambda o, b: o.last_hidden_state.astype(jnp.float32).mean(),
+        example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    placed = jax.device_put(
+        jax.tree.map(jnp.asarray, merged), boosted.state_shardings.params
+    )
+    from colossalai_tpu.tensor import use_mesh
+
+    jmesh = jax.tree.leaves(boosted.state_shardings.params)[0].mesh
+    with use_mesh(jmesh):
+        sharded = jax.jit(
+            lambda p, px: model.apply({"params": p}, px).last_hidden_state
+        )(placed, batch["pixel_values"])
+    _assert_close(np.asarray(sharded)[:2], theirs, "vit tp2 hidden")
 
 
 def test_whisper_tp2_matches_hf():
