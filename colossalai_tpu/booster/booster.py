@@ -61,6 +61,7 @@ class Booster:
         seed: int = 0,
         drop_last: bool = True,
         seq_len: Optional[int] = None,
+        num_epochs: Optional[int] = None,
     ):
         """Iterate per-PROCESS batches of a dataset, sharded for data
         parallelism (≙ reference ``Plugin.prepare_dataloader`` wiring a
@@ -83,12 +84,26 @@ class Booster:
         the jitted train step never retraces. With ``drop_last=False`` the
         final short batch is likewise padded by wrapping (samples repeat)
         rather than shrinking.
+
+        .. warning:: With ``num_epochs=None`` (the default) the iterator is
+           an ENDLESS stream — epochs repeat forever, so ``for batch in
+           loader`` never terminates on its own; bound it with a step
+           count (``itertools.islice`` / a step-budget loop) or pass
+           ``num_epochs`` for a finite, per-epoch-style iterator. Token-file
+           datasets are always endless (random crops have no epoch).
         """
         import numpy as np
 
+        if num_epochs is not None and num_epochs < 1:
+            raise ValueError(f"num_epochs={num_epochs} must be >= 1")
         if isinstance(dataset, str):
             if seq_len is None:
                 raise ValueError("token-file datasets need seq_len")
+            if num_epochs is not None:
+                raise ValueError(
+                    "token-file datasets are endless random-crop streams; "
+                    "num_epochs does not apply — bound by step count instead"
+                )
             if not shuffle:
                 raise ValueError(
                     "token-file datasets are random-crop loaders; "
@@ -130,7 +145,7 @@ class Booster:
 
         def _epochs():
             epoch = 0
-            while True:
+            while num_epochs is None or epoch < num_epochs:
                 idx = np.arange(n)
                 if shuffle:
                     np.random.RandomState(seed + epoch).shuffle(idx)

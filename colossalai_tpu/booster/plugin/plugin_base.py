@@ -321,10 +321,17 @@ class Plugin(abc.ABC):
         if getattr(self, "placement_policy", "static") == "auto" and not offload_optim:
             # ≙ the Gemini warmup memory tracer, the XLA way: the static
             # estimate above never sees activation/temp peaks, but the
-            # compiled executable's memory analysis does. AOT-compile the
-            # train step (reused by the first real step — no extra cost on
-            # the happy path) and flip to host offload when the true peak
-            # would not fit.
+            # compiled executable's memory analysis does. COST: this AOT
+            # probe compile is NOT installed into jit's dispatch cache, so
+            # placement_policy="auto" pays one extra full compile of the
+            # train step (plus a state re-init when it flips to offload) —
+            # logged below so the probe's price is visible.
+            from colossalai_tpu.logging import get_dist_logger
+
+            get_dist_logger().info(
+                "auto placement: probe-compiling the train step for memory "
+                "analysis (one extra compile beyond the first real step)"
+            )
             peak = _compiled_peak_bytes(train_step, mesh, state, example_batch)
             from colossalai_tpu.accelerator import get_accelerator
 

@@ -38,6 +38,10 @@ _DEFAULT_ALPHA_S = 1e-6  # ICI hop latency is ~µs-scale
 _DCN_BYTES_PER_S = 25e9  # conservative per-host DCN
 
 
+_PROBED_GENERATION: "str | None" = None  # cold-probe result; a subprocess
+# probe costs seconds (full jax import), so pay it at most once per process
+
+
 def _detect_generation() -> str:
     try:
         from jax._src import xla_bridge
@@ -45,26 +49,30 @@ def _detect_generation() -> str:
         if not getattr(xla_bridge, "_backends", None):
             # backend never initialized: initializing one just to read a
             # device name can BLOCK FOREVER on an unreachable tunneled TPU
-            # (the r02 multichip-gate failure mode) — probe in a DAEMON
-            # thread with a hard timeout (an executor thread would be
-            # joined at interpreter exit and hang the process instead)
-            import queue
-            import threading
+            # (the r02 multichip-gate failure mode) — probe in a THROWAWAY
+            # SUBPROCESS with a hard timeout. A daemon thread is not safe
+            # here: jax.devices() can complete AFTER the timeout and
+            # initialize the backend in the background, racing any later
+            # jax.config.update('jax_platforms', ...) in this process
+            # (e.g. initialize._enforce_env_platform). A killed subprocess
+            # can never mutate this process's backend state.
+            global _PROBED_GENERATION
+            if _PROBED_GENERATION is not None:
+                return _PROBED_GENERATION
+            import subprocess
+            import sys
 
-            box: "queue.Queue[str]" = queue.Queue(1)
-
-            def _probe():
-                try:
-                    box.put(jax.devices()[0].device_kind.lower())
-                except Exception:
-                    box.put("cpu")
-
-            threading.Thread(target=_probe, daemon=True).start()
             try:
-                kind = box.get(timeout=10)
-            except queue.Empty:
+                probe = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; print(jax.devices()[0].device_kind.lower())"],
+                    capture_output=True, text=True, timeout=10,
+                )
+            except (subprocess.TimeoutExpired, OSError):
                 # a slow-but-healthy pod init also lands here; warn so an
-                # 18x ICI-vs-cpu bandwidth miscosting isn't silent
+                # 18x ICI-vs-cpu bandwidth miscosting isn't silent. A hung
+                # tunnel is a process-lifetime condition — cache it so
+                # every later call doesn't stall 10 s.
                 import warnings
 
                 warnings.warn(
@@ -72,11 +80,29 @@ def _detect_generation() -> str:
                     "interconnect costs — pass alpha_beta/generation "
                     "explicitly if a real TPU backend is still initializing"
                 )
+                _PROBED_GENERATION = "cpu"
                 return "cpu"
+            if probe.returncode != 0 or not probe.stdout.strip():
+                # transient (e.g. the TPU briefly held by another process):
+                # warn but do NOT cache — a later call may see it freed
+                import warnings
+
+                warnings.warn(
+                    "backend probe exited nonzero; assuming cpu-class "
+                    "interconnect costs for THIS call (not cached): "
+                    + (probe.stderr or "").strip()[-300:]
+                )
+                return "cpu"
+            _PROBED_GENERATION = _normalize_kind(probe.stdout.strip())
+            return _PROBED_GENERATION
         else:
             kind = jax.devices()[0].device_kind.lower()
     except Exception:  # unavailable backend
         return "cpu"
+    return _normalize_kind(kind)
+
+
+def _normalize_kind(kind: str) -> str:
     # real device_kind strings spell lite parts out: "TPU v5 lite",
     # "TPU v6 lite" — not "v5e"/"v6e"
     if "v6" in kind:

@@ -26,7 +26,12 @@ _QUANT_KEYS = frozenset({"q", "scale"})
 
 #: param-path fragments never quantized (≙ bnb llm_int8_skip_modules:
 #: embeddings and the lm head stay full precision)
-_SKIP = ("embed", "lm_head", "wte", "wpe", "shared", "norm")
+_SKIP = ("embed", "lm_head", "wte", "wpe", "norm")
+
+#: exact path SEGMENTS never quantized. "shared" (T5's shared embedding
+#: module) must not substring-match MoE "shared_expert" FFN kernels, which
+#: are large and exactly what weight-only quantization is for.
+_SKIP_SEGMENTS = frozenset({"shared"})
 
 _QMAX = {8: 127.0, 4: 7.0}
 _QDTYPE = {8: jnp.int8, 4: jnp.int4}
@@ -38,6 +43,8 @@ def is_quantized_leaf(x: Any) -> bool:
 
 def _should_quantize(path: str, leaf) -> bool:
     if not path.endswith("kernel") or leaf.ndim not in (2, 3):
+        return False
+    if _SKIP_SEGMENTS.intersection(path.split("/")):
         return False
     return not any(s in path for s in _SKIP)
 

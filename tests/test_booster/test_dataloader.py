@@ -99,3 +99,21 @@ def test_token_file_path(tmp_path):
         Booster().prepare_dataloader(
             str(p), batch_size=4, seq_len=16, shuffle=False
         )
+
+
+def test_num_epochs_bounds_the_stream():
+    data = np.arange(32)
+    loader = Booster().prepare_dataloader(data, batch_size=8, num_epochs=2)
+    batches = list(loader)  # must terminate on its own
+    assert len(batches) == 8  # 2 epochs x 4 batches
+    seen = np.concatenate([b["input_ids"] for b in batches])
+    assert sorted(seen.tolist()) == sorted(list(range(32)) * 2)
+
+
+def test_num_epochs_rejected_for_token_files(tmp_path):
+    path = tmp_path / "tokens.npy"
+    np.save(path, np.arange(4096, dtype=np.uint16))
+    with pytest.raises(ValueError, match="endless"):
+        Booster().prepare_dataloader(
+            str(path), batch_size=2, seq_len=16, num_epochs=1
+        )

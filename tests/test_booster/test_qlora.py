@@ -93,3 +93,16 @@ def test_qlora_composes_with_tp():
     )
     ref, _ = _losses(LoraConfig(r=4, base_quant_bits=8))
     np.testing.assert_allclose(q8, ref, atol=1e-4)
+
+
+def test_moe_shared_expert_is_quantized_but_t5_shared_embedding_is_not():
+    """The skip list must treat "shared" as an exact path segment (T5's
+    shared embedding), not a substring — MoE shared_expert FFN kernels are
+    large and exactly what weight-only quantization is for (r3 advisor)."""
+    from colossalai_tpu.quantization.weight_only import _should_quantize
+
+    w2 = jnp.zeros((8, 8))
+    assert _should_quantize("layers/block/mlp/shared_expert/gate_proj/kernel", w2)
+    assert _should_quantize("layers/block/mlp/shared_experts/down_proj/kernel", w2)
+    assert not _should_quantize("shared/embedding/kernel", w2)
+    assert not _should_quantize("model/shared/kernel", w2)
