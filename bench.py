@@ -332,6 +332,10 @@ def supervise():
             probe_failures += 1
             if status == "timeout":
                 last_err = "attempt-gate: backend probe timed out (hung tunnel?)"
+            elif any(s in probe_err for s in _RETRYABLE):
+                # transient-looking nonzero rc (e.g. the TPU briefly held by
+                # a just-killed child, UNAVAILABLE churn): keep retrying
+                last_err = f"attempt-gate: transient probe failure: {probe_err}"
             else:
                 # deterministic (import error, misconfig): retrying won't
                 # heal it — count toward the soft-failure stop and keep the

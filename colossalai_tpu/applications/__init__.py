@@ -1,6 +1,7 @@
 from .eval import evaluate_perplexity, score_choices
 from .pretrain import dedup_exact, dedup_minhash, expand_vocab, pack_sequences
 from .qa import RAGPipeline, VectorStore, embed_texts
+from .rollout import EngineRollout
 from .rlhf import (
     DPOTrainer,
     PPOTrainer,
@@ -21,6 +22,7 @@ from .rlhf import (
 
 __all__ = [
     "DPOTrainer",
+    "EngineRollout",
     "PPOTrainer",
     "compute_gae",
     "compute_reference_logprobs",

@@ -335,6 +335,21 @@ class PPOTrainer:
             "reward_mean": float(rewards_seq.mean()),
         }
 
+    def rollout_step(self, rollout, prompts, reward_fn,
+                     n_samples: int = 1) -> Dict[str, float]:
+        """One ON-POLICY iteration with engine-backed generation (≙ the
+        coati distributed PPO tick: broadcast weights → rollout →
+        experience → update): sync the current actor weights into the
+        rollout engine, generate ``n_samples`` completions per prompt
+        (grouped: one shared prefill each), score with ``reward_fn``, and
+        apply one PPO update. The batch is static-shape: it must match the
+        trainer's example batch — B = len(prompts)·n_samples rows of
+        ``rollout.pad_to`` tokens."""
+        rollout.sync_weights(self.actor.state.params)
+        return self.step(rollout.make_experience(
+            prompts, reward_fn, n_samples=n_samples
+        ))
+
 
 @functools.lru_cache(maxsize=8)
 def _ref_fwd(model):

@@ -1,0 +1,166 @@
+"""RLHF rollout backend over the paged inference engine.
+
+≙ reference ``applications/ColossalChat/coati/distributed/`` (a
+vllm-backed generation worker decoupled from the trainer, experience
+shipped back to the learners over ray). TPU redesign: the paged
+:class:`~colossalai_tpu.inference.LLMEngine` runs in-process over the same
+runtime — "weight sync" is a device-array handoff into the engine
+(``engine.sync_params``), not a cross-process broadcast, and grouped
+sampling (GRPO / best-of-n) prefills each prompt ONCE and forks its KV
+pages per member (``engine.add_request(n_samples=k)``), so a group of k
+completions costs one prefill plus k decodes.
+
+The produced experience batch has STATIC shapes — every row is padded to
+``pad_to`` — so the PPO train steps compiled against the example batch
+never retrace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine
+
+
+class EngineRollout:
+    """Generation backend for on-policy RLHF (PPO/GRPO).
+
+    Usage::
+
+        rollout = EngineRollout(cfg, pad_to=64, max_batch_size=8,
+                                gen=GenerationConfig(do_sample=True,
+                                                     temperature=1.0,
+                                                     max_new_tokens=24))
+        trainer = PPOTrainer(...)           # example batch [B*k, pad_to]
+        for _ in range(iters):
+            metrics = trainer.rollout_step(rollout, prompts, reward_fn,
+                                           n_samples=k)
+
+    ``reward_fn(batch) -> [B]`` scores the padded experience batch
+    (``input_ids``, ``loss_mask``, ``prompt_lens`` are available); plug a
+    reward model's eval step or a verifiable rule.
+    """
+
+    def __init__(
+        self,
+        config,
+        *,
+        pad_to: int,
+        max_batch_size: int = 8,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        gen: Optional[GenerationConfig] = None,
+        mesh=None,
+        seed: int = 0,
+    ):
+        if pad_to % block_size:
+            raise ValueError(
+                f"pad_to={pad_to} must be a multiple of block_size={block_size}"
+            )
+        self.config = config
+        self.pad_to = pad_to
+        self.gen = gen or GenerationConfig(do_sample=True, temperature=1.0)
+        self.mesh = mesh
+        self._engine_kw = dict(
+            max_batch_size=max_batch_size, max_seq_len=pad_to,
+            block_size=block_size, num_blocks=num_blocks, seed=seed,
+            mesh=mesh,
+        )
+        self.engine: Optional[LLMEngine] = None
+
+    # ------------------------------------------------------------ weights
+    def sync_weights(self, params) -> None:
+        """Push the actor's CURRENT params into the engine (the coati
+        trainer→rollout broadcast, as an in-process array handoff). The
+        first call constructs the engine; later calls reuse every compiled
+        prefill/decode program (same tree structure/shapes/dtypes)."""
+        params = self._engine_placement(params)
+        if self.engine is None:
+            self.engine = LLMEngine(params, self.config, **self._engine_kw)
+        else:
+            self.engine.sync_params(params)
+
+    def _engine_placement(self, params):
+        if "params" not in params:
+            params = {"params": params}
+        if self.mesh is not None:
+            return params  # engine reshards through its tp specs
+        # trainer params can be committed replicated across a multi-device
+        # mesh; the engine's single-device jits can't mix those with its
+        # uncommitted cache arrays — pull one replica and re-place it ON
+        # DEVICE once (a host numpy tree would pay a full H2D upload on
+        # EVERY prefill/decode dispatch). No-op on one chip.
+        def pull(a):
+            sharding = getattr(a, "sharding", None)
+            if sharding is not None and len(sharding.device_set) > 1:
+                return jax.device_put(np.asarray(a))
+            return a
+
+        return jax.tree.map(pull, params)
+
+    # ----------------------------------------------------------- rollout
+    def generate(
+        self, prompts: List[List[int]], n_samples: int = 1
+    ) -> Dict[str, Any]:
+        """Generate ``n_samples`` completions per prompt through the
+        engine's continuous batching; returns a static-shape batch:
+        ``input_ids`` [B·k, pad_to] (prompt + completion, zero-padded),
+        ``loss_mask`` [B·k, pad_to] (1 on completion tokens),
+        ``prompt_lens`` [B·k]. Row order is prompt-major (all k samples of
+        prompt 0, then prompt 1, …) — exactly the grouping
+        :func:`~colossalai_tpu.applications.rlhf.grpo_advantages` expects.
+        """
+        if self.engine is None:
+            raise RuntimeError("call sync_weights(params) before generate()")
+        order: List[int] = []
+        for p in prompts:
+            # the engine stops a request at pad_to - 1 total tokens, so an
+            # exact fit would silently yield max_new_tokens - 1 completions
+            if len(p) + self.gen.max_new_tokens > self.pad_to - 1:
+                raise ValueError(
+                    f"prompt of {len(p)} + max_new_tokens="
+                    f"{self.gen.max_new_tokens} needs pad_to > "
+                    f"{len(p) + self.gen.max_new_tokens} (engine reserves "
+                    f"one position); got pad_to={self.pad_to}"
+                )
+            ids = self.engine.add_request(p, self.gen, n_samples=n_samples)
+            order.extend(ids if isinstance(ids, list) else [ids])
+        done: Dict[int, Any] = {}
+        while len(done) < len(order):
+            for req in self.engine.step():
+                done[req.request_id] = req
+        rows = len(prompts) * n_samples
+        input_ids = np.zeros((rows, self.pad_to), np.int32)
+        loss_mask = np.zeros((rows, self.pad_to), np.float32)
+        prompt_lens = np.zeros((rows,), np.int32)
+        outputs: List[List[int]] = []
+        for i, rid in enumerate(order):
+            req = done[rid]
+            n = len(req.prompt_ids)
+            out = req.output_ids[: self.pad_to - n]
+            input_ids[i, :n] = req.prompt_ids
+            input_ids[i, n:n + len(out)] = out
+            loss_mask[i, n:n + len(out)] = 1.0
+            prompt_lens[i] = n
+            outputs.append(list(out))
+        return {
+            "input_ids": input_ids,
+            "loss_mask": loss_mask,
+            "prompt_lens": prompt_lens,
+            "output_ids": outputs,
+        }
+
+    def make_experience(
+        self,
+        prompts: List[List[int]],
+        reward_fn: Callable[[Dict[str, Any]], Any],
+        n_samples: int = 1,
+    ) -> Dict[str, Any]:
+        """Generate + score: the PPO/GRPO experience tick. Returns the
+        batch from :meth:`generate` with ``rewards`` [B·k] attached."""
+        batch = self.generate(prompts, n_samples=n_samples)
+        batch["rewards"] = np.asarray(reward_fn(batch), np.float32)
+        return batch

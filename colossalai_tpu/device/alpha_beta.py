@@ -38,8 +38,13 @@ _DEFAULT_ALPHA_S = 1e-6  # ICI hop latency is ~µs-scale
 _DCN_BYTES_PER_S = 25e9  # conservative per-host DCN
 
 
-_PROBED_GENERATION: "str | None" = None  # cold-probe result; a subprocess
-# probe costs seconds (full jax import), so pay it at most once per process
+# cold-probe result: (generation, monotonic timestamp, definitive). A
+# subprocess probe costs seconds (full jax import), so successful answers
+# cache for the process lifetime; FAILED probes (timeout / nonzero rc —
+# possibly a slow pod init or a briefly-held TPU) cache only briefly so a
+# backend that comes up seconds later is not miscosted forever.
+_PROBE_CACHE: "tuple[str, float, bool] | None" = None
+_PROBE_RETRY_S = 60.0
 
 
 def _detect_generation() -> str:
@@ -56,9 +61,14 @@ def _detect_generation() -> str:
             # jax.config.update('jax_platforms', ...) in this process
             # (e.g. initialize._enforce_env_platform). A killed subprocess
             # can never mutate this process's backend state.
-            global _PROBED_GENERATION
-            if _PROBED_GENERATION is not None:
-                return _PROBED_GENERATION
+            global _PROBE_CACHE
+            import time
+
+            now = time.monotonic()
+            if _PROBE_CACHE is not None and (
+                _PROBE_CACHE[2] or now - _PROBE_CACHE[1] < _PROBE_RETRY_S
+            ):
+                return _PROBE_CACHE[0]
             import subprocess
             import sys
 
@@ -70,31 +80,32 @@ def _detect_generation() -> str:
                 )
             except (subprocess.TimeoutExpired, OSError):
                 # a slow-but-healthy pod init also lands here; warn so an
-                # 18x ICI-vs-cpu bandwidth miscosting isn't silent. A hung
-                # tunnel is a process-lifetime condition — cache it so
-                # every later call doesn't stall 10 s.
+                # 18x ICI-vs-cpu bandwidth miscosting isn't silent, and
+                # cache only briefly so a backend that comes up later heals
                 import warnings
 
                 warnings.warn(
                     "backend probe timed out after 10s; assuming cpu-class "
-                    "interconnect costs — pass alpha_beta/generation "
+                    "interconnect costs (re-probed after "
+                    f"{_PROBE_RETRY_S:.0f}s) — pass alpha_beta/generation "
                     "explicitly if a real TPU backend is still initializing"
                 )
-                _PROBED_GENERATION = "cpu"
+                _PROBE_CACHE = ("cpu", now, False)
                 return "cpu"
             if probe.returncode != 0 or not probe.stdout.strip():
-                # transient (e.g. the TPU briefly held by another process):
-                # warn but do NOT cache — a later call may see it freed
+                # transient (e.g. the TPU briefly held by another process)
                 import warnings
 
                 warnings.warn(
                     "backend probe exited nonzero; assuming cpu-class "
-                    "interconnect costs for THIS call (not cached): "
+                    "interconnect costs (re-probed after "
+                    f"{_PROBE_RETRY_S:.0f}s): "
                     + (probe.stderr or "").strip()[-300:]
                 )
+                _PROBE_CACHE = ("cpu", now, False)
                 return "cpu"
-            _PROBED_GENERATION = _normalize_kind(probe.stdout.strip())
-            return _PROBED_GENERATION
+            _PROBE_CACHE = (_normalize_kind(probe.stdout.strip()), now, True)
+            return _PROBE_CACHE[0]
         else:
             kind = jax.devices()[0].device_kind.lower()
     except Exception:  # unavailable backend

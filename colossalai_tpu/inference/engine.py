@@ -28,8 +28,9 @@ Design deltas for TPU/XLA:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import itertools
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -62,9 +63,28 @@ class Request:
     finished: bool = False
     #: ended early because the page pool ran dry (vs natural EOS/length stop)
     truncated: bool = False
+    #: grouped sampling (n_samples > 1): the QUEUED leader carries every
+    #: member's request id; followers are materialized at admission off the
+    #: leader's single prefill (KV pages fork-shared, partial page copied)
+    group_ids: Optional[List[int]] = None
+
+    @property
+    def n_samples(self) -> int:
+        return len(self.group_ids) if self.group_ids else 1
 
 
 _greedy_slots = jax.jit(lambda logits: jnp.argmax(logits, axis=-1))
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _copy_block(cache: PagedKVCache, src, dst) -> PagedKVCache:
+    """Copy-on-write of one page (grouped-sampling fork: the partial prompt
+    page is the only one a follower would overwrite). src/dst are traced
+    int32 scalars so every block pair reuses one compiled program."""
+    return PagedKVCache(
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
 
 
 @jax.jit
@@ -159,20 +179,11 @@ class LLMEngine:
                 mesh, config, block_size, self.max_blocks_per_seq
             )
             mesh = None  # skip the GSPMD tp placement below
+        self._tp_mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
-
-            policy = get_autopolicy("llama")
-            specs = policy.param_specs(params["params"] if "params" in params else params)
-            params_tree = params["params"] if "params" in params else params
-            sharded = jax.tree.map(
-                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-                params_tree, specs,
-                is_leaf=lambda x: not isinstance(x, dict),
-            )
-            params = {"params": sharded} if "params" in params else sharded
+            params = self._place_params(params)
             # pool [L, n_blocks, Hkv, bs, D]: heads over tp
             kv_spec = NamedSharding(mesh, P(None, None, "tp", None, None))
             cache = PagedKVCache(
@@ -194,17 +205,73 @@ class LLMEngine:
         self._gen_topp = np.ones((max_batch_size,), np.float32)
         self._gen_sample = np.zeros((max_batch_size,), bool)
 
+    def _place_params(self, params):
+        """tp placement of a param tree via the llama auto-policy specs."""
+        from jax.sharding import NamedSharding
+
+        from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
+
+        tree = params["params"] if "params" in params else params
+        specs = get_autopolicy("llama").param_specs(tree)
+        sharded = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(self._tp_mesh, s)),
+            tree, specs,
+            is_leaf=lambda x: not isinstance(x, dict),
+        )
+        return {"params": sharded} if "params" in params else sharded
+
+    def sync_params(self, params) -> None:
+        """Swap in fresh weights — the RLHF weight sync (≙ coati's trainer→
+        rollout-worker broadcast; here a device-array handoff). The new tree
+        must match the original's structure/shapes/dtypes so every compiled
+        prefill/decode program is reused without retracing; with a tp mesh
+        the tree is resharded through the same auto-policy specs as at
+        construction."""
+        if self._pp:
+            raise NotImplementedError("sync_params has no pp path yet")
+        if self._tp_mesh is not None:
+            params = self._place_params(params)
+        inner = params["params"] if "params" in params else params
+        # mirror the wrapper convention self.params was constructed with
+        self.params = {"params": inner} if "params" in self.params else inner
+
     # ------------------------------------------------------------- frontend
-    def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None) -> int:
+    def add_request(
+        self, prompt_ids, gen: Optional[GenerationConfig] = None,
+        n_samples: int = 1,
+    ) -> Union[int, List[int]]:
+        """Queue a prompt. ``n_samples > 1`` queues a GROUP (GRPO/best-of-n
+        rollouts): the prompt is prefilled ONCE, full prompt pages are
+        ref-count shared across the members, each member gets its own tail
+        pages (the partial prompt page is copied), and every member decodes
+        independently from the same prefill logits. Returns the request id,
+        or the list of member ids for a group. Pair groups with
+        ``do_sample=True`` — greedy members would all emit the same tokens.
+        """
         req = Request(next(self._ids), list(map(int, prompt_ids)), gen or GenerationConfig())
         if len(req.prompt_ids) >= self.max_seq:
             raise ValueError(f"prompt length {len(req.prompt_ids)} >= max_seq_len {self.max_seq}")
-        need = self._bucket(len(req.prompt_ids)) // self.block_size
+        if n_samples < 1:
+            raise ValueError(f"n_samples={n_samples} must be >= 1")
+        if n_samples > 1 and self._pp:
+            raise NotImplementedError("grouped sampling has no pp relay path yet")
+        if n_samples > self.max_batch:
+            raise ValueError(
+                f"n_samples={n_samples} > max_batch_size={self.max_batch}: "
+                "a group must fit into one running batch"
+            )
+        _, _, _, _, need = self._group_page_needs(len(req.prompt_ids), n_samples)
         if need > self.allocator.num_blocks - 1:
             raise ValueError(
                 f"prompt needs {need} pages but the pool only has "
                 f"{self.allocator.num_blocks - 1} - raise num_blocks"
             )
+        if n_samples > 1:
+            req.group_ids = [req.request_id] + [
+                next(self._ids) for _ in range(n_samples - 1)
+            ]
+            self.waiting.append(req)
+            return list(req.group_ids)
         self.waiting.append(req)
         return req.request_id
 
@@ -227,30 +294,77 @@ class LLMEngine:
                 return b
         return self.max_seq
 
+    def _group_page_needs(self, n: int, n_samples: int):
+        """Page accounting for one (possibly grouped) prompt of ``n``
+        tokens — the SINGLE source both add_request's static validation and
+        the admission gate fund from: ``(bucket, need_leader, full, tail,
+        total)`` where ``full`` prompt-complete pages are fork-shared,
+        each member owns ``tail`` pages, and ``total`` funds the leader's
+        whole bucket plus every follower's tail."""
+        bucket = self._bucket(n)
+        need_leader = bucket // self.block_size
+        full = n // self.block_size
+        tail = need_leader - full
+        return bucket, need_leader, full, tail, need_leader + (n_samples - 1) * tail
+
     def step(self) -> List[Request]:
         """Admit waiting requests into free slots (prefill, page-funded),
         then advance all running slots one token. Returns finished requests."""
         finished_at_prefill: List[Request] = []
-        for slot in self._free_slots():
-            if not self.waiting:
-                break
+        free = self._free_slots()
+        while self.waiting and free:
             req = self.waiting[0]
-            # fund the whole prefill (padded bucket) + one decode page ahead
-            bucket = self._bucket(len(req.prompt_ids))
-            need = bucket // self.block_size
+            if req.n_samples > len(free):
+                break  # a group is admitted whole or not at all
+            n = len(req.prompt_ids)
+            # fund the whole prefill (padded bucket); group followers share
+            # the full prompt pages and fund only their own tail pages
+            bucket, need_leader, full, tail, need = self._group_page_needs(
+                n, req.n_samples
+            )
             if self.allocator.num_free < need:
                 break  # no pages: stay queued until frees arrive
             self.waiting.pop(0)
-            req.slot = slot
-            req.table = SequenceTable(self.allocator.allocate(need))
-            self._tables[slot] = req.table
-            self._prefill_into_slot(req, bucket)
-            if self._is_finished(req, req.output_ids[-1]):
-                req.finished = True
-                finished_at_prefill.append(req)
-                self._release(slot)
-            else:
-                self.running[slot] = req
+            req.slot = free.pop(0)
+            req.table = SequenceTable(self.allocator.allocate(need_leader))
+            self._tables[req.slot] = req.table
+            logits = self._prefill_into_slot(req, bucket)
+            members = [req]
+            for fid in (req.group_ids or [])[1:]:
+                f = Request(fid, req.prompt_ids, req.gen)
+                f.slot = free.pop(0)
+                shared = req.table.blocks[:full]
+                self.allocator.fork(shared)
+                fresh = self.allocator.allocate(tail) if tail else []
+                if n % self.block_size:
+                    # the partial prompt page would be overwritten by this
+                    # member's first tokens: copy-on-write it
+                    self.cache = _copy_block(
+                        self.cache,
+                        jnp.asarray(req.table.blocks[full], jnp.int32),
+                        jnp.asarray(fresh[0], jnp.int32),
+                    )
+                f.table = SequenceTable(shared + fresh)
+                f.table.length = n
+                self._tables[f.slot] = f.table
+                self._set_slot_gen(f.slot, f.gen)
+                # first member token: an independent sample from the SAME
+                # prefill logits (the whole point of the shared prefill)
+                tok = int(self._sample_rows(
+                    logits, np.asarray([f.gen.temperature]),
+                    np.asarray([f.gen.top_k]), np.asarray([f.gen.top_p]),
+                    np.asarray([f.gen.do_sample]),
+                )[0])
+                f.output_ids.append(tok)
+                self._slot_tokens[f.slot] = tok
+                members.append(f)
+            for m in members:
+                if self._is_finished(m, m.output_ids[-1]):
+                    m.finished = True
+                    finished_at_prefill.append(m)
+                    self._release(m.slot)
+                else:
+                    self.running[m.slot] = m
 
         if not self.running:
             return finished_at_prefill
@@ -334,13 +448,19 @@ class LLMEngine:
         )
 
     # -------------------------------------------------------------- internal
-    def _prefill_into_slot(self, req: Request, bucket: int) -> None:
+    def _set_slot_gen(self, slot: int, g: GenerationConfig) -> None:
+        self._gen_temp[slot] = g.temperature
+        self._gen_topk[slot] = g.top_k
+        self._gen_topp[slot] = g.top_p
+        self._gen_sample[slot] = g.do_sample
+
+    def _prefill_into_slot(self, req: Request, bucket: int):
+        """Prefill one prompt into its slot; returns the next-token logits
+        [1, V] (grouped sampling draws every member's first token from
+        them)."""
         n = len(req.prompt_ids)
         g = req.gen
-        self._gen_temp[req.slot] = g.temperature
-        self._gen_topk[req.slot] = g.top_k
-        self._gen_topp[req.slot] = g.top_p
-        self._gen_sample[req.slot] = g.do_sample
+        self._set_slot_gen(req.slot, g)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
         table = jnp.asarray(req.table.padded(self.max_blocks_per_seq), jnp.int32)
@@ -361,6 +481,7 @@ class LLMEngine:
         )[0])
         req.output_ids.append(tok)
         self._slot_tokens[req.slot] = tok
+        return logits
 
     def _release(self, slot: int) -> None:
         self.running.pop(slot, None)

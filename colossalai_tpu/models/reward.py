@@ -52,9 +52,12 @@ class RewardModel(nn.Module):
     def supports_ep(self):
         return getattr(self.lm, "supports_ep", False)
 
+    @nn.nowrap
     def with_config(self, cfg):
         """Rebuild with a new backbone config (precision cast, plugin
-        feature flags) keeping the wrapper."""
+        feature flags) keeping the wrapper. ``nowrap``: flax's method
+        wrapping would auto-parent the freshly built backbone into this
+        (unbound) module and trip the scope assert."""
         return type(self)(lm=type(self.lm)(cfg))
 
     @nn.compact

@@ -1,9 +1,12 @@
-"""PPO actor-critic loop with a trained reward model.
+"""PPO actor-critic loop with engine-backed rollouts.
 
-≙ reference ``applications/ColossalChat/examples/training_scripts/train_ppo``:
-rollouts arrive as arrays (plug your generation loop or the inference
-engine in ``rollout()``); the trainer owns GAE, the clipped surrogate and
-the clipped value loss, each as an ordinary boosted train step.
+≙ reference ``applications/ColossalChat`` distributed PPO
+(``coati/distributed/``): generation is decoupled from the trainer. Here
+the paged inference engine runs in-process: each iteration syncs the
+current actor weights into the engine (a device-array handoff), generates
+``--samples`` completions per prompt — each prompt prefilled ONCE, its KV
+pages fork-shared across the group — scores them with a verifiable rule,
+and applies one PPO update.
 
     python examples/rlhf/ppo_train.py --iters 10 --tp 2
 """
@@ -18,8 +21,9 @@ import numpy as np
 import optax
 
 import colossalai_tpu as clt
-from colossalai_tpu.applications import PPOTrainer
+from colossalai_tpu.applications import EngineRollout, PPOTrainer
 from colossalai_tpu.booster import DataParallelPlugin, HybridParallelPlugin
+from colossalai_tpu.inference import GenerationConfig
 from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM, RewardModel
 
 
@@ -27,8 +31,11 @@ def main():
     clt.launch_from_env()
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompts", type=int, default=4)
+    ap.add_argument("--samples", type=int, default=2,
+                    help="completions per prompt (grouped: one shared prefill)")
     ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--new-tokens", type=int, default=16)
     ap.add_argument("--tp", type=int, default=1)
     args = ap.parse_args()
 
@@ -37,29 +44,38 @@ def main():
         HybridParallelPlugin(tp_size=args.tp, precision="bf16")
         if args.tp > 1 else DataParallelPlugin(precision="bf16")
     )
-    key = jax.random.PRNGKey(0)
-    ids = jax.random.randint(key, (args.batch, args.seq), 0, cfg.vocab_size)
-    mask = jnp.broadcast_to(
-        (jnp.arange(args.seq)[None, :] >= args.seq // 4).astype(jnp.float32),
-        ids.shape,
-    )
-    example = {"input_ids": ids, "loss_mask": mask}
-
+    b = args.prompts * args.samples
+    example = {
+        "input_ids": jnp.zeros((b, args.seq), jnp.int32),
+        "loss_mask": jnp.ones((b, args.seq), jnp.float32),
+    }
     trainer = PPOTrainer(
         LlamaForCausalLM(cfg), RewardModel(lm=LlamaForCausalLM(cfg)),
         optax.adamw(1e-4), optax.adamw(1e-4), plugin, plugin, example,
     )
+    # with tp the engine decodes over the SAME mesh the trainer shards on:
+    # weight sync stays a device-side reshard (no host gather per iteration)
+    rollout = EngineRollout(
+        cfg, pad_to=args.seq, max_batch_size=b, block_size=16,
+        mesh=trainer.actor.mesh.mesh if args.tp > 1 else None,
+        gen=GenerationConfig(
+            max_new_tokens=args.new_tokens, do_sample=True, temperature=1.0
+        ),
+    )
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, cfg.vocab_size, size=(8,)))
+               for _ in range(args.prompts)]
 
-    def rollout(step):
-        """Replace with real generation (inference engine) + reward model
-        scoring; here: random continuations scored by a verifiable rule."""
-        k = jax.random.fold_in(key, step)
-        ids = jax.random.randint(k, (args.batch, args.seq), 0, cfg.vocab_size)
-        rewards = ((ids % 2 == 0).astype(jnp.float32) * mask).sum(-1) / mask.sum(-1)
-        return {"input_ids": ids, "loss_mask": mask, "rewards": rewards}
+    def reward_fn(batch):
+        """Verifiable rule: fraction of even tokens in the completion.
+        Swap in a trained RewardModel eval step for learned rewards."""
+        even = (batch["input_ids"] % 2 == 0) & (batch["loss_mask"] > 0)
+        return even.sum(-1) / np.maximum(batch["loss_mask"].sum(-1), 1.0)
 
     for it in range(args.iters):
-        metrics = trainer.step(rollout(it))
+        metrics = trainer.rollout_step(
+            rollout, prompts, reward_fn, n_samples=args.samples
+        )
         print(
             f"iter {it}: actor {metrics['actor_loss']:.4f} "
             f"critic {metrics['critic_loss']:.4f} reward {metrics['reward_mean']:.3f}"

@@ -192,3 +192,100 @@ def test_sampler_topk_topp_sequential_semantics():
         ))[0])
         outs.add(tok)
     assert outs == {0}, outs
+
+
+# --------------------------------------------------- grouped sampling (GRPO)
+
+
+def test_grouped_greedy_matches_plain_request(model_and_params):
+    """A greedy group member decodes through fork-shared prompt pages +
+    a copied partial page; its output must equal a plain request's."""
+    cfg, model, params = model_and_params
+    prompt = list(RNG.randint(0, cfg.vocab_size, size=(12,)))  # 12 % 8 != 0
+    gen = GenerationConfig(max_new_tokens=6)
+
+    plain = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64, block_size=8)
+    ref = plain.generate([prompt], gen)[0]
+
+    engine = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64, block_size=8)
+    ids = engine.add_request(prompt, gen, n_samples=3)
+    assert isinstance(ids, list) and len(ids) == 3
+    done = {}
+    while len(done) < 3:
+        for req in engine.step():
+            done[req.request_id] = req
+    for rid in ids:
+        assert done[rid].output_ids == ref, (done[rid].output_ids, ref)
+    # every page released (fork refs balanced against frees)
+    assert engine.allocator.num_free == engine.allocator.num_blocks - 1
+
+
+def test_grouped_prefills_once_and_shares_pages(model_and_params, monkeypatch):
+    cfg, model, params = model_and_params
+    import colossalai_tpu.inference.engine as eng_mod
+
+    calls = {"prefill": 0}
+    real_prefill = eng_mod.prefill_paged
+
+    def counting_prefill(*a, **kw):
+        calls["prefill"] += 1
+        return real_prefill(*a, **kw)
+
+    monkeypatch.setattr(eng_mod, "prefill_paged", counting_prefill)
+    engine = LLMEngine(params, cfg, max_batch_size=8, max_seq_len=64, block_size=8)
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True, temperature=1.0)
+    ids = engine.add_request(list(RNG.randint(0, cfg.vocab_size, size=(12,))),
+                             gen, n_samples=4)
+    engine.step()  # admission tick: ONE prefill funds all 4 members
+    assert calls["prefill"] == 1
+    # the 12-token prompt fills one 8-token page completely: that page is
+    # ref-shared by all 4 members
+    shared_block = engine._tables[0].blocks[0]
+    assert engine.allocator.ref_count(shared_block) == 4
+    done = {}
+    while len(done) < 4:
+        for req in engine.step():
+            done[req.request_id] = req
+    assert calls["prefill"] == 1
+    assert engine.allocator.num_free == engine.allocator.num_blocks - 1
+
+
+def test_grouped_sampling_diversifies(model_and_params):
+    cfg, model, params = model_and_params
+    engine = LLMEngine(params, cfg, max_batch_size=8, max_seq_len=64, block_size=8)
+    gen = GenerationConfig(max_new_tokens=8, do_sample=True, temperature=5.0)
+    ids = engine.add_request(list(RNG.randint(0, cfg.vocab_size, size=(10,))),
+                             gen, n_samples=4)
+    done = {}
+    while len(done) < 4:
+        for req in engine.step():
+            done[req.request_id] = req
+    outs = {tuple(done[r].output_ids) for r in ids}
+    assert len(outs) > 1, "high-temperature group produced identical samples"
+
+
+def test_grouped_validation(model_and_params):
+    cfg, model, params = model_and_params
+    engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64, block_size=8)
+    with pytest.raises(ValueError, match="n_samples"):
+        engine.add_request([1, 2, 3], n_samples=0)
+    with pytest.raises(ValueError, match="max_batch_size"):
+        engine.add_request([1, 2, 3], n_samples=3)
+
+
+def test_sync_params_swaps_weights(model_and_params):
+    """sync_params must change the decoded continuation (RLHF weight sync)
+    without rebuilding the engine."""
+    cfg, model, params = model_and_params
+    prompt = list(RNG.randint(0, cfg.vocab_size, size=(8,)))
+    gen = GenerationConfig(max_new_tokens=6)
+    engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64)
+    out_before = engine.generate([prompt], gen)[0]
+
+    params2 = model.init(jax.random.PRNGKey(7), jnp.ones((1, 8), jnp.int32))
+    engine.sync_params(params2)
+    out_after = engine.generate([prompt], gen)[0]
+    ref = LLMEngine(params2, cfg, max_batch_size=2, max_seq_len=64).generate(
+        [prompt], gen)[0]
+    assert out_after == ref
+    assert out_before != out_after  # different weights, different tokens
