@@ -19,10 +19,15 @@ Design deltas for TPU/XLA:
 - optional pipeline parallelism: a mesh with a ``pp`` axis distributes
   layer stages — weights and their KV pages — across device groups with a
   ppermute activation relay (pp_decode.py ≙ schedule/generate.py);
-- multi-host story: the single controller drives the same jitted programs
-  over a mesh that spans hosts (``jax.distributed`` + ICI/DCN
-  collectives) — the XLA runtime replaces the reference's rpc_worker
-  executor processes (≙ inference/executor/rpc_worker.py).
+- multi-host: pass a mesh that SPANS processes (under ``jax.distributed``)
+  and every process runs this same engine as a replicated deterministic
+  scheduler — host inputs become global replicated arrays, the jitted
+  prefill/decode execute over ICI/DCN collectives, and the XLA runtime
+  replaces the reference's rpc_worker executor processes
+  (≙ inference/executor/rpc_worker.py). The contract: every process issues
+  the same add_request/step sequence; ``broadcast_prompts`` ships process
+  0's frontend batch to the rest (tests/test_inference/
+  test_multiprocess_engine.py runs this over 2 real processes).
 """
 
 from __future__ import annotations
@@ -180,14 +185,25 @@ class LLMEngine:
             )
             mesh = None  # skip the GSPMD tp placement below
         self._tp_mesh = mesh
+        # mesh spans processes → multi-controller SPMD: every process runs
+        # this same engine (replicated deterministic scheduler), host inputs
+        # are placed as GLOBAL replicated arrays, and the jitted prefill/
+        # decode programs execute across processes over ICI/DCN collectives.
+        # This replaces the reference's rpc_worker executor processes
+        # (≙ inference/executor/rpc_worker.py): XLA's runtime is the
+        # transport; the contract is that every process issues the SAME
+        # add_request/step sequence (see broadcast_prompts).
+        self._global = mesh is not None and not all(
+            d.process_index == jax.process_index() for d in mesh.devices.flat
+        )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             params = self._place_params(params)
             # pool [L, n_blocks, Hkv, bs, D]: heads over tp
-            kv_spec = NamedSharding(mesh, P(None, None, "tp", None, None))
+            kv_spec = P(None, None, "tp", None, None)
             cache = PagedKVCache(
-                k=jax.device_put(cache.k, kv_spec), v=jax.device_put(cache.v, kv_spec)
+                k=self._put(cache.k, kv_spec), v=self._put(cache.v, kv_spec)
             )
         # pp mode only ever reads _pp_top/_pp_stacked — don't pin a second
         # full copy of the weights for the engine's lifetime
@@ -205,17 +221,65 @@ class LLMEngine:
         self._gen_topp = np.ones((max_batch_size,), np.float32)
         self._gen_sample = np.zeros((max_batch_size,), bool)
 
+    def _put(self, x, spec):
+        """Place ``x`` on the engine mesh. Single-process: a device_put.
+        Multi-process: the local value must be IDENTICAL on every process
+        (same init seed / same checkpoint); each process contributes its
+        addressable shards of the global array."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ns = NamedSharding(self._tp_mesh, spec if isinstance(spec, PartitionSpec)
+                           else PartitionSpec(*spec))
+        if not self._global:
+            return jax.device_put(x, ns)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # already a process-spanning global array (e.g. sync_params
+            # from a multi-process trainer): reshard device-side
+            return jax.jit(lambda a: a, out_shardings=ns)(x)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, ns, lambda idx: arr[idx])
+
+    def _put_rep(self, x):
+        """Replicated placement of a host operand (block tables, slot
+        tokens, rng keys) so multi-process jits see global arrays; on a
+        single process jnp.asarray is enough."""
+        from jax.sharding import PartitionSpec as P
+
+        return self._put(x, P()) if self._global else jnp.asarray(x)
+
+    @staticmethod
+    def _fetch(arr) -> np.ndarray:
+        """Host fetch that works on global arrays: outputs of the sampling
+        jits are replicated, so the local shard IS the full value."""
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        return np.asarray(arr.addressable_shards[0].data)
+
+    @staticmethod
+    def broadcast_prompts(prompts):
+        """Ship process 0's prompt batch to every process (the serving
+        frontend lives on one host; the SPMD contract needs every process
+        to enqueue the same requests). Returns the prompts on all
+        processes."""
+        from jax.experimental import multihost_utils
+
+        n = np.asarray([len(prompts), max((len(p) for p in prompts), default=0)])
+        n = multihost_utils.broadcast_one_to_all(n)
+        padded = np.full((int(n[0]), max(int(n[1]), 1)), -1, np.int32)
+        if jax.process_index() == 0:
+            for i, p in enumerate(prompts):
+                padded[i, :len(p)] = p
+        padded = multihost_utils.broadcast_one_to_all(padded)
+        return [[int(t) for t in row if t >= 0] for row in padded]
+
     def _place_params(self, params):
         """tp placement of a param tree via the llama auto-policy specs."""
-        from jax.sharding import NamedSharding
-
         from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
 
         tree = params["params"] if "params" in params else params
         specs = get_autopolicy("llama").param_specs(tree)
         sharded = jax.tree.map(
-            lambda a, s: jax.device_put(a, NamedSharding(self._tp_mesh, s)),
-            tree, specs,
+            self._put, tree, specs,
             is_leaf=lambda x: not isinstance(x, dict),
         )
         return {"params": sharded} if "params" in params else sharded
@@ -341,8 +405,8 @@ class LLMEngine:
                     # member's first tokens: copy-on-write it
                     self.cache = _copy_block(
                         self.cache,
-                        jnp.asarray(req.table.blocks[full], jnp.int32),
-                        jnp.asarray(fresh[0], jnp.int32),
+                        self._put_rep(np.asarray(req.table.blocks[full], np.int32)),
+                        self._put_rep(np.asarray(fresh[0], np.int32)),
                     )
                 f.table = SequenceTable(shared + fresh)
                 f.table.length = n
@@ -384,7 +448,7 @@ class LLMEngine:
         if not self.running:
             return finished_at_prefill
 
-        tokens = jnp.asarray(self._slot_tokens, jnp.int32)
+        tokens = self._put_rep(np.asarray(self._slot_tokens, np.int32))
         tables = np.zeros((self.max_batch, self.max_blocks_per_seq), np.int32)
         lengths = np.zeros((self.max_batch,), np.int32)
         active = np.zeros((self.max_batch,), bool)
@@ -399,8 +463,8 @@ class LLMEngine:
             )
         else:
             logits, self.cache = decode_paged(
-                self.params, self.config, tokens, jnp.asarray(tables),
-                jnp.asarray(lengths), self.cache, jnp.asarray(active),
+                self.params, self.config, tokens, self._put_rep(tables),
+                self._put_rep(lengths), self.cache, self._put_rep(active),
                 use_kernel=self.use_kernel,
             )
         # ALL slots sample on device with their own params; the host fetches
@@ -430,12 +494,14 @@ class LLMEngine:
         params; all-greedy rows take a bare-argmax program (the benchmarked
         default path skips the sort/softmax machinery entirely)."""
         if not np.any(sample_mask):
-            return np.asarray(_greedy_slots(logits))
+            return self._fetch(_greedy_slots(logits))
         self._rng, key = jax.random.split(self._rng)
-        return np.asarray(_sample_slots(
-            logits, key, jnp.asarray(temp, jnp.float32),
-            jnp.asarray(topk, jnp.int32), jnp.asarray(topp, jnp.float32),
-            jnp.asarray(sample_mask, bool),
+        return self._fetch(_sample_slots(
+            logits, self._put_rep(np.asarray(key)),
+            self._put_rep(np.asarray(temp, np.float32)),
+            self._put_rep(np.asarray(topk, np.int32)),
+            self._put_rep(np.asarray(topp, np.float32)),
+            self._put_rep(np.asarray(sample_mask, bool)),
         ))
 
     def _is_finished(self, req: Request, last_tok: int) -> bool:
@@ -463,16 +529,17 @@ class LLMEngine:
         self._set_slot_gen(req.slot, g)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
-        table = jnp.asarray(req.table.padded(self.max_blocks_per_seq), jnp.int32)
+        table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
         if self._pp:
             logits, self.cache = self._pp_prefill(
                 self._pp_top, self._pp_stacked, jnp.asarray(ids),
-                jnp.asarray([n], jnp.int32), self.cache, table,
+                jnp.asarray([n], jnp.int32), self.cache, jnp.asarray(table),
             )
         else:
             logits, self.cache = prefill_paged(
-                self.params, self.config, jnp.asarray(ids),
-                jnp.asarray([n], jnp.int32), self.cache, table,
+                self.params, self.config, self._put_rep(ids),
+                self._put_rep(np.asarray([n], np.int32)), self.cache,
+                self._put_rep(table),
             )
         req.table.length = n
         tok = int(self._sample_rows(
