@@ -1,15 +1,28 @@
-"""Evaluation harness: perplexity + log-prob choice scoring.
+"""Evaluation harness: dataset runners over two scoring primitives.
 
-≙ reference ``applications/ColossalEval`` (dataset runners + metrics): the
-two primitives every eval there reduces to — next-token perplexity over a
-corpus, and multiple-choice answers picked by length-normalized completion
-log-probability (the ARC/MMLU/HellaSwag scoring rule).
+≙ reference ``applications/ColossalEval`` (``colossal_eval/dataset/``
+runner classes — e.g. ``mmlu.py`` — + prompt templates + per-benchmark
+metrics). Structure here:
+
+- primitives: corpus perplexity (:func:`evaluate_perplexity`) and
+  length-normalized completion log-prob (:func:`score_choices`);
+- runners: :class:`ChoiceTaskRunner` (MMLU/ARC letter-style and
+  HellaSwag continuation-style, few-shot templating, bucketed batches
+  scored in one forward per batch — through a raw model or through a
+  boosted/sharded ``eval_step``) and :class:`GenerationTaskRunner`
+  (GSM8K-style greedy generation through the paged
+  :class:`~colossalai_tpu.inference.LLMEngine` + answer extraction +
+  exact match);
+- :func:`run_benchmarks` drives a task list into a per-benchmark results
+  dict.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Any, Dict, Iterable, List, Sequence
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -62,9 +75,300 @@ def score_choices(
         comp_mask[i, plen : plen + len(comp)] = 1.0
 
     out = model.apply({"params": p}, jnp.asarray(ids))
-    lp = dist_log_prob(out.logits[:, :-1], jnp.asarray(ids)[:, 1:])
+    seq_lp = _masked_completion_logprob(out.logits, ids, comp_mask, length_normalize)
+    return [float(x) for x in seq_lp]
+
+
+def _masked_completion_logprob(logits, ids, comp_mask, length_normalize):
+    """The one scoring rule every choice eval reduces to: summed (or
+    length-normalized) next-token log-prob over completion positions."""
+    lp = dist_log_prob(logits[:, :-1], jnp.asarray(ids)[:, 1:])
     mask = jnp.asarray(comp_mask)[:, 1:]
     seq_lp = (lp * mask).sum(-1)
     if length_normalize:
         seq_lp = seq_lp / jnp.maximum(mask.sum(-1), 1.0)
-    return [float(x) for x in seq_lp]
+    return seq_lp
+
+
+# ------------------------------------------------------------ dataset runners
+
+LETTERS = "ABCDEFGH"
+
+
+@dataclasses.dataclass
+class ChoiceSample:
+    """One multiple-choice item (≙ a ColossalEval dataset row)."""
+
+    question: str
+    choices: List[str]
+    answer: int  # index into choices
+    context: str = ""  # optional passage/premise
+
+
+@dataclasses.dataclass
+class GenSample:
+    """One generation item; ``answer`` is the string to exact-match."""
+
+    question: str
+    answer: str
+
+
+def mmlu_prompt(s: ChoiceSample, include_answer: bool) -> str:
+    """Letter-style template (≙ ColossalEval mmlu.py get_few_shot_data):
+    the model is scored on the answer LETTER after 'Answer:'."""
+    head = [s.context] if s.context else []
+    lines = head + [s.question] + [
+        f"{LETTERS[i]}. {c}" for i, c in enumerate(s.choices)
+    ]
+    tail = f" {LETTERS[s.answer]}\n\n" if include_answer else ""
+    return "\n".join(lines) + "\nAnswer:" + tail
+
+
+def continuation_prompt(s: ChoiceSample, include_answer: bool) -> str:
+    """Continuation-style (HellaSwag/ARC-challenge scoring rule): the
+    candidate CONTINUATIONS are scored after the context."""
+    tail = f" {s.choices[s.answer]}\n\n" if include_answer else ""
+    return (s.context + " " if s.context else "") + s.question + tail
+
+
+class ChoiceTaskRunner:
+    """Few-shot multiple-choice benchmark runner.
+
+    ``style="letter"`` scores the answer letter (MMLU/ARC letter rule);
+    ``style="continuation"`` scores each full choice text (HellaSwag
+    rule, length-normalized by default). Items are bucketed by padded
+    length and scored one forward per batch.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        samples: Sequence[ChoiceSample],
+        tokenizer: Callable[[str], List[int]],
+        *,
+        dev_samples: Sequence[ChoiceSample] = (),
+        n_shot: int = 0,
+        style: str = "letter",
+        length_normalize: Optional[bool] = None,
+        batch_size: int = 8,
+    ):
+        if style not in ("letter", "continuation"):
+            raise ValueError(f"style={style!r} not in ('letter', 'continuation')")
+        if n_shot > len(dev_samples):
+            raise ValueError(
+                f"n_shot={n_shot} needs >= that many dev_samples "
+                f"(got {len(dev_samples)})"
+            )
+        if style == "letter":
+            widest = max((len(s.choices) for s in [*samples, *dev_samples]),
+                         default=0)
+            if widest > len(LETTERS):
+                raise ValueError(
+                    f"letter style labels at most {len(LETTERS)} choices; "
+                    f"a sample has {widest} — use style='continuation'"
+                )
+        self.name = name
+        self.samples = list(samples)
+        self.tok = tokenizer
+        self.dev = list(dev_samples)[:n_shot]
+        self.style = style
+        self.template = mmlu_prompt if style == "letter" else continuation_prompt
+        # letter answers are single tokens — normalization is a no-op there
+        # and HURTS continuation scoring when off (HF convention: on)
+        self.length_normalize = (
+            (style == "continuation") if length_normalize is None else length_normalize
+        )
+        self.batch_size = batch_size
+
+    def _few_shot_prefix(self) -> str:
+        return "".join(self.template(d, include_answer=True) for d in self.dev)
+
+    def rows(self):
+        """(prompt_ids, per-choice completion ids, answer) per sample."""
+        prefix = self._few_shot_prefix()
+        for s in self.samples:
+            prompt = prefix + self.template(s, include_answer=False)
+            if self.style == "letter":
+                comps = [self.tok(f" {LETTERS[i]}") for i in range(len(s.choices))]
+            else:
+                comps = [self.tok(" " + c) for c in s.choices]
+            yield self.tok(prompt), comps, s.answer
+
+    def run(self, model=None, params=None, boosted=None) -> Dict[str, Any]:
+        """Accuracy over the samples. Pass ``model, params`` for a raw
+        forward or ``boosted=`` to score through the plugin's sharded
+        eval_step (any tp/sp config)."""
+        score = _make_row_scorer(model, params, boosted)
+        correct = n = 0
+        batch: List[tuple] = []
+
+        def flush():
+            nonlocal correct, n
+            if not batch:
+                return
+            ids, mask, meta = _pad_rows(batch)
+            lp = score(ids, mask, self.length_normalize)
+            at = 0
+            for n_choices, answer in meta:
+                pred = int(np.argmax(lp[at:at + n_choices]))
+                correct += int(pred == answer)
+                n += 1
+                at += n_choices
+            batch.clear()
+
+        for prompt_ids, comps, answer in self.rows():
+            batch.append((prompt_ids, comps, answer))
+            if len(batch) >= self.batch_size:
+                flush()
+        flush()
+        return {"task": self.name, "accuracy": correct / max(n, 1), "n": n,
+                "n_shot": len(self.dev), "style": self.style}
+
+
+def _pad_rows(batch):
+    """Flatten (prompt, choices) into one padded [rows, L] matrix with a
+    completion mask. L pads to the next multiple of 16 and the row count
+    to the next multiple of 8 (all-zero-mask filler rows, ignored by the
+    meta walk) so shape buckets — and therefore recompiles — stay few and
+    a dp mesh can always shard dim 0."""
+    rows, meta = [], []
+    for prompt_ids, comps, answer in batch:
+        meta.append((len(comps), answer))
+        for c in comps:
+            rows.append((prompt_ids, c))
+    L = max(len(p) + len(c) for p, c in rows)
+    L = (L + 15) // 16 * 16
+    n_rows = (len(rows) + 7) // 8 * 8
+    ids = np.zeros((n_rows, L), np.int32)
+    mask = np.zeros((n_rows, L), np.float32)
+    for i, (p, c) in enumerate(rows):
+        ids[i, :len(p)] = p
+        ids[i, len(p):len(p) + len(c)] = c
+        mask[i, len(p):len(p) + len(c)] = 1.0
+    return ids, mask, meta
+
+
+def _make_row_scorer(model, params, boosted):
+    """score(ids, comp_mask, length_normalize) -> [rows] log-probs, via a
+    raw apply or the boosted eval_step's logits (sharded forward)."""
+    if boosted is not None:
+        def logits_of(ids):
+            out = boosted.eval_step(
+                boosted.state, boosted.shard_batch({"input_ids": ids})
+            )
+            return out["logits"]
+    elif model is not None and params is not None:
+        p = params["params"] if "params" in params else params
+
+        def logits_of(ids):
+            return model.apply({"params": p}, jnp.asarray(ids)).logits
+    else:
+        raise ValueError("pass model+params or boosted=")
+
+    def score(ids, comp_mask, length_normalize):
+        seq_lp = _masked_completion_logprob(
+            logits_of(ids), ids, comp_mask, length_normalize
+        )
+        return np.asarray(jax.device_get(seq_lp))
+
+    return score
+
+
+def extract_last_number(text: str) -> Optional[str]:
+    """GSM8K answer rule: the '#### N' marker if present, else the last
+    number in the generation."""
+    m = re.search(r"####\s*(-?[\d,.]+)", text)
+    if m is None:
+        nums = re.findall(r"-?\d[\d,]*\.?\d*", text)
+        if not nums:
+            return None
+        raw = nums[-1]
+    else:
+        raw = m.group(1)
+    return raw.replace(",", "").rstrip(".")
+
+
+class GenerationTaskRunner:
+    """Few-shot generation benchmark (GSM8K-style exact match): greedy
+    decode through the paged engine, extract the answer, compare."""
+
+    def __init__(
+        self,
+        name: str,
+        samples: Sequence[GenSample],
+        tokenizer: Callable[[str], List[int]],
+        detokenizer: Callable[[Sequence[int]], str],
+        *,
+        dev_samples: Sequence[GenSample] = (),
+        n_shot: int = 0,
+        max_new_tokens: int = 64,
+        extract: Callable[[str], Optional[str]] = extract_last_number,
+        eos_token_id: Optional[int] = None,
+    ):
+        if n_shot > len(dev_samples):
+            raise ValueError(f"n_shot={n_shot} needs >= that many dev_samples")
+        self.name = name
+        self.samples = list(samples)
+        self.tok, self.detok = tokenizer, detokenizer
+        self.dev = list(dev_samples)[:n_shot]
+        self.max_new_tokens = max_new_tokens
+        self.extract = extract
+        self.eos_token_id = eos_token_id
+
+    @staticmethod
+    def _item(s: GenSample, include_answer: bool) -> str:
+        tail = f" {s.answer}\n\n" if include_answer else ""
+        return f"Question: {s.question}\nAnswer:" + tail
+
+    def prompts(self) -> List[List[int]]:
+        prefix = "".join(self._item(d, include_answer=True) for d in self.dev)
+        return [self.tok(prefix + self._item(s, include_answer=False))
+                for s in self.samples]
+
+    def run(self, model=None, params=None, *, engine=None,
+            max_batch_size: int = 8) -> Dict[str, Any]:
+        """Exact-match rate. Pass a prebuilt ``engine=`` (reused pages /
+        custom mesh) or ``model, params`` to build a throwaway one."""
+        from colossalai_tpu.inference import GenerationConfig, LLMEngine
+
+        prompts = self.prompts()
+        if engine is None:
+            if model is None or params is None:
+                raise ValueError("pass model+params or engine=")
+            longest = max(len(p) for p in prompts) + self.max_new_tokens + 1
+            max_seq = (longest + 63) // 64 * 64
+            engine = LLMEngine(params, model.config,
+                               max_batch_size=max_batch_size,
+                               max_seq_len=max_seq)
+        gen = GenerationConfig(max_new_tokens=self.max_new_tokens,
+                               eos_token_id=self.eos_token_id)
+        outs = engine.generate(prompts, gen)
+        hits = 0
+        for s, out in zip(self.samples, outs):
+            got = self.extract(self.detok(out))
+            # normalize the GOLD answer through the same extractor so
+            # '1,234' matches '1234' (fall back to strip when the gold has
+            # no extractable form)
+            gold = self.extract(s.answer)
+            gold = s.answer.strip() if gold is None else gold
+            hits += int(got is not None and got == gold)
+        n = len(self.samples)
+        return {"task": self.name, "exact_match": hits / max(n, 1), "n": n,
+                "n_shot": len(self.dev)}
+
+
+def run_benchmarks(tasks: Sequence[Any], **target) -> Dict[str, Dict[str, Any]]:
+    """Drive a list of runners against one model; returns
+    ``{task_name: metrics}`` (≙ ColossalEval's per-benchmark results
+    dict). ``target`` forwards to each runner's ``run`` (``model=,
+    params=`` / ``boosted=`` / ``engine=`` as the runner supports)."""
+    results = {}
+    for t in tasks:
+        kw = dict(target)
+        if isinstance(t, GenerationTaskRunner):
+            kw.pop("boosted", None)
+        else:
+            kw.pop("engine", None)
+            kw.pop("max_batch_size", None)
+        results[t.name] = t.run(**kw)
+    return results

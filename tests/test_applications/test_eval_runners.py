@@ -1,0 +1,193 @@
+"""Dataset runners (≙ ColossalEval colossal_eval/dataset/mmlu.py etc.):
+few-shot templating, batched choice scoring (raw and sharded paths must
+agree), GSM8K-style generation exact match."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.applications import (
+    ChoiceSample,
+    ChoiceTaskRunner,
+    GenSample,
+    GenerationTaskRunner,
+    extract_last_number,
+    run_benchmarks,
+)
+from colossalai_tpu.applications.eval import LETTERS, continuation_prompt, mmlu_prompt
+from colossalai_tpu.booster import Booster, HybridParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def tok(s):
+    return [ord(c) % 256 for c in s]
+
+
+def detok(ids):
+    return "".join(chr(int(t) % 256) for t in ids)
+
+
+SAMPLES = [
+    ChoiceSample("What is 2+2?", ["3", "4", "5", "6"], answer=1),
+    ChoiceSample("Pick B.", ["no", "yes", "maybe", "never"], answer=1),
+    ChoiceSample("Pick D.", ["a", "b", "c", "d"], answer=3),
+]
+
+
+def test_mmlu_prompt_template():
+    s = SAMPLES[0]
+    q = mmlu_prompt(s, include_answer=False)
+    assert q == "What is 2+2?\nA. 3\nB. 4\nC. 5\nD. 6\nAnswer:"
+    shot = mmlu_prompt(s, include_answer=True)
+    assert shot.endswith("Answer: B\n\n")
+
+
+def test_few_shot_prefix_composes():
+    r = ChoiceTaskRunner("mmlu", SAMPLES[:1], tok, dev_samples=SAMPLES[1:],
+                         n_shot=2)
+    prompt_ids, comps, answer = next(iter(r.rows()))
+    text = detok(prompt_ids)
+    # both dev items appear WITH answers, the test item without
+    assert text.count("Answer:") == 3
+    assert "Answer: B\n\n" in text and "Answer: D\n\n" in text
+    assert text.endswith("Answer:")
+    assert [detok(c) for c in comps] == [" A", " B", " C", " D"]
+    assert answer == 1
+
+
+class _RiggedLM:
+    """Fake causal LM whose next-token logits always favor one char —
+    makes runner accuracy exactly predictable without training."""
+
+    def __init__(self, favorite: str):
+        self.fav = ord(favorite) % 256
+
+    def apply(self, variables, ids):
+        b, s = np.asarray(ids).shape
+        logits = np.zeros((b, s, 256), np.float32)
+        logits[..., self.fav] = 5.0
+
+        @dataclasses.dataclass
+        class Out:
+            logits: jnp.ndarray
+
+        return Out(logits=jnp.asarray(logits))
+
+
+def test_letter_runner_scores_rigged_model():
+    # a model that always wants to emit "B" answers letter-B on every item
+    r = ChoiceTaskRunner("mmlu", SAMPLES, tok, batch_size=2)
+    res = r.run(model=_RiggedLM("B"), params={"params": {}})
+    # items with answer==1 (letter B) are "correct": samples 0 and 1
+    assert res == {"task": "mmlu", "accuracy": 2 / 3, "n": 3, "n_shot": 0,
+                   "style": "letter"}
+    res_d = ChoiceTaskRunner("mmlu", SAMPLES, tok).run(
+        model=_RiggedLM("D"), params={"params": {}})
+    assert res_d["accuracy"] == 1 / 3  # only sample 2 has answer D
+
+
+def test_continuation_runner_length_normalizes():
+    # continuation scoring: choices of DIFFERENT lengths; the rigged model
+    # gives every token the same logp, so without normalization the
+    # shortest choice always wins, with it they tie (argmax -> index 0)
+    s = ChoiceSample("The sky is", ["blue", "cerulean today"], answer=0,
+                     context="Look up.")
+    assert continuation_prompt(s, True).endswith(" blue\n\n")
+    r = ChoiceTaskRunner("hellaswag", [s], tok, style="continuation")
+    assert r.length_normalize is True
+    res = r.run(model=_RiggedLM("x"), params={"params": {}})
+    assert res["n"] == 1 and res["style"] == "continuation"
+
+
+def test_raw_and_boosted_scoring_agree():
+    """The sharded eval_step path must produce the same accuracy as the
+    raw forward (the runner's 'batched through Booster' contract)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    runner = ChoiceTaskRunner("mmlu", SAMPLES, tok, batch_size=8)
+    raw = runner.run(model=model, params=params)
+    # load the SAME weights into a tp2-boosted state so scores compare
+    sharded = runner.run(boosted=_reboost_with(model, params))
+    assert sharded["accuracy"] == raw["accuracy"], (sharded, raw)
+
+
+def _reboost_with(model, params):
+    """Boost the model and overwrite the state with the given weights."""
+    boosted = Booster(plugin=HybridParallelPlugin(tp_size=2, precision="fp32")).boost(
+        model, optax.adamw(1e-3),
+        example_batch={"input_ids": jnp.zeros((12, 64), jnp.int32)},
+        rng=jax.random.PRNGKey(0),
+    )
+    placed = jax.tree.map(
+        jax.device_put, params["params"],
+        jax.tree.map(lambda s: s, boosted.state_shardings.params),
+    )
+    boosted.state = boosted.state.replace(params=placed)
+    return boosted
+
+
+def test_extract_last_number():
+    assert extract_last_number("blah 12 then #### 42") == "42"
+    assert extract_last_number("#### 1,234.") == "1234"
+    assert extract_last_number("costs 3 plus 4 = 7 total") == "7"
+    assert extract_last_number("no digits here") is None
+
+
+class _StubEngine:
+    """Replays canned completions; records prompts for template checks."""
+
+    def __init__(self, outputs):
+        self.outputs = outputs
+        self.seen = None
+
+    def generate(self, prompts, gen):
+        self.seen = prompts
+        return self.outputs
+
+
+def test_generation_runner_exact_match():
+    samples = [GenSample("2+2?", "4"), GenSample("3*3?", "9")]
+    dev = [GenSample("1+1?", "2")]
+    r = GenerationTaskRunner("gsm8k", samples, tok, detok,
+                             dev_samples=dev, n_shot=1, max_new_tokens=8)
+    stub = _StubEngine([tok(" the answer is #### 4"), tok(" hmm #### 8")])
+    res = r.run(engine=stub)
+    assert res == {"task": "gsm8k", "exact_match": 0.5, "n": 2, "n_shot": 1}
+    # few-shot prefix reached the engine: dev answer embedded, test q last
+    texts = [detok(p) for p in stub.seen]
+    assert all(t.startswith("Question: 1+1?\nAnswer: 2\n\n") for t in texts)
+    assert texts[0].endswith("Question: 2+2?\nAnswer:")
+
+
+@pytest.mark.slow
+def test_generation_runner_real_engine_and_run_benchmarks():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    tasks = [
+        ChoiceTaskRunner("mmlu", SAMPLES, tok),
+        GenerationTaskRunner("gsm8k", [GenSample("2+2?", "4")], tok, detok,
+                             max_new_tokens=4),
+    ]
+    res = run_benchmarks(tasks, model=model, params=params)
+    assert set(res) == {"mmlu", "gsm8k"}
+    assert 0.0 <= res["mmlu"]["accuracy"] <= 1.0 and res["mmlu"]["n"] == 3
+    assert 0.0 <= res["gsm8k"]["exact_match"] <= 1.0 and res["gsm8k"]["n"] == 1
+
+
+def test_gold_answer_normalized_like_prediction():
+    r = GenerationTaskRunner("gsm8k", [GenSample("big?", "1,234")], tok, detok)
+    res = r.run(engine=_StubEngine([tok(" #### 1234")]))
+    assert res["exact_match"] == 1.0
+
+
+def test_letter_runner_rejects_too_many_choices():
+    wide = ChoiceSample("q", [str(i) for i in range(9)], 0)
+    with pytest.raises(ValueError, match="letter style"):
+        ChoiceTaskRunner("x", [wide], tok)
+    ChoiceTaskRunner("x", [wide], tok, style="continuation")  # fine
