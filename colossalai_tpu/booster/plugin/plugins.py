@@ -276,7 +276,8 @@ class HybridParallelPlugin(Plugin):
             if not getattr(model, "supports_fp8", False):
                 raise NotImplementedError(
                     f"{type(model).__name__} has no fp8 matmul path "
-                    "(supports_fp8); currently the llama family implements it"
+                    "(supports_fp8); the llama family and every DecoderLM-"
+                    "based family implement it"
                 )
             if not getattr(model.config, "fp8_matmul", False):
                 updates["fp8_matmul"] = True

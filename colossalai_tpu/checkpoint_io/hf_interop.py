@@ -19,6 +19,8 @@ per-model ``modeling`` name conventions. One declarative spec per family:
     [q…q k v] layout (MQA = 1 group) ↔ our split q/k/v (needs ``heads``)
   - "qkv_concat": MPT Wqkv, plain [q_all; k_all; v_all] block concat
     ↔ our split q/k/v (needs ``heads``)
+  - "glu_concat": chatglm dense_h_to_4h, [gate; up] row concat ↔ our
+    separate gate_proj/up_proj kernels
 - multiple scanned stacks (T5/Whisper encoder+decoder, DeepSeek
   dense_layers+layers) with per-stack HF layer-index offsets;
 - optional entries (qkv biases, lm_head) are skipped when absent on either
@@ -711,6 +713,53 @@ _DEEPSEEK_V3 = dataclasses.replace(
     },
 )
 
+_BAICHUAN = _spec(
+    "layers",
+    _LLAMA_TOP,
+    [
+        # fused W_pack = plain [q; k; v] row concat (MHA: nh == nkv).
+        # Published layout: baichuan-inc/Baichuan-13B — llama bones with
+        # ALiBi; Baichuan2's NormHead is an inference-time renorm of the
+        # SAME stored lm_head tensor, so its checkpoints load identically.
+        ("model.layers.{i}.self_attn.W_pack.weight", "self_attn", "qkv_concat"),
+        ("model.layers.{i}.self_attn.o_proj.weight", "self_attn.o_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.gate_proj.weight", "mlp.gate_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.up_proj.weight", "mlp.up_proj.kernel", "linear"),
+        ("model.layers.{i}.mlp.down_proj.weight", "mlp.down_proj.kernel", "linear"),
+        ("model.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        ("model.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+    ],
+    optional=("lm_head.kernel",),
+    vocab_keys=("model.embed_tokens.weight", "lm_head.weight"),
+)
+
+_CHATGLM = _spec(
+    "layers",
+    [
+        # published THUDM/chatglm2+3 layout (the trust_remote_code modeling
+        # file's state-dict names are stable across chatglm2/3)
+        ("transformer.embedding.word_embeddings.weight", "embed_tokens.embedding", "raw"),
+        ("transformer.encoder.final_layernorm.weight", "norm.scale", "raw"),
+        ("transformer.output_layer.weight", "lm_head.kernel", "linear"),
+    ],
+    [
+        ("transformer.encoder.layers.{i}.input_layernorm.weight", "input_layernorm.scale", "raw"),
+        # fused qkv, plain [q_all; k_all; v_all] concat with GQA-sized k/v
+        # (multi_query_group_num) — the mpt Wqkv layout
+        ("transformer.encoder.layers.{i}.self_attention.query_key_value.weight", "self_attn", "qkv_concat"),
+        ("transformer.encoder.layers.{i}.self_attention.query_key_value.bias", "self_attn", "qkv_concat_bias"),
+        ("transformer.encoder.layers.{i}.self_attention.dense.weight", "self_attn.o_proj.kernel", "linear"),
+        ("transformer.encoder.layers.{i}.post_attention_layernorm.weight", "post_attention_layernorm.scale", "raw"),
+        # SwiGLU packed as one [gate; up] matrix
+        ("transformer.encoder.layers.{i}.mlp.dense_h_to_4h.weight", "mlp", "glu_concat"),
+        ("transformer.encoder.layers.{i}.mlp.dense_4h_to_h.weight", "mlp.down_proj.kernel", "linear"),
+    ],
+    vocab_keys=("transformer.embedding.word_embeddings.weight",
+                "transformer.output_layer.weight"),
+    # computed rotary table, not a parameter
+    ignore_hf=("transformer.rotary_pos_emb.inv_freq",),
+)
+
 HF_SPECS: Dict[str, FamilySpec] = {
     "llama": _LLAMA,
     "mistral": _LLAMA,
@@ -734,6 +783,8 @@ HF_SPECS: Dict[str, FamilySpec] = {
     "starcoder2": _STARCODER2,
     "mpt": _MPT,
     "gpt_bigcode": _GPT_BIGCODE,
+    "baichuan": _BAICHUAN,
+    "chatglm": _CHATGLM,
     "bert": _BERT,
     "vit": _VIT,
     "t5": _T5,
@@ -829,14 +880,22 @@ def _qkv_paths(ours: str, is_bias: bool):
     return [f"{ours}.{p}_proj.{sfx}" for p in ("q", "k", "v")]
 
 
+def _glu_paths(ours: str):
+    return [f"{ours}.gate_proj.kernel", f"{ours}.up_proj.kernel"]
+
+
 def _stack_len(stack, stack_spec) -> int:
     """Layer count of a scanned stack = dim 0 of any resolvable entry."""
     if stack is None:
         return 0
     for _, ours, kind in stack_spec.entries:
-        node = _get(
-            stack, _qkv_paths(ours, False)[0] if kind.startswith("qkv_") else ours
-        )
+        if kind.startswith("qkv_"):
+            path = _qkv_paths(ours, False)[0]
+        elif kind == "glu_concat":
+            path = _glu_paths(ours)[0]
+        else:
+            path = ours
+        node = _get(stack, path)
         if node is not None:
             return int(np.asarray(node).shape[0])
     return 0
@@ -933,6 +992,17 @@ def params_to_hf(
                 for j in range(qp.shape[0]):
                     out[hf_t.format(i=j + base)] = _join_qkv(
                         qp[j], kp[j], vp[j], kind, heads, family
+                    )
+                continue
+            if kind == "glu_concat":
+                gp, up = (_get(stack, x) for x in _glu_paths(ours))
+                if gp is None or up is None:
+                    raise KeyError(f"{family}: missing {ours} gate/up")
+                gp, up = np.asarray(gp), np.asarray(up)
+                for j in range(gp.shape[0]):
+                    # our [in, ffn] kernels → HF [2*ffn, in] rows [gate; up]
+                    out[hf_t.format(i=j + base)] = np.concatenate(
+                        [gp[j].T, up[j].T], axis=0
                     )
                 continue
             node = _get(stack, ours)
@@ -1080,6 +1150,21 @@ def hf_to_params(
                 for path, stacked in zip(
                     _qkv_paths(ours, is_bias),
                     (np.stack(qs, 0), np.stack(ks, 0), np.stack(vs, 0)),
+                ):
+                    _put(p, f"{container}.block.{path}", stacked)
+                continue
+            if kind == "glu_concat":
+                gs, us = [], []
+                for j in range(n):
+                    key = hf_t.format(i=j + base)
+                    if key not in state:
+                        raise KeyError(f"{family}: checkpoint missing {key}")
+                    consumed.add(key)
+                    g, u = np.split(state[key], 2, axis=0)  # rows [gate; up]
+                    gs.append(g.T)
+                    us.append(u.T)
+                for path, stacked in zip(
+                    _glu_paths(ours), (np.stack(gs, 0), np.stack(us, 0))
                 ):
                     _put(p, f"{container}.block.{path}", stacked)
                 continue

@@ -256,9 +256,19 @@ class DecoderMLP(nn.Module):
         cfg = self.config
         dtype = cfg.dtype or jnp.float32
         act = _ACTS[cfg.act_fn]
+        extra = {}
+        if cfg.fp8_matmul:
+            # same param tree as the bf16 path; only the matmul changes
+            # (≙ FP8Hook patching Linear.forward to fp8_linear — the hook
+            # is model-agnostic there, and so is this: every DecoderLM
+            # family inherits the fp8 MLP path)
+            from colossalai_tpu.quantization.fp8 import fp8_dot_general
+
+            extra["dot_general"] = fp8_dot_general
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=cfg.mlp_bias, dtype=dtype,
             param_dtype=cfg.param_dtype or jnp.float32, name=name,
+            **extra,
         )
         if cfg.glu:
             gate = dense(cfg.intermediate_size, "gate_proj")(x)
@@ -306,6 +316,10 @@ class DecoderLM(nn.Module):
     config: DecoderConfig
     supports_pipeline = True
     supports_sp_modes = ("split_gather", "all_to_all")
+    #: fp8 MLP matmuls (enable_fp8) — generalized across every family
+    #: built on this decoder (≙ the model-agnostic FP8Hook,
+    #: quantization/fp8_hook.py:7)
+    supports_fp8 = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):

@@ -20,15 +20,53 @@ import pytest  # noqa: E402
 # pinned; config.update before first backend use still wins.
 jax.config.update("jax_platforms", "cpu")
 
-# NOTE: do NOT enable jax_compilation_cache_dir here, despite the ~7x warm
-# speedup it gives per boosted config (measured on jax 0.9). Root cause of
-# the r02-documented crash, narrowed this round: executables containing a
-# CollectivePermute inside a WhileThunk (scanned layers + GSPMD collectives
-# — most tp-trained models here) hit an XLA:CPU AOT-reload bug where the
-# in-process communicator's rendezvous never completes — AwaitAndLogIfStuck
-# aborts the process. Plain matmul/conv programs reload fine; the tp train
-# steps do not. Reproduce: enable the cache, run
-# tests/test_models/test_bert_vit_fp8.py::test_vit_training twice.
+# Persistent-cache story (r02 crash, r03 root cause, r04 scoping):
+# executables containing collectives inside a WhileThunk (scanned layers +
+# GSPMD collectives — most tp-trained models here) hit an XLA:CPU
+# AOT-reload bug where the in-process communicator's rendezvous never
+# completes — AwaitAndLogIfStuck aborts the process (re-verified on
+# jax/jaxlib 0.9.0: reload of test_vit_training's step is a fatal abort).
+# Cross-device collective thunks can only exist in MULTI-device programs,
+# so the cache is scoped to single-device executables below: model.apply
+# parity forwards, engine prefill/decode, kernels — the bulk of the
+# suite's compile count — reload safely and get the warm-cache speedup,
+# while multi-device programs always compile fresh (exactly the previous
+# cache-off behavior). Revisit when a jaxlib fixes the reload rendezvous.
+if os.environ.get("CLT_TEST_CACHE", "1") != "0":
+    _cache_dir = os.environ.get(
+        "CLT_TEST_CACHE_DIR",
+        os.path.expanduser("~/.cache/colossalai_tpu_test_jax_cache"),
+    )
+    try:
+        from jax._src import compiler as _jax_compiler
+
+        _orig_compile_or_get_cached = _jax_compiler.compile_or_get_cached
+        # bind at patch time: if a future jax renames this, the except
+        # below falls back to cache-off instead of erroring mid-test
+        _backend_compile_and_load = _jax_compiler.backend_compile_and_load
+
+        def _single_device_scoped_cache(
+            backend, computation, devices, compile_options, host_callbacks,
+            executable_devices, pgle_profiler=None,
+        ):
+            if devices.size > 1:  # may contain collective thunks: no reload
+                return _backend_compile_and_load(
+                    backend, computation, executable_devices,
+                    compile_options, host_callbacks,
+                )
+            return _orig_compile_or_get_cached(
+                backend, computation, devices, compile_options,
+                host_callbacks, executable_devices, pgle_profiler,
+            )
+
+        _jax_compiler.compile_or_get_cached = _single_device_scoped_cache
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # tiny test programs compile fast individually but number in the
+        # hundreds — cache them all, not just the slow ones
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (ImportError, AttributeError):
+        pass  # jax internals moved: fall back to cache-off, still correct
 
 
 @pytest.fixture(autouse=True)

@@ -411,3 +411,59 @@ def test_vit_roundtrip():
         assert kp in flat_b, kp
         np.testing.assert_array_equal(np.asarray(leaf), flat_b[kp],
                                       err_msg=path)
+
+
+def test_baichuan_wpack_roundtrip():
+    """Baichuan W_pack (plain [q;k;v] fused, MHA) — the r03 unmapped
+    family, implemented from the published Baichuan-13B layout."""
+    from colossalai_tpu.models import BaichuanConfig, BaichuanForCausalLM
+
+    cfg = BaichuanConfig.tiny()
+    heads = (cfg.num_attention_heads, cfg.kv_heads_, cfg.head_dim_)
+    hf = _roundtrip("baichuan", BaichuanForCausalLM(cfg), cfg, heads=heads)
+    w = hf["model.layers.0.self_attn.W_pack.weight"]
+    assert w.shape == (3 * cfg.hidden_size, cfg.hidden_size)
+    assert "model.layers.0.self_attn.o_proj.weight" in hf
+    assert "model.layers.0.mlp.gate_proj.weight" in hf
+    # fused layout semantics: the first h rows of W_pack ARE q_proj
+    params = BaichuanForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    q = np.asarray(params["params"]["layers"]["block"]["self_attn"]["q_proj"]["kernel"][0])
+    np.testing.assert_array_equal(w[: cfg.hidden_size], q.T)
+
+
+def test_chatglm_fused_roundtrip():
+    """ChatGLM query_key_value (GQA concat) + dense_h_to_4h ([gate; up])
+    — implemented from the published THUDM/chatglm3 layout."""
+    from colossalai_tpu.models import ChatGLMConfig, ChatGLMForConditionalGeneration
+
+    cfg = ChatGLMConfig.tiny()
+    heads = (cfg.num_attention_heads, cfg.kv_heads_, cfg.head_dim_)
+    hf = _roundtrip("chatglm", ChatGLMForConditionalGeneration(cfg), cfg,
+                    heads=heads)
+    qkv = hf["transformer.encoder.layers.0.self_attention.query_key_value.weight"]
+    h, kv = cfg.hidden_size, cfg.kv_heads_ * cfg.head_dim_
+    assert qkv.shape == (h + 2 * kv, h)
+    assert hf["transformer.encoder.layers.0.self_attention.query_key_value.bias"].shape == (h + 2 * kv,)
+    glu = hf["transformer.encoder.layers.0.mlp.dense_h_to_4h.weight"]
+    assert glu.shape == (2 * cfg.intermediate_size, h)
+    # [gate; up] packing: top half rows == gate_proj
+    params = ChatGLMForConditionalGeneration(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    gate = np.asarray(params["params"]["layers"]["block"]["mlp"]["gate_proj"]["kernel"][0])
+    np.testing.assert_array_equal(glu[: cfg.intermediate_size], gate.T)
+    assert "transformer.output_layer.weight" in hf
+    assert "transformer.embedding.word_embeddings.weight" in hf
+
+
+def test_chatglm_strict_ignores_rotary_table():
+    from colossalai_tpu.models import ChatGLMConfig, ChatGLMForConditionalGeneration
+
+    cfg = ChatGLMConfig.tiny()
+    heads = (cfg.num_attention_heads, cfg.kv_heads_, cfg.head_dim_)
+    params = ChatGLMForConditionalGeneration(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    hf = params_to_hf(params, "chatglm", heads=heads)
+    hf["transformer.rotary_pos_emb.inv_freq"] = np.ones((8,), np.float32)
+    # strict import must tolerate the checkpoint's computed rotary table
+    hf_to_params(hf, "chatglm", cfg.num_hidden_layers, heads=heads, strict=True)
