@@ -27,6 +27,14 @@ class ModelSpec:
     opt_bytes: int = 4
     #: full rematerialization (backward recomputes the forward)
     remat: bool = True
+    #: attention heads (0 = unknown): gates the all_to_all sp mode, which
+    #: redistributes heads and needs num_heads % (tp·sp) == 0
+    num_heads: int = 0
+    #: sp modes the model family implements (``supports_sp_modes`` on the
+    #: model class); the advisor picks among these per plan.
+    #: ``from_config`` resolves them from the family; the bare default is
+    #: the universally-implemented mode so hand-built specs stay boostable
+    sp_modes: Tuple[str, ...] = ("split_gather",)
 
     @classmethod
     def from_config(cls, cfg, n_params: Optional[int] = None, **kw) -> "ModelSpec":
@@ -42,10 +50,45 @@ class ModelSpec:
                 cfg.vocab_size * h * 2  # embed + lm head
                 + cfg.num_hidden_layers * (attn + mlp_mult * h * inter)
             )
+        kw.setdefault("num_heads", getattr(cfg, "num_attention_heads", 0))
+        modes = _family_sp_modes(cfg)
+        if modes is not None:
+            kw.setdefault("sp_modes", modes)
         return cls(
             n_params=n_params, num_layers=cfg.num_hidden_layers,
             hidden_size=cfg.hidden_size, vocab_size=cfg.vocab_size, **kw,
         )
+
+
+def _family_sp_modes(cfg) -> Optional[Tuple[str, ...]]:
+    """Resolve ``supports_sp_modes`` from the model family that declares
+    this config class (via the module's ``config:`` annotations), so the
+    advisor never recommends a mode the family won't boost. The most
+    config-specific match wins (LlamaForCausalLM for MistralConfig, not a
+    generic base)."""
+    import colossalai_tpu.models as M
+
+    cfg_names = [c.__name__ for c in type(cfg).__mro__]
+    best_rank, best = len(cfg_names), None
+    for name in dir(M):
+        cls = getattr(M, name)
+        if not isinstance(cls, type):
+            continue
+        ann = None
+        for klass in getattr(cls, "__mro__", ()):
+            ann = getattr(klass, "__annotations__", {}).get("config", ann)
+            if ann is not None:
+                break
+        ann_name = ann if isinstance(ann, str) else getattr(ann, "__name__", None)
+        if ann_name not in cfg_names:
+            continue
+        modes = getattr(cls, "supports_sp_modes", None)
+        if modes is None:
+            continue
+        rank = cfg_names.index(ann_name)
+        if rank < best_rank:
+            best_rank, best = rank, tuple(modes)
+    return best
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,11 +116,17 @@ class Plan:
     step_time_s: float
     fits: bool
     hbm_bytes: int
+    #: the chosen activation-sharding mode for the sp axis (the GSPMD-land
+    #: analog of the reference solver's per-op strategy choice: WHERE each
+    #: block's boundary activations are constrained — sequence-sharded
+    #: with gather/scatter, head-redistributed, or ring-streamed)
+    sp_mode: str = "none"
 
     def describe(self) -> str:
         m = self.memory
+        sp = f"·{self.sp_mode}" if self.sp > 1 else ""
         return (
-            f"dp{self.dp}·tp{self.tp}·sp{self.sp}·pp{self.pp} zero{self.zero_stage}"
+            f"dp{self.dp}·tp{self.tp}·sp{self.sp}{sp}·pp{self.pp} zero{self.zero_stage}"
             f" (micro={self.num_microbatches}): "
             f"{m.total / 2**30:.2f} GiB/device "
             f"(P {m.params / 2**30:.2f} + G {m.grads / 2**30:.2f} + "
@@ -93,7 +142,7 @@ class Plan:
             tp_size=self.tp, sp_size=self.sp, pp_size=self.pp,
             zero_stage=self.zero_stage, precision=precision,
             num_microbatches=self.num_microbatches if self.pp > 1 else None,
-            sequence_parallel_mode="ring_attn" if self.sp > 1 else "none",
+            sequence_parallel_mode=self.sp_mode if self.sp > 1 else "none",
             **kw,
         )
 
@@ -120,10 +169,14 @@ def _memory(spec: ModelSpec, dp, tp, sp, pp, zero, micro_tokens, inflight) -> Me
         opt /= dp
     # live activations: boundary tensors per layer (full remat keeps ~2
     # hidden-vectors per layer per token; no remat ~16) × in-flight
-    # microbatches (pipeline stash) ÷ tp·sp sharding of the token dim
+    # microbatches (pipeline stash). Only SP shards the live boundary
+    # activations (sequence dim); tp shards the transient MLP/attn
+    # intermediates, which remat keeps out of the live set — a tp-only
+    # plan replicates the boundaries across the tp group (the reason
+    # Megatron added sequence parallelism in the first place).
     per_token_layer = (2 if spec.remat else 16) * spec.hidden_size * spec.param_bytes
     acts = (
-        per_token_layer * (spec.num_layers / pp) * micro_tokens / (tp * sp)
+        per_token_layer * (spec.num_layers / pp) * micro_tokens / sp
         * max(inflight, 1)
     )
     # logits buffer for the loss microbatch: tokens × vocab fp32 ÷ tp·sp
@@ -131,9 +184,52 @@ def _memory(spec: ModelSpec, dp, tp, sp, pp, zero, micro_tokens, inflight) -> Me
     return MemoryBreakdown(params, grads, opt, acts)
 
 
+def _sp_mode_candidates(spec: ModelSpec, tp: int, sp: int, seq_len: int) -> List[str]:
+    """sp modes legal for this (family, tp, sp, seq): the family must
+    implement the mode, all_to_all must be able to redistribute heads, and
+    ring attention must keep a per-device sequence chunk big enough for
+    the flash tiles. Empty = no legal mode: the caller must SKIP this
+    sp>1 factorization (a fallback the family can't boost would be
+    worse than no plan)."""
+    if sp <= 1:
+        return ["none"]
+    out = []
+    for mode in spec.sp_modes:
+        if mode == "all_to_all" and spec.num_heads and spec.num_heads % (tp * sp):
+            continue
+        if mode == "ring_attn" and seq_len // sp < 512:
+            continue  # ring chunks below a flash tile waste the MXU
+        out.append(mode)
+    return out
+
+
+def _sp_comm_time(
+    spec: ModelSpec, mode: str, sp: int, micro_tokens, n_micro, ab: AlphaBeta,
+) -> float:
+    """Per-step cost of the chosen activation-sharding mode, α-β model.
+    ``act_bytes`` is the GLOBAL boundary activation of one microbatch."""
+    if sp <= 1 or mode == "none":
+        return 0.0
+    act_bytes = micro_tokens * spec.hidden_size * spec.param_bytes
+    per_layer = {
+        # Megatron-style sequence parallelism: gather before / scatter
+        # after each of the two sublayers, mirrored in the backward
+        "split_gather": 4 * (ab.all_gather(act_bytes, sp)
+                             + ab.reduce_scatter(act_bytes, sp)),
+        # DeepSpeed-Ulysses: two head⇄sequence all_to_alls forward, two
+        # backward — each moves only 1/sp of the payload per hop
+        "all_to_all": 4 * ab.all_to_all(act_bytes, sp),
+        # ring attention streams k/v via neighbour hops that overlap the
+        # flash-attention compute; the unoverlapped residue is latency
+        "ring_attn": 2 * sp * ab.ppermute(0),
+    }[mode]
+    return spec.num_layers * n_micro * per_layer
+
+
 def _step_time(
     spec: ModelSpec, dp, tp, sp, pp, zero, global_tokens, n_micro,
     peak_flops: float, ab_ici: AlphaBeta, ab_dcn: Optional[AlphaBeta],
+    sp_mode: str = "split_gather",
 ) -> float:
     n_dev = dp * tp * sp * pp
     # compute: 6·N flops/token (+ remat recompute ≈ +2N)
@@ -148,9 +244,7 @@ def _step_time(
     if tp > 1:
         act_bytes = micro_tokens / sp * spec.hidden_size * spec.param_bytes
         comm += 4 * spec.num_layers * n_micro * ab_ici.all_reduce(act_bytes, tp)
-    if sp > 1:
-        act_bytes = micro_tokens / sp * spec.hidden_size * spec.param_bytes
-        comm += 2 * spec.num_layers * n_micro * ab_ici.all_gather(act_bytes, sp)
+    comm += _sp_comm_time(spec, sp_mode, sp, micro_tokens, n_micro, ab_ici)
     if dp > 1:
         grad_bytes = spec.n_params * spec.param_bytes / (tp * pp)
         ab = ab_dcn or ab_ici
@@ -203,15 +297,25 @@ def plan_parallelism(
         for zero in zero_stages:
             if zero >= 1 and dp == 1:
                 continue  # nothing to shard
+            candidates = _sp_mode_candidates(spec, tp, sp, seq_len)
+            if not candidates:
+                continue  # family can't boost any sp mode at this shape
             mem = _memory(spec, dp, tp, sp, pp, zero, micro_tokens, inflight)
-            t = _step_time(
-                spec, dp, tp, sp, pp, zero, global_tokens, n_micro,
-                peak_flops, ab_ici, ab_dcn,
+            # one level deeper than the mesh shape: choose the activation-
+            # sharding mode for the sp axis from the α-β model (the
+            # cheapest LEGAL mode for this family/mesh/seq)
+            mode, t = min(
+                ((m, _step_time(
+                    spec, dp, tp, sp, pp, zero, global_tokens, n_micro,
+                    peak_flops, ab_ici, ab_dcn, sp_mode=m,
+                )) for m in candidates),
+                key=lambda mt: mt[1],
             )
             plans.append(Plan(
                 dp=dp, tp=tp, sp=sp, pp=pp, zero_stage=zero,
                 num_microbatches=n_micro, memory=mem, step_time_s=t,
                 fits=mem.total <= 0.9 * hbm_bytes, hbm_bytes=hbm_bytes,
+                sp_mode=mode,
             ))
 
     plans.sort(key=lambda p: (
