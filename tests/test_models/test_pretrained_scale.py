@@ -15,7 +15,6 @@ Two layers of defense:
   over 12 blocks).
 """
 
-import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -57,14 +56,21 @@ def _parity(hf, seq=32, batch=2, atol=2e-4, rtol=2e-3):
 @pytest.mark.slow
 def test_gpt2_pretrained_checkpoint_logits():
     """Real gpt2 weights when the HF cache carries them (zero-egress
-    hosts without a cache skip)."""
-    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    hosts without a cache skip — checked against the LOCAL cache only,
+    never the network: a doomed connection attempt costs ~70 s)."""
+    from huggingface_hub import try_to_load_from_cache
+
+    if not any(
+        isinstance(try_to_load_from_cache("gpt2", f), str)
+        for f in ("model.safetensors", "pytorch_model.bin")
+    ):
+        pytest.skip("gpt2 checkpoint not in the local HF cache")
     try:
         hf = transformers.GPT2LMHeadModel.from_pretrained(
-            "gpt2", attn_implementation="eager"
+            "gpt2", attn_implementation="eager", local_files_only=True
         )
-    except OSError:
-        pytest.skip("gpt2 checkpoint not in the local HF cache")
+    except OSError:  # weights cached but config.json missing (partial cache)
+        pytest.skip("gpt2 cache is incomplete")
     _parity(hf, atol=5e-4, rtol=5e-3)  # 124M fp32 accumulates more noise
 
 
