@@ -1,26 +1,111 @@
-"""Retrieval-augmented QA: vector store + RAG pipeline.
+"""Retrieval-augmented QA: document pipeline + vector store + RAG chain.
 
-≙ reference ``applications/ColossalQA`` (RAG chatbot: langchain retriever +
-vector store + conversation memory over a Colossal-served LLM). TPU-native,
-dependency-free equivalent:
+≙ reference ``applications/ColossalQA`` (langchain RAG chatbot:
+``retriever.py`` incremental-update CustomRetriever, ``memory.py``
+ConversationBufferWithSummary, ``data_loader/document_loader.py``,
+``text_splitter/``, ``retrieval_conversation_en.py`` chain with follow-up
+disambiguation). TPU-native, dependency-free equivalent:
 
-- :class:`VectorStore` — document embeddings in one device array; top-k by
-  a single jitted matmul (the MXU IS the vector index at these sizes).
-- :func:`embed_texts` — mean-pooled hidden states from any backbone in this
-  repo (the reference uses an external sentence-transformer).
-- :class:`RAGPipeline` — retrieve → prompt assembly → generate via the
-  inference engine, with a sliding conversation memory
-  (≙ ConversationBufferWithSummary, minus the summarizer model).
+- :func:`load_documents` / :func:`chunk_text` — file loading (txt/md/
+  jsonl/csv via stdlib) and overlap chunking with sentence-boundary
+  preference (≙ document_loader + text_splitter);
+- :class:`VectorStore` — document embeddings in one device array; top-k
+  by a single jitted matmul (the MXU IS the vector index at these
+  sizes); content-hash dedup + per-source incremental replace
+  (≙ CustomRetriever over SQLRecordManager's incremental index);
+- :func:`embed_texts` — mean-pooled hidden states from any backbone in
+  this repo (the reference uses an external sentence-transformer);
+- :class:`ConversationMemory` — recent turns verbatim, older turns
+  folded into a running summary through the LLM itself
+  (≙ ConversationBufferWithSummary);
+- :class:`RAGPipeline` — optional follow-up rephrasing → retrieve →
+  prompt assembly → generate via the inference engine
+  (≙ the en/zh retrieval conversation chains' disambiguation step).
 """
 
 from __future__ import annotations
 
+import csv
 import dataclasses
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+import hashlib
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+# ----------------------------------------------------------- document layer
+
+
+@dataclasses.dataclass(frozen=True)
+class Document:
+    """One retrievable chunk (≙ langchain Document: page_content + source
+    metadata)."""
+
+    text: str
+    source: str = ""
+
+
+def chunk_text(text: str, chunk_size: int = 512, overlap: int = 64) -> List[str]:
+    """Split into ~chunk_size-character pieces, preferring sentence
+    boundaries, with ``overlap`` characters of context carried between
+    consecutive chunks (≙ the recursive text splitter)."""
+    if chunk_size <= 0:
+        raise ValueError(f"chunk_size={chunk_size} must be positive")
+    if overlap >= chunk_size:
+        raise ValueError(f"overlap={overlap} must be < chunk_size={chunk_size}")
+    text = text.strip()
+    if len(text) <= chunk_size:
+        return [text] if text else []
+    out, start = [], 0
+    while start < len(text):
+        end = min(start + chunk_size, len(text))
+        if end < len(text):
+            # prefer sentence-ish boundaries, then whitespace, then hard cut
+            window = text[start:end]
+            cut = max(window.rfind(". "), window.rfind("! "),
+                      window.rfind("? "), window.rfind("\n"))
+            if cut < chunk_size // 2:
+                cut = window.rfind(" ")
+            if cut > chunk_size // 2:
+                end = start + cut + 1
+        out.append(text[start:end].strip())
+        if end >= len(text):
+            break
+        start = max(end - overlap, start + 1)
+    return [c for c in out if c]
+
+
+def load_documents(
+    paths: Sequence[str], chunk_size: int = 512, overlap: int = 64,
+    text_key: str = "text",
+) -> List[Document]:
+    """Load + chunk files into Documents (≙ DocumentLoader): ``.txt``/
+    ``.md`` as plain text, ``.jsonl`` one record per line (``text_key``
+    field), ``.csv`` one row per record (columns joined as ``k: v``)."""
+    docs: List[Document] = []
+    for path in paths:
+        ext = os.path.splitext(path)[1].lower()
+        with open(path, encoding="utf-8") as f:
+            if ext == ".jsonl":
+                texts = [json.loads(line)[text_key]
+                         for line in f if line.strip()]
+            elif ext == ".csv":
+                reader = csv.DictReader(f)
+                texts = [", ".join(f"{k}: {v}" for k, v in row.items())
+                         for row in reader]
+            else:  # txt / md / anything utf-8
+                texts = [f.read()]
+        for t in texts:
+            docs.extend(Document(c, source=path)
+                        for c in chunk_text(t, chunk_size, overlap))
+    return docs
+
+
+# ------------------------------------------------------------- embeddings
 
 
 def embed_texts(model, params, token_batches: Sequence[jnp.ndarray]) -> jnp.ndarray:
@@ -37,37 +122,177 @@ def embed_texts(model, params, token_batches: Sequence[jnp.ndarray]) -> jnp.ndar
     return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
 
 
+# ------------------------------------------------------------ vector store
+
+
 class VectorStore:
-    """Cosine-similarity store over a single [N, D] device array."""
+    """Cosine-similarity store over one [N, D] array, with content-hash
+    dedup and per-source incremental replacement (≙ CustomRetriever's
+    incremental index: re-adding a source drops its stale chunks;
+    identical content is never embedded twice).
+
+    Each unique text is stored ONCE with the SET of sources that contain
+    it — a duplicate chunk arriving from a second source attributes that
+    source to the existing row, and ``remove_source`` only drops a row
+    when its last source is gone. Embeddings accumulate host-side; the
+    device copy uploads lazily once per change batch (repeated adds never
+    round-trip the whole matrix)."""
 
     def __init__(self):
-        self._embs: Optional[jnp.ndarray] = None
+        self._embs_np: Optional[np.ndarray] = None  # host [N, D], normalized
+        self._embs_dev: Optional[jnp.ndarray] = None  # lazy device mirror
         self._docs: List[str] = []
+        self._row_sources: List[set] = []  # per-row source attribution
+        self._hash_to_row: Dict[str, int] = {}
 
-    def add(self, docs: Sequence[str], embeddings: jnp.ndarray) -> None:
-        embeddings = jnp.asarray(embeddings, jnp.float32)
-        norm = jnp.linalg.norm(embeddings, axis=-1, keepdims=True).clip(1e-6)
-        embeddings = embeddings / norm
-        self._docs.extend(docs)
-        self._embs = (
-            embeddings if self._embs is None
-            else jnp.concatenate([self._embs, embeddings], 0)
+    def add(
+        self, docs: Sequence[str], embeddings,
+        sources: Optional[Sequence[str]] = None, dedup: bool = True,
+    ) -> int:
+        """Index docs; returns how many NEW rows were created (duplicate
+        texts only gain source attribution)."""
+        embeddings = np.asarray(embeddings, np.float32)
+        sources = list(sources) if sources is not None else [""] * len(docs)
+        if not (len(docs) == len(embeddings) == len(sources)):
+            raise ValueError(
+                f"docs({len(docs)}) / embeddings({len(embeddings)}) / "
+                f"sources({len(sources)}) lengths disagree"
+            )
+        keep_embs = []
+        for d, e, s in zip(docs, embeddings, sources):
+            h = hashlib.sha1(d.encode()).hexdigest()
+            row = self._hash_to_row.get(h)
+            if dedup and row is not None:
+                if s:  # duplicate content: attribute the extra source
+                    self._row_sources[row].add(s)
+                continue
+            self._hash_to_row[h] = len(self._docs)
+            self._docs.append(d)
+            self._row_sources.append({s} if s else set())
+            keep_embs.append(e)
+        if not keep_embs:
+            return 0
+        embs = np.stack(keep_embs)
+        embs = embs / np.linalg.norm(embs, axis=-1, keepdims=True).clip(1e-6)
+        self._embs_np = (
+            embs if self._embs_np is None
+            else np.concatenate([self._embs_np, embs], 0)
         )
+        self._embs_dev = None  # re-upload lazily at the next search
+        return len(keep_embs)
+
+    def add_documents_from(
+        self, documents: Sequence[Document], embed_fn: Callable[[str], Any],
+        replace_source: bool = True,
+    ) -> int:
+        """Incremental update: embed + index Documents, dropping any
+        previously-indexed chunks of the same sources (the by-source
+        cleanup mode of the reference's incremental index). Embedding runs
+        BEFORE the removal so an embed failure leaves the old index
+        intact."""
+        if not documents:
+            return 0
+        embs = np.stack([np.asarray(embed_fn(d.text), np.float32)
+                         for d in documents])
+        if replace_source:
+            for src in {d.source for d in documents if d.source}:
+                self.remove_source(src)
+        return self.add([d.text for d in documents], embs,
+                        sources=[d.source for d in documents])
+
+    def remove_source(self, source: str) -> int:
+        """Detach ``source`` from its rows; rows whose LAST source it was
+        are dropped. Returns how many rows were dropped."""
+        keep = []
+        for i, srcs in enumerate(self._row_sources):
+            had = source in srcs
+            srcs.discard(source)
+            # drop only rows whose LAST source this was; unsourced rows
+            # (added without attribution) are never touched
+            if srcs or not had:
+                keep.append(i)
+        removed = len(self._docs) - len(keep)
+        if not removed:
+            return 0
+        self._docs = [self._docs[i] for i in keep]
+        self._row_sources = [self._row_sources[i] for i in keep]
+        self._embs_np = self._embs_np[keep] if keep else None
+        self._embs_dev = None
+        self._hash_to_row = {
+            hashlib.sha1(d.encode()).hexdigest(): i
+            for i, d in enumerate(self._docs)
+        }
+        return removed
 
     def __len__(self) -> int:
         return len(self._docs)
 
-    def search(self, query_emb: jnp.ndarray, k: int = 4) -> List[Tuple[str, float]]:
-        if self._embs is None:
+    def search(self, query_emb, k: int = 4) -> List[Tuple[str, float]]:
+        return [(h["text"], h["score"])
+                for h in self.search_with_sources(query_emb, k)]
+
+    def search_with_sources(self, query_emb, k: int = 4) -> List[Dict[str, Any]]:
+        if self._embs_np is None:
             return []
+        if self._embs_dev is None:
+            self._embs_dev = jnp.asarray(self._embs_np)
         q = jnp.asarray(query_emb, jnp.float32).reshape(-1)
         q = q / jnp.linalg.norm(q).clip(1e-6)
-        scores = self._embs @ q  # one matvec — the whole "index"
+        scores = self._embs_dev @ q  # one matvec — the whole "index"
         k = min(k, len(self._docs))
         top = jax.lax.top_k(scores, k)
-        idx = np.asarray(top[1])
-        val = np.asarray(top[0])
-        return [(self._docs[i], float(s)) for i, s in zip(idx, val)]
+        return [
+            {"text": self._docs[i], "score": float(s),
+             "source": min(self._row_sources[i], default="")}
+            for i, s in zip(np.asarray(top[1]), np.asarray(top[0]))
+        ]
+
+
+# ------------------------------------------------------ conversation memory
+
+
+class ConversationMemory:
+    """Recent turns verbatim; older turns folded into a running summary by
+    the LLM itself (≙ ConversationBufferWithSummary: a bounded buffer
+    whose overflow is summarized, not dropped)."""
+
+    _SUMMARY_PROMPT = (
+        "Summarize the following conversation in 2-3 sentences, keeping "
+        "names, facts and decisions:\n{existing}{turns}\nSummary:"
+    )
+
+    def __init__(
+        self, summarize_fn: Optional[Callable[[str], str]] = None,
+        max_turns: int = 4,
+    ):
+        self.summarize_fn = summarize_fn
+        self.max_turns = max_turns
+        self.summary = ""
+        self.turns: List[Tuple[str, str]] = []
+
+    def append(self, question: str, answer: str) -> None:
+        self.turns.append((question, answer))
+        while len(self.turns) > self.max_turns:
+            stale = self.turns.pop(0)
+            if self.summarize_fn is None:
+                continue  # buffer-only mode: stale turns are dropped
+            self.summary = self.summarize_fn(self._SUMMARY_PROMPT.format(
+                existing=(f"(earlier summary: {self.summary})\n"
+                          if self.summary else ""),
+                turns=f"Q: {stale[0]}\nA: {stale[1]}",
+            )).strip()
+
+    def render(self) -> str:
+        head = (f"Summary of earlier conversation: {self.summary}\n"
+                if self.summary else "")
+        return head + "".join(f"Q: {q}\nA: {a}\n" for q, a in self.turns)
+
+    def clear(self) -> None:
+        self.summary = ""
+        self.turns.clear()
+
+
+# ------------------------------------------------------------- RAG pipeline
 
 
 _PROMPT = (
@@ -75,14 +300,24 @@ _PROMPT = (
     "{history}Context:\n{context}\n\nQuestion: {question}\nAnswer:"
 )
 
+_REPHRASE_PROMPT = (
+    "Given the conversation so far, rewrite the follow-up question as one "
+    "standalone question. Reply with the question only.\n"
+    "{history}Follow-up: {question}\nStandalone question:"
+)
+
 
 @dataclasses.dataclass
 class RAGPipeline:
-    """retrieve → assemble → generate (≙ ColossalQA RetrievalQA chain).
+    """rephrase → retrieve → assemble → generate
+    (≙ ColossalQA RetrievalQA chain with the disambiguation handler).
 
     ``generate_fn(prompt) -> str``: any text-in/text-out callable — the
     inference engine's generate, or a stub in tests.
     ``embed_fn(text) -> [D]`` embedding for queries and documents.
+    ``rephrase_followups``: on multi-turn conversations, rewrite each
+    follow-up into a standalone retrieval query through the LLM first
+    (pronouns and ellipses otherwise retrieve garbage).
     """
 
     embed_fn: Callable[[str], jnp.ndarray]
@@ -90,21 +325,48 @@ class RAGPipeline:
     store: VectorStore = dataclasses.field(default_factory=VectorStore)
     top_k: int = 4
     memory_turns: int = 4
+    rephrase_followups: bool = False
+    #: summarize stale turns through generate_fn instead of dropping them
+    summarize_memory: bool = False
 
     def __post_init__(self):
-        self._history: List[Tuple[str, str]] = []
+        self.memory = ConversationMemory(
+            summarize_fn=self.generate_fn if self.summarize_memory else None,
+            max_turns=self.memory_turns,
+        )
 
-    def add_documents(self, docs: Sequence[str]) -> None:
-        embs = jnp.stack([self.embed_fn(d) for d in docs])
-        self.store.add(docs, embs)
+    def add_documents(
+        self, docs: Sequence[Any], source: str = "",
+        replace_source: bool = True,
+    ) -> int:
+        """Index strings or :class:`Document` chunks; re-adding a named
+        source replaces its previous chunks (incremental update)."""
+        documents = [
+            d if isinstance(d, Document) else Document(str(d), source=source)
+            for d in docs
+        ]
+        return self.store.add_documents_from(
+            documents, self.embed_fn, replace_source=replace_source
+        )
+
+    def add_files(self, paths: Sequence[str], chunk_size: int = 512,
+                  overlap: int = 64) -> int:
+        return self.store.add_documents_from(
+            load_documents(paths, chunk_size, overlap), self.embed_fn
+        )
 
     def ask(self, question: str) -> dict:
-        hits = self.store.search(self.embed_fn(question), self.top_k)
+        query = question
+        if self.rephrase_followups and self.memory.turns:
+            query = self.generate_fn(_REPHRASE_PROMPT.format(
+                history=self.memory.render(), question=question
+            )).strip() or question
+        hits = self.store.search(self.embed_fn(query), self.top_k)
         context = "\n---\n".join(doc for doc, _ in hits)
-        history = "".join(
-            f"Q: {q}\nA: {a}\n" for q, a in self._history[-self.memory_turns:]
+        prompt = _PROMPT.format(
+            history=self.memory.render(), context=context, question=question
         )
-        prompt = _PROMPT.format(history=history, context=context, question=question)
         answer = self.generate_fn(prompt)
-        self._history.append((question, answer))
-        return {"answer": answer, "sources": hits, "prompt": prompt}
+        self.memory.append(question, answer)
+        return {"answer": answer, "sources": hits, "prompt": prompt,
+                "query": query}
