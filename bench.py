@@ -202,6 +202,91 @@ def measure_moe(n_dev: int, steps: int = 5):
     return round(batch["input_ids"].size / dt / max(n_dev, 1), 1)
 
 
+def measure_encdec(n_dev: int, steps: int = 4, cfg=None, bs: int = 4,
+                   src_len: int = 1024, tgt_len: int = 256):
+    """Enc-dec pretraining throughput: a T5-v1.1-Large-class (~0.8B) step,
+    total (src+tgt) tokens/s/device — the seq2seq row the llama-family
+    primary cannot show (cross-attention + relative position bias)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from colossalai_tpu.booster import Booster, HybridParallelPlugin
+    from colossalai_tpu.models import T5Config, T5ForConditionalGeneration, shift_right
+
+    if cfg is None:
+        cfg = T5Config.t5_v1_1_large(
+            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, remat=True,
+        )
+    rng = np.random.RandomState(0)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs * max(n_dev, 1), tgt_len)))
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (bs * max(n_dev, 1), src_len))
+        ),
+        "decoder_input_ids": shift_right(labels, cfg.decoder_start_token_id),
+        "labels": labels,
+    }
+    boosted = Booster(
+        plugin=HybridParallelPlugin(zero_stage=1 if n_dev > 1 else 0, precision="bf16")
+    ).boost(
+        # configure() auto-selects the seq2seq loss for this batch shape
+        T5ForConditionalGeneration(cfg), optax.adamw(3e-4),
+        example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    state = boosted.state
+    sharded = boosted.shard_batch(batch)
+    state, m = boosted.train_step(state, sharded)
+    float(m["loss"])  # sync (block_until_ready is a no-op on axon)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = boosted.train_step(state, sharded)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    tokens = batch["input_ids"].size + labels.size
+    return round(tokens / dt / max(n_dev, 1), 1)
+
+
+def measure_ring_sp(n_dev: int, steps: int = 3, seq: int = 32768, cfg=None):
+    """Ring-attention sequence parallelism at 32k context: the long-context
+    row. Needs >= 2 devices (sp shards the sequence) — the 1-chip driver
+    skips it; a pod slice reproduces it as-is."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from colossalai_tpu.booster import Booster, HybridParallelPlugin
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    if cfg is None:
+        cfg = model_for(16 * 1024**3, seq)
+    batch = {
+        "input_ids": jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, size=(1, seq))
+        )
+    }
+    boosted = Booster(
+        plugin=HybridParallelPlugin(
+            sp_size=n_dev, sequence_parallel_mode="ring_attn", precision="bf16",
+        )
+    ).boost(
+        LlamaForCausalLM(cfg),
+        optax.adamw(3e-4), example_batch=batch, rng=jax.random.PRNGKey(0),
+    )
+    state = boosted.state
+    sharded = boosted.shard_batch(batch)
+    state, m = boosted.train_step(state, sharded)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = boosted.train_step(state, sharded)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    return round(batch["input_ids"].size / dt / n_dev, 1)
+
+
 def child_main():
     import jax
 
@@ -235,6 +320,17 @@ def child_main():
             extras["moe_tokens_per_s_per_device"] = measure_moe(n_dev, steps=4)
         except Exception as e:
             print(f"moe bench failed: {e}", file=sys.stderr)
+        try:
+            extras["encdec_tokens_per_s_per_device"] = measure_encdec(n_dev)
+        except Exception as e:
+            print(f"encdec bench failed: {e}", file=sys.stderr)
+        if n_dev >= 2:  # sp shards the sequence: needs a real mesh axis
+            try:
+                extras["ring_sp_tokens_per_s_per_device_seq32k"] = (
+                    measure_ring_sp(n_dev)
+                )
+            except Exception as e:
+                print(f"ring-sp bench failed: {e}", file=sys.stderr)
 
     result = {
         "metric": f"llama_{primary['n_params_b']}B_pretrain_mfu_bs{bs}_seq{seq}",

@@ -195,19 +195,25 @@ class VectorStore:
         embs = np.stack([np.asarray(embed_fn(d.text), np.float32)
                          for d in documents])
         if replace_source:
-            for src in {d.source for d in documents if d.source}:
-                self.remove_source(src)
+            self._remove_sources({d.source for d in documents if d.source})
         return self.add([d.text for d in documents], embs,
                         sources=[d.source for d in documents])
 
     def remove_source(self, source: str) -> int:
         """Detach ``source`` from its rows; rows whose LAST source it was
         are dropped. Returns how many rows were dropped."""
+        return self._remove_sources({source})
+
+    def _remove_sources(self, sources: set) -> int:
+        """One pass for a whole source set (re-indexing a multi-source
+        batch must not copy the matrix once per source)."""
+        if not sources:
+            return 0
         keep = []
         for i, srcs in enumerate(self._row_sources):
-            had = source in srcs
-            srcs.discard(source)
-            # drop only rows whose LAST source this was; unsourced rows
+            had = bool(srcs & sources)
+            srcs -= sources
+            # drop only rows whose LAST source was removed; unsourced rows
             # (added without attribution) are never touched
             if srcs or not had:
                 keep.append(i)
@@ -361,12 +367,14 @@ class RAGPipeline:
             query = self.generate_fn(_REPHRASE_PROMPT.format(
                 history=self.memory.render(), question=question
             )).strip() or question
-        hits = self.store.search(self.embed_fn(query), self.top_k)
-        context = "\n---\n".join(doc for doc, _ in hits)
+        hits = self.store.search_with_sources(self.embed_fn(query), self.top_k)
+        context = "\n---\n".join(h["text"] for h in hits)
         prompt = _PROMPT.format(
             history=self.memory.render(), context=context, question=question
         )
         answer = self.generate_fn(prompt)
         self.memory.append(question, answer)
+        # hits carry per-chunk source attribution — the citations a RAG
+        # answer exists to show
         return {"answer": answer, "sources": hits, "prompt": prompt,
                 "query": query}

@@ -130,7 +130,7 @@ def test_rag_pipeline_retrieves_and_answers():
     assert len(rag.store) == 3
     res = rag.ask("TPUs use a systolic array for what")
     # the most similar doc must be retrieved and enter the prompt
-    assert docs[0] in [d for d, _ in res["sources"]]
+    assert docs[0] in [h["text"] for h in res["sources"]]
     assert docs[0] in res["prompt"]
     # memory: second turn carries the first Q/A
     res2 = rag.ask("What about France")
