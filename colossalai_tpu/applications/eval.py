@@ -290,7 +290,10 @@ def extract_last_number(text: str) -> Optional[str]:
 
 class GenerationTaskRunner:
     """Few-shot generation benchmark (GSM8K-style exact match): greedy
-    decode through the paged engine, extract the answer, compare."""
+    decode through the paged engine, extract the answer, compare.
+    ``metrics`` adds text-overlap scores on the RAW generation vs the
+    reference answer — "token_f1" (SQuAD rule, hotpotqa/triviaqa-style
+    tasks) and/or "rouge_l" (summarization-style tasks)."""
 
     def __init__(
         self,
@@ -304,9 +307,15 @@ class GenerationTaskRunner:
         max_new_tokens: int = 64,
         extract: Callable[[str], Optional[str]] = extract_last_number,
         eos_token_id: Optional[int] = None,
+        metrics: Sequence[str] = (),
     ):
         if n_shot > len(dev_samples):
             raise ValueError(f"n_shot={n_shot} needs >= that many dev_samples")
+        unknown = [m for m in metrics if m not in TEXT_METRICS]
+        if unknown:
+            raise ValueError(
+                f"unknown metrics {unknown}; available: {sorted(TEXT_METRICS)}"
+            )
         self.name = name
         self.samples = list(samples)
         self.tok, self.detok = tokenizer, detokenizer
@@ -314,6 +323,7 @@ class GenerationTaskRunner:
         self.max_new_tokens = max_new_tokens
         self.extract = extract
         self.eos_token_id = eos_token_id
+        self.metrics = tuple(metrics)
 
     @staticmethod
     def _item(s: GenSample, include_answer: bool) -> str:
@@ -344,17 +354,23 @@ class GenerationTaskRunner:
                                eos_token_id=self.eos_token_id)
         outs = engine.generate(prompts, gen)
         hits = 0
+        metric_sums = {m: 0.0 for m in self.metrics}
         for s, out in zip(self.samples, outs):
-            got = self.extract(self.detok(out))
+            text = self.detok(out)
+            got = self.extract(text)
             # normalize the GOLD answer through the same extractor so
             # '1,234' matches '1234' (fall back to strip when the gold has
             # no extractable form)
             gold = self.extract(s.answer)
             gold = s.answer.strip() if gold is None else gold
             hits += int(got is not None and got == gold)
+            for m in self.metrics:
+                metric_sums[m] += TEXT_METRICS[m](text, s.answer)
         n = len(self.samples)
-        return {"task": self.name, "exact_match": hits / max(n, 1), "n": n,
-                "n_shot": len(self.dev)}
+        result = {"task": self.name, "exact_match": hits / max(n, 1), "n": n,
+                  "n_shot": len(self.dev)}
+        result.update({m: v / max(n, 1) for m, v in metric_sums.items()})
+        return result
 
 
 def run_benchmarks(tasks: Sequence[Any], **target) -> Dict[str, Dict[str, Any]]:
@@ -372,3 +388,65 @@ def run_benchmarks(tasks: Sequence[Any], **target) -> Dict[str, Dict[str, Any]]:
             kw.pop("max_batch_size", None)
         results[t.name] = t.run(**kw)
     return results
+
+
+# ------------------------------------------------------------ text metrics
+# ≙ ColossalEval evaluate/dataset_evaluator/metrics.py (rouge/f1/accuracy
+# family), dependency-free.
+
+
+def normalize_answer(s: str) -> str:
+    """The official SQuAD normalization, in its exact order — lowercase,
+    REMOVE punctuation (no space inserted: 'the-best' → 'thebest'), strip
+    articles, collapse whitespace — so reported F1 is comparable to
+    published SQuAD/hotpotqa numbers."""
+    import string
+
+    s = s.lower()
+    s = "".join(c for c in s if c not in string.punctuation)
+    s = re.sub(r"\b(a|an|the)\b", " ", s)
+    return " ".join(s.split())
+
+
+def token_f1(prediction: str, reference: str) -> float:
+    """SQuAD-style token-overlap F1 on normalized answers."""
+    pred = normalize_answer(prediction).split()
+    ref = normalize_answer(reference).split()
+    if not pred or not ref:
+        return float(pred == ref)
+    from collections import Counter
+
+    common = Counter(pred) & Counter(ref)
+    overlap = sum(common.values())
+    if overlap == 0:
+        return 0.0
+    precision = overlap / len(pred)
+    recall = overlap / len(ref)
+    return 2 * precision * recall / (precision + recall)
+
+
+def rouge_l(prediction: str, reference: str) -> float:
+    """ROUGE-L F1: longest-common-subsequence of normalized tokens."""
+    pred = normalize_answer(prediction).split()
+    ref = normalize_answer(reference).split()
+    if not pred or not ref:
+        return float(pred == ref)
+    # O(|pred|·|ref|) LCS with a rolling row
+    prev = [0] * (len(ref) + 1)
+    for p in pred:
+        cur = [0]
+        for j, r in enumerate(ref, 1):
+            cur.append(prev[j - 1] + 1 if p == r else max(prev[j], cur[-1]))
+        prev = cur
+    lcs = prev[-1]
+    if lcs == 0:
+        return 0.0
+    precision = lcs / len(pred)
+    recall = lcs / len(ref)
+    return 2 * precision * recall / (precision + recall)
+
+
+TEXT_METRICS: Dict[str, Callable[[str, str], float]] = {
+    "token_f1": token_f1,
+    "rouge_l": rouge_l,
+}

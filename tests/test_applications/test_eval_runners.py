@@ -191,3 +191,36 @@ def test_letter_runner_rejects_too_many_choices():
     with pytest.raises(ValueError, match="letter style"):
         ChoiceTaskRunner("x", [wide], tok)
     ChoiceTaskRunner("x", [wide], tok, style="continuation")  # fine
+
+
+def test_text_metrics_known_values():
+    from colossalai_tpu.applications import normalize_answer, rouge_l, token_f1
+
+    assert normalize_answer("The Quick, Brown Fox!") == "quick brown fox"
+    assert token_f1("the quick brown fox", "a quick fox") == pytest.approx(
+        2 * (2 / 3) * (2 / 2) / (2 / 3 + 2 / 2))  # overlap {quick, fox}
+    assert token_f1("", "") == 1.0 and token_f1("x", "") == 0.0
+    # LCS("quick brown fox", "quick fox jumps") = quick fox (2)
+    assert rouge_l("the quick brown fox", "quick fox jumps") == pytest.approx(
+        2 * (2 / 3) * (2 / 3) / (2 / 3 + 2 / 3))
+    assert rouge_l("same words", "same words") == 1.0
+
+
+def test_generation_runner_reports_requested_metrics():
+    r = GenerationTaskRunner(
+        "narrativeqa", [GenSample("who?", "the brown fox")], tok, detok,
+        metrics=("token_f1", "rouge_l"),
+    )
+    res = r.run(engine=_StubEngine([tok(" a brown fox appears 7")]))
+    assert 0.0 < res["token_f1"] <= 1.0 and 0.0 < res["rouge_l"] <= 1.0
+    with pytest.raises(ValueError, match="unknown metrics"):
+        GenerationTaskRunner("x", [], tok, detok, metrics=("bleu_42",))
+
+
+def test_normalize_answer_official_squad_order():
+    from colossalai_tpu.applications import normalize_answer
+
+    # punctuation removed BEFORE article stripping: 'the-best' stays one
+    # token 'thebest' (the official rule), never 'best'
+    assert normalize_answer("the-best") == "thebest"
+    assert normalize_answer("over-the-counter") == "overthecounter"
