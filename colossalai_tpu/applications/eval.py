@@ -311,6 +311,8 @@ class GenerationTaskRunner:
     ):
         if n_shot > len(dev_samples):
             raise ValueError(f"n_shot={n_shot} needs >= that many dev_samples")
+        if isinstance(metrics, str):  # a bare name iterates per-character
+            metrics = (metrics,)
         unknown = [m for m in metrics if m not in TEXT_METRICS]
         if unknown:
             raise ValueError(
@@ -425,10 +427,20 @@ def token_f1(prediction: str, reference: str) -> float:
     return 2 * precision * recall / (precision + recall)
 
 
+def _rouge_tokens(s: str) -> List[str]:
+    """ROUGE tokenization: lowercase + strip punctuation, but KEEP
+    articles — standard ROUGE-L counts 'the' vs 'a' mismatches, unlike
+    the SQuAD rule, so scores stay comparable to published baselines."""
+    import string
+
+    s = "".join(c for c in s.lower() if c not in string.punctuation)
+    return s.split()
+
+
 def rouge_l(prediction: str, reference: str) -> float:
-    """ROUGE-L F1: longest-common-subsequence of normalized tokens."""
-    pred = normalize_answer(prediction).split()
-    ref = normalize_answer(reference).split()
+    """ROUGE-L F1: longest-common-subsequence of tokens."""
+    pred = _rouge_tokens(prediction)
+    ref = _rouge_tokens(reference)
     if not pred or not ref:
         return float(pred == ref)
     # O(|pred|·|ref|) LCS with a rolling row

@@ -1,0 +1,115 @@
+"""Distributed RLHF: PPO over 2 REAL processes whose rollouts stream from
+the process-spanning paged engine (≙ ColossalChat coati/distributed/ —
+trainer + decoupled generation backend across workers; here both are the
+same SPMD program: the trainer's update runs over the global mesh and the
+engine decodes over it, with weight sync as a global-array reshard)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_CHILD = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    rank = int(sys.argv[1]); port = sys.argv[2]
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    try:
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    except Exception:
+        pass
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import colossalai_tpu as clt
+    from colossalai_tpu.applications import EngineRollout, PPOTrainer
+    from colossalai_tpu.booster import DataParallelPlugin
+    from colossalai_tpu.inference import GenerationConfig
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM, RewardModel
+
+    clt.launch(coordinator_address=f'localhost:{{port}}',
+               num_processes=2, process_id=rank, seed=7)
+    assert jax.process_count() == 2 and jax.device_count() == 2
+
+    cfg = LlamaConfig.tiny(vocab_size=128, dtype=jnp.float32)
+    b, pad_to = 4, 32
+    example = {{
+        "input_ids": jnp.zeros((b, pad_to), jnp.int32),
+        "loss_mask": jnp.ones((b, pad_to), jnp.float32),
+    }}
+    trainer = PPOTrainer(
+        LlamaForCausalLM(cfg), RewardModel(lm=LlamaForCausalLM(cfg)),
+        optax.adamw(5e-3), optax.adamw(5e-3),
+        DataParallelPlugin(precision="fp32"), DataParallelPlugin(precision="fp32"),
+        example,
+    )
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()), ('tp',))  # engine spans processes
+    rollout = EngineRollout(
+        cfg, pad_to=pad_to, max_batch_size=b, block_size=16, mesh=mesh,
+        gen=GenerationConfig(max_new_tokens=6, do_sample=True, temperature=1.0),
+    )
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(1, 128, size=(6,))) for _ in range(b)]
+
+    def reward_fn(batch):
+        even = (batch["input_ids"] % 2 == 0) & (batch["loss_mask"] > 0)
+        return even.sum(-1) / np.maximum(batch["loss_mask"].sum(-1), 1.0)
+
+    losses = []
+    for _ in range(2):
+        m = trainer.rollout_step(rollout, prompts, reward_fn)
+        assert np.isfinite(m["actor_loss"]) and np.isfinite(m["critic_loss"]), m
+        losses.append(m["actor_loss"])
+
+    # the replicated scheduler + identical sampling keys must give BOTH
+    # processes the same losses (any divergence would deadlock collectives
+    # eventually; assert it directly)
+    from jax.experimental import multihost_utils
+    got = multihost_utils.process_allgather(np.asarray(losses, np.float64))
+    assert np.array_equal(got[0], got[1]), got
+    print(f'rank {{rank}} OK', flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_ppo_with_engine_rollout(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(repo=repo))
+    port = _free_port()
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # 1 CPU device per process
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank} OK" in out

@@ -200,9 +200,9 @@ def test_text_metrics_known_values():
     assert token_f1("the quick brown fox", "a quick fox") == pytest.approx(
         2 * (2 / 3) * (2 / 2) / (2 / 3 + 2 / 2))  # overlap {quick, fox}
     assert token_f1("", "") == 1.0 and token_f1("x", "") == 0.0
-    # LCS("quick brown fox", "quick fox jumps") = quick fox (2)
+    # articles KEPT for rouge: pred has 4 tokens, LCS = quick fox (2)
     assert rouge_l("the quick brown fox", "quick fox jumps") == pytest.approx(
-        2 * (2 / 3) * (2 / 3) / (2 / 3 + 2 / 3))
+        2 * (2 / 4) * (2 / 3) / (2 / 4 + 2 / 3))
     assert rouge_l("same words", "same words") == 1.0
 
 
@@ -224,3 +224,13 @@ def test_normalize_answer_official_squad_order():
     # token 'thebest' (the official rule), never 'best'
     assert normalize_answer("the-best") == "thebest"
     assert normalize_answer("over-the-counter") == "overthecounter"
+
+
+def test_rouge_keeps_articles_and_metrics_accepts_bare_string():
+    from colossalai_tpu.applications import rouge_l
+
+    # standard ROUGE-L penalizes article mismatches (unlike the SQuAD rule)
+    assert rouge_l("the cat sat on the mat", "a cat sat on a mat") < 1.0
+    assert rouge_l("the cat", "the cat") == 1.0
+    r = GenerationTaskRunner("x", [], tok, detok, metrics="token_f1")
+    assert r.metrics == ("token_f1",)
