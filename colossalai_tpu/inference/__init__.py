@@ -8,6 +8,7 @@ from .kv_cache import (
     init_paged_cache,
 )
 from .modeling import KVCache, decode_step, extend_step, init_cache, prefill
+from .multiprocess import MultiProcessFrontend
 from .paged_modeling import decode_paged, prefill_paged
 from .server import make_server
 from .speculative import SpeculativeEngine, SpecStats
@@ -17,6 +18,7 @@ __all__ = [
     "ddim_schedule",
     "GenerationConfig",
     "LLMEngine",
+    "MultiProcessFrontend",
     "Request",
     "KVCache",
     "decode_step",

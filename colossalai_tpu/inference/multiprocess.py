@@ -1,0 +1,108 @@
+"""Lockstep frontend for multi-process serving.
+
+≙ reference ``inference/executor/rpc_worker.py`` deployment shape: the
+request-facing frontend lives on ONE process while every process holds a
+shard of the model. Here the workers are not rpc servers — all processes
+run the same SPMD engine (engine.py's replicated scheduler), and this
+frontend keeps them in lockstep: process 0 drives a batch at a time
+(e.g. from the HTTP server), follower processes loop in
+:meth:`serve_followers` replaying the same ``generate`` calls from
+broadcast state, until :meth:`close` broadcasts the stop signal.
+
+Every round is two collectives: a small op/GenerationConfig header, then
+the prompt batch (``LLMEngine.broadcast_prompts``). Generation params are
+broadcast too — a mismatched ``max_new_tokens`` would desync the two
+hosts' step loops and deadlock the collectives, so followers never trust
+local defaults.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .engine import GenerationConfig, LLMEngine
+
+_OP_STOP = 0
+_OP_GENERATE = 1
+
+
+def _bcast(arr: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(arr))
+
+
+def _pack_gen(gen: GenerationConfig) -> np.ndarray:
+    return np.asarray([
+        float(gen.max_new_tokens), float(gen.temperature), float(gen.top_k),
+        float(gen.top_p), float(bool(gen.do_sample)),
+        float(-1 if gen.eos_token_id is None else gen.eos_token_id),
+    ], np.float64)
+
+
+def _unpack_gen(arr: np.ndarray) -> GenerationConfig:
+    eos = int(arr[5])
+    return GenerationConfig(
+        max_new_tokens=int(arr[0]), temperature=float(arr[1]),
+        top_k=int(arr[2]), top_p=float(arr[3]), do_sample=bool(arr[4]),
+        eos_token_id=None if eos < 0 else eos,
+    )
+
+
+class MultiProcessFrontend:
+    """Drive a process-spanning engine from process 0.
+
+    Process 0::
+
+        fe = MultiProcessFrontend(engine)
+        outs = fe.drive(prompts, gen)   # per request batch
+        ...
+        fe.close()                      # release the followers
+
+    Every other process::
+
+        MultiProcessFrontend(engine).serve_followers()  # blocks until close
+    """
+
+    def __init__(self, engine: LLMEngine):
+        import jax
+
+        self.engine = engine
+        self.rank = jax.process_index()
+
+    def drive(self, prompts: List[List[int]],
+              gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        """One lockstep batch from process 0; followers must be inside
+        :meth:`serve_followers`."""
+        if self.rank != 0:
+            raise RuntimeError(
+                f"drive() is the process-0 frontend; rank {self.rank} "
+                "belongs in serve_followers()"
+            )
+        gen = gen or GenerationConfig()
+        _bcast(np.concatenate([[float(_OP_GENERATE)], _pack_gen(gen)]))
+        prompts = LLMEngine.broadcast_prompts(prompts)
+        return self.engine.generate(prompts, gen)
+
+    def serve_followers(self) -> int:
+        """Follower loop: replay every driven batch until close(). Returns
+        how many batches were served."""
+        if self.rank == 0:
+            raise RuntimeError("process 0 drives; followers serve")
+        served = 0
+        while True:
+            header = _bcast(np.zeros(7, np.float64))
+            if int(header[0]) == _OP_STOP:
+                return served
+            gen = _unpack_gen(header[1:])
+            prompts = LLMEngine.broadcast_prompts([])
+            self.engine.generate(prompts, gen)
+            served += 1
+
+    def close(self) -> None:
+        """Broadcast the stop signal (process 0)."""
+        if self.rank != 0:
+            raise RuntimeError("only process 0 closes the frontend")
+        _bcast(np.zeros(7, np.float64))

@@ -103,3 +103,78 @@ def test_two_process_engine_generates(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank} OK" in out
+
+
+_FRONTEND_CHILD = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    rank = int(sys.argv[1]); port = sys.argv[2]
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    try:
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    except Exception:
+        pass
+    import numpy as np
+    import jax.numpy as jnp
+    import colossalai_tpu as clt
+    from colossalai_tpu.inference import (GenerationConfig, LLMEngine,
+                                          MultiProcessFrontend)
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    clt.launch(coordinator_address=f'localhost:{{port}}',
+               num_processes=2, process_id=rank, seed=7)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(jax.random.PRNGKey(0),
+                                        jnp.ones((1, 8), jnp.int32))
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()), ('tp',))
+    engine = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                       block_size=16, prefill_buckets=(16,), mesh=mesh)
+    fe = MultiProcessFrontend(engine)
+    if rank == 0:
+        # two request batches with DIFFERENT generation configs, then stop
+        out1 = fe.drive([[3, 1, 4]], GenerationConfig(max_new_tokens=5))
+        out2 = fe.drive([[2, 7], [1, 8, 2]], GenerationConfig(max_new_tokens=3))
+        fe.close()
+        assert len(out1[0]) == 5 and [len(o) for o in out2] == [3, 3], (out1, out2)
+        local = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                          block_size=16, prefill_buckets=(16,))
+        assert out1 == local.generate([[3, 1, 4]], GenerationConfig(max_new_tokens=5))
+    else:
+        served = fe.serve_followers()
+        assert served == 2, served
+    print(f'rank {{rank}} OK', flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_multiprocess_frontend_drives_followers(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "fe_child.py"
+    script.write_text(_FRONTEND_CHILD.format(repo=repo))
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank} OK" in out
