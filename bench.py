@@ -15,20 +15,30 @@ both attention-flop conventions: "value" halves the causal attention term
 Robustness (r02 post-mortem: one transient `UNAVAILABLE: TPU backend
 setup/compile error` erased the round's number; r03 post-mortem: a HUNG
 tunnel cost a full 1500 s attempt before the probe gate engaged, leaving ~2
-probe windows in a 2400 s deadline): the measurement runs in a CHILD
-process; this supervisor PROBES the backend in a throwaway process before
-EVERY attempt — including the first — so a dead tunnel costs one probe
-timeout (120 s), not a full attempt. Retries use a fresh process each time
-(jax caches a failed backend init for the life of the process). When the
-remaining deadline can no longer fit a full attempt, the child runs in
-BENCH_FAST mode (primary config only, fewer timed steps). If no attempt
-succeeds, the failure JSON still carries the last driver-captured good
-result (`last_good`, `last_good_round`, `stale: true`) scanned from
-BENCH_r*.json so an outage round shows the trajectory instead of a bare 0.
+probe windows in a 2400 s deadline; r04 post-mortem: the DRIVER's own
+timeout killed the supervisor at ~1700-1800 s — before the 2400 s internal
+deadline — so the failure JSON never reached stdout and the round recorded
+`parsed: null`): the measurement runs in a CHILD process; this supervisor
+(1) prints + flushes a PROVISIONAL failure JSON carrying the last
+driver-captured good result as its very first act — a later success or
+final-failure line supersedes it, and an external kill at any point still
+leaves a parseable line on stdout; (2) caps its internal deadline at
+min(BENCH_DEADLINE_S, BENCH_DRIVER_CAP_S=1500) so it always finishes and
+prints before the driver's observed kill window; (3) PROBES the backend in
+a throwaway process before EVERY attempt — including the first — so a dead
+tunnel costs one probe timeout, not a full attempt, and never launches a
+probe or child whose timeout would not fit the remaining budget. Retries
+use a fresh process each time (jax caches a failed backend init for the
+life of the process). When the remaining deadline can no longer fit a full
+attempt, the child runs in BENCH_FAST mode (primary config only, fewer
+timed steps). Failure JSONs carry the last driver-captured good result
+(`last_good`, `last_good_round`, `stale: true`) scanned from BENCH_r*.json
+so an outage round shows the trajectory instead of a bare 0.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -387,6 +397,7 @@ def _backend_probe(timeout_s: float = 120.0):
     return ("fail", (probe.stderr or "").strip()[-1500:])
 
 
+@functools.lru_cache(maxsize=1)  # artifacts are immutable for the run
 def _scan_last_good():
     """Newest driver-captured success: highest-round BENCH_r*.json whose
     `parsed` is a real result (value > 0, no error key)."""
@@ -413,17 +424,62 @@ def _scan_last_good():
     return best
 
 
+def _failure_json(last_err: str, attempt: int, probe_failures: int, *,
+                  provisional: bool = False):
+    failure = {
+        "metric": "llama_pretrain_mfu",
+        "value": 0.0,
+        "unit": "MFU",
+        "vs_baseline": 0.0,
+        # the driver records a bounded (~2000 char) output tail: the WHOLE
+        # JSON line must fit well inside it or its head gets truncated and
+        # nothing parses. Full errors are already on stderr.
+        "error": last_err[-500:],
+        "bench_attempts": attempt,
+        "probe_failures": probe_failures,
+    }
+    if provisional:
+        failure["provisional"] = True
+    good = _scan_last_good()
+    if good is not None:
+        failure["stale"] = True
+        failure["last_good_round"] = good[0]
+        failure["last_good"] = good[1]
+    return failure
+
+
 def supervise():
-    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S", "2400"))
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1500"))
+    # r04: the driver's kill window is ~1700-1800 s — SHORTER than the old
+    # 2400 s internal default, so the supervisor died before it could print.
+    # Cap the internal deadline well under the observed window.
+    internal_cap = float(os.environ.get("BENCH_DRIVER_CAP_S", "1500"))
+    deadline = time.monotonic() + min(
+        float(os.environ.get("BENCH_DEADLINE_S", "2400")), internal_cap
+    )
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1200"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
     delay, attempt, soft_failures, probe_failures = 10.0, 0, 0, 0
     last_err = "no attempts ran"
+    # FIRST act: a provisional failure line, flushed. If anything — including
+    # the driver — kills this process at any later point, stdout already
+    # carries a parseable record with the last-good trajectory. A later
+    # success/final-failure line supersedes it (the driver takes the last
+    # JSON line).
+    print(json.dumps(_failure_json(
+        "provisional: supervisor started; killed before any attempt finished",
+        0, 0, provisional=True)), flush=True)
     while True:
         # Probe before EVERY attempt, including the first: a healthy backend
         # answers in seconds; a hung tunnel costs probe_timeout, not a full
         # attempt (r03 lost its whole window to one blind 1500 s attempt).
-        status, probe_err = _backend_probe(probe_timeout)
+        # Never start a probe that would outlive the budget (r04: nine
+        # back-to-back 120 s probe timeouts marched straight into the
+        # driver's kill).
+        remaining = deadline - time.monotonic()
+        if remaining < 30.0:
+            last_err = f"deadline exhausted ({last_err})"
+            break
+        status, probe_err = _backend_probe(min(probe_timeout, remaining - 15.0))
         if status != "ok":
             probe_failures += 1
             if status == "timeout":
@@ -439,14 +495,19 @@ def supervise():
                 last_err = f"attempt-gate: backend probe failed: {probe_err}"
                 soft_failures += 1
             print(last_err, file=sys.stderr)
+            # refresh the provisional record: if the driver kills us later,
+            # the newest (= last) JSON line carries CURRENT counts and error,
+            # and stays inside the driver's bounded output-tail window
+            print(json.dumps(_failure_json(last_err, attempt, probe_failures,
+                                           provisional=True)), flush=True)
             if soft_failures >= 2 or time.monotonic() + delay > deadline:
                 break
             time.sleep(delay)
             delay = min(delay * 2, 120.0)
             continue
         attempt += 1
-        budget = deadline - time.monotonic()
-        if budget <= 0:
+        budget = deadline - time.monotonic() - 15.0  # reserve a print margin
+        if budget < 60.0:
             # the probe itself may have consumed the last of the deadline —
             # never start a child that would outlive it
             last_err = "deadline exhausted before the child could launch"
@@ -458,7 +519,7 @@ def supervise():
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
                 capture_output=True, text=True, env=env,
-                timeout=max(60.0, min(attempt_timeout, budget)),
+                timeout=min(attempt_timeout, budget),
             )
         except subprocess.TimeoutExpired as e:
             last_err = f"attempt {attempt}: child timed out after {e.timeout:.0f}s"
@@ -469,12 +530,14 @@ def supervise():
                 if attempt > 1 or probe_failures:
                     found["bench_attempts"] = attempt
                     found["probe_failures"] = probe_failures
-                print(json.dumps(found))
+                print(json.dumps(found), flush=True)
                 return
             err_tail = ((proc.stderr or "") + (proc.stdout or "")).strip()[-2000:]
             last_err = f"attempt {attempt}: rc={proc.returncode}: {err_tail}"
             retryable = any(s in err_tail for s in _RETRYABLE)
         print(last_err, file=sys.stderr)
+        print(json.dumps(_failure_json(last_err, attempt, probe_failures,
+                                       provisional=True)), flush=True)
         if not retryable:
             # a deterministic failure (bad config, OOM) won't heal — allow one
             # re-run for flakes, then stop burning the deadline
@@ -485,21 +548,8 @@ def supervise():
             break
         time.sleep(delay)
         delay = min(delay * 2, 120.0)
-    failure = {
-        "metric": "llama_pretrain_mfu",
-        "value": 0.0,
-        "unit": "MFU",
-        "vs_baseline": 0.0,
-        "error": last_err[-1200:],
-        "bench_attempts": attempt,
-        "probe_failures": probe_failures,
-    }
-    good = _scan_last_good()
-    if good is not None:
-        failure["stale"] = True
-        failure["last_good_round"] = good[0]
-        failure["last_good"] = good[1]
-    print(json.dumps(failure))
+    print(json.dumps(_failure_json(last_err, attempt, probe_failures)),
+          flush=True)
 
 
 if __name__ == "__main__":
