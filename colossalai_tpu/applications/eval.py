@@ -344,6 +344,11 @@ class GenerationTaskRunner:
         from colossalai_tpu.inference import GenerationConfig, LLMEngine
 
         prompts = self.prompts()
+        if not prompts:  # zero-sample task: report n=0 like ChoiceTaskRunner
+            result = {"task": self.name, "exact_match": 0.0, "n": 0,
+                      "n_shot": len(self.dev)}
+            result.update({m: 0.0 for m in self.metrics})
+            return result
         if engine is None:
             if model is None or params is None:
                 raise ValueError("pass model+params or engine=")

@@ -166,7 +166,11 @@ class VectorStore:
                 if s:  # duplicate content: attribute the extra source
                     self._row_sources[row].add(s)
                 continue
-            self._hash_to_row[h] = len(self._docs)
+            # first-wins: with dedup=False a text may occupy several rows;
+            # hash-based attribution (later dedup adds, rebuilds) then
+            # deterministically targets the EARLIEST surviving copy
+            if row is None:
+                self._hash_to_row[h] = len(self._docs)
             self._docs.append(d)
             self._row_sources.append({s} if s else set())
             keep_embs.append(e)
@@ -224,10 +228,12 @@ class VectorStore:
         self._row_sources = [self._row_sources[i] for i in keep]
         self._embs_np = self._embs_np[keep] if keep else None
         self._embs_dev = None
-        self._hash_to_row = {
-            hashlib.sha1(d.encode()).hexdigest(): i
-            for i, d in enumerate(self._docs)
-        }
+        # first-wins, matching add(): duplicate texts left by dedup=False
+        # keep attributing to the earliest surviving copy across rebuilds
+        self._hash_to_row = {}
+        for i, d in enumerate(self._docs):
+            self._hash_to_row.setdefault(
+                hashlib.sha1(d.encode()).hexdigest(), i)
         return removed
 
     def __len__(self) -> int:

@@ -68,24 +68,41 @@ def _family_sp_modes(cfg) -> Optional[Tuple[str, ...]]:
     generic base)."""
     import colossalai_tpu.models as M
 
-    cfg_names = [c.__name__ for c in type(cfg).__mro__]
-    best_rank, best = len(cfg_names), None
+    import sys as _sys
+
+    cfg_mro = list(type(cfg).__mro__)
+    cfg_names = [c.__name__ for c in cfg_mro]
+    best_rank, best = len(cfg_mro), None
     for name in dir(M):
         cls = getattr(M, name)
         if not isinstance(cls, type):
             continue
-        ann = None
+        ann, owner = None, cls
         for klass in getattr(cls, "__mro__", ()):
             ann = getattr(klass, "__annotations__", {}).get("config", ann)
             if ann is not None:
+                owner = klass
                 break
-        ann_name = ann if isinstance(ann, str) else getattr(ann, "__name__", None)
-        if ann_name not in cfg_names:
-            continue
+        # match by class IDENTITY against the config's MRO so two config
+        # classes sharing a bare name cannot cross-resolve. `from
+        # __future__ import annotations` makes every annotation a string —
+        # resolve it through the declaring module's namespace first; bare
+        # name matching is only the last-resort fallback.
+        if isinstance(ann, str):
+            mod = _sys.modules.get(getattr(owner, "__module__", ""), None)
+            ann = getattr(mod, ann, ann)
+        if isinstance(ann, type):
+            if ann not in cfg_mro:
+                continue
+            rank = cfg_mro.index(ann)
+        else:
+            ann_name = ann if isinstance(ann, str) else None
+            if ann_name not in cfg_names:
+                continue
+            rank = cfg_names.index(ann_name)
         modes = getattr(cls, "supports_sp_modes", None)
         if modes is None:
             continue
-        rank = cfg_names.index(ann_name)
         if rank < best_rank:
             best_rank, best = rank, tuple(modes)
     return best

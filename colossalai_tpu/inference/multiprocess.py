@@ -18,6 +18,7 @@ local defaults.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional
 
 import numpy as np
@@ -34,21 +35,44 @@ def _bcast(arr: np.ndarray) -> np.ndarray:
     return np.asarray(multihost_utils.broadcast_one_to_all(arr))
 
 
+# Wire format: one float per GenerationConfig field, in dataclass field
+# order. None encodes as -1 (only eos_token_id is Optional). NOTE the
+# broadcast downcasts to float32 on device — ints survive exactly only up
+# to 2^24 (plenty for token ids / lengths today; revisit if a field ever
+# exceeds that).
+_GEN_FIELDS = tuple(f.name for f in dataclasses.fields(GenerationConfig))
+
+
 def _pack_gen(gen: GenerationConfig) -> np.ndarray:
-    return np.asarray([
-        float(gen.max_new_tokens), float(gen.temperature), float(gen.top_k),
-        float(gen.top_p), float(bool(gen.do_sample)),
-        float(-1 if gen.eos_token_id is None else gen.eos_token_id),
-    ], np.float64)
+    vals = []
+    for name in _GEN_FIELDS:
+        v = getattr(gen, name)
+        vals.append(-1.0 if v is None else float(v))
+    return np.asarray(vals, np.float64)
 
 
 def _unpack_gen(arr: np.ndarray) -> GenerationConfig:
-    eos = int(arr[5])
-    return GenerationConfig(
-        max_new_tokens=int(arr[0]), temperature=float(arr[1]),
-        top_k=int(arr[2]), top_p=float(arr[3]), do_sample=bool(arr[4]),
-        eos_token_id=None if eos < 0 else eos,
-    )
+    # a new GenerationConfig field changes the header length on BOTH ends
+    # (same code), so a version skew between driver and follower processes
+    # fails loudly here instead of silently desyncing the step loops
+    if len(arr) != len(_GEN_FIELDS):
+        raise ValueError(
+            f"GenerationConfig header has {len(arr)} values, expected "
+            f"{len(_GEN_FIELDS)} ({_GEN_FIELDS}) — driver/follower "
+            "version skew?"
+        )
+    kwargs = {}
+    for name, f, raw in zip(_GEN_FIELDS,
+                            dataclasses.fields(GenerationConfig), arr):
+        if name == "eos_token_id":
+            kwargs[name] = None if raw < 0 else int(raw)
+        elif f.type in ("int", int):
+            kwargs[name] = int(raw)
+        elif f.type in ("bool", bool):
+            kwargs[name] = bool(raw)
+        else:
+            kwargs[name] = float(raw)
+    return GenerationConfig(**kwargs)
 
 
 class MultiProcessFrontend:
@@ -93,7 +117,7 @@ class MultiProcessFrontend:
             raise RuntimeError("process 0 drives; followers serve")
         served = 0
         while True:
-            header = _bcast(np.zeros(7, np.float64))
+            header = _bcast(np.zeros(1 + len(_GEN_FIELDS), np.float64))
             if int(header[0]) == _OP_STOP:
                 return served
             gen = _unpack_gen(header[1:])
@@ -105,4 +129,4 @@ class MultiProcessFrontend:
         """Broadcast the stop signal (process 0)."""
         if self.rank != 0:
             raise RuntimeError("only process 0 closes the frontend")
-        _bcast(np.zeros(7, np.float64))
+        _bcast(np.zeros(1 + len(_GEN_FIELDS), np.float64))

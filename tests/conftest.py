@@ -38,12 +38,25 @@ if os.environ.get("CLT_TEST_CACHE", "1") != "0":
         os.path.expanduser("~/.cache/colossalai_tpu_test_jax_cache"),
     )
     try:
+        import inspect
+
         from jax._src import compiler as _jax_compiler
 
         _orig_compile_or_get_cached = _jax_compiler.compile_or_get_cached
         # bind at patch time: if a future jax renames this, the except
         # below falls back to cache-off instead of erroring mid-test
         _backend_compile_and_load = _jax_compiler.backend_compile_and_load
+
+        # the patch below mirrors this exact private signature; if a jax
+        # upgrade changes it, degrade to cache-off HERE instead of failing
+        # with a confusing TypeError at the first mid-test compile
+        _expected = [
+            "backend", "computation", "devices", "compile_options",
+            "host_callbacks", "executable_devices", "pgle_profiler",
+        ]
+        if list(inspect.signature(
+                _orig_compile_or_get_cached).parameters) != _expected:
+            raise AttributeError("compile_or_get_cached signature drifted")
 
         def _single_device_scoped_cache(
             backend, computation, devices, compile_options, host_callbacks,
