@@ -92,6 +92,17 @@ def _copy_block(cache: PagedKVCache, src, dst) -> PagedKVCache:
     )
 
 
+@functools.partial(jax.jit, donate_argnums=0)
+def _copy_block_pp(cache: PagedKVCache, src, dst) -> PagedKVCache:
+    """Pp variant of :func:`_copy_block`: the pool is [pp, L/pp, blocks,
+    ...] (stage-sharded on dim 0), so the page copy runs on axis 2 — a
+    per-stage local update, no cross-stage traffic."""
+    return PagedKVCache(
+        k=cache.k.at[:, :, dst].set(cache.k[:, :, src]),
+        v=cache.v.at[:, :, dst].set(cache.v[:, :, src]),
+    )
+
+
 @jax.jit
 def _sample_slots(logits, rng, temperature, top_k, top_p, do_sample):
     """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
@@ -290,9 +301,15 @@ class LLMEngine:
         must match the original's structure/shapes/dtypes so every compiled
         prefill/decode program is reused without retracing; with a tp mesh
         the tree is resharded through the same auto-policy specs as at
-        construction."""
+        construction; with a pp mesh it is re-split into (top, stacked)
+        stage placements, leaving the live page pool untouched."""
         if self._pp:
-            raise NotImplementedError("sync_params has no pp path yet")
+            from .pp_decode import place_params_pp
+
+            self._pp_top, self._pp_stacked = place_params_pp(
+                params, self.mesh, self.config.num_hidden_layers
+            )
+            return
         if self._tp_mesh is not None:
             params = self._place_params(params)
         inner = params["params"] if "params" in params else params
@@ -317,8 +334,6 @@ class LLMEngine:
             raise ValueError(f"prompt length {len(req.prompt_ids)} >= max_seq_len {self.max_seq}")
         if n_samples < 1:
             raise ValueError(f"n_samples={n_samples} must be >= 1")
-        if n_samples > 1 and self._pp:
-            raise NotImplementedError("grouped sampling has no pp relay path yet")
         if n_samples > self.max_batch:
             raise ValueError(
                 f"n_samples={n_samples} > max_batch_size={self.max_batch}: "
@@ -403,7 +418,8 @@ class LLMEngine:
                 if n % self.block_size:
                     # the partial prompt page would be overwritten by this
                     # member's first tokens: copy-on-write it
-                    self.cache = _copy_block(
+                    copy = _copy_block_pp if self._pp else _copy_block
+                    self.cache = copy(
                         self.cache,
                         self._put_rep(np.asarray(req.table.blocks[full], np.int32)),
                         self._put_rep(np.asarray(fresh[0], np.int32)),

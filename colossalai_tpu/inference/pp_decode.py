@@ -39,25 +39,41 @@ from .kv_cache import PagedKVCache
 from .modeling import _block_step, _project_kv, _rms
 
 
-def shard_params_pp(params, cache: PagedKVCache, mesh, num_layers: int):
-    """Reshape the scanned stack and the page pool to [pp, L/pp, ...] and
-    place them: stacked dim 0 over ``pp``, top-level params replicated."""
+def _stage_layout(mesh, num_layers: int):
+    """(pp, layers-per-stage, stage sharding) — the ONE place the stage
+    layout is defined, so weights and pages can never shard differently."""
     pp = mesh.shape["pp"]
     if num_layers % pp:
         raise ValueError(f"num_layers={num_layers} not divisible by pp={pp}")
-    per = num_layers // pp
+    return pp, num_layers // pp, NamedSharding(mesh, P("pp"))
+
+
+def place_params_pp(params, mesh, num_layers: int):
+    """Reshape the scanned layer stack to [pp, L/pp, ...] and place it:
+    stacked dim 0 over ``pp``, top-level params replicated. Params-only so
+    ``LLMEngine.sync_params`` (the RLHF weight handoff) can re-place fresh
+    weights without touching the live page pool."""
+    pp, per, stage_sharding = _stage_layout(mesh, num_layers)
     p = params["params"] if "params" in params else params
     top = {k: v for k, v in p.items() if k != "layers"}
     stacked = jax.tree.map(
         lambda a: jnp.asarray(a).reshape((pp, per) + a.shape[1:]),
         p["layers"]["block"],
     )
-    stage_sharding = NamedSharding(mesh, P("pp"))
     repl = NamedSharding(mesh, P())
     top = jax.device_put(top, jax.tree.map(lambda _: repl, top))
     stacked = jax.device_put(
         stacked, jax.tree.map(lambda _: stage_sharding, stacked)
     )
+    return top, stacked
+
+
+def shard_params_pp(params, cache: PagedKVCache, mesh, num_layers: int):
+    """Engine-init placement: params via :func:`place_params_pp` plus the
+    page pool reshaped to [pp, L/pp, ...] with dim 0 over ``pp`` (each
+    stage owns its layers' pages)."""
+    top, stacked = place_params_pp(params, mesh, num_layers)
+    pp, per, stage_sharding = _stage_layout(mesh, num_layers)
     ck = jax.device_put(
         cache.k.reshape((pp, per) + cache.k.shape[1:]), stage_sharding
     )

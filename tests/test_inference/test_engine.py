@@ -142,6 +142,59 @@ def test_engine_pp_rejects_tp_mix(model_and_params):
                   block_size=16, mesh=mesh)
 
 
+def test_engine_pp2_grouped_sampling_matches_single_device(model_and_params):
+    """Grouped sampling (one prefill, KV pages fork-shared, partial page
+    copy-on-write) over a pp mesh: the [pp, L/pp, blocks, ...] pool copies
+    pages on axis 2, and at the same seed the members' sampled tokens are
+    identical to the single-device engine's (VERDICT r04 #3)."""
+    from jax.sharding import Mesh
+
+    cfg, model, params = model_and_params
+    # 7 tokens with block_size 16: a PARTIAL prompt page, so every follower
+    # exercises the copy-on-write fork
+    prompt = list(RNG.randint(0, cfg.vocab_size, size=(7,)))
+    gen = GenerationConfig(max_new_tokens=5, do_sample=True, temperature=1.0)
+
+    def run(mesh):
+        eng = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=128,
+                        block_size=16, mesh=mesh, seed=3)
+        ids = eng.add_request(prompt, gen, n_samples=3)
+        done = {}
+        while eng.waiting or eng.running:
+            for r in eng.step():
+                done[r.request_id] = r
+        return [done[i].output_ids for i in ids]
+
+    ref = run(None)
+    out = run(Mesh(np.array(jax.devices()[:2]), ("pp",)))
+    assert out == ref, (out, ref)
+
+
+def test_engine_pp2_sync_params(model_and_params):
+    """The RLHF weight handoff on a pp mesh: sync_params re-places fresh
+    weights into (top, stacked) stage shards without touching the live page
+    pool; generations then match a single-device engine holding the same
+    new weights (VERDICT r04 #3)."""
+    from jax.sharding import Mesh
+
+    cfg, model, params = model_and_params
+    params2 = model.init(jax.random.PRNGKey(7), jnp.ones((1, 8), jnp.int32))
+    prompt = list(RNG.randint(0, cfg.vocab_size, size=(6,)))
+    gen = GenerationConfig(max_new_tokens=6)
+
+    ref = LLMEngine(params2, cfg, max_batch_size=2, max_seq_len=128,
+                    block_size=16).generate([prompt], gen)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                    block_size=16, mesh=mesh)
+    before = eng.generate([prompt], gen)
+    eng.sync_params(params2)
+    out = eng.generate([prompt], gen)
+    assert out == ref, (out, ref)
+    assert out != before  # the fresh weights actually took effect
+
+
 def test_engine_per_slot_sampling_configs(model_and_params):
     """Slots with different sampling configs coexist in one tick: greedy
     slots stay deterministic while a sampling slot draws from the filtered
