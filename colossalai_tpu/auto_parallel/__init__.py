@@ -14,8 +14,18 @@ exactly that by composing the three cost models the framework already has:
 ``plan_parallelism`` enumerates mesh factorizations and returns ranked
 :class:`Plan` objects; ``Plan.to_plugin()`` yields the ready
 HybridParallelPlugin.
+
+The per-tensor level below the mesh plan — the reference solver's per-op
+strategy choice — is :func:`search_param_shardings` (``solver.py``): a
+grouped strategy search over {policy-tp, replicate, fsdp, tp+fsdp} per
+parameter group, costed by the same α-β model plus a redundant-compute
+term, emitting ``param_spec_overrides`` every plugin accepts.
 """
 
 from .advisor import MemoryBreakdown, Plan, plan_parallelism
+from .solver import GroupChoice, SearchedShardings, search_param_shardings
 
-__all__ = ["Plan", "MemoryBreakdown", "plan_parallelism"]
+__all__ = [
+    "Plan", "MemoryBreakdown", "plan_parallelism",
+    "GroupChoice", "SearchedShardings", "search_param_shardings",
+]

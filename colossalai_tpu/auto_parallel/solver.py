@@ -1,0 +1,410 @@
+"""Per-op sharding-strategy search.
+
+≙ reference ``auto_parallel/tensor_shard/solver`` (solver.py:1 — per-node
+strategy sets from ``node_handler/``, edge resharding costs in a
+CostGraph, one ILP choice per fx node). TPU redesign: under GSPMD a
+"strategy" is a PartitionSpec per parameter; XLA inserts the collectives,
+so the solver searches SPECS, not comm schedules. Three structural
+deltas keep the search bounded the way the reference's graph coarsening
+pass does:
+
+- **Groups, not nodes.** Leaves are grouped by owning submodule (one
+  attention block, one MLP, the embedding, ...). A scanned layer stack is
+  ONE leaf per weight, so a group choice covers every layer at once —
+  the per-layer choice the reference's ILP makes is the per-group choice
+  here (coarser but exactly the granularity GSPMD can express without
+  unrolling the scan).
+- **Pair-aware cost.** The reference prices resharding on graph edges;
+  here the Megatron column→row composition inside a group (q/k/v + o,
+  up/gate + down) is priced as one fwd + one bwd all_reduce of the
+  boundary activation, and a tp choice WITHOUT a closing row matmul pays
+  an extra activation gather — the same interaction the edge costs
+  encode, collapsed into the group term.
+- **Greedy knapsack, not ILP.** Per-group costs are separable, so the
+  comm-and-compute-optimal assignment is the independent per-group
+  argmin; the memory constraint is then met by flipping, one at a time,
+  the choice with the best bytes-saved per second-added ratio until the
+  plan fits (the LP-relaxation greedy of the reference's ILP memory
+  constraint, solver.py `memory_budget`).
+
+The result is a dict of per-tensor constraint overrides
+(``path regex → PartitionSpec``) that every plugin accepts
+(``param_spec_overrides``), composing with the policy exactly where the
+search found a better placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+from colossalai_tpu.device.alpha_beta import AlphaBeta, default_alpha_beta
+from colossalai_tpu.shardformer.policies.base_policy import (
+    add_data_axis,
+    is_scanned,
+    path_str,
+)
+
+_MXU_EFFICIENCY = 0.55  # matches advisor._MXU_EFFICIENCY's convention
+#: param-name leaves that are matmul kernels (their FLOPs scale with tp)
+_MATMUL_LEAVES = ("kernel",)
+#: adam m+v in fp32 — the opt-state bytes the strategies shard
+_OPT_BYTES_PER_ELEM = 8.0
+
+
+@dataclasses.dataclass(frozen=True)
+class _Leaf:
+    path: str
+    shape: Tuple[int, ...]
+    dtype_bytes: int
+    policy_spec: PartitionSpec
+    scanned: bool
+
+    @property
+    def elems(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def is_matmul(self) -> bool:
+        name = self.path.rsplit("/", 1)[-1]
+        own_ndim = len(self.shape) - (1 if self.scanned else 0)
+        return name in _MATMUL_LEAVES and own_ndim >= 2
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupChoice:
+    """One group's chosen strategy with its modeled costs."""
+
+    group: str
+    strategy: str  # "policy" | "replicate" | "fsdp" | "policy+fsdp"
+    time_s: float  # per-step comm + redundant-compute cost
+    bytes_per_dev: float  # param+grad+opt state bytes per device
+
+    def describe(self) -> str:
+        return (
+            f"{self.group}: {self.strategy} "
+            f"({self.bytes_per_dev / 2**20:.1f} MiB/dev, "
+            f"+{self.time_s * 1e3:.2f} ms/step)"
+        )
+
+
+@dataclasses.dataclass
+class SearchedShardings:
+    """Output of :func:`search_param_shardings`."""
+
+    choices: List[GroupChoice]
+    #: per-tensor constraint overrides: exact leaf path → full PartitionSpec
+    #: (only leaves whose searched spec differs from the policy default)
+    overrides: Dict[str, PartitionSpec]
+    time_s: float
+    bytes_per_dev: float
+    fits: bool
+    #: the same costs under the pure policy assignment, for comparison
+    baseline_time_s: float = 0.0
+    baseline_bytes_per_dev: float = 0.0
+
+    def describe(self) -> str:
+        head = (
+            f"searched: {self.bytes_per_dev / 2**30:.2f} GiB/dev, "
+            f"comm+redundant {self.time_s * 1e3:.1f} ms/step "
+            f"({'fits' if self.fits else 'OOM'}); policy baseline "
+            f"{self.baseline_bytes_per_dev / 2**30:.2f} GiB/dev, "
+            f"{self.baseline_time_s * 1e3:.1f} ms/step"
+        )
+        return "\n  ".join([head] + [c.describe() for c in self.choices])
+
+
+def _group_key(path: str) -> str:
+    """Group = the owning submodule one level above the weight's module:
+    ``.../self_attn/q_proj/kernel`` → ``.../self_attn`` (merging the
+    Megatron pair), ``.../embed_tokens/embedding`` → ``.../embed_tokens``.
+    """
+    parts = path.split("/")
+    if len(parts) >= 3 and parts[-3] not in ("params",):
+        return "/".join(parts[:-2])
+    return "/".join(parts[:-1])
+
+
+def _strip_tp(spec: PartitionSpec, tp_axis: str = "tp") -> PartitionSpec:
+    entries = []
+    for e in spec:
+        if isinstance(e, tuple):
+            kept = tuple(a for a in e if a != tp_axis)
+            entries.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            entries.append(None if e == tp_axis else e)
+    return PartitionSpec(*entries)
+
+
+def _shard_factor(spec: PartitionSpec, mesh_shape: Dict[str, int]) -> int:
+    f = 1
+    for e in spec:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                f *= mesh_shape.get(a, 1)
+    return f
+
+
+def _spec_with_mesh(spec: PartitionSpec, shape, mesh_shape) -> PartitionSpec:
+    """Drop axes whose mesh size is 1 and entries that don't divide the
+    dim — the spec must be legal on THIS mesh."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for e, dim in zip(entries, shape):
+        axes = tuple(
+            a for a in (e if isinstance(e, tuple) else (e,))
+            if a is not None and mesh_shape.get(a, 1) > 1
+        )
+        size = math.prod(mesh_shape.get(a, 1) for a in axes)
+        if not axes or (size and dim % size):
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    return PartitionSpec(*out)
+
+
+def _leaf_specs_for(leaf: _Leaf, strategy: str, mesh_shape) -> PartitionSpec:
+    spec = leaf.policy_spec
+    if strategy in ("replicate", "fsdp"):
+        spec = _strip_tp(spec)
+    if strategy in ("fsdp", "policy+fsdp"):
+        spec = add_data_axis(spec, leaf.shape, mesh_shape)
+    return _spec_with_mesh(spec, leaf.shape, mesh_shape)
+
+
+def _group_cost(
+    leaves: List[_Leaf],
+    strategy: str,
+    mesh_shape: Dict[str, int],
+    *,
+    tokens_local: float,
+    ab: AlphaBeta,
+    peak_flops: float,
+    remat: bool,
+    zero_stage: int,
+) -> Tuple[float, float]:
+    """(time_s, bytes_per_dev) of assigning ``strategy`` to the group.
+
+    Time = tp activation collectives + fsdp gathers/scatter + dp grad sync
+    + redundant-compute penalty for unsharded matmul FLOPs. Bytes =
+    param + grad + adam state per device under the resulting specs (grads
+    and opt states additionally shard over dp at zero ≥ 2 / ≥ 1, matching
+    ``_opt_state_specs(shard_over_data=...)`` in the plugin core).
+    """
+    dp = mesh_shape.get("dp", 1)
+    tp = mesh_shape.get("tp", 1)
+    nbytes = 0.0
+    time = 0.0
+    flop_factor = 8.0 if remat else 6.0
+    has_tp_matmul = False
+    act_bytes, act_layers = 0.0, 1
+    for lf in leaves:
+        spec = _leaf_specs_for(lf, strategy, mesh_shape)
+        axes = {
+            a for e in spec for a in (e if isinstance(e, tuple) else (e,))
+            if a is not None
+        }
+        shard = _shard_factor(spec, mesh_shape)
+        grad_div = shard * (dp if zero_stage >= 2 and "dp" not in axes else 1)
+        opt_div = shard * (dp if zero_stage >= 1 and "dp" not in axes else 1)
+        nbytes += lf.elems * (
+            lf.dtype_bytes / shard + lf.dtype_bytes / grad_div
+            + _OPT_BYTES_PER_ELEM / opt_div
+        )
+        if lf.is_matmul:
+            # redundant compute: FLOPs not divided by tp run on every
+            # tp-group device (the reason matmuls want tp; norms don't)
+            tp_here = "tp" in axes
+            eff_tp = tp if tp_here else 1
+            flops = flop_factor * lf.elems * tokens_local
+            time += flops * (1.0 / eff_tp - 1.0 / tp) / (peak_flops * _MXU_EFFICIENCY)
+            if tp_here:
+                has_tp_matmul = True
+                in_dim = lf.shape[-2]
+                act_bytes = max(act_bytes, tokens_local * in_dim * lf.dtype_bytes)
+                if lf.scanned:
+                    act_layers = max(act_layers, lf.shape[0])
+        elif lf.path.endswith("embedding") and "tp" in axes:
+            # vocab-parallel gather: masked partials all_reduce fwd + bwd
+            h = lf.shape[-1]
+            time += 2 * ab.all_reduce(tokens_local * h * lf.dtype_bytes, tp)
+        # collective payloads are GLOBAL bytes of the dp-replicated unit:
+        # the weight as sharded by the non-data axes
+        nondp = 1
+        for a in axes:
+            if a not in ("dp", "ep"):
+                nondp *= mesh_shape.get(a, 1)
+        payload = lf.elems * lf.dtype_bytes / nondp
+        if dp > 1:
+            # charge fsdp collectives only where the data axis actually
+            # landed — add_data_axis leaves non-divisible weights
+            # replicated, and those pay only the plain grad sync
+            if strategy.endswith("fsdp") and "dp" in axes:
+                # gather the weight before each use (fwd + bwd re-gather
+                # under remat) and reduce-scatter its grad
+                time += 2 * ab.all_gather(payload, dp)
+                time += ab.reduce_scatter(payload, dp)
+            else:
+                # plain dp grad sync, largely overlapped with backward
+                time += 0.5 * ab.all_reduce(payload, dp)
+    if tp > 1 and has_tp_matmul:
+        # the Megatron column→row pair costs one fwd + one bwd boundary
+        # all_reduce per layer; a single-sided group (lm_head into the
+        # sharded CE loss, a lone row matmul) pays the same two boundary
+        # collectives (input-grad reduce + output reshard) — group
+        # granularity cannot see the consumer, so both sides are priced
+        time += 2 * act_layers * ab.all_reduce(act_bytes, tp)
+    return time, nbytes
+
+
+def search_param_shardings(
+    model,
+    example_batch: Dict[str, Any],
+    mesh_shape: Dict[str, int],
+    *,
+    hbm_bytes: int,
+    global_tokens: Optional[int] = None,
+    policy=None,
+    rng=None,
+    peak_flops: float = 197e12,
+    alpha_beta: Optional[AlphaBeta] = None,
+    headroom: float = 0.75,
+    zero_stage: int = 1,
+) -> SearchedShardings:
+    """Search a PartitionSpec per parameter group and emit plugin overrides.
+
+    ``mesh_shape`` is the plan's axis sizes (e.g. ``{"dp": 2, "tp": 2}``
+    from an advisor :class:`~colossalai_tpu.auto_parallel.Plan`);
+    ``headroom`` is the fraction of ``hbm_bytes`` the states may occupy
+    (the rest is activations, which the mesh plan — not this search —
+    already sized).
+
+    Returns a :class:`SearchedShardings` whose ``overrides`` feed any
+    plugin's ``param_spec_overrides``; by construction the searched
+    assignment's modeled cost beats or ties the pure-policy baseline
+    (the baseline is one of the candidate profiles).
+    """
+    from colossalai_tpu.shardformer.policies.auto_policy import get_autopolicy
+
+    if mesh_shape.get("pp", 1) > 1:
+        raise NotImplementedError(
+            "per-op search does not compose with pp — per-stage placement "
+            "is the pipeline schedule's choice; search the dp/tp/sp axes "
+            "and keep the policy specs for the scanned layer dim"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if policy is None:
+        policy = get_autopolicy(model)
+    ids = {
+        k: v for k, v in example_batch.items()
+        if k in ("input_ids", "pixel_values", "input_features")
+    } or dict(example_batch)
+    params_shape = jax.eval_shape(lambda r: model.init(r, **ids), rng)
+    tree = params_shape["params"] if "params" in params_shape else params_shape
+    specs = policy.param_specs(tree)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    flat_specs = {
+        path_str(kp): s
+        for kp, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+        )[0]
+    }
+    leaves = [
+        _Leaf(
+            path=path_str(kp), shape=tuple(v.shape),
+            dtype_bytes=jax.dtypes.canonicalize_dtype(v.dtype).itemsize,
+            policy_spec=flat_specs[path_str(kp)], scanned=is_scanned(path_str(kp)),
+        )
+        for kp, v in flat
+    ]
+    cfg = getattr(model, "config", None)
+    remat = bool(getattr(cfg, "remat", False))
+    if global_tokens is None:
+        bsz = next(iter(example_batch.values())).shape
+        global_tokens = int(bsz[0]) * int(bsz[1] if len(bsz) > 1 else 1)
+    dp = mesh_shape.get("dp", 1)
+    sp = mesh_shape.get("sp", 1)
+    tokens_local = global_tokens / (dp * sp)
+    ab = alpha_beta or default_alpha_beta()
+
+    groups: Dict[str, List[_Leaf]] = {}
+    for lf in leaves:
+        groups.setdefault(_group_key(lf.path), []).append(lf)
+
+    strategies = ("policy", "replicate", "fsdp", "policy+fsdp")
+    costed: Dict[str, Dict[str, Tuple[float, float]]] = {
+        g: {
+            s: _group_cost(
+                ls, s, mesh_shape, tokens_local=tokens_local, ab=ab,
+                peak_flops=peak_flops, remat=remat, zero_stage=zero_stage,
+            )
+            for s in strategies
+        }
+        for g, ls in groups.items()
+    }
+
+    # comm/compute-optimal independent assignment (ties → policy default,
+    # so a no-win search changes nothing)
+    order = {s: i for i, s in enumerate(strategies)}
+    chosen = {
+        g: min(c, key=lambda s: (round(c[s][0], 9), order[s]))
+        for g, c in costed.items()
+    }
+
+    budget = headroom * hbm_bytes
+
+    def total_bytes():
+        return sum(costed[g][chosen[g]][1] for g in groups)
+
+    def total_time():
+        return sum(costed[g][chosen[g]][0] for g in groups)
+
+    # greedy knapsack: flip the cheapest time-per-byte-saved choice until
+    # the states fit (the LP-relaxation greedy of the reference ILP's
+    # memory_budget constraint)
+    while total_bytes() > budget:
+        best = None
+        for g, c in costed.items():
+            t0, b0 = c[chosen[g]]
+            for s, (t1, b1) in c.items():
+                if b1 < b0:
+                    ratio = (t1 - t0) / (b0 - b1)
+                    if best is None or ratio < best[0]:
+                        best = (ratio, g, s)
+        if best is None:
+            break  # nothing left to shrink: report fits=False
+        chosen[best[1]] = best[2]
+
+    baseline_t = sum(costed[g]["policy"][0] for g in groups)
+    baseline_b = sum(costed[g]["policy"][1] for g in groups)
+
+    overrides: Dict[str, PartitionSpec] = {}
+    choices = []
+    for g, ls in sorted(groups.items()):
+        s = chosen[g]
+        t, b = costed[g][s]
+        choices.append(GroupChoice(group=g, strategy=s, time_s=t, bytes_per_dev=b))
+        if s == "policy":
+            continue
+        for lf in ls:
+            final = _leaf_specs_for(lf, s, mesh_shape)
+            default = _spec_with_mesh(lf.policy_spec, lf.shape, mesh_shape)
+            if final != default:
+                overrides[f"^{re.escape(lf.path)}$"] = final
+    return SearchedShardings(
+        choices=choices,
+        overrides=overrides,
+        time_s=total_time(),
+        bytes_per_dev=total_bytes(),
+        fits=total_bytes() <= budget,
+        baseline_time_s=baseline_t,
+        baseline_bytes_per_dev=baseline_b,
+    )

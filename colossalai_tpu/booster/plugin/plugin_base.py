@@ -97,6 +97,10 @@ class Plugin(abc.ABC):
 
     precision: str = "fp32"
     support_no_sync: bool = False
+    #: per-tensor constraint overrides (path regex → PartitionSpec), e.g.
+    #: from auto_parallel.search_param_shardings — applied on top of the
+    #: policy-derived specs in configure()
+    param_spec_overrides: Optional[Dict[str, Any]] = None
 
     @abc.abstractmethod
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
@@ -169,6 +173,15 @@ class Plugin(abc.ABC):
             param_specs = tree_add_pp_axis(param_specs, params_shape["params"])
         if self.fsdp:
             param_specs = tree_add_data_axis(param_specs, params_shape["params"], mesh)
+        overrides = getattr(self, "param_spec_overrides", None)
+        if overrides:
+            # per-tensor constraints from the per-op solver (or the user):
+            # authoritative full specs, applied over every policy transform
+            from colossalai_tpu.shardformer.policies.base_policy import (
+                apply_spec_overrides,
+            )
+
+            param_specs = apply_spec_overrides(param_specs, overrides)
         # ---- LoRA (≙ booster.enable_lora / peft): the trainable state is a
         # parallel adapter tree; base params are frozen cargo in TrainState.
         lora_shape = None

@@ -34,6 +34,7 @@ class DataParallelPlugin(Plugin):
     grad_accum_steps: int = 1
     zero_stage: int = 0
     fsdp: bool = False
+    param_spec_overrides: Optional[dict] = None
 
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> DeviceMesh:
         return create_device_mesh(devices=devices)
@@ -46,6 +47,7 @@ class LowLevelZeroPlugin(Plugin):
     max_norm: float = 0.0
     grad_accum_steps: int = 1
     fsdp: bool = False
+    param_spec_overrides: Optional[dict] = None
 
     def __post_init__(self):
         if self.stage not in (1, 2):
@@ -77,6 +79,7 @@ class GeminiPlugin(Plugin):
     #: all-gather fsdp-sharded params as fp8 (+ scale) in the forward
     #: (≙ fp8 comm hooks, quantization/fp8.py:408); identity-backward grads
     fp8_communication: bool = False
+    param_spec_overrides: Optional[dict] = None
 
     def __post_init__(self):
         if self.placement_policy not in ("static", "auto"):
@@ -130,6 +133,10 @@ class HybridParallelPlugin(Plugin):
     #: checkpoint only this fraction of each stage's layers when the model
     #: remats (≙ PipelineGradientCheckpointConfig per-stage ckpt ratios)
     pp_remat_ratio: float = 1.0
+    #: per-tensor constraint overrides (path regex → PartitionSpec), e.g.
+    #: from auto_parallel.search_param_shardings (≙ the reference solver's
+    #: per-op strategy output feeding the sharder)
+    param_spec_overrides: Optional[dict] = None
 
     PP_SCHEDULES = ("1f1b", "interleaved", "zb", "gpipe", "auto")
 

@@ -133,6 +133,31 @@ def tree_add_data_axis(specs: Any, params: Any, mesh) -> Any:
     )
 
 
+def apply_spec_overrides(specs: Any, overrides: Dict[str, Any]) -> Any:
+    """Per-tensor constraint overrides (the per-op solver's output, or a
+    user's hand override): ``path regex → PartitionSpec`` (or spec-entry
+    tuple), replacing the policy-derived spec of every matching leaf.
+    The override is the FULL spec including any scanned layer dim; first
+    matching pattern wins."""
+    compiled = [
+        (re.compile(pat),
+         sp if isinstance(sp, PartitionSpec) else PartitionSpec(*sp))
+        for pat, sp in overrides.items()
+    ]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    leaves = []
+    for keypath, spec in flat:
+        path = path_str(keypath)
+        for pat, sp in compiled:
+            if pat.search(path):
+                spec = sp
+                break
+        leaves.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def tree_add_pp_axis(specs: Any, params: Any) -> Any:
     """Pipeline: shard the stacked layer dim of scanned stacks over ``pp``
     (each stage holds its L/pp layers — ≙ _release_unheld_layers,

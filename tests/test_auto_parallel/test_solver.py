@@ -1,0 +1,121 @@
+"""Per-op sharding-strategy search (≙ reference tensor_shard solver ILP,
+auto_parallel/tensor_shard/solver/solver.py): the searched assignment must
+beat or tie the fixed policy assignment on modeled step cost, shrink
+compiled memory when the budget demands it, and train identically to the
+policy placement (same math, different specs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.auto_parallel import search_param_shardings
+from colossalai_tpu.booster import Booster, HybridParallelPlugin
+from colossalai_tpu.models import (
+    GPT2Config,
+    GPT2LMHeadModel,
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+
+def _llama():
+    cfg = LlamaConfig(
+        vocab_size=4096, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=256,
+    )
+    batch = {"input_ids": jnp.zeros((8, 128), jnp.int32)}
+    return LlamaForCausalLM(cfg), batch
+
+
+def _gpt2():
+    cfg = GPT2Config.tiny(vocab_size=2048)
+    batch = {"input_ids": jnp.zeros((8, 64), jnp.int32)}
+    return GPT2LMHeadModel(cfg), batch
+
+
+@pytest.mark.parametrize("build,mesh_shape", [
+    (_llama, {"dp": 4, "tp": 2}),
+    (_gpt2, {"dp": 2, "tp": 2, "sp": 2}),
+])
+def test_search_beats_or_ties_policy_baseline(build, mesh_shape):
+    """VERDICT r04 #2's validation contract, modeled half: on two configs
+    the searched plan beats or ties the advisor's fixed (policy) plan on
+    the simulated step cost while fitting the budget."""
+    model, batch = build()
+    sr = search_param_shardings(
+        model, batch, mesh_shape, hbm_bytes=16 * 2**30,
+    )
+    assert sr.time_s <= sr.baseline_time_s + 1e-12, (
+        sr.time_s, sr.baseline_time_s,
+    )
+    assert sr.fits
+    # choices cover every group once and report real costs
+    assert len({c.group for c in sr.choices}) == len(sr.choices)
+    assert all(np.isfinite(c.time_s) and c.bytes_per_dev >= 0 for c in sr.choices)
+
+
+def test_search_tight_budget_engages_fsdp_and_shrinks_compiled_memory():
+    """Modeled + compiled halves together: a budget too small for the
+    policy placement flips groups to fsdp, and the emitted overrides
+    REALLY shrink the compiled train step's resident bytes."""
+    model, batch = _llama()
+    mesh_shape = {"dp": 4, "tp": 2}
+    free = search_param_shardings(model, batch, mesh_shape, hbm_bytes=16 * 2**30)
+    # below the all-policy byte floor: only fsdp sharding can close the gap
+    tight_hbm = int(free.baseline_bytes_per_dev / 0.75 * 0.8)
+    sr = search_param_shardings(model, batch, mesh_shape, hbm_bytes=tight_hbm)
+    assert sr.fits and sr.bytes_per_dev < free.baseline_bytes_per_dev
+    assert any("fsdp" in c.strategy for c in sr.choices)
+    assert sr.overrides  # the searched constraints materialized
+
+    opt = optax.adamw(1e-3)
+    base = Booster(plugin=HybridParallelPlugin(
+        tp_size=2, zero_stage=1, precision="fp32",
+    )).boost(model, opt, example_batch=batch, rng=jax.random.PRNGKey(0))
+    searched = Booster(plugin=HybridParallelPlugin(
+        tp_size=2, zero_stage=1, precision="fp32",
+        param_spec_overrides=sr.overrides,
+    )).boost(model, opt, example_batch=batch, rng=jax.random.PRNGKey(0))
+    m_base = base.memory_stats(batch)
+    m_sr = searched.memory_stats(batch)
+    # params are compiled-step arguments: the fsdp overrides must shrink
+    # the per-device argument bytes (and not blow up the peak)
+    assert m_sr["argument_bytes"] < m_base["argument_bytes"], (m_sr, m_base)
+
+
+def test_search_overrides_train_identically():
+    """The overrides change placement, not math: same seed, same batch,
+    same loss trajectory as the pure policy plugin."""
+    model, batch = _llama()
+    rng = np.random.RandomState(0)
+    data = {"input_ids": jnp.asarray(
+        rng.randint(0, model.config.vocab_size, size=(8, 128))
+    )}
+    sr = search_param_shardings(
+        model, batch, {"dp": 4, "tp": 2}, hbm_bytes=16 * 2**30,
+    )
+    opt = optax.adamw(1e-3)
+    losses = {}
+    for name, overrides in (("policy", None), ("searched", sr.overrides)):
+        boosted = Booster(plugin=HybridParallelPlugin(
+            tp_size=2, zero_stage=1, precision="fp32",
+            param_spec_overrides=overrides,
+        )).boost(model, opt, example_batch=batch, rng=jax.random.PRNGKey(0))
+        state = boosted.state
+        run = []
+        for _ in range(2):
+            state, metrics = boosted.train_step(state, boosted.shard_batch(data))
+            run.append(float(metrics["loss"]))
+        losses[name] = run
+    np.testing.assert_allclose(losses["policy"], losses["searched"],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_search_rejects_pp_mesh():
+    model, batch = _llama()
+    with pytest.raises(NotImplementedError, match="per-op search"):
+        search_param_shardings(model, batch, {"dp": 2, "pp": 2},
+                               hbm_bytes=16 * 2**30)
