@@ -354,6 +354,25 @@ class LLMEngine:
         self.waiting.append(req)
         return req.request_id
 
+    def abort(self, request_id: int) -> bool:
+        """Cancel a request mid-flight (≙ the reference server's abort
+        path): a WAITING request leaves the queue (a grouped leader takes
+        its whole group with it — members share one prefill); a RUNNING
+        request releases its slot and frees its KV pages immediately
+        (ref-counted, so aborting one member of a group never frees pages
+        the others still read). Returns whether anything was cancelled."""
+        for i, req in enumerate(self.waiting):
+            if req.request_id == request_id or (
+                req.group_ids and request_id in req.group_ids
+            ):
+                self.waiting.pop(i)
+                return True
+        for slot, req in list(self.running.items()):
+            if req.request_id == request_id:
+                self._release(slot)
+                return True
+        return False
+
     def generate(self, prompts: List[List[int]], gen: Optional[GenerationConfig] = None) -> List[List[int]]:
         """Blocking batch API (≙ LLMEngine.generate :496)."""
         order = [self.add_request(p, gen) for p in prompts]

@@ -1,50 +1,121 @@
 """Minimal HTTP inference server over the paged engine.
 
-≙ reference ``inference/server/api_server.py`` (FastAPI + uvicorn). Zero
-extra dependencies: stdlib ``http.server`` with a background scheduler
-thread draining the engine's continuous-batching step loop.
+≙ reference ``inference/server/api_server.py`` (FastAPI + uvicorn: SSE
+streaming ``/generate`` + abort-on-disconnect). Zero extra dependencies:
+stdlib ``http.server`` with a background scheduler thread draining the
+engine's continuous-batching step loop.
 
 Endpoints:
 - ``POST /generate``  {"prompt_ids": [...], "max_new_tokens": n, ...}
   → {"request_id": i, "output_ids": [...]}
+  With ``"stream": true`` the response is Server-Sent Events
+  (``text/event-stream``): one ``data: {"request_id", "token"}`` event
+  per generated token as the engine's step loop produces it, then a final
+  ``data: {"done": true, "output_ids": [...]}``. A client that
+  disconnects mid-stream aborts the request and frees its KV pages.
+- ``POST /abort``     {"request_id": i} → {"aborted": bool} — cancel a
+  queued or running request; running requests free their pages
+  immediately (≙ engine.abort_request).
 - ``GET /health``     → {"status": "ok", "running": n, "waiting": m}
 """
 
 from __future__ import annotations
 
 import json
+import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
+from typing import Dict, Optional
 
 from .engine import GenerationConfig, LLMEngine
+
+#: sentinel pushed to a stream queue when its request leaves the engine
+_DONE = object()
+_ABORTED = object()
 
 
 class _Scheduler(threading.Thread):
     """Drains engine.step() continuously; completions signal per-request
-    events (continuous batching across concurrent HTTP requests)."""
+    events and stream queues (continuous batching across concurrent HTTP
+    requests)."""
 
-    def __init__(self, engine: LLMEngine):
+    def __init__(self, engine: LLMEngine, request_timeout: float = 300.0):
         super().__init__(daemon=True)
         self.engine = engine
+        self.request_timeout = request_timeout
         self.lock = threading.Lock()
         self.done: Dict[int, list] = {}
         self.events: Dict[int, threading.Event] = {}
+        #: per-streaming-request token queues + how many tokens were pushed
+        self.streams: Dict[int, queue.Queue] = {}
+        self._pushed: Dict[int, int] = {}
         self._wake = threading.Event()
         self._stop = False
 
-    def submit(self, prompt_ids, gen: GenerationConfig) -> int:
+    def submit(self, prompt_ids, gen: GenerationConfig,
+               stream: bool = False):
+        """Queue a request. Returns the request id, or ``(id, queue)`` for
+        a streaming request — the caller must hold its own queue handle
+        because a fast request can finish (and be popped from
+        ``self.streams``) before the caller ever looks it up."""
         with self.lock:
             rid = self.engine.add_request(prompt_ids, gen)
-            self.events[rid] = threading.Event()
+            if stream:
+                q = queue.Queue()
+                self.streams[rid] = q
+                self._pushed[rid] = 0
+            else:
+                self.events[rid] = threading.Event()
         self._wake.set()
-        return rid
+        return (rid, q) if stream else rid
 
-    def wait(self, rid: int, timeout: float = 300.0):
-        self.events[rid].wait(timeout)
+    def wait(self, rid: int, timeout: Optional[float] = None):
+        """Block until the request finishes; on timeout the request is
+        aborted so its pages free instead of decoding for a client that
+        already gave up."""
+        # .get(): a concurrent abort() may have popped the event already —
+        # then the result (None) is immediately decided, no wait needed
+        ev = self.events.get(rid)
+        ok = ev is None or ev.wait(
+            self.request_timeout if timeout is None else timeout
+        )
         with self.lock:
             self.events.pop(rid, None)
-            return self.done.pop(rid, None)
+            out = self.done.pop(rid, None)
+            if not ok and out is None:
+                self.engine.abort(rid)
+        return out
+
+    def abort(self, rid: int) -> bool:
+        with self.lock:
+            hit = self.engine.abort(rid)
+            if hit:
+                # only a request the engine really cancelled loses its
+                # bookkeeping — an already-finished request keeps its
+                # unconsumed result for the waiter
+                self.done.pop(rid, None)
+                ev = self.events.pop(rid, None)
+                if ev is not None:
+                    ev.set()  # unblock a waiter with done=None
+                q = self.streams.pop(rid, None)
+                self._pushed.pop(rid, None)
+                if q is not None:
+                    q.put(_ABORTED)
+        if hit:
+            self._wake.set()  # freed pages may admit waiting requests
+        return hit
+
+    def _push_stream_deltas(self):
+        """Called under the lock after each step: ship tokens the engine
+        appended since the last push to their stream queues."""
+        for slot, req in self.engine.running.items():
+            q = self.streams.get(req.request_id)
+            if q is None:
+                continue
+            sent = self._pushed.get(req.request_id, 0)
+            for tok in req.output_ids[sent:]:
+                q.put(int(tok))
+            self._pushed[req.request_id] = len(req.output_ids)
 
     def run(self):
         while not self._stop:
@@ -55,11 +126,21 @@ class _Scheduler(threading.Thread):
                 self._wake.clear()
                 continue
             with self.lock:
-                for req in self.engine.step():
-                    ev = self.events.get(req.request_id)
+                finished = self.engine.step()
+                self._push_stream_deltas()
+                for req in finished:
+                    rid = req.request_id
+                    q = self.streams.pop(rid, None)
+                    if q is not None:
+                        sent = self._pushed.pop(rid, 0)
+                        for tok in req.output_ids[sent:]:
+                            q.put(int(tok))
+                        q.put(_DONE)
+                        continue
+                    ev = self.events.get(rid)
                     if ev is None:
                         continue  # client gave up (timeout): drop the result
-                    self.done[req.request_id] = req.output_ids
+                    self.done[rid] = req.output_ids
                     ev.set()
 
     def stop(self):
@@ -67,10 +148,13 @@ class _Scheduler(threading.Thread):
         self._wake.set()
 
 
-def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000):
+def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
+                request_timeout: float = 300.0):
     """Returns (ThreadingHTTPServer, scheduler). Call serve_forever() /
-    shutdown() on the server; scheduler.stop() on teardown."""
-    sched = _Scheduler(engine)
+    shutdown() on the server; scheduler.stop() on teardown.
+    ``request_timeout`` bounds non-streaming waits; a timed-out request is
+    aborted so its KV pages return to the pool."""
+    sched = _Scheduler(engine, request_timeout=request_timeout)
     sched.start()
 
     class Handler(BaseHTTPRequestHandler):
@@ -97,13 +181,56 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000):
             else:
                 self._json(404, {"error": "not found"})
 
+        def _stream(self, rid: int, q: queue.Queue):
+            """SSE: one event per token as the step loop produces it. A
+            broken pipe (client went away) aborts the request so its KV
+            pages free mid-decode."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            out = []
+            try:
+                while True:
+                    tok = q.get(timeout=sched.request_timeout)
+                    if tok is _DONE:
+                        payload = {"request_id": rid, "done": True,
+                                   "output_ids": out}
+                    elif tok is _ABORTED:
+                        payload = {"request_id": rid, "aborted": True,
+                                   "output_ids": out}
+                    else:
+                        out.append(tok)
+                        payload = {"request_id": rid, "token": tok}
+                    self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+                    self.wfile.flush()
+                    if tok is _DONE or tok is _ABORTED:
+                        return
+            except queue.Empty:
+                sched.abort(rid)
+                self.wfile.write(
+                    f"data: {json.dumps({'request_id': rid, 'aborted': True})}\n\n".encode()
+                )
+            except (BrokenPipeError, ConnectionResetError):
+                sched.abort(rid)  # client went away: free the pages
+
         def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+            except Exception as e:
+                self._json(400, {"error": str(e)})
+                return
+            if self.path == "/abort":
+                try:
+                    self._json(200, {"aborted": sched.abort(int(req["request_id"]))})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(n))
                 gen = GenerationConfig(
                     max_new_tokens=int(req.get("max_new_tokens", 64)),
                     temperature=float(req.get("temperature", 1.0)),
@@ -112,6 +239,11 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000):
                     do_sample=bool(req.get("do_sample", False)),
                     eos_token_id=req.get("eos_token_id"),
                 )
+                stream = bool(req.get("stream", False))
+                if stream:
+                    rid, q = sched.submit(req["prompt_ids"], gen, stream=True)
+                    self._stream(rid, q)
+                    return
                 rid = sched.submit(req["prompt_ids"], gen)
                 out = sched.wait(rid)
                 if out is None:

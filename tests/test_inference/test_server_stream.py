@@ -1,0 +1,151 @@
+"""Streaming serving + cancellation (≙ reference api_server.py: SSE
+generate endpoints + abort-on-disconnect). The stream must surface tokens
+incrementally as the step loop produces them, and an abort mid-decode must
+return the request's KV pages to the pool."""
+
+import http.client
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine, make_server
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16, prefill_buckets=(16,))
+    server, sched = make_server(eng, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield eng, port
+    server.shutdown()
+    sched.stop()
+
+
+def _sse_events(resp):
+    """Parse data: lines off a streaming response as they arrive."""
+    for raw in resp:
+        raw = raw.strip()
+        if raw.startswith(b"data: "):
+            yield json.loads(raw[len(b"data: "):])
+
+
+def test_stream_tokens_arrive_incrementally_and_match(served):
+    eng, port = served
+    prompt = [1, 2, 3]
+    # non-streamed greedy reference through the same server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"prompt_ids": prompt, "max_new_tokens": 6}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        ref = json.loads(r.read())["output_ids"]
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/generate", json.dumps(
+        {"prompt_ids": prompt, "max_new_tokens": 6, "stream": True}
+    ), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    events = list(_sse_events(resp))
+    conn.close()
+    tokens = [e["token"] for e in events if "token" in e]
+    final = events[-1]
+    assert final.get("done") is True
+    assert tokens == final["output_ids"] == ref, (tokens, final, ref)
+    # one event per token + the final summary: genuinely incremental
+    assert len(events) == len(ref) + 1
+
+
+def test_abort_mid_stream_frees_kv_pages():
+    # dedicated long-horizon engine: ~400 decode steps give the HTTP abort
+    # round-trip a wide window to land mid-decode (the module fixture's
+    # 64-token horizon can finish before the abort on a fast host)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=512,
+                    block_size=16, prefill_buckets=(16,))
+    server, sched = make_server(eng, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        free_before = eng.allocator.num_free
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/generate", json.dumps(
+            {"prompt_ids": [5, 6, 7], "max_new_tokens": 400, "stream": True}
+        ), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        events = _sse_events(resp)
+        first = next(events)
+        rid = first["request_id"]
+        assert "token" in first
+
+        abort_req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/abort",
+            data=json.dumps({"request_id": rid}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(abort_req, timeout=30) as r:
+            assert json.loads(r.read())["aborted"] is True
+
+        tail = list(events)
+        conn.close()
+        assert tail and tail[-1].get("aborted") is True
+        assert len(tail) < 400  # it really stopped early
+        # the aborted request's pages returned to the pool
+        assert eng.allocator.num_free == free_before
+    finally:
+        server.shutdown()
+        sched.stop()
+
+
+def test_abort_unknown_request_is_false(served):
+    _, port = served
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/abort",
+        data=json.dumps({"request_id": 10**9}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read())["aborted"] is False
+
+
+def test_engine_abort_waiting_and_running():
+    """Engine-level abort semantics: waiting requests (and their whole
+    group) leave the queue; running requests free ref-counted pages."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16, prefill_buckets=(16,))
+    free0 = eng.allocator.num_free
+    gen = GenerationConfig(max_new_tokens=10, do_sample=True, temperature=1.0)
+    ids = eng.add_request([1, 2, 3], gen, n_samples=2)
+    eng.step()  # admit the group: leader + fork both running
+    assert len(eng.running) == 2 and eng.allocator.num_free < free0
+    # aborting one member must NOT free the shared prompt pages the other
+    # still reads: the survivor keeps decoding correctly
+    assert eng.abort(ids[0])
+    assert len(eng.running) == 1
+    for _ in range(20):
+        if not eng.running:
+            break
+        eng.step()
+    assert eng.allocator.num_free == free0
+    # waiting group abort removes all members before admission
+    gids = eng.add_request([4, 5, 6], gen, n_samples=2)
+    assert eng.abort(gids[1])  # any member id cancels the queued group
+    assert not eng.waiting
+    assert not eng.abort(10**9)
