@@ -1,0 +1,270 @@
+"""Benchmark dataset loaders: official file formats → runner samples.
+
+≙ reference ``applications/ColossalEval/colossal_eval/dataset/`` (one
+loader class per benchmark — ``mmlu.py``, ``arc.py``, ``gsm.py``,
+``hellaswag.py`` … each parsing the benchmark's published release files).
+Here each loader is a function from the official on-disk format to the
+:class:`~colossalai_tpu.applications.eval.ChoiceSample` /
+:class:`~colossalai_tpu.applications.eval.GenSample` lists the runners
+consume, and :func:`load_benchmark` + :func:`runner_for` give the
+file→runner→accuracy path with no user glue.
+
+Formats parsed (the files the benchmarks publish):
+- MMLU: per-subject headerless csv ``question,A,B,C,D,answer`` in
+  ``dev/``/``test/`` directories (:func:`load_mmlu_csv`,
+  :func:`load_mmlu_dir`);
+- ARC (Easy/Challenge): jsonl with
+  ``{"question": {"stem", "choices": [{"text", "label"}]}, "answerKey"}``
+  (labels may be letters or digits);
+- HellaSwag: jsonl with ``{"ctx", "endings", "label"}``;
+- GSM8K: jsonl with ``{"question", "answer"}`` where the gold answer
+  carries the ``#### N`` marker the extractor understands.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .eval import (
+    ChoiceSample,
+    ChoiceTaskRunner,
+    GenSample,
+    GenerationTaskRunner,
+    LETTERS,
+)
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def load_mmlu_csv(path: str) -> List[ChoiceSample]:
+    """One MMLU subject csv (headerless: question, A, B, C, D, answer)."""
+    samples = []
+    with open(path, newline="", encoding="utf-8") as f:
+        for row in csv.reader(f):
+            if len(row) < 6:
+                raise ValueError(
+                    f"{path}: MMLU rows have 6 columns "
+                    f"(question, A, B, C, D, answer); got {len(row)}"
+                )
+            *qc, answer = row[:6]
+            samples.append(ChoiceSample(
+                question=qc[0], choices=list(qc[1:5]),
+                answer=LETTERS.index(answer.strip().upper()),
+            ))
+    return samples
+
+
+def load_mmlu_dir(root: str) -> Dict[str, Tuple[List[ChoiceSample], List[ChoiceSample]]]:
+    """The official MMLU release layout: ``root/dev/<subject>_dev.csv`` +
+    ``root/test/<subject>_test.csv`` → ``{subject: (dev, test)}`` (dev
+    rows are the canonical 5-shot examples)."""
+    out = {}
+    test_dir = os.path.join(root, "test")
+    for fname in sorted(os.listdir(test_dir)):
+        if not fname.endswith("_test.csv"):
+            continue
+        subject = fname[: -len("_test.csv")]
+        dev_path = os.path.join(root, "dev", f"{subject}_dev.csv")
+        dev = load_mmlu_csv(dev_path) if os.path.exists(dev_path) else []
+        out[subject] = (dev, load_mmlu_csv(os.path.join(test_dir, fname)))
+    return out
+
+
+def load_arc_jsonl(path: str) -> List[ChoiceSample]:
+    """Official ARC jsonl (AI2 release / HF dump): choice labels may be
+    letters (A..E) or digits (1..5); answerKey uses the same alphabet."""
+    samples = []
+    for row in _read_jsonl(path):
+        q = row["question"]
+        stem = q["stem"] if isinstance(q, dict) else str(q)
+        raw_choices = (q if isinstance(q, dict) else row)["choices"]
+        if isinstance(raw_choices, dict):  # HF dump: {"text": [...], "label": [...]}
+            labels = [str(l) for l in raw_choices["label"]]
+            texts = list(raw_choices["text"])
+        else:
+            labels = [str(c["label"]) for c in raw_choices]
+            texts = [c["text"] for c in raw_choices]
+        key = str(row["answerKey"]).strip()
+        if key not in labels:
+            raise ValueError(f"{path}: answerKey {key!r} not in labels {labels}")
+        samples.append(ChoiceSample(
+            question=stem, choices=texts, answer=labels.index(key),
+        ))
+    return samples
+
+
+def load_hellaswag_jsonl(path: str) -> List[ChoiceSample]:
+    """Official HellaSwag jsonl: the context is scored against the four
+    endings (continuation style, length-normalized)."""
+    samples = []
+    for row in _read_jsonl(path):
+        ctx = row.get("ctx") or (row.get("ctx_a", "") + " " + row.get("ctx_b", "")).strip()
+        samples.append(ChoiceSample(
+            question=ctx, choices=list(row["endings"]), answer=int(row["label"]),
+        ))
+    return samples
+
+
+def load_gsm8k_jsonl(path: str) -> List[GenSample]:
+    """Official GSM8K jsonl; the gold answer string keeps its ``#### N``
+    marker — the runner's extractor normalizes both sides."""
+    return [GenSample(question=r["question"], answer=r["answer"])
+            for r in _read_jsonl(path)]
+
+
+#: benchmark name → (loader, runner style). "letter" and "continuation"
+#: build ChoiceTaskRunner; "generation" builds GenerationTaskRunner.
+BENCHMARK_FORMATS: Dict[str, Tuple[Callable[[str], list], str]] = {
+    "mmlu": (load_mmlu_csv, "letter"),
+    "arc": (load_arc_jsonl, "continuation"),
+    "arc_letter": (load_arc_jsonl, "letter"),
+    "hellaswag": (load_hellaswag_jsonl, "continuation"),
+    "gsm8k": (load_gsm8k_jsonl, "generation"),
+}
+
+
+def load_benchmark(name: str, path: str) -> list:
+    """Parse ``path`` with the named benchmark's official format."""
+    try:
+        loader, _ = BENCHMARK_FORMATS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown benchmark {name!r}; known: {sorted(BENCHMARK_FORMATS)}"
+        ) from None
+    return loader(path)
+
+
+def runner_for(
+    name: str,
+    path: str,
+    tokenizer: Callable[[str], List[int]],
+    *,
+    dev_path: Optional[str] = None,
+    n_shot: int = 0,
+    detokenizer: Optional[Callable[[Sequence[int]], str]] = None,
+    **runner_kw,
+):
+    """File → ready runner: ``runner_for("mmlu", csv, tok, n_shot=5).run(
+    model, params)`` is the whole benchmark. Generation benchmarks
+    (gsm8k) additionally need ``detokenizer``."""
+    samples = load_benchmark(name, path)  # friendly unknown-name error
+    loader, style = BENCHMARK_FORMATS[name]
+    dev = loader(dev_path) if dev_path else []
+    task = f"{name}:{os.path.splitext(os.path.basename(path))[0]}"
+    if style == "generation":
+        if detokenizer is None:
+            raise ValueError(f"{name} is a generation benchmark: pass detokenizer=")
+        return GenerationTaskRunner(
+            task, samples, tokenizer, detokenizer,
+            dev_samples=dev, n_shot=n_shot, **runner_kw,
+        )
+    return ChoiceTaskRunner(
+        task, samples, tokenizer,
+        dev_samples=dev, n_shot=n_shot, style=style, **runner_kw,
+    )
+
+
+# ------------------------------------------------------------- LLM-as-judge
+
+DEFAULT_JUDGE_TEMPLATE = (
+    "You are a strict grader. Rate how well the answer addresses the "
+    "question on a scale of 1 (useless) to {top} (excellent).\n\n"
+    "Question: {question}\n"
+    "{reference_block}"
+    "Answer: {answer}\n\n"
+    "Rating:"
+)
+
+
+class LLMJudgeRunner:
+    """Judge-model scoring of generations (≙ ColossalEval's
+    ``evaluate/dataset_evaluator/gpt_judge.py``, where GPT rates each
+    answer against a rubric prompt). Here ANY local model is the judge:
+    the rubric prompt ends in ``Rating:`` and the rating alternatives are
+    scored exactly like a choice benchmark (one forward per batch via the
+    same row scorer), so the judge never free-generates — its rating is
+    the argmax completion log-prob, deterministic and tokenizer-robust.
+
+    ``items``: dicts with ``question`` and ``answer`` (optionally
+    ``reference`` — shown to the judge when present).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        items: Sequence[Dict[str, str]],
+        tokenizer: Callable[[str], List[int]],
+        *,
+        scale: int = 5,
+        template: str = DEFAULT_JUDGE_TEMPLATE,
+        batch_size: int = 8,
+    ):
+        if scale < 2:
+            raise ValueError(f"scale={scale} needs at least ratings 1..2")
+        self.name = name
+        self.items = list(items)
+        self.tok = tokenizer
+        self.scale = scale
+        self.template = template
+        self.batch_size = batch_size
+
+    def _prompt(self, item: Dict[str, str]) -> str:
+        ref = item.get("reference")
+        return self.template.format(
+            question=item["question"], answer=item["answer"], top=self.scale,
+            reference_block=f"Reference answer: {ref}\n" if ref else "",
+        )
+
+    def run(self, model=None, params=None, boosted=None) -> Dict[str, Any]:
+        """Per-item ratings (1..scale) + their mean."""
+        from .eval import _make_row_scorer, _pad_rows
+
+        if not self.items:
+            return {"task": self.name, "mean_rating": 0.0, "ratings": [],
+                    "n": 0, "scale": self.scale}
+        score = _make_row_scorer(model, params, boosted)
+        comps = [self.tok(f" {r}") for r in range(1, self.scale + 1)]
+        # ' 10' is multiple BPE tokens while ' 1' is one: raw summed
+        # log-prob would make longer ratings strictly less likely than
+        # their own prefix. Length-normalize whenever the alternatives
+        # tokenize to different lengths.
+        length_normalize = len({len(c) for c in comps}) > 1
+        ratings: List[int] = []
+
+        def flush(batch):
+            import numpy as np
+
+            if not batch:
+                return
+            ids, mask, meta = _pad_rows(batch)
+            lp = score(ids, mask, length_normalize)
+            at = 0
+            for n_choices, _ in meta:
+                ratings.append(1 + int(np.argmax(lp[at:at + n_choices])))
+                at += n_choices
+
+        batch = []
+        for item in self.items:
+            batch.append((self.tok(self._prompt(item)), comps, 0))
+            if len(batch) >= self.batch_size:
+                flush(batch)
+                batch = []
+        flush(batch)
+        return {
+            "task": self.name,
+            "mean_rating": sum(ratings) / len(ratings),
+            "ratings": ratings,
+            "n": len(ratings),
+            "scale": self.scale,
+        }

@@ -49,6 +49,9 @@ class _Scheduler(threading.Thread):
         #: per-streaming-request token queues + how many tokens were pushed
         self.streams: Dict[int, queue.Queue] = {}
         self._pushed: Dict[int, int] = {}
+        #: rids a /abort cancelled while a waiter was blocked — lets the
+        #: waiter report "aborted" instead of a misleading timeout
+        self._client_aborted: set = set()
         self._wake = threading.Event()
         self._stop = False
 
@@ -70,9 +73,11 @@ class _Scheduler(threading.Thread):
         return (rid, q) if stream else rid
 
     def wait(self, rid: int, timeout: Optional[float] = None):
-        """Block until the request finishes; on timeout the request is
-        aborted so its pages free instead of decoding for a client that
-        already gave up."""
+        """Block until the request resolves: ``(output_ids, "done")``,
+        ``(None, "aborted")`` (a concurrent /abort), or
+        ``(None, "timeout")`` — a timed-out request is aborted so its
+        pages free instead of decoding for a client that already gave
+        up."""
         # .get(): a concurrent abort() may have popped the event already —
         # then the result (None) is immediately decided, no wait needed
         ev = self.events.get(rid)
@@ -82,9 +87,13 @@ class _Scheduler(threading.Thread):
         with self.lock:
             self.events.pop(rid, None)
             out = self.done.pop(rid, None)
-            if not ok and out is None:
+            aborted = rid in self._client_aborted
+            self._client_aborted.discard(rid)
+            if not ok and out is None and not aborted:
                 self.engine.abort(rid)
-        return out
+        if out is not None:
+            return out, "done"
+        return None, ("aborted" if aborted else "timeout")
 
     def abort(self, rid: int) -> bool:
         with self.lock:
@@ -96,7 +105,8 @@ class _Scheduler(threading.Thread):
                 self.done.pop(rid, None)
                 ev = self.events.pop(rid, None)
                 if ev is not None:
-                    ev.set()  # unblock a waiter with done=None
+                    self._client_aborted.add(rid)
+                    ev.set()  # unblock a waiter with (None, "aborted")
                 q = self.streams.pop(rid, None)
                 self._pushed.pop(rid, None)
                 if q is not None:
@@ -208,9 +218,12 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         return
             except queue.Empty:
                 sched.abort(rid)
-                self.wfile.write(
-                    f"data: {json.dumps({'request_id': rid, 'aborted': True})}\n\n".encode()
-                )
+                try:
+                    self.wfile.write(
+                        f"data: {json.dumps({'request_id': rid, 'aborted': True})}\n\n".encode()
+                    )
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # starved AND gone: pages are already freed
             except (BrokenPipeError, ConnectionResetError):
                 sched.abort(rid)  # client went away: free the pages
 
@@ -245,8 +258,10 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     self._stream(rid, q)
                     return
                 rid = sched.submit(req["prompt_ids"], gen)
-                out = sched.wait(rid)
-                if out is None:
+                out, status = sched.wait(rid)
+                if status == "aborted":
+                    self._json(409, {"request_id": rid, "error": "aborted"})
+                elif out is None:
                     self._json(504, {"error": "generation timed out"})
                 else:
                     self._json(200, {"request_id": rid, "output_ids": out})
