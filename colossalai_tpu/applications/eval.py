@@ -138,6 +138,11 @@ class ChoiceTaskRunner:
     ``style="continuation"`` scores each full choice text (HellaSwag
     rule, length-normalized by default). Items are bucketed by padded
     length and scored one forward per batch.
+
+    ``length_normalize``: ``True`` divides by TOKEN count; ``"bytes"``
+    divides by the continuation's UTF-8 byte length — the lm-eval-harness
+    ``acc_norm`` convention, which published leaderboard numbers use
+    (the two disagree on items whose endings differ in tokens-per-byte).
     """
 
     def __init__(
@@ -154,6 +159,11 @@ class ChoiceTaskRunner:
     ):
         if style not in ("letter", "continuation"):
             raise ValueError(f"style={style!r} not in ('letter', 'continuation')")
+        if length_normalize not in (None, True, False, "bytes"):
+            raise ValueError(
+                f"length_normalize={length_normalize!r} not in "
+                "(None, True, False, 'bytes')"
+            )
         if n_shot > len(dev_samples):
             raise ValueError(
                 f"n_shot={n_shot} needs >= that many dev_samples "
@@ -184,15 +194,17 @@ class ChoiceTaskRunner:
         return "".join(self.template(d, include_answer=True) for d in self.dev)
 
     def rows(self):
-        """(prompt_ids, per-choice completion ids, answer) per sample."""
+        """(prompt_ids, per-choice completion ids, answer, byte lengths)
+        per sample."""
         prefix = self._few_shot_prefix()
         for s in self.samples:
             prompt = prefix + self.template(s, include_answer=False)
             if self.style == "letter":
-                comps = [self.tok(f" {LETTERS[i]}") for i in range(len(s.choices))]
+                texts = [f" {LETTERS[i]}" for i in range(len(s.choices))]
             else:
-                comps = [self.tok(" " + c) for c in s.choices]
-            yield self.tok(prompt), comps, s.answer
+                texts = [" " + c for c in s.choices]
+            yield (self.tok(prompt), [self.tok(t) for t in texts], s.answer,
+                   [len(t.encode("utf-8")) for t in texts])
 
     def run(self, model=None, params=None, boosted=None) -> Dict[str, Any]:
         """Accuracy over the samples. Pass ``model, params`` for a raw
@@ -201,13 +213,20 @@ class ChoiceTaskRunner:
         score = _make_row_scorer(model, params, boosted)
         correct = n = 0
         batch: List[tuple] = []
+        blens: List[int] = []  # flattened per-row completion byte lengths
 
         def flush():
             nonlocal correct, n
             if not batch:
                 return
             ids, mask, meta = _pad_rows(batch)
-            lp = score(ids, mask, self.length_normalize)
+            lp = score(ids, mask, self.length_normalize is True)
+            if self.length_normalize == "bytes":
+                # lm-eval acc_norm: raw summed log-prob over UTF-8 byte
+                # length (filler rows beyond the real ones stay untouched
+                # — the meta walk never reads them)
+                lp = np.array(lp, np.float64)
+                lp[:len(blens)] /= np.maximum(np.asarray(blens, np.float64), 1.0)
             at = 0
             for n_choices, answer in meta:
                 pred = int(np.argmax(lp[at:at + n_choices]))
@@ -215,9 +234,11 @@ class ChoiceTaskRunner:
                 n += 1
                 at += n_choices
             batch.clear()
+            blens.clear()
 
-        for prompt_ids, comps, answer in self.rows():
+        for prompt_ids, comps, answer, bl in self.rows():
             batch.append((prompt_ids, comps, answer))
+            blens.extend(bl)
             if len(batch) >= self.batch_size:
                 flush()
         flush()

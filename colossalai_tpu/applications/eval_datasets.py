@@ -51,16 +51,25 @@ def load_mmlu_csv(path: str) -> List[ChoiceSample]:
     """One MMLU subject csv (headerless: question, A, B, C, D, answer)."""
     samples = []
     with open(path, newline="", encoding="utf-8") as f:
-        for row in csv.reader(f):
-            if len(row) < 6:
+        for i, row in enumerate(csv.reader(f)):
+            # exactly 6: a 7-field row means an unquoted comma shifted the
+            # columns, and silently truncating would grade a choice text
+            # as the gold answer
+            if len(row) != 6:
                 raise ValueError(
-                    f"{path}: MMLU rows have 6 columns "
+                    f"{path} row {i + 1}: MMLU rows have exactly 6 columns "
                     f"(question, A, B, C, D, answer); got {len(row)}"
                 )
-            *qc, answer = row[:6]
+            *qc, answer = row
+            answer = answer.strip().upper()
+            if answer not in LETTERS[:4]:
+                raise ValueError(
+                    f"{path} row {i + 1}: answer column must be A-D, "
+                    f"got {answer!r}"
+                )
             samples.append(ChoiceSample(
                 question=qc[0], choices=list(qc[1:5]),
-                answer=LETTERS.index(answer.strip().upper()),
+                answer=LETTERS.index(answer),
             ))
     return samples
 

@@ -137,6 +137,10 @@ class HybridParallelPlugin(Plugin):
     #: from auto_parallel.search_param_shardings (≙ the reference solver's
     #: per-op strategy output feeding the sharder)
     param_spec_overrides: Optional[dict] = None
+    #: measured/calibrated ScheduleCosts for pp_schedule="auto" (e.g. from
+    #: pipeline.schedule_sim.calibrate_costs on this host's wall-clock
+    #: rows); None = the ideal-chip defaults
+    pp_costs: Optional[object] = None
 
     PP_SCHEDULES = ("1f1b", "interleaved", "zb", "gpipe", "auto")
 
@@ -222,7 +226,8 @@ class HybridParallelPlugin(Plugin):
             if self.pp_size > 1 and self._resolved_microbatches:
                 from colossalai_tpu.pipeline.schedule_sim import choose_schedule
 
-                best = choose_schedule(self.pp_size, self._resolved_microbatches)
+                best = choose_schedule(self.pp_size, self._resolved_microbatches,
+                                       costs=self.pp_costs)
                 name = {"one_f_one_b": "1f1b"}.get(best.schedule, best.schedule)
                 self._resolved_schedule, self._resolved_chunks = name, best.chunks
             else:

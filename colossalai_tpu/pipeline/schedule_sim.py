@@ -47,6 +47,18 @@ class ScheduleCosts:
     #: weight-grad work deferred by split_dw (part of t_b when fused)
     t_w: float = 1.0
     t_comm: float = 0.05
+    #: fixed PER-OP dispatch/fusion-loss overhead, NOT divided by chunks —
+    #: the term that ranks schedules on overhead-bound hosts (zb runs 3
+    #: ops per microbatch-stage vs 1f1b's 2; interleaved doubles the op
+    #: count per unit of work). 0 models an ideal chip; calibrate_costs
+    #: fits it from measured wall-clock rows.
+    t_overhead: float = 0.0
+    #: extra work the SPLIT backward pays over the fused one (zb only):
+    #: under remat the fused backward recomputes the forward once and
+    #: shares it between dX and dW; splitting defuses that sharing, so Bw
+    #: re-pays recompute/fusion work. 0 models perfect sharing (an ideal
+    #: split); calibrate_costs fits the real defusion cost.
+    t_split: float = 0.0
 
 
 @dataclasses.dataclass
@@ -82,10 +94,12 @@ def simulate(
     split_dw = schedule == "zb"
     v = pp * chunks
     # costs are per PHYSICAL stage pass at chunks=1; a virtual stage runs
-    # 1/chunks of the stage's layers
-    t_f = costs.t_f / chunks
-    t_w = costs.t_w / chunks
-    t_b_fused = (costs.t_b if split_dw else costs.t_b + costs.t_w) / chunks
+    # 1/chunks of the stage's layers. The per-op overhead is NOT divided:
+    # splitting the same work into more ops pays it more often.
+    t_o = costs.t_overhead
+    t_f = costs.t_f / chunks + t_o
+    t_w = (costs.t_w + (costs.t_split if split_dw else 0.0)) / chunks + t_o
+    t_b_fused = (costs.t_b if split_dw else costs.t_b + costs.t_w) / chunks + t_o
 
     # op table: deps + durations ------------------------------------------
     ops: Dict[Tuple[str, int, int], float] = {}
@@ -185,8 +199,81 @@ def choose_schedule(
     costs: Optional[ScheduleCosts] = None,
     max_chunks: int = 2,
 ) -> ScheduleReport:
-    """Best schedule family for the config (used by pp_schedule='auto')."""
-    return compare(
+    """Best schedule family for the config (used by pp_schedule='auto').
+
+    Near-ties (within 10% makespan) break toward the LOWER activation
+    stash: gpipe and 1f1b run the same ops, so they land within the cost
+    model's own fit error of each other — but gpipe holds every
+    microbatch's activations at once, which is the reason 1F1B exists.
+    A <10% predicted win is inside calibration noise (calibrate_costs
+    fits measured rows to ~5-20%); doubling the stash for it is never
+    the right trade.
+    """
+    reports = compare(
         pp, n_micro, costs or ScheduleCosts(),
         chunk_options=tuple(range(2, max_chunks + 1)),
-    )[0]
+    )
+    cutoff = reports[0].makespan * 1.10
+    near = [r for r in reports if r.makespan <= cutoff]
+    return min(near, key=lambda r: (r.peak_inflight, r.makespan))
+
+
+def calibrate_costs(
+    measured: Dict[Tuple[str, int, int], float],
+    pp: int,
+    *,
+    ratios: Tuple[float, float] = (2.0, 1.0),
+) -> ScheduleCosts:
+    """Fit ScheduleCosts to measured wall-clock rows so ``choose_schedule``
+    ranks correctly on THIS host (the docs/pipeline_schedules.md promise:
+    the op-overhead/t_comm terms "can be calibrated" from the measured
+    table — this is that fit).
+
+    ``measured``: ``{(schedule, chunks, n_micro): seconds}`` from warm
+    steps (schedule names as ``simulate`` spells them). ``ratios`` pins
+    (t_b, t_w) as multiples of t_f — the repo's recompute-interleaved
+    backward convention — leaving four free parameters: the time unit
+    (t_f seconds), the per-op overhead, the comm cost, and the split
+    defusion cost. The overhead/comm/split GRIDS are searched in units of
+    t_f; the time unit then has a closed-form least-squares solution per
+    grid point (makespans scale linearly with the unit); sims memoize per
+    distinct (row, relevant-params) key — non-zb rows ignore the split
+    grid — so the fit costs a few hundred event-driven sims.
+    """
+    if not measured:
+        raise ValueError("calibrate_costs needs at least one measured row")
+    rows = list(measured.items())
+    t_b_r, t_w_r = ratios
+    has_zb = any(sched == "zb" for (sched, _, _) in measured)
+    # memoize: non-zb rows don't depend on t_s, so the grid would re-run
+    # them identically for every t_s value
+    memo: Dict[Tuple, float] = {}
+
+    def _sim(sched, chunks, m, t_o, t_c, t_s):
+        key = (sched, chunks, m, t_o, t_c, t_s if sched == "zb" else 0.0)
+        if key not in memo:
+            memo[key] = simulate(
+                pp, m, sched, chunks,
+                ScheduleCosts(1.0, t_b_r, t_w_r, t_c, t_o, t_s),
+            ).makespan
+        return memo[key]
+
+    best = None
+    for t_o in (0.0, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0):
+        for t_c in (0.0, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0):
+            for t_s in ((0.0, 0.5, 1.0, 2.0, 4.0, 8.0) if has_zb else (0.0,)):
+                sims = [
+                    _sim(sched, chunks, m, t_o, t_c, t_s)
+                    for (sched, chunks, m), _ in rows
+                ]
+                num = sum(s * t for s, (_, t) in zip(sims, rows))
+                den = sum(s * s for s in sims)
+                unit = num / den if den else 0.0
+                err = sum((t - unit * s) ** 2 for s, (_, t) in zip(sims, rows))
+                if best is None or err < best[0]:
+                    best = (err, unit, t_o, t_c, t_s)
+    _, unit, t_o, t_c, t_s = best
+    return ScheduleCosts(
+        t_f=unit, t_b=t_b_r * unit, t_w=t_w_r * unit,
+        t_comm=t_c * unit, t_overhead=t_o * unit, t_split=t_s * unit,
+    )

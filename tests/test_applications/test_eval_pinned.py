@@ -1,11 +1,13 @@
 """Pin the eval harness to a PUBLISHED benchmark number (VERDICT r04 #4:
 "the reference's eval exists precisely to reproduce published numbers").
 
-gpt2-small on HellaSwag validation, continuation style with length
-normalization — the lm-eval-harness ``acc_norm`` convention — is
-published at ~0.311 (EleutherAI lm-eval v0.4 reports 0.3114). The test
-scores a 500-item slice and asserts the published value within sampling
-tolerance (binomial std at n=500 is ~0.021; ±0.05 is ~2.4 sigma).
+gpt2-small on HellaSwag validation, continuation style with BYTE-length
+normalization — lm-eval-harness ``acc_norm`` divides the summed log-prob
+by the continuation's UTF-8 byte length (NOT token count; the two
+metrics disagree where endings differ in tokens-per-byte) — is published
+at ~0.311 (EleutherAI lm-eval v0.4 reports 0.3114). The test scores a
+500-item slice and asserts the published value within sampling tolerance
+(binomial std at n=500 is ~0.021; ±0.05 is ~2.4 sigma).
 
 Guards (zero-egress hosts skip; populate to opt in):
 - gpt2 weights + tokenizer in the LOCAL HF cache (never the network);
@@ -64,6 +66,7 @@ def test_gpt2_hellaswag_pinned_slice():
     assert len(samples) == SLICE, "validation set should exceed the slice"
     runner = ChoiceTaskRunner(
         "hellaswag:gpt2-pin", samples, tok.encode, style="continuation",
+        length_normalize="bytes",  # = the acc_norm convention being pinned
     )
     out = runner.run(GPT2LMHeadModel(cfg), {"params": params})
     assert out["n"] == SLICE

@@ -49,7 +49,8 @@ def test_mmlu_prompt_template():
 def test_few_shot_prefix_composes():
     r = ChoiceTaskRunner("mmlu", SAMPLES[:1], tok, dev_samples=SAMPLES[1:],
                          n_shot=2)
-    prompt_ids, comps, answer = next(iter(r.rows()))
+    prompt_ids, comps, answer, blens = next(iter(r.rows()))
+    assert blens == [2, 2, 2, 2]  # " A".." D" are two bytes each
     text = detok(prompt_ids)
     # both dev items appear WITH answers, the test item without
     assert text.count("Answer:") == 3
@@ -234,3 +235,35 @@ def test_rouge_keeps_articles_and_metrics_accepts_bare_string():
     assert rouge_l("the cat", "the cat") == 1.0
     r = GenerationTaskRunner("x", [], tok, detok, metrics="token_f1")
     assert r.metrics == ("token_f1",)
+
+
+def test_byte_normalization_differs_from_token_normalization():
+    """length_normalize="bytes" is the lm-eval acc_norm rule (summed
+    log-prob over UTF-8 byte length) — with a uniform model every token
+    costs -log V, so token normalization ties all choices while byte
+    normalization prefers fewer tokens PER BYTE; the two modes must be
+    able to disagree."""
+    from types import SimpleNamespace
+
+    V = 32
+
+    class Uniform:
+        def apply(self, variables, ids):
+            return SimpleNamespace(logits=jnp.zeros(ids.shape + (V,)))
+
+    # choice 0: 3 tokens / 2 bytes; choice 1: 1 token / 4 bytes
+    vocab = {" x": [2, 3, 4], " abc": [5]}
+
+    def tok(s):
+        return vocab.get(s, [1] * max(len(s) // 4, 1))
+
+    sample = ChoiceSample(question="pick", choices=["x", "abc"], answer=1)
+    by_tok = ChoiceTaskRunner("t", [sample], tok, style="continuation",
+                              length_normalize=True)
+    by_bytes = ChoiceTaskRunner("b", [sample], tok, style="continuation",
+                                length_normalize="bytes")
+    params = {"params": {}}
+    # token-norm: both choices score -log V -> tie -> argmax = choice 0
+    assert by_tok.run(Uniform(), params)["accuracy"] == 0.0
+    # byte-norm: -3logV/2 vs -logV/4 -> choice 1 wins
+    assert by_bytes.run(Uniform(), params)["accuracy"] == 1.0
