@@ -1,0 +1,286 @@
+"""RLHF data tooling: chat templates + conversation/preference loaders.
+
+≙ reference ``applications/ColossalChat/coati/dataset/`` —
+``conversation.py`` (Conversation template with per-turn assistant-span
+tracking), ``tokenization_utils.py`` (supervise_tokenize_sft /
+tokenize_rlhf: loss masks over assistant turns only), ``loader.py``
+(jsonl dataset classes). TPU redesign: everything lands in STATIC-shape
+numpy batches (``pad_to``) that the compiled train steps consume without
+retracing — the coati collators' dynamic padding would recompile per
+batch under XLA.
+
+The three batch builders target the trainer contracts in ``rlhf.py``:
+``sft_batch`` → {input_ids, loss_mask}; ``dpo_batch`` → the
+[chosen; rejected] batch-dim concatenation with row i / row B+i pairing
+the losses expect; ``ppo_prompt_ids`` → token prompts (with the
+generation prompt appended) for ``EngineRollout.generate``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .eval_datasets import _read_jsonl  # one jsonl reader per package
+
+#: a conversation is a list of {"role": "...", "content": "..."} dicts
+Message = Dict[str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChatTemplate:
+    """Declarative chat template (≙ coati Conversation.from_config: the
+    jinja chat_template + end_of_assistant pair). Each turn renders as
+    ``prefix + content + suffix``; ONLY assistant-turn content+suffix is
+    supervised (the loss-mask rule of supervise_tokenize_sft)."""
+
+    system_prefix: str = ""
+    system_suffix: str = "\n"
+    user_prefix: str = "User: "
+    user_suffix: str = "\n"
+    assistant_prefix: str = "Assistant: "
+    assistant_suffix: str = "\n"
+    system_message: str = ""
+    #: text that cues the assistant's reply (generation prompt)
+    generation_prefix: Optional[str] = None
+
+    # ----------------------------------------------------------- presets
+    @classmethod
+    def chatml(cls, system_message: str = "") -> "ChatTemplate":
+        """The ChatML layout (qwen/yi-style chat checkpoints)."""
+        return cls(
+            system_prefix="<|im_start|>system\n",
+            system_suffix="<|im_end|>\n",
+            user_prefix="<|im_start|>user\n",
+            user_suffix="<|im_end|>\n",
+            assistant_prefix="<|im_start|>assistant\n",
+            assistant_suffix="<|im_end|>\n",
+            system_message=system_message,
+        )
+
+    @classmethod
+    def llama3(cls, system_message: str = "") -> "ChatTemplate":
+        return cls(
+            system_prefix="<|start_header_id|>system<|end_header_id|>\n\n",
+            system_suffix="<|eot_id|>",
+            user_prefix="<|start_header_id|>user<|end_header_id|>\n\n",
+            user_suffix="<|eot_id|>",
+            assistant_prefix="<|start_header_id|>assistant<|end_header_id|>\n\n",
+            assistant_suffix="<|eot_id|>",
+            system_message=system_message,
+        )
+
+    @classmethod
+    def plain(cls) -> "ChatTemplate":
+        """Bare User:/Assistant: lines — for base models in tests/demos."""
+        return cls()
+
+    # ---------------------------------------------------------- rendering
+    def _segments(
+        self, messages: Sequence[Message], add_generation_prompt: bool,
+    ) -> List[Tuple[str, bool]]:
+        """(text, supervised) segments in order. Supervised = the span a
+        loss mask should cover (assistant content + its suffix, which
+        teaches the model to STOP)."""
+        segs: List[Tuple[str, bool]] = []
+        if self.system_message:
+            segs.append(
+                (self.system_prefix + self.system_message + self.system_suffix,
+                 False)
+            )
+        for m in messages:
+            role, content = m["role"], m["content"]
+            if role == "system":
+                segs.append(
+                    (self.system_prefix + content + self.system_suffix, False)
+                )
+            elif role == "user":
+                segs.append((self.user_prefix + content + self.user_suffix, False))
+            elif role == "assistant":
+                segs.append((self.assistant_prefix, False))
+                segs.append((content + self.assistant_suffix, True))
+            else:
+                raise ValueError(f"unknown role {role!r}")
+        if add_generation_prompt:
+            segs.append(
+                (self.generation_prefix
+                 if self.generation_prefix is not None
+                 else self.assistant_prefix, False)
+            )
+        return segs
+
+    def render(self, messages: Sequence[Message],
+               add_generation_prompt: bool = False) -> str:
+        return "".join(
+            t for t, _ in self._segments(messages, add_generation_prompt)
+        )
+
+    def encode_with_mask(
+        self, messages: Sequence[Message], tokenizer: Callable[[str], List[int]],
+    ) -> Tuple[List[int], List[int]]:
+        """(ids, mask): mask is 1 exactly on assistant-reply tokens
+        (content + stop suffix). Each segment tokenizes separately so the
+        mask boundary is exact — the coati approach of tracking assistant
+        spans, without offset bookkeeping."""
+        ids: List[int] = []
+        mask: List[int] = []
+        for text, supervised in self._segments(messages, False):
+            seg = tokenizer(text)
+            ids.extend(seg)
+            mask.extend([int(supervised)] * len(seg))
+        return ids, mask
+
+
+# ---------------------------------------------------------------- loaders
+
+
+_SHAREGPT_ROLES = {"human": "user", "user": "user", "gpt": "assistant",
+                   "assistant": "assistant", "system": "system"}
+
+
+def _as_messages(row: dict) -> List[Message]:
+    """Normalize the two common conversation layouts to role/content:
+    {"messages": [{"role", "content"}]} (OpenAI) and
+    {"conversations": [{"from", "value"}]} (ShareGPT)."""
+    if "messages" in row:
+        return [
+            {"role": m["role"], "content": m["content"]}
+            for m in row["messages"]
+        ]
+    if "conversations" in row:
+        return [
+            {"role": _SHAREGPT_ROLES[m["from"]], "content": m["value"]}
+            for m in row["conversations"]
+        ]
+    if "prompt" in row:  # prompt-only shorthand
+        return [{"role": "user", "content": row["prompt"]}]
+    raise ValueError(
+        f"row has none of 'messages'/'conversations'/'prompt': {sorted(row)}"
+    )
+
+
+def load_conversations_jsonl(path: str) -> List[List[Message]]:
+    """SFT conversations (≙ coati SFT jsonl): OpenAI ``messages`` or
+    ShareGPT ``conversations`` rows → role/content message lists."""
+    return [_as_messages(r) for r in _read_jsonl(path)]
+
+
+@dataclasses.dataclass(frozen=True)
+class PreferenceSample:
+    """One pairwise preference row (DPO/RM/KTO-style)."""
+
+    prompt: List[Message]
+    chosen: str
+    rejected: str
+
+
+def load_preference_jsonl(path: str) -> List[PreferenceSample]:
+    """Pairwise preference rows (≙ coati preference jsonl): ``chosen`` /
+    ``rejected`` strings next to a ``prompt`` string or ``messages``
+    context."""
+    out = []
+    for r in _read_jsonl(path):
+        if "chosen" not in r or "rejected" not in r:
+            raise ValueError(f"preference row needs chosen+rejected: {sorted(r)}")
+        chosen, rejected = r["chosen"], r["rejected"]
+        # chosen/rejected may be message lists (take the assistant text)
+        if isinstance(chosen, list):
+            chosen = chosen[-1]["content"]
+        if isinstance(rejected, list):
+            rejected = rejected[-1]["content"]
+        ctx = {k: v for k, v in r.items() if k not in ("chosen", "rejected")}
+        out.append(PreferenceSample(
+            prompt=_as_messages(ctx), chosen=chosen, rejected=rejected,
+        ))
+    return out
+
+
+def load_prompts_jsonl(path: str) -> List[List[Message]]:
+    """Prompt-only rows for on-policy rollouts (PPO/GRPO)."""
+    return [_as_messages(r) for r in _read_jsonl(path)]
+
+
+# ---------------------------------------------------------- batch builders
+
+
+def _pad_rows(rows: List[Tuple[List[int], List[int]]], pad_to: int):
+    """Right-pad (truncating the FRONT of over-long conversations so the
+    supervised tail survives, the coati truncation direction)."""
+    ids = np.zeros((len(rows), pad_to), np.int32)
+    mask = np.zeros((len(rows), pad_to), np.float32)
+    for i, (r_ids, r_mask) in enumerate(rows):
+        if len(r_ids) > pad_to:
+            r_ids, r_mask = r_ids[-pad_to:], r_mask[-pad_to:]
+        ids[i, : len(r_ids)] = r_ids
+        mask[i, : len(r_mask)] = r_mask
+    return ids, mask
+
+
+def sft_batch(
+    conversations: Sequence[Sequence[Message]],
+    template: ChatTemplate,
+    tokenizer: Callable[[str], List[int]],
+    pad_to: int,
+) -> Dict[str, np.ndarray]:
+    """Static-shape SFT batch: loss only on assistant tokens
+    (≙ supervise_tokenize_sft)."""
+    rows = [template.encode_with_mask(c, tokenizer) for c in conversations]
+    ids, mask = _pad_rows(rows, pad_to)
+    return {"input_ids": ids, "loss_mask": mask}
+
+
+def dpo_batch(
+    pairs: Sequence[PreferenceSample],
+    template: ChatTemplate,
+    tokenizer: Callable[[str], List[int]],
+    pad_to: int,
+) -> Dict[str, np.ndarray]:
+    """[chosen; rejected] concatenated on the batch dim — row i and row
+    B+i are one pair, the layout ``make_dpo_loss`` / ``make_reward_loss``
+    score in a single forward. Also returns per-row ``lengths`` (the
+    RewardModel pooling input).
+
+    Over-long pairs truncate the shared prompt by the PAIR's max
+    overflow, so both halves keep identical conditioning context — the
+    implicit reward must never contrast completions against different
+    prompts (independent per-row truncation would bias toward the
+    shorter reply)."""
+    chosen_rows, rejected_rows = [], []
+    for p in pairs:
+        rows = {}
+        for half in ("chosen", "rejected"):
+            msgs = list(p.prompt) + [
+                {"role": "assistant", "content": getattr(p, half)}
+            ]
+            rows[half] = template.encode_with_mask(msgs, tokenizer)
+        overflow = max(
+            0, max(len(rows["chosen"][0]), len(rows["rejected"][0])) - pad_to
+        )
+        for half, dest in (("chosen", chosen_rows), ("rejected", rejected_rows)):
+            r_ids, r_mask = rows[half]
+            dest.append((r_ids[overflow:], r_mask[overflow:]))
+    rows = chosen_rows + rejected_rows
+    ids, mask = _pad_rows(rows, pad_to)
+    lengths = np.asarray(
+        [min(len(r), pad_to) for r, _ in rows], np.int32
+    )
+    return {"input_ids": ids, "loss_mask": mask, "lengths": lengths}
+
+
+def ppo_prompt_ids(
+    prompts: Sequence[Sequence[Message]],
+    template: ChatTemplate,
+    tokenizer: Callable[[str], List[int]],
+    max_prompt_len: Optional[int] = None,
+) -> List[List[int]]:
+    """Token prompts with the generation prompt appended — the input
+    ``EngineRollout.generate`` / ``PPOTrainer.rollout_step`` take."""
+    out = []
+    for msgs in prompts:
+        ids = tokenizer(template.render(msgs, add_generation_prompt=True))
+        if max_prompt_len is not None and len(ids) > max_prompt_len:
+            ids = ids[-max_prompt_len:]
+        out.append(ids)
+    return out

@@ -1,0 +1,207 @@
+"""RLHF data tooling (≙ coati/dataset): chat templates with exact
+assistant-span loss masks, conversation/preference/prompt loaders, and
+static-shape batch builders feeding the SFT/DPO/PPO trainers."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.applications import (
+    ChatTemplate,
+    PreferenceSample,
+    dpo_batch,
+    load_conversations_jsonl,
+    load_preference_jsonl,
+    load_prompts_jsonl,
+    make_dpo_loss,
+    make_sft_loss,
+    ppo_prompt_ids,
+    sft_batch,
+)
+from colossalai_tpu.booster import Booster, DataParallelPlugin
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def tok(s):
+    return [ord(c) % 250 + 2 for c in s]
+
+
+CONV = [
+    {"role": "user", "content": "Hi"},
+    {"role": "assistant", "content": "Hello!"},
+    {"role": "user", "content": "Bye"},
+    {"role": "assistant", "content": "See you."},
+]
+
+
+def test_chatml_render_and_generation_prompt():
+    t = ChatTemplate.chatml(system_message="Be kind.")
+    text = t.render(CONV[:2])
+    assert text == (
+        "<|im_start|>system\nBe kind.<|im_end|>\n"
+        "<|im_start|>user\nHi<|im_end|>\n"
+        "<|im_start|>assistant\nHello!<|im_end|>\n"
+    )
+    gen = t.render(CONV[:1], add_generation_prompt=True)
+    assert gen.endswith("<|im_start|>assistant\n")
+
+
+def test_mask_covers_exactly_assistant_spans():
+    t = ChatTemplate.plain()
+    ids, mask = t.encode_with_mask(CONV, tok)
+    assert len(ids) == len(mask)
+    # reconstruct the supervised text from masked positions: precisely the
+    # assistant replies + their stop suffixes, nothing else
+    want = "Hello!\nSee you.\n"
+    got = "".join(chr((i - 2) % 250) for i, m in zip(ids, mask) if m)
+    unsup = "".join(chr((i - 2) % 250) for i, m in zip(ids, mask) if not m)
+    assert got == want, (got, want)
+    assert "Hello" not in unsup and "User: Hi" in unsup
+    # role prefixes (including the assistant's own header) are unsupervised
+    assert "Assistant: " in unsup
+
+
+def test_loaders_both_layouts(tmp_path):
+    rows = [
+        {"messages": CONV[:2]},
+        {"conversations": [{"from": "human", "value": "Q"},
+                           {"from": "gpt", "value": "A"}]},
+        {"prompt": "solo"},
+    ]
+    p = tmp_path / "conv.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    convs = load_conversations_jsonl(str(p))
+    assert convs[0] == CONV[:2]
+    assert convs[1] == [{"role": "user", "content": "Q"},
+                        {"role": "assistant", "content": "A"}]
+    assert convs[2] == [{"role": "user", "content": "solo"}]
+
+    prefs = [
+        {"prompt": "2+2?", "chosen": "4", "rejected": "5"},
+        {"messages": CONV[:1], "chosen": [{"role": "assistant", "content": "ok"}],
+         "rejected": [{"role": "assistant", "content": "no"}]},
+    ]
+    pp = tmp_path / "pref.jsonl"
+    pp.write_text("\n".join(json.dumps(r) for r in prefs))
+    loaded = load_preference_jsonl(str(pp))
+    assert loaded[0].chosen == "4" and loaded[0].rejected == "5"
+    assert loaded[0].prompt == [{"role": "user", "content": "2+2?"}]
+    assert loaded[1].chosen == "ok" and loaded[1].prompt == CONV[:1]
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"prompt": "x", "chosen": "y"}))
+    with pytest.raises(ValueError, match="chosen\\+rejected"):
+        load_preference_jsonl(str(bad))
+
+    pr = tmp_path / "prompts.jsonl"
+    pr.write_text(json.dumps({"prompt": "go"}))
+    assert load_prompts_jsonl(str(pr)) == [[{"role": "user", "content": "go"}]]
+
+
+def test_sft_batch_shapes_and_front_truncation():
+    t = ChatTemplate.plain()
+    batch = sft_batch([CONV, CONV[:2]], t, tok, pad_to=64)
+    assert batch["input_ids"].shape == (2, 64)
+    assert batch["loss_mask"].shape == (2, 64)
+    assert batch["loss_mask"].sum() > 0
+    # over-long conversations keep their TAIL (the supervised turns)
+    tight = sft_batch([CONV], t, tok, pad_to=12)
+    ids, mask = t.encode_with_mask(CONV, tok)
+    np.testing.assert_array_equal(tight["input_ids"][0], ids[-12:])
+    np.testing.assert_array_equal(tight["loss_mask"][0], mask[-12:])
+
+
+def test_dpo_batch_pairs_and_feeds_loss():
+    t = ChatTemplate.plain()
+    pairs = [
+        PreferenceSample([{"role": "user", "content": "2+2?"}], "4", "banana"),
+        PreferenceSample([{"role": "user", "content": "color?"}], "blue", "4"),
+    ]
+    batch = dpo_batch(pairs, t, tok, pad_to=32)
+    b = len(pairs)
+    assert batch["input_ids"].shape == (2 * b, 32)
+    assert batch["lengths"].shape == (2 * b,)
+    # row i and row B+i share the prompt and differ in the completion
+    prompt_len = len(tok("User: 2+2?\nAssistant: "))
+    np.testing.assert_array_equal(
+        batch["input_ids"][0, :prompt_len], batch["input_ids"][b, :prompt_len]
+    )
+    assert list(batch["input_ids"][0]) != list(batch["input_ids"][b])
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    out = model.apply(params, jnp.asarray(batch["input_ids"]))
+    loss = make_dpo_loss()(out, {
+        "input_ids": jnp.asarray(batch["input_ids"]),
+        "loss_mask": jnp.asarray(batch["loss_mask"]),
+        "ref_logp": jnp.zeros((2 * b,), jnp.float32),
+    })
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.slow
+def test_sft_from_files_end_to_end(tmp_path):
+    """jsonl → sft_batch → boosted SFT train steps: loss decreases and
+    only assistant tokens carry loss."""
+    rows = [{"messages": CONV}, {"messages": CONV[:2]},
+            {"conversations": [{"from": "human", "value": "Count"},
+                               {"from": "gpt", "value": "1 2 3"}]},
+            {"messages": CONV[2:]}] * 2  # 8 rows: divisible by the dp mesh
+    p = tmp_path / "sft.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    convs = load_conversations_jsonl(str(p))
+    batch = sft_batch(convs, ChatTemplate.plain(), tok, pad_to=64)
+    jb = {k: jnp.asarray(v) for k, v in batch.items()}
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    boosted = Booster(plugin=DataParallelPlugin(precision="fp32")).boost(
+        LlamaForCausalLM(cfg), optax.adamw(5e-3), loss_fn=make_sft_loss(),
+        example_batch=jb, rng=jax.random.PRNGKey(0),
+    )
+    state = boosted.state
+    losses = []
+    for _ in range(4):
+        state, m = boosted.train_step(state, boosted.shard_batch(jb))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ppo_prompt_ids_generation_prompt_and_cap():
+    t = ChatTemplate.plain()
+    prompts = [[{"role": "user", "content": "Say hi"}]]
+    ids = ppo_prompt_ids(prompts, t, tok)
+    assert ids[0] == tok("User: Say hi\nAssistant: ")
+    capped = ppo_prompt_ids(prompts, t, tok, max_prompt_len=5)
+    assert capped[0] == tok("User: Say hi\nAssistant: ")[-5:]
+
+
+def test_dpo_batch_pair_truncation_keeps_shared_context():
+    """Over-long pairs drop the SAME prompt prefix from both halves, so
+    the implicit reward always contrasts completions under identical
+    conditioning (independent truncation would bias toward shorter
+    replies)."""
+    t = ChatTemplate.plain()
+    long_prompt = [{"role": "user", "content": "x" * 20}]
+    pair = PreferenceSample(long_prompt, "a" * 12, "b")
+    pad_to = 32
+    batch = dpo_batch([pair], t, tok, pad_to=pad_to)
+    chosen, rejected = batch["input_ids"][0], batch["input_ids"][1]
+    # shared context = everything before the replies diverge; both rows
+    # must start with the SAME truncated prompt tokens
+    full_c, _ = t.encode_with_mask(
+        long_prompt + [{"role": "assistant", "content": "a" * 12}], tok)
+    full_r, _ = t.encode_with_mask(
+        long_prompt + [{"role": "assistant", "content": "b"}], tok)
+    overflow = max(len(full_c), len(full_r)) - pad_to
+    assert overflow > 0  # the case under test really overflows
+    prompt_len = len(tok("User: " + "x" * 20 + "\nAssistant: ")) - overflow
+    np.testing.assert_array_equal(chosen[:prompt_len], rejected[:prompt_len])
+    np.testing.assert_array_equal(chosen[:len(full_c) - overflow],
+                                  full_c[overflow:])
+    np.testing.assert_array_equal(rejected[:len(full_r) - overflow],
+                                  full_r[overflow:])
