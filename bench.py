@@ -137,6 +137,52 @@ def measure(cfg, bs: int, seq: int, n_dev: int, steps: int):
     }
 
 
+def measure_flash_kernels(b: int = 2, s: int = 4096, h: int = 16,
+                          hkv: int = 4, d: int = 128, iters: int = 8):
+    """Flash-attention kernel TF/s, forward and backward, at a GQA shape
+    (group=4 exercises the in-kernel dk/dv group accumulation). Causal
+    flops convention: half the s x s matrix is actually issued."""
+    import jax
+    import jax.numpy as jnp
+
+    from colossalai_tpu.kernel.pallas.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.bfloat16)
+
+    fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    loss = lambda q, k, v: flash_attention(q, k, v, causal=True).astype(
+        jnp.float32).sum()
+    bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def time_fn(fn):
+        out = fn(q, k, v)  # compile + warm
+        float(jax.tree.leaves(out)[0].sum())  # scalar fetch = reliable sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        float(jax.tree.leaves(out)[0].sum())
+        return (time.perf_counter() - t0) / iters
+
+    # causal fwd: 2 matmuls over half the s^2 tiles = 2 * bhs^2d flops.
+    # jax.grad RE-RUNS the forward (the custom_vjp fwd rule recomputes
+    # out/lse residuals), so the grad timing covers fwd + dq + dkv; the
+    # bwd kernels' own time is the difference, credited their ~2.5x-fwd
+    # flops (dq: 2 matmuls, dkv: 3).
+    fwd_flops = 2.0 * b * h * s * s * d
+    t_fwd = time_fn(fwd)
+    t_grad = time_fn(bwd)
+    t_bwd = t_grad - t_fwd
+    if t_bwd <= 0.05 * t_grad:  # subtraction noise swamped the signal
+        t_bwd = t_grad / 1.8  # fall back to the 2.5/4.5 flop split
+    return {
+        "flash_fwd_tflops": round(fwd_flops / t_fwd / 1e12, 1),
+        "flash_bwd_tflops": round(2.5 * fwd_flops / t_bwd / 1e12, 1),
+    }
+
+
 def measure_decode(cfg, bs: int = 8, prompt_len: int = 128, steps: int = 24):
     """Paged-engine decode throughput (tokens/s across the running batch)."""
     import jax
@@ -326,6 +372,10 @@ def child_main():
             extras["decode_tokens_per_s_bs8"] = measure_decode(model_for(hbm, 1024))
         except Exception as e:
             print(f"decode bench failed: {e}", file=sys.stderr)
+        try:
+            extras.update(measure_flash_kernels())
+        except Exception as e:
+            print(f"flash kernel bench failed: {e}", file=sys.stderr)
         try:
             extras["moe_tokens_per_s_per_device"] = measure_moe(n_dev, steps=4)
         except Exception as e:

@@ -150,10 +150,17 @@ def _as_messages(row: dict) -> List[Message]:
             for m in row["messages"]
         ]
     if "conversations" in row:
-        return [
-            {"role": _SHAREGPT_ROLES[m["from"]], "content": m["value"]}
-            for m in row["conversations"]
-        ]
+        msgs = []
+        for m in row["conversations"]:
+            role = _SHAREGPT_ROLES.get(m["from"])
+            if role is None:
+                raise ValueError(
+                    f"unsupported ShareGPT role {m['from']!r} (known: "
+                    f"{sorted(_SHAREGPT_ROLES)}); filter tool/function "
+                    "turns before loading"
+                )
+            msgs.append({"role": role, "content": m["value"]})
+        return msgs
     if "prompt" in row:  # prompt-only shorthand
         return [{"role": "user", "content": row["prompt"]}]
     raise ValueError(
@@ -258,6 +265,24 @@ def dpo_batch(
         overflow = max(
             0, max(len(rows["chosen"][0]), len(rows["rejected"][0])) - pad_to
         )
+        if overflow:
+            # truncation may only eat the SHARED prefix (prompt + assistant
+            # header): past it the halves diverge, and dropping reply
+            # tokens — or emptying the shorter half — would corrupt the
+            # contrast silently
+            c_ids, r_ids = rows["chosen"][0], rows["rejected"][0]
+            shared = 0
+            for a, b in zip(c_ids, r_ids):
+                if a != b:
+                    break
+                shared += 1
+            if overflow > shared:
+                raise ValueError(
+                    f"preference pair needs {overflow} tokens truncated but "
+                    f"only {shared} shared prompt tokens exist — the longer "
+                    f"reply alone exceeds pad_to={pad_to}; raise pad_to or "
+                    "shorten the replies"
+                )
         for half, dest in (("chosen", chosen_rows), ("rejected", rejected_rows)):
             r_ids, r_mask = rows[half]
             dest.append((r_ids[overflow:], r_mask[overflow:]))

@@ -205,13 +205,18 @@ def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
         lse_ref[0, 0] = lse
 
 
-def _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, kv_major=False):
+def _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, kv_major=False,
+                q_steps=None):
     """BlockSpecs for the optional (qpos, kpos, qseg, kseg) inputs.
     Grid is (b*h, nq, nkv), or (b*h, nkv, nq) when ``kv_major`` (dkv pass).
+    ``q_steps``: the dkv pass's combined (group, q-block) axis — the last
+    grid index is g = group_idx * q_steps + qi and mask tiles (per-batch,
+    head-independent) index by qi = g % q_steps.
     q-side arrays are [B, Sq, LANES]; kv-side [B, SUBLANES, Skv]."""
     if kv_major:
-        q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, qi: (bh // h, qi, 0), memory_space=pltpu.VMEM)
-        kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, ki, qi: (bh // h, 0, ki), memory_space=pltpu.VMEM)
+        qi_of = (lambda g: g) if q_steps is None else (lambda g: g % q_steps)
+        q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, g: (bh // h, qi_of(g), 0), memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, ki, g: (bh // h, 0, ki), memory_space=pltpu.VMEM)
     else:
         q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh // h, qi, 0), memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, qi, ki: (bh // h, 0, ki), memory_space=pltpu.VMEM)
@@ -329,7 +334,7 @@ def _bwd_dq_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
-                    block_kv, num_q_blocks):
+                    block_kv, num_q_blocks, num_gq_steps):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     qpos_ref = next(it) if has_pos else None
@@ -341,9 +346,14 @@ def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
     dk_acc, dv_acc = next(it), next(it)
 
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    # the last grid axis walks (gqa-group, q-block): the same dk/dv output
+    # block is revisited across the WHOLE axis, so the group reduction
+    # happens here in f32 scratch instead of as a [B, H, Skv, D]
+    # materialization + XLA sum afterwards
+    gqi = pl.program_id(2)
+    qi = gqi % num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(gqi == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -383,7 +393,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(gqi == num_gq_steps - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -427,32 +437,39 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
         interpret=_interpret(),
     )(q, k, v, *mask_args, do, lse, delta)
 
-    # dk/dv per (b, q-head, kv block); summed over the GQA group afterwards.
-    # grid axis 1 = kv blocks, axis 2 = q blocks — mask specs get swapped
-    # index maps via kv_major.
+    # dk/dv at KV-HEAD granularity: grid axis 0 walks (b, kv-head), axis 2
+    # the combined (gqa-group, q-block) range with the output block
+    # revisited throughout, so the group reduction happens in f32 scratch
+    # inside the kernel. vs the old per-q-head output + XLA reshape/sum:
+    # group x fewer dk/dv HBM writes, no [B, H, Skv, D] intermediate, and
+    # a single f32->param-dtype rounding instead of per-head rounding
+    # before an XLA re-sum.
+    gnq = group * nq
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
             has_pos=has_pos, has_seg=has_seg,
             block_q=block_q, block_kv=block_kv, num_q_blocks=nq,
+            num_gq_steps=gnq,
         ),
-        grid=(b * h, nkv, nq),
+        grid=(b * hkv, nkv, gnq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
-        ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, kv_major=True) + [
-            pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, qi: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, g: (bh // hkv, bh % hkv, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, g: (bh // hkv, bh % hkv, ki, 0), memory_space=pltpu.VMEM),
+        ] + _mask_specs(b, hkv, has_pos, has_seg, block_q, block_kv,
+                        kv_major=True, q_steps=nq) + [
+            pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, bh % h, ki, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, qi: (bh // h, bh % h, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, g: (bh // hkv, bh % hkv, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, g: (bh // hkv, bh % hkv, ki, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, skv, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, skv, d), q.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_kv, d), jnp.float32),
@@ -460,10 +477,6 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
         ],
         interpret=_interpret(),
     )(q, k, v, *mask_args, do, lse, delta)
-
-    if group > 1:
-        dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2)
-        dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2)
     return dq, dk, dv
 
 

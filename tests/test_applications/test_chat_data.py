@@ -205,3 +205,22 @@ def test_dpo_batch_pair_truncation_keeps_shared_context():
                                   full_c[overflow:])
     np.testing.assert_array_equal(rejected[:len(full_r) - overflow],
                                   full_r[overflow:])
+
+
+def test_dpo_batch_rejects_pair_longer_than_shared_prompt():
+    """When the longer reply alone exceeds pad_to, truncation would have
+    to eat reply tokens (or empty the shorter half) — refuse loudly."""
+    t = ChatTemplate.plain()
+    pair = PreferenceSample([{"role": "user", "content": "hi"}],
+                            "a" * 60, "b")
+    with pytest.raises(ValueError, match="raise pad_to"):
+        dpo_batch([pair], t, tok, pad_to=32)
+
+
+def test_sharegpt_unknown_role_is_descriptive(tmp_path):
+    p = tmp_path / "tool.jsonl"
+    p.write_text(json.dumps({"conversations": [
+        {"from": "human", "value": "q"}, {"from": "tool", "value": "{}"},
+    ]}))
+    with pytest.raises(ValueError, match="ShareGPT role 'tool'"):
+        load_conversations_jsonl(str(p))
