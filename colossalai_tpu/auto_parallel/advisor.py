@@ -30,6 +30,10 @@ class ModelSpec:
     #: attention heads (0 = unknown): gates the all_to_all sp mode, which
     #: redistributes heads and needs num_heads % (tp·sp) == 0
     num_heads: int = 0
+    #: kv heads (0 = unknown): Ulysses must shard the KV head axis too —
+    #: a GQA model with fewer kv heads than tp·sp degrades to XLA's
+    #: replicate-then-repartition of every score tensor
+    num_kv_heads: int = 0
     #: sp modes the model family implements (``supports_sp_modes`` on the
     #: model class); the advisor picks among these per plan.
     #: ``from_config`` resolves them from the family; the bare default is
@@ -51,6 +55,11 @@ class ModelSpec:
                 + cfg.num_hidden_layers * (attn + mlp_mult * h * inter)
             )
         kw.setdefault("num_heads", getattr(cfg, "num_attention_heads", 0))
+        kw.setdefault(
+            "num_kv_heads",
+            getattr(cfg, "num_key_value_heads", None)
+            or getattr(cfg, "num_attention_heads", 0),
+        )
         modes = _family_sp_modes(cfg)
         if modes is not None:
             kw.setdefault("sp_modes", modes)
@@ -212,7 +221,10 @@ def _sp_mode_candidates(spec: ModelSpec, tp: int, sp: int, seq_len: int) -> List
         return ["none"]
     out = []
     for mode in spec.sp_modes:
-        if mode == "all_to_all" and spec.num_heads and spec.num_heads % (tp * sp):
+        if mode == "all_to_all" and (
+            (spec.num_heads and spec.num_heads % (tp * sp))
+            or (spec.num_kv_heads and spec.num_kv_heads % (tp * sp))
+        ):
             continue
         if mode == "ring_attn" and seq_len // sp < 512:
             continue  # ring chunks below a flash tile waste the MXU

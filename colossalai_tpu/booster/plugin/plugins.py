@@ -260,6 +260,22 @@ class HybridParallelPlugin(Plugin):
                 raise ValueError(
                     f"num_hidden_layers={n_layers} must be divisible by pp_size={self.pp_size}"
                 )
+        if self.sequence_parallel_mode == "all_to_all":
+            # Ulysses redistributes seq-sharding into head-sharding: BOTH
+            # head counts must divide the head axis, or XLA falls back to
+            # replicate-then-repartition of the [B,H,S,S] score tensors
+            # every layer ("involuntary full rematerialization" — measured
+            # on the degenerate kv4/sp8 config). ring_attn/split_gather
+            # have no head requirement.
+            span = self.tp_size * self.sp_size
+            for attr in ("num_attention_heads", "num_key_value_heads"):
+                n = getattr(model.config, attr, None)
+                if n is not None and n % span:
+                    raise ValueError(
+                        f"sequence_parallel_mode='all_to_all' needs {attr} "
+                        f"divisible by tp_size*sp_size={span}, got {n} — "
+                        "use ring_attn or split_gather for this model/mesh"
+                    )
         n_micro = getattr(self, "_resolved_microbatches", self.num_microbatches)
         updates = {}
         padded_vocab = getattr(model.config, "padded_vocab_size_", None)

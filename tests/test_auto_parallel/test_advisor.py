@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from colossalai_tpu.auto_parallel import plan_parallelism
 from colossalai_tpu.auto_parallel.advisor import ModelSpec
@@ -143,7 +144,10 @@ def test_sp_mode_choice_changes_compiled_program():
     from colossalai_tpu.booster import Booster, HybridParallelPlugin
     from colossalai_tpu.tensor import use_mesh
 
-    cfg = LlamaConfig.tiny(num_hidden_layers=2, remat=True)
+    # MHA (kv == q heads): tp2·sp2 Ulysses needs BOTH head counts
+    # divisible by 4 — the degenerate-GQA case is now rejected outright
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, remat=True,
+                           num_key_value_heads=4)
     spec = ModelSpec.from_config(cfg)
     seq, bs = 512, 8
 
@@ -180,3 +184,26 @@ def test_sp_mode_choice_changes_compiled_program():
     np.testing.assert_allclose(loss_sg, loss_aa, rtol=1e-5)
     # step-time leg: record + sanity-bound the ratio
     assert t_sg > 0 and t_aa > 0 and max(t_sg, t_aa) / min(t_sg, t_aa) < 10
+
+
+def test_all_to_all_gated_on_kv_heads():
+    """Ulysses must shard the KV head axis too: a GQA model with kv heads
+    < tp*sp degrades to XLA replicate-then-repartition of every score
+    tensor (measured: 'involuntary full rematerialization' warnings at
+    kv4/sp8), so neither the advisor nor the plugin may offer it."""
+    from colossalai_tpu.auto_parallel.advisor import ModelSpec, _sp_mode_candidates
+    from colossalai_tpu.booster import HybridParallelPlugin
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    spec = ModelSpec(n_params=10**8, num_layers=4, hidden_size=256,
+                     vocab_size=1000, num_heads=8, num_kv_heads=4,
+                     sp_modes=("split_gather", "all_to_all", "ring_attn"))
+    assert "all_to_all" not in _sp_mode_candidates(spec, tp=1, sp=8, seq_len=2**15)
+    assert "all_to_all" in _sp_mode_candidates(spec, tp=1, sp=4, seq_len=2**15)
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=8,
+                      num_key_value_heads=4, max_position_embeddings=128)
+    plugin = HybridParallelPlugin(sp_size=8, sequence_parallel_mode="all_to_all")
+    with pytest.raises(ValueError, match="num_key_value_heads"):
+        plugin.modify_model(LlamaForCausalLM(cfg))
