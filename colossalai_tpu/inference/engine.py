@@ -173,13 +173,31 @@ class LLMEngine:
         self._pp = 0
         if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
             # pipeline-parallel decode: layers (weights AND pages) live on
-            # their stage; activations relay via ppermute (pp_decode.py)
-            others = {a: n for a, n in dict(mesh.shape).items() if a != "pp" and n > 1}
+            # their stage; activations relay via ppermute; a tp axis
+            # composes Megatron head-sharding INSIDE each stage
+            # (pp_decode.py ≙ the reference's tp-within-pp executor)
+            others = {
+                a: n for a, n in dict(mesh.shape).items()
+                if a not in ("pp", "tp") and n > 1
+            }
             if others:
                 raise NotImplementedError(
                     f"pp inference does not compose with {others} — use a "
-                    f"pp-only mesh (tp-only runs through the GSPMD path)"
+                    f"pp(+tp) mesh (tp-only runs through the GSPMD path)"
                 )
+            pp_tp = dict(mesh.shape).get("tp", 1)
+            if pp_tp > 1:
+                # everything _stacked_spec tp-shards must divide: the head
+                # dims AND the MLP width (gate/up column, down row)
+                for attr in ("num_attention_heads", "num_key_value_heads",
+                             "intermediate_size"):
+                    n = getattr(config, attr, None)
+                    if n is not None and n % pp_tp:
+                        raise ValueError(
+                            f"pp+tp inference Megatron-shards each stage: "
+                            f"{attr}={n} must divide tp={pp_tp} (heads and "
+                            "the MLP width are column/row-sliced)"
+                        )
             if use_kernel:
                 raise NotImplementedError(
                     "use_kernel (Pallas paged attention) has no pp relay "

@@ -43,25 +43,47 @@ def _rms(x, scale, eps):
     return (x32 * jax.lax.rsqrt(jnp.mean(x32**2, -1, keepdims=True) + eps) * scale).astype(x.dtype)
 
 
-def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask):
+def _proj(h, leaf, dtype):
+    """x @ kernel (+ bias when the checkpoint has one — qwen2-style
+    attention_bias configs; under a tp shard_map the bias arrives
+    column-sliced like its kernel)."""
+    y = h @ leaf["kernel"].astype(dtype)
+    if "bias" in leaf:
+        y = y + leaf["bias"].astype(dtype)
+    return y
+
+
+def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
+                tp_axis=None):
     """One decoder block over x [B, S, H] attending to the cache + itself.
 
     k_cache/v_cache: [B, S_max, Hkv, D] already containing THIS x's K/V at
     ``positions``. ``kv_valid_mask``: [B, S_max] True where cache is valid.
+
+    Head counts derive from the KERNEL shapes, not cfg: inside a
+    ``shard_map`` over a tp axis, ``p`` holds the local head shard (q/k/v
+    column-sliced) and ``tp_axis`` names the axis to psum the o_proj /
+    down_proj row-matmul partials over (the Megatron pattern, manual
+    collectives because shard_map sees per-device values).
     """
     dtype = x.dtype
     eps = cfg.rms_norm_eps
     hd = cfg.head_dim_
     b, s, _ = x.shape
 
+    def _row_out(y):
+        return jax.lax.psum(y, tp_axis) if tp_axis is not None else y
+
     h = _rms(x, p["input_layernorm"]["scale"], eps)
-    q = h @ p["self_attn"]["q_proj"]["kernel"].astype(dtype)
-    q = q.reshape(b, s, cfg.num_attention_heads, hd)
+    q = _proj(h, p["self_attn"]["q_proj"], dtype)
+    n_heads = q.shape[-1] // hd  # LOCAL heads under a tp shard
+    q = q.reshape(b, s, n_heads, hd)
     cos, sin = rope_table(positions, hd, cfg.rope_theta)
     q = apply_rope(q, cos, sin)
 
-    group = cfg.num_attention_heads // cfg.num_key_value_heads
-    qg = q.reshape(b, s, cfg.num_key_value_heads, group, hd)
+    n_kv = k_cache.shape[-2]
+    group = n_heads // n_kv
+    qg = q.reshape(b, s, n_kv, group, hd)
     scores = jnp.einsum(
         "bshgd,bthd->bhgst", qg, k_cache, preferred_element_type=jnp.float32
     ) * (hd**-0.5)
@@ -71,25 +93,25 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask):
     scores = jnp.where(mask[:, None, None], scores, -1e9)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     attn = jnp.einsum("bhgst,bthd->bshgd", probs, v_cache, preferred_element_type=jnp.float32)
-    attn = attn.reshape(b, s, cfg.num_attention_heads * hd).astype(dtype)
-    x = x + attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype)
+    attn = attn.reshape(b, s, n_heads * hd).astype(dtype)
+    x = x + _row_out(attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype))
 
     h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
     gate = h @ p["mlp"]["gate_proj"]["kernel"].astype(dtype)
     up = h @ p["mlp"]["up_proj"]["kernel"].astype(dtype)
     act = jax.nn.silu(gate) * up
-    return x + act @ p["mlp"]["down_proj"]["kernel"].astype(dtype)
+    return x + _row_out(act @ p["mlp"]["down_proj"]["kernel"].astype(dtype))
 
 
 def _project_kv(cfg, p, h_normed, positions):
     dtype = h_normed.dtype
     hd = cfg.head_dim_
     b, s, _ = h_normed.shape
-    k = (h_normed @ p["self_attn"]["k_proj"]["kernel"].astype(dtype)).reshape(
-        b, s, cfg.num_key_value_heads, hd
-    )
-    v = (h_normed @ p["self_attn"]["v_proj"]["kernel"].astype(dtype)).reshape(
-        b, s, cfg.num_key_value_heads, hd
+    k_flat = _proj(h_normed, p["self_attn"]["k_proj"], dtype)
+    n_kv = k_flat.shape[-1] // hd  # LOCAL kv heads under a tp shard
+    k = k_flat.reshape(b, s, n_kv, hd)
+    v = _proj(h_normed, p["self_attn"]["v_proj"], dtype).reshape(
+        b, s, n_kv, hd
     )
     cos, sin = rope_table(positions, hd, cfg.rope_theta)
     return apply_rope(k, cos, sin), v

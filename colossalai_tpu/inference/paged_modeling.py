@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
 from .kv_cache import PagedKVCache
-from .modeling import _block_step, _project_kv, _rms
+from .modeling import _block_step, _proj, _project_kv, _rms
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -112,7 +112,7 @@ def decode_paged(
         if use_kernel:
             from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
 
-            q = h @ layer_params["self_attn"]["q_proj"]["kernel"].astype(dtype)
+            q = _proj(h, layer_params["self_attn"]["q_proj"], dtype)
             q = q.reshape(n_slots, cfg.num_attention_heads, cfg.head_dim_)
             cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
             q = apply_rope(q[:, None], cos, sin)[:, 0]

@@ -20,8 +20,10 @@ multi-device story. TPU redesign: ONE jitted program per tick under
   same way the reference's microbatch ring does.
 
 The relay supports any decoder the paged engine runs (llama family).
-tp inside a pp stage is not composed here (the GSPMD tp path covers
-tp-only); the engine raises if both are requested.
+A ``tp`` axis on the mesh composes Megatron head-sharding inside each
+stage (kernels column/row-sliced, kv pages head-sharded, o_proj/down_proj
+partials psum'd over "tp" — ≙ the reference's tp-within-pp inference
+executor); dp/sp/ep do not compose here and the engine rejects them.
 """
 
 from __future__ import annotations
@@ -40,20 +42,57 @@ from .modeling import _block_step, _project_kv, _rms
 
 
 def _stage_layout(mesh, num_layers: int):
-    """(pp, layers-per-stage, stage sharding) — the ONE place the stage
-    layout is defined, so weights and pages can never shard differently."""
+    """(pp, layers-per-stage, tp) — the ONE place the stage layout is
+    defined, so weights and pages can never shard differently."""
     pp = mesh.shape["pp"]
     if num_layers % pp:
         raise ValueError(f"num_layers={num_layers} not divisible by pp={pp}")
-    return pp, num_layers // pp, NamedSharding(mesh, P("pp"))
+    return pp, num_layers // pp, dict(mesh.shape).get("tp", 1)
+
+
+#: stacked-leaf module names with a tp-shardable dim: column-parallel
+#: (output dim) vs row-parallel (input dim) — the Megatron layout the
+#: training policies use, mirrored for the pp stage stacks
+_COL_MODULES = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
+_ROW_MODULES = ("o_proj", "down_proj")
+
+
+def _stacked_spec(path_parts, ndim: int, tp: int) -> P:
+    """PartitionSpec for one stacked leaf [pp, L/pp, ...own dims]."""
+    if tp > 1 and len(path_parts) >= 2 and path_parts[-1] == "kernel":
+        mod = path_parts[-2]
+        if mod in _COL_MODULES and ndim >= 4:
+            return P("pp", None, None, "tp")
+        if mod in _ROW_MODULES and ndim >= 4:
+            return P("pp", None, "tp", None)
+    if tp > 1 and len(path_parts) >= 2 and path_parts[-1] == "bias":
+        if path_parts[-2] in _COL_MODULES and ndim >= 3:
+            return P("pp", None, "tp")
+    return P("pp")
+
+
+def _stacked_specs(stacked, tp: int):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(stacked)
+    leaves = []
+    for keypath, leaf in flat:
+        parts = [str(getattr(k, "key", k)) for k in keypath]
+        leaves.append(_stacked_spec(parts, jnp.ndim(leaf), tp))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _cache_spec(tp: int) -> P:
+    """Pool [pp, L/pp, n_blocks, Hkv, bs, D]: stages own dim 0, tp shards
+    the kv heads."""
+    return P("pp", None, None, "tp" if tp > 1 else None, None, None)
 
 
 def place_params_pp(params, mesh, num_layers: int):
     """Reshape the scanned layer stack to [pp, L/pp, ...] and place it:
-    stacked dim 0 over ``pp``, top-level params replicated. Params-only so
-    ``LLMEngine.sync_params`` (the RLHF weight handoff) can re-place fresh
-    weights without touching the live page pool."""
-    pp, per, stage_sharding = _stage_layout(mesh, num_layers)
+    stacked dim 0 over ``pp``, kernels additionally Megatron-sharded over
+    ``tp`` when the mesh has one, top-level params replicated. Params-only
+    so ``LLMEngine.sync_params`` (the RLHF weight handoff) can re-place
+    fresh weights without touching the live page pool."""
+    pp, per, tp = _stage_layout(mesh, num_layers)
     p = params["params"] if "params" in params else params
     top = {k: v for k, v in p.items() if k != "layers"}
     stacked = jax.tree.map(
@@ -61,9 +100,22 @@ def place_params_pp(params, mesh, num_layers: int):
         p["layers"]["block"],
     )
     repl = NamedSharding(mesh, P())
-    top = jax.device_put(top, jax.tree.map(lambda _: repl, top))
+    top_shardings = jax.tree.map(lambda _: repl, top)
+    if tp > 1 and "lm_head" in top:
+        # the per-tick full-vocab head matmul runs OUTSIDE the relay under
+        # GSPMD: column-shard it so tp devices split the vocab instead of
+        # replicating the largest matmul on the decode critical path (tied
+        # embeddings stay replicated — the input gather wants locality)
+        top_shardings["lm_head"] = jax.tree.map(
+            lambda a: NamedSharding(
+                mesh, P(None, "tp") if jnp.ndim(a) == 2 else P("tp")
+            ),
+            top["lm_head"],
+        )
+    top = jax.device_put(top, top_shardings)
     stacked = jax.device_put(
-        stacked, jax.tree.map(lambda _: stage_sharding, stacked)
+        stacked,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), _stacked_specs(stacked, tp)),
     )
     return top, stacked
 
@@ -71,28 +123,33 @@ def place_params_pp(params, mesh, num_layers: int):
 def shard_params_pp(params, cache: PagedKVCache, mesh, num_layers: int):
     """Engine-init placement: params via :func:`place_params_pp` plus the
     page pool reshaped to [pp, L/pp, ...] with dim 0 over ``pp`` (each
-    stage owns its layers' pages)."""
+    stage owns its layers' pages; kv heads over ``tp`` when present)."""
     top, stacked = place_params_pp(params, mesh, num_layers)
-    pp, per, stage_sharding = _stage_layout(mesh, num_layers)
+    pp, per, tp = _stage_layout(mesh, num_layers)
+    pool_sharding = NamedSharding(mesh, _cache_spec(tp))
     ck = jax.device_put(
-        cache.k.reshape((pp, per) + cache.k.shape[1:]), stage_sharding
+        cache.k.reshape((pp, per) + cache.k.shape[1:]), pool_sharding
     )
     cv = jax.device_put(
-        cache.v.reshape((pp, per) + cache.v.shape[1:]), stage_sharding
+        cache.v.reshape((pp, per) + cache.v.shape[1:]), pool_sharding
     )
     return top, stacked, PagedKVCache(k=ck, v=cv)
 
 
-def _relay(mesh, stage_fn, x, stacked, ck, cv, extras):
+def _relay(mesh, stage_fn, x, stacked, ck, cv, extras, tp: int = 1):
     """Run ``stage_fn`` through the pp stages sequentially inside shard_map.
 
     ``stage_fn(x, local_stacked, local_k, local_v, extras)`` →
     (y, k_new, v_new) with local stack shapes [L/pp, ...]; ``extras`` is a
     pytree of replicated operands (shard_map cannot close over tracers).
-    Returns (x broadcast to all stages, updated pools). Cost note: inactive
-    stages compute on don't-care inputs — the relay trades pp-1 idle-stage
-    FLOPs for one static XLA program; with a full continuous batch every
-    tick, stage utilization comes from consecutive ticks, not within one.
+    Returns (x broadcast to all stages, updated pools). With ``tp > 1``
+    the mesh also has a tp axis: kernels/pages arrive head-sharded,
+    ``stage_fn`` psums its row-matmul partials over "tp" (the engine wires
+    ``tp_axis`` into ``_block_step``), and activations stay replicated
+    across the tp group. Cost note: inactive stages compute on don't-care
+    inputs — the relay trades pp-1 idle-stage FLOPs for one static XLA
+    program; with a full continuous batch every tick, stage utilization
+    comes from consecutive ticks, not within one.
     """
     pp = mesh.shape["pp"]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -102,7 +159,10 @@ def _relay(mesh, stage_fn, x, stacked, ck, cv, extras):
         kl, vl = ck[0], cv[0]
         stage = jax.lax.axis_index("pp")
         # the carry becomes device-varying after the first masked select;
-        # mark it varying up front so the fori_loop carry type is stable
+        # mark it varying up front so the fori_loop carry type is stable.
+        # Over "pp" ONLY: the activation stays tp-INVARIANT throughout —
+        # tp-varying intermediates (head shards, MLP slices) all flow into
+        # the in-block psums, which restore invariance before they touch x
         if hasattr(jax.lax, "pcast"):
             x = jax.lax.pcast(x, ("pp",), to="varying")
         else:  # older jax spells it pvary
@@ -123,12 +183,13 @@ def _relay(mesh, stage_fn, x, stacked, ck, cv, extras):
         x = jax.lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
         return x, kl[None], vl[None]
 
-    stack_specs = jax.tree.map(lambda _: P("pp"), stacked)
+    stack_specs = _stacked_specs(stacked, tp)
+    pool_spec = _cache_spec(tp)
     extra_specs = jax.tree.map(lambda _: P(), extras)
     return shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(), stack_specs, P("pp"), P("pp"), extra_specs),
-        out_specs=(P(), P("pp"), P("pp")),
+        in_specs=(P(), stack_specs, pool_spec, pool_spec, extra_specs),
+        out_specs=(P(), pool_spec, pool_spec),
     )(x, stacked, ck, cv, extras)
 
 
@@ -136,10 +197,14 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
     """(prefill_fn, decode_fn) — pp variants of prefill_paged/decode_paged.
 
     Signatures mirror the single-stage functions but take (top, stacked)
-    from :func:`shard_params_pp` and the [pp, L/pp, ...] cache.
+    from :func:`shard_params_pp` and the [pp, L/pp, ...] cache. A tp axis
+    on the mesh composes Megatron head-sharding inside each stage
+    (≙ the reference's tp-within-pp inference executor).
     """
     dtype = cfg.dtype or jnp.bfloat16
     bs = block_size
+    tp = dict(mesh.shape).get("tp", 1)
+    tp_axis = "tp" if tp > 1 else None
 
     def _head(top, x):
         x = _rms(x, top["norm"]["scale"], cfg.rms_norm_eps)
@@ -167,7 +232,8 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
                 v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
                 k_pool = k_pool.at[block_table[:n_pages]].set(k_pages)
                 v_pool = v_pool.at[block_table[:n_pages]].set(v_pages)
-                x = _block_step(cfg, lp, x, k, v, positions, valid)
+                x = _block_step(cfg, lp, x, k, v, positions, valid,
+                                tp_axis=tp_axis)
                 return (x,), (k_pool, v_pool)
 
             (x,), (k_new, v_new) = jax.lax.scan(
@@ -177,7 +243,7 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
 
         x, k_new, v_new = _relay(
             mesh, stage_fn, x, stacked, cache.k, cache.v,
-            (positions, valid, block_table),
+            (positions, valid, block_table), tp=tp,
         )
         logits = _head(top, x)
         last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
@@ -213,7 +279,8 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
                     g = g.transpose(0, 1, 3, 2, 4)
                     return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
 
-                x = _block_step(cfg, lp, x, to_seq(k_pool), to_seq(v_pool), positions, attend)
+                x = _block_step(cfg, lp, x, to_seq(k_pool), to_seq(v_pool),
+                                positions, attend, tp_axis=tp_axis)
                 return (x,), (k_pool, v_pool)
 
             (x,), (k_new, v_new) = jax.lax.scan(
@@ -223,7 +290,7 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
 
         x, k_new, v_new = _relay(
             mesh, stage_fn, x, stacked, cache.k, cache.v,
-            (positions, block_tables, active, w_block, w_off, attend),
+            (positions, block_tables, active, w_block, w_off, attend), tp=tp,
         )
         return _head(top, x)[:, 0], PagedKVCache(k=k_new, v=v_new)
 
