@@ -18,7 +18,11 @@ Formats parsed (the files the benchmarks publish):
   (labels may be letters or digits);
 - HellaSwag: jsonl with ``{"ctx", "endings", "label"}``;
 - GSM8K: jsonl with ``{"question", "answer"}`` where the gold answer
-  carries the ``#### N`` marker the extractor understands.
+  carries the ``#### N`` marker the extractor understands;
+- WinoGrande: jsonl with a ``_``-blanked sentence + two options;
+- BoolQ: jsonl with passage/question/boolean answer (yes/no scored as
+  continuations);
+- CMMLU / C-Eval: headered csv ``id,question,A,B,C,D,answer[,...]``.
 """
 
 from __future__ import annotations
@@ -132,6 +136,74 @@ def load_gsm8k_jsonl(path: str) -> List[GenSample]:
             for r in _read_jsonl(path)]
 
 
+def load_winogrande_jsonl(path: str) -> List[ChoiceSample]:
+    """Official WinoGrande jsonl (``sentence`` with a ``_`` blank,
+    ``option1``/``option2``, ``answer`` "1"/"2"). Scored as the two full
+    continuations (option + rest of sentence) after the shared prefix —
+    the whole-continuation variant of lm-eval's partial scoring."""
+    samples = []
+    for r in _read_jsonl(path):
+        sent = r["sentence"]
+        if "_" not in sent:
+            raise ValueError(f"{path}: winogrande sentence has no blank: {sent!r}")
+        prefix, suffix = sent.split("_", 1)
+        samples.append(ChoiceSample(
+            question=prefix.rstrip(),
+            choices=[r["option1"] + suffix, r["option2"] + suffix],
+            answer=int(r["answer"]) - 1,
+        ))
+    return samples
+
+
+def load_boolq_jsonl(path: str) -> List[ChoiceSample]:
+    """Official BoolQ jsonl (``passage``, ``question``, boolean
+    ``answer``): yes/no scored as continuations after the passage +
+    question (the lm-eval rule)."""
+    return [
+        ChoiceSample(
+            question=r["question"].rstrip("?") + "?",
+            choices=["no", "yes"], answer=int(bool(r["answer"])),
+            context=r.get("passage", ""),
+        )
+        for r in _read_jsonl(path)
+    ]
+
+
+def load_cmmlu_csv(path: str) -> List[ChoiceSample]:
+    """CMMLU / C-Eval release csv: a HEADER row then
+    ``id,question,A,B,C,D,answer[,...]`` (C-Eval val adds an explanation
+    column — trailing columns are ignored)."""
+    samples = []
+    with open(path, newline="", encoding="utf-8") as f:
+        rows = list(csv.reader(f))
+    if not rows:
+        return samples
+    header = [h.strip().lower() for h in rows[0]]
+    try:
+        cols = [header.index(c) for c in ("question", "a", "b", "c", "d", "answer")]
+    except ValueError:
+        raise ValueError(
+            f"{path}: expected a header with question, A-D and answer "
+            f"columns (CMMLU/C-Eval layout); got {rows[0]}"
+        ) from None
+    for i, row in enumerate(rows[1:]):
+        if not row:
+            continue  # blank separator lines between records
+        if len(row) <= max(cols):
+            raise ValueError(
+                f"{path} row {i + 2}: expected at least {max(cols) + 1} "
+                f"columns per the header, got {len(row)}"
+            )
+        q, a, b, c, d, ans = (row[j] for j in cols)
+        ans = ans.strip().upper()
+        if ans not in LETTERS[:4]:
+            raise ValueError(f"{path} row {i + 2}: answer must be A-D, got {ans!r}")
+        samples.append(ChoiceSample(
+            question=q, choices=[a, b, c, d], answer=LETTERS.index(ans),
+        ))
+    return samples
+
+
 #: benchmark name → (loader, runner style). "letter" and "continuation"
 #: build ChoiceTaskRunner; "generation" builds GenerationTaskRunner.
 BENCHMARK_FORMATS: Dict[str, Tuple[Callable[[str], list], str]] = {
@@ -140,6 +212,10 @@ BENCHMARK_FORMATS: Dict[str, Tuple[Callable[[str], list], str]] = {
     "arc_letter": (load_arc_jsonl, "letter"),
     "hellaswag": (load_hellaswag_jsonl, "continuation"),
     "gsm8k": (load_gsm8k_jsonl, "generation"),
+    "winogrande": (load_winogrande_jsonl, "continuation"),
+    "boolq": (load_boolq_jsonl, "continuation"),
+    "cmmlu": (load_cmmlu_csv, "letter"),
+    "ceval": (load_cmmlu_csv, "letter"),
 }
 
 

@@ -195,8 +195,8 @@ class LLMEngine:
                     if n is not None and n % pp_tp:
                         raise ValueError(
                             f"pp+tp inference Megatron-shards each stage: "
-                            f"{attr}={n} must divide tp={pp_tp} (heads and "
-                            "the MLP width are column/row-sliced)"
+                            f"{attr}={n} must be divisible by tp={pp_tp} "
+                            "(heads and the MLP width are column/row-sliced)"
                         )
             if use_kernel:
                 raise NotImplementedError(

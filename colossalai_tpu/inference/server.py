@@ -159,11 +159,17 @@ class _Scheduler(threading.Thread):
 
 
 def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
-                request_timeout: float = 300.0):
+                request_timeout: float = 300.0,
+                tokenizer=None, detokenizer=None):
     """Returns (ThreadingHTTPServer, scheduler). Call serve_forever() /
     shutdown() on the server; scheduler.stop() on teardown.
     ``request_timeout`` bounds non-streaming waits; a timed-out request is
-    aborted so its KV pages return to the pool."""
+    aborted so its KV pages return to the pool.
+
+    Pass ``tokenizer`` (str → ids) and ``detokenizer`` (ids → str) to
+    serve TEXT: /generate then also accepts ``{"prompt": "..."}`` and
+    answers/streams ``text`` alongside the ids (≙ the reference
+    api_server's tokenizer-in-the-server completion endpoints)."""
     sched = _Scheduler(engine, request_timeout=request_timeout)
     sched.start()
 
@@ -203,12 +209,16 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
             try:
                 while True:
                     tok = q.get(timeout=sched.request_timeout)
-                    if tok is _DONE:
-                        payload = {"request_id": rid, "done": True,
+                    if tok is _DONE or tok is _ABORTED:
+                        # only the FINAL event carries text: detokenizing
+                        # single tokens mid-stream splits multibyte BPE
+                        # pieces; clients wanting incremental text detok
+                        # the accumulated ids themselves
+                        payload = {"request_id": rid,
+                                   ("done" if tok is _DONE else "aborted"): True,
                                    "output_ids": out}
-                    elif tok is _ABORTED:
-                        payload = {"request_id": rid, "aborted": True,
-                                   "output_ids": out}
+                        if detokenizer is not None:
+                            payload["text"] = detokenizer(out)
                     else:
                         out.append(tok)
                         payload = {"request_id": rid, "token": tok}
@@ -252,19 +262,33 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     do_sample=bool(req.get("do_sample", False)),
                     eos_token_id=req.get("eos_token_id"),
                 )
+                if "prompt_ids" in req:
+                    prompt_ids = req["prompt_ids"]
+                elif "prompt" in req:
+                    if tokenizer is None:
+                        self._json(400, {"error":
+                                         "text prompts need make_server(tokenizer=...)"})
+                        return
+                    prompt_ids = list(map(int, tokenizer(req["prompt"])))
+                else:
+                    self._json(400, {"error": "need prompt_ids or prompt"})
+                    return
                 stream = bool(req.get("stream", False))
                 if stream:
-                    rid, q = sched.submit(req["prompt_ids"], gen, stream=True)
+                    rid, q = sched.submit(prompt_ids, gen, stream=True)
                     self._stream(rid, q)
                     return
-                rid = sched.submit(req["prompt_ids"], gen)
+                rid = sched.submit(prompt_ids, gen)
                 out, status = sched.wait(rid)
                 if status == "aborted":
                     self._json(409, {"request_id": rid, "error": "aborted"})
                 elif out is None:
                     self._json(504, {"error": "generation timed out"})
                 else:
-                    self._json(200, {"request_id": rid, "output_ids": out})
+                    payload = {"request_id": rid, "output_ids": out}
+                    if detokenizer is not None:
+                        payload["text"] = detokenizer(out)
+                    self._json(200, payload)
             except Exception as e:  # pragma: no cover - defensive
                 self._json(400, {"error": str(e)})
 

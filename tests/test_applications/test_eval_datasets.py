@@ -144,3 +144,48 @@ def test_llm_judge_runner(tiny_model):
     assert again["ratings"] == out["ratings"]
     empty = LLMJudgeRunner("empty", [], _tok).run(model, params)
     assert empty["n"] == 0 and empty["mean_rating"] == 0.0
+
+
+def test_winogrande_boolq_cmmlu_loaders(tmp_path):
+    _write(tmp_path / "wg.jsonl", json.dumps({
+        "sentence": "The trophy didn't fit in the case because _ was too big.",
+        "option1": "the trophy", "option2": "the case", "answer": "1",
+    }))
+    wg = load_benchmark("winogrande", str(tmp_path / "wg.jsonl"))
+    assert wg[0].question == "The trophy didn't fit in the case because"
+    assert wg[0].choices[0] == "the trophy was too big."
+    assert wg[0].answer == 0
+    with pytest.raises(ValueError, match="no blank"):
+        _write(tmp_path / "bad_wg.jsonl", json.dumps(
+            {"sentence": "no blank", "option1": "a", "option2": "b", "answer": "1"}))
+        load_benchmark("winogrande", str(tmp_path / "bad_wg.jsonl"))
+
+    _write(tmp_path / "bq.jsonl", json.dumps({
+        "passage": "Cats are mammals.", "question": "is a cat a mammal",
+        "answer": True,
+    }))
+    bq = load_benchmark("boolq", str(tmp_path / "bq.jsonl"))
+    assert bq[0].context == "Cats are mammals."
+    assert bq[0].question == "is a cat a mammal?"
+    assert bq[0].choices == ["no", "yes"] and bq[0].answer == 1
+
+    _write(tmp_path / "cm.csv",
+           "id,question,A,B,C,D,answer,explanation\n"
+           '0,"首都是?",北京,上海,广州,深圳,A,capital\n')
+    cm = load_benchmark("cmmlu", str(tmp_path / "cm.csv"))
+    assert cm[0].question == "首都是?" and cm[0].answer == 0
+    assert cm[0].choices[0] == "北京"
+    assert load_benchmark("ceval", str(tmp_path / "cm.csv")) == cm
+    with pytest.raises(ValueError, match="header"):
+        _write(tmp_path / "noheader.csv", "q,a,b,c,d,A\n")
+        load_benchmark("cmmlu", str(tmp_path / "noheader.csv"))
+
+
+def test_new_formats_run_through_runner_for(tmp_path, tiny_model):
+    model, params = tiny_model
+    _write(tmp_path / "bq.jsonl", "\n".join(json.dumps(r) for r in (
+        {"passage": "A.", "question": "q1", "answer": True},
+        {"passage": "B.", "question": "q2", "answer": False},
+    )))
+    out = runner_for("boolq", str(tmp_path / "bq.jsonl"), _tok).run(model, params)
+    assert out["n"] == 2 and out["style"] == "continuation"

@@ -6,6 +6,7 @@ return the request's KV pages to the pool."""
 import http.client
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import jax
@@ -149,3 +150,58 @@ def test_engine_abort_waiting_and_running():
     assert eng.abort(gids[1])  # any member id cancels the queued group
     assert not eng.waiting
     assert not eng.abort(10**9)
+
+
+def test_text_serving_roundtrip():
+    """make_server(tokenizer=, detokenizer=): /generate accepts a text
+    prompt and answers/streams text alongside the ids (≙ the reference
+    api_server's tokenizer-in-the-server completion endpoint)."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16, prefill_buckets=(16,))
+    tok = lambda s: [ord(c) % cfg.vocab_size for c in s]
+    detok = lambda ids: "".join(chr(65 + (int(i) % 26)) for i in ids)
+    server, sched = make_server(eng, port=0, tokenizer=tok, detokenizer=detok)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "hello", "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["text"] == detok(out["output_ids"]) and len(out["text"]) == 4
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/generate", json.dumps(
+            {"prompt": "hello", "max_new_tokens": 4, "stream": True}),
+            {"Content-Type": "application/json"})
+        events = list(_sse_events(conn.getresponse()))
+        conn.close()
+        assert events[-1]["done"] and events[-1]["text"] == out["text"]
+
+        # a text prompt without a tokenizer is a clear 400
+        server2, sched2 = make_server(eng, port=0)
+        port2 = server2.server_address[1]
+        threading.Thread(target=server2.serve_forever, daemon=True).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port2}/generate",
+                data=json.dumps({"prompt": "hi"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400 and "tokenizer" in json.loads(e.read())["error"]
+        finally:
+            server2.shutdown()
+            sched2.stop()
+    finally:
+        server.shutdown()
+        sched.stop()
