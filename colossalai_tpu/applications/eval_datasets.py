@@ -159,14 +159,22 @@ def load_boolq_jsonl(path: str) -> List[ChoiceSample]:
     """Official BoolQ jsonl (``passage``, ``question``, boolean
     ``answer``): yes/no scored as continuations after the passage +
     question (the lm-eval rule)."""
-    return [
-        ChoiceSample(
+    samples = []
+    for i, r in enumerate(_read_jsonl(path)):
+        ans = r["answer"]
+        # validate like the csv loaders: a dump serializing "false" as a
+        # STRING would silently grade as yes via bool("false") == True
+        if not isinstance(ans, bool) and ans not in (0, 1):
+            raise ValueError(
+                f"{path} row {i + 1}: boolq answer must be a JSON boolean "
+                f"(or 0/1), got {ans!r}"
+            )
+        samples.append(ChoiceSample(
             question=r["question"].rstrip("?") + "?",
-            choices=["no", "yes"], answer=int(bool(r["answer"])),
+            choices=["no", "yes"], answer=int(bool(ans)),
             context=r.get("passage", ""),
-        )
-        for r in _read_jsonl(path)
-    ]
+        ))
+    return samples
 
 
 def load_cmmlu_csv(path: str) -> List[ChoiceSample]:

@@ -77,18 +77,99 @@ def _cmd_run(args) -> int:
     return next((r for r in rcs if r), 0)
 
 
-def _cmd_plan(args) -> int:
-    from colossalai_tpu.auto_parallel import plan_parallelism
+def _resolve_preset(preset: str):
     from colossalai_tpu.models import LlamaConfig
 
     # presets are the no-arg classmethod constructors; plain attributes
     # (vocab_size) and instance methods (to_dict) must hit the error branch
     known = [n for n in dir(LlamaConfig) if not n.startswith("_")
              and isinstance(inspect.getattr_static(LlamaConfig, n), classmethod)]
-    if args.preset not in known:
-        print(f"unknown preset {args.preset!r}; try one of {known}", file=sys.stderr)
+    if preset not in known:
+        print(f"unknown preset {preset!r}; try one of {known}", file=sys.stderr)
+        return None
+    return getattr(LlamaConfig, preset)()
+
+
+def _build_server(args):
+    """serve's engine+server assembly, separated so tests can drive it
+    without serve_forever."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import LLMEngine, make_server
+
+    cfg = _resolve_preset(args.preset)
+    if cfg is None:
+        return None
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    rng = jax.random.PRNGKey(args.seed)
+    ids = jnp.ones((1, 8), jnp.int32)
+    if args.checkpoint:
+        from colossalai_tpu.checkpoint_io import CheckpointIO
+
+        # eval_shape target: never materialize a full random init just to
+        # overwrite it (an 8B preset would be ~32 GiB of thrown-away fp32)
+        target = jax.eval_shape(lambda r: model.init(r, ids), rng)["params"]
+        params = {"params": CheckpointIO().load_model(
+            args.checkpoint, target=target
+        )}
+    else:
+        print("WARNING: no --checkpoint — serving RANDOM weights (demo mode)",
+              file=sys.stderr)
+        params = model.init(rng, ids)
+    mesh = None
+    if args.pp > 1 or args.tp > 1:
+        from jax.sharding import Mesh
+
+        need = args.pp * args.tp
+        have = len(jax.devices())
+        if have < need:
+            print(f"--pp {args.pp} x --tp {args.tp} needs {need} devices; "
+                  f"this host has {have}", file=sys.stderr)
+            return None
+        devices = np.array(jax.devices()[:need])
+        mesh = Mesh(devices.reshape(args.pp, args.tp), ("pp", "tp"))
+    engine = LLMEngine(
+        params, cfg, max_batch_size=args.max_batch_size,
+        max_seq_len=args.max_seq_len, block_size=args.block_size, mesh=mesh,
+    )
+    tokenizer = detokenizer = None
+    if args.tokenizer:
+        from transformers import AutoTokenizer
+
+        t = AutoTokenizer.from_pretrained(args.tokenizer, local_files_only=True)
+        tokenizer, detokenizer = t.encode, t.decode
+    return make_server(engine, host=args.host, port=args.port,
+                       tokenizer=tokenizer, detokenizer=detokenizer)
+
+
+def _cmd_serve(args) -> int:
+    built = _build_server(args)
+    if built is None:
         return 2
-    cfg = getattr(LlamaConfig, args.preset)()
+    server, sched = built
+    host, port = server.server_address[:2]
+    print(f"serving {args.preset} on http://{host}:{port} "
+          f"(POST /generate, /abort; GET /health)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        sched.stop()
+    return 0
+
+
+def _cmd_plan(args) -> int:
+    from colossalai_tpu.auto_parallel import plan_parallelism
+
+    cfg = _resolve_preset(args.preset)
+    if cfg is None:
+        return 2
     plans = plan_parallelism(
         cfg, args.devices, int(args.hbm_gib * 2**30), args.batch, args.seq,
         peak_flops=args.peak_tflops * 1e12, multi_host_dp=args.multi_host,
@@ -131,6 +212,28 @@ def main(argv=None) -> int:
     p_plan.add_argument("--multi-host", action="store_true",
                         help="cost the dp gradient sync at DCN rates")
     p_plan.set_defaults(fn=_cmd_plan)
+
+    p_serve = sub.add_parser(
+        "serve", help="serve a checkpoint over HTTP (paged engine, SSE streaming)"
+    )
+    p_serve.add_argument("--preset", required=True,
+                         help="LlamaConfig classmethod name (e.g. llama3_8b)")
+    p_serve.add_argument("--checkpoint", default=None,
+                         help="safetensors dir saved by CheckpointIO.save_model "
+                              "(convert raw HF checkpoints with "
+                              "checkpoint_io.hf_interop first); "
+                              "omit = random demo weights")
+    p_serve.add_argument("--tokenizer", default=None,
+                         help="local HF tokenizer path: enables text prompts")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8000)
+    p_serve.add_argument("--max-batch-size", type=int, default=8)
+    p_serve.add_argument("--max-seq-len", type=int, default=2048)
+    p_serve.add_argument("--block-size", type=int, default=64)
+    p_serve.add_argument("--tp", type=int, default=1)
+    p_serve.add_argument("--pp", type=int, default=1)
+    p_serve.add_argument("--seed", type=int, default=0)
+    p_serve.set_defaults(fn=_cmd_serve)
 
     args = parser.parse_args(argv)
     if args.command == "run":
