@@ -93,33 +93,20 @@ def _resolve_preset(preset: str):
 def _build_server(args):
     """serve's engine+server assembly, separated so tests can drive it
     without serve_forever."""
+    # cheap validation BEFORE the jax import: an unknown preset must not
+    # pay (or risk) backend initialization just to print an error
+    cfg = _resolve_preset(args.preset)
+    if cfg is None:
+        return None
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from colossalai_tpu.inference import LLMEngine, make_server
-
-    cfg = _resolve_preset(args.preset)
-    if cfg is None:
-        return None
     from colossalai_tpu.models import LlamaForCausalLM
 
-    model = LlamaForCausalLM(cfg)
-    rng = jax.random.PRNGKey(args.seed)
-    ids = jnp.ones((1, 8), jnp.int32)
-    if args.checkpoint:
-        from colossalai_tpu.checkpoint_io import CheckpointIO
-
-        # eval_shape target: never materialize a full random init just to
-        # overwrite it (an 8B preset would be ~32 GiB of thrown-away fp32)
-        target = jax.eval_shape(lambda r: model.init(r, ids), rng)["params"]
-        params = {"params": CheckpointIO().load_model(
-            args.checkpoint, target=target
-        )}
-    else:
-        print("WARNING: no --checkpoint — serving RANDOM weights (demo mode)",
-              file=sys.stderr)
-        params = model.init(rng, ids)
+    # mesh validation next — still before any multi-GiB load
     mesh = None
     if args.pp > 1 or args.tp > 1:
         from jax.sharding import Mesh
@@ -132,6 +119,40 @@ def _build_server(args):
             return None
         devices = np.array(jax.devices()[:need])
         mesh = Mesh(devices.reshape(args.pp, args.tp), ("pp", "tp"))
+
+    model = LlamaForCausalLM(cfg)
+    rng = jax.random.PRNGKey(args.seed)
+    ids = jnp.ones((1, 8), jnp.int32)
+    if args.checkpoint:
+        from colossalai_tpu.checkpoint_io import CheckpointIO
+
+        # eval_shape target: never materialize a full random init just to
+        # overwrite it (an 8B preset would be ~32 GiB of thrown-away fp32)
+        target = jax.eval_shape(lambda r: model.init(r, ids), rng)["params"]
+        shardings = None
+        if mesh is not None and args.pp == 1:
+            # tp-only: load straight into the engine's policy layout so a
+            # 70B-class model never materializes unsharded on one device.
+            # (pp meshes load replicated: the stage reshape wants the full
+            # layer stack before it splits to [pp, L/pp, ...].)
+            from jax.sharding import NamedSharding
+
+            from colossalai_tpu.shardformer.policies.auto_policy import (
+                get_autopolicy,
+            )
+
+            specs = get_autopolicy("llama").param_specs(target)
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: not isinstance(x, dict),
+            )
+        params = {"params": CheckpointIO().load_model(
+            args.checkpoint, target=target, shardings=shardings
+        )}
+    else:
+        print("WARNING: no --checkpoint — serving RANDOM weights (demo mode)",
+              file=sys.stderr)
+        params = model.init(rng, ids)
     engine = LLMEngine(
         params, cfg, max_batch_size=args.max_batch_size,
         max_seq_len=args.max_seq_len, block_size=args.block_size, mesh=mesh,

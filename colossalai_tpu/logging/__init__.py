@@ -1,3 +1,4 @@
 from .logger import DistributedLogger, get_dist_logger
+from .metrics import MetricsLogger
 
-__all__ = ["DistributedLogger", "get_dist_logger"]
+__all__ = ["DistributedLogger", "MetricsLogger", "get_dist_logger"]

@@ -1,0 +1,129 @@
+"""Training-metrics sink: windowed console lines + append-only jsonl.
+
+≙ reference trainer monitoring (``legacy/trainer/hooks/_log_hook.py``
+LogMetricByEpochHook / TensorboardHook, and the example trainers' tqdm +
+tensorboard writers). TPU redesign: no tensorboard dependency — an
+append-only jsonl (one record per log window, machine-readable, loads
+into pandas or a tensorboard importer in two lines) plus rank-0 console
+lines through the DistributedLogger. Append-only matters: it survives
+preemption and composes with ``elastic``'s resume — a restarted run
+keeps appending to the same history.
+
+Usage::
+
+    metrics = MetricsLogger("runs/exp1/metrics.jsonl", log_every=20)
+    for step, batch in enumerate(loader):
+        state, m = boosted.train_step(state, batch)
+        metrics.log(step, m)     # device scalars fetched HERE, once per
+    metrics.close()              # window tail is flushed
+
+Values may be python numbers or scalar jax arrays; non-scalars and
+non-numerics are ignored (a metrics dict can carry logits/debug cargo).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .logger import DistributedLogger, get_dist_logger
+
+
+def _scalar(v: Any) -> Optional[float]:
+    """float(v) for scalars, None for everything else. Non-finite values
+    pass through — a NaN loss in the record is the signal, not noise."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+class MetricsLogger:
+    """Windowed metrics aggregation → jsonl + rank-0 console."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        log_every: int = 10,
+        logger: Optional[DistributedLogger] = None,
+    ):
+        if log_every < 1:
+            raise ValueError(f"log_every={log_every} must be >= 1")
+        self.path = path
+        self.log_every = log_every
+        self.logger = logger or get_dist_logger()
+        self._file = None
+        self._is_writer = self._process_index() == 0
+        if path is not None and self._is_writer:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._file = open(path, "a", encoding="utf-8")
+        self._sums: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._window = 0
+        self._last_step: Optional[int] = None
+        self._t0 = time.perf_counter()
+
+    @staticmethod
+    def _process_index() -> int:
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    # ------------------------------------------------------------------ api
+    def log(self, step: int, metrics: Dict[str, Any]) -> None:
+        """Accumulate one step's metrics; flushes every ``log_every``
+        calls. Fetching ``float(...)`` here is the device sync point —
+        call it once per step, not per metric consumer."""
+        for k, v in metrics.items():
+            f = _scalar(v)
+            if f is None:
+                continue
+            self._sums[k] = self._sums.get(k, 0.0) + f
+            self._counts[k] = self._counts.get(k, 0) + 1
+        self._window += 1
+        self._last_step = int(step)
+        if self._window >= self.log_every:
+            self.flush()
+
+    def flush(self) -> Optional[Dict[str, float]]:
+        """Emit the current window (mean per key + steps/s); returns the
+        record (also on non-writer ranks, for tests/metrics piggybacking)."""
+        if not self._window:
+            return None
+        dt = time.perf_counter() - self._t0
+        record: Dict[str, Any] = {
+            "step": self._last_step,
+            "steps_per_s": round(self._window / max(dt, 1e-9), 3),
+        }
+        for k in sorted(self._sums):
+            record[k] = self._sums[k] / max(self._counts[k], 1)
+        if self._is_writer:
+            if self._file is not None:
+                self._file.write(json.dumps(record) + "\n")
+                self._file.flush()
+            body = " ".join(
+                f"{k}={v:.4g}" for k, v in record.items() if k != "step"
+            )
+            self.logger.info(f"step {record['step']}: {body}", ranks=[0])
+        self._sums.clear()
+        self._counts.clear()
+        self._window = 0
+        self._t0 = time.perf_counter()
+        return record
+
+    def close(self) -> None:
+        self.flush()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

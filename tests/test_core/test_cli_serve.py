@@ -92,3 +92,27 @@ def test_serve_loads_saved_checkpoint(tmp_path):
     finally:
         server.shutdown()
         sched.stop()
+
+
+def test_serve_checkpoint_loads_sharded_on_tp_mesh(tmp_path):
+    """tp-only serving loads the checkpoint straight into the policy
+    layout — weights arrive on the mesh, never unsharded on one device."""
+    import jax
+    import jax.numpy as jnp
+
+    from colossalai_tpu.checkpoint_io import CheckpointIO
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(4), jnp.ones((1, 8), jnp.int32))
+    CheckpointIO().save_model(params["params"], str(tmp_path / "ckpt"))
+    server, sched = _build_server(
+        _args(checkpoint=str(tmp_path / "ckpt"), tp=2))
+    try:
+        eng = server._scheduler.engine
+        qk = eng.params["params"]["layers"]["block"]["self_attn"]["q_proj"]["kernel"]
+        assert len(qk.sharding.device_set) == 2, qk.sharding
+    finally:
+        server.server_close()
+        sched.stop()

@@ -1,0 +1,56 @@
+"""MetricsLogger (≙ reference trainer monitor/TensorBoard hooks):
+windowed means into append-only jsonl + rank-0 console."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from colossalai_tpu.logging import MetricsLogger
+
+
+def test_windowed_means_and_jsonl(tmp_path):
+    path = tmp_path / "run" / "metrics.jsonl"
+    with MetricsLogger(str(path), log_every=10) as m:
+        for step in range(25):
+            m.log(step, {"loss": float(step), "lr": 0.5,
+                         "grad_norm": jnp.asarray(2.0),
+                         "logits": jnp.zeros((4, 8)),   # non-scalar: ignored
+                         "note": "text"})               # non-numeric: ignored
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    # two full windows + the close() tail
+    assert [r["step"] for r in rows] == [9, 19, 24]
+    assert rows[0]["loss"] == pytest.approx(sum(range(10)) / 10)
+    assert rows[1]["loss"] == pytest.approx(sum(range(10, 20)) / 10)
+    assert rows[2]["loss"] == pytest.approx(sum(range(20, 25)) / 5)
+    assert all(r["lr"] == 0.5 and r["grad_norm"] == 2.0 for r in rows)
+    assert all(r["steps_per_s"] > 0 for r in rows)
+    assert all("logits" not in r and "note" not in r for r in rows)
+
+
+def test_append_only_survives_restart(tmp_path):
+    """The elastic-resume pairing: a restarted run keeps appending to the
+    same history file."""
+    path = tmp_path / "metrics.jsonl"
+    with MetricsLogger(str(path), log_every=2) as m:
+        m.log(0, {"loss": 1.0})
+        m.log(1, {"loss": 1.0})
+    with MetricsLogger(str(path), log_every=2) as m:  # "resumed" process
+        m.log(2, {"loss": 0.5})
+        m.log(3, {"loss": 0.5})
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["step"] for r in rows] == [1, 3]
+
+
+def test_flush_returns_record_and_resets():
+    m = MetricsLogger(None, log_every=100)
+    m.log(0, {"loss": 2.0})
+    rec = m.flush()
+    assert rec["loss"] == 2.0 and rec["step"] == 0
+    assert m.flush() is None  # empty window
+    m.close()
+
+
+def test_log_every_validated():
+    with pytest.raises(ValueError, match="log_every"):
+        MetricsLogger(None, log_every=0)
