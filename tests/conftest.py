@@ -33,10 +33,44 @@ jax.config.update("jax_platforms", "cpu")
 # while multi-device programs always compile fresh (exactly the previous
 # cache-off behavior). Revisit when a jaxlib fixes the reload rendezvous.
 if os.environ.get("CLT_TEST_CACHE", "1") != "0":
-    _cache_dir = os.environ.get(
-        "CLT_TEST_CACHE_DIR",
-        os.path.expanduser("~/.cache/colossalai_tpu_test_jax_cache"),
-    )
+    # key the default cache dir by a CPU fingerprint: XLA:CPU AOT
+    # artifacts encode the COMPILE machine's features, and reloading them
+    # on a different host is at best a wall of cpu_aot_loader errors and
+    # at worst a SIGILL mid-suite (observed: a cache carried across build
+    # hosts crashed the run). A host-keyed dir makes cross-host reuse
+    # structurally impossible.
+    import hashlib as _hashlib
+    import platform as _platform
+
+    try:
+        with open("/proc/cpuinfo") as _f:
+            _cpu_id = next(
+                (l for l in _f if l.startswith(("flags", "Features"))),
+                _platform.machine(),
+            )
+    except OSError:
+        _cpu_id = _platform.machine() + _platform.processor()
+    _fp = _hashlib.sha1(_cpu_id.encode()).hexdigest()[:10]
+    _override = os.environ.get("CLT_TEST_CACHE_DIR")
+    if _override:
+        # the fingerprint rides along even on explicit overrides (e.g. a
+        # shared/NFS cache root): heterogeneous hosts must never reload
+        # each other's AOT artifacts
+        _cache_dir = os.path.join(_override, _fp)
+    else:
+        _cache_dir = os.path.expanduser(
+            f"~/.cache/colossalai_tpu_test_jax_cache-{_fp}"
+        )
+        # bound ~/.cache growth: drop the legacy unkeyed dir and caches
+        # fingerprinted for other/previous CPU generations
+        import glob as _glob
+        import shutil as _shutil
+
+        for _old in _glob.glob(
+            os.path.expanduser("~/.cache/colossalai_tpu_test_jax_cache*")
+        ):
+            if _old != _cache_dir:
+                _shutil.rmtree(_old, ignore_errors=True)
     try:
         import inspect
 
@@ -89,6 +123,17 @@ def _reset_singletons():
     from colossalai_tpu.accelerator import api
 
     api._CURRENT = None
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compile_state():
+    # A full run compiles ~500 programs into ONE process; rare XLA:CPU
+    # compile segfaults were observed only deep into such runs (the same
+    # test passes standalone). Dropping the in-memory executable/tracing
+    # caches per module bounds the accumulated native state; single-device
+    # programs come back cheaply from the on-disk cache.
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture
