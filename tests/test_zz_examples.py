@@ -60,3 +60,11 @@ def test_example_dit_diffusion():
 def test_example_dpo():
     out = _run(["examples/rlhf/dpo_train.py", "--steps", "4"])
     assert "loss" in out.lower()
+
+
+@pytest.mark.slow
+def test_example_searched_train():
+    out = _run(["examples/auto_parallel/searched_train.py", "--steps", "3"])
+    assert "plan:" in out and "final loss" in out, out
+    # the search branch runs for pp-free plans and says why otherwise
+    assert "searched:" in out or "search skipped:" in out, out
