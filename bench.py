@@ -392,6 +392,15 @@ def child_main():
             except Exception as e:
                 print(f"ring-sp bench failed: {e}", file=sys.stderr)
 
+    try:
+        # autotuner visibility: chosen tilings per (kernel, device, shape
+        # bucket, dtype) plus cache hit/miss counters for this process
+        from colossalai_tpu.kernel import tuning
+
+        extras["kernel_tuning"] = tuning.stats()
+    except Exception as e:
+        print(f"tuning stats failed: {e}", file=sys.stderr)
+
     result = {
         "metric": f"llama_{primary['n_params_b']}B_pretrain_mfu_bs{bs}_seq{seq}",
         "value": primary["mfu"],
@@ -475,7 +484,7 @@ def _scan_last_good():
 
 
 def _failure_json(last_err: str, attempt: int, probe_failures: int, *,
-                  provisional: bool = False):
+                  provisional: bool = False, probes=None, backoff=None):
     failure = {
         "metric": "llama_pretrain_mfu",
         "value": 0.0,
@@ -488,6 +497,11 @@ def _failure_json(last_err: str, attempt: int, probe_failures: int, *,
         "bench_attempts": attempt,
         "probe_failures": probe_failures,
     }
+    if probes:
+        # per-probe [status, seconds] — was the tunnel slow, dead, or flapping?
+        failure["probe_history"] = probes[-8:]
+    if backoff:
+        failure["backoff_s"] = backoff[-8:]
     if provisional:
         failure["provisional"] = True
     good = _scan_last_good()
@@ -509,6 +523,7 @@ def supervise():
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1200"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
     delay, attempt, soft_failures, probe_failures = 10.0, 0, 0, 0
+    probe_history, backoff_history = [], []  # [status, seconds] / slept delays
     last_err = "no attempts ran"
     # FIRST act: a provisional failure line, flushed. If anything — including
     # the driver — kills this process at any later point, stdout already
@@ -529,7 +544,9 @@ def supervise():
         if remaining < 30.0:
             last_err = f"deadline exhausted ({last_err})"
             break
+        t_probe = time.monotonic()
         status, probe_err = _backend_probe(min(probe_timeout, remaining - 15.0))
+        probe_history.append([status, round(time.monotonic() - t_probe, 1)])
         if status != "ok":
             probe_failures += 1
             if status == "timeout":
@@ -548,10 +565,12 @@ def supervise():
             # refresh the provisional record: if the driver kills us later,
             # the newest (= last) JSON line carries CURRENT counts and error,
             # and stays inside the driver's bounded output-tail window
-            print(json.dumps(_failure_json(last_err, attempt, probe_failures,
-                                           provisional=True)), flush=True)
+            print(json.dumps(_failure_json(
+                last_err, attempt, probe_failures, provisional=True,
+                probes=probe_history, backoff=backoff_history)), flush=True)
             if soft_failures >= 2 or time.monotonic() + delay > deadline:
                 break
+            backoff_history.append(delay)
             time.sleep(delay)
             delay = min(delay * 2, 120.0)
             continue
@@ -586,8 +605,9 @@ def supervise():
             last_err = f"attempt {attempt}: rc={proc.returncode}: {err_tail}"
             retryable = any(s in err_tail for s in _RETRYABLE)
         print(last_err, file=sys.stderr)
-        print(json.dumps(_failure_json(last_err, attempt, probe_failures,
-                                       provisional=True)), flush=True)
+        print(json.dumps(_failure_json(
+            last_err, attempt, probe_failures, provisional=True,
+            probes=probe_history, backoff=backoff_history)), flush=True)
         if not retryable:
             # a deterministic failure (bad config, OOM) won't heal — allow one
             # re-run for flakes, then stop burning the deadline
@@ -596,9 +616,12 @@ def supervise():
                 break
         if time.monotonic() + delay > deadline:
             break
+        backoff_history.append(delay)
         time.sleep(delay)
         delay = min(delay * 2, 120.0)
-    print(json.dumps(_failure_json(last_err, attempt, probe_failures)),
+    print(json.dumps(_failure_json(last_err, attempt, probe_failures,
+                                   probes=probe_history,
+                                   backoff=backoff_history)),
           flush=True)
 
 

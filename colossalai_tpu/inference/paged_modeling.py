@@ -110,6 +110,7 @@ def decode_paged(
         k_pool = k_pool.at[wb, :, wo].set(k_new_tok)
         v_pool = v_pool.at[wb, :, wo].set(v_new_tok)
         if use_kernel:
+            from colossalai_tpu.kernel import fused_add_rms_norm
             from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
 
             q = _proj(h, layer_params["self_attn"]["q_proj"], dtype)
@@ -118,11 +119,15 @@ def decode_paged(
             q = apply_rope(q[:, None], cos, sin)[:, 0]
             attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1)
             attn = attn.reshape(n_slots, 1, cfg.num_attention_heads * cfg.head_dim_)
-            x = x + (
+            attn_out = (
                 attn.astype(dtype)
                 @ layer_params["self_attn"]["o_proj"]["kernel"].astype(dtype)
             )
-            h2 = _rms(x, layer_params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+            # fused residual+norm kernel: h2 = rms(x + attn_out), x = x + attn_out
+            h2, x = fused_add_rms_norm(
+                x, attn_out, layer_params["post_attention_layernorm"]["scale"],
+                eps=cfg.rms_norm_eps,
+            )
             gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
             up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
             x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)

@@ -6,9 +6,11 @@ available implementation per op. On TPU the "best" path is a Pallas kernel;
 the fallback is plain jnp, which XLA still fuses well.
 """
 
+from . import tuning
 from .loader import KernelLoader
 from .ops import (
     flash_attention,
+    fused_add_rms_norm,
     fused_layer_norm,
     fused_rms_norm,
     fused_softmax,
@@ -20,10 +22,12 @@ from .ops import (
 __all__ = [
     "KernelLoader",
     "flash_attention",
+    "fused_add_rms_norm",
     "fused_layer_norm",
     "fused_rms_norm",
     "fused_softmax",
     "rope_and_cache_update",
     "rope_embed",
     "silu_and_mul",
+    "tuning",
 ]

@@ -17,20 +17,40 @@ from .loader import KernelLoader, on_tpu
 # ≙ extensions/pybind/flash_attention + flash_decoding_attention_kernel.cu
 
 
-def _flash_attention_xla(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None, sliding_window=None):
+def _flash_attention_xla(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None,
+                         sliding_window=None, rope_theta=None, q_positions=None,
+                         kv_positions=None):
     from colossalai_tpu.shardformer.layer.attention import xla_attention
 
+    if rope_theta is not None:
+        # same math as the fused kernel path, applied up front; q and kv
+        # positions can differ (ring-style chunks), so rotate separately
+        from colossalai_tpu.models.llama import apply_rope, rope_table
+
+        if q_positions is None:
+            q_positions = jnp.broadcast_to(
+                jnp.arange(q.shape[1], dtype=jnp.int32)[None, :], q.shape[:2])
+        if kv_positions is None:
+            kv_positions = q_positions
+        cos, sin = rope_table(q_positions, q.shape[-1], rope_theta)
+        q = apply_rope(q, cos, sin)
+        cos, sin = rope_table(kv_positions, q.shape[-1], rope_theta)
+        k = apply_rope(k, cos, sin)
     return xla_attention(
         q, k, v, causal=causal, segment_ids=segment_ids,
         softmax_scale=softmax_scale, sliding_window=sliding_window,
     )
 
 
-def _flash_attention_pallas(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None, sliding_window=None):
+def _flash_attention_pallas(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None,
+                            sliding_window=None, rope_theta=None, q_positions=None,
+                            kv_positions=None):
     from .pallas.flash_attention import flash_attention as fa
 
     return fa(q, k, v, causal=causal, segment_ids=segment_ids,
-              softmax_scale=softmax_scale, sliding_window=sliding_window)
+              softmax_scale=softmax_scale, sliding_window=sliding_window,
+              rope_theta=rope_theta, q_positions=q_positions,
+              kv_positions=kv_positions)
 
 
 def _pallas_module(name: str):
@@ -50,11 +70,17 @@ KernelLoader.register("flash_attention", "pallas", _pallas_module("flash_attenti
 KernelLoader.register("flash_attention", "xla", lambda: True, _flash_attention_xla)
 
 
-def flash_attention(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None, sliding_window=None):
-    """[B, S, H, D] attention via the best available kernel."""
+def flash_attention(q, k, v, *, causal=True, segment_ids=None, softmax_scale=None,
+                    sliding_window=None, rope_theta=None, q_positions=None,
+                    kv_positions=None):
+    """[B, S, H, D] attention via the best available kernel. ``rope_theta``
+    folds the rotary embedding into the kernel's q/k load path (Pallas) or
+    applies the identical rotation up front (XLA fallback)."""
     fn = KernelLoader.load("flash_attention")
     return fn(q, k, v, causal=causal, segment_ids=segment_ids,
-              softmax_scale=softmax_scale, sliding_window=sliding_window)
+              softmax_scale=softmax_scale, sliding_window=sliding_window,
+              rope_theta=rope_theta, q_positions=q_positions,
+              kv_positions=kv_positions)
 
 
 # ------------------------------------------------------------------ RMSNorm
@@ -83,6 +109,13 @@ KernelLoader.register("rms_norm", "xla", lambda: True, _rms_norm_xla)
 def fused_rms_norm(x, scale, eps: float = 1e-5, residual=None):
     """RMSNorm; with ``residual`` returns (normed, x+residual) like the
     reference's fused_add_rms_layernorm."""
+    return KernelLoader.load("rms_norm")(x, scale, eps=eps, residual=residual)
+
+
+def fused_add_rms_norm(x, residual, scale, eps: float = 1e-5):
+    """Single-HBM-pass ``s = x + residual; (rms_norm(s) * scale, s)`` — the
+    twice-per-decoder-layer residual+norm step. Pallas on TPU (one kernel,
+    no separate XLA add); identical-math jnp composition elsewhere."""
     return KernelLoader.load("rms_norm")(x, scale, eps=eps, residual=residual)
 
 

@@ -1,0 +1,33 @@
+"""Pallas TPU kernel inventory.
+
+Every public kernel exported here must have an interpret-mode parity test
+under ``tests/test_kernel/`` — enforced by
+``tests/test_kernel/test_kernel_coverage.py``, which walks ``__all__``.
+See ``docs/kernels.md`` for the inventory, tuning cache, and fusion flags.
+"""
+
+from .flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+)
+from .layer_norm import layer_norm
+from .paged_attention import paged_attention
+from .rms_norm import fused_add_rms_norm, rms_norm
+from .rope import fused_rope, rope_and_cache_update
+from .softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "fused_add_rms_norm",
+    "fused_rope",
+    "layer_norm",
+    "paged_attention",
+    "rms_norm",
+    "rope_and_cache_update",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+]

@@ -11,3 +11,18 @@ def interpret_mode() -> bool:
         return jax.devices()[0].platform != "tpu"
     except RuntimeError:
         return True
+
+
+def mask_value(dtype) -> float:
+    """Finite large-negative fill for masked score entries.
+
+    ``-inf`` produces NaN through ``inf - inf`` in online-softmax rescaling,
+    and a fixed ``-1e9`` is not representable as a *large* value in every
+    dtype (it's ~3% of bf16's range but astronomically far from f16's).
+    ``-0.7 * finfo.max`` stays finite in the score dtype, exponentiates to
+    exactly 0.0, and leaves headroom so `fill - max_score` cannot overflow
+    to -inf.
+    """
+    import jax.numpy as jnp
+
+    return -0.7 * float(jnp.finfo(dtype).max)

@@ -20,6 +20,18 @@ RingAttention's position-exact masks, ``attn.py:54,406``):
 - sliding window (Mistral), also position-exact;
 - segment ids (packed varlen, ≙ varlen_kvpacked path).
 
+RoPE fusion (``rope_theta``): the rotary embedding is applied to q/k tiles
+on load inside the kernels — per layer this deletes the standalone rope
+kernel's full q+k HBM round-trip (read, rotate, write, re-read). Rotation
+is orthogonal, so the backward kernels rotate q/k on load the same way and
+un-rotate dq/dk once at finalize (rotation by -pos), exactly mirroring
+``rope.py``'s VJP. The standalone ``rope.py`` kernel stays for
+non-attention callers (decode cache updates, partial-rotary models).
+
+Tile sizes: explicit ``block_q``/``block_kv`` are honored as caps; when
+omitted they come from the persistent tuning cache (``kernel.tuning``) on
+TPU and from the static defaults under interpret mode / CPU.
+
 Backward follows the standard two-pass flash design: a dq pass (grid over q
 blocks, inner kv) and a dk/dv pass (grid over kv blocks, inner q), both
 recomputing probs from the saved per-row LSE with the same masks.
@@ -28,6 +40,7 @@ recomputing probs from the saved per-row LSE with the same masks.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -35,22 +48,43 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-#: measured on v5e at 16k seq (fwd 53 / bwd 64 TF/s, ~5% over 512/1024):
-#: 1024x1024 tiles win; larger tiles exceed VMEM
+from ._common import interpret_mode as _interpret
+from ._common import mask_value as _mask_value
+
+#: static fallbacks, measured on v5e at 16k seq (fwd 53 / bwd 64 TF/s, ~5%
+#: over 512/1024); the tuning cache supersedes them per chip/shape/dtype
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
 
 
 def pick_block(seq: int, cap: int) -> int:
-    """Largest tile <= cap dividing ``seq``. When no standard tile divides
-    it (seq not 128-aligned), return min(seq, cap) so the caller's
-    divisibility check fails LOUDLY instead of attempting an over-cap tile;
-    sub-128 sequences tile whole (interpret-mode tests)."""
+    """Largest tile <= cap dividing ``seq``; sub-128 sequences tile whole
+    (interpret-mode tests). Non-128-aligned sequences >= 128 cannot be tiled
+    by any supported block — fail here at the selection site, naming the
+    nearest valid lengths, instead of letting the caller's divisibility
+    check (or a Mosaic lowering error) produce something opaque."""
     for b in (cap, 512, 256, 128):
         if b <= cap and b <= seq and seq % b == 0:
             return b
-    return min(seq, cap)
+    if seq < 128:
+        return min(seq, cap)
+    lo = (seq // 128) * 128
+    raise ValueError(
+        f"flash attention needs a 128-aligned sequence length to tile: got "
+        f"seq={seq}; nearest valid lengths are {lo} and {lo + 128} "
+        f"(no tile in ({cap}, 512, 256, 128) divides {seq})"
+    )
+
+
+#: per-row LSE sentinel for fully-masked rows: finite and large-negative so
+#: ring-attention merges (exp(lse - max)) treat the row as weightless. This
+#: is an OUTPUT encoding, deliberately NOT the score-mask fill below.
 _NEG_INF = -1e9
+
+#: score-mask fill: scores are always f32 (preferred_element_type), so the
+#: dtype-aware finite fill exponentiates to exactly 0.0 without the
+#: inf - inf NaNs of a true -inf (see _common.mask_value)
+_MASK_FILL = _mask_value(jnp.float32)
 
 
 # Mosaic tiling: a [B, S] int vector cannot be block-specced as (1, block),
@@ -62,6 +96,20 @@ _LANES = 128
 _SUBLANES = 8
 
 
+def _q_side(a):
+    """[B, S] → [B, S, LANES] (values along sublanes)."""
+    return None if a is None else jax.lax.broadcast_in_dim(
+        a, (a.shape[0], a.shape[1], _LANES), (0, 1)
+    )
+
+
+def _kv_side(a):
+    """[B, S] → [B, SUBLANES, S] (values along lanes)."""
+    return None if a is None else jax.lax.broadcast_in_dim(
+        a, (a.shape[0], _SUBLANES, a.shape[1]), (0, 2)
+    )
+
+
 def _q_col(ref):
     """(block_q, 1) value column from a q-side [1, block_q, LANES] tile."""
     return ref[0][:, :1]
@@ -70,6 +118,29 @@ def _q_col(ref):
 def _kv_row(ref):
     """(1, block_kv) value row from a kv-side [1, SUBLANES, block_kv] tile."""
     return ref[0][:1, :]
+
+
+def _rope_rows(x, pos_col, theta, negate=False):
+    """Rotate each row of ``x`` [rows, d] by RoPE at its position
+    ([rows, 1] int32). HF half-split convention — identical math to
+    ``rope.py``'s kernel and ``models.llama.apply_rope``, f32 compute, cast
+    back to ``x.dtype`` (the same rounding point as the unfused path).
+    ``negate`` applies the inverse rotation (orthogonal transpose) — the
+    backward kernels un-rotate dq/dk with it."""
+    d = x.shape[-1]
+    half = d // 2
+    x32 = x.astype(jnp.float32)
+    inv_freq = jnp.exp(
+        jax.lax.broadcasted_iota(jnp.float32, (1, half), 1)
+        * (-math.log(theta) / half)
+    )
+    pos = pos_col.astype(jnp.float32)
+    angles = (-pos if negate else pos) * inv_freq  # [rows, half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    x1, x2 = x32[:, :half], x32[:, half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
 
 
 def _tile_mask(qi, ki, qpos_ref, kpos_ref, qseg_ref, kseg_ref, *, causal,
@@ -125,24 +196,19 @@ def _tile_needed(qi, ki, qpos_ref, kpos_ref, *, causal, window, block_q, block_k
 
 def _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg):
     """[B, S] vectors → Mosaic-tileable layouts (see _LANES/_SUBLANES)."""
-    q_side = lambda a: None if a is None else jax.lax.broadcast_in_dim(
-        a, (a.shape[0], a.shape[1], _LANES), (0, 1)
-    )
-    kv_side = lambda a: None if a is None else jax.lax.broadcast_in_dim(
-        a, (a.shape[0], _SUBLANES, a.shape[1]), (0, 2)
-    )
-    return q_side(qpos), kv_side(kpos), q_side(qseg), kv_side(kseg)
+    return _q_side(qpos), _kv_side(kpos), _q_side(qseg), _kv_side(kseg)
 
 
 # ----------------------------------------------------------------- forward
 
 
 def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
-                block_kv, num_kv_blocks):
+                block_kv, num_kv_blocks, rope_theta):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     qpos_ref = next(it) if has_pos else None
     kpos_ref = next(it) if has_pos else None
+    kposc_ref = next(it) if rope_theta is not None else None
     qseg_ref = next(it) if has_seg else None
     kseg_ref = next(it) if has_seg else None
     o_ref, lse_ref = next(it), next(it)
@@ -154,7 +220,7 @@ def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
     @pl.when(ki == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, _MASK_FILL)
         l_ref[:] = jnp.zeros_like(l_ref)
 
     needed = _tile_needed(
@@ -166,6 +232,9 @@ def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
     def _compute():
         q = q_ref[0, 0]  # [block_q, d] native dtype → MXU bf16 path
         k = k_ref[0, 0]  # [block_kv, d]
+        if rope_theta is not None:
+            q = _rope_rows(q, _q_col(qpos_ref), rope_theta)
+            k = _rope_rows(k, _q_col(kposc_ref), rope_theta)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_kv]
@@ -175,7 +244,7 @@ def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
             causal=causal, window=window, block_q=block_q, block_kv=block_kv,
         )
         if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
+            s = jnp.where(mask, s, _MASK_FILL)
 
         m_prev = m_ref[:]  # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -183,7 +252,7 @@ def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)  # [block_q, block_kv]
         if mask is not None:
-            # fully-masked rows: m stays _NEG_INF, exp(-1e9 - -1e9)=1 rows
+            # fully-masked rows: m stays at the fill, exp(fill - fill)=1 rows
             # must not pollute l/acc
             p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
@@ -200,35 +269,45 @@ def _fwd_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
         l = l_ref[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        # fully-masked rows keep lse = -inf-ish so ring merges ignore them
+        # fully-masked rows keep the finite lse sentinel so ring merges
+        # ignore them and downstream math stays NaN-free
         lse = jnp.where(l == 0.0, _NEG_INF, m_ref[:] + jnp.log(safe_l))
         lse_ref[0, 0] = lse
 
 
 def _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, kv_major=False,
-                q_steps=None):
-    """BlockSpecs for the optional (qpos, kpos, qseg, kseg) inputs.
+                q_steps=None, has_rope=False):
+    """BlockSpecs for the optional (qpos, kpos, [kposc], qseg, kseg) inputs.
     Grid is (b*h, nq, nkv), or (b*h, nkv, nq) when ``kv_major`` (dkv pass).
     ``q_steps``: the dkv pass's combined (group, q-block) axis — the last
     grid index is g = group_idx * q_steps + qi and mask tiles (per-batch,
     head-independent) index by qi = g % q_steps.
-    q-side arrays are [B, Sq, LANES]; kv-side [B, SUBLANES, Skv]."""
+    q-side arrays are [B, Sq, LANES]; kv-side [B, SUBLANES, Skv]; the rope
+    fusion's ``kposc`` is the kv positions in q-side layout ([B, Skv,
+    LANES], indexed by the kv-block axis) so the kernels read a
+    (block_kv, 1) position COLUMN to rotate k rows without an in-kernel
+    transpose."""
     if kv_major:
         qi_of = (lambda g: g) if q_steps is None else (lambda g: g % q_steps)
         q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, g: (bh // h, qi_of(g), 0), memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, ki, g: (bh // h, 0, ki), memory_space=pltpu.VMEM)
+        kposc_spec = pl.BlockSpec((1, block_kv, _LANES), lambda bh, ki, g: (bh // h, ki, 0), memory_space=pltpu.VMEM)
     else:
         q_spec = pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh // h, qi, 0), memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, _SUBLANES, block_kv), lambda bh, qi, ki: (bh // h, 0, ki), memory_space=pltpu.VMEM)
+        kposc_spec = pl.BlockSpec((1, block_kv, _LANES), lambda bh, qi, ki: (bh // h, ki, 0), memory_space=pltpu.VMEM)
     specs = []
     if has_pos:
         specs += [q_spec, kv_spec]
+    if has_rope:
+        specs += [kposc_spec]
     if has_seg:
         specs += [q_spec, kv_spec]
     return specs
 
 
-def _fwd(q, k, v, qpos, kpos, qseg, kseg, *, scale, causal, window, block_q, block_kv):
+def _fwd(q, k, v, qpos, kpos, qseg, kseg, *, scale, causal, window, block_q,
+         block_kv, rope_theta=None):
     """q [B,H,Sq,D], k/v [B,Hkv,Skv,D] → out [B,H,Sq,D], lse [B,H,Sq,1]."""
     b, h, sq, d = q.shape
     _, hkv, skv, _ = k.shape
@@ -237,6 +316,9 @@ def _fwd(q, k, v, qpos, kpos, qseg, kseg, *, scale, causal, window, block_q, blo
     nkv = pl.cdiv(skv, block_kv)
     has_pos = qpos is not None
     has_seg = qseg is not None
+    has_rope = rope_theta is not None
+    if has_rope and not has_pos:
+        raise ValueError("rope fusion needs explicit q/kv positions")
 
     grid = (b * h, nq, nkv)
 
@@ -244,16 +326,19 @@ def _fwd(q, k, v, qpos, kpos, qseg, kseg, *, scale, causal, window, block_q, blo
         _fwd_kernel, scale=scale, causal=causal, window=window,
         has_pos=has_pos, has_seg=has_seg,
         block_q=block_q, block_kv=block_kv, num_kv_blocks=nkv,
+        rope_theta=rope_theta,
     )
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
-    ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv)
+    ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv, has_rope=has_rope)
     qpos_t, kpos_t, qseg_t, kseg_t = _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg)
     args = [q, k, v]
     if has_pos:
         args += [qpos_t, kpos_t]
+    if has_rope:
+        args += [_q_side(kpos)]
     if has_seg:
         args += [qseg_t, kseg_t]
     out, lse = pl.pallas_call(
@@ -282,11 +367,12 @@ def _fwd(q, k, v, qpos, kpos, qseg, kseg, *, scale, causal, window, block_q, blo
 
 
 def _bwd_dq_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
-                   block_kv, num_kv_blocks):
+                   block_kv, num_kv_blocks, rope_theta):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     qpos_ref = next(it) if has_pos else None
     kpos_ref = next(it) if has_pos else None
+    kposc_ref = next(it) if rope_theta is not None else None
     qseg_ref = next(it) if has_seg else None
     kseg_ref = next(it) if has_seg else None
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
@@ -309,6 +395,9 @@ def _bwd_dq_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
+        if rope_theta is not None:
+            q = _rope_rows(q, _q_col(qpos_ref), rope_theta)
+            k = _rope_rows(k, _q_col(kposc_ref), rope_theta)
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]  # [block_q, 1]
@@ -320,7 +409,7 @@ def _bwd_dq_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
             causal=causal, window=window, block_q=block_q, block_kv=block_kv,
         )
         if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
+            s = jnp.where(mask, s, _MASK_FILL)
         p = jnp.exp(s - lse)  # [block_q, block_kv]
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
@@ -330,15 +419,21 @@ def _bwd_dq_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
-        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+        acc = acc_ref[:]
+        if rope_theta is not None:
+            # dq accumulated in ROTATED basis; rotation is orthogonal, so
+            # the pullback is one rotation by -pos at the end
+            acc = _rope_rows(acc, _q_col(qpos_ref), rope_theta, negate=True)
+        dq_ref[0, 0] = acc.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
-                    block_kv, num_q_blocks, num_gq_steps):
+                    block_kv, num_q_blocks, num_gq_steps, rope_theta):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     qpos_ref = next(it) if has_pos else None
     kpos_ref = next(it) if has_pos else None
+    kposc_ref = next(it) if rope_theta is not None else None
     qseg_ref = next(it) if has_seg else None
     kseg_ref = next(it) if has_seg else None
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
@@ -367,6 +462,9 @@ def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
+        if rope_theta is not None:
+            q = _rope_rows(q, _q_col(qpos_ref), rope_theta)
+            k = _rope_rows(k, _q_col(kposc_ref), rope_theta)
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]
@@ -378,7 +476,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
             causal=causal, window=window, block_q=block_q, block_kv=block_kv,
         )
         if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
+            s = jnp.where(mask, s, _MASK_FILL)
         p = jnp.exp(s - lse)  # [block_q, block_kv]
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
@@ -395,12 +493,15 @@ def _bwd_dkv_kernel(*refs, scale, causal, window, has_pos, has_seg, block_q,
 
     @pl.when(gqi == num_gq_steps - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dk = dk_acc[:]
+        if rope_theta is not None:
+            dk = _rope_rows(dk, _q_col(kposc_ref), rope_theta, negate=True)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
-         window, block_q, block_kv, delta=None):
+         window, block_q, block_kv, delta=None, rope_theta=None):
     b, h, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     group = h // hkv
@@ -408,25 +509,32 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
     nkv = pl.cdiv(skv, block_kv)
     has_pos = qpos is not None
     has_seg = qseg is not None
+    has_rope = rope_theta is not None
+    if has_rope and not has_pos:
+        raise ValueError("rope fusion needs explicit q/kv positions")
 
     if delta is None:  # ring callers precompute: delta is loop-invariant
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # [B,H,Sq,1]
 
     qpos_t, kpos_t, qseg_t, kseg_t = _broadcast_mask_inputs(b, qpos, kpos, qseg, kseg)
-    mask_args = ([qpos_t, kpos_t] if has_pos else []) + ([qseg_t, kseg_t] if has_seg else [])
+    mask_args = ([qpos_t, kpos_t] if has_pos else []) \
+        + ([_q_side(kpos)] if has_rope else []) \
+        + ([qseg_t, kseg_t] if has_seg else [])
 
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, window=window,
             has_pos=has_pos, has_seg=has_seg,
             block_q=block_q, block_kv=block_kv, num_kv_blocks=nkv,
+            rope_theta=rope_theta,
         ),
         grid=(b * h, nq, nkv),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // h, (bh % h) // group, ki, 0), memory_space=pltpu.VMEM),
-        ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv) + [
+        ] + _mask_specs(b, h, has_pos, has_seg, block_q, block_kv,
+                        has_rope=has_rope) + [
             pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, qi, ki: (bh // h, bh % h, qi, 0), memory_space=pltpu.VMEM),
@@ -450,7 +558,7 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
             _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
             has_pos=has_pos, has_seg=has_seg,
             block_q=block_q, block_kv=block_kv, num_q_blocks=nq,
-            num_gq_steps=gnq,
+            num_gq_steps=gnq, rope_theta=rope_theta,
         ),
         grid=(b * hkv, nkv, gnq),
         in_specs=[
@@ -458,7 +566,7 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
             pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, g: (bh // hkv, bh % hkv, ki, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_kv, d), lambda bh, ki, g: (bh // hkv, bh % hkv, ki, 0), memory_space=pltpu.VMEM),
         ] + _mask_specs(b, hkv, has_pos, has_seg, block_q, block_kv,
-                        kv_major=True, q_steps=nq) + [
+                        kv_major=True, q_steps=nq, has_rope=has_rope) + [
             pl.BlockSpec((1, 1, block_q, d), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda bh, ki, g: (bh // hkv, (bh % hkv) * group + g // nq, g % nq, 0), memory_space=pltpu.VMEM),
@@ -483,42 +591,71 @@ def _bwd(q, k, v, out, lse, do, qpos, kpos, qseg, kseg, *, scale, causal,
 # ------------------------------------------------------------- public entry
 
 
-from ._common import interpret_mode as _interpret
-
-
 # (q, k, v, qpos, kpos, qseg, kseg) diff/nondiff: mask inputs get zero
 # cotangents via custom_vjp residuals; statics are (scale, causal, window,
-# blocks, lse-return flag).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
-def _flash_bhsd(q, k, v, qpos, kpos, qseg, kseg, scale, causal, window, block_q, block_kv):
+# blocks, rope_theta).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def _flash_bhsd(q, k, v, qpos, kpos, qseg, kseg, scale, causal, window, block_q, block_kv, rope_theta):
     out, lse = _fwd(
         q, k, v, qpos, kpos, qseg, kseg,
-        scale=scale, causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        scale=scale, causal=causal, window=window, block_q=block_q,
+        block_kv=block_kv, rope_theta=rope_theta,
     )
     return out, lse[..., 0]
 
 
-def _flash_fwd_rule(q, k, v, qpos, kpos, qseg, kseg, scale, causal, window, block_q, block_kv):
+def _flash_fwd_rule(q, k, v, qpos, kpos, qseg, kseg, scale, causal, window, block_q, block_kv, rope_theta):
     out, lse = _fwd(
         q, k, v, qpos, kpos, qseg, kseg,
-        scale=scale, causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        scale=scale, causal=causal, window=window, block_q=block_q,
+        block_kv=block_kv, rope_theta=rope_theta,
     )
     return (out, lse[..., 0]), (q, k, v, qpos, kpos, qseg, kseg, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, window, block_q, block_kv, res, cots):
+def _flash_bwd_rule(scale, causal, window, block_q, block_kv, rope_theta, res, cots):
     q, k, v, qpos, kpos, qseg, kseg, out, lse = res
     do, _ = cots  # lse cotangent: lse is a streaming statistic, treated as
     # non-differentiable output (ring merges re-derive gradients through out)
     dq, dk, dv = _bwd(
         q, k, v, out, lse, do, qpos, kpos, qseg, kseg,
-        scale=scale, causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        scale=scale, causal=causal, window=window, block_q=block_q,
+        block_kv=block_kv, rope_theta=rope_theta,
     )
     zero = lambda a: None if a is None else jnp.zeros_like(a)
     return dq, dk, dv, zero(qpos), zero(kpos), zero(qseg), zero(kseg)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _tuned_block_caps(sq, skv, d, dtype, causal) -> Tuple[int, int]:
+    """(block_q, block_kv) caps from the persistent tuning cache; static
+    defaults off-TPU or on any tuning failure."""
+    from .. import tuning
+
+    if not tuning.tuning_enabled():
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV
+
+    bsq, bskv = tuning.bucket(sq), tuning.bucket(skv)
+
+    def measure(cand):
+        bq, bkv = cand
+        q = jnp.zeros((1, bsq, 4, d), dtype)
+        k = jnp.zeros((1, bskv, 2, d), dtype)
+        v = jnp.zeros((1, bskv, 2, d), dtype)
+        fn = jax.jit(functools.partial(
+            flash_attention, causal=causal, block_q=bq, block_kv=bkv,
+        ))
+        return tuning.time_fn(fn, q, k, v)
+
+    try:
+        return tuning.flash_blocks(
+            sq, skv, d, dtype, causal, measure,
+            (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV),
+        )
+    except Exception:  # never let tuning break the hot path
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV
 
 
 def flash_attention(
@@ -533,15 +670,17 @@ def flash_attention(
     kv_positions: Optional[jax.Array] = None,
     sliding_window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_kv: int = DEFAULT_BLOCK_KV,
+    rope_theta: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on model-layout [B, S, H, D] tensors."""
     out, _ = flash_attention_with_lse(
         q, k, v, causal=causal, segment_ids=segment_ids,
         kv_segment_ids=kv_segment_ids, q_positions=q_positions,
         kv_positions=kv_positions, sliding_window=sliding_window,
-        softmax_scale=softmax_scale, block_q=block_q, block_kv=block_kv,
+        softmax_scale=softmax_scale, rope_theta=rope_theta,
+        block_q=block_q, block_kv=block_kv,
     )
     return out
 
@@ -558,14 +697,29 @@ def flash_attention_with_lse(
     kv_positions: Optional[jax.Array] = None,
     sliding_window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_kv: int = DEFAULT_BLOCK_KV,
+    rope_theta: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Like :func:`flash_attention` but also returns the per-row LSE
     ([B, H, Sq] fp32) — the streaming-softmax statistic ring attention needs
-    for its rescaled merge (≙ ``attn.py:376`` _rescale_out_lse)."""
+    for its rescaled merge (≙ ``attn.py:376`` _rescale_out_lse).
+
+    ``rope_theta``: apply rotary embedding to q/k INSIDE the kernels (fused;
+    see module docstring). Positions default to ``arange(S)`` per batch row;
+    explicit ``q_positions``/``kv_positions`` serve both masking and
+    rotation (ring-attention chunks pass global positions).
+
+    ``block_q``/``block_kv``: explicit tile caps; ``None`` consults the
+    persistent tuning cache on TPU (static defaults elsewhere).
+    """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    sq, skv = q.shape[1], k.shape[1]
+    b, sq = q.shape[0], q.shape[1]
+    skv, d = k.shape[1], q.shape[-1]
+    if block_q is None or block_kv is None:
+        tq, tkv = _tuned_block_caps(sq, skv, d, q.dtype, causal)
+        block_q = block_q if block_q is not None else tq
+        block_kv = block_kv if block_kv is not None else tkv
     block_q = pick_block(sq, block_q)
     block_kv = pick_block(skv, block_kv)
     if sq % block_q or skv % block_kv:
@@ -578,6 +732,11 @@ def flash_attention_with_lse(
         raise ValueError("kv_segment_ids without segment_ids would be silently dropped")
     if segment_ids is not None and kv_segment_ids is None:
         kv_segment_ids = segment_ids
+    if rope_theta is not None and q_positions is None:
+        q_positions = jnp.broadcast_to(
+            jnp.arange(sq, dtype=jnp.int32)[None, :], (b, sq))
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(skv, dtype=jnp.int32)[None, :], (b, skv))
 
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -587,14 +746,20 @@ def flash_attention_with_lse(
         qt, kt, vt, as_i32(q_positions), as_i32(kv_positions),
         as_i32(segment_ids), as_i32(kv_segment_ids),
         scale, causal, sliding_window, block_q, block_kv,
+        None if rope_theta is None else float(rope_theta),
     )
     return jnp.swapaxes(out, 1, 2), lse
 
 
-def supports(q_shape, k_shape, block_q: int = DEFAULT_BLOCK_Q, block_kv: int = DEFAULT_BLOCK_KV) -> bool:
+def supports(q_shape, k_shape, block_q: Optional[int] = None,
+             block_kv: Optional[int] = None) -> bool:
     """Whether the kernel handles these [B, S, H, D] shapes (tile limits)."""
     sq, skv, d = q_shape[1], k_shape[1], q_shape[-1]
     if d % 128 != 0 or q_shape[2] % k_shape[2] != 0:
         return False
-    bq, bkv = pick_block(sq, block_q), pick_block(skv, block_kv)
+    try:
+        bq = pick_block(sq, block_q or DEFAULT_BLOCK_Q)
+        bkv = pick_block(skv, block_kv or DEFAULT_BLOCK_KV)
+    except ValueError:
+        return False
     return sq % bq == 0 and skv % bkv == 0 and sq % 128 == 0 and skv % 128 == 0

@@ -21,6 +21,29 @@ _BLOCK_ROWS = 256
 from ._common import interpret_mode as _interpret
 
 
+def _pick_rows(n: int, h: int, dtype) -> int:
+    """Row tile: tuned cap (TPU, persistent cache) or the static default,
+    clamped to a divisor of n."""
+    from .. import tuning
+
+    cap = _BLOCK_ROWS
+    if tuning.tuning_enabled():
+        def measure(r):
+            x = jnp.zeros((tuning.bucket(max(n, r)), h), dtype)
+            s = jnp.zeros((h,), jnp.float32)
+            fn = jax.jit(lambda x, s, b: _run_fwd(x, s, b, 1e-5, rows=r)[0])
+            return tuning.time_fn(fn, x, s, s)
+
+        try:
+            cap = tuning.norm_rows("layer_norm", n, h, dtype, measure, _BLOCK_ROWS)
+        except Exception:
+            cap = _BLOCK_ROWS
+    rows = min(cap, n)
+    if n % rows:
+        rows = n
+    return rows
+
+
 def _fwd_kernel(x_ref, scale_ref, bias_ref, o_ref, mean_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     mean = jnp.mean(x, axis=-1, keepdims=True)
@@ -34,11 +57,10 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, o_ref, mean_ref, rstd_ref, *, eps):
     rstd_ref[:] = rstd
 
 
-def _run_fwd(x2d, scale, bias, eps):
+def _run_fwd(x2d, scale, bias, eps, rows=None):
     n, h = x2d.shape
-    rows = min(_BLOCK_ROWS, n)
-    if n % rows:
-        rows = n
+    if rows is None:
+        rows = _pick_rows(n, h, x2d.dtype)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(pl.cdiv(n, rows),),

@@ -14,6 +14,12 @@ full padded table.
 Layout: q [S, H, D] (grouped per kv head in-kernel), pool
 [n_blocks, Hkv, block_size, D], tables [S, max_blocks], lengths [S].
 Online-softmax accumulation across a sequence's pages (flash-decoding).
+
+``heads_per_step`` — how many KV heads one grid step processes — trades
+per-step overhead against VMEM working set and pipeline overlap; it is the
+knob the persistent tuning cache (``kernel.tuning``) measures per
+(chip, head-geometry, page-size, dtype) key. The default (all heads per
+step, a single head-group grid index) reproduces the original kernel.
 """
 
 from __future__ import annotations
@@ -25,20 +31,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e9
+from ._common import interpret_mode as _interpret
+from ._common import mask_value as _mask_value
+
+#: scores are f32; finite dtype-aware fill (see _common.mask_value)
+_MASK_FILL = _mask_value(jnp.float32)
 
 
 def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
-            scale, block_size, max_blocks, hkv):
-    """Grid (slots, pages); ALL kv heads per step (static loop) — per-step
-    overhead, not MXU work, dominates single-token decode."""
+            scale, block_size, max_blocks, hps):
+    """Grid (slots, head-groups, pages); ``hps`` kv heads per step (static
+    loop) — per-step overhead, not MXU work, dominates single-token
+    decode."""
     s = pl.program_id(0)
-    j = pl.program_id(1)
+    j = pl.program_id(2)
 
     @pl.when(j == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
-        m[:] = jnp.full_like(m, _NEG_INF)
+        m[:] = jnp.full_like(m, _MASK_FILL)
         l[:] = jnp.zeros_like(l)
 
     length = len_ref[s]
@@ -46,7 +57,7 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
 
     @pl.when(needed)
     def _compute():
-        for hh in range(hkv):
+        for hh in range(hps):
             q = q_ref[0, hh]  # [G, D]
             k = k_ref[0, hh]  # [block_size, D]
             v = v_ref[0, hh]
@@ -55,7 +66,7 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
             ) * scale  # [G, block_size]
             pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
             in_len = pos < length
-            sc = jnp.where(in_len, sc, _NEG_INF)
+            sc = jnp.where(in_len, sc, _MASK_FILL)
 
             m_prev = m[hh]
             m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
@@ -74,6 +85,29 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
 
 
+def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype) -> int:
+    from .. import tuning
+
+    if not tuning.tuning_enabled():
+        return hkv
+
+    def measure(hps):
+        n_slots = 8
+        q = jnp.zeros((n_slots, hkv * group, d), dtype)
+        pool = jnp.zeros((max_blocks, hkv, block_size, d), dtype)
+        bt = jnp.broadcast_to(
+            jnp.arange(max_blocks, dtype=jnp.int32)[None], (n_slots, max_blocks))
+        ln = jnp.full((n_slots,), max_blocks * block_size, jnp.int32)
+        fn = jax.jit(functools.partial(paged_attention, heads_per_step=hps))
+        return tuning.time_fn(fn, q, pool, pool, bt, ln)
+
+    try:
+        return tuning.paged_heads_per_step(
+            hkv, group, d, block_size, dtype, measure)
+    except Exception:  # never let tuning break the hot path
+        return hkv
+
+
 def paged_attention(
     q: jax.Array,            # [S, H, D] one token per slot
     k_pool: jax.Array,       # [n_blocks, Hkv, block_size, D]
@@ -82,39 +116,48 @@ def paged_attention(
     lengths: jax.Array,       # [S] valid tokens INCLUDING the new one
     *,
     softmax_scale: float | None = None,
+    heads_per_step: int | None = None,
 ) -> jax.Array:
-    """Returns [S, H, D]."""
+    """Returns [S, H, D]. ``heads_per_step`` must divide Hkv; ``None``
+    consults the tuning cache on TPU (all heads per step elsewhere)."""
     n_slots, h, d = q.shape
     _, hkv, block_size, _ = k_pool.shape
     group = h // hkv
     max_blocks = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else d**-0.5
+    if heads_per_step is None:
+        heads_per_step = _tuned_heads_per_step(
+            hkv, group, d, block_size, max_blocks, q.dtype)
+    hps = heads_per_step
+    if hkv % hps:
+        raise ValueError(f"heads_per_step={hps} must divide Hkv={hkv}")
+    n_hgroups = hkv // hps
 
     qg = q.reshape(n_slots, hkv, group, d)
 
-    def page_map(s, j, bt, ln):
+    def page_map(s, hg, j, bt, ln):
         # clamp to the last REAL page: steps past a sequence's length keep
         # the previous origin, so Mosaic never re-fetches for skipped pages
         last = jnp.maximum((ln[s] + block_size - 1) // block_size - 1, 0)
-        return (bt[s, jnp.minimum(j, last)], 0, 0, 0)
+        return (bt[s, jnp.minimum(j, last)], hg, 0, 0)
 
     kernel = functools.partial(
         _kernel, scale=scale, block_size=block_size, max_blocks=max_blocks,
-        hkv=hkv,
+        hps=hps,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(n_slots, max_blocks),
+        grid=(n_slots, n_hgroups, max_blocks),
         in_specs=[
-            pl.BlockSpec((1, hkv, group, d), lambda s, j, bt, ln: (s, 0, 0, 0)),
-            pl.BlockSpec((1, hkv, block_size, d), page_map),
-            pl.BlockSpec((1, hkv, block_size, d), page_map),
+            pl.BlockSpec((1, hps, group, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
+            pl.BlockSpec((1, hps, block_size, d), page_map),
+            pl.BlockSpec((1, hps, block_size, d), page_map),
         ],
-        out_specs=pl.BlockSpec((1, hkv, group, d), lambda s, j, bt, ln: (s, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, hps, group, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((hkv, group, d), jnp.float32),
-            pltpu.VMEM((hkv, group, 1), jnp.float32),
-            pltpu.VMEM((hkv, group, 1), jnp.float32),
+            pltpu.VMEM((hps, group, d), jnp.float32),
+            pltpu.VMEM((hps, group, 1), jnp.float32),
+            pltpu.VMEM((hps, group, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -124,6 +167,3 @@ def paged_attention(
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
     return out.reshape(n_slots, h, d)
-
-
-from ._common import interpret_mode as _interpret

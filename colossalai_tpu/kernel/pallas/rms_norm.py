@@ -4,6 +4,15 @@
 fused_add_rms_layernorm variant. Row-tiled, fp32 statistics, differentiable
 via a custom VJP (the backward is the analytic RMSNorm gradient, fused the
 same way).
+
+The residual variant (:func:`fused_add_rms_norm`, also reachable as
+``rms_norm(..., residual=...)``) computes ``s = x + residual`` INSIDE the
+kernel and emits both ``norm(s)`` and ``s`` in one HBM pass — the
+twice-per-decoder-layer ``x + h`` → norm sequence that used to cost a
+separate XLA add (one extra read+write of the full hidden state each).
+
+Row-tile size is a cap consulted from the persistent tuning cache
+(``kernel.tuning``) on TPU; the static ``_BLOCK_ROWS`` elsewhere.
 """
 
 from __future__ import annotations
@@ -21,6 +30,29 @@ _BLOCK_ROWS = 256
 from ._common import interpret_mode as _interpret
 
 
+def _pick_rows(n: int, h: int, dtype) -> int:
+    """Row tile for an (n, h) kernel: tuned cap (TPU) or static default,
+    clamped to a divisor of n (whole-array fallback, as before)."""
+    from .. import tuning
+
+    cap = _BLOCK_ROWS
+    if tuning.tuning_enabled():
+        def measure(r):
+            x = jnp.zeros((tuning.bucket(max(n, r)), h), dtype)
+            s = jnp.zeros((h,), jnp.float32)
+            fn = jax.jit(lambda x, s: _run_fwd(x, s, 1e-5, rows=r)[0])
+            return tuning.time_fn(fn, x, s)
+
+        try:
+            cap = tuning.norm_rows("rms_norm", n, h, dtype, measure, _BLOCK_ROWS)
+        except Exception:
+            cap = _BLOCK_ROWS
+    rows = min(cap, n)
+    if n % rows:
+        rows = n  # fall back to one block
+    return rows
+
+
 def _fwd_kernel(x_ref, scale_ref, o_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
@@ -29,11 +61,10 @@ def _fwd_kernel(x_ref, scale_ref, o_ref, rstd_ref, *, eps):
     rstd_ref[:] = rstd
 
 
-def _run_fwd(x2d, scale, eps):
+def _run_fwd(x2d, scale, eps, rows=None):
     n, h = x2d.shape
-    rows = min(_BLOCK_ROWS, n)
-    if n % rows:
-        rows = n  # fall back to one block
+    if rows is None:
+        rows = _pick_rows(n, h, x2d.dtype)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(pl.cdiv(n, rows),),
@@ -64,27 +95,108 @@ def _rms_fwd(x2d, scale, eps):
     return out, (x2d, scale, rstd)
 
 
+def _rms_grad_x(x, scale, rstd, g):
+    """Analytic d norm(x) / dx pullback, f32 in/out ([n, h] each)."""
+    xhat = x * rstd
+    gs = g * scale
+    return rstd * (gs - xhat * jnp.mean(gs * xhat, axis=-1, keepdims=True))
+
+
 def _rms_bwd(eps, res, g):
     x2d, scale, rstd = res
     x = x2d.astype(jnp.float32)
     g = g.astype(jnp.float32)
     s = scale.astype(jnp.float32)
-    h = x.shape[-1]
-    xhat = x * rstd
-    gs = g * s
-    # d/dx of x*rstd*s: rstd*(gs - xhat * mean(gs*xhat))
-    dx = rstd * (gs - xhat * jnp.mean(gs * xhat, axis=-1, keepdims=True))
-    dscale = jnp.sum(g * xhat, axis=0)
+    dx = _rms_grad_x(x, s, rstd, g)
+    dscale = jnp.sum(g * x * rstd, axis=0)
     return dx.astype(x2d.dtype), dscale.astype(scale.dtype)
 
 
 _rms_norm_2d.defvjp(_rms_fwd, _rms_bwd)
 
 
+# -------------------------------------------------- fused residual + norm
+
+
+def _fused_add_fwd_kernel(x_ref, r_ref, scale_ref, o_ref, s_ref, rstd_ref, *, eps):
+    s = x_ref[:].astype(jnp.float32) + r_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(s), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    s_ref[:] = s.astype(s_ref.dtype)
+    o_ref[:] = (s * rstd * scale_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _run_fused_add_fwd(x2d, r2d, scale, eps, rows=None):
+    n, h = x2d.shape
+    if rows is None:
+        rows = _pick_rows(n, h, x2d.dtype)
+    row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_fused_add_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(n, rows),),
+        in_specs=[
+            row_spec,
+            row_spec,
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            row_spec,
+            row_spec,
+            pl.BlockSpec((rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, r2d, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_add_rms_2d(x2d, r2d, scale, eps):
+    out, summed, _ = _run_fused_add_fwd(x2d, r2d, scale, eps)
+    return out, summed
+
+
+def _fused_add_fwd(x2d, r2d, scale, eps):
+    out, summed, rstd = _run_fused_add_fwd(x2d, r2d, scale, eps)
+    return (out, summed), (summed, scale, rstd)
+
+
+def _fused_add_bwd(eps, res, cots):
+    summed, scale, rstd = res
+    g_out, g_sum = cots
+    s32 = summed.astype(jnp.float32)
+    g = g_out.astype(jnp.float32)
+    sc = scale.astype(jnp.float32)
+    # d/ds flows through BOTH outputs: the norm pullback plus the summed
+    # passthrough; x and residual enter symmetrically (ds/dx = ds/dr = I)
+    dsum = _rms_grad_x(s32, sc, rstd, g) + g_sum.astype(jnp.float32)
+    dscale = jnp.sum(g * s32 * rstd, axis=0)
+    dx = dsum.astype(summed.dtype)
+    return dx, dx, dscale.astype(scale.dtype)
+
+
+_fused_add_rms_2d.defvjp(_fused_add_fwd, _fused_add_bwd)
+
+
+def fused_add_rms_norm(x, residual, scale, eps: float = 1e-5):
+    """One-HBM-pass ``s = x + residual; return (rms_norm(s) * scale, s)``."""
+    shape = x.shape
+    h = shape[-1]
+    out, summed = _fused_add_rms_2d(
+        x.reshape(-1, h), residual.reshape(-1, h), scale, eps
+    )
+    return out.reshape(shape), summed.reshape(shape)
+
+
 def rms_norm(x, scale, eps: float = 1e-5, residual=None):
-    """RMSNorm over the last dim; with residual returns (normed, x+residual)."""
+    """RMSNorm over the last dim; with residual returns (normed, x+residual)
+    via the fused single-pass kernel."""
     if residual is not None:
-        x = x + residual
+        return fused_add_rms_norm(x, residual, scale, eps)
     shape = x.shape
     out = _rms_norm_2d(x.reshape(-1, shape[-1]), scale, eps).reshape(shape)
-    return (out, x) if residual is not None else out
+    return out

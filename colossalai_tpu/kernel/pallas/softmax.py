@@ -19,11 +19,35 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e9
 _BLOCK_ROWS = 256
 
 
 from ._common import interpret_mode as _interpret
+from ._common import mask_value as _mask_value
+
+#: scores are promoted to f32 before masking — finite dtype-aware fill
+#: (exponentiates to exactly 0.0, no inf - inf NaNs on fully-masked rows)
+_MASK_FILL = _mask_value(jnp.float32)
+
+
+def _pick_rows_cap(n: int, s: int, dtype) -> int:
+    """Tuned row-tile cap (TPU, persistent cache) or the static default;
+    the caller still gcd-clamps to a divisor of the flat row count."""
+    from .. import tuning
+
+    if not tuning.tuning_enabled():
+        return _BLOCK_ROWS
+
+    def measure(r):
+        rows_n = tuning.bucket(max(n, r))
+        x = jnp.zeros((rows_n, s), dtype)
+        fn = jax.jit(lambda x: _run_fwd(x, None, 1.0, False, rows_n, rows_cap=r))
+        return tuning.time_fn(fn, x)
+
+    try:
+        return tuning.norm_rows("softmax", n, s, dtype, measure, _BLOCK_ROWS)
+    except Exception:
+        return _BLOCK_ROWS
 
 
 def _fwd_kernel(x_ref, o_ref, *, scale, causal, rows, sq):
@@ -34,7 +58,7 @@ def _fwd_kernel(x_ref, o_ref, *, scale, causal, rows, sq):
         # tiles may straddle square boundaries, the modulo keeps it exact
         row = (i * rows + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)) % sq
         col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-        x = jnp.where(col <= row, x, _NEG_INF)
+        x = jnp.where(col <= row, x, _MASK_FILL)
     m = jnp.max(x, axis=-1, keepdims=True)
     p = jnp.exp(x - m)
     o_ref[:] = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
@@ -42,19 +66,21 @@ def _fwd_kernel(x_ref, o_ref, *, scale, causal, rows, sq):
 
 def _masked_fwd_kernel(x_ref, mask_ref, o_ref, *, scale):
     x = x_ref[:].astype(jnp.float32) * scale
-    x = jnp.where(mask_ref[:] != 0, _NEG_INF, x)  # mask==1 means MASKED (≙ ref)
+    x = jnp.where(mask_ref[:] != 0, _MASK_FILL, x)  # mask==1 means MASKED (≙ ref)
     m = jnp.max(x, axis=-1, keepdims=True)
     p = jnp.exp(x - m)
     o_ref[:] = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
 
 
-def _run_fwd(x2d, mask2d, scale, causal, sq):
+def _run_fwd(x2d, mask2d, scale, causal, sq, rows_cap=None):
     import math
 
     n, s = x2d.shape
     # tile over the FLAT row count (leading dims x S_q) — s_q need not equal
     # s_k, and the tile size must divide n, not s
-    rows = math.gcd(n, _BLOCK_ROWS)
+    if rows_cap is None:
+        rows_cap = _pick_rows_cap(n, s, x2d.dtype)
+    rows = math.gcd(n, rows_cap)
     grid = (n // rows,)
     spec = pl.BlockSpec((rows, s), lambda i: (i, 0), memory_space=pltpu.VMEM)
     if mask2d is None:

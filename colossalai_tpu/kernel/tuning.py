@@ -1,0 +1,314 @@
+"""Persistent kernel tuning cache: measured tile selection per chip.
+
+Every Pallas kernel in this tree used to ship hard-coded tile constants
+(``DEFAULT_BLOCK_Q = DEFAULT_BLOCK_KV = 1024``, ``_BLOCK_ROWS = 256``)
+measured once on one chip generation. This module replaces those private
+constants with a measured choice per ``(kernel, device_kind, shape-bucket,
+dtype)`` key:
+
+- the first time a kernel runs at a new key on a real TPU, a small candidate
+  grid of tilings is benchmarked (a few ms each) and the winner is persisted
+  to an on-disk JSON cache, so every later process — and every later run on
+  the same chip model — starts from the measured optimum;
+- off-TPU (CPU tests, interpret mode) tuning is bypassed entirely and the
+  static defaults are returned, keeping tier-1 runs deterministic and free
+  of disk IO.
+
+Environment:
+
+- ``COLOSSALAI_TPU_TUNING_DIR``: cache directory
+  (default ``~/.cache/colossalai_tpu/tuning``);
+- ``COLOSSALAI_TPU_TUNING=0``: disable tuning even on TPU (static defaults).
+
+``bench.py`` reports :func:`stats` — chosen tilings plus hit/miss counts —
+in its JSON extras so MFU movements are attributable to tile changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+ENV_DIR = "COLOSSALAI_TPU_TUNING_DIR"
+ENV_ENABLE = "COLOSSALAI_TPU_TUNING"
+SCHEMA_VERSION = 1
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(ENV_DIR) or os.path.expanduser(
+        "~/.cache/colossalai_tpu/tuning"
+    )
+
+
+def device_kind() -> str:
+    """Normalized accelerator model string, e.g. ``tpu-v5-lite`` / ``cpu``."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except RuntimeError:
+        return "none"
+    return "".join(c if c.isalnum() else "-" for c in kind.lower()).strip("-")
+
+
+def tuning_enabled() -> bool:
+    """Tuning benchmarks run only on a real TPU backend (never under
+    interpret mode / CPU meshes) and can be vetoed by env."""
+    if os.environ.get(ENV_ENABLE, "1") == "0":
+        return False
+    from .loader import on_tpu
+
+    return on_tpu()
+
+
+def bucket(n: int, cap: int = 65536) -> int:
+    """Shape bucket: next power of two >= n (bounded). Keys and benchmark
+    shapes use the bucket so 12k and 16k sequences share one measurement."""
+    b = 1
+    while b < n and b < cap:
+        b <<= 1
+    return b
+
+
+def time_fn(fn: Callable, *args, iters: int = 3) -> float:
+    """Mean seconds/call. Sync is a scalar fetch, not block_until_ready —
+    on tunneled platforms (axon) block_until_ready returns before execution
+    (see bench.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sync(out):
+        leaf = jax.tree.leaves(out)[0]
+        float(jnp.sum(leaf.astype(jnp.float32)))
+
+    out = fn(*args)  # compile + warm
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+class KernelTuner:
+    """Benchmark-and-persist tile selection.
+
+    One instance per process (see :func:`get_tuner`); tests build their own
+    with a temp ``cache_dir`` and ``force=True`` to exercise the round-trip
+    off-TPU.
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir or default_cache_dir()
+        self._mem: Dict[str, Dict[str, Any]] = {}
+        self._loaded = False
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.bypassed = 0
+        self.errors = 0
+        #: key -> config resolved during THIS process (bench visibility)
+        self.chosen: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------ persistence
+
+    def _path(self) -> str:
+        return os.path.join(self.cache_dir, f"tuning_{device_kind()}.json")
+
+    def _load_locked(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self._path()) as f:
+                data = json.load(f)
+            if isinstance(data, dict) and data.get("version") == SCHEMA_VERSION:
+                entries = data.get("entries", {})
+                if isinstance(entries, dict):
+                    self._mem.update(entries)
+        except (OSError, ValueError):
+            pass  # absent or corrupt cache == cold cache
+
+    def _persist_locked(self) -> None:
+        path = self._path()
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            # merge-with-disk before writing: concurrent processes tuning
+            # different keys must not clobber each other's winners
+            try:
+                with open(path) as f:
+                    on_disk = json.load(f).get("entries", {})
+                if isinstance(on_disk, dict):
+                    for k, v in on_disk.items():
+                        self._mem.setdefault(k, v)
+            except (OSError, ValueError):
+                pass
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"version": SCHEMA_VERSION, "device": device_kind(),
+                     "entries": self._mem},
+                    f, indent=1, sort_keys=True,
+                )
+            os.replace(tmp, path)
+        except OSError:
+            pass  # read-only FS: tuning still works, just doesn't persist
+
+    # ----------------------------------------------------------------- tuning
+
+    def tune(
+        self,
+        kernel: str,
+        key_parts: Sequence[Any],
+        candidates: Sequence[Any],
+        measure: Callable[[Any], float],
+        default: Any,
+        force: bool = False,
+    ) -> Any:
+        """Measured winner for ``kernel`` at ``key_parts``.
+
+        ``measure(candidate) -> seconds`` (exceptions skip the candidate).
+        Off-TPU (or ``COLOSSALAI_TPU_TUNING=0``) returns ``default`` without
+        touching the disk unless ``force`` (tests) is set.
+        """
+        if not force and not tuning_enabled():
+            self.bypassed += 1
+            return default
+        key = "|".join([kernel] + [str(p) for p in key_parts])
+        with self._lock:
+            self._load_locked()
+            entry = self._mem.get(key)
+            if entry is not None:
+                self.hits += 1
+                cfg = _decode(entry.get("config", default))
+                self.chosen[key] = cfg
+                return cfg
+        self.misses += 1
+        best, best_t = None, float("inf")
+        timings = {}
+        for cand in candidates:
+            try:
+                t = measure(cand)
+            except Exception:  # a candidate that won't compile just loses
+                self.errors += 1
+                continue
+            timings[str(cand)] = round(t * 1e6, 2)
+            if t < best_t:
+                best, best_t = cand, t
+        if best is None:
+            return default
+        with self._lock:
+            self._mem[key] = {
+                "config": _encode(best),
+                "us": round(best_t * 1e6, 2),
+                "timings_us": timings,
+                "ts": int(time.time()),
+            }
+            self._persist_locked()
+        self.chosen[key] = best
+        return best
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "device": device_kind(),
+            "enabled": tuning_enabled(),
+            "cache_file": self._path(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "bypassed": self.bypassed,
+            "errors": self.errors,
+            "chosen": {k: _encode(v) for k, v in self.chosen.items()},
+        }
+
+
+def _encode(cfg):
+    return list(cfg) if isinstance(cfg, tuple) else cfg
+
+
+def _decode(cfg):
+    return tuple(cfg) if isinstance(cfg, list) else cfg
+
+
+_TUNER: Optional[KernelTuner] = None
+_TUNER_LOCK = threading.Lock()
+
+
+def get_tuner() -> KernelTuner:
+    global _TUNER
+    with _TUNER_LOCK:
+        if _TUNER is None:
+            _TUNER = KernelTuner()
+        return _TUNER
+
+
+def stats() -> Dict[str, Any]:
+    """Process-level tuning visibility (bench extras)."""
+    return get_tuner().stats()
+
+
+# ------------------------------------------------- per-kernel tile selection
+# These helpers own the candidate grids. The kernel modules call them with a
+# ``measure`` closure over their own pallas_call so this module never imports
+# kernel code (no cycles).
+
+
+def flash_blocks(
+    sq: int, skv: int, d: int, dtype, causal: bool,
+    measure: Callable[[Tuple[int, int]], float],
+    default: Tuple[int, int],
+) -> Tuple[int, int]:
+    """(block_q cap, block_kv cap) for the flash kernels. The result is a
+    CAP — callers still run ``pick_block`` so non-bucket sequences stay
+    legal."""
+    bq, bkv = bucket(sq), bucket(skv)
+    cands: List[Tuple[int, int]] = [
+        c for c in (
+            (512, 512), (512, 1024), (1024, 512), (1024, 1024),
+            (2048, 1024), (1024, 2048), (256, 1024),
+        )
+        if c[0] <= bq and c[1] <= bkv
+    ] or [default]
+    return get_tuner().tune(
+        "flash_attention",
+        (device_kind(), bq, bkv, d, _dt(dtype), int(causal)),
+        cands, measure, default,
+    )
+
+
+def norm_rows(
+    kernel: str, n: int, h: int, dtype,
+    measure: Callable[[int], float], default: int,
+) -> int:
+    """Row-tile cap for rms_norm / layer_norm / softmax style row kernels."""
+    bn = bucket(n)
+    cands = [r for r in (128, 256, 512, 1024, 2048) if r <= bn] or [default]
+    return get_tuner().tune(
+        kernel, (device_kind(), bn, h, _dt(dtype)), cands, measure, default,
+    )
+
+
+def paged_heads_per_step(
+    hkv: int, group: int, d: int, block_size: int, dtype,
+    measure: Callable[[int], float],
+) -> int:
+    """KV-heads processed per grid step in the paged decode kernel: all
+    heads (fewest grid steps, current default) vs smaller groups (smaller
+    VMEM working set, more pipeline overlap)."""
+    cands = sorted({h for h in (hkv, max(hkv // 2, 1), 1) if hkv % h == 0},
+                   reverse=True)
+    if len(cands) == 1:
+        return hkv
+    return get_tuner().tune(
+        "paged_attention",
+        (device_kind(), hkv, group, d, block_size, _dt(dtype)),
+        cands, measure, hkv,
+    )
+
+
+def _dt(dtype) -> str:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).name

@@ -57,6 +57,15 @@ class ModelConfig:
     # run MLP matmuls through the scaled-fp8 path (≙ FP8Hook/fp8_linear);
     # set by HybridParallelPlugin(enable_fp8=True)
     fp8_matmul: bool = False
+    # fold RoPE into the flash-attention kernels' q/k load path (deletes the
+    # standalone rope kernel's q+k HBM round-trip per layer). Safe to default
+    # on: off-TPU (and wherever flash is ineligible) the same math runs
+    # unfused, so numerics and tests are unchanged.
+    fuse_rope_attn: bool = True
+    # residual-add + norm as ONE kernel pass (twice per decoder layer the
+    # hidden state skips an extra HBM read+write). Same-math jnp fallback
+    # off-TPU; applies to rmsnorm layers only.
+    fused_norm: bool = True
     # pad embed/lm_head vocab dim to this multiple so tp can shard it
     # (≙ make_vocab_size_divisible_by / padded_tensor). Set by the plugin
     # when vocab_size % tp != 0; phantom logits are masked in the forward.

@@ -137,6 +137,26 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
+class FusedAddRMSNorm(nn.Module):
+    """``(rms_norm(x + res) * scale, x + res)`` in one kernel pass.
+
+    Same param path as ``RMSNorm`` ("scale", fp32 ones) so checkpoints and
+    policies are interchangeable with the unfused pair ``x + res`` →
+    ``RMSNorm``; off-TPU the kernel loader runs the identical jnp math.
+    """
+
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, res):
+        from colossalai_tpu.kernel import fused_add_rms_norm
+
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        out, summed = fused_add_rms_norm(x, res, scale, eps=self.eps)
+        return out.astype(self.dtype), summed
+
+
 def rope_table(positions: jax.Array, head_dim: int, theta: float) -> tuple:
     """cos/sin tables [..., head_dim/2] for the given positions."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
@@ -191,9 +211,14 @@ class LlamaAttention(nn.Module):
             k = constrain(k, ("dp", "ep"), None, "tp", None)
             v = constrain(v, ("dp", "ep"), None, "tp", None)
 
-        cos, sin = rope_table(positions, hd, cfg.rope_theta)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        # default: rope rides inside the flash kernels' q/k load (see
+        # kernel/pallas/flash_attention.py); ring manages its own chunk
+        # positions and pre-rotates as before
+        fuse_rope = cfg.fuse_rope_attn and sp != "ring_attn"
+        if not fuse_rope:
+            cos, sin = rope_table(positions, hd, cfg.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
 
         if sp == "ring_attn":
             from colossalai_tpu.shardformer.layer.ring_attention import ring_attention
@@ -210,6 +235,8 @@ class LlamaAttention(nn.Module):
             out = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
                 sliding_window=cfg.sliding_window,
+                rope_theta=cfg.rope_theta if fuse_rope else None,
+                positions=positions if fuse_rope else None,
             )
         out = out.reshape(b, s, cfg.num_attention_heads * hd)
         out = dense(cfg.hidden_size, "o_proj")(out)
@@ -252,8 +279,15 @@ class LlamaBlock(nn.Module):
         dtype = cfg.dtype or jnp.float32
         h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="input_layernorm")(x)
         h = LlamaAttention(cfg, name="self_attn")(h, positions, segment_ids)
-        x = x + h
-        h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="post_attention_layernorm")(x)
+        if cfg.fused_norm:
+            # one HBM pass for residual-add + norm; x becomes the summed
+            # residual stream exactly as in the unfused pair below
+            h, x = FusedAddRMSNorm(
+                eps=cfg.rms_norm_eps, dtype=dtype, name="post_attention_layernorm"
+            )(x, h)
+        else:
+            x = x + h
+            h = RMSNorm(eps=cfg.rms_norm_eps, dtype=dtype, name="post_attention_layernorm")(x)
         h = LlamaMLP(cfg, name="mlp")(h)
         return x + h
 

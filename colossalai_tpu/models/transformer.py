@@ -197,13 +197,20 @@ class DecoderAttention(nn.Module):
             spec = (("dp", "ep"), None, "tp", None)
         q, k, v = (constrain(t, *spec) for t in (q, k, v))
 
+        fuse_rope = False
         if cfg.pos_embedding == "rope":
             rotary_dim = max(2, int(hd * cfg.rotary_pct)) // 2 * 2
-            from .llama import rope_table
+            # full-dim half-split rotation is what the flash kernels fuse;
+            # partial (GPT-NeoX/Phi) and interleaved (GPT-J) stay up-front
+            fuse_rope = (
+                cfg.fuse_rope_attn and rotary_dim == hd and not cfg.rope_interleaved
+            )
+            if not fuse_rope:
+                from .llama import rope_table
 
-            cos, sin = rope_table(positions, rotary_dim, cfg.rope_theta)
-            q = apply_rope_partial(q, cos, sin, rotary_dim, cfg.rope_interleaved)
-            k = apply_rope_partial(k, cos, sin, rotary_dim, cfg.rope_interleaved)
+                cos, sin = rope_table(positions, rotary_dim, cfg.rope_theta)
+                q = apply_rope_partial(q, cos, sin, rotary_dim, cfg.rope_interleaved)
+                k = apply_rope_partial(k, cos, sin, rotary_dim, cfg.rope_interleaved)
 
         bias = None
         if cfg.pos_embedding == "alibi":
@@ -242,6 +249,8 @@ class DecoderAttention(nn.Module):
             q, k, v, causal=True, bias=bias, segment_ids=segment_ids,
             impl=cfg.attention_impl, sliding_window=window,
             logit_softcap=cfg.attn_logit_softcap, extra_mask=extra_mask,
+            rope_theta=cfg.rope_theta if fuse_rope else None,
+            positions=positions if fuse_rope else None,
         )
         out = out.reshape(b, s, cfg.num_attention_heads * hd)
         out = dense(cfg.hidden_size, "o_proj", cfg.attention_out_bias)(out)
@@ -307,8 +316,18 @@ class DecoderBlock(nn.Module):
             m = DecoderMLP(cfg, name="mlp")(h)
             return x + make_norm(cfg, "post_feedforward_layernorm", dtype)(m)
         h = make_norm(cfg, "input_layernorm", dtype)(x)
-        x = x + DecoderAttention(cfg, name="self_attn")(h, positions, segment_ids, layer_id)
-        h = make_norm(cfg, "post_attention_layernorm", dtype)(x)
+        a = DecoderAttention(cfg, name="self_attn")(h, positions, segment_ids, layer_id)
+        if cfg.fused_norm and cfg.norm_type == "rmsnorm" and not cfg.rms_scale_offset:
+            # plain-RMSNorm families take the fused residual+norm kernel;
+            # LayerNorm/offset variants keep the generic pair
+            from .llama import FusedAddRMSNorm
+
+            h, x = FusedAddRMSNorm(
+                eps=cfg.norm_eps, dtype=dtype, name="post_attention_layernorm"
+            )(x, a)
+        else:
+            x = x + a
+            h = make_norm(cfg, "post_attention_layernorm", dtype)(x)
         return x + DecoderMLP(cfg, name="mlp")(h)
 
 

@@ -116,6 +116,8 @@ def dot_product_attention(
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
     extra_mask: Optional[jax.Array] = None,
+    rope_theta: Optional[float] = None,
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention entry point used by all model forwards.
 
@@ -123,11 +125,22 @@ def dot_product_attention(
     kernel on TPU when shapes are tile-friendly, else XLA. Sliding windows
     and packed segment ids run in the kernel (position/segment tile masks);
     only an additive bias forces the XLA path.
+
+    ``rope_theta``: apply rotary embedding to q/k HERE instead of in the
+    model — the Pallas path folds the rotation into the flash kernels'
+    q/k load (no standalone rope HBM round-trip), every other path applies
+    the identical rotation up front. ``positions`` [B, S] defaults to
+    ``arange(S)``.
     """
     if impl == "auto":
         impl = "pallas" if (
             _pallas_eligible(q, k, bias) and logit_softcap is None and extra_mask is None
         ) else "xla"
+    if rope_theta is not None and positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(q.shape[1], dtype=jnp.int32)[None, :],
+            (q.shape[0], q.shape[1]),
+        )
     if impl == "pallas":
         if bias is not None:
             raise ValueError(
@@ -145,7 +158,13 @@ def dot_product_attention(
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             sliding_window=sliding_window, softmax_scale=softmax_scale,
+            rope_theta=rope_theta, q_positions=positions,
+            kv_positions=positions,
         )
+    if rope_theta is not None:
+        from colossalai_tpu.kernel import rope_embed
+
+        q, k = rope_embed(q, k, positions, theta=rope_theta)
     return xla_attention(
         q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
         softmax_scale=softmax_scale, sliding_window=sliding_window,

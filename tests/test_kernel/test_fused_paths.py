@@ -1,0 +1,223 @@
+"""Parity tests for the fused hot-path kernels (interpret mode on CPU):
+RoPE folded into the flash-attention q/k load, fused residual+RMSNorm,
+and the regressions the fusions must not break (dtype-aware mask fills,
+non-128-aligned fallback, paged heads_per_step splits)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.kernel.pallas.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+    pick_block,
+    supports,
+)
+from colossalai_tpu.kernel.pallas.rms_norm import fused_add_rms_norm
+from colossalai_tpu.models.llama import apply_rope, rope_table
+from colossalai_tpu.shardformer.layer.attention import xla_attention
+
+RNG = np.random.RandomState(7)
+THETA = 10000.0
+
+
+def _qkv(b=2, s=256, h=4, hkv=2, d=128, dtype=jnp.float32):
+    q = jnp.asarray(RNG.randn(b, s, h, d), dtype)
+    k = jnp.asarray(RNG.randn(b, s, hkv, d), dtype)
+    v = jnp.asarray(RNG.randn(b, s, hkv, d), dtype)
+    return q, k, v
+
+
+def _rotated(q, k, positions):
+    cos, sin = rope_table(positions, q.shape[-1], THETA)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+
+
+# ------------------------------------------------------- rope-in-flash fusion
+
+
+def test_fused_rope_forward_matches_prerotated():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=True, rope_theta=THETA)
+    qr, kr = _rotated(q, k, jnp.broadcast_to(jnp.arange(q.shape[1]), q.shape[:2]))
+    ref = xla_attention(qr, kr, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_fused_rope_grads_match_prerotated():
+    q, k, v = _qkv(s=256)
+    pos = jnp.broadcast_to(jnp.arange(q.shape[1]), q.shape[:2])
+
+    def lp(q, k, v):
+        return (flash_attention(q, k, v, causal=True, rope_theta=THETA) ** 2).sum()
+
+    def lx(q, k, v):
+        qr, kr = _rotated(q, k, pos)
+        return (xla_attention(qr, kr, v, causal=True) ** 2).sum()
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lx, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-4)
+
+
+def test_fused_rope_window_and_segments():
+    # the hard composition: sliding window + packed segment ids + explicit
+    # (restarting) positions, all masks resolved in-kernel while rope rides
+    # the q/k load
+    q, k, v = _qkv(s=256)
+    seg = jnp.asarray(RNG.randint(0, 2, size=q.shape[:2]).cumsum(-1) // 2, jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(q.shape[1]), q.shape[:2])
+    out = flash_attention(
+        q, k, v, causal=True, segment_ids=seg, sliding_window=64,
+        rope_theta=THETA, q_positions=pos, kv_positions=pos,
+    )
+    qr, kr = _rotated(q, k, pos)
+    ref = xla_attention(qr, kr, v, causal=True, segment_ids=seg, sliding_window=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=2e-5)
+
+
+def test_fused_rope_custom_positions():
+    # non-arange positions (e.g. packed restarts) rotate by the GIVEN angle
+    q, k, v = _qkv(b=1, s=128)
+    pos = jnp.asarray(RNG.randint(0, 4096, size=q.shape[:2]), jnp.int32)
+    pos = jnp.sort(pos, axis=-1)  # keep causal-by-position sensible
+    out = flash_attention(
+        q, k, v, causal=False, rope_theta=THETA, q_positions=pos, kv_positions=pos
+    )
+    qr, kr = _rotated(q, k, pos)
+    ref = xla_attention(qr, kr, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=2e-5)
+
+
+def test_model_level_fusion_flags_are_noops_on_cpu():
+    # default-on model flags must not change numerics: CPU runs the
+    # identical-math fallbacks, so logits are bit-equal with flags off
+    from colossalai_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32,
+    )
+    assert cfg.fuse_rope_attn and cfg.fused_norm  # defaults stay on
+    ids = jnp.asarray(RNG.randint(0, 64, size=(2, 16)))
+    params = LlamaForCausalLM(cfg).init(jax.random.PRNGKey(0), ids)
+    on = LlamaForCausalLM(cfg).apply(params, ids).logits
+    off = LlamaForCausalLM(
+        dataclasses.replace(cfg, fuse_rope_attn=False, fused_norm=False)
+    ).apply(params, ids).logits
+    assert float(jnp.abs(on - off).max()) == 0.0
+
+
+# ------------------------------------------------------ fused residual+norm
+
+
+def _rms_ref(x, scale, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_add_rms_norm_forward(dtype):
+    x = jnp.asarray(RNG.randn(6, 96), dtype)
+    r = jnp.asarray(RNG.randn(6, 96), dtype)
+    scale = jnp.asarray(RNG.randn(96), jnp.float32)
+    out, summed = fused_add_rms_norm(x, r, scale)
+    np.testing.assert_allclose(
+        np.asarray(summed, np.float32), np.asarray(x + r, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(_rms_ref(x + r, scale), np.float32),
+        atol=2e-2 if dtype == jnp.bfloat16 else 1e-6, rtol=2e-2,
+    )
+
+
+def test_fused_add_rms_norm_grads():
+    x = jnp.asarray(RNG.randn(8, 64), jnp.float32)
+    r = jnp.asarray(RNG.randn(8, 64), jnp.float32)
+    scale = jnp.asarray(RNG.randn(64), jnp.float32)
+
+    def lf(x, r, s):
+        out, summed = fused_add_rms_norm(x, r, s)
+        return (out ** 2).sum() + (summed ** 3).sum()  # use BOTH outputs
+
+    def lr(x, r, s):
+        summed = x + r
+        return (_rms_ref(summed, s) ** 2).sum() + (summed ** 3).sum()
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(x, r, scale)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, r, scale)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+# ----------------------------------------------- masking / shape regressions
+
+
+def test_fully_masked_rows_zero_output_finite_lse():
+    # rows whose segment appears nowhere in kv must produce EXACTLY zero
+    # output and a finite lse (the dtype-aware fill: -inf would make the
+    # online-softmax rescale emit NaN through inf - inf)
+    q, k, v = _qkv(b=1, s=128)
+    qseg = jnp.where(jnp.arange(128)[None, :] < 64, 0, 7).astype(jnp.int32)
+    kseg = jnp.zeros((1, 128), jnp.int32)  # segment 7 never appears kv-side
+    out, lse = flash_attention_with_lse(
+        q, k, v, causal=False, segment_ids=qseg, kv_segment_ids=kseg
+    )
+    out = np.asarray(out)
+    assert np.all(np.isfinite(np.asarray(lse)))
+    assert np.all(out[:, 64:] == 0.0), "masked rows must be exactly zero"
+    assert np.all(np.isfinite(out))
+    ref = np.asarray(xla_attention(q, k, v, causal=False, segment_ids=qseg,
+                                   kv_segment_ids=kseg))
+    np.testing.assert_allclose(out[:, :64], ref[:, :64], atol=2e-5, rtol=2e-5)
+
+
+def test_pick_block_names_nearest_valid_lengths():
+    with pytest.raises(ValueError) as e:
+        pick_block(300, 1024)
+    msg = str(e.value)
+    assert "seq=300" in msg and "256" in msg and "384" in msg
+    assert not supports((1, 300, 4, 128), (1, 300, 2, 128))
+
+
+def test_non_divisor_shapes_fall_back_to_xla():
+    # a 200-token (non-128-aligned) sequence with rope requested must run —
+    # impl="auto" routes around the kernel and applies the same rotation
+    from colossalai_tpu.shardformer.layer.attention import dot_product_attention
+
+    q, k, v = _qkv(b=1, s=200, d=64)
+    out = dot_product_attention(q, k, v, causal=True, rope_theta=THETA)
+    pos = jnp.broadcast_to(jnp.arange(200), (1, 200))
+    qr, kr = _rotated(q, k, pos)
+    ref = xla_attention(qr, kr, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+# ------------------------------------------------- paged attention splitting
+
+
+def test_paged_attention_heads_per_step_splits_match():
+    from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
+
+    S, H, Hkv, D, bs, nb, mb = 4, 8, 4, 128, 16, 16, 3
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (S, H, D), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (nb, Hkv, bs, D), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (nb, Hkv, bs, D), jnp.float32)
+    tables = jnp.asarray(
+        np.random.default_rng(1).permutation(np.arange(1, nb))[: S * mb].reshape(S, mb),
+        jnp.int32,
+    )
+    lengths = jnp.asarray([3, 17, 30, 48], jnp.int32)
+    full = paged_attention(q, k_pool, v_pool, tables, lengths, heads_per_step=Hkv)
+    for hps in (2, 1):  # the candidate splits the tuner measures
+        split = paged_attention(q, k_pool, v_pool, tables, lengths, heads_per_step=hps)
+        np.testing.assert_allclose(
+            np.asarray(split), np.asarray(full), atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError):
+        paged_attention(q, k_pool, v_pool, tables, lengths, heads_per_step=3)

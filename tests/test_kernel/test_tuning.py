@@ -1,0 +1,109 @@
+"""Unit tests for the persistent kernel tuning cache (kernel/tuning.py).
+
+These run on CPU: benchmarks are exercised with ``force=True`` and a fake
+measure function, and the no-force path must BYPASS tuning entirely (no
+disk IO, static defaults) so tier-1 stays deterministic.
+"""
+
+import json
+import os
+
+import pytest
+
+from colossalai_tpu.kernel import tuning
+from colossalai_tpu.kernel.tuning import KernelTuner, bucket
+
+
+def test_bucket_is_bounded_power_of_two():
+    assert bucket(1) == 1
+    assert bucket(100) == 128
+    assert bucket(4096) == 4096
+    assert bucket(4097) == 8192
+    assert bucket(10**9) == 65536  # capped
+
+
+def test_bypassed_off_tpu_returns_default_without_disk(tmp_path):
+    t = KernelTuner(cache_dir=str(tmp_path))
+    calls = []
+    got = t.tune("flash_attention", ("cpu", 1024), [(512, 512), (1024, 1024)],
+                 lambda c: calls.append(c) or 0.1, default=(1024, 1024))
+    assert got == (1024, 1024)
+    assert calls == []  # never benchmarked
+    assert t.bypassed == 1 and t.misses == 0
+    assert os.listdir(tmp_path) == []  # never touched disk
+
+
+def test_force_round_trip_persists_across_instances(tmp_path):
+    times = {(512, 512): 0.003, (1024, 1024): 0.001, (2048, 1024): 0.002}
+    calls = []
+
+    def measure(c):
+        calls.append(c)
+        return times[c]
+
+    t1 = KernelTuner(cache_dir=str(tmp_path))
+    got = t1.tune("flash_attention", ("dev", 4096, "bf16"), list(times),
+                  measure, default=(512, 512), force=True)
+    assert got == (1024, 1024)  # the measured winner, not the default
+    assert sorted(calls) == sorted(times)
+    assert t1.misses == 1
+
+    # fresh instance (≙ a new process): hits the on-disk entry, no benchmarks
+    t2 = KernelTuner(cache_dir=str(tmp_path))
+    calls.clear()
+    got2 = t2.tune("flash_attention", ("dev", 4096, "bf16"), list(times),
+                   measure, default=(512, 512), force=True)
+    assert got2 == (1024, 1024) and calls == []
+    assert t2.hits == 1 and t2.misses == 0
+
+    # the artifact is versioned json with candidate timings for inspection
+    (cache_file,) = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+    with open(tmp_path / cache_file) as f:
+        data = json.load(f)
+    assert data["version"] == tuning.SCHEMA_VERSION
+    (entry,) = data["entries"].values()
+    assert entry["config"] == [1024, 1024]
+    assert len(entry["timings_us"]) == 3
+
+
+def test_failing_candidates_lose_and_all_failing_returns_default(tmp_path):
+    t = KernelTuner(cache_dir=str(tmp_path))
+
+    def measure(c):
+        if c != 256:
+            raise RuntimeError("won't compile")
+        return 0.5
+
+    assert t.tune("rms_norm", ("dev", 8), [128, 256, 512], measure,
+                  default=128, force=True) == 256
+    assert t.errors == 2
+
+    def all_fail(c):
+        raise RuntimeError("no")
+
+    assert t.tune("rms_norm", ("dev", 16), [128, 256], all_fail,
+                  default=128, force=True) == 128
+
+
+def test_env_kill_switch(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.ENV_ENABLE, "0")
+    assert not tuning.tuning_enabled()
+
+
+def test_corrupt_cache_is_cold_cache(tmp_path):
+    t1 = KernelTuner(cache_dir=str(tmp_path))
+    t1.tune("softmax", ("dev", 1), [64], lambda c: 0.1, default=64, force=True)
+    (cache_file,) = os.listdir(tmp_path)
+    (tmp_path / cache_file).write_text("{not json")
+    t2 = KernelTuner(cache_dir=str(tmp_path))
+    got = t2.tune("softmax", ("dev", 1), [64], lambda c: 0.1, default=32,
+                  force=True)
+    assert got == 64 and t2.misses == 1  # re-measured, not crashed
+
+
+def test_stats_shape():
+    s = tuning.stats()
+    for key in ("device", "enabled", "cache_file", "hits", "misses",
+                "bypassed", "chosen"):
+        assert key in s
+    json.dumps(s)  # bench.py embeds this verbatim in its JSON line
