@@ -214,6 +214,81 @@ def measure_decode(cfg, bs: int = 8, prompt_len: int = 128, steps: int = 24):
     return round(n_tokens / dt, 1)
 
 
+def measure_serving(cfg, bs: int = 8, ks=(1, 8), new_tokens: int = 64):
+    """Decode-serving metrics under a MIXED prefill/decode workload, per
+    megastep-K: batch tokens/s, mean time-to-first-token, and mean
+    inter-token latency. Half the requests (short prompts) arrive up
+    front; the other half (long prompts) arrive mid-decode, so their
+    prefills compete with running decode — the head-of-line case chunked
+    prefill exists for. K=1 is the classic per-token loop (the before
+    picture); K>1 runs device-resident megasteps + chunked prefill."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    # short prompts decode from tick 1; long ones land mid-flight
+    lens = [64] * (bs // 2) + [512] * (bs - bs // 2)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(n,))) for n in lens]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+
+    out = {}
+    for k in ks:
+        engine = LLMEngine(
+            params, cfg, max_batch_size=bs, max_seq_len=1024, block_size=64,
+            megastep_k=k, prefill_chunk=256 if k > 1 else None,
+        )
+        # warm every program this workload needs (both prefill buckets /
+        # chunk sizes + the decode megastep) on throwaway requests
+        for p in (prompts[0], prompts[-1]):
+            engine.generate([list(p)], GenerationConfig(max_new_tokens=2))
+
+        wave1 = bs // 2
+        t_submit, t_first, t_done, n_toks = {}, {}, {}, {}
+        rids = []
+        for p in prompts[:wave1]:
+            rids.append(engine.add_request(list(p), gen))
+            t_submit[rids[-1]] = time.perf_counter()
+        ticks = 0
+        t0 = time.perf_counter()
+        while engine.has_work:
+            finished = engine.step()
+            now = time.perf_counter()
+            ticks += 1
+            if ticks == 2:  # second wave: long prompts against live decode
+                for p in prompts[wave1:]:
+                    rids.append(engine.add_request(list(p), gen))
+                    t_submit[rids[-1]] = time.perf_counter()
+            for req in engine.running.values():
+                if req.output_ids and req.request_id not in t_first:
+                    t_first[req.request_id] = now
+            for req in finished:
+                t_first.setdefault(req.request_id, now)
+                t_done[req.request_id] = now
+                n_toks[req.request_id] = len(req.output_ids)
+        dt = time.perf_counter() - t0
+        ttft = [t_first[r] - t_submit[r] for r in rids]
+        itl = [
+            (t_done[r] - t_first[r]) / max(n_toks[r] - 1, 1) for r in rids
+        ]
+        st = engine.stats
+        out[f"k{k}"] = {
+            "tokens_per_s": round(sum(n_toks.values()) / dt, 1),
+            "ttft_ms_mean": round(1e3 * sum(ttft) / len(ttft), 1),
+            "itl_ms_mean": round(1e3 * sum(itl) / len(itl), 2),
+            "decode_syncs": st.decode_syncs,
+            "h2d_scalars_per_token": round(
+                st.decode_h2d_scalars / max(st.decode_tokens, 1), 3
+            ),
+        }
+    return out
+
+
 def measure_moe(n_dev: int, steps: int = 5):
     """MoE pretraining throughput: a ~0.8B-active mixtral-shaped model
     (tokens/s/device — MoE MFU accounting is convention-laden, so the raw
@@ -372,6 +447,12 @@ def child_main():
             extras["decode_tokens_per_s_bs8"] = measure_decode(model_for(hbm, 1024))
         except Exception as e:
             print(f"decode bench failed: {e}", file=sys.stderr)
+        try:
+            # mixed prefill/decode serving: TTFT / inter-token latency /
+            # tokens-per-s per megastep-K — the device-resident-loop win
+            extras["serving"] = measure_serving(model_for(hbm, 1024))
+        except Exception as e:
+            print(f"serving bench failed: {e}", file=sys.stderr)
         try:
             extras.update(measure_flash_kernels())
         except Exception as e:

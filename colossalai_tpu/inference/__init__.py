@@ -1,5 +1,5 @@
 from .diffusion import ddim_sample, ddim_schedule
-from .engine import GenerationConfig, LLMEngine, Request
+from .engine import EngineStats, GenerationConfig, LLMEngine, Request
 from .kv_cache import (
     BlockAllocator,
     OutOfBlocks,
@@ -9,7 +9,13 @@ from .kv_cache import (
 )
 from .modeling import KVCache, decode_step, extend_step, init_cache, prefill
 from .multiprocess import MultiProcessFrontend
-from .paged_modeling import decode_paged, prefill_paged
+from .paged_modeling import (
+    decode_megastep,
+    decode_paged,
+    prefill_chunk_paged,
+    prefill_paged,
+    sample_tokens,
+)
 from .server import make_server
 from .speculative import SpeculativeEngine, SpecStats
 
@@ -29,8 +35,12 @@ __all__ = [
     "PagedKVCache",
     "SequenceTable",
     "init_paged_cache",
+    "EngineStats",
+    "decode_megastep",
     "decode_paged",
+    "prefill_chunk_paged",
     "prefill_paged",
+    "sample_tokens",
     "make_server",
     "extend_step",
     "SpeculativeEngine",

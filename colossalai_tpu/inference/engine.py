@@ -7,10 +7,20 @@ Design deltas for TPU/XLA:
 
 - static shapes: a fixed page pool [L, n_blocks, Hkv, bs, D] + padded
   per-slot block tables — recompiles happen only per prompt-length bucket;
-- prefill runs per-request (padded to a bucket) writing whole pages;
-  decode advances ALL running slots in one jitted step through the pages
-  (XLA gather or the Pallas paged kernel) — that interleaving is the
-  continuous batching;
+- decode runs in device-resident MEGASTEPS: a jitted ``lax.fori_loop`` of
+  K forward→sample→commit iterations with on-device length increments and
+  per-slot done flags, so the host syncs once per K tokens instead of per
+  token, and the block tables / lengths / sampling params live on device,
+  patched O(1) at admission and page growth instead of re-uploaded
+  wholesale every step (the [max_batch, max_blocks] numpy rebuild the r02
+  host-bound-decode review flagged). K is ``megastep_k`` (default >1 on
+  TPU, 1 elsewhere so CPU-path numerics are unchanged); the scheduler
+  pre-funds K tokens of pages per slot before entering the loop and falls
+  back to K=1 when pages are tight;
+- prefill either runs per-request (padded to a bucket) writing whole
+  pages, or — with ``prefill_chunk`` set — in block-aligned CHUNKS
+  interleaved with decode megasteps, so one long prompt no longer
+  head-of-line-blocks the whole decode batch (chunked prefill);
 - host-side BlockAllocator does allocation/free/ref-counting; admission
   blocks when no pages are free and resumes as finished requests release
   theirs (≙ the reference's running/waiting queues);
@@ -18,7 +28,8 @@ Design deltas for TPU/XLA:
   (auto-policy) and the page pool's head dim over ``tp``;
 - optional pipeline parallelism: a mesh with a ``pp`` axis distributes
   layer stages — weights and their KV pages — across device groups with a
-  ppermute activation relay (pp_decode.py ≙ schedule/generate.py);
+  ppermute activation relay (pp_decode.py ≙ schedule/generate.py); decode
+  megasteps run the relay K times inside one program;
 - multi-host: pass a mesh that SPANS processes (under ``jax.distributed``)
   and every process runs this same engine as a replicated deterministic
   scheduler — host inputs become global replicated arrays, the jitted
@@ -35,7 +46,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional, Set, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +55,12 @@ import numpy as np
 from colossalai_tpu.models.llama import LlamaConfig
 
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
-from .paged_modeling import decode_paged, prefill_paged
+from .paged_modeling import (
+    decode_megastep,
+    prefill_chunk_paged,
+    prefill_paged,
+    sample_tokens,
+)
 
 
 @dataclasses.dataclass
@@ -72,13 +88,69 @@ class Request:
     #: member's request id; followers are materialized at admission off the
     #: leader's single prefill (KV pages fork-shared, partial page copied)
     group_ids: Optional[List[int]] = None
+    #: chunked prefill: prompt tokens already ingested into the pool
+    prefill_pos: int = 0
+    #: chunked prefill of a GROUP: follower slots held in reserve until the
+    #: leader's final chunk produces the logits every member samples from
+    group_slots: Optional[List[int]] = None
 
     @property
     def n_samples(self) -> int:
         return len(self.group_ids) if self.group_ids else 1
 
 
+@dataclasses.dataclass
+class EngineStats:
+    """Host↔device traffic accounting for the decode hot path — the
+    megastep contract is O(1) amortized transfers per generated token, and
+    these counters make it assertable (tests) and observable (/health)."""
+
+    decode_megasteps: int = 0
+    #: host fetches of decode results (one per megastep — the only decode sync)
+    decode_syncs: int = 0
+    decode_tokens: int = 0
+    #: scalars uploaded by incremental decode-path patches (page funding);
+    #: the pre-megastep engine re-uploaded max_batch × max_blocks_per_seq
+    #: table entries (plus tokens/lengths/active) EVERY token instead
+    decode_h2d_scalars: int = 0
+    decode_d2h_elements: int = 0
+    prefill_chunks: int = 0
+    #: megasteps demoted to K=1 because the page pool couldn't fund K tokens
+    fallback_k1: int = 0
+
+
+#: jitted sampler shared with the megastep's in-loop sampling (kept under
+#: its historical name — tests and downstreams import it from here)
+_sample_slots = jax.jit(sample_tokens)
+
 _greedy_slots = jax.jit(lambda logits: jnp.argmax(logits, axis=-1))
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _patch1(arr, idx, val):
+    """O(1) device-side update of one element/row of a device-resident
+    state array — the incremental patching that replaces wholesale
+    re-uploads of the block tables / lengths / sampling params."""
+    return arr.at[idx].set(val)
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _patch2(arr, i, j, val):
+    """O(1) update of one [i, j] entry (page-table growth)."""
+    return arr.at[i, j].set(val)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _split_chain(rng, k: int):
+    """K sequential PRNG splits in one dispatch. The chain is IDENTICAL to
+    k per-step ``rng, key = jax.random.split(rng)`` calls, so a megastep
+    consumes randomness exactly like k single steps would."""
+
+    def body(r, _):
+        r, key = jax.random.split(r)
+        return r, key
+
+    return jax.lax.scan(body, rng, None, length=k)
 
 
 @functools.partial(jax.jit, donate_argnums=0)
@@ -103,35 +175,6 @@ def _copy_block_pp(cache: PagedKVCache, src, dst) -> PagedKVCache:
     )
 
 
-@jax.jit
-def _sample_slots(logits, rng, temperature, top_k, top_p, do_sample):
-    """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
-    generation params [S] → tokens [S]. One compiled program per tick; the
-    host fetches S ints, never the [S, V] logits (the r02 review's
-    host-bound-decode fix). top_k=0 / top_p=1 disable those filters.
-    Filters compose sequentially (HF convention): the top-p nucleus is
-    measured on the top-k-RENORMALIZED distribution, not the full vocab."""
-    vocab = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    k_eff = jnp.where(top_k > 0, top_k, vocab).astype(jnp.int32)
-    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1).clip(0, vocab - 1)[:, None], axis=-1)
-    masked = jnp.where(scaled < kth, -1e9, scaled)
-    # top-p over the POST-top-k distribution (already sorted: prefix of
-    # sorted_desc survives the k filter, the tail is -1e9)
-    sorted_masked = jnp.where(
-        jnp.arange(vocab)[None, :] < k_eff[:, None], sorted_desc, -1e9
-    )
-    probs = jax.nn.softmax(sorted_masked, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
-    cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx.clip(0, vocab - 1), axis=-1)
-    masked = jnp.where(scaled < cutoff, -1e9, masked)
-    sampled = jax.random.categorical(rng, masked, axis=-1)
-    return jnp.where(do_sample, sampled, greedy)
-
-
 class LLMEngine:
     """Paged continuous batching over a llama-family model."""
 
@@ -147,6 +190,8 @@ class LLMEngine:
         seed: int = 0,
         mesh=None,
         use_kernel: bool = False,
+        megastep_k: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         self.config = config
         self.max_batch = max_batch_size
@@ -166,6 +211,21 @@ class LLMEngine:
             b for b in sorted(prefill_buckets)
             if b <= max_seq_len and b % block_size == 0
         ) or (max_seq_len,)
+        if megastep_k is None:
+            # >1 only where the per-token dispatch/sync overhead dominates;
+            # K=1 on CPU keeps tier-1 numerics and rng consumption identical
+            # to per-step scheduling
+            megastep_k = 8 if jax.default_backend() == "tpu" else 1
+        if megastep_k < 1:
+            raise ValueError(f"megastep_k={megastep_k} must be >= 1")
+        self.megastep_k = int(megastep_k)
+        if prefill_chunk is not None:
+            if prefill_chunk < block_size or prefill_chunk % block_size:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} must be a multiple of "
+                    f"block_size={block_size} (chunks write whole pages)"
+                )
+        self.prefill_chunk = prefill_chunk
         self.use_kernel = use_kernel
         self.mesh = mesh
         dtype = config.dtype or jnp.bfloat16
@@ -209,7 +269,8 @@ class LLMEngine:
             self._pp_top, self._pp_stacked, cache = shard_params_pp(
                 params, cache, mesh, config.num_hidden_layers
             )
-            self._pp_prefill, self._pp_decode = build_pp_paged(
+            (self._pp_prefill, self._pp_decode, self._pp_megastep,
+             self._pp_prefill_chunk) = build_pp_paged(
                 mesh, config, block_size, self.max_blocks_per_seq
             )
             mesh = None  # skip the GSPMD tp placement below
@@ -242,6 +303,10 @@ class LLMEngine:
         self._ids = itertools.count()
         self.waiting: List[Request] = []
         self.running: Dict[int, Request] = {}  # slot -> request
+        #: slot -> request mid-chunked-prefill (not yet decoding)
+        self.prefilling: Dict[int, Request] = {}
+        #: follower slots held while a group leader's chunked prefill runs
+        self._reserved: Set[int] = set()
         self._slot_tokens = np.zeros((max_batch_size,), np.int64)
         self._tables: Dict[int, SequenceTable] = {}
         # per-slot generation params mirrored as arrays for _sample_slots
@@ -249,6 +314,23 @@ class LLMEngine:
         self._gen_topk = np.zeros((max_batch_size,), np.int32)
         self._gen_topp = np.ones((max_batch_size,), np.float32)
         self._gen_sample = np.zeros((max_batch_size,), bool)
+        self.stats = EngineStats()
+        # ---- device-resident decode state: the scheduler PATCHES these
+        # (O(1) scalars at admission / page growth / release) and the
+        # megastep advances them in-graph; nothing per-token crosses the
+        # host boundary except the once-per-K result fetch
+        mb = max_batch_size
+        self._dev_tables = self._put_rep(
+            np.zeros((mb, self.max_blocks_per_seq), np.int32))
+        self._dev_lengths = self._put_rep(np.zeros((mb,), np.int32))
+        self._dev_tokens = self._put_rep(np.zeros((mb,), np.int32))
+        self._dev_active = self._put_rep(np.zeros((mb,), bool))
+        self._dev_budget = self._put_rep(np.zeros((mb,), np.int32))
+        self._dev_temp = self._put_rep(np.ones((mb,), np.float32))
+        self._dev_topk = self._put_rep(np.zeros((mb,), np.int32))
+        self._dev_topp = self._put_rep(np.ones((mb,), np.float32))
+        self._dev_sample = self._put_rep(np.zeros((mb,), bool))
+        self._dev_eos = self._put_rep(np.full((mb,), -1, np.int32))
 
     def _put(self, x, spec):
         """Place ``x`` on the engine mesh. Single-process: a device_put.
@@ -347,9 +429,17 @@ class LLMEngine:
         or the list of member ids for a group. Pair groups with
         ``do_sample=True`` — greedy members would all emit the same tokens.
         """
-        req = Request(next(self._ids), list(map(int, prompt_ids)), gen or GenerationConfig())
-        if len(req.prompt_ids) >= self.max_seq:
-            raise ValueError(f"prompt length {len(req.prompt_ids)} >= max_seq_len {self.max_seq}")
+        prompt_ids = list(map(int, prompt_ids))
+        if not prompt_ids:
+            raise ValueError("empty prompt: at least one token is required")
+        if len(prompt_ids) >= self.max_seq:
+            raise ValueError(
+                f"prompt is {len(prompt_ids)} tokens but max_seq_len="
+                f"{self.max_seq} and generation needs at least one free "
+                f"position — truncate the prompt or build the engine with "
+                f"a larger max_seq_len"
+            )
+        req = Request(next(self._ids), prompt_ids, gen or GenerationConfig())
         if n_samples < 1:
             raise ValueError(f"n_samples={n_samples} must be >= 1")
         if n_samples > self.max_batch:
@@ -375,15 +465,25 @@ class LLMEngine:
     def abort(self, request_id: int) -> bool:
         """Cancel a request mid-flight (≙ the reference server's abort
         path): a WAITING request leaves the queue (a grouped leader takes
-        its whole group with it — members share one prefill); a RUNNING
-        request releases its slot and frees its KV pages immediately
-        (ref-counted, so aborting one member of a group never frees pages
-        the others still read). Returns whether anything was cancelled."""
+        its whole group with it — members share one prefill); a PREFILLING
+        request (chunked prefill) releases its slot, pages, and any
+        reserved follower slots; a RUNNING request releases its slot and
+        frees its KV pages immediately (ref-counted, so aborting one member
+        of a group never frees pages the others still read). Returns
+        whether anything was cancelled."""
         for i, req in enumerate(self.waiting):
             if req.request_id == request_id or (
                 req.group_ids and request_id in req.group_ids
             ):
                 self.waiting.pop(i)
+                return True
+        for slot, req in list(self.prefilling.items()):
+            if req.request_id == request_id or (
+                req.group_ids and request_id in req.group_ids
+            ):
+                # members don't exist yet: the whole group leaves together
+                self._reserved.difference_update(req.group_slots or [])
+                self._release(slot)
                 return True
         for slot, req in list(self.running.items()):
             if req.request_id == request_id:
@@ -395,14 +495,23 @@ class LLMEngine:
         """Blocking batch API (≙ LLMEngine.generate :496)."""
         order = [self.add_request(p, gen) for p in prompts]
         done: Dict[int, Request] = {}
-        while self.waiting or self.running:
+        while self.has_work:
             for req in self.step():
                 done[req.request_id] = req
         return [done[rid].output_ids for rid in order]
 
+    @property
+    def has_work(self) -> bool:
+        """Anything queued, mid-prefill, or decoding."""
+        return bool(self.waiting or self.prefilling or self.running)
+
     # ------------------------------------------------------------ scheduler
     def _free_slots(self) -> List[int]:
-        return [s for s in range(self.max_batch) if s not in self.running]
+        return [
+            s for s in range(self.max_batch)
+            if s not in self.running and s not in self.prefilling
+            and s not in self._reserved
+        ]
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -424,9 +533,18 @@ class LLMEngine:
         return bucket, need_leader, full, tail, need_leader + (n_samples - 1) * tail
 
     def step(self) -> List[Request]:
-        """Admit waiting requests into free slots (prefill, page-funded),
-        then advance all running slots one token. Returns finished requests."""
-        finished_at_prefill: List[Request] = []
+        """One scheduler tick: admit waiting requests into free slots
+        (page-funded), advance chunked prefills by one chunk each, then
+        advance all running slots by one decode MEGASTEP (K tokens per
+        host sync; K=1 degenerates to the classic per-token loop).
+        Returns finished requests."""
+        finished: List[Request] = []
+        self._admit(finished)
+        self._advance_prefills(finished)
+        self._decode_tick(finished)
+        return finished
+
+    def _admit(self, finished: List[Request]) -> None:
         free = self._free_slots()
         while self.waiting and free:
             req = self.waiting[0]
@@ -444,97 +562,230 @@ class LLMEngine:
             req.slot = free.pop(0)
             req.table = SequenceTable(self.allocator.allocate(need_leader))
             self._tables[req.slot] = req.table
+            if self.prefill_chunk is not None and n > self.prefill_chunk:
+                # chunked prefill: ingest block-aligned chunks across ticks
+                # so decode megasteps interleave instead of stalling behind
+                # one big padded-bucket prefill; a group's follower slots
+                # are reserved until the final chunk yields the logits
+                # every member samples its first token from
+                req.prefill_pos = 0
+                req.group_slots = [
+                    free.pop(0) for _ in (req.group_ids or [])[1:]
+                ]
+                self._reserved.update(req.group_slots)
+                self.prefilling[req.slot] = req
+                continue
             logits = self._prefill_into_slot(req, bucket)
-            members = [req]
-            for fid in (req.group_ids or [])[1:]:
-                f = Request(fid, req.prompt_ids, req.gen)
-                f.slot = free.pop(0)
-                shared = req.table.blocks[:full]
-                self.allocator.fork(shared)
-                fresh = self.allocator.allocate(tail) if tail else []
-                if n % self.block_size:
-                    # the partial prompt page would be overwritten by this
-                    # member's first tokens: copy-on-write it
-                    copy = _copy_block_pp if self._pp else _copy_block
-                    self.cache = copy(
-                        self.cache,
-                        self._put_rep(np.asarray(req.table.blocks[full], np.int32)),
-                        self._put_rep(np.asarray(fresh[0], np.int32)),
-                    )
-                f.table = SequenceTable(shared + fresh)
-                f.table.length = n
-                self._tables[f.slot] = f.table
-                self._set_slot_gen(f.slot, f.gen)
-                # first member token: an independent sample from the SAME
-                # prefill logits (the whole point of the shared prefill)
-                tok = int(self._sample_rows(
-                    logits, np.asarray([f.gen.temperature]),
-                    np.asarray([f.gen.top_k]), np.asarray([f.gen.top_p]),
-                    np.asarray([f.gen.do_sample]),
-                )[0])
-                f.output_ids.append(tok)
-                self._slot_tokens[f.slot] = tok
-                members.append(f)
-            for m in members:
-                if self._is_finished(m, m.output_ids[-1]):
-                    m.finished = True
-                    finished_at_prefill.append(m)
-                    self._release(m.slot)
-                else:
-                    self.running[m.slot] = m
+            self._finish_prefill(req, logits, free, finished)
 
+    def _advance_prefills(self, finished: List[Request]) -> None:
+        """One chunk of prompt ingestion per prefilling slot per tick."""
+        for slot in sorted(self.prefilling):
+            req = self.prefilling[slot]
+            c = self.prefill_chunk
+            n = len(req.prompt_ids)
+            pos = req.prefill_pos
+            n_valid = min(n - pos, c)
+            ids = np.zeros((1, c), np.int32)
+            ids[0, :n_valid] = req.prompt_ids[pos:pos + n_valid]
+            table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
+            if self._pp:
+                logits, self.cache = self._pp_prefill_chunk(
+                    self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                    jnp.asarray(pos, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+                    self.cache, jnp.asarray(table),
+                )
+            else:
+                logits, self.cache = prefill_chunk_paged(
+                    self.params, self.config, self._put_rep(ids),
+                    self._put_rep(np.asarray(pos, np.int32)),
+                    self._put_rep(np.asarray(n_valid, np.int32)),
+                    self.cache, self._put_rep(table),
+                )
+            self.stats.prefill_chunks += 1
+            req.prefill_pos = pos + n_valid
+            if req.prefill_pos >= n:
+                self.prefilling.pop(slot)
+                req.table.length = n
+                followers = req.group_slots or []
+                self._reserved.difference_update(followers)
+                self._finish_prefill(req, logits, followers, finished)
+
+    def _finish_prefill(self, req: Request, logits, follower_slots: List[int],
+                        finished: List[Request]) -> None:
+        """Prefill logits → first sampled token for the leader and every
+        group member (fork-shared pages, CoW partial page), then activate
+        the survivors' device-resident decode state."""
+        n = len(req.prompt_ids)
+        _, _, full, tail, _ = self._group_page_needs(n, req.n_samples)
+        g = req.gen
+        self._set_slot_gen(req.slot, g)
+        tok = int(self._sample_rows(
+            logits, np.asarray([g.temperature]), np.asarray([g.top_k]),
+            np.asarray([g.top_p]), np.asarray([g.do_sample]),
+        )[0])
+        req.output_ids.append(tok)
+        self._slot_tokens[req.slot] = tok
+        members = [req]
+        for fid in (req.group_ids or [])[1:]:
+            f = Request(fid, req.prompt_ids, req.gen)
+            f.slot = follower_slots.pop(0)
+            shared = req.table.blocks[:full]
+            self.allocator.fork(shared)
+            fresh = self.allocator.allocate(tail) if tail else []
+            if n % self.block_size:
+                # the partial prompt page would be overwritten by this
+                # member's first tokens: copy-on-write it
+                copy = _copy_block_pp if self._pp else _copy_block
+                self.cache = copy(
+                    self.cache,
+                    self._put_rep(np.asarray(req.table.blocks[full], np.int32)),
+                    self._put_rep(np.asarray(fresh[0], np.int32)),
+                )
+            f.table = SequenceTable(shared + fresh)
+            f.table.length = n
+            self._tables[f.slot] = f.table
+            self._set_slot_gen(f.slot, f.gen)
+            # first member token: an independent sample from the SAME
+            # prefill logits (the whole point of the shared prefill)
+            ftok = int(self._sample_rows(
+                logits, np.asarray([f.gen.temperature]),
+                np.asarray([f.gen.top_k]), np.asarray([f.gen.top_p]),
+                np.asarray([f.gen.do_sample]),
+            )[0])
+            f.output_ids.append(ftok)
+            self._slot_tokens[f.slot] = ftok
+            members.append(f)
+        for m in members:
+            if self._is_finished(m, m.output_ids[-1]):
+                m.finished = True
+                finished.append(m)
+                self._release(m.slot)
+            else:
+                self.running[m.slot] = m
+                self._activate_slot(m)
+
+    # ------------------------------------------------------ decode megastep
+    def _budget_left(self, req: Request) -> int:
+        """Tokens this request may still emit (max_new_tokens AND the
+        max_seq guard) — the device-side done flag counts down from this."""
+        cap = min(req.gen.max_new_tokens,
+                  self.max_seq - 1 - len(req.prompt_ids))
+        return cap - len(req.output_ids)
+
+    def _activate_slot(self, req: Request) -> None:
+        """Patch one slot's decode state into the device-resident arrays:
+        its padded table row, length, last token, token budget, active
+        flag. O(max_blocks) once per admission — never again per token."""
+        slot = req.slot
+        row = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
+        idx = self._put_rep(np.asarray(slot, np.int32))
+        self._dev_tables = _patch1(self._dev_tables, idx, self._put_rep(row))
+        self._dev_lengths = _patch1(
+            self._dev_lengths, idx,
+            self._put_rep(np.asarray(req.table.length, np.int32)))
+        self._dev_tokens = _patch1(
+            self._dev_tokens, idx,
+            self._put_rep(np.asarray(req.output_ids[-1], np.int32)))
+        self._dev_budget = _patch1(
+            self._dev_budget, idx,
+            self._put_rep(np.asarray(self._budget_left(req), np.int32)))
+        self._dev_active = _patch1(self._dev_active, idx,
+                                   self._put_rep(np.asarray(True)))
+
+    def _fund_slot(self, slot: int, req: Request, k: int) -> bool:
+        """Reserve pages for min(k, budget) more tokens of this slot and
+        patch exactly the new table entries into the device table. Returns
+        False (allocator untouched) when the pool can't cover it."""
+        t = req.table
+        target = t.length + min(k, max(self._budget_left(req), 1))
+        base = len(t.blocks)
+        try:
+            fresh = self.allocator.fund(t, target)
+        except OutOfBlocks:
+            return False
+        idx = self._put_rep(np.asarray(slot, np.int32))
+        for j, b in enumerate(fresh):
+            self._dev_tables = _patch2(
+                self._dev_tables, idx,
+                self._put_rep(np.asarray(base + j, np.int32)),
+                self._put_rep(np.asarray(b, np.int32)))
+            self.stats.decode_h2d_scalars += 3
+        return True
+
+    def _decode_tick(self, finished: List[Request]) -> None:
         if not self.running:
-            return finished_at_prefill
-
-        # grow tables: slots whose next token starts a fresh page
-        for slot, req in list(self.running.items()):
-            t = req.table
-            if t.length % self.block_size == 0 and len(t.blocks) * self.block_size <= t.length:
-                try:
-                    t.blocks.extend(self.allocator.allocate(1))
-                except OutOfBlocks:
-                    # out of pages mid-flight: truncate this request
+            return
+        # pre-fund K tokens of pages per slot so the device loop never
+        # needs a host allocation decision; demote to K=1 when tight
+        k = self.megastep_k
+        if k > 1:
+            for slot, req in self.running.items():
+                if not self._fund_slot(slot, req, k):
+                    k = 1
+                    self.stats.fallback_k1 += 1
+                    break
+        if k == 1:
+            for slot, req in list(self.running.items()):
+                if not self._fund_slot(slot, req, 1):
+                    # out of pages mid-flight: truncate this request —
+                    # _release frees exactly the pages the slot owns
                     req.finished = True
                     req.truncated = True
                     self._release(slot)
-                    finished_at_prefill.append(req)
+                    finished.append(req)
         if not self.running:
-            return finished_at_prefill
+            return
 
-        tokens = self._put_rep(np.asarray(self._slot_tokens, np.int32))
-        tables = np.zeros((self.max_batch, self.max_blocks_per_seq), np.int32)
-        lengths = np.zeros((self.max_batch,), np.int32)
-        active = np.zeros((self.max_batch,), bool)
-        for slot, req in self.running.items():
-            tables[slot] = req.table.padded(self.max_blocks_per_seq)
-            lengths[slot] = req.table.length
-            active[slot] = True
+        any_sample = bool(np.any(self._gen_sample))
+        if any_sample:
+            self._rng, keys = _split_chain(self._rng, k)
+            if self._global:
+                keys = self._put_rep(self._fetch(keys))
+        else:
+            # greedy megasteps never consume randomness (matching the
+            # per-step fast path); the keys operand is a dead input
+            keys = self._put_rep(np.zeros((k, 2), np.uint32))
         if self._pp:
-            logits, self.cache = self._pp_decode(
-                self._pp_top, self._pp_stacked, tokens, jnp.asarray(tables),
-                jnp.asarray(lengths), self.cache, jnp.asarray(active),
+            out = self._pp_megastep(
+                self._pp_top, self._pp_stacked, self._dev_tokens,
+                self._dev_tables, self._dev_lengths, self.cache,
+                self._dev_active, self._dev_budget, self._dev_eos,
+                self._dev_temp, self._dev_topk, self._dev_topp,
+                self._dev_sample, keys, k_steps=k, use_sampling=any_sample,
             )
         else:
-            logits, self.cache = decode_paged(
-                self.params, self.config, tokens, self._put_rep(tables),
-                self._put_rep(lengths), self.cache, self._put_rep(active),
-                use_kernel=self.use_kernel,
+            out = decode_megastep(
+                self.params, self.config, self._dev_tokens,
+                self._dev_tables, self._dev_lengths, self.cache,
+                self._dev_active, self._dev_budget, self._dev_eos,
+                self._dev_temp, self._dev_topk, self._dev_topp,
+                self._dev_sample, keys, k_steps=k,
+                use_kernel=self.use_kernel, use_sampling=any_sample,
             )
-        # ALL slots sample on device with their own params; the host fetches
-        # S ints, never the [S, V] logits
-        next_np = self._sample_all(logits)
-
-        finished: List[Request] = []
+        (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+         self._dev_budget, self.cache) = out
+        # the ONE host sync per megastep: K×S ids + per-slot counts/flags
+        buf_np = self._fetch(buf)
+        emitted_np = self._fetch(emitted)
+        alive_np = self._fetch(alive)
+        self.stats.decode_megasteps += 1
+        self.stats.decode_syncs += 1
+        self.stats.decode_d2h_elements += (
+            buf_np.size + emitted_np.size + alive_np.size
+        )
         for slot, req in list(self.running.items()):
-            req.table.length += 1
-            tok = int(next_np[slot])
-            req.output_ids.append(tok)
-            self._slot_tokens[slot] = tok
-            if self._is_finished(req, tok):
+            t = int(emitted_np[slot])
+            toks = [int(x) for x in buf_np[slot, :t]]
+            req.output_ids.extend(toks)
+            req.table.length += t
+            if toks:
+                self._slot_tokens[slot] = toks[-1]
+            self.stats.decode_tokens += t
+            if not alive_np[slot]:
                 req.finished = True
                 finished.append(req)
                 self._release(slot)
-        return finished_at_prefill + finished
 
     def _sample_all(self, logits) -> np.ndarray:
         return self._sample_rows(
@@ -572,14 +823,24 @@ class LLMEngine:
         self._gen_topk[slot] = g.top_k
         self._gen_topp[slot] = g.top_p
         self._gen_sample[slot] = g.do_sample
+        idx = self._put_rep(np.asarray(slot, np.int32))
+        self._dev_temp = _patch1(
+            self._dev_temp, idx, self._put_rep(np.asarray(g.temperature, np.float32)))
+        self._dev_topk = _patch1(
+            self._dev_topk, idx, self._put_rep(np.asarray(g.top_k, np.int32)))
+        self._dev_topp = _patch1(
+            self._dev_topp, idx, self._put_rep(np.asarray(g.top_p, np.float32)))
+        self._dev_sample = _patch1(
+            self._dev_sample, idx, self._put_rep(np.asarray(bool(g.do_sample))))
+        eos = -1 if g.eos_token_id is None else int(g.eos_token_id)
+        self._dev_eos = _patch1(
+            self._dev_eos, idx, self._put_rep(np.asarray(eos, np.int32)))
 
     def _prefill_into_slot(self, req: Request, bucket: int):
         """Prefill one prompt into its slot; returns the next-token logits
         [1, V] (grouped sampling draws every member's first token from
         them)."""
         n = len(req.prompt_ids)
-        g = req.gen
-        self._set_slot_gen(req.slot, g)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
@@ -595,22 +856,20 @@ class LLMEngine:
                 self._put_rep(table),
             )
         req.table.length = n
-        tok = int(self._sample_rows(
-            logits, np.asarray([g.temperature]), np.asarray([g.top_k]),
-            np.asarray([g.top_p]), np.asarray([g.do_sample]),
-        )[0])
-        req.output_ids.append(tok)
-        self._slot_tokens[req.slot] = tok
         return logits
 
     def _release(self, slot: int) -> None:
         self.running.pop(slot, None)
+        self.prefilling.pop(slot, None)
         # reset sampling params so a freed sampling slot doesn't pin the
         # all-greedy fast path off for the engine's lifetime
         self._gen_temp[slot] = 1.0
         self._gen_topk[slot] = 0
         self._gen_topp[slot] = 1.0
         self._gen_sample[slot] = False
+        self._dev_active = _patch1(
+            self._dev_active, self._put_rep(np.asarray(slot, np.int32)),
+            self._put_rep(np.asarray(False)))
         table = self._tables.pop(slot, None)
         if table is not None:
             self.allocator.free(table.blocks)

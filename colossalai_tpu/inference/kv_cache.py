@@ -76,6 +76,22 @@ class BlockAllocator:
             self._refs[b] = 1
         return out
 
+    def fund(self, table: "SequenceTable", n_tokens: int) -> List[int]:
+        """Grow ``table`` until it can hold ``n_tokens`` total tokens
+        (the megastep pre-funding: K tokens of pages are reserved BEFORE
+        the device-resident decode loop runs, so no allocation decision —
+        and therefore no host sync — is needed inside it). Returns the
+        newly allocated block ids, appended to ``table.blocks`` in order,
+        so the engine can patch exactly those entries into the
+        device-resident block table. Raises :class:`OutOfBlocks` without
+        mutating the table when the pool can't cover the growth."""
+        need = self.blocks_needed(n_tokens) - len(table.blocks)
+        if need <= 0:
+            return []
+        fresh = self.allocate(need)  # raises OutOfBlocks before any mutation
+        table.blocks.extend(fresh)
+        return fresh
+
     def fork(self, blocks: List[int]) -> None:
         """Share pages with another sequence (prefix reuse): bump refs."""
         for b in blocks:
@@ -100,5 +116,12 @@ class SequenceTable:
     length: int = 0
 
     def padded(self, max_blocks: int) -> List[int]:
+        if len(self.blocks) > max_blocks:
+            raise ValueError(
+                f"sequence maps {len(self.blocks)} pages ({self.length} "
+                f"tokens in cache) but tables are padded to "
+                f"max_blocks_per_seq={max_blocks} — the sequence outgrew "
+                f"max_seq_len; raise max_seq_len or stop the request sooner"
+            )
         pad = [0] * (max_blocks - len(self.blocks))
         return list(self.blocks) + pad

@@ -7,6 +7,21 @@ slot at (table[len // bs], len % bs) and attends through the gathered
 pages. The XLA decode path materializes the page gather; the Pallas
 ``paged_attention`` kernel (kernel/pallas/paged_attention.py) streams pages
 via scalar-prefetched block tables instead.
+
+Three decode entries share one per-iteration core (``_decode_once``):
+
+- ``decode_paged`` — one token per slot, one host dispatch per token (the
+  K=1 building block, kept for parity tests and the speculative engine);
+- ``decode_megastep`` — K decode iterations inside ONE jitted
+  ``lax.fori_loop``: on-device sampling, an on-device ``[S, K]`` token
+  buffer, device-side length increments and per-slot done flags (eos /
+  token-budget checks as array ops). The host syncs once per K tokens —
+  the launch/sync-overhead elimination that dominates small-batch decode
+  latency (arXiv:2502.17728);
+- ``prefill_chunk_paged`` — one block-aligned chunk of a longer prompt,
+  attending to previously written pages through the block table, so prompt
+  ingestion can interleave with decode megasteps (chunked prefill) instead
+  of head-of-line-blocking the batch on one padded-bucket prefill.
 """
 
 from __future__ import annotations
@@ -21,6 +36,44 @@ from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
 from .kv_cache import PagedKVCache
 from .modeling import _block_step, _proj, _project_kv, _rms
+
+
+def _logits_head(p, cfg: LlamaConfig, x) -> jax.Array:
+    """Final norm + lm head over hidden states x [B, S, H] → [B, S, V]."""
+    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        return x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    return x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+
+
+def sample_tokens(logits, rng, temperature, top_k, top_p, do_sample):
+    """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
+    generation params [S] → tokens [S]. The host fetches S ints, never the
+    [S, V] logits (the r02 review's host-bound-decode fix). top_k=0 /
+    top_p=1 disable those filters. Filters compose sequentially (HF
+    convention): the top-p nucleus is measured on the top-k-RENORMALIZED
+    distribution, not the full vocab. Pure function — jitted standalone by
+    the engine (``_sample_slots``) and traced inside ``decode_megastep``'s
+    device-resident loop."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_eff = jnp.where(top_k > 0, top_k, vocab).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1).clip(0, vocab - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -1e9, scaled)
+    # top-p over the POST-top-k distribution (already sorted: prefix of
+    # sorted_desc survives the k filter, the tail is -1e9)
+    sorted_masked = jnp.where(
+        jnp.arange(vocab)[None, :] < k_eff[:, None], sorted_desc, -1e9
+    )
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx.clip(0, vocab - 1), axis=-1)
+    masked = jnp.where(scaled < cutoff, -1e9, masked)
+    sampled = jax.random.categorical(rng, masked, axis=-1)
+    return jnp.where(do_sample, sampled, greedy)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -59,30 +112,86 @@ def prefill_paged(
         layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
     )
 
-    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
-    else:
-        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+    logits = _logits_head(p, cfg, x)
     last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
     return last, PagedKVCache(k=k_new, v=v_new)
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_kernel"), donate_argnames=("cache",))
-def decode_paged(
-    params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
-    active, use_kernel: bool = False,
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_chunk_paged(
+    params, cfg: LlamaConfig, input_ids, start, n_valid, cache: PagedKVCache,
+    block_table,
 ) -> Tuple[jax.Array, PagedKVCache]:
-    """One token per slot through the paged pool.
+    """One CHUNK [1, C] of a longer prompt (chunked prefill).
 
-    tokens [S]; block_tables [S, max_blocks]; lengths [S] (tokens already in
-    cache); active [S] bool. Returns (logits [S, V], cache).
-    """
+    ``start`` tokens of this sequence are already in the pool (block-
+    aligned — C must be a page multiple); this chunk holds ``n_valid`` real
+    tokens (< C only on the final, padded chunk). K/V land in the pages
+    ``block_table[start//bs : start//bs + C//bs]``; attention runs over the
+    WHOLE table gather (prior chunks + this one) under the causal mask, so
+    the result is bit-compatible with a single-shot prefill. ``start`` and
+    ``n_valid`` are traced scalars: every chunk of every prompt reuses one
+    compiled program per chunk size. Returns the logits [1, V] of token
+    ``start + n_valid - 1`` (only the final chunk's are meaningful) and the
+    updated cache."""
     p = params["params"] if "params" in params else params
     stacked = p["layers"]["block"]
     dtype = cfg.dtype or jnp.bfloat16
-    n_slots = tokens.shape[0]
+    b, c = input_ids.shape
     bs = cache.block_size
+    n_pages = c // bs
+    max_blocks = block_table.shape[0]
+    s_max = max_blocks * bs
+    positions = start + jnp.broadcast_to(jnp.arange(c), (b, c))  # [1, C]
+    # valid kv: everything written so far, including this chunk's real
+    # tokens; the causal mask in _block_step keeps pad-token K/V (garbage
+    # written past n_valid on the final chunk) invisible to real queries
+    kv_valid = (jnp.arange(s_max)[None, :] < start + n_valid)  # [1, s_max]
+    page_ids = jax.lax.dynamic_slice(block_table, (start // bs,), (n_pages,))
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[input_ids]
+
+    def layer(carry, inputs):
+        x, i = carry
+        layer_params, k_pool, v_pool = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)
+        k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
+        v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+        k_pool = k_pool.at[page_ids].set(k_pages)
+        v_pool = v_pool.at[page_ids].set(v_pages)
+
+        # gather the whole table: prior chunks' pages + the ones just
+        # written — [mb, Hkv, bs, D] → [1, s_max, Hkv, D]
+        def to_seq(pool):
+            g = pool[block_table].transpose(0, 2, 1, 3)
+            return g.reshape(s_max, pool.shape[1], pool.shape[3])[None]
+
+        x = _block_step(cfg, layer_params, x, to_seq(k_pool), to_seq(v_pool),
+                        positions, kv_valid)
+        return (x, i + 1), (k_pool, v_pool)
+
+    (x, _), (k_new, v_new) = jax.lax.scan(
+        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+    )
+
+    logits = _logits_head(p, cfg, x)
+    last = jax.lax.dynamic_index_in_dim(
+        logits, jnp.clip(n_valid - 1, 0), axis=1, keepdims=False
+    )  # [1, V]: the chunk's last real token (meaningful on the final chunk)
+    return last, PagedKVCache(k=k_new, v=v_new)
+
+
+def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
+                 cache_v, active, use_kernel: bool):
+    """One decode iteration over unwrapped params: tokens [S] at positions
+    ``lengths`` → (logits [S, V], k pool, v pool). The shared core of
+    ``decode_paged`` (K=1, jitted per call) and ``decode_megastep`` (traced
+    K times inside one fori_loop)."""
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    n_slots = tokens.shape[0]
+    bs = cache_k.shape[3]
     max_blocks = block_tables.shape[1]
     positions = lengths[:, None]  # [S, 1]
 
@@ -145,12 +254,108 @@ def decode_paged(
         return (x, i + 1), (k_pool, v_pool)
 
     (x, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+        layer, (x.astype(dtype), 0), (stacked, cache_k, cache_v)
+    )
+    return _logits_head(p, cfg, x)[:, 0], k_new, v_new
+
+
+@partial(jax.jit, static_argnames=("cfg", "use_kernel"), donate_argnames=("cache",))
+def decode_paged(
+    params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
+    active, use_kernel: bool = False,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """One token per slot through the paged pool.
+
+    tokens [S]; block_tables [S, max_blocks]; lengths [S] (tokens already in
+    cache); active [S] bool. Returns (logits [S, V], cache).
+    """
+    p = params["params"] if "params" in params else params
+    logits, k_new, v_new = _decode_once(
+        p, cfg, tokens, block_tables, lengths, cache.k, cache.v, active, use_kernel
+    )
+    return logits, PagedKVCache(k=k_new, v=v_new)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "k_steps", "use_kernel", "use_sampling"),
+    donate_argnames=("cache",),
+)
+def decode_megastep(
+    params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
+    active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
+    k_steps: int, use_kernel: bool = False, use_sampling: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    """Device-resident decode loop: ``k_steps`` iterations of
+    forward→sample→commit inside one ``lax.fori_loop`` — ONE dispatch and
+    ONE host sync per K tokens instead of per token.
+
+    Inputs are all per-slot [S] device arrays: ``tokens`` last committed
+    token; ``lengths`` tokens in cache; ``active`` decode-eligible slots;
+    ``budgets`` tokens each slot may still emit (counts both
+    max_new_tokens and the max_seq guard, precomputed by the scheduler);
+    ``eos_ids`` per-slot eos (-1 = none); ``temp/topk/topp/do_sample``
+    sampling params; ``rng_keys`` [k_steps, 2] one PRNG key per iteration
+    (ignored when ``use_sampling`` is False — greedy stays a pure argmax
+    program). The scheduler must have pre-funded ``block_tables`` with
+    pages for ``min(k_steps, budget)`` tokens per active slot.
+
+    A slot that hits eos or exhausts its budget flips its own done flag ON
+    DEVICE and stops emitting (subsequent iterations write its K/V to the
+    reserved null page, like an inactive slot). Returns
+    ``(buf [S, k_steps] emitted ids (-1 = nothing), emitted [S], alive [S],
+    tokens, lengths, budgets, cache)`` — the last three are the advanced
+    device state the scheduler keeps for the next megastep.
+    """
+    p = params["params"] if "params" in params else params
+
+    def decode_once(tok, lens, ck, cv, alive):
+        return _decode_once(
+            p, cfg, tok, block_tables, lens, ck, cv, alive, use_kernel
+        )
+
+    return megastep_loop(
+        decode_once, tokens, lengths, cache, active, budgets, eos_ids,
+        temp, topk, topp, do_sample, rng_keys, k_steps, use_sampling,
     )
 
-    x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = x.astype(jnp.float32) @ p["embed_tokens"]["embedding"].T.astype(jnp.float32)
-    else:
-        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
-    return logits[:, 0], PagedKVCache(k=k_new, v=v_new)
+
+def megastep_loop(
+    decode_once, tokens, lengths, cache: PagedKVCache, active, budgets,
+    eos_ids, temp, topk, topp, do_sample, rng_keys, k_steps: int,
+    use_sampling: bool,
+):
+    """The megastep's per-iteration bookkeeping (buffer commit, length/
+    budget advance, eos/done flags) around any single-iteration decode —
+    ``decode_once(tok, lens, ck, cv, alive) → (logits [S, V], ck, cv)``.
+    Shared by :func:`decode_megastep` (single-stage ``_decode_once``) and
+    the pipeline-parallel megastep (pp_decode's shard_map relay), so both
+    advance device state identically. Must be called under jit (traces a
+    ``fori_loop``)."""
+    n_slots = tokens.shape[0]
+    buf0 = jnp.full((n_slots, k_steps), -1, jnp.int32)
+
+    def body(i, carry):
+        ck, cv, tok, lens, alive, budg, buf, emitted = carry
+        logits, ck, cv = decode_once(tok, lens, ck, cv, alive)
+        if use_sampling:
+            nxt = sample_tokens(logits, rng_keys[i], temp, topk, topp, do_sample)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        buf = buf.at[:, i].set(jnp.where(alive, nxt, -1))
+        step = alive.astype(jnp.int32)
+        emitted = emitted + step
+        lens = lens + step
+        budg = budg - step
+        hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+        tok = jnp.where(alive, nxt, tok)
+        alive = alive & ~hit_eos & (budg > 0)
+        return (ck, cv, tok, lens, alive, budg, buf, emitted)
+
+    init = (cache.k, cache.v, tokens, lengths, active, budgets, buf0,
+            jnp.zeros((n_slots,), jnp.int32))
+    ck, cv, tok, lens, alive, budg, buf, emitted = jax.lax.fori_loop(
+        0, k_steps, body, init
+    )
+    return buf, emitted, alive, tok, lens, budg, PagedKVCache(k=ck, v=cv)

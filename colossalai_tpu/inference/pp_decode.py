@@ -32,13 +32,18 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.6 re-exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from colossalai_tpu.models.llama import LlamaConfig
 
 from .kv_cache import PagedKVCache
 from .modeling import _block_step, _project_kv, _rms
+from .paged_modeling import megastep_loop
 
 
 def _stage_layout(mesh, num_layers: int):
@@ -165,8 +170,9 @@ def _relay(mesh, stage_fn, x, stacked, ck, cv, extras, tp: int = 1):
         # the in-block psums, which restore invariance before they touch x
         if hasattr(jax.lax, "pcast"):
             x = jax.lax.pcast(x, ("pp",), to="varying")
-        else:  # older jax spells it pvary
+        elif hasattr(jax.lax, "pvary"):  # older jax spells it pvary
             x = jax.lax.pvary(x, ("pp",))
+        # jax without varying-ness tracking (< 0.5): nothing to mark
 
         def body(s, carry):
             x, kl, vl = carry
@@ -194,12 +200,16 @@ def _relay(mesh, stage_fn, x, stacked, ck, cv, extras, tp: int = 1):
 
 
 def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
-    """(prefill_fn, decode_fn) — pp variants of prefill_paged/decode_paged.
+    """(prefill_fn, decode_fn, megastep_fn, prefill_chunk_fn) — pp variants
+    of prefill_paged / decode_paged / decode_megastep / prefill_chunk_paged.
 
     Signatures mirror the single-stage functions but take (top, stacked)
     from :func:`shard_params_pp` and the [pp, L/pp, ...] cache. A tp axis
     on the mesh composes Megatron head-sharding inside each stage
-    (≙ the reference's tp-within-pp inference executor).
+    (≙ the reference's tp-within-pp inference executor). ``megastep_fn``
+    runs the whole ppermute relay K times inside ONE ``fori_loop`` program
+    (shared bookkeeping: :func:`..paged_modeling.megastep_loop`), so a pp
+    group also pays one dispatch and one host sync per K tokens.
     """
     dtype = cfg.dtype or jnp.bfloat16
     bs = block_size
@@ -249,8 +259,10 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
         last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
         return last, PagedKVCache(k=k_new, v=v_new)
 
-    @partial(jax.jit, donate_argnames=("cache",))
-    def decode_fn(top, stacked, tokens, block_tables, lengths, cache: PagedKVCache, active):
+    def _decode_relay(top, stacked, tokens, block_tables, lengths, ck, cv, active):
+        """One decode iteration through the relay: tokens [S] at positions
+        ``lengths`` → (logits [S, V], k pool, v pool). Shared by decode_fn
+        (K=1, own jit) and megastep_fn (traced K times in one fori_loop)."""
         n_slots = tokens.shape[0]
         positions = lengths[:, None]
         x = top["embed_tokens"]["embedding"].astype(dtype)[tokens][:, None, :].astype(dtype)
@@ -289,9 +301,88 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
             return x, k_new, v_new
 
         x, k_new, v_new = _relay(
-            mesh, stage_fn, x, stacked, cache.k, cache.v,
+            mesh, stage_fn, x, stacked, ck, cv,
             (positions, block_tables, active, w_block, w_off, attend), tp=tp,
         )
-        return _head(top, x)[:, 0], PagedKVCache(k=k_new, v=v_new)
+        return _head(top, x)[:, 0], k_new, v_new
 
-    return prefill_fn, decode_fn
+    @partial(jax.jit, donate_argnames=("cache",))
+    def decode_fn(top, stacked, tokens, block_tables, lengths, cache: PagedKVCache, active):
+        logits, k_new, v_new = _decode_relay(
+            top, stacked, tokens, block_tables, lengths, cache.k, cache.v, active
+        )
+        return logits, PagedKVCache(k=k_new, v=v_new)
+
+    @partial(jax.jit, static_argnames=("k_steps", "use_sampling"),
+             donate_argnames=("cache",))
+    def megastep_fn(top, stacked, tokens, block_tables, lengths,
+                    cache: PagedKVCache, active, budgets, eos_ids, temp, topk,
+                    topp, do_sample, rng_keys, k_steps: int,
+                    use_sampling: bool = False):
+        """K relay iterations in one program — same contract and return
+        shape as :func:`..paged_modeling.decode_megastep`."""
+
+        def decode_once(tok, lens, ck, cv, alive):
+            return _decode_relay(
+                top, stacked, tok, block_tables, lens, ck, cv, alive
+            )
+
+        return megastep_loop(
+            decode_once, tokens, lengths, cache, active, budgets, eos_ids,
+            temp, topk, topp, do_sample, rng_keys, k_steps, use_sampling,
+        )
+
+    @partial(jax.jit, donate_argnames=("cache",))
+    def prefill_chunk_fn(top, stacked, input_ids, start, n_valid,
+                         cache: PagedKVCache, block_table):
+        """One block-aligned chunk of a longer prompt through the relay —
+        same contract as :func:`..paged_modeling.prefill_chunk_paged`:
+        K/V land in ``block_table[start//bs : start//bs + C//bs]``,
+        attention gathers the WHOLE table (prior chunks + this one) under
+        the causal mask, and the returned [1, V] logits belong to token
+        ``start + n_valid - 1``."""
+        b, c = input_ids.shape
+        n_pages = c // bs
+        s_max = max_blocks * bs
+        positions = start + jnp.broadcast_to(jnp.arange(c), (b, c))
+        kv_valid = jnp.arange(s_max)[None, :] < start + n_valid
+        page_ids = jax.lax.dynamic_slice(block_table, (start // bs,), (n_pages,))
+        x = top["embed_tokens"]["embedding"].astype(dtype)[input_ids].astype(dtype)
+
+        def stage_fn(x, local, k_pool_stack, v_pool_stack, extras):
+            positions, kv_valid, block_table, page_ids = extras
+
+            def layer(carry, inputs):
+                x, = carry
+                lp, k_pool, v_pool = inputs
+                h = _rms(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+                k, v = _project_kv(cfg, lp, h, positions)
+                k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
+                v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+                k_pool = k_pool.at[page_ids].set(k_pages)
+                v_pool = v_pool.at[page_ids].set(v_pages)
+
+                def to_seq(pool):
+                    g = pool[block_table].transpose(0, 2, 1, 3)
+                    return g.reshape(s_max, pool.shape[1], pool.shape[3])[None]
+
+                x = _block_step(cfg, lp, x, to_seq(k_pool), to_seq(v_pool),
+                                positions, kv_valid, tp_axis=tp_axis)
+                return (x,), (k_pool, v_pool)
+
+            (x,), (k_new, v_new) = jax.lax.scan(
+                layer, (x,), (local, k_pool_stack, v_pool_stack)
+            )
+            return x, k_new, v_new
+
+        x, k_new, v_new = _relay(
+            mesh, stage_fn, x, stacked, cache.k, cache.v,
+            (positions, kv_valid, block_table, page_ids), tp=tp,
+        )
+        logits = _head(top, x)
+        last = jax.lax.dynamic_index_in_dim(
+            logits, jnp.clip(n_valid - 1, 0), axis=1, keepdims=False
+        )
+        return last, PagedKVCache(k=k_new, v=v_new)
+
+    return prefill_fn, decode_fn, megastep_fn, prefill_chunk_fn

@@ -11,12 +11,19 @@ Endpoints:
   With ``"stream": true`` the response is Server-Sent Events
   (``text/event-stream``): one ``data: {"request_id", "token"}`` event
   per generated token as the engine's step loop produces it, then a final
-  ``data: {"done": true, "output_ids": [...]}``. A client that
-  disconnects mid-stream aborts the request and frees its KV pages.
+  ``data: {"done": true, "output_ids": [...]}``. Tokens FLUSH once per
+  scheduler tick — with decode megasteps (``engine.megastep_k = K > 1``)
+  that means up to K events arrive in a burst per sync, trading worst-case
+  per-token latency for K× fewer host round-trips; K=1 restores strictly
+  per-token flushing. A client that disconnects mid-stream aborts the
+  request and frees its KV pages.
 - ``POST /abort``     {"request_id": i} → {"aborted": bool} — cancel a
-  queued or running request; running requests free their pages
-  immediately (≙ engine.abort_request).
-- ``GET /health``     → {"status": "ok", "running": n, "waiting": m}
+  queued, prefilling, or running request; running requests free their
+  pages immediately (≙ engine.abort_request). With megasteps an abort
+  lands at the next K-token sync, not mid-loop.
+- ``GET /health``     → {"status": "ok", "running": n, "waiting": m, ...}
+  plus the engine's decode-path transfer counters (megasteps, syncs,
+  tokens) for observing the O(1)-transfers-per-token contract live.
 """
 
 from __future__ import annotations
@@ -130,7 +137,7 @@ class _Scheduler(threading.Thread):
     def run(self):
         while not self._stop:
             with self.lock:
-                busy = bool(self.engine.waiting or self.engine.running)
+                busy = self.engine.has_work
             if not busy:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -188,11 +195,17 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
         def do_GET(self):
             if self.path == "/health":
                 with sched.lock:
+                    st = engine.stats
                     self._json(200, {
                         "status": "ok",
                         "running": len(engine.running),
                         "waiting": len(engine.waiting),
+                        "prefilling": len(engine.prefilling),
                         "free_blocks": engine.allocator.num_free,
+                        "megastep_k": engine.megastep_k,
+                        "decode_megasteps": st.decode_megasteps,
+                        "decode_syncs": st.decode_syncs,
+                        "decode_tokens": st.decode_tokens,
                     })
             else:
                 self._json(404, {"error": "not found"})

@@ -1,0 +1,291 @@
+"""Decode megasteps + chunked prefill: the device-resident serving loop.
+
+The megastep contract: K decode iterations inside one jitted fori_loop —
+token-for-token IDENTICAL to per-step scheduling (K=1), with ONE host sync
+per K tokens and O(1) amortized host→device traffic per token (incremental
+page-table patches instead of wholesale re-uploads). Chunked prefill must
+be bit-compatible with single-shot bucket prefill."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine, SequenceTable
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+RNG = np.random.RandomState(42)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    return cfg, model, params
+
+
+def _prompts(cfg, lens):
+    return [list(RNG.randint(0, cfg.vocab_size, size=(n,))) for n in lens]
+
+
+def test_megastep_greedy_parity_k1_vs_k4(model_and_params):
+    """Tier-1 gate: greedy outputs are token-identical for K=1 (the classic
+    per-token loop) vs K=4 (device-resident megasteps), and match the
+    full-forward argmax loop — the megastep changes scheduling, never
+    tokens."""
+    cfg, model, params = model_and_params
+    prompts = _prompts(cfg, (5, 9, 3))
+    gen = GenerationConfig(max_new_tokens=6)
+
+    e1 = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64,
+                   block_size=16, megastep_k=1)
+    out1 = e1.generate([list(p) for p in prompts], gen)
+    e4 = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64,
+                   block_size=16, megastep_k=4)
+    out4 = e4.generate([list(p) for p in prompts], gen)
+    assert out1 == out4, (out1, out4)
+
+    # and both match the uncached full-forward greedy loop
+    seq = list(prompts[0])
+    for _ in range(6):
+        logits = model.apply(params, jnp.asarray([seq])).logits
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert out1[0] == seq[len(prompts[0]):]
+
+
+def test_megastep_sampled_parity_k1_vs_k4(model_and_params):
+    """Sampling consumes one PRNG key per iteration from the SAME split
+    chain regardless of K, so sampled outputs are also K-invariant."""
+    cfg, _, params = model_and_params
+    prompts = _prompts(cfg, (6, 4))
+    gen = GenerationConfig(max_new_tokens=8, do_sample=True,
+                           temperature=0.8, top_k=5)
+    outs = []
+    for k in (1, 4):
+        eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                        block_size=16, megastep_k=k, seed=11)
+        outs.append(eng.generate([list(p) for p in prompts], gen))
+    assert outs[0] == outs[1], outs
+
+
+def test_megastep_one_sync_per_k_tokens_and_o1_uploads(model_and_params):
+    """The perf contract, asserted on counters: one host sync per megastep
+    (not per token), and host→device traffic that is O(1) amortized per
+    token — only the incremental page-funding patches, not the old
+    per-token [max_batch, max_blocks_per_seq] table re-upload."""
+    cfg, _, params = model_and_params
+    prompt = _prompts(cfg, (5,))[0]
+    # buckets=(16,): prefill funds 1 page, so decode growth MUST patch new
+    # pages into the device table (the path under test)
+    eng = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=64,
+                    block_size=16, prefill_buckets=(16,), megastep_k=4)
+    out = eng.generate([list(prompt)], GenerationConfig(max_new_tokens=16))
+    assert len(out[0]) == 16
+    st = eng.stats
+    # 15 decode tokens (first came from prefill) at K=4 → 4 megasteps
+    assert st.decode_tokens == 15
+    assert st.decode_megasteps == 4
+    assert st.decode_syncs == st.decode_megasteps == 4
+    # lengths 5→21 cross one page boundary: exactly one (slot, idx, block)
+    # patch = 3 scalars uploaded across the whole decode — vs
+    # max_batch × max_blocks_per_seq PER TOKEN before megasteps
+    assert st.decode_h2d_scalars == 3
+    assert st.decode_h2d_scalars < st.decode_tokens
+    assert st.fallback_k1 == 0
+
+
+def test_megastep_fallback_to_k1_when_pages_tight(model_and_params):
+    """When the pool can't pre-fund K tokens of pages for every slot, the
+    scheduler demotes that megastep to K=1 (classic one-token ticks)
+    instead of failing — and once a finishing slot frees pages, megasteps
+    resume at full K. Tokens still match a roomy engine."""
+    cfg, _, params = model_and_params
+    prompts = _prompts(cfg, (4, 4))
+    gens = [GenerationConfig(max_new_tokens=2), GenerationConfig(max_new_tokens=8)]
+
+    def run(num_blocks=None):
+        eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=32,
+                        block_size=4, prefill_buckets=(4,),
+                        num_blocks=num_blocks, megastep_k=8)
+        order = [eng.add_request(list(p), g) for p, g in zip(prompts, gens)]
+        done = {}
+        while eng.has_work:
+            for r in eng.step():
+                done[r.request_id] = r
+        return [done[rid].output_ids for rid in order], eng
+
+    ref, roomy = run()
+    assert roomy.stats.fallback_k1 == 0
+    # 4 usable pages: prefills take 2, slot 1's K=8 pre-fund wants 2 fresh
+    # with only 1 free → fallback tick; slot 0 finishes (budget 1) and
+    # frees its pages, then slot 1's next megastep funds and runs at K=8
+    out, tight = run(num_blocks=5)
+    assert out == ref, (out, ref)
+    assert tight.stats.fallback_k1 >= 1
+    assert [len(o) for o in out] == [2, 8]  # both ran to budget, no truncation
+    # nothing leaked: every page back in the pool
+    assert tight.allocator.num_free == 4
+
+
+def test_chunked_prefill_matches_single_shot(model_and_params):
+    """A long prompt ingested in block-aligned chunks (interleaved with
+    decode ticks) produces the same greedy tokens as one bucket prefill —
+    including a short prompt that takes the classic path alongside."""
+    cfg, _, params = model_and_params
+    prompts = _prompts(cfg, (40, 5))
+    gen = GenerationConfig(max_new_tokens=5)
+
+    ref = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16).generate([list(p) for p in prompts], gen)
+
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16, prefill_chunk=16)
+    out = eng.generate([list(p) for p in prompts], gen)
+    assert out == ref, (out, ref)
+    assert eng.stats.prefill_chunks == 3  # 40 tokens / 16-token chunks
+
+
+def test_chunked_prefill_grouped_sampling(model_and_params):
+    """A group admitted through chunked prefill defers follower
+    materialization to the final chunk (their slots reserved meanwhile) and
+    still matches the unchunked engine draw-for-draw at the same seed."""
+    cfg, _, params = model_and_params
+    prompt = _prompts(cfg, (40,))[0]
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True, temperature=1.0)
+
+    def run(**kw):
+        eng = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64,
+                        block_size=16, seed=5, **kw)
+        ids = eng.add_request(list(prompt), gen, n_samples=3)
+        done = {}
+        while eng.has_work:
+            for r in eng.step():
+                done[r.request_id] = r
+        assert eng.allocator.num_free == eng.allocator.num_blocks - 1
+        return [done[i].output_ids for i in ids]
+
+    ref = run()
+    out = run(prefill_chunk=16)
+    assert out == ref, (out, ref)
+
+
+def test_group_fork_refcounts_and_cow_release(model_and_params):
+    """Prefix-sharing accounting: grouped admission forks the full prompt
+    pages (ref count = n_samples), copy-on-writes the partial tail page per
+    member, and completion releases EXACTLY the owned pages back to the
+    pool."""
+    cfg, _, params = model_and_params
+    # 20-token prompt, 16-token pages: 1 FULL shared page + a partial tail
+    prompt = _prompts(cfg, (20,))[0]
+    gen = GenerationConfig(max_new_tokens=3, do_sample=True, temperature=1.0)
+    eng = LLMEngine(params, cfg, max_batch_size=4, max_seq_len=64,
+                    block_size=16, prefill_buckets=(32, 64), seed=2)
+    free0 = eng.allocator.num_free
+    ids = eng.add_request(list(prompt), gen, n_samples=3)
+    eng.step()  # admission: leader prefill + follower fork/CoW
+    tables = [eng._tables[s] for s in sorted(eng._tables)]
+    assert len(tables) == 3
+    shared = tables[0].blocks[0]
+    # every member's table starts with the SAME physical full-prompt page
+    assert all(t.blocks[0] == shared for t in tables)
+    assert eng.allocator.ref_count(shared) == 3
+    # tail pages are per-member (CoW), ref count 1, all distinct
+    tails = [t.blocks[1] for t in tables]
+    assert len(set(tails)) == 3
+    assert all(eng.allocator.ref_count(b) == 1 for b in tails)
+    # leader funded the whole 32-token bucket; followers only their tail
+    assert eng.allocator.num_free <= free0 - 4
+    while eng.has_work:
+        eng.step()
+    assert eng.allocator.ref_count(shared) == 0
+    assert eng.allocator.num_free == free0
+    assert len(ids) == 3
+
+
+def test_out_of_blocks_truncation_releases_owned_pages(model_and_params):
+    """Mid-flight pool exhaustion truncates the starved request (flagged,
+    partial output returned) and releases exactly the pages that slot
+    owned — the survivor keeps decoding to its full budget."""
+    cfg, _, params = model_and_params
+    prompts = _prompts(cfg, (4, 3))
+    gen = GenerationConfig(max_new_tokens=8)
+    # 3 usable pages: two prefills take 2, ONE growth page left for two
+    # slots that both need to grow past their first page
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=32,
+                    block_size=4, prefill_buckets=(4,), num_blocks=4)
+    order = [eng.add_request(list(p), gen) for p in prompts]
+    done = {}
+    while eng.has_work:
+        for r in eng.step():
+            done[r.request_id] = r
+    outs = [done[rid] for rid in order]
+    truncated = [r for r in outs if r.truncated]
+    survivors = [r for r in outs if not r.truncated]
+    assert len(truncated) == 1 and len(survivors) == 1
+    assert len(truncated[0].output_ids) < 8
+    # the survivor reaches its full max_new_tokens budget — the truncated
+    # slot's released pages fund its later growth
+    assert len(survivors[0].output_ids) == 8
+    # every page — truncated slot's AND survivor's — is back in the pool
+    assert eng.allocator.num_free == 3
+    assert not eng._tables
+
+
+def test_padded_table_overflow_raises(model_and_params):
+    with pytest.raises(ValueError, match="max_blocks_per_seq=2"):
+        SequenceTable([1, 2, 3], length=40).padded(2)
+
+
+def test_add_request_validation(model_and_params):
+    cfg, _, params = model_and_params
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=16,
+                    block_size=16)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.add_request(list(range(16)))  # == max_seq: no room to generate
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.add_request([])
+    assert not eng.waiting  # nothing half-queued by the failed validations
+
+
+def test_pp_megastep_matches_single_device(model_and_params):
+    """The megastep through pipeline stages: K relay iterations inside one
+    program must emit the same greedy tokens as the single-device megastep
+    engine."""
+    from jax.sharding import Mesh
+
+    cfg, _, params = model_and_params
+    prompts = _prompts(cfg, (5, 9))
+    gen = GenerationConfig(max_new_tokens=4)
+
+    ref = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                    block_size=16, megastep_k=2).generate(
+                        [list(p) for p in prompts], gen)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                    block_size=16, mesh=mesh, megastep_k=2)
+    out = eng.generate([list(p) for p in prompts], gen)
+    assert out == ref, (out, ref)
+    assert eng.stats.decode_syncs == eng.stats.decode_megasteps > 0
+
+
+def test_pp_chunked_prefill_matches_single_device(model_and_params):
+    """Chunked prefill through the pp relay: same tokens as the unchunked
+    single-device engine."""
+    from jax.sharding import Mesh
+
+    cfg, _, params = model_and_params
+    prompt = _prompts(cfg, (40,))[0]
+    gen = GenerationConfig(max_new_tokens=4)
+
+    ref = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                    block_size=16).generate([list(prompt)], gen)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=128,
+                    block_size=16, mesh=mesh, prefill_chunk=16)
+    out = eng.generate([list(prompt)], gen)
+    assert out == ref, (out, ref)
+    assert eng.stats.prefill_chunks == 3

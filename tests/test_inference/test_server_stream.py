@@ -69,6 +69,39 @@ def test_stream_tokens_arrive_incrementally_and_match(served):
     assert len(events) == len(ref) + 1
 
 
+def test_stream_with_megasteps_bursts_and_matches():
+    """With megastep_k>1 tokens flush per K-token sync (in bursts), but the
+    streamed sequence and the final summary are unchanged."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16, prefill_buckets=(16,), megastep_k=4)
+    server, sched = make_server(eng, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        prompt = [1, 2, 3]
+        ref_eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                            block_size=16, prefill_buckets=(16,))
+        ref = ref_eng.generate([prompt], GenerationConfig(max_new_tokens=6))[0]
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/generate", json.dumps(
+            {"prompt_ids": prompt, "max_new_tokens": 6, "stream": True}
+        ), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        events = list(_sse_events(resp))
+        conn.close()
+        tokens = [e["token"] for e in events if "token" in e]
+        assert events[-1].get("done") is True
+        assert tokens == events[-1]["output_ids"] == ref
+        assert eng.stats.decode_syncs < eng.stats.decode_tokens  # real bursts
+    finally:
+        server.shutdown()
+        sched.stop()
+
+
 def test_abort_mid_stream_frees_kv_pages():
     # dedicated long-horizon engine: ~400 decode steps give the HTTP abort
     # round-trip a wide window to land mid-decode (the module fixture's
