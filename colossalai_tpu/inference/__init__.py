@@ -1,5 +1,11 @@
 from .diffusion import ddim_sample, ddim_schedule
-from .engine import EngineStats, GenerationConfig, LLMEngine, Request
+from .engine import (
+    SCHEDULER_POLICIES,
+    EngineStats,
+    GenerationConfig,
+    LLMEngine,
+    Request,
+)
 from .kv_cache import (
     BlockAllocator,
     OutOfBlocks,
@@ -16,6 +22,7 @@ from .paged_modeling import (
     prefill_paged,
     sample_tokens,
 )
+from .prefix_cache import PrefixCache
 from .server import make_server
 from .speculative import SpeculativeEngine, SpecStats
 
@@ -32,6 +39,8 @@ __all__ = [
     "prefill",
     "BlockAllocator",
     "OutOfBlocks",
+    "PrefixCache",
+    "SCHEDULER_POLICIES",
     "PagedKVCache",
     "SequenceTable",
     "init_paged_cache",

@@ -23,7 +23,15 @@ Design deltas for TPU/XLA:
   head-of-line-blocks the whole decode batch (chunked prefill);
 - host-side BlockAllocator does allocation/free/ref-counting; admission
   blocks when no pages are free and resumes as finished requests release
-  theirs (≙ the reference's running/waiting queues);
+  theirs (≙ the reference's running/waiting queues); the waiting queue's
+  order is a pluggable ``scheduler_policy`` (fifo | priority |
+  shortest_prompt_first | any Request→key callable);
+- optional PREFIX CACHE (``prefix_cache=True``): a radix tree of
+  block-aligned prompt chunks (prefix_cache.py) sits between the
+  scheduler and the page pool — finished requests donate their full
+  prompt pages into the tree, admission fork-shares every matched page
+  and prefills only the uncached suffix, and LRU eviction hands cached
+  pages back whenever live sequences would otherwise hit OutOfBlocks;
 - optional tensor parallelism: pass a mesh and the engine shards params
   (auto-policy) and the page pool's head dim over ``tp``;
 - optional pipeline parallelism: a mesh with a ``pp`` axis distributes
@@ -55,6 +63,7 @@ import numpy as np
 from colossalai_tpu.models.llama import LlamaConfig
 
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
+from .prefix_cache import PrefixCache
 from .paged_modeling import (
     decode_megastep,
     prefill_chunk_paged,
@@ -78,6 +87,9 @@ class Request:
     request_id: int
     prompt_ids: List[int]
     gen: GenerationConfig
+    #: admission priority (scheduler_policy="priority": higher runs first;
+    #: FIFO within a priority level)
+    priority: int = 0
     output_ids: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     table: Optional[SequenceTable] = None
@@ -93,6 +105,11 @@ class Request:
     #: chunked prefill of a GROUP: follower slots held in reserve until the
     #: leader's final chunk produces the logits every member samples from
     group_slots: Optional[List[int]] = None
+    #: prefix cache: physical page ids of the matched (cached) prompt
+    #: prefix — fork-shared at admission; prefill starts after them
+    cached_blocks: List[int] = dataclasses.field(default_factory=list)
+    #: prefix cache: deepest matched tree node (pin handle, opaque)
+    cache_node: Optional[object] = None
 
     @property
     def n_samples(self) -> int:
@@ -117,6 +134,26 @@ class EngineStats:
     prefill_chunks: int = 0
     #: megasteps demoted to K=1 because the page pool couldn't fund K tokens
     fallback_k1: int = 0
+    # ---- prefix cache (prefix_cache=True): cross-request prompt reuse
+    #: full prompt pages fork-shared from the radix tree at admission
+    prefix_hit_blocks: int = 0
+    #: prompt tokens whose prefill was skipped thanks to those hits
+    prefix_saved_tokens: int = 0
+    #: pages donated into the tree by finished/aborted sequences
+    prefix_insertions: int = 0
+    #: cached pages LRU-evicted back to the pool under allocation pressure
+    prefix_evictions: int = 0
+
+
+#: admission-order policies (``scheduler_policy=``): each maps a waiting
+#: Request to a sort key; the LOWEST key is tried first. request_id is the
+#: arrival order, so it is every policy's tiebreak (FIFO within a level).
+#: Pluggable: pass any ``Request -> sortable`` callable instead of a name.
+SCHEDULER_POLICIES = {
+    "fifo": lambda req: req.request_id,
+    "priority": lambda req: (-req.priority, req.request_id),
+    "shortest_prompt_first": lambda req: (len(req.prompt_ids), req.request_id),
+}
 
 
 #: jitted sampler shared with the megastep's in-loop sampling (kept under
@@ -192,6 +229,9 @@ class LLMEngine:
         use_kernel: bool = False,
         megastep_k: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = False,
+        prefix_cache_max_blocks: Optional[int] = None,
+        scheduler_policy="fifo",
     ):
         self.config = config
         self.max_batch = max_batch_size
@@ -226,6 +266,28 @@ class LLMEngine:
                     f"block_size={block_size} (chunks write whole pages)"
                 )
         self.prefill_chunk = prefill_chunk
+        #: cross-request prompt reuse: a radix tree of full prompt pages,
+        #: fork-shared at admission, donated back at release, LRU-evicted
+        #: under pool pressure. Off by default — the tree retains finished
+        #: requests' pages, which changes num_free accounting.
+        self.prefix_cache = (
+            PrefixCache(block_size, prefix_cache_max_blocks)
+            if prefix_cache else None
+        )
+        if callable(scheduler_policy):
+            self._policy_key = scheduler_policy
+        else:
+            try:
+                self._policy_key = SCHEDULER_POLICIES[scheduler_policy]
+            except KeyError:
+                raise ValueError(
+                    f"scheduler_policy={scheduler_policy!r}: pass one of "
+                    f"{sorted(SCHEDULER_POLICIES)} or a Request -> sort-key "
+                    f"callable"
+                ) from None
+        self.scheduler_policy = (
+            scheduler_policy if isinstance(scheduler_policy, str) else "custom"
+        )
         self.use_kernel = use_kernel
         self.mesh = mesh
         dtype = config.dtype or jnp.bfloat16
@@ -419,7 +481,7 @@ class LLMEngine:
     # ------------------------------------------------------------- frontend
     def add_request(
         self, prompt_ids, gen: Optional[GenerationConfig] = None,
-        n_samples: int = 1,
+        n_samples: int = 1, priority: int = 0,
     ) -> Union[int, List[int]]:
         """Queue a prompt. ``n_samples > 1`` queues a GROUP (GRPO/best-of-n
         rollouts): the prompt is prefilled ONCE, full prompt pages are
@@ -428,6 +490,12 @@ class LLMEngine:
         independently from the same prefill logits. Returns the request id,
         or the list of member ids for a group. Pair groups with
         ``do_sample=True`` — greedy members would all emit the same tokens.
+
+        ``priority`` orders admission under ``scheduler_policy="priority"``
+        (higher first; ignored by the other policies). With the prefix
+        cache on, the prompt walks the radix tree here and the matched
+        path is pinned; the match is refreshed at admission so prefixes
+        donated while the request waited still count.
         """
         prompt_ids = list(map(int, prompt_ids))
         if not prompt_ids:
@@ -439,7 +507,8 @@ class LLMEngine:
                 f"position — truncate the prompt or build the engine with "
                 f"a larger max_seq_len"
             )
-        req = Request(next(self._ids), prompt_ids, gen or GenerationConfig())
+        req = Request(next(self._ids), prompt_ids, gen or GenerationConfig(),
+                      priority=int(priority))
         if n_samples < 1:
             raise ValueError(f"n_samples={n_samples} must be >= 1")
         if n_samples > self.max_batch:
@@ -453,6 +522,11 @@ class LLMEngine:
                 f"prompt needs {need} pages but the pool only has "
                 f"{self.allocator.num_blocks - 1} - raise num_blocks"
             )
+        if self.prefix_cache is not None:
+            # walk the radix tree now (pins the matched path); _admit
+            # re-walks so later donations extend a queued request's hit
+            req.cache_node, req.cached_blocks = \
+                self.prefix_cache.match(prompt_ids)
         if n_samples > 1:
             req.group_ids = [req.request_id] + [
                 next(self._ids) for _ in range(n_samples - 1)
@@ -476,6 +550,9 @@ class LLMEngine:
                 req.group_ids and request_id in req.group_ids
             ):
                 self.waiting.pop(i)
+                if self.prefix_cache is not None:
+                    self.prefix_cache.unpin(req.cache_node)
+                    req.cache_node = None
                 return True
         for slot, req in list(self.prefilling.items()):
             if req.request_id == request_id or (
@@ -483,11 +560,11 @@ class LLMEngine:
             ):
                 # members don't exist yet: the whole group leaves together
                 self._reserved.difference_update(req.group_slots or [])
-                self._release(slot)
+                self._release(slot, req)
                 return True
         for slot, req in list(self.running.items()):
             if req.request_id == request_id:
-                self._release(slot)
+                self._release(slot, req)
                 return True
         return False
 
@@ -544,31 +621,61 @@ class LLMEngine:
         self._decode_tick(finished)
         return finished
 
+    def _next_waiting(self) -> int:
+        """Index of the waiting request the admission policy tries next
+        (fifo degenerates to index 0 — request ids are arrival-ordered)."""
+        return min(range(len(self.waiting)),
+                   key=lambda i: self._policy_key(self.waiting[i]))
+
     def _admit(self, finished: List[Request]) -> None:
         free = self._free_slots()
         while self.waiting and free:
-            req = self.waiting[0]
+            i = self._next_waiting()
+            req = self.waiting[i]
             if req.n_samples > len(free):
                 break  # a group is admitted whole or not at all
             n = len(req.prompt_ids)
+            if self.prefix_cache is not None:
+                # refresh the tree walk: prefixes donated while this
+                # request waited in the queue extend its hit now
+                self.prefix_cache.unpin(req.cache_node)
+                req.cache_node, req.cached_blocks = \
+                    self.prefix_cache.match(req.prompt_ids)
+            hit = len(req.cached_blocks)
             # fund the whole prefill (padded bucket); group followers share
-            # the full prompt pages and fund only their own tail pages
+            # the full prompt pages and fund only their own tail pages;
+            # cache-hit pages are fork-shared, not allocated
             bucket, need_leader, full, tail, need = self._group_page_needs(
                 n, req.n_samples
             )
+            need -= hit
+            if self.allocator.num_free < need:
+                self._evict_for(need - self.allocator.num_free)
             if self.allocator.num_free < need:
                 break  # no pages: stay queued until frees arrive
-            self.waiting.pop(0)
+            self.waiting.pop(i)
             req.slot = free.pop(0)
-            req.table = SequenceTable(self.allocator.allocate(need_leader))
+            if hit:
+                # fork-share the matched full prompt pages (bump tree refs,
+                # grouped-sampling style) and allocate only the rest
+                shared = list(req.cached_blocks)
+                self.allocator.fork(shared)
+                req.table = SequenceTable(
+                    shared + self.allocator.allocate(need_leader - hit))
+                self.stats.prefix_hit_blocks += hit
+                self.stats.prefix_saved_tokens += hit * self.block_size
+            else:
+                req.table = SequenceTable(self.allocator.allocate(need_leader))
             self._tables[req.slot] = req.table
-            if self.prefill_chunk is not None and n > self.prefill_chunk:
+            start = hit * self.block_size
+            if self.prefill_chunk is not None and n - start > self.prefill_chunk:
                 # chunked prefill: ingest block-aligned chunks across ticks
                 # so decode megasteps interleave instead of stalling behind
                 # one big padded-bucket prefill; a group's follower slots
                 # are reserved until the final chunk yields the logits
-                # every member samples its first token from
-                req.prefill_pos = 0
+                # every member samples its first token from. A cache hit
+                # starts the chunk walk at the first uncached block.
+                req.prefill_pos = start
                 req.group_slots = [
                     free.pop(0) for _ in (req.group_ids or [])[1:]
                 ]
@@ -632,7 +739,7 @@ class LLMEngine:
             f.slot = follower_slots.pop(0)
             shared = req.table.blocks[:full]
             self.allocator.fork(shared)
-            fresh = self.allocator.allocate(tail) if tail else []
+            fresh = self._alloc_blocks(tail) if tail else []
             if n % self.block_size:
                 # the partial prompt page would be overwritten by this
                 # member's first tokens: copy-on-write it
@@ -660,7 +767,7 @@ class LLMEngine:
             if self._is_finished(m, m.output_ids[-1]):
                 m.finished = True
                 finished.append(m)
-                self._release(m.slot)
+                self._release(m.slot, m)
             else:
                 self.running[m.slot] = m
                 self._activate_slot(m)
@@ -699,6 +806,10 @@ class LLMEngine:
         False (allocator untouched) when the pool can't cover it."""
         t = req.table
         target = t.length + min(k, max(self._budget_left(req), 1))
+        shortfall = (self.allocator.blocks_needed(target) - len(t.blocks)
+                     - self.allocator.num_free)
+        if shortfall > 0:
+            self._evict_for(shortfall)  # cached pages yield before fallback
         base = len(t.blocks)
         try:
             fresh = self.allocator.fund(t, target)
@@ -732,7 +843,7 @@ class LLMEngine:
                     # _release frees exactly the pages the slot owns
                     req.finished = True
                     req.truncated = True
-                    self._release(slot)
+                    self._release(slot, req)
                     finished.append(req)
         if not self.running:
             return
@@ -785,7 +896,7 @@ class LLMEngine:
             if not alive_np[slot]:
                 req.finished = True
                 finished.append(req)
-                self._release(slot)
+                self._release(slot, req)
 
     def _sample_all(self, logits) -> np.ndarray:
         return self._sample_rows(
@@ -839,8 +950,14 @@ class LLMEngine:
     def _prefill_into_slot(self, req: Request, bucket: int):
         """Prefill one prompt into its slot; returns the next-token logits
         [1, V] (grouped sampling draws every member's first token from
-        them)."""
+        them). With a prefix-cache hit, only the uncached SUFFIX runs — a
+        single chunk-prefill call starting at the first uncached block,
+        attending to the shared pages through the block table."""
         n = len(req.prompt_ids)
+        start = (len(req.cached_blocks) * self.block_size
+                 if self.prefix_cache is not None else 0)
+        if start:
+            return self._prefill_suffix_into_slot(req, bucket, start)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
@@ -858,7 +975,53 @@ class LLMEngine:
         req.table.length = n
         return logits
 
-    def _release(self, slot: int) -> None:
+    def _prefill_suffix_into_slot(self, req: Request, bucket: int, start: int):
+        """Cache-hit prefill: ``start`` prompt tokens already sit in fork-
+        shared pages, so only tokens [start, n) are computed — one chunk of
+        ``bucket - start`` (block-aligned: the hit shrinks the padded
+        bucket from the left). The chunk attends to the cached pages
+        through the table, exactly like chunked prefill attends to prior
+        chunks, so warm logits match cold ones."""
+        n = len(req.prompt_ids)
+        c = bucket - start
+        ids = np.zeros((1, c), np.int32)
+        ids[0, :n - start] = req.prompt_ids[start:]
+        table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
+        if self._pp:
+            logits, self.cache = self._pp_prefill_chunk(
+                self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(n - start, jnp.int32),
+                self.cache, jnp.asarray(table),
+            )
+        else:
+            logits, self.cache = prefill_chunk_paged(
+                self.params, self.config, self._put_rep(ids),
+                self._put_rep(np.asarray(start, np.int32)),
+                self._put_rep(np.asarray(n - start, np.int32)),
+                self.cache, self._put_rep(table),
+            )
+        req.table.length = n
+        return logits
+
+    def _evict_for(self, n_blocks: int) -> int:
+        """Try to reclaim ``n_blocks`` pages from the prefix cache — the
+        pre-OutOfBlocks relief valve: cache residency yields to live
+        sequences, so caching never shrinks effective pool capacity."""
+        if self.prefix_cache is None or n_blocks <= 0:
+            return 0
+        freed = self.prefix_cache.evict(n_blocks, self.allocator)
+        self.stats.prefix_evictions = self.prefix_cache.evictions
+        return freed
+
+    def _alloc_blocks(self, n_blocks: int) -> List[int]:
+        """allocate() with the cache-eviction fallback in front."""
+        if self.allocator.num_free < n_blocks:
+            self._evict_for(n_blocks - self.allocator.num_free)
+        return self.allocator.allocate(n_blocks)
+
+    def _release(self, slot: int, req: Optional[Request] = None) -> None:
+        req = (req or self.running.get(slot) or self.prefilling.get(slot))
         self.running.pop(slot, None)
         self.prefilling.pop(slot, None)
         # reset sampling params so a freed sampling slot doesn't pin the
@@ -870,6 +1033,25 @@ class LLMEngine:
         self._dev_active = _patch1(
             self._dev_active, self._put_rep(np.asarray(slot, np.int32)),
             self._put_rep(np.asarray(False)))
+        pc = self.prefix_cache
+        if pc is not None and req is not None and req.cache_node is not None:
+            pc.unpin(req.cache_node)
+            req.cache_node = None
         table = self._tables.pop(slot, None)
-        if table is not None:
+        if table is None:
+            return
+        if (pc is not None and req is not None
+                and table.length >= len(req.prompt_ids)):
+            # the full prompt made it into pages: DONATE the complete
+            # prompt pages into the radix tree instead of freeing them
+            # (already-cached chunks net out to a plain free inside
+            # insert); the partial tail + generated pages free as usual.
+            # Skipped when the prompt never finished prefilling (chunked
+            # prefill abort) — those pages hold a partial prefix only.
+            full = len(req.prompt_ids) // self.block_size
+            pc.insert(req.prompt_ids, table.blocks[:full], self.allocator)
+            self.stats.prefix_insertions = pc.insertions
+            self.stats.prefix_evictions = pc.evictions
+            self.allocator.free(table.blocks[full:])
+        else:
             self.allocator.free(table.blocks)

@@ -93,11 +93,37 @@ class BlockAllocator:
         return fresh
 
     def fork(self, blocks: List[int]) -> None:
-        """Share pages with another sequence (prefix reuse): bump refs."""
+        """Share pages with another sequence (prefix reuse): bump refs.
+
+        Only LIVE pages (allocated, ref > 0) can be shared — forking a
+        freed or never-allocated id would hand out a page the free list
+        still owns, silently corrupting two sequences at once. Validates
+        every id before touching any ref, so a failed fork mutates
+        nothing."""
+        for b in blocks:
+            if self._refs.get(b, 0) <= 0:
+                raise ValueError(
+                    f"fork of unallocated block {b}: only live pages "
+                    f"(allocated, ref count > 0) can be ref-shared"
+                )
         for b in blocks:
             self._refs[b] += 1
 
     def free(self, blocks: List[int]) -> None:
+        """Drop one ref per listed page; a page whose count hits zero
+        returns to the free list. A double free — more drops than the page
+        has refs, including duplicates WITHIN this call — raises before any
+        ref is touched: decrementing past zero would put the page on the
+        free list while another sequence still reads it."""
+        need: Dict[int, int] = {}
+        for b in blocks:
+            need[b] = need.get(b, 0) + 1
+        for b, n in need.items():
+            if self._refs.get(b, 0) < n:
+                raise ValueError(
+                    f"double free of block {b}: {n} release(s) requested "
+                    f"but ref count is {self._refs.get(b, 0)}"
+                )
         for b in blocks:
             self._refs[b] -= 1
             if self._refs[b] == 0:

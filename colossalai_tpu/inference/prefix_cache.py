@@ -1,0 +1,204 @@
+"""Radix-tree prefix cache over the paged KV pool (cross-request reuse).
+
+High-traffic serving recomputes the same prompt prefixes — system prompts,
+few-shot templates, multi-turn histories — on every request, so once decode
+is device-resident (megasteps) TTFT is dominated by redundant prefill. This
+module adds the missing layer between the scheduler and the KV pool: a
+radix tree keyed on BLOCK-ALIGNED token chunks (``block_size`` tokens per
+edge) mapping prompt prefixes to physical KV page ids, so a new request
+fork-shares every full prompt page it has in common with any finished one
+and prefills only the uncached suffix.
+
+Design, built on the substrate :class:`..kv_cache.BlockAllocator` already
+provides (per-block ref counts + CoW fork):
+
+- **Edges are whole pages.** One tree edge = one ``block_size``-token chunk
+  = one physical page. Pages are append-only while a sequence runs, so a
+  FULL prompt page is immutable forever — exactly the unit that can be
+  shared with zero copies. Partial tail pages are never cached (a member's
+  first generated tokens would overwrite them; the engine CoW-copies those,
+  as grouped sampling already does).
+- **The tree owns one allocator ref per cached page.** Insertion is a
+  DONATION: when a sequence finishes (or aborts after prefill), ownership
+  of its full prompt pages transfers to the tree instead of being freed —
+  a chunk that already exists in the tree keeps the incumbent page and the
+  duplicate is released. A cache hit bumps refs via ``BlockAllocator.fork``
+  just like a grouped-sampling follower, so aborting/evicting either side
+  never invalidates the other.
+- **Pinning.** ``match`` pins the matched path; the engine releases the pin
+  when the sequence leaves (completion OR abort). Pinned nodes — and inner
+  nodes, whose descendants' KV is only reachable through them — are never
+  evicted.
+- **LRU eviction, leaf-first.** ``evict`` frees the least-recently-used
+  unpinned leaves back to the allocator. The engine calls it whenever an
+  allocation would otherwise raise ``OutOfBlocks`` (admission, megastep
+  page pre-funding, grouped-fork tails), so cache residency NEVER reduces
+  effective pool capacity — the cache only holds pages nobody else wants.
+- **Matches stop one token short.** The longest usable prefix is capped at
+  ``len(prompt) - 1`` tokens: the first generated token is sampled from the
+  last prompt token's logits, which only a real forward pass produces, so
+  at least one suffix token always remains to prefill (a full-prefix hit
+  recomputes just the final page).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .kv_cache import BlockAllocator
+
+
+@dataclasses.dataclass
+class _Node:
+    """One cached page: the edge label (its ``block_size`` tokens), the
+    physical page id the tree owns a ref on, and LRU/pin bookkeeping."""
+
+    chunk: Tuple[int, ...]
+    block: int = -1
+    parent: Optional["_Node"] = None
+    children: Dict[Tuple[int, ...], "_Node"] = dataclasses.field(
+        default_factory=dict)
+    pins: int = 0
+    last_used: int = 0
+
+
+class PrefixCache:
+    """Block-chunked radix tree: prompt prefixes → KV page ids.
+
+    ``max_blocks`` bounds tree residency (None = bounded only by the pool);
+    insertion evicts LRU leaves to stay under it and stops donating when it
+    can't. All methods are host-side and O(prompt blocks) except ``evict``,
+    which scans the tree per victim — fine at serving scale (thousands of
+    resident pages, eviction off the hot path).
+    """
+
+    def __init__(self, block_size: int, max_blocks: Optional[int] = None):
+        if max_blocks is not None and max_blocks < 1:
+            raise ValueError(f"max_blocks={max_blocks} must be >= 1 (or None)")
+        self.block_size = block_size
+        self.max_blocks = max_blocks
+        self.root = _Node(chunk=())
+        self._tick = 0
+        #: pages currently resident in the tree
+        self.num_blocks = 0
+        #: lifetime counters, mirrored into EngineStats by the engine
+        self.hit_blocks = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return self.num_blocks
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    def _chunks(self, tokens):
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            yield tuple(tokens[i * bs:(i + 1) * bs])
+
+    # -------------------------------------------------------------- lookup
+    def match(self, prompt_ids) -> Tuple[Optional[_Node], List[int]]:
+        """Longest cached block-aligned prefix of ``prompt_ids``, capped one
+        token short of the full prompt (see module docstring). Returns
+        ``(deepest matched node or None, page ids root→deepest)`` and PINS
+        the matched path — the caller must :meth:`unpin` the node when the
+        sequence leaves the engine. The caller forks the returned pages
+        (``BlockAllocator.fork``) before reading them."""
+        limit = (len(prompt_ids) - 1) // self.block_size
+        node, blocks = self.root, []
+        for i, chunk in enumerate(self._chunks(prompt_ids)):
+            if i >= limit:
+                break
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            node = child
+            blocks.append(node.block)
+            self._touch(node)
+        if node is self.root:
+            return None, []
+        n: Optional[_Node] = node
+        while n is not None and n is not self.root:
+            n.pins += 1
+            n = n.parent
+        self.hit_blocks += len(blocks)
+        return node, blocks
+
+    def unpin(self, node: Optional[_Node]) -> None:
+        """Release a pin taken by :meth:`match` (walks deepest→root)."""
+        while node is not None and node is not self.root:
+            node.pins -= 1
+            node = node.parent
+
+    # ----------------------------------------------------------- insertion
+    def insert(self, prompt_ids, blocks: List[int],
+               allocator: BlockAllocator) -> int:
+        """Donate a finished sequence's FULL prompt pages into the tree.
+
+        ``blocks`` are the sequence's page ids for ``prompt_ids``'s complete
+        blocks, in order. Per chunk: an existing edge keeps the incumbent
+        page and the duplicate donation is freed (dropping the sequence's
+        ref — shared group pages net out to the tree's single ref); a new
+        edge takes ownership of the donated page (the sequence's ref BECOMES
+        the tree's — not freed). Returns the number of pages newly cached.
+        """
+        node = self.root
+        created = 0
+        donate = True
+        for i, chunk in enumerate(self._chunks(prompt_ids)):
+            if i >= len(blocks):
+                break
+            b = blocks[i]
+            child = node.children.get(chunk)
+            if child is not None:
+                allocator.free([b])
+                node = child
+                self._touch(node)
+                continue
+            if donate and self.max_blocks is not None \
+                    and self.num_blocks >= self.max_blocks \
+                    and not self._evict_one(allocator, protect=node):
+                donate = False  # full and nothing evictable: stop donating
+            if not donate:
+                allocator.free([b])
+                continue  # deeper chunks can't attach without this one
+            child = _Node(chunk=chunk, block=b, parent=node)
+            node.children[chunk] = child
+            self.num_blocks += 1
+            self.insertions += 1
+            created += 1
+            node = child
+            self._touch(node)
+        return created
+
+    # ------------------------------------------------------------ eviction
+    def evict(self, want: int, allocator: BlockAllocator) -> int:
+        """Free up to ``want`` pages back to ``allocator`` — LRU unpinned
+        leaves first (an evicted leaf exposes its parent as the next
+        candidate). Returns how many pages were actually freed."""
+        freed = 0
+        while freed < want and self._evict_one(allocator):
+            freed += 1
+        return freed
+
+    def _evict_one(self, allocator: BlockAllocator,
+                   protect: Optional[_Node] = None) -> bool:
+        victim: Optional[_Node] = None
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.children or n.pins or n is protect:
+                continue
+            if victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        del victim.parent.children[victim.chunk]
+        allocator.free([victim.block])
+        self.num_blocks -= 1
+        self.evictions += 1
+        return True

@@ -23,7 +23,12 @@ Endpoints:
   lands at the next K-token sync, not mid-loop.
 - ``GET /health``     → {"status": "ok", "running": n, "waiting": m, ...}
   plus the engine's decode-path transfer counters (megasteps, syncs,
-  tokens) for observing the O(1)-transfers-per-token contract live.
+  tokens) for observing the O(1)-transfers-per-token contract live, the
+  scheduler policy, and the prefix-cache counters (resident blocks, hit
+  blocks, saved prefill tokens, insertions, evictions).
+
+``/generate`` also accepts ``"priority"`` (int, default 0) — it orders
+admission when the engine runs ``scheduler_policy="priority"``.
 """
 
 from __future__ import annotations
@@ -63,13 +68,15 @@ class _Scheduler(threading.Thread):
         self._stop = False
 
     def submit(self, prompt_ids, gen: GenerationConfig,
-               stream: bool = False):
+               stream: bool = False, priority: int = 0):
         """Queue a request. Returns the request id, or ``(id, queue)`` for
         a streaming request — the caller must hold its own queue handle
         because a fast request can finish (and be popped from
-        ``self.streams``) before the caller ever looks it up."""
+        ``self.streams``) before the caller ever looks it up.
+        ``priority`` orders admission when the engine runs the
+        ``priority`` scheduler policy."""
         with self.lock:
-            rid = self.engine.add_request(prompt_ids, gen)
+            rid = self.engine.add_request(prompt_ids, gen, priority=priority)
             if stream:
                 q = queue.Queue()
                 self.streams[rid] = q
@@ -196,6 +203,7 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
             if self.path == "/health":
                 with sched.lock:
                     st = engine.stats
+                    pc = engine.prefix_cache
                     self._json(200, {
                         "status": "ok",
                         "running": len(engine.running),
@@ -206,6 +214,13 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         "decode_megasteps": st.decode_megasteps,
                         "decode_syncs": st.decode_syncs,
                         "decode_tokens": st.decode_tokens,
+                        "scheduler_policy": engine.scheduler_policy,
+                        "prefix_cache": pc is not None,
+                        "prefix_cache_blocks": 0 if pc is None else len(pc),
+                        "prefix_hit_blocks": st.prefix_hit_blocks,
+                        "prefix_saved_tokens": st.prefix_saved_tokens,
+                        "prefix_insertions": st.prefix_insertions,
+                        "prefix_evictions": st.prefix_evictions,
                     })
             else:
                 self._json(404, {"error": "not found"})
@@ -286,12 +301,14 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                 else:
                     self._json(400, {"error": "need prompt_ids or prompt"})
                     return
+                priority = int(req.get("priority", 0))
                 stream = bool(req.get("stream", False))
                 if stream:
-                    rid, q = sched.submit(prompt_ids, gen, stream=True)
+                    rid, q = sched.submit(prompt_ids, gen, stream=True,
+                                          priority=priority)
                     self._stream(rid, q)
                     return
-                rid = sched.submit(prompt_ids, gen)
+                rid = sched.submit(prompt_ids, gen, priority=priority)
                 out, status = sched.wait(rid)
                 if status == "aborted":
                     self._json(409, {"request_id": rid, "error": "aborted"})

@@ -1,0 +1,384 @@
+"""Radix-tree prefix cache: cross-request prompt reuse over the paged pool.
+
+The cache contract: warm requests fork-share every cached full prompt page
+and prefill only the uncached suffix, warm outputs are TOKEN-IDENTICAL to
+cold runs (greedy and sampled, any megastep K), and cached pages yield to
+live sequences (LRU eviction before OutOfBlocks) so residency never shrinks
+effective pool capacity. Plus the satellite hardening: admission-priority
+policies and BlockAllocator double-free/bad-fork guards.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import (
+    BlockAllocator,
+    GenerationConfig,
+    LLMEngine,
+    PrefixCache,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+RNG = np.random.RandomState(7)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def prompts(model_and_params):
+    cfg, _ = model_and_params
+    tok = lambda n: list(RNG.randint(0, cfg.vocab_size, size=(n,)))
+    return {"shared": tok(32), "s1": tok(5), "s2": tok(7), "other": tok(32)}
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 16)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _drain(eng, order):
+    done = {}
+    while eng.has_work:
+        for r in eng.step():
+            done[r.request_id] = r
+    return [done[rid] for rid in order]
+
+
+# ---------------------------------------------------------------- hit paths
+@pytest.mark.parametrize("k", [1, 4])
+def test_warm_outputs_token_identical_greedy(model_and_params, prompts, k):
+    """Tier-1 gate: a warm request (cached shared prefix) emits EXACTLY the
+    tokens a cold engine emits — the cache changes page provenance, never
+    tokens — at megastep K=1 and K=4."""
+    cfg, params = model_and_params
+    p1 = prompts["shared"] + prompts["s1"]
+    p2 = prompts["shared"] + prompts["s2"]
+    gen = GenerationConfig(max_new_tokens=6)
+
+    cold = _engine(params, cfg, megastep_k=k)
+    ref1 = cold.generate([list(p1)], gen)[0]
+    ref2 = _engine(params, cfg, megastep_k=k).generate([list(p2)], gen)[0]
+
+    warm = _engine(params, cfg, megastep_k=k, prefix_cache=True)
+    out1 = warm.generate([list(p1)], gen)[0]  # cold fill: misses, donates
+    assert warm.stats.prefix_hit_blocks == 0
+    out2 = warm.generate([list(p2)], gen)[0]  # warm: shared prefix hits
+    assert (out1, out2) == (ref1, ref2)
+    # 32 shared tokens / 16-token pages = 2 full blocks fork-shared
+    assert warm.stats.prefix_hit_blocks == 2
+    assert warm.stats.prefix_saved_tokens == 32
+    assert warm.stats.prefix_insertions >= 2
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_warm_outputs_token_identical_sampled(model_and_params, prompts, k):
+    """Sampled decode consumes the same PRNG stream warm and cold (one
+    split per prefill sample, one chain per megastep), so sampled outputs
+    are also warm/cold- and K-invariant."""
+    cfg, params = model_and_params
+    p1 = prompts["shared"] + prompts["s1"]
+    p2 = prompts["shared"] + prompts["s2"]
+    gen = GenerationConfig(max_new_tokens=8, do_sample=True,
+                           temperature=0.8, top_k=5)
+
+    def run(cache):
+        eng = _engine(params, cfg, megastep_k=k, seed=11, prefix_cache=cache)
+        return [eng.generate([list(p)], gen)[0] for p in (p1, p2)], eng
+
+    ref, _ = run(False)
+    out, eng = run(True)
+    assert out == ref, (out, ref)
+    assert eng.stats.prefix_hit_blocks == 2
+
+
+def test_miss_partial_and_capped_full_prefix(model_and_params, prompts):
+    """Match granularity: a disjoint prompt misses entirely; sharing only
+    the first page hits 1 block; a prompt IDENTICAL to a cached one (length
+    an exact page multiple) is capped one token short — the last page is
+    recomputed so real logits seed the first generated token."""
+    cfg, params = model_and_params
+    shared, other = prompts["shared"], prompts["other"]
+    gen = GenerationConfig(max_new_tokens=4)
+    eng = _engine(params, cfg, prefix_cache=True)
+    ref = _engine(params, cfg)
+
+    eng.generate([list(shared)], gen)  # prime: donates 2 full pages
+    base = eng.stats.prefix_hit_blocks
+
+    out = eng.generate([list(other)], gen)[0]  # fully disjoint
+    assert eng.stats.prefix_hit_blocks == base
+    assert out == ref.generate([list(other)], gen)[0]
+
+    half = shared[:16] + prompts["s2"]  # shares exactly one page
+    out = eng.generate([list(half)], gen)[0]
+    assert eng.stats.prefix_hit_blocks == base + 1
+    assert out == _engine(params, cfg).generate([list(half)], gen)[0]
+
+    out = eng.generate([list(shared)], gen)[0]  # exact 32-token replay
+    assert eng.stats.prefix_hit_blocks == base + 2  # 1 of 2 pages: capped
+    assert out == _engine(params, cfg).generate([list(shared)], gen)[0]
+
+
+def test_chunked_prefill_warm_start(model_and_params, prompts):
+    """Chunked prefill composes with the cache: a warm long prompt starts
+    its chunk walk at the first uncached block — fewer chunks, same
+    tokens."""
+    cfg, params = model_and_params
+    long1 = prompts["shared"] + prompts["s1"] + prompts["s2"][:3]  # 40 toks
+    long2 = prompts["shared"] + prompts["s2"] + prompts["s1"]      # 44 toks
+    gen = GenerationConfig(max_new_tokens=5)
+
+    ref = [_engine(params, cfg).generate([list(p)], gen)[0]
+           for p in (long1, long2)]
+    eng = _engine(params, cfg, prefill_chunk=16, prefix_cache=True)
+    out1 = eng.generate([list(long1)], gen)[0]
+    cold_chunks = eng.stats.prefill_chunks
+    assert cold_chunks == 3  # 40 tokens / 16-token chunks, no hit
+    out2 = eng.generate([list(long2)], gen)[0]
+    # warm: 2 pages cached -> suffix is 12 tokens -> single suffix prefill
+    assert eng.stats.prefix_hit_blocks == 2
+    assert eng.stats.prefill_chunks - cold_chunks < cold_chunks
+    assert [out1, out2] == ref
+
+
+# ------------------------------------------------ eviction & pool pressure
+def test_eviction_yields_cache_before_out_of_blocks(model_and_params):
+    """Pool pressure: cached pages are LRU-evicted to fund a live request
+    BEFORE OutOfBlocks/truncation — cache residency never reduces the
+    pool's effective capacity."""
+    cfg, params = model_and_params
+    pA = list(RNG.randint(0, cfg.vocab_size, size=(7,)))
+    pB = list(RNG.randint(0, cfg.vocab_size, size=(12,)))
+    gen = GenerationConfig(max_new_tokens=1)
+
+    def run(cache):
+        return LLMEngine(params, cfg, max_batch_size=2, max_seq_len=32,
+                         block_size=4, prefill_buckets=(8, 16), num_blocks=5,
+                         prefix_cache=cache)
+
+    eng = run(True)
+    eng.generate([list(pA)], gen)  # pA donates its full page into the tree
+    assert len(eng.prefix_cache) >= 1
+    # pB needs all 4 usable pages; the tree holds one -> must evict
+    outB = eng.generate([list(pB)], gen)[0]
+    assert eng.stats.prefix_evictions >= 1
+    assert outB == run(False).generate([list(pB)], gen)[0]
+    done = _drain(eng, [])  # noqa: F841 — engine idle, nothing truncated
+    assert eng.allocator.num_free + len(eng.prefix_cache) == 4
+
+
+def test_eviction_skips_pinned_pages(model_and_params, prompts):
+    """A cached page a LIVE sequence forked stays pinned: eviction under
+    pressure must take only unpinned pages, and the pinned ones survive
+    for the next warm request."""
+    cfg, params = model_and_params
+    pc = PrefixCache(block_size=4)
+    alloc = BlockAllocator(num_blocks=8, block_size=4)
+    b1 = alloc.allocate(2)
+    pc.insert(list(range(8)), b1, alloc)  # two chained pages
+    b2 = alloc.allocate(1)
+    pc.insert([9, 9, 9, 9], b2, alloc)    # a disjoint single page
+    assert len(pc) == 3 and alloc.num_free == 4
+
+    node, blocks = pc.match(list(range(8)) + [42])  # pins the 2-page chain
+    assert blocks == b1
+    # want everything: only the unpinned disjoint page may go
+    assert pc.evict(10, alloc) == 1
+    assert len(pc) == 2 and alloc.num_free == 5
+    pc.unpin(node)
+    assert pc.evict(10, alloc) == 2  # unpinned now: chain evicts leaf-first
+    assert len(pc) == 0 and alloc.num_free == 7
+
+
+def test_cache_max_blocks_bounds_residency(model_and_params):
+    """prefix_cache_max_blocks caps the tree: inserting past the cap
+    evicts LRU pages first and stops donating when nothing is evictable."""
+    cfg, params = model_and_params
+    pc = PrefixCache(block_size=4, max_blocks=2)
+    alloc = BlockAllocator(num_blocks=8, block_size=4)
+    pc.insert(list(range(8)), alloc.allocate(2), alloc)
+    assert len(pc) == 2
+    pc.insert([5, 5, 5, 5, 6, 6, 6, 6], alloc.allocate(2), alloc)
+    assert len(pc) == 2  # capped: older pages made room
+    assert pc.evictions == 2
+    assert alloc.num_free == 7 - 2  # everything beyond the cap went back
+
+
+# --------------------------------------------- refcounts & grouped sampling
+def test_warm_grouped_sampling_forks_cached_pages(model_and_params, prompts):
+    """Grouped sampling on a warm cache: the leader's table starts with
+    fork-shared tree pages, followers fork them AGAIN (tree + n members
+    refs), and full release leaves exactly the tree's own ref."""
+    cfg, params = model_and_params
+    prompt = prompts["shared"] + prompts["s1"]  # 37 tokens: 2 full pages
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True, temperature=1.0)
+
+    def run(cache):
+        eng = _engine(params, cfg, seed=5, prefix_cache=cache)
+        eng.generate([list(prompts["shared"]) + prompts["s2"]],
+                     GenerationConfig(max_new_tokens=2))  # prime the tree
+        ids = eng.add_request(list(prompt), gen, n_samples=3)
+        eng.step()  # admission + leader prefill + follower fork
+        if cache:
+            node, blocks = eng.prefix_cache.match(list(prompt))
+            eng.prefix_cache.unpin(node)  # probe only: net-zero pins
+            # tree ref + leader + 2 followers all share the cached page
+            assert eng.allocator.ref_count(blocks[0]) == 4
+        out = [r.output_ids for r in _drain(eng, ids)]
+        return out, eng
+
+    ref, _ = run(False)
+    out, eng = run(True)
+    assert out == ref, (out, ref)
+    assert eng.stats.prefix_hit_blocks >= 2
+    # all sequences gone: only the tree's refs remain, accounting balances
+    assert (eng.allocator.num_free + len(eng.prefix_cache)
+            == eng.allocator.num_blocks - 1)
+    node, blocks = eng.prefix_cache.match(list(prompt))
+    eng.prefix_cache.unpin(node)
+    assert blocks and all(eng.allocator.ref_count(b) == 1 for b in blocks)
+
+
+def test_disabled_cache_keeps_seed_accounting(model_and_params, prompts):
+    """prefix_cache off (the default) reproduces pre-cache behavior: no
+    counters move and every page returns to the free list."""
+    cfg, params = model_and_params
+    eng = _engine(params, cfg)
+    assert eng.prefix_cache is None
+    eng.generate([list(prompts["shared"])], GenerationConfig(max_new_tokens=3))
+    st = eng.stats
+    assert (st.prefix_hit_blocks == st.prefix_saved_tokens
+            == st.prefix_insertions == st.prefix_evictions == 0)
+    assert eng.allocator.num_free == eng.allocator.num_blocks - 1
+
+
+# ------------------------------------------------------ allocator hardening
+def test_allocator_double_free_raises():
+    a = BlockAllocator(num_blocks=8, block_size=16)
+    b = a.allocate(2)
+    a.free(b)
+    with pytest.raises(ValueError, match="double free"):
+        a.free([b[0]])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([7])  # never allocated
+    c = a.allocate(1)
+    with pytest.raises(ValueError, match="double free"):
+        a.free(c + c)  # duplicate within ONE call: ref 1, two drops
+    assert a.ref_count(c[0]) == 1  # the failed free mutated nothing
+
+
+def test_allocator_fork_unallocated_raises():
+    a = BlockAllocator(num_blocks=8, block_size=16)
+    with pytest.raises(ValueError, match="fork of unallocated"):
+        a.fork([3])
+    b = a.allocate(2)
+    with pytest.raises(ValueError, match="fork of unallocated"):
+        a.fork([b[0], 5])  # one live, one bogus: nothing mutates
+    assert a.ref_count(b[0]) == 1
+    a.free(b)
+    with pytest.raises(ValueError, match="fork of unallocated"):
+        a.fork([b[0]])  # freed page can't be shared back to life
+
+
+# ------------------------------------------------------- admission policies
+def _policy_completion_order(params, cfg, reqs, policy):
+    """Submit all requests up front on a 1-slot engine; completion order IS
+    admission order."""
+    eng = LLMEngine(params, cfg, max_batch_size=1, max_seq_len=64,
+                    block_size=16, scheduler_policy=policy)
+    gen = GenerationConfig(max_new_tokens=2)
+    rids = [eng.add_request(list(p), gen, priority=pri) for p, pri in reqs]
+    order = []
+    while eng.has_work:
+        order.extend(r.request_id for r in eng.step())
+    return rids, order
+
+
+def test_policy_priority_orders_admission(model_and_params, prompts):
+    cfg, params = model_and_params
+    p = prompts["shared"][:8]
+    rids, order = _policy_completion_order(
+        params, cfg, [(p, 0), (p, 5), (p, 1), (p, 5)], "priority")
+    # highest priority first, FIFO within a level
+    assert order == [rids[1], rids[3], rids[2], rids[0]]
+
+
+def test_policy_shortest_prompt_first(model_and_params, prompts):
+    cfg, params = model_and_params
+    mk = lambda n: prompts["shared"][:n]
+    rids, order = _policy_completion_order(
+        params, cfg, [(mk(9), 0), (mk(3), 0), (mk(6), 0)],
+        "shortest_prompt_first")
+    assert order == [rids[1], rids[2], rids[0]]
+
+
+def test_policy_fifo_and_custom_callable(model_and_params, prompts):
+    cfg, params = model_and_params
+    p = prompts["shared"][:8]
+    rids, order = _policy_completion_order(
+        params, cfg, [(p, 0), (p, 9), (p, 1)], "fifo")
+    assert order == rids  # priority ignored
+    # pluggable: any Request -> key callable (here: LIFO)
+    rids, order = _policy_completion_order(
+        params, cfg, [(p, 0), (p, 0), (p, 0)],
+        lambda req: -req.request_id)
+    assert order == rids[::-1]
+    with pytest.raises(ValueError, match="scheduler_policy"):
+        LLMEngine(params, cfg, max_batch_size=1, max_seq_len=64,
+                  block_size=16, scheduler_policy="nope")
+
+
+# ----------------------------------------------------------------- /health
+def test_server_exposes_cache_counters_and_priority(model_and_params,
+                                                    prompts):
+    """/health publishes the prefix-cache counters and the scheduler
+    policy; /generate forwards "priority" into the engine."""
+    from colossalai_tpu.inference import make_server
+
+    cfg, params = model_and_params
+    eng = _engine(params, cfg, prefix_cache=True,
+                  scheduler_policy="priority")
+    eng.generate([list(prompts["shared"]) + prompts["s1"]],
+                 GenerationConfig(max_new_tokens=2))  # prime the tree
+    server, sched = make_server(eng, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({
+                "prompt_ids": [int(t) for t in prompts["shared"]]
+                + [int(t) for t in prompts["s2"]],
+                "max_new_tokens": 2, "priority": 3,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert len(json.loads(r.read())["output_ids"]) == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["prefix_cache"] is True
+        assert health["scheduler_policy"] == "priority"
+        assert health["prefix_cache_blocks"] >= 2
+        assert health["prefix_hit_blocks"] == 2  # the warm HTTP request
+        assert health["prefix_saved_tokens"] == 32
+        assert health["prefix_insertions"] >= 2
+        assert "prefix_evictions" in health
+    finally:
+        server.shutdown()
+        sched.stop()
