@@ -353,6 +353,68 @@ def measure_prefix_cache(cfg, n_requests: int = 8, sys_len: int = 256,
     }
 
 
+def measure_speculative(cfg, bs: int = 4, prompt_len: int = 128,
+                        new_tokens: int = 64, k: int = 8,
+                        draft_lens=(0, 2, 4)):
+    """Speculative serving scenario: the SAME decode workload per
+    ``draft_len`` (0 = plain megastep decode, the before picture) at
+    megastep K, with a truncated-layer self-draft (quarter of the target's
+    layers — zero extra weights, the GlideDrafter shape). Reports batch
+    tokens/s, TTFT, inter-token latency and the measured acceptance rate —
+    the knob that decides whether drafting pays for a given model/workload
+    (spec wins when acceptance × draft_len outruns the draft's cost)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
+               for _ in range(bs)]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    n_draft_layers = max(cfg.num_hidden_layers // 4, 1)
+
+    out = {}
+    for d in draft_lens:
+        spec = {"draft_len": d, "self_draft_layers": n_draft_layers} if d else {}
+        engine = LLMEngine(params, cfg, max_batch_size=bs, max_seq_len=1024,
+                           block_size=64, megastep_k=k, **spec)
+        engine.generate([prompts[0]], GenerationConfig(max_new_tokens=2))  # warm
+        t_submit, t_first, t_done, n_toks = {}, {}, {}, {}
+        rids = []
+        for p in prompts:
+            rids.append(engine.add_request(list(p), gen))
+            t_submit[rids[-1]] = time.perf_counter()
+        t0 = time.perf_counter()
+        while engine.has_work:
+            finished = engine.step()
+            now = time.perf_counter()
+            for req in engine.running.values():
+                if req.output_ids and req.request_id not in t_first:
+                    t_first[req.request_id] = now
+            for req in finished:
+                t_first.setdefault(req.request_id, now)
+                t_done[req.request_id] = now
+                n_toks[req.request_id] = len(req.output_ids)
+        dt = time.perf_counter() - t0
+        ttft = [t_first[r] - t_submit[r] for r in rids]
+        itl = [(t_done[r] - t_first[r]) / max(n_toks[r] - 1, 1) for r in rids]
+        st = engine.stats
+        out[f"draft{d}"] = {
+            "tokens_per_s": round(sum(n_toks.values()) / dt, 1),
+            "ttft_ms_mean": round(1e3 * sum(ttft) / len(ttft), 1),
+            "itl_ms_mean": round(1e3 * sum(itl) / len(itl), 2),
+            "acceptance_rate": round(st.spec_acceptance_rate, 3) if d else None,
+            "target_passes": st.spec_target_passes,
+            "decode_syncs": st.decode_syncs,
+        }
+    return out
+
+
 def measure_moe(n_dev: int, steps: int = 5):
     """MoE pretraining throughput: a ~0.8B-active mixtral-shaped model
     (tokens/s/device — MoE MFU accounting is convention-laden, so the raw
@@ -523,6 +585,12 @@ def child_main():
             extras["prefix_cache"] = measure_prefix_cache(model_for(hbm, 1024))
         except Exception as e:
             print(f"prefix cache bench failed: {e}", file=sys.stderr)
+        try:
+            # speculative decode: tokens/s + TTFT/ITL + acceptance rate vs
+            # draft_len (0 = plain megasteps) with a self-draft drafter
+            extras["speculative"] = measure_speculative(model_for(hbm, 1024))
+        except Exception as e:
+            print(f"speculative bench failed: {e}", file=sys.stderr)
         try:
             extras.update(measure_flash_kernels())
         except Exception as e:

@@ -18,13 +18,20 @@ from .multiprocess import MultiProcessFrontend
 from .paged_modeling import (
     decode_megastep,
     decode_paged,
+    filter_logits,
     prefill_chunk_paged,
     prefill_paged,
     sample_tokens,
+    verify_paged,
 )
 from .prefix_cache import PrefixCache
 from .server import make_server
-from .speculative import SpeculativeEngine, SpecStats
+from .speculative import (
+    SpeculativeEngine,
+    SpecStats,
+    decode_spec_megastep,
+    self_draft_params,
+)
 
 __all__ = [
     "ddim_sample",
@@ -47,9 +54,13 @@ __all__ = [
     "EngineStats",
     "decode_megastep",
     "decode_paged",
+    "decode_spec_megastep",
+    "filter_logits",
     "prefill_chunk_paged",
     "prefill_paged",
     "sample_tokens",
+    "self_draft_params",
+    "verify_paged",
     "make_server",
     "extend_step",
     "SpeculativeEngine",

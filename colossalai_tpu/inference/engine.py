@@ -70,6 +70,7 @@ from .paged_modeling import (
     prefill_paged,
     sample_tokens,
 )
+from .speculative import decode_spec_megastep, self_draft_params
 
 
 @dataclasses.dataclass
@@ -143,6 +144,19 @@ class EngineStats:
     prefix_insertions: int = 0
     #: cached pages LRU-evicted back to the pool under allocation pressure
     prefix_evictions: int = 0
+    # ---- speculative decoding (draft_len > 0): all accumulated ON DEVICE
+    # inside the megastep and fetched in its single host sync
+    #: draft proposals scored by the target verify pass
+    spec_draft_tokens: int = 0
+    #: draft proposals accepted (emitted verbatim); the correction/bonus
+    #: token each pass also emits is NOT counted here
+    spec_accepted_tokens: int = 0
+    #: multi-token verify forwards (one per live slot per megastep iteration)
+    spec_target_passes: int = 0
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        return self.spec_accepted_tokens / max(self.spec_draft_tokens, 1)
 
 
 #: admission-order policies (``scheduler_policy=``): each maps a waiting
@@ -232,6 +246,10 @@ class LLMEngine:
         prefix_cache: bool = False,
         prefix_cache_max_blocks: Optional[int] = None,
         scheduler_policy="fifo",
+        draft_len: int = 0,
+        draft_params=None,
+        draft_config: Optional[LlamaConfig] = None,
+        self_draft_layers: Optional[int] = None,
     ):
         self.config = config
         self.max_batch = max_batch_size
@@ -276,14 +294,29 @@ class LLMEngine:
         )
         if callable(scheduler_policy):
             self._policy_key = scheduler_policy
+        elif scheduler_policy == "cache_aware":
+            # cache-aware admission: under pool pressure, requests with
+            # prefix-cache hits go first, weighted by the pages they save
+            # (a warm request admits with fewer fresh pages AND prefills
+            # less); FIFO breaks ties, so with a cold cache this IS fifo.
+            # peek() neither pins nor LRU-touches — ordering a queue scan
+            # must not distort eviction recency.
+            if not prefix_cache:
+                raise ValueError(
+                    "scheduler_policy='cache_aware' orders admission by "
+                    "prefix-cache hits — build the engine with "
+                    "prefix_cache=True"
+                )
+            self._policy_key = lambda req: (
+                -self.prefix_cache.peek(req.prompt_ids), req.request_id)
         else:
             try:
                 self._policy_key = SCHEDULER_POLICIES[scheduler_policy]
             except KeyError:
                 raise ValueError(
                     f"scheduler_policy={scheduler_policy!r}: pass one of "
-                    f"{sorted(SCHEDULER_POLICIES)} or a Request -> sort-key "
-                    f"callable"
+                    f"{sorted(SCHEDULER_POLICIES) + ['cache_aware']} or a "
+                    f"Request -> sort-key callable"
                 ) from None
         self.scheduler_policy = (
             scheduler_policy if isinstance(scheduler_policy, str) else "custom"
@@ -292,6 +325,63 @@ class LLMEngine:
         self.mesh = mesh
         dtype = config.dtype or jnp.bfloat16
         cache = init_paged_cache(config, num_blocks, block_size, dtype=dtype)
+        # ---- speculative decoding (draft_len > 0): the megastep drafts
+        # draft_len tokens per iteration (separate draft model, or a
+        # truncated-layer self-draft sharing the target's weights) and the
+        # target verifies the whole window in ONE multi-token paged
+        # forward. The draft's page pool mirrors the target's BLOCK IDS —
+        # same tables, same allocator — so funding, rollback refunds,
+        # prefix-cache forks and CoW all stay single-bookkeeping.
+        if draft_len < 0:
+            raise ValueError(f"draft_len={draft_len} must be >= 0")
+        self.draft_len = int(draft_len)
+        self.draft_params = None
+        self.draft_config: Optional[LlamaConfig] = None
+        self.draft_cache: Optional[PagedKVCache] = None
+        if draft_len == 0 and (draft_params is not None
+                               or self_draft_layers is not None):
+            raise ValueError(
+                "a draft model was given but draft_len=0 — set draft_len "
+                "to the number of tokens to draft per verify pass"
+            )
+        if draft_len > 0:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative decoding (draft_len > 0) is single-device "
+                    "only — drop the mesh or draft_len"
+                )
+            if draft_params is not None:
+                if draft_config is None:
+                    raise ValueError(
+                        "draft_params without draft_config — the engine "
+                        "needs the draft model's LlamaConfig"
+                    )
+                if self_draft_layers is not None:
+                    raise ValueError(
+                        "pass EITHER draft_params (separate draft model) OR "
+                        "self_draft_layers (truncated-layer self-draft)"
+                    )
+                self.draft_params = draft_params
+                self.draft_config = draft_config
+            else:
+                if self_draft_layers is None:
+                    raise ValueError(
+                        "draft_len > 0 needs a draft: pass draft_params + "
+                        "draft_config, or self_draft_layers=n to self-draft "
+                        "with the target's first n layers"
+                    )
+                self.draft_params, self.draft_config = self_draft_params(
+                    params, config, self_draft_layers
+                )
+            if self.draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size={self.draft_config.vocab_size} != "
+                    f"target vocab_size={config.vocab_size} — acceptance "
+                    "compares token ids, the vocabularies must match"
+                )
+            self.draft_cache = init_paged_cache(
+                self.draft_config, num_blocks, block_size, dtype=dtype
+            )
         self._pp = 0
         if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
             # pipeline-parallel decode: layers (weights AND pages) live on
@@ -709,6 +799,17 @@ class LLMEngine:
                     self._put_rep(np.asarray(n_valid, np.int32)),
                     self.cache, self._put_rep(table),
                 )
+                if self.draft_len:
+                    # mirror the chunk into the draft pool (same physical
+                    # pages) so the draft's prompt KV is ready when the
+                    # slot starts drafting
+                    _, self.draft_cache = prefill_chunk_paged(
+                        self.draft_params, self.draft_config,
+                        self._put_rep(ids),
+                        self._put_rep(np.asarray(pos, np.int32)),
+                        self._put_rep(np.asarray(n_valid, np.int32)),
+                        self.draft_cache, self._put_rep(table),
+                    )
             self.stats.prefill_chunks += 1
             req.prefill_pos = pos + n_valid
             if req.prefill_pos >= n:
@@ -744,11 +845,12 @@ class LLMEngine:
                 # the partial prompt page would be overwritten by this
                 # member's first tokens: copy-on-write it
                 copy = _copy_block_pp if self._pp else _copy_block
-                self.cache = copy(
-                    self.cache,
-                    self._put_rep(np.asarray(req.table.blocks[full], np.int32)),
-                    self._put_rep(np.asarray(fresh[0], np.int32)),
-                )
+                src = self._put_rep(np.asarray(req.table.blocks[full], np.int32))
+                dst = self._put_rep(np.asarray(fresh[0], np.int32))
+                self.cache = copy(self.cache, src, dst)
+                if self.draft_len:
+                    # the draft pool shares the block ids — CoW in lockstep
+                    self.draft_cache = copy(self.draft_cache, src, dst)
             f.table = SequenceTable(shared + fresh)
             f.table.length = n
             self._tables[f.slot] = f.table
@@ -824,19 +926,50 @@ class LLMEngine:
             self.stats.decode_h2d_scalars += 3
         return True
 
+    def _fund_all(self, w: int) -> bool:
+        """Fund every running slot for ``w`` more tokens (budget-capped).
+        False on the first slot the pool can't cover; slots already funded
+        keep their pages — the next (smaller) target subsumes them, or the
+        post-megastep refund hands the surplus back."""
+        for slot, req in self.running.items():
+            if not self._fund_slot(slot, req, w):
+                return False
+        return True
+
+    def _refund_slot(self, slot: int, req: Request) -> None:
+        """Speculative rollback refund: pages funded for tokens the verify
+        pass rejected go straight back to the free list — an O(1) host
+        list push, no device traffic. The device table row still names the
+        freed ids, but positions past ``length`` are never read (causal
+        mask / length mask) and the next funding re-patches those entries
+        before any write can reach them (writes are limit-masked)."""
+        t = req.table
+        keep = self.allocator.blocks_needed(t.length)
+        if len(t.blocks) > keep:
+            extra = t.blocks[keep:]
+            del t.blocks[keep:]
+            self.allocator.free(extra)
+
     def _decode_tick(self, finished: List[Request]) -> None:
         if not self.running:
             return
-        # pre-fund K tokens of pages per slot so the device loop never
-        # needs a host allocation decision; demote to K=1 when tight
+        # pre-fund the whole megastep's worth of pages per slot so the
+        # device loop never needs a host allocation decision; demote when
+        # tight: (K, d) -> (1, d) -> (1, 0) plain -> per-slot truncation
         k = self.megastep_k
-        if k > 1:
-            for slot, req in self.running.items():
-                if not self._fund_slot(slot, req, k):
-                    k = 1
+        d = self.draft_len
+        if d > 0:
+            # a speculative iteration can commit up to d+1 tokens
+            if not self._fund_all(k * (d + 1)):
+                if k > 1:
                     self.stats.fallback_k1 += 1
-                    break
-        if k == 1:
+                    k = 1
+                if not self._fund_all(d + 1):
+                    d = 0  # pool too tight even for one verify window
+        elif k > 1 and not self._fund_all(k):
+            self.stats.fallback_k1 += 1
+            k = 1
+        if d == 0 and k == 1:
             for slot, req in list(self.running.items()):
                 if not self._fund_slot(slot, req, 1):
                     # out of pages mid-flight: truncate this request —
@@ -857,8 +990,24 @@ class LLMEngine:
             # greedy megasteps never consume randomness (matching the
             # per-step fast path); the keys operand is a dead input
             keys = self._put_rep(np.zeros((k, 2), np.uint32))
-        if self._pp:
-            out = self._pp_megastep(
+        if d > 0:
+            # draft/verify/commit runs entirely on device; the extra
+            # outputs are the per-slot speculative counters, fetched in
+            # the same single sync below
+            (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+             self._dev_budget, self.cache, self.draft_cache,
+             passes, drafted, accepted) = decode_spec_megastep(
+                self.params, self.draft_params, self.config,
+                self.draft_config, self._dev_tokens, self._dev_tables,
+                self._dev_lengths, self.cache, self.draft_cache,
+                self._dev_active, self._dev_budget, self._dev_eos,
+                self._dev_temp, self._dev_topk, self._dev_topp,
+                self._dev_sample, keys, k_steps=k, draft_len=d,
+                use_kernel=self.use_kernel, use_sampling=any_sample,
+            )
+        elif self._pp:
+            (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+             self._dev_budget, self.cache) = self._pp_megastep(
                 self._pp_top, self._pp_stacked, self._dev_tokens,
                 self._dev_tables, self._dev_lengths, self.cache,
                 self._dev_active, self._dev_budget, self._dev_eos,
@@ -866,7 +1015,8 @@ class LLMEngine:
                 self._dev_sample, keys, k_steps=k, use_sampling=any_sample,
             )
         else:
-            out = decode_megastep(
+            (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+             self._dev_budget, self.cache) = decode_megastep(
                 self.params, self.config, self._dev_tokens,
                 self._dev_tables, self._dev_lengths, self.cache,
                 self._dev_active, self._dev_budget, self._dev_eos,
@@ -874,8 +1024,6 @@ class LLMEngine:
                 self._dev_sample, keys, k_steps=k,
                 use_kernel=self.use_kernel, use_sampling=any_sample,
             )
-        (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
-         self._dev_budget, self.cache) = out
         # the ONE host sync per megastep: K×S ids + per-slot counts/flags
         buf_np = self._fetch(buf)
         emitted_np = self._fetch(emitted)
@@ -885,6 +1033,16 @@ class LLMEngine:
         self.stats.decode_d2h_elements += (
             buf_np.size + emitted_np.size + alive_np.size
         )
+        if d > 0:
+            passes_np = self._fetch(passes)
+            drafted_np = self._fetch(drafted)
+            accepted_np = self._fetch(accepted)
+            self.stats.decode_d2h_elements += (
+                passes_np.size + drafted_np.size + accepted_np.size
+            )
+            self.stats.spec_target_passes += int(passes_np.sum())
+            self.stats.spec_draft_tokens += int(drafted_np.sum())
+            self.stats.spec_accepted_tokens += int(accepted_np.sum())
         for slot, req in list(self.running.items()):
             t = int(emitted_np[slot])
             toks = [int(x) for x in buf_np[slot, :t]]
@@ -897,6 +1055,10 @@ class LLMEngine:
                 req.finished = True
                 finished.append(req)
                 self._release(slot, req)
+            elif self.draft_len:
+                # rollback = length decrement already happened on device;
+                # hand the pages funded past the committed frontier back
+                self._refund_slot(slot, req)
 
     def _sample_all(self, logits) -> np.ndarray:
         return self._sample_rows(
@@ -972,6 +1134,12 @@ class LLMEngine:
                 self._put_rep(np.asarray([n], np.int32)), self.cache,
                 self._put_rep(table),
             )
+            if self.draft_len:
+                _, self.draft_cache = prefill_paged(
+                    self.draft_params, self.draft_config, self._put_rep(ids),
+                    self._put_rep(np.asarray([n], np.int32)),
+                    self.draft_cache, self._put_rep(table),
+                )
         req.table.length = n
         return logits
 
@@ -1001,6 +1169,17 @@ class LLMEngine:
                 self._put_rep(np.asarray(n - start, np.int32)),
                 self.cache, self._put_rep(table),
             )
+            if self.draft_len:
+                # the cached prefix pages already hold draft KV — their
+                # donor mirrored its whole prompt into the draft pool at
+                # these same physical ids, and tree-owned pages are never
+                # reallocated while cached — so only the suffix runs here
+                _, self.draft_cache = prefill_chunk_paged(
+                    self.draft_params, self.draft_config, self._put_rep(ids),
+                    self._put_rep(np.asarray(start, np.int32)),
+                    self._put_rep(np.asarray(n - start, np.int32)),
+                    self.draft_cache, self._put_rep(table),
+                )
         req.table.length = n
         return logits
 

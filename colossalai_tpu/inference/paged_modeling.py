@@ -46,17 +46,17 @@ def _logits_head(p, cfg: LlamaConfig, x) -> jax.Array:
     return x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
 
 
-def sample_tokens(logits, rng, temperature, top_k, top_p, do_sample):
-    """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
-    generation params [S] → tokens [S]. The host fetches S ints, never the
-    [S, V] logits (the r02 review's host-bound-decode fix). top_k=0 /
-    top_p=1 disable those filters. Filters compose sequentially (HF
+def filter_logits(logits, temperature, top_k, top_p):
+    """Temperature-scaled, top-k/top-p-filtered logits [S, V] (entries
+    outside the nucleus at -1e9) — the exact distribution
+    :func:`sample_tokens` draws from, factored out so speculative decoding
+    can compute the SAME per-slot draft/target distributions for its
+    accept / leftover-sampling step (distribution preservation requires
+    q and p to be the filtered distributions, not the raw ones). top_k=0 /
+    top_p=1 disable those filters; filters compose sequentially (HF
     convention): the top-p nucleus is measured on the top-k-RENORMALIZED
-    distribution, not the full vocab. Pure function — jitted standalone by
-    the engine (``_sample_slots``) and traced inside ``decode_megastep``'s
-    device-resident loop."""
+    distribution, not the full vocab."""
     vocab = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
     sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
     k_eff = jnp.where(top_k > 0, top_k, vocab).astype(jnp.int32)
@@ -71,7 +71,18 @@ def sample_tokens(logits, rng, temperature, top_k, top_p, do_sample):
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
     cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx.clip(0, vocab - 1), axis=-1)
-    masked = jnp.where(scaled < cutoff, -1e9, masked)
+    return jnp.where(scaled < cutoff, -1e9, masked)
+
+
+def sample_tokens(logits, rng, temperature, top_k, top_p, do_sample):
+    """Vectorized per-slot sampling ON DEVICE: logits [S, V] + per-slot
+    generation params [S] → tokens [S]. The host fetches S ints, never the
+    [S, V] logits (the r02 review's host-bound-decode fix). Pure function —
+    jitted standalone by the engine (``_sample_slots``) and traced inside
+    ``decode_megastep``'s device-resident loop. See :func:`filter_logits`
+    for the filtering semantics."""
+    greedy = jnp.argmax(logits, axis=-1)
+    masked = filter_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, masked, axis=-1)
     return jnp.where(do_sample, sampled, greedy)
 
@@ -272,6 +283,117 @@ def decode_paged(
     p = params["params"] if "params" in params else params
     logits, k_new, v_new = _decode_once(
         p, cfg, tokens, block_tables, lengths, cache.k, cache.v, active, use_kernel
+    )
+    return logits, PagedKVCache(k=k_new, v=v_new)
+
+
+def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
+                 cache_k, cache_v, active, use_kernel: bool):
+    """One MULTI-TOKEN decode iteration: tokens [S, W] at positions
+    ``lengths .. lengths+W-1`` → (logits [S, W, V], k pool, v pool).
+
+    The speculative verify pass (one forward scores a whole draft window)
+    and the W=1 degenerate case share this core; with W=1 the math is
+    op-for-op identical to ``_decode_once``, which is what makes greedy
+    speculative output token-identical to plain greedy decode on CPU.
+
+    ``limits`` [S] is the per-slot funded frontier: positions >= limit
+    (tokens past the scheduler's page funding / token budget) redirect
+    their K/V write to the reserved null page 0, exactly like inactive
+    slots — without the mask JAX's clamping index semantics would silently
+    corrupt the LAST real page when a draft window overruns its funding.
+    Their logits still compute (garbage) and the caller discards them."""
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    n_slots, w = tokens.shape
+    bs = cache_k.shape[3]
+    max_blocks = block_tables.shape[1]
+    positions = lengths[:, None] + jnp.arange(w)[None, :]  # [S, W]
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[tokens]  # [S, W, H]
+    # write coordinates per (slot, window) token; masked writes land on
+    # the null page like _decode_once's inactive-slot scatter
+    write_ok = active[:, None] & (positions < limits[:, None])  # [S, W]
+    wb = jnp.where(
+        write_ok,
+        jnp.take_along_axis(
+            block_tables, (positions // bs).clip(0, max_blocks - 1), axis=1),
+        0,
+    )
+    wo = jnp.where(write_ok, positions % bs, 0)
+
+    s_max = max_blocks * bs
+    kv_pos = jnp.arange(s_max)[None, :]
+    # everything written so far plus this window; per-query causality is
+    # refined inside _block_step (query at positions[s, i] sees kv_pos <=
+    # positions[s, i])
+    attend = kv_pos < (lengths[:, None] + w)
+
+    def layer(carry, inputs):
+        x, i = carry
+        layer_params, k_pool, v_pool = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)  # [S,W,Hkv,D]
+        # pool [n_blocks, Hkv, bs, D]: advanced indices (wb, :, wo) → [S, W, Hkv, D]
+        k_new = jnp.where(write_ok[..., None, None], k, k_pool[wb, :, wo])
+        v_new = jnp.where(write_ok[..., None, None], v, v_pool[wb, :, wo])
+        k_pool = k_pool.at[wb, :, wo].set(k_new)
+        v_pool = v_pool.at[wb, :, wo].set(v_new)
+        if use_kernel:
+            from colossalai_tpu.kernel import fused_add_rms_norm
+            from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
+
+            q = _proj(h, layer_params["self_attn"]["q_proj"], dtype)
+            q = q.reshape(n_slots, w, cfg.num_attention_heads, cfg.head_dim_)
+            cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+            q = apply_rope(q, cos, sin)
+            # kernel length semantics: valid tokens INCLUDING the first
+            # query token; query i's causal frontier is lengths + 1 + i
+            attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1)
+            attn = attn.reshape(n_slots, w, cfg.num_attention_heads * cfg.head_dim_)
+            attn_out = (
+                attn.astype(dtype)
+                @ layer_params["self_attn"]["o_proj"]["kernel"].astype(dtype)
+            )
+            h2, x = fused_add_rms_norm(
+                x, attn_out, layer_params["post_attention_layernorm"]["scale"],
+                eps=cfg.rms_norm_eps,
+            )
+            gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
+            up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
+            x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
+        else:
+            def to_seq(pool):
+                g = pool[block_tables]  # [S, mb, Hkv, bs, D]
+                g = g.transpose(0, 1, 3, 2, 4)
+                return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
+
+            x = _block_step(cfg, layer_params, x, to_seq(k_pool), to_seq(v_pool),
+                            positions, attend)
+        return (x, i + 1), (k_pool, v_pool)
+
+    (x, _), (k_new, v_new) = jax.lax.scan(
+        layer, (x.astype(dtype), 0), (stacked, cache_k, cache_v)
+    )
+    return _logits_head(p, cfg, x), k_new, v_new
+
+
+@partial(jax.jit, static_argnames=("cfg", "use_kernel"), donate_argnames=("cache",))
+def verify_paged(
+    params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
+    active, use_kernel: bool = False,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """W tokens per slot through the paged pool in ONE forward — the
+    standalone multi-token verify entry (the speculative megastep traces
+    ``_extend_once`` directly; this jit exists for parity tests and
+    host-loop callers). tokens [S, W] land at positions ``lengths ..
+    lengths+W-1`` (the caller must have funded pages for all of them);
+    returns (logits [S, W, V], cache)."""
+    p = params["params"] if "params" in params else params
+    limits = lengths + tokens.shape[1]
+    logits, k_new, v_new = _extend_once(
+        p, cfg, tokens, block_tables, lengths, limits, cache.k, cache.v,
+        active, use_kernel,
     )
     return logits, PagedKVCache(k=k_new, v=v_new)
 

@@ -127,6 +127,24 @@ class PrefixCache:
         self.hit_blocks += len(blocks)
         return node, blocks
 
+    def peek(self, prompt_ids) -> int:
+        """Number of cached blocks :meth:`match` WOULD return for this
+        prompt — no pin, no LRU touch, no hit accounting. The cache-aware
+        admission policy calls this once per waiting request per scheduler
+        tick to order the queue; a read-only probe must not distort
+        eviction recency or the hit-rate stats."""
+        limit = (len(prompt_ids) - 1) // self.block_size
+        node, depth = self.root, 0
+        for i, chunk in enumerate(self._chunks(prompt_ids)):
+            if i >= limit:
+                break
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            node = child
+            depth += 1
+        return depth
+
     def unpin(self, node: Optional[_Node]) -> None:
         """Release a pin taken by :meth:`match` (walks deepest→root)."""
         while node is not None and node is not self.root:

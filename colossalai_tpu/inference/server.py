@@ -221,6 +221,11 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         "prefix_saved_tokens": st.prefix_saved_tokens,
                         "prefix_insertions": st.prefix_insertions,
                         "prefix_evictions": st.prefix_evictions,
+                        "draft_len": engine.draft_len,
+                        "spec_draft_tokens": st.spec_draft_tokens,
+                        "spec_accepted_tokens": st.spec_accepted_tokens,
+                        "spec_target_passes": st.spec_target_passes,
+                        "spec_acceptance_rate": st.spec_acceptance_rate,
                     })
             else:
                 self._json(404, {"error": "not found"})

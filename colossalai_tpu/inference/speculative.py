@@ -6,23 +6,46 @@ variant: output matches target-only greedy decoding exactly whenever the
 two paths' logits agree bitwise (guaranteed on the CPU test mesh; on TPU
 differently-shaped compiled forwards may differ by a ULP at argmax
 near-ties). The win is wall-clock — the target scores a whole K-token
-draft window in ONE fixed-shape forward (``extend_step``) and accepts the
-matching prefix, so ~(accepted+1) tokens emerge per target pass.
+draft window in ONE fixed-shape forward and accepts the matching prefix,
+so ~(accepted+1) tokens emerge per target pass.
 
-Slot-cache rollback is free on TPU: writes land at position ``lengths``
-and reads mask by it, so rejecting draft tokens = decrementing a length.
+Rollback is free in both cache designs: writes land at position
+``lengths`` and reads mask by it, so rejecting draft tokens = decrementing
+a length — in the PAGED pool the pages funded for rejected tokens are
+simply handed back (an O(1) host-side free list push, no device traffic).
+
+Two engines live here:
+
+- :class:`SpeculativeEngine` — the original standalone host loop (single
+  sequence, slot cache, one host sync per target pass); kept as the
+  reference implementation and for its tests;
+- :func:`decode_spec_megastep` — the BATCHED, PAGED, DEVICE-RESIDENT
+  promotion ``LLMEngine(draft_len=...)`` runs: each of the K megastep
+  iterations drafts ``d`` tokens with a small draft model (or a
+  truncated-layer self-draft via :func:`self_draft_params`), verifies all
+  ``d+1`` in ONE multi-token paged forward (``_extend_once`` → the
+  multi-token Pallas paged-attention path under ``use_kernel``), then
+  accepts/commits the matching prefix and samples the correction entirely
+  on device. The host syncs once per megastep, exactly like the plain
+  ``decode_megastep``; greedy output is token-identical to plain greedy
+  for any (K, d), and sampled output preserves the target distribution
+  via standard rejection + leftover sampling over the SAME filtered
+  per-slot distributions ``sample_tokens`` uses.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .kv_cache import PagedKVCache
 from .modeling import KVCache, decode_step, extend_step, init_cache, prefill
+from .paged_modeling import _extend_once, filter_logits
 
 
 @dataclasses.dataclass
@@ -148,3 +171,238 @@ class SpeculativeEngine:
                 break
 
         return out[:max_new_tokens]
+
+
+# --------------------------------------------------------------------------
+# Batched, paged, device-resident speculative decoding (LLMEngine draft_len=)
+# --------------------------------------------------------------------------
+
+
+def self_draft_params(params, cfg, n_layers: int):
+    """Truncated-layer SELF-DRAFT: a draft model that is the target's first
+    ``n_layers`` decoder blocks plus the target's own embedding / final
+    norm / lm head (≙ GlideDrafter's shared-trunk drafter, zero extra
+    weights). Returns ``(draft_params, draft_cfg)`` — the param leaves are
+    SLICES/ALIASES of the target's (no copy); ``draft_cfg`` is the target
+    config with ``num_hidden_layers=n_layers``."""
+    if not 1 <= n_layers <= cfg.num_hidden_layers:
+        raise ValueError(
+            f"self_draft_layers={n_layers} must be in [1, "
+            f"{cfg.num_hidden_layers}] (the target's layer count)"
+        )
+    wrapped = "params" in params
+    p = params["params"] if wrapped else params
+    dp = dict(p)  # shallow: embed/norm/lm_head leaves are shared
+    dp["layers"] = {
+        "block": jax.tree.map(lambda x: x[:n_layers], p["layers"]["block"])
+    }
+    dcfg = dataclasses.replace(cfg, num_hidden_layers=n_layers)
+    return ({"params": dp} if wrapped else dp), dcfg
+
+
+def spec_megastep_loop(
+    target_extend, draft_extend, tokens, lengths, cache: PagedKVCache,
+    draft_cache: PagedKVCache, active, budgets, eos_ids, temp, topk, topp,
+    do_sample, rng_keys, k_steps: int, draft_len: int, use_sampling: bool,
+):
+    """The speculative megastep's per-iteration bookkeeping around a pair
+    of extend callables (must be called under jit; traces a fori_loop):
+
+    - ``draft_extend(tokens [S, W'], lens, limits, ck, cv, alive)`` →
+      ``(logits [S, W', V], ck, cv)`` over the DRAFT pool;
+    - ``target_extend(...)`` — same signature over the target pool.
+
+    Each of the ``k_steps`` iterations: (1) ``d`` sequential single-token
+    draft decodes propose d tokens (plus one extra decode that back-fills
+    the draft cache with its own last proposal — the full-acceptance hole
+    the host-loop engine patches after the fact); (2) ONE (d+1)-token
+    target forward scores the window ``[last_committed, d_1..d_d]``;
+    (3) the matching prefix commits and the correction token is drawn on
+    device — greedy: first argmax mismatch; sampled: standard rejection
+    sampling (accept d_i with prob min(1, p_i/q_i)) with the correction
+    from the leftover distribution ``normalize(max(p - q, 0))`` (the bonus
+    token from ``p_{d+1}`` when everything was accepted), over the SAME
+    filtered distributions ``sample_tokens`` uses, so the output
+    distribution equals the target's. Rollback is implicit: lengths
+    advance by the accepted count only, and positions past the per-slot
+    funded ``limit`` redirect writes to the null page.
+
+    Per-slot [S] device inputs mirror :func:`~.paged_modeling
+    .megastep_loop`; returns ``(buf [S, k_steps*(d+1)] emitted ids (-1 =
+    nothing), emitted [S], alive [S], tokens, lengths, budgets, cache,
+    draft_cache, target_passes [S], drafted [S], accepted [S])`` — the
+    last three are per-slot speculative counters accumulated on device and
+    fetched in the megastep's single host sync."""
+    n_slots = tokens.shape[0]
+    d = draft_len
+    w = d + 1
+    width = k_steps * w
+    iota_w = jnp.arange(w)[None, :]
+    rows = jnp.arange(n_slots)
+    buf0 = jnp.full((n_slots, width), -1, jnp.int32)
+    zeros = jnp.zeros((n_slots,), jnp.int32)
+    # the funded frontier: the scheduler reserved pages for exactly
+    # min(k*(d+1), max(budget, 1)) tokens past the entry lengths (the
+    # device budget mirrors the host's _budget_left at megastep entry)
+    limits = lengths + jnp.minimum(width, jnp.maximum(budgets, 1))
+
+    def body(j, carry):
+        (ck, cv, dk, dv, tok, lens, alive, budg, buf, emitted,
+         passes, drafted, accepted) = carry
+        key = rng_keys[j]
+
+        # ---- draft phase: d sequential proposals + the hole-fix decode
+        drafts = []
+        q_list = []
+        t = tok
+        for i in range(d):
+            dlog, dk, dv = draft_extend(t[:, None], lens + i, limits, dk, dv, alive)
+            dlog = dlog[:, 0]
+            if use_sampling:
+                dmask = filter_logits(dlog, temp, topk, topp)
+                di = jnp.where(
+                    do_sample,
+                    jax.random.categorical(jax.random.fold_in(key, i), dmask),
+                    jnp.argmax(dlog, axis=-1),
+                ).astype(jnp.int32)
+                q_list.append(jax.nn.softmax(dmask, axis=-1))
+            else:
+                di = jnp.argmax(dlog, axis=-1).astype(jnp.int32)
+            drafts.append(di)
+            t = di
+        # back-fill d_d's K/V so a full acceptance leaves no hole at
+        # position lens + d (when a < d the garbage is re-fed next round
+        # before anything reads it); logits discarded
+        _, dk, dv = draft_extend(t[:, None], lens + d, limits, dk, dv, alive)
+        drafts_arr = jnp.stack(drafts, axis=1)  # [S, d]
+
+        # ---- verify: ONE multi-token forward over [t0, d_1 .. d_d]
+        window = jnp.concatenate([tok[:, None], drafts_arr], axis=1)  # [S, W]
+        vlog, ck, cv = target_extend(window, lens, limits, ck, cv, alive)
+        tgt = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [S, W]
+
+        # ---- acceptance: longest matching prefix + correction token
+        match_g = (tgt[:, :d] == drafts_arr).astype(jnp.int32)
+        a_greedy = jnp.sum(jnp.cumprod(match_g, axis=1), axis=1)  # [S]
+        if use_sampling:
+            vocab = vlog.shape[-1]
+            pmask = filter_logits(
+                vlog.reshape(n_slots * w, vocab),
+                jnp.repeat(temp, w), jnp.repeat(topk, w), jnp.repeat(topp, w),
+            )
+            p_probs = jax.nn.softmax(pmask, axis=-1).reshape(n_slots, w, vocab)
+            q_probs = jnp.stack(q_list, axis=1)  # [S, d, V]
+            p_draft = jnp.take_along_axis(
+                p_probs[:, :d], drafts_arr[..., None], axis=-1)[..., 0]
+            q_draft = jnp.take_along_axis(
+                q_probs, drafts_arr[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(jax.random.fold_in(key, d), (n_slots, d))
+            # accept d_i with prob min(1, p_i/q_i): u*q <= p (q(d_i) > 0
+            # a.s. — d_i was drawn from q)
+            ok = (u * q_draft <= p_draft).astype(jnp.int32)
+            a_sample = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+            a = jnp.where(do_sample, a_sample, a_greedy)
+            # correction ~ normalize(max(p_a - q_a, 0)); padding q with a
+            # zero layer at index d makes the full-acceptance bonus (draw
+            # straight from p_d) the same gather-and-subtract
+            q_pad = jnp.concatenate(
+                [q_probs, jnp.zeros((n_slots, 1, vocab), q_probs.dtype)], axis=1)
+            p_at_a = jnp.take_along_axis(p_probs, a[:, None, None], axis=1)[:, 0]
+            q_at_a = jnp.take_along_axis(q_pad, a[:, None, None], axis=1)[:, 0]
+            left = jnp.maximum(p_at_a - q_at_a, 0.0)
+            # numerical guard: a rejection with p == q everywhere has
+            # probability 0, but a degenerate all-zero leftover must not
+            # produce NaNs — fall back to p itself
+            degenerate = jnp.sum(left, axis=-1, keepdims=True) <= 1e-9
+            left = jnp.where(degenerate, p_at_a, left)
+            c_sample = jax.random.categorical(
+                jax.random.fold_in(key, d + 1), jnp.log(left + 1e-30))
+            c_greedy = jnp.take_along_axis(tgt, a_greedy[:, None], axis=1)[:, 0]
+            c = jnp.where(do_sample, c_sample, c_greedy).astype(jnp.int32)
+        else:
+            a = a_greedy
+            c = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
+
+        # emit[i] = accepted draft for i < a, the correction at i == a
+        # (entries past a repeat c — never emitted)
+        emit = jnp.where(
+            iota_w < a[:, None],
+            jnp.concatenate([drafts_arr, zeros[:, None]], axis=1),
+            c[:, None],
+        )
+
+        # ---- emission: budget + first-eos cut, buffer commit
+        has_eos = (eos_ids[:, None] >= 0) & (emit == eos_ids[:, None])
+        eos_idx = jnp.min(jnp.where(has_eos, iota_w, w), axis=1)
+        e = jnp.minimum(jnp.minimum(a + 1, eos_idx + 1), jnp.maximum(budg, 0))
+        e = jnp.where(alive, e, 0)
+        for i in range(w):
+            col = jnp.clip(emitted + i, 0, width - 1)
+            wr = (i < e)
+            buf = buf.at[rows, col].set(
+                jnp.where(wr, emit[:, i], buf[rows, col]))
+
+        # ---- advance device state + speculative counters
+        passes = passes + alive.astype(jnp.int32)
+        drafted = drafted + jnp.where(alive, d, 0)
+        accepted = accepted + jnp.minimum(e, a)
+        last = jnp.take_along_axis(
+            emit, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+        tok = jnp.where(e > 0, last, tok)
+        emitted = emitted + e
+        lens = lens + e
+        budg = budg - e
+        stopped = eos_idx < e  # an emitted token was eos
+        alive = alive & ~stopped & (budg > 0)
+        return (ck, cv, dk, dv, tok, lens, alive, budg, buf, emitted,
+                passes, drafted, accepted)
+
+    init = (cache.k, cache.v, draft_cache.k, draft_cache.v, tokens, lengths,
+            active, budgets, buf0, zeros, zeros, zeros, zeros)
+    (ck, cv, dk, dv, tok, lens, alive, budg, buf, emitted,
+     passes, drafted, accepted) = jax.lax.fori_loop(0, k_steps, body, init)
+    return (buf, emitted, alive, tok, lens, budg,
+            PagedKVCache(k=ck, v=cv), PagedKVCache(k=dk, v=dv),
+            passes, drafted, accepted)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "k_steps", "draft_len",
+                     "use_kernel", "use_sampling"),
+    donate_argnames=("cache", "draft_cache"),
+)
+def decode_spec_megastep(
+    params, draft_params, cfg, draft_cfg, tokens, block_tables, lengths,
+    cache: PagedKVCache, draft_cache: PagedKVCache, active, budgets, eos_ids,
+    temp, topk, topp, do_sample, rng_keys, k_steps: int, draft_len: int,
+    use_kernel: bool = False, use_sampling: bool = False,
+):
+    """Device-resident SPECULATIVE decode megastep over the paged pool —
+    ``decode_megastep`` with a draft/verify inner loop: per iteration the
+    draft model proposes ``draft_len`` tokens (sequential single-token
+    decodes over its own pool, which shares the target's block tables),
+    the target verifies all ``draft_len+1`` in one multi-token paged
+    forward, and the matching prefix + correction commit on device. ONE
+    dispatch and ONE host sync per megastep; see :func:`spec_megastep_loop`
+    for inputs/outputs."""
+    if draft_len < 1:
+        raise ValueError(f"draft_len={draft_len} must be >= 1 here "
+                         "(draft_len=0 is the plain decode_megastep)")
+    p = params["params"] if "params" in params else params
+    dp = draft_params["params"] if "params" in draft_params else draft_params
+
+    def target_extend(toks, lens, limits, ck, cv, alive):
+        return _extend_once(
+            p, cfg, toks, block_tables, lens, limits, ck, cv, alive, use_kernel)
+
+    def draft_extend(toks, lens, limits, ck, cv, alive):
+        return _extend_once(
+            dp, draft_cfg, toks, block_tables, lens, limits, ck, cv, alive,
+            use_kernel)
+
+    return spec_megastep_loop(
+        target_extend, draft_extend, tokens, lengths, cache, draft_cache,
+        active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
+        k_steps, draft_len, use_sampling,
+    )

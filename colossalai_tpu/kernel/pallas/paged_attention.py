@@ -15,11 +15,19 @@ Layout: q [S, H, D] (grouped per kv head in-kernel), pool
 [n_blocks, Hkv, block_size, D], tables [S, max_blocks], lengths [S].
 Online-softmax accumulation across a sequence's pages (flash-decoding).
 
+MULTI-TOKEN queries (q [S, W, H, D]) serve the speculative verify pass and
+chunk-sized megastep decodes: the W query tokens of a slot sit at positions
+``lengths-1 .. lengths-1+W-1`` and are folded into the head-group dimension
+of the SAME grid (one pass over the pages scores the whole window), with a
+per-row causal limit inside the page tile — query w sees ``pos <
+lengths + w``. W=1 degenerates bit-for-bit to the classic decode kernel.
+
 ``heads_per_step`` — how many KV heads one grid step processes — trades
 per-step overhead against VMEM working set and pipeline overlap; it is the
 knob the persistent tuning cache (``kernel.tuning``) measures per
-(chip, head-geometry, page-size, dtype) key. The default (all heads per
-step, a single head-group grid index) reproduces the original kernel.
+(chip, head-geometry, page-size, dtype, query-window) key.  The default
+(all heads per step, a single head-group grid index) reproduces the
+original kernel.
 """
 
 from __future__ import annotations
@@ -39,10 +47,12 @@ _MASK_FILL = _mask_value(jnp.float32)
 
 
 def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
-            scale, block_size, max_blocks, hps):
+            scale, block_size, max_blocks, hps, group, w):
     """Grid (slots, head-groups, pages); ``hps`` kv heads per step (static
     loop) — per-step overhead, not MXU work, dominates single-token
-    decode."""
+    decode. Each kv head's q tile has ``w * group`` rows: row r belongs to
+    query token ``r // group``, whose causal frontier is ``length + r //
+    group`` (``length`` counts valid tokens INCLUDING the first query)."""
     s = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -53,19 +63,22 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
         l[:] = jnp.zeros_like(l)
 
     length = len_ref[s]
-    needed = j * block_size < length
+    # a page is needed if ANY query row reaches into it — the deepest
+    # frontier is the last query's: pos < length + (w - 1)
+    needed = j * block_size < length + (w - 1)
 
     @pl.when(needed)
     def _compute():
         for hh in range(hps):
-            q = q_ref[0, hh]  # [G, D]
+            q = q_ref[0, hh]  # [W*G, D]
             k = k_ref[0, hh]  # [block_size, D]
             v = v_ref[0, hh]
             sc = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            ) * scale  # [G, block_size]
+            ) * scale  # [W*G, block_size]
             pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-            in_len = pos < length
+            row_w = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0) // group
+            in_len = pos < length + row_w
             sc = jnp.where(in_len, sc, _MASK_FILL)
 
             m_prev = m[hh]
@@ -85,7 +98,8 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
 
 
-def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype) -> int:
+def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype,
+                          qlen=1) -> int:
     from .. import tuning
 
     if not tuning.tuning_enabled():
@@ -93,71 +107,87 @@ def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype) -> int:
 
     def measure(hps):
         n_slots = 8
-        q = jnp.zeros((n_slots, hkv * group, d), dtype)
+        if qlen > 1:
+            q = jnp.zeros((n_slots, qlen, hkv * group, d), dtype)
+        else:
+            q = jnp.zeros((n_slots, hkv * group, d), dtype)
         pool = jnp.zeros((max_blocks, hkv, block_size, d), dtype)
         bt = jnp.broadcast_to(
             jnp.arange(max_blocks, dtype=jnp.int32)[None], (n_slots, max_blocks))
-        ln = jnp.full((n_slots,), max_blocks * block_size, jnp.int32)
+        ln = jnp.full((n_slots,), max_blocks * block_size - (qlen - 1), jnp.int32)
         fn = jax.jit(functools.partial(paged_attention, heads_per_step=hps))
         return tuning.time_fn(fn, q, pool, pool, bt, ln)
 
     try:
         return tuning.paged_heads_per_step(
-            hkv, group, d, block_size, dtype, measure)
+            hkv, group, d, block_size, dtype, measure, qlen=qlen)
     except Exception:  # never let tuning break the hot path
         return hkv
 
 
 def paged_attention(
-    q: jax.Array,            # [S, H, D] one token per slot
+    q: jax.Array,            # [S, H, D] one token per slot, or [S, W, H, D]
     k_pool: jax.Array,       # [n_blocks, Hkv, block_size, D]
     v_pool: jax.Array,
     block_tables: jax.Array,  # [S, max_blocks] int32
-    lengths: jax.Array,       # [S] valid tokens INCLUDING the new one
+    lengths: jax.Array,       # [S] valid tokens INCLUDING the first query
     *,
     softmax_scale: float | None = None,
     heads_per_step: int | None = None,
 ) -> jax.Array:
-    """Returns [S, H, D]. ``heads_per_step`` must divide Hkv; ``None``
-    consults the tuning cache on TPU (all heads per step elsewhere)."""
-    n_slots, h, d = q.shape
+    """Returns [S, H, D] (or [S, W, H, D] for a multi-token window, whose
+    query w sits at position ``lengths - 1 + w``). ``heads_per_step`` must
+    divide Hkv; ``None`` consults the tuning cache on TPU (all heads per
+    step elsewhere)."""
+    multi = q.ndim == 4
+    if not multi:
+        q = q[:, None]
+    n_slots, w, h, d = q.shape
     _, hkv, block_size, _ = k_pool.shape
     group = h // hkv
     max_blocks = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     if heads_per_step is None:
         heads_per_step = _tuned_heads_per_step(
-            hkv, group, d, block_size, max_blocks, q.dtype)
+            hkv, group, d, block_size, max_blocks, q.dtype, qlen=w)
     hps = heads_per_step
     if hkv % hps:
         raise ValueError(f"heads_per_step={hps} must divide Hkv={hkv}")
     n_hgroups = hkv // hps
+    rows = w * group
 
-    qg = q.reshape(n_slots, hkv, group, d)
+    # fold the query window into the per-kv-head row dim: [S, Hkv, W*G, D]
+    # with rows ordered query-major (row r ↔ query r // group) so the
+    # kernel recovers the causal frontier from the row index alone
+    qg = (q.reshape(n_slots, w, hkv, group, d)
+          .transpose(0, 2, 1, 3, 4)
+          .reshape(n_slots, hkv, rows, d))
 
     def page_map(s, hg, j, bt, ln):
-        # clamp to the last REAL page: steps past a sequence's length keep
-        # the previous origin, so Mosaic never re-fetches for skipped pages
-        last = jnp.maximum((ln[s] + block_size - 1) // block_size - 1, 0)
+        # clamp to the last REAL page (of the deepest query's frontier):
+        # steps past it keep the previous origin, so Mosaic never
+        # re-fetches for skipped pages
+        last = jnp.maximum(
+            (ln[s] + (w - 1) + block_size - 1) // block_size - 1, 0)
         return (bt[s, jnp.minimum(j, last)], hg, 0, 0)
 
     kernel = functools.partial(
         _kernel, scale=scale, block_size=block_size, max_blocks=max_blocks,
-        hps=hps,
+        hps=hps, group=group, w=w,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_slots, n_hgroups, max_blocks),
         in_specs=[
-            pl.BlockSpec((1, hps, group, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
+            pl.BlockSpec((1, hps, rows, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
             pl.BlockSpec((1, hps, block_size, d), page_map),
             pl.BlockSpec((1, hps, block_size, d), page_map),
         ],
-        out_specs=pl.BlockSpec((1, hps, group, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
+        out_specs=pl.BlockSpec((1, hps, rows, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((hps, group, d), jnp.float32),
-            pltpu.VMEM((hps, group, 1), jnp.float32),
-            pltpu.VMEM((hps, group, 1), jnp.float32),
+            pltpu.VMEM((hps, rows, d), jnp.float32),
+            pltpu.VMEM((hps, rows, 1), jnp.float32),
+            pltpu.VMEM((hps, rows, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -166,4 +196,7 @@ def paged_attention(
         out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
-    return out.reshape(n_slots, h, d)
+    out = (out.reshape(n_slots, hkv, w, group, d)
+           .transpose(0, 2, 1, 3, 4)
+           .reshape(n_slots, w, h, d))
+    return out if multi else out[:, 0]

@@ -292,18 +292,21 @@ def norm_rows(
 
 def paged_heads_per_step(
     hkv: int, group: int, d: int, block_size: int, dtype,
-    measure: Callable[[int], float],
+    measure: Callable[[int], float], qlen: int = 1,
 ) -> int:
     """KV-heads processed per grid step in the paged decode kernel: all
     heads (fewest grid steps, current default) vs smaller groups (smaller
-    VMEM working set, more pipeline overlap)."""
+    VMEM working set, more pipeline overlap). ``qlen`` is the query window
+    width — 1 for plain decode, draft_len+1 for the speculative verify
+    pass — a separate key because the q tile (and the profitable tiling)
+    scales with it."""
     cands = sorted({h for h in (hkv, max(hkv // 2, 1), 1) if hkv % h == 0},
                    reverse=True)
     if len(cands) == 1:
         return hkv
     return get_tuner().tune(
         "paged_attention",
-        (device_kind(), hkv, group, d, block_size, _dt(dtype)),
+        (device_kind(), hkv, group, d, block_size, _dt(dtype), qlen),
         cands, measure, hkv,
     )
 

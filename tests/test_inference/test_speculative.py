@@ -80,3 +80,339 @@ def test_self_draft_accepts_everything(models):
     assert out == ref
     assert spec.stats.acceptance_rate == 1.0
     assert spec.stats.tokens_per_target_pass == pytest.approx(5.0, abs=1.0)
+
+
+# --------------------------------------------------------------------------
+# Device-resident speculative megastep (LLMEngine draft_len=) — the paged,
+# batched promotion of the host loop above
+# --------------------------------------------------------------------------
+
+import dataclasses
+
+from colossalai_tpu.inference import (
+    GenerationConfig,
+    LLMEngine,
+    decode_paged,
+    init_paged_cache,
+    self_draft_params,
+    verify_paged,
+)
+
+
+@pytest.fixture(scope="module")
+def f32_models():
+    """float32 target + 1-layer independent draft: the paged verify path's
+    W=1 math is op-identical to plain decode, so on CPU f32 the engine
+    identity below is exact, not approximate."""
+    tc = LlamaConfig.tiny(dtype=jnp.float32)
+    dc = dataclasses.replace(tc, num_hidden_layers=1)
+    ids = jnp.ones((1, 8), jnp.int32)
+    tp = LlamaForCausalLM(tc).init(jax.random.PRNGKey(0), ids)
+    dp = LlamaForCausalLM(dc).init(jax.random.PRNGKey(7), ids)
+    return tp, tc, dp, dc
+
+
+PROMPTS = [
+    [3, 14, 15, 9, 2, 6],
+    list(range(40, 59)),                  # crosses a block boundary
+    [5] * 33,                             # > 2 blocks, degenerate content
+]
+
+
+def _engine(tp, tc, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("seed", 0)
+    return LLMEngine(tp, tc, **kw)
+
+
+@pytest.fixture(scope="module")
+def plain_greedy(f32_models):
+    tp, tc, _, _ = f32_models
+    return _engine(tp, tc).generate(PROMPTS, GenerationConfig(max_new_tokens=24))
+
+
+def test_verify_paged_matches_sequential_decode(f32_models):
+    """The multi-token verify forward is BITWISE the same computation as W
+    sequential single-token decodes on CPU f32 — logits and written KV."""
+    tp, tc, _, _ = f32_models
+    bs, w = 16, 3
+    toks = np.array([[7, 21, 3], [11, 11, 11]], np.int32)
+    tables = np.zeros((2, 8), np.int32)
+    tables[0, :2] = [1, 2]
+    tables[1, :2] = [3, 4]
+    lengths = np.array([5, 16], np.int32)  # slot 1 starts at a page edge
+    active = np.array([True, True])
+
+    seq_cache = init_paged_cache(tc, 16, bs, dtype=jnp.float32)
+    seq_logits = []
+    for i in range(w):
+        lg, seq_cache = decode_paged(
+            tp, tc, jnp.asarray(toks[:, i]), jnp.asarray(tables),
+            jnp.asarray(lengths + i), seq_cache, jnp.asarray(active))
+        seq_logits.append(lg)
+
+    ver_cache = init_paged_cache(tc, 16, bs, dtype=jnp.float32)
+    ver_logits, ver_cache = verify_paged(
+        tp, tc, jnp.asarray(toks), jnp.asarray(tables), jnp.asarray(lengths),
+        ver_cache, jnp.asarray(active))
+
+    for i in range(w):
+        np.testing.assert_array_equal(
+            np.asarray(ver_logits[:, i]), np.asarray(seq_logits[i]))
+    np.testing.assert_array_equal(np.asarray(ver_cache.k), np.asarray(seq_cache.k))
+    np.testing.assert_array_equal(np.asarray(ver_cache.v), np.asarray(seq_cache.v))
+
+
+def test_multi_token_paged_kernel_matches_reference():
+    """query_len > 1 Pallas path (interpret mode on CPU) vs a dense gather
+    reference with per-row causal masking; the 3D q path must be exactly
+    the 4D path's first row."""
+    from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
+
+    rng = np.random.RandomState(0)
+    S, W, H, Hkv, D, bs, nb, mb = 3, 4, 8, 2, 128, 16, 24, 6
+    q = jnp.asarray(rng.randn(S, W, H, D), jnp.float32)
+    k_pool = jnp.asarray(rng.randn(nb, Hkv, bs, D), jnp.float32)
+    v_pool = jnp.asarray(rng.randn(nb, Hkv, bs, D), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, nb))[: S * mb].reshape(S, mb), jnp.int32)
+    lengths = jnp.asarray([5, bs * 2, bs * mb - W + 1], jnp.int32)
+
+    out = paged_attention(q, k_pool, v_pool, tables, lengths)
+    assert out.shape == (S, W, H, D)
+
+    # dense reference: gather each slot's pages, per-query causal mask
+    scale = D ** -0.5
+    g = H // Hkv
+    ref = np.zeros((S, W, H, D), np.float32)
+    for s in range(S):
+        ks = np.asarray(k_pool)[np.asarray(tables)[s]].transpose(1, 0, 2, 3)
+        ks = ks.reshape(Hkv, mb * bs, D)
+        vs = np.asarray(v_pool)[np.asarray(tables)[s]].transpose(1, 0, 2, 3)
+        vs = vs.reshape(Hkv, mb * bs, D)
+        for w_i in range(W):
+            n_vis = int(lengths[s]) + w_i  # query w sees pos < lengths + w
+            for h in range(H):
+                sc = (np.asarray(q)[s, w_i, h] @ ks[h // g, :n_vis].T) * scale
+                p = np.exp(sc - sc.max())
+                p /= p.sum()
+                ref[s, w_i, h] = p @ vs[h // g, :n_vis]
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=1e-5)
+
+    out1 = paged_attention(q[:, 0], k_pool, v_pool, tables, lengths)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out[:, 0]))
+
+
+@pytest.mark.parametrize("k,d,variant", [
+    (1, 2, None),
+    (3, 1, None),
+    (3, 4, None),
+    (3, 2, "prefix"),
+    (3, 2, "chunk"),
+])
+def test_engine_spec_greedy_identity(f32_models, plain_greedy, k, d, variant):
+    """Greedy speculative output == plain greedy output for any (megastep_k,
+    draft_len), including with the prefix cache and chunked prefill on —
+    the draft only ever changes speed, never content."""
+    tp, tc, dp, dc = f32_models
+    kw = {}
+    if variant == "prefix":
+        kw["prefix_cache"] = True
+    elif variant == "chunk":
+        kw["prefill_chunk"] = 16
+    eng = _engine(tp, tc, megastep_k=k, draft_len=d,
+                  draft_params=dp, draft_config=dc, **kw)
+    out = eng.generate(PROMPTS, GenerationConfig(max_new_tokens=24))
+    assert out == plain_greedy, (k, d, variant)
+    st = eng.stats
+    assert st.spec_target_passes > 0
+    assert st.spec_draft_tokens == st.spec_target_passes * d
+    assert 0 <= st.spec_accepted_tokens <= st.spec_draft_tokens
+
+
+def test_engine_self_draft_full_layers_accepts_all(f32_models, plain_greedy):
+    """self_draft_layers == all layers makes the draft the target: every
+    proposal must be accepted (the verify path scoring its own argmaxes),
+    which pins the whole accept/commit/rollback machinery."""
+    tp, tc, _, _ = f32_models
+    eng = _engine(tp, tc, megastep_k=2, draft_len=3,
+                  self_draft_layers=tc.num_hidden_layers)
+    out = eng.generate(PROMPTS, GenerationConfig(max_new_tokens=24))
+    assert out == plain_greedy
+    assert eng.stats.spec_acceptance_rate == 1.0
+
+
+def test_engine_spec_truncated_self_draft_identity(f32_models, plain_greedy):
+    tp, tc, _, _ = f32_models
+    eng = _engine(tp, tc, megastep_k=2, draft_len=2, self_draft_layers=1)
+    out = eng.generate(PROMPTS, GenerationConfig(max_new_tokens=24))
+    assert out == plain_greedy
+
+
+def test_engine_spec_sampled_topk1_matches_greedy(f32_models, plain_greedy):
+    """top_k=1 sampling is deterministic: rejection sampling over the
+    filtered one-hot distributions must reproduce plain greedy exactly —
+    the distribution-preservation smoke that needs no statistics."""
+    tp, tc, dp, dc = f32_models
+    eng = _engine(tp, tc, megastep_k=2, draft_len=2,
+                  draft_params=dp, draft_config=dc)
+    gen = GenerationConfig(max_new_tokens=24, do_sample=True, top_k=1)
+    out = eng.generate(PROMPTS, gen)
+    assert out == plain_greedy
+
+
+def test_engine_spec_sampled_smoke(f32_models):
+    """Free sampling with an independent (bad) draft: every emitted token
+    must be a valid vocab id and the requested lengths must be respected;
+    acceptance stays sane."""
+    tp, tc, dp, dc = f32_models
+    eng = _engine(tp, tc, megastep_k=2, draft_len=2,
+                  draft_params=dp, draft_config=dc)
+    gen = GenerationConfig(max_new_tokens=16, do_sample=True, temperature=0.9)
+    out = eng.generate(PROMPTS, gen)
+    for o in out:
+        assert len(o) == 16
+        assert all(0 <= t < tc.vocab_size for t in o)
+    st = eng.stats
+    assert st.spec_target_passes > 0
+    assert 0 <= st.spec_accepted_tokens <= st.spec_draft_tokens
+
+
+def test_spec_rollback_refunds_pages(f32_models, plain_greedy):
+    """Rejected draft tokens' pages go back to the free list each megastep
+    (length decrement + O(1) refund): mid-flight no slot holds more pages
+    than its committed length needs, and with the prefix cache on the
+    end-state accounting (free + cached + null) covers the whole pool."""
+    tp, tc, dp, dc = f32_models
+    eng = _engine(tp, tc, megastep_k=2, draft_len=4,
+                  draft_params=dp, draft_config=dc, prefix_cache=True)
+    gen = GenerationConfig(max_new_tokens=24)
+    for p in PROMPTS:
+        eng.add_request(p, gen)
+    saw_decode = False
+    while eng.has_work:
+        eng.step()
+        for slot, req in eng.running.items():
+            assert len(req.table.blocks) == \
+                eng.allocator.blocks_needed(req.table.length), \
+                "unfunded-refund invariant broken mid-flight"
+            saw_decode = True
+    assert saw_decode
+    nb = eng.allocator.num_blocks
+    assert eng.allocator.num_free + len(eng.prefix_cache) == nb - 1
+    # every cached page holds exactly the tree's ref; re-running the same
+    # prompts (warm hits over fork-shared pages) must change nothing
+    out2 = eng.generate(PROMPTS, gen)
+    assert out2 == plain_greedy
+    assert eng.stats.prefix_hit_blocks > 0
+    assert eng.allocator.num_free + len(eng.prefix_cache) == nb - 1
+
+
+def test_engine_spec_transfer_accounting(f32_models):
+    """The megastep contract survives speculation: ONE host sync per
+    megastep (not per drafted/verified token) and the spec counters ride
+    the same fetch; with draft_len=0 they stay zero."""
+    tp, tc, dp, dc = f32_models
+    gen = GenerationConfig(max_new_tokens=12)
+    eng = _engine(tp, tc, megastep_k=3, draft_len=2,
+                  draft_params=dp, draft_config=dc)
+    eng.generate(PROMPTS[:1], gen)
+    st = eng.stats
+    assert st.decode_syncs == st.decode_megasteps > 0
+    # each megastep fetches buf + emitted + alive + 3 spec counters; the
+    # per-megastep fetch size is independent of how many tokens committed
+    per = st.decode_d2h_elements / st.decode_syncs
+    mb = eng.max_batch
+    width = 3 * (2 + 1)
+    assert per == mb * width + 5 * mb
+    assert st.spec_target_passes > 0
+
+    plain = _engine(tp, tc, megastep_k=3)
+    plain.generate(PROMPTS[:1], gen)
+    assert plain.stats.spec_draft_tokens == 0
+    assert plain.stats.spec_accepted_tokens == 0
+    assert plain.stats.spec_target_passes == 0
+    assert plain.stats.decode_syncs == plain.stats.decode_megasteps > 0
+
+
+def test_cache_aware_policy_prefers_warm_requests(f32_models):
+    """scheduler_policy='cache_aware': under slot pressure the request with
+    the deepest prefix-cache hit is admitted first, FIFO otherwise."""
+    tp, tc, _, _ = f32_models
+    eng = _engine(tp, tc, max_batch_size=1, prefix_cache=True,
+                  scheduler_policy="cache_aware")
+    warm_prompt = list(range(10, 45))   # 2 full blocks cacheable
+    cold_prompt = list(range(60, 95))
+    gen = GenerationConfig(max_new_tokens=4)
+    eng.generate([warm_prompt], gen)    # donates warm_prompt's pages
+    cold_id = eng.add_request(cold_prompt, gen)
+    warm_id = eng.add_request(warm_prompt, gen)  # arrives LATER
+    eng.step()
+    running = list(eng.running.values()) + list(eng.prefilling.values())
+    assert len(running) == 1
+    assert running[0].request_id == warm_id, "warm request should jump the queue"
+    # drain; the cold request must still complete (no starvation in this
+    # two-request scenario: once the warm one finishes the cold admits)
+    done = []
+    while eng.has_work:
+        done += [r.request_id for r in eng.step()]
+    assert set(done) == {cold_id, warm_id}
+
+
+def test_cache_aware_policy_requires_prefix_cache(f32_models):
+    tp, tc, _, _ = f32_models
+    with pytest.raises(ValueError, match="cache_aware"):
+        _engine(tp, tc, scheduler_policy="cache_aware")
+
+
+def test_prefix_cache_peek_is_read_only(f32_models):
+    """peek() must report match depth without pinning or LRU-touching."""
+    from colossalai_tpu.inference import PrefixCache
+
+    tp, tc, _, _ = f32_models
+    eng = _engine(tp, tc, prefix_cache=True)
+    prompt = list(range(10, 45))
+    eng.generate([prompt], GenerationConfig(max_new_tokens=4))
+    pc = eng.prefix_cache
+    hits_before = pc.hit_blocks
+    tick_before = pc._tick
+    assert pc.peek(prompt) == len(prompt[:-1]) // eng.block_size
+    assert pc.peek(list(range(200, 210))) == 0
+    assert pc.hit_blocks == hits_before
+    assert pc._tick == tick_before
+
+
+def test_spec_constructor_validation(f32_models):
+    tp, tc, dp, dc = f32_models
+    with pytest.raises(ValueError, match="draft_len=0"):
+        _engine(tp, tc, draft_params=dp, draft_config=dc)
+    with pytest.raises(ValueError, match="draft_config"):
+        _engine(tp, tc, draft_len=2, draft_params=dp)
+    with pytest.raises(ValueError, match="EITHER"):
+        _engine(tp, tc, draft_len=2, draft_params=dp, draft_config=dc,
+                self_draft_layers=1)
+    with pytest.raises(ValueError, match="needs a draft"):
+        _engine(tp, tc, draft_len=2)
+    with pytest.raises(ValueError, match="self_draft_layers"):
+        _engine(tp, tc, draft_len=2, self_draft_layers=99)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = dataclasses.replace(dc, vocab_size=dc.vocab_size * 2)
+        _engine(tp, tc, draft_len=2, draft_params=dp, draft_config=bad)
+
+
+def test_self_draft_params_shares_leaves(f32_models):
+    """The self-draft is slices/aliases of the target's tree — same embed
+    object, first-n layer slices — plus a layer-truncated config."""
+    tp, tc, _, _ = f32_models
+    dp, dc = self_draft_params(tp, tc, 1)
+    assert dc.num_hidden_layers == 1
+    assert dc.vocab_size == tc.vocab_size
+    t = tp["params"] if "params" in tp else tp
+    d = dp["params"] if "params" in dp else dp
+    assert d["embed_tokens"]["embedding"] is t["embed_tokens"]["embedding"]
+    tgt_leaf = jax.tree.leaves(t["layers"]["block"])[0]
+    dr_leaf = jax.tree.leaves(d["layers"]["block"])[0]
+    assert dr_leaf.shape[0] == 1 and tgt_leaf.shape[0] == tc.num_hidden_layers
+    np.testing.assert_array_equal(np.asarray(dr_leaf[0]), np.asarray(tgt_leaf[0]))

@@ -107,3 +107,27 @@ def test_stats_shape():
                 "bypassed", "chosen"):
         assert key in s
     json.dumps(s)  # bench.py embeds this verbatim in its JSON line
+
+
+def test_paged_heads_per_step_keys_on_query_window(tmp_path, monkeypatch):
+    """The speculative verify pass tunes separately from plain decode: the
+    paged-attention key must include the query window width, so qlen=1 and
+    qlen=d+1 get independent measurements (the q tile scales with qlen)."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    def measure(hps):
+        return {4: 0.003, 2: 0.001, 1: 0.002}[hps]
+
+    got1 = tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure)
+    gotw = tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure, qlen=4)
+    assert got1 == gotw == 2  # same fake timings -> same winner...
+    assert t.misses == 2      # ...but measured under two distinct keys
+    keys = list(t.chosen)
+    assert any(k.endswith("|1") for k in keys)
+    assert any(k.endswith("|4") for k in keys)
+
+    # second lookup at each width is a cache hit, no re-benchmark
+    tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure, qlen=4)
+    assert t.hits == 1 and t.misses == 2
