@@ -47,6 +47,20 @@ import time
 
 TARGET_MFU = 0.45
 
+
+def _tail_ms(samples):
+    """(p50_ms, p99_ms) of a latency sample list, computed through the
+    serving telemetry Histogram — same log-spaced bucketing /metrics
+    exports, so bench percentiles and scrape percentiles agree on
+    resolution. Tail latency is the trajectory BENCH_*.json should carry:
+    means hide the head-of-line stalls megasteps/chunked prefill exist to
+    fix."""
+    from colossalai_tpu.inference import Histogram
+
+    h = Histogram.log_spaced(1e-5, 600.0, 48)
+    h.observe_many(samples)
+    return round(1e3 * h.percentile(50), 2), round(1e3 * h.percentile(99), 2)
+
 #: stderr substrings that mean "the backend may come back — keep retrying"
 _RETRYABLE = (
     "UNAVAILABLE",
@@ -277,10 +291,16 @@ def measure_serving(cfg, bs: int = 8, ks=(1, 8), new_tokens: int = 64):
             (t_done[r] - t_first[r]) / max(n_toks[r] - 1, 1) for r in rids
         ]
         st = engine.stats
+        ttft_p50, ttft_p99 = _tail_ms(ttft)
+        itl_p50, itl_p99 = _tail_ms(itl)
         out[f"k{k}"] = {
             "tokens_per_s": round(sum(n_toks.values()) / dt, 1),
             "ttft_ms_mean": round(1e3 * sum(ttft) / len(ttft), 1),
+            "ttft_ms_p50": ttft_p50,
+            "ttft_ms_p99": ttft_p99,
             "itl_ms_mean": round(1e3 * sum(itl) / len(itl), 2),
+            "itl_ms_p50": itl_p50,
+            "itl_ms_p99": itl_p99,
             "decode_syncs": st.decode_syncs,
             "h2d_scalars_per_token": round(
                 st.decode_h2d_scalars / max(st.decode_tokens, 1), 3
@@ -404,10 +424,16 @@ def measure_speculative(cfg, bs: int = 4, prompt_len: int = 128,
         ttft = [t_first[r] - t_submit[r] for r in rids]
         itl = [(t_done[r] - t_first[r]) / max(n_toks[r] - 1, 1) for r in rids]
         st = engine.stats
+        ttft_p50, ttft_p99 = _tail_ms(ttft)
+        itl_p50, itl_p99 = _tail_ms(itl)
         out[f"draft{d}"] = {
             "tokens_per_s": round(sum(n_toks.values()) / dt, 1),
             "ttft_ms_mean": round(1e3 * sum(ttft) / len(ttft), 1),
+            "ttft_ms_p50": ttft_p50,
+            "ttft_ms_p99": ttft_p99,
             "itl_ms_mean": round(1e3 * sum(itl) / len(itl), 2),
+            "itl_ms_p50": itl_p50,
+            "itl_ms_p99": itl_p99,
             "acceptance_rate": round(st.spec_acceptance_rate, 3) if d else None,
             "target_passes": st.spec_target_passes,
             "decode_syncs": st.decode_syncs,

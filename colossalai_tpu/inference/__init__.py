@@ -26,6 +26,14 @@ from .paged_modeling import (
 )
 from .prefix_cache import PrefixCache
 from .server import make_server
+from .telemetry import (
+    FINISH_REASONS,
+    EventLog,
+    Histogram,
+    NullTelemetry,
+    Telemetry,
+    prometheus_exposition,
+)
 from .speculative import (
     SpeculativeEngine,
     SpecStats,
@@ -65,4 +73,10 @@ __all__ = [
     "extend_step",
     "SpeculativeEngine",
     "SpecStats",
+    "FINISH_REASONS",
+    "EventLog",
+    "Histogram",
+    "NullTelemetry",
+    "Telemetry",
+    "prometheus_exposition",
 ]

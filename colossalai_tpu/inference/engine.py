@@ -54,6 +54,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import time
 from typing import Dict, List, Optional, Set, Union
 
 import jax
@@ -61,9 +62,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from colossalai_tpu.models.llama import LlamaConfig
+from colossalai_tpu.utils.profiler import annotate, step_annotation
 
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
 from .prefix_cache import PrefixCache
+from .telemetry import NullTelemetry, Telemetry
 from .paged_modeling import (
     decode_megastep,
     prefill_chunk_paged,
@@ -111,6 +114,18 @@ class Request:
     cached_blocks: List[int] = dataclasses.field(default_factory=list)
     #: prefix cache: deepest matched tree node (pin handle, opaque)
     cache_node: Optional[object] = None
+    # ---- lifecycle telemetry (monotonic clock, stamped by Telemetry):
+    # arrival (add_request) → admitted (slot granted) → first_token
+    # (prefill sample lands on the host) → finished (terminal)
+    t_arrival: Optional[float] = None
+    t_admitted: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finished: Optional[float] = None
+    #: terminal state, one of telemetry.FINISH_REASONS
+    finish_reason: Optional[str] = None
+    #: per-request speculative accounting (attributed at each megastep sync)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def n_samples(self) -> int:
@@ -153,10 +168,40 @@ class EngineStats:
     spec_accepted_tokens: int = 0
     #: multi-token verify forwards (one per live slot per megastep iteration)
     spec_target_passes: int = 0
+    # ---- request accounting: every id handed out by add_request lands in
+    # exactly one terminal bucket, so completed + aborted == submitted once
+    # the engine drains (the counter-invariant gate in test_telemetry.py)
+    #: request ids accepted by add_request (each group member counts)
+    requests_submitted: int = 0
+    #: requests that reached a natural terminal state (eos / length /
+    #: truncation) — truncated requests are also counted here
+    requests_completed: int = 0
+    #: requests cancelled via abort() from any state (waiting/prefilling/
+    #: running; a queued group counts every member)
+    requests_aborted: int = 0
+    #: completed requests that ended early because the page pool ran dry
+    requests_truncated: int = 0
 
     @property
     def spec_acceptance_rate(self) -> float:
         return self.spec_accepted_tokens / max(self.spec_draft_tokens, 1)
+
+    def as_dict(self) -> Dict[str, float]:
+        """Every counter plus the derived rates, keyed by field name — the
+        ONE serialization both ``/health`` and ``/metrics`` go through, so
+        new counters surface everywhere the moment they're added (the
+        hand-maintained dict in server.py used to drift)."""
+        d = dataclasses.asdict(self)
+        d["spec_acceptance_rate"] = self.spec_acceptance_rate
+        return d
+
+    def snapshot(self) -> "EngineStats":
+        """An independent copy (delta accounting across a bench window)."""
+        return dataclasses.replace(self)
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, f.default)
 
 
 #: admission-order policies (``scheduler_policy=``): each maps a waiting
@@ -250,8 +295,30 @@ class LLMEngine:
         draft_params=None,
         draft_config: Optional[LlamaConfig] = None,
         self_draft_layers: Optional[int] = None,
+        telemetry: Union[bool, Telemetry] = True,
+        event_log: Optional[str] = None,
     ):
         self.config = config
+        # ---- observability: lifecycle stamps + histograms are host-side
+        # floats observed at scheduling boundaries that exist anyway, so
+        # the default is ON (device traffic provably unchanged — asserted
+        # in test_telemetry.py); event_log= adds the per-request jsonl.
+        if isinstance(telemetry, Telemetry):
+            if event_log is not None:
+                raise ValueError(
+                    "pass event_log= to the Telemetry you constructed, not "
+                    "alongside it"
+                )
+            self.telemetry = telemetry
+        elif telemetry:
+            self.telemetry = Telemetry(event_log=event_log)
+        else:
+            if event_log is not None:
+                raise ValueError(
+                    "event_log= needs telemetry enabled — drop "
+                    "telemetry=False or the event_log path"
+                )
+            self.telemetry = NullTelemetry()
         self.max_batch = max_batch_size
         if max_seq_len % block_size:
             raise ValueError(
@@ -612,6 +679,8 @@ class LLMEngine:
                 f"prompt needs {need} pages but the pool only has "
                 f"{self.allocator.num_blocks - 1} - raise num_blocks"
             )
+        self.telemetry.on_submitted(req)
+        self.stats.requests_submitted += n_samples
         if self.prefix_cache is not None:
             # walk the radix tree now (pins the matched path); _admit
             # re-walks so later donations extend a queued request's hit
@@ -643,6 +712,7 @@ class LLMEngine:
                 if self.prefix_cache is not None:
                     self.prefix_cache.unpin(req.cache_node)
                     req.cache_node = None
+                self._finish(req, "aborted", count=req.n_samples)
                 return True
         for slot, req in list(self.prefilling.items()):
             if req.request_id == request_id or (
@@ -651,10 +721,12 @@ class LLMEngine:
                 # members don't exist yet: the whole group leaves together
                 self._reserved.difference_update(req.group_slots or [])
                 self._release(slot, req)
+                self._finish(req, "aborted", count=req.n_samples)
                 return True
         for slot, req in list(self.running.items()):
             if req.request_id == request_id:
                 self._release(slot, req)
+                self._finish(req, "aborted")
                 return True
         return False
 
@@ -706,6 +778,7 @@ class LLMEngine:
         host sync; K=1 degenerates to the classic per-token loop).
         Returns finished requests."""
         finished: List[Request] = []
+        self.telemetry.observe_queue_depth(len(self.waiting))
         self._admit(finished)
         self._advance_prefills(finished)
         self._decode_tick(finished)
@@ -745,6 +818,7 @@ class LLMEngine:
                 break  # no pages: stay queued until frees arrive
             self.waiting.pop(i)
             req.slot = free.pop(0)
+            self.telemetry.on_admitted(req)
             if hit:
                 # fork-share the matched full prompt pages (bump tree refs,
                 # grouped-sampling style) and allocate only the rest
@@ -786,30 +860,31 @@ class LLMEngine:
             ids = np.zeros((1, c), np.int32)
             ids[0, :n_valid] = req.prompt_ids[pos:pos + n_valid]
             table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-            if self._pp:
-                logits, self.cache = self._pp_prefill_chunk(
-                    self._pp_top, self._pp_stacked, jnp.asarray(ids),
-                    jnp.asarray(pos, jnp.int32), jnp.asarray(n_valid, jnp.int32),
-                    self.cache, jnp.asarray(table),
-                )
-            else:
-                logits, self.cache = prefill_chunk_paged(
-                    self.params, self.config, self._put_rep(ids),
-                    self._put_rep(np.asarray(pos, np.int32)),
-                    self._put_rep(np.asarray(n_valid, np.int32)),
-                    self.cache, self._put_rep(table),
-                )
-                if self.draft_len:
-                    # mirror the chunk into the draft pool (same physical
-                    # pages) so the draft's prompt KV is ready when the
-                    # slot starts drafting
-                    _, self.draft_cache = prefill_chunk_paged(
-                        self.draft_params, self.draft_config,
-                        self._put_rep(ids),
+            with annotate("prefill_chunk"):
+                if self._pp:
+                    logits, self.cache = self._pp_prefill_chunk(
+                        self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                        jnp.asarray(pos, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+                        self.cache, jnp.asarray(table),
+                    )
+                else:
+                    logits, self.cache = prefill_chunk_paged(
+                        self.params, self.config, self._put_rep(ids),
                         self._put_rep(np.asarray(pos, np.int32)),
                         self._put_rep(np.asarray(n_valid, np.int32)),
-                        self.draft_cache, self._put_rep(table),
+                        self.cache, self._put_rep(table),
                     )
+                    if self.draft_len:
+                        # mirror the chunk into the draft pool (same physical
+                        # pages) so the draft's prompt KV is ready when the
+                        # slot starts drafting
+                        _, self.draft_cache = prefill_chunk_paged(
+                            self.draft_params, self.draft_config,
+                            self._put_rep(ids),
+                            self._put_rep(np.asarray(pos, np.int32)),
+                            self._put_rep(np.asarray(n_valid, np.int32)),
+                            self.draft_cache, self._put_rep(table),
+                        )
             self.stats.prefill_chunks += 1
             req.prefill_pos = pos + n_valid
             if req.prefill_pos >= n:
@@ -834,9 +909,13 @@ class LLMEngine:
         )[0])
         req.output_ids.append(tok)
         self._slot_tokens[req.slot] = tok
+        self.telemetry.on_first_token(req)
         members = [req]
         for fid in (req.group_ids or [])[1:]:
             f = Request(fid, req.prompt_ids, req.gen)
+            # followers share the leader's queue history: one arrival, one
+            # admission, one prefill — only their sampled tokens diverge
+            f.t_arrival, f.t_admitted = req.t_arrival, req.t_admitted
             f.slot = follower_slots.pop(0)
             shared = req.table.blocks[:full]
             self.allocator.fork(shared)
@@ -864,12 +943,13 @@ class LLMEngine:
             )[0])
             f.output_ids.append(ftok)
             self._slot_tokens[f.slot] = ftok
+            self.telemetry.on_first_token(f)
             members.append(f)
         for m in members:
             if self._is_finished(m, m.output_ids[-1]):
-                m.finished = True
-                finished.append(m)
                 self._release(m.slot, m)
+                self._finish(m, self._natural_reason(m))
+                finished.append(m)
             else:
                 self.running[m.slot] = m
                 self._activate_slot(m)
@@ -974,9 +1054,9 @@ class LLMEngine:
                 if not self._fund_slot(slot, req, 1):
                     # out of pages mid-flight: truncate this request —
                     # _release frees exactly the pages the slot owns
-                    req.finished = True
                     req.truncated = True
                     self._release(slot, req)
+                    self._finish(req, "truncated")
                     finished.append(req)
         if not self.running:
             return
@@ -990,53 +1070,62 @@ class LLMEngine:
             # greedy megasteps never consume randomness (matching the
             # per-step fast path); the keys operand is a dead input
             keys = self._put_rep(np.zeros((k, 2), np.uint32))
-        if d > 0:
-            # draft/verify/commit runs entirely on device; the extra
-            # outputs are the per-slot speculative counters, fetched in
-            # the same single sync below
-            (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
-             self._dev_budget, self.cache, self.draft_cache,
-             passes, drafted, accepted) = decode_spec_megastep(
-                self.params, self.draft_params, self.config,
-                self.draft_config, self._dev_tokens, self._dev_tables,
-                self._dev_lengths, self.cache, self.draft_cache,
-                self._dev_active, self._dev_budget, self._dev_eos,
-                self._dev_temp, self._dev_topk, self._dev_topp,
-                self._dev_sample, keys, k_steps=k, draft_len=d,
-                use_kernel=self.use_kernel, use_sampling=any_sample,
-            )
-        elif self._pp:
-            (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
-             self._dev_budget, self.cache) = self._pp_megastep(
-                self._pp_top, self._pp_stacked, self._dev_tokens,
-                self._dev_tables, self._dev_lengths, self.cache,
-                self._dev_active, self._dev_budget, self._dev_eos,
-                self._dev_temp, self._dev_topk, self._dev_topp,
-                self._dev_sample, keys, k_steps=k, use_sampling=any_sample,
-            )
-        else:
-            (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
-             self._dev_budget, self.cache) = decode_megastep(
-                self.params, self.config, self._dev_tokens,
-                self._dev_tables, self._dev_lengths, self.cache,
-                self._dev_active, self._dev_budget, self._dev_eos,
-                self._dev_temp, self._dev_topk, self._dev_topp,
-                self._dev_sample, keys, k_steps=k,
-                use_kernel=self.use_kernel, use_sampling=any_sample,
-            )
-        # the ONE host sync per megastep: K×S ids + per-slot counts/flags
-        buf_np = self._fetch(buf)
-        emitted_np = self._fetch(emitted)
-        alive_np = self._fetch(alive)
+        # trace attribution: a /profile capture groups each megastep as one
+        # XProf step named for its engine phase; wall time (dispatch through
+        # host sync) feeds the megastep_seconds histogram — measured once
+        # per K tokens, so the device loop itself never sees a timer
+        t_mega = time.perf_counter()
+        with step_annotation(self.stats.decode_megasteps,
+                             name="spec_megastep" if d > 0 else "decode_megastep"):
+            if d > 0:
+                # draft/verify/commit runs entirely on device; the extra
+                # outputs are the per-slot speculative counters, fetched in
+                # the same single sync below
+                (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+                 self._dev_budget, self.cache, self.draft_cache,
+                 passes, drafted, accepted) = decode_spec_megastep(
+                    self.params, self.draft_params, self.config,
+                    self.draft_config, self._dev_tokens, self._dev_tables,
+                    self._dev_lengths, self.cache, self.draft_cache,
+                    self._dev_active, self._dev_budget, self._dev_eos,
+                    self._dev_temp, self._dev_topk, self._dev_topp,
+                    self._dev_sample, keys, k_steps=k, draft_len=d,
+                    use_kernel=self.use_kernel, use_sampling=any_sample,
+                )
+            elif self._pp:
+                (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+                 self._dev_budget, self.cache) = self._pp_megastep(
+                    self._pp_top, self._pp_stacked, self._dev_tokens,
+                    self._dev_tables, self._dev_lengths, self.cache,
+                    self._dev_active, self._dev_budget, self._dev_eos,
+                    self._dev_temp, self._dev_topk, self._dev_topp,
+                    self._dev_sample, keys, k_steps=k, use_sampling=any_sample,
+                )
+            else:
+                (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+                 self._dev_budget, self.cache) = decode_megastep(
+                    self.params, self.config, self._dev_tokens,
+                    self._dev_tables, self._dev_lengths, self.cache,
+                    self._dev_active, self._dev_budget, self._dev_eos,
+                    self._dev_temp, self._dev_topk, self._dev_topp,
+                    self._dev_sample, keys, k_steps=k,
+                    use_kernel=self.use_kernel, use_sampling=any_sample,
+                )
+            # the ONE host sync per megastep: K×S ids + per-slot counts/flags
+            buf_np = self._fetch(buf)
+            emitted_np = self._fetch(emitted)
+            alive_np = self._fetch(alive)
+            if d > 0:
+                passes_np = self._fetch(passes)
+                drafted_np = self._fetch(drafted)
+                accepted_np = self._fetch(accepted)
+        self.telemetry.observe_megastep(time.perf_counter() - t_mega)
         self.stats.decode_megasteps += 1
         self.stats.decode_syncs += 1
         self.stats.decode_d2h_elements += (
             buf_np.size + emitted_np.size + alive_np.size
         )
         if d > 0:
-            passes_np = self._fetch(passes)
-            drafted_np = self._fetch(drafted)
-            accepted_np = self._fetch(accepted)
             self.stats.decode_d2h_elements += (
                 passes_np.size + drafted_np.size + accepted_np.size
             )
@@ -1051,10 +1140,15 @@ class LLMEngine:
             if toks:
                 self._slot_tokens[slot] = toks[-1]
             self.stats.decode_tokens += t
+            if d > 0:
+                # per-request speculative attribution (the event-log record
+                # reports each request's own acceptance, not the global rate)
+                req.spec_drafted += int(drafted_np[slot])
+                req.spec_accepted += int(accepted_np[slot])
             if not alive_np[slot]:
-                req.finished = True
-                finished.append(req)
                 self._release(slot, req)
+                self._finish(req, self._natural_reason(req))
+                finished.append(req)
             elif self.draft_len:
                 # rollback = length decrement already happened on device;
                 # hand the pages funded past the committed frontier back
@@ -1090,6 +1184,32 @@ class LLMEngine:
             or total >= self.max_seq - 1
         )
 
+    def _natural_reason(self, req: Request) -> str:
+        """Why a non-aborted request stopped: truncated (pool ran dry),
+        eos (its last token is the stop token), else length (budget)."""
+        if req.truncated:
+            return "truncated"
+        last = req.output_ids[-1] if req.output_ids else None
+        if req.gen.eos_token_id is not None and last == req.gen.eos_token_id:
+            return "eos"
+        return "length"
+
+    def _finish(self, req: Request, reason: str, count: int = 1) -> None:
+        """Terminal bookkeeping for one request (or a still-queued group of
+        ``count`` members sharing a single Request object): finished flag,
+        finish_reason, the requests_* counters, and the telemetry record.
+        Every id add_request hands out passes through here exactly once,
+        which is what makes completed + aborted == submitted assertable."""
+        req.finished = True
+        req.finish_reason = reason
+        if reason == "aborted":
+            self.stats.requests_aborted += count
+        else:
+            self.stats.requests_completed += count
+            if reason == "truncated":
+                self.stats.requests_truncated += count
+        self.telemetry.on_finished(req, group_size=count)
+
     # -------------------------------------------------------------- internal
     def _set_slot_gen(self, slot: int, g: GenerationConfig) -> None:
         self._gen_temp[slot] = g.temperature
@@ -1123,23 +1243,24 @@ class LLMEngine:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt_ids
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-        if self._pp:
-            logits, self.cache = self._pp_prefill(
-                self._pp_top, self._pp_stacked, jnp.asarray(ids),
-                jnp.asarray([n], jnp.int32), self.cache, jnp.asarray(table),
-            )
-        else:
-            logits, self.cache = prefill_paged(
-                self.params, self.config, self._put_rep(ids),
-                self._put_rep(np.asarray([n], np.int32)), self.cache,
-                self._put_rep(table),
-            )
-            if self.draft_len:
-                _, self.draft_cache = prefill_paged(
-                    self.draft_params, self.draft_config, self._put_rep(ids),
-                    self._put_rep(np.asarray([n], np.int32)),
-                    self.draft_cache, self._put_rep(table),
+        with annotate("prefill"):
+            if self._pp:
+                logits, self.cache = self._pp_prefill(
+                    self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                    jnp.asarray([n], jnp.int32), self.cache, jnp.asarray(table),
                 )
+            else:
+                logits, self.cache = prefill_paged(
+                    self.params, self.config, self._put_rep(ids),
+                    self._put_rep(np.asarray([n], np.int32)), self.cache,
+                    self._put_rep(table),
+                )
+                if self.draft_len:
+                    _, self.draft_cache = prefill_paged(
+                        self.draft_params, self.draft_config, self._put_rep(ids),
+                        self._put_rep(np.asarray([n], np.int32)),
+                        self.draft_cache, self._put_rep(table),
+                    )
         req.table.length = n
         return logits
 
@@ -1155,31 +1276,32 @@ class LLMEngine:
         ids = np.zeros((1, c), np.int32)
         ids[0, :n - start] = req.prompt_ids[start:]
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-        if self._pp:
-            logits, self.cache = self._pp_prefill_chunk(
-                self._pp_top, self._pp_stacked, jnp.asarray(ids),
-                jnp.asarray(start, jnp.int32),
-                jnp.asarray(n - start, jnp.int32),
-                self.cache, jnp.asarray(table),
-            )
-        else:
-            logits, self.cache = prefill_chunk_paged(
-                self.params, self.config, self._put_rep(ids),
-                self._put_rep(np.asarray(start, np.int32)),
-                self._put_rep(np.asarray(n - start, np.int32)),
-                self.cache, self._put_rep(table),
-            )
-            if self.draft_len:
-                # the cached prefix pages already hold draft KV — their
-                # donor mirrored its whole prompt into the draft pool at
-                # these same physical ids, and tree-owned pages are never
-                # reallocated while cached — so only the suffix runs here
-                _, self.draft_cache = prefill_chunk_paged(
-                    self.draft_params, self.draft_config, self._put_rep(ids),
+        with annotate("prefill_suffix"):
+            if self._pp:
+                logits, self.cache = self._pp_prefill_chunk(
+                    self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                    jnp.asarray(start, jnp.int32),
+                    jnp.asarray(n - start, jnp.int32),
+                    self.cache, jnp.asarray(table),
+                )
+            else:
+                logits, self.cache = prefill_chunk_paged(
+                    self.params, self.config, self._put_rep(ids),
                     self._put_rep(np.asarray(start, np.int32)),
                     self._put_rep(np.asarray(n - start, np.int32)),
-                    self.draft_cache, self._put_rep(table),
+                    self.cache, self._put_rep(table),
                 )
+                if self.draft_len:
+                    # the cached prefix pages already hold draft KV — their
+                    # donor mirrored its whole prompt into the draft pool at
+                    # these same physical ids, and tree-owned pages are never
+                    # reallocated while cached — so only the suffix runs here
+                    _, self.draft_cache = prefill_chunk_paged(
+                        self.draft_params, self.draft_config, self._put_rep(ids),
+                        self._put_rep(np.asarray(start, np.int32)),
+                        self._put_rep(np.asarray(n - start, np.int32)),
+                        self.draft_cache, self._put_rep(table),
+                    )
         req.table.length = n
         return logits
 

@@ -119,9 +119,12 @@ def prefill_paged(
         x = _block_step(cfg, layer_params, x, k, v, positions, valid)
         return (x, i + 1), (k_pool, v_pool)
 
-    (x, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
-    )
+    # named HLO region: a /profile capture attributes this op cluster to
+    # the prefill phase (see docs/observability.md)
+    with jax.named_scope("prefill"):
+        (x, _), (k_new, v_new) = jax.lax.scan(
+            layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+        )
 
     logits = _logits_head(p, cfg, x)
     last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
@@ -182,9 +185,10 @@ def prefill_chunk_paged(
                         positions, kv_valid)
         return (x, i + 1), (k_pool, v_pool)
 
-    (x, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
-    )
+    with jax.named_scope("prefill_chunk"):
+        (x, _), (k_new, v_new) = jax.lax.scan(
+            layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+        )
 
     logits = _logits_head(p, cfg, x)
     last = jax.lax.dynamic_index_in_dim(
@@ -459,7 +463,10 @@ def megastep_loop(
 
     def body(i, carry):
         ck, cv, tok, lens, alive, budg, buf, emitted = carry
-        logits, ck, cv = decode_once(tok, lens, ck, cv, alive)
+        # named HLO regions: a /profile capture splits each megastep
+        # iteration into forward vs sample/commit time
+        with jax.named_scope("decode_iter"):
+            logits, ck, cv = decode_once(tok, lens, ck, cv, alive)
         if use_sampling:
             nxt = sample_tokens(logits, rng_keys[i], temp, topk, topp, do_sample)
         else:

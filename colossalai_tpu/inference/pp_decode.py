@@ -300,10 +300,13 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
             )
             return x, k_new, v_new
 
-        x, k_new, v_new = _relay(
-            mesh, stage_fn, x, stacked, ck, cv,
-            (positions, block_tables, active, w_block, w_off, attend), tp=tp,
-        )
+        # named HLO region so a /profile capture attributes the pp hop
+        # relay (ppermute chain + per-stage blocks) to the decode phase
+        with jax.named_scope("pp_decode_relay"):
+            x, k_new, v_new = _relay(
+                mesh, stage_fn, x, stacked, ck, cv,
+                (positions, block_tables, active, w_block, w_off, attend), tp=tp,
+            )
         return _head(top, x)[:, 0], k_new, v_new
 
     @partial(jax.jit, donate_argnames=("cache",))

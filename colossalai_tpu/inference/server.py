@@ -22,10 +22,26 @@ Endpoints:
   pages immediately (≙ engine.abort_request). With megasteps an abort
   lands at the next K-token sync, not mid-loop.
 - ``GET /health``     → {"status": "ok", "running": n, "waiting": m, ...}
-  plus the engine's decode-path transfer counters (megasteps, syncs,
-  tokens) for observing the O(1)-transfers-per-token contract live, the
-  scheduler policy, and the prefix-cache counters (resident blocks, hit
-  blocks, saved prefill tokens, insertions, evictions).
+  plus EVERY ``EngineStats`` counter (serialized through
+  ``EngineStats.as_dict()``, so new counters surface here automatically):
+  the decode-path transfer counters for observing the
+  O(1)-transfers-per-token contract live, the scheduler policy, the
+  prefix-cache and speculative counters, and the request-accounting
+  counters (submitted/completed/aborted/truncated).
+- ``GET /metrics``    → Prometheus text exposition (format 0.0.4; zero
+  dependencies): the same counters as ``clt_*`` counter metrics, queue/
+  batch occupancy gauges, and the telemetry latency histograms (TTFT,
+  ITL, e2e, queue wait, queue depth, megastep wall time) as
+  ``_bucket``/``_sum``/``_count`` families — drop the URL into any
+  standard scrape pipeline (see docs/observability.md).
+- ``POST /profile``   {"action": "start", "log_dir": d} | {"action": "stop"}
+  → on-demand XLA trace capture of the LIVE engine: start begins a
+  ``jax.profiler`` trace into ``log_dir``, stop finishes it and returns
+  the dir. Captured megasteps carry ``decode_megastep`` /
+  ``spec_megastep`` step annotations and prefills ``prefill*`` trace
+  regions, so on-device time attributes to engine phases in XProf/
+  Perfetto. 409 when a capture is already running (start) or none is
+  (stop) — ``jax.profiler`` is a process-global singleton.
 
 ``/generate`` also accepts ``"priority"`` (int, default 0) — it orders
 admission when the engine runs ``scheduler_policy="priority"``.
@@ -39,7 +55,10 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from colossalai_tpu.utils.profiler import start_profile, stop_profile
+
 from .engine import GenerationConfig, LLMEngine
+from .telemetry import prometheus_exposition
 
 #: sentinel pushed to a stream queue when its request leaves the engine
 _DONE = object()
@@ -199,34 +218,50 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
             self.end_headers()
             self.wfile.write(body)
 
+        def _occupancy(self) -> dict:
+            """Point-in-time scheduler/pool gauges (caller holds the
+            lock) — the non-counter half of /health and /metrics."""
+            pc = engine.prefix_cache
+            return {
+                "running": len(engine.running),
+                "waiting": len(engine.waiting),
+                "prefilling": len(engine.prefilling),
+                "free_blocks": engine.allocator.num_free,
+                "megastep_k": engine.megastep_k,
+                "prefix_cache_blocks": 0 if pc is None else len(pc),
+                "draft_len": engine.draft_len,
+            }
+
         def do_GET(self):
             if self.path == "/health":
                 with sched.lock:
-                    st = engine.stats
-                    pc = engine.prefix_cache
-                    self._json(200, {
+                    payload = {
                         "status": "ok",
-                        "running": len(engine.running),
-                        "waiting": len(engine.waiting),
-                        "prefilling": len(engine.prefilling),
-                        "free_blocks": engine.allocator.num_free,
-                        "megastep_k": engine.megastep_k,
-                        "decode_megasteps": st.decode_megasteps,
-                        "decode_syncs": st.decode_syncs,
-                        "decode_tokens": st.decode_tokens,
                         "scheduler_policy": engine.scheduler_policy,
-                        "prefix_cache": pc is not None,
-                        "prefix_cache_blocks": 0 if pc is None else len(pc),
-                        "prefix_hit_blocks": st.prefix_hit_blocks,
-                        "prefix_saved_tokens": st.prefix_saved_tokens,
-                        "prefix_insertions": st.prefix_insertions,
-                        "prefix_evictions": st.prefix_evictions,
-                        "draft_len": engine.draft_len,
-                        "spec_draft_tokens": st.spec_draft_tokens,
-                        "spec_accepted_tokens": st.spec_accepted_tokens,
-                        "spec_target_passes": st.spec_target_passes,
-                        "spec_acceptance_rate": st.spec_acceptance_rate,
-                    })
+                        "prefix_cache": engine.prefix_cache is not None,
+                        **self._occupancy(),
+                    }
+                    # one serialization for every counter: as_dict() keys
+                    # match the EngineStats field names, so /health can
+                    # never drift from the dataclass again
+                    payload.update(engine.stats.as_dict())
+                self._json(200, payload)
+            elif self.path == "/metrics":
+                with sched.lock:
+                    counters = engine.stats.as_dict()
+                    gauges = self._occupancy()
+                    # a ratio is a gauge, not a counter (it can go down)
+                    gauges["spec_acceptance_rate"] = \
+                        counters.pop("spec_acceptance_rate")
+                    body = prometheus_exposition(
+                        counters, gauges, engine.telemetry.histograms,
+                    ).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -282,6 +317,33 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     self._json(200, {"aborted": sched.abort(int(req["request_id"]))})
                 except Exception as e:
                     self._json(400, {"error": str(e)})
+                return
+            if self.path == "/profile":
+                # on-demand XLA capture of the live engine; no scheduler
+                # lock — jax.profiler traces concurrently with dispatches,
+                # and its own start/stop guard serializes state changes
+                action = req.get("action")
+                try:
+                    if action == "start":
+                        log_dir = req.get("log_dir")
+                        if not log_dir:
+                            self._json(400, {"error":
+                                             '"start" needs a "log_dir"'})
+                            return
+                        start_profile(log_dir)
+                        self._json(200, {"profiling": True,
+                                         "log_dir": log_dir})
+                    elif action == "stop":
+                        self._json(200, {"profiling": False,
+                                         "log_dir": stop_profile()})
+                    else:
+                        self._json(400, {"error":
+                                         'need "action": "start" | "stop"'})
+                except RuntimeError as e:
+                    # double start / stop without start: the capture guard
+                    self._json(409, {"error": str(e)})
+                except Exception as e:  # pragma: no cover - defensive
+                    self._json(500, {"error": str(e)})
                 return
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})

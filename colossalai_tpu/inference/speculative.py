@@ -252,34 +252,38 @@ def spec_megastep_loop(
         key = rng_keys[j]
 
         # ---- draft phase: d sequential proposals + the hole-fix decode
-        drafts = []
-        q_list = []
-        t = tok
-        for i in range(d):
-            dlog, dk, dv = draft_extend(t[:, None], lens + i, limits, dk, dv, alive)
-            dlog = dlog[:, 0]
-            if use_sampling:
-                dmask = filter_logits(dlog, temp, topk, topp)
-                di = jnp.where(
-                    do_sample,
-                    jax.random.categorical(jax.random.fold_in(key, i), dmask),
-                    jnp.argmax(dlog, axis=-1),
-                ).astype(jnp.int32)
-                q_list.append(jax.nn.softmax(dmask, axis=-1))
-            else:
-                di = jnp.argmax(dlog, axis=-1).astype(jnp.int32)
-            drafts.append(di)
-            t = di
-        # back-fill d_d's K/V so a full acceptance leaves no hole at
-        # position lens + d (when a < d the garbage is re-fed next round
-        # before anything reads it); logits discarded
-        _, dk, dv = draft_extend(t[:, None], lens + d, limits, dk, dv, alive)
-        drafts_arr = jnp.stack(drafts, axis=1)  # [S, d]
+        # (named HLO region: a /profile capture splits each spec iteration
+        # into draft vs verify time — the ratio IS the speculation budget)
+        with jax.named_scope("spec_draft"):
+            drafts = []
+            q_list = []
+            t = tok
+            for i in range(d):
+                dlog, dk, dv = draft_extend(t[:, None], lens + i, limits, dk, dv, alive)
+                dlog = dlog[:, 0]
+                if use_sampling:
+                    dmask = filter_logits(dlog, temp, topk, topp)
+                    di = jnp.where(
+                        do_sample,
+                        jax.random.categorical(jax.random.fold_in(key, i), dmask),
+                        jnp.argmax(dlog, axis=-1),
+                    ).astype(jnp.int32)
+                    q_list.append(jax.nn.softmax(dmask, axis=-1))
+                else:
+                    di = jnp.argmax(dlog, axis=-1).astype(jnp.int32)
+                drafts.append(di)
+                t = di
+            # back-fill d_d's K/V so a full acceptance leaves no hole at
+            # position lens + d (when a < d the garbage is re-fed next round
+            # before anything reads it); logits discarded
+            _, dk, dv = draft_extend(t[:, None], lens + d, limits, dk, dv, alive)
+            drafts_arr = jnp.stack(drafts, axis=1)  # [S, d]
 
         # ---- verify: ONE multi-token forward over [t0, d_1 .. d_d]
-        window = jnp.concatenate([tok[:, None], drafts_arr], axis=1)  # [S, W]
-        vlog, ck, cv = target_extend(window, lens, limits, ck, cv, alive)
-        tgt = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [S, W]
+        with jax.named_scope("spec_verify"):
+            window = jnp.concatenate([tok[:, None], drafts_arr], axis=1)  # [S, W]
+            vlog, ck, cv = target_extend(window, lens, limits, ck, cv, alive)
+            tgt = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [S, W]
 
         # ---- acceptance: longest matching prefix + correction token
         match_g = (tgt[:, :d] == drafts_arr).astype(jnp.int32)

@@ -1,0 +1,411 @@
+"""Serving-engine observability: lifecycle tracing, histograms, /metrics.
+
+The reference framework ships a monitoring/tracing layer for TRAINING
+(trainer hooks, memory tracer, torch.profiler wrappers — SURVEY §5); this
+module is its serving-side counterpart for the paged engine. Three pieces:
+
+- :class:`Histogram` — a fixed-bucket streaming histogram (log-spaced
+  bounds, O(1) observe, mergeable, p50/p90/p99 queries, Prometheus
+  ``_bucket/_sum/_count`` rendering). Fixed buckets matter: the decode hot
+  path stays device-resident, so every observation happens at the
+  once-per-megastep host sync and costs one list increment — no
+  reservoirs, no sorting, no allocation;
+- :class:`EventLog` — an append-only jsonl sink (the
+  ``logging/metrics.py`` design: one json object per line, flushed per
+  write, so the log survives preemption and a restarted server keeps
+  appending to the same history);
+- :class:`Telemetry` — the engine-facing facade: stamps each
+  :class:`~.engine.Request` with monotonic ``arrival → admitted →
+  first_token → finished`` times, folds the derived latencies (queue
+  wait, TTFT, mean ITL, e2e) into the histograms, and emits one
+  per-request jsonl record at finish. :class:`NullTelemetry` is the
+  zero-cost off switch (``LLMEngine(telemetry=False)``).
+
+Everything here is host-side arithmetic on python floats — enabling
+telemetry provably changes NOTHING about device traffic
+(``decode_syncs`` / ``decode_h2d_scalars`` are asserted byte-identical in
+``tests/test_inference/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+#: every terminal state a request can reach — the ``finish_reason`` field
+#: of lifecycle records is always one of these
+FINISH_REASONS = ("eos", "length", "aborted", "truncated")
+
+
+class Histogram:
+    """Fixed-bucket streaming histogram.
+
+    ``bounds`` are the strictly increasing bucket UPPER bounds; an
+    implicit +Inf bucket catches overflow. Observation is O(buckets) in
+    the worst case (a bisect over ~50 floats — trivial next to the host
+    sync it piggybacks on); ``merge`` composes histograms observed by
+    different engines (bench sweeps, multi-engine frontends).
+
+    Percentile queries interpolate linearly inside the bracketing bucket
+    and clamp to the observed min/max, so the error is bounded by one
+    bucket's width — with the default log spacing that is a small,
+    constant RELATIVE error across six decades of latency.
+    """
+
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
+
+    def __init__(self, bounds: Sequence[float]):
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError(f"bounds must be strictly increasing: {bounds}")
+        if not all(math.isfinite(b) for b in bounds):
+            raise ValueError("bounds must be finite (+Inf is implicit)")
+        self.bounds = bounds
+        self.bucket_counts = [0] * (len(bounds) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    @classmethod
+    def log_spaced(cls, lo: float, hi: float, n_buckets: int) -> "Histogram":
+        """``n_buckets`` geometrically spaced bounds over [lo, hi] — the
+        right shape for latencies, whose interesting range spans decades
+        (a 100µs megastep and a 100s queue wait in one histogram)."""
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+        if n_buckets < 1:
+            raise ValueError(f"n_buckets={n_buckets} must be >= 1")
+        ratio = (hi / lo) ** (1.0 / max(n_buckets - 1, 1))
+        return cls([lo * ratio ** i for i in range(n_buckets)])
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:  # first bound >= v (bisect_left over upper bounds)
+            mid = (lo + hi) // 2
+            if self.bounds[mid] < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.bucket_counts[lo] += 1
+
+    def observe_many(self, values: Iterable[float]) -> None:
+        for v in values:
+            self.observe(v)
+
+    def percentile(self, q: float) -> float:
+        """The ``q``-th percentile (0..100), interpolated within its
+        bucket and clamped to the observed [min, max]. NaN when empty."""
+        if not 0 <= q <= 100:
+            raise ValueError(f"q={q} must be in [0, 100]")
+        if self.count == 0:
+            return math.nan
+        target = (q / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self.bucket_counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else min(self.min, self.bounds[0])
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                frac = (target - cum) / c
+                v = lo + frac * (hi - lo)
+                return min(max(v, self.min), self.max)
+            cum += c
+        return self.max  # pragma: no cover - unreachable (counts sum to count)
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into self (bounds must match). Returns self."""
+        if self.bounds != other.bounds:
+            raise ValueError("cannot merge histograms with different bounds")
+        for i, c in enumerate(other.bucket_counts):
+            self.bucket_counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        return self
+
+    def reset(self) -> None:
+        self.bucket_counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "bounds": list(self.bounds),
+            "bucket_counts": list(self.bucket_counts),
+        }
+
+    def prometheus_lines(self, name: str) -> List[str]:
+        """Text-exposition sample lines: cumulative ``_bucket`` counts per
+        ``le`` bound (+Inf last), then ``_sum`` and ``_count``."""
+        lines = []
+        cum = 0
+        for b, c in zip(self.bounds, self.bucket_counts):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{_fmt(b)}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum {_fmt(self.sum)}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integral values without the trailing
+    .0, everything else repr-roundtrippable."""
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class EventLog:
+    """Append-only jsonl event sink (≙ ``logging/metrics.py``'s file
+    discipline: one record per line, flush per write, open in append mode
+    so restarts extend the same history). Thread-safe — the engine's
+    scheduler thread and a server's handler threads may both emit."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._file = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            if self._file is not None:
+                self._file.write(line)
+                self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    @staticmethod
+    def read(path: str) -> List[Dict[str, Any]]:
+        """Load every record back (the round-trip helper tests and offline
+        analysis use — one json.loads per line, blank lines skipped)."""
+        out = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+#: histogram catalog: name → constructor. Latencies get log-spaced bounds
+#: spanning 100µs–1h; queue depth gets powers of two (an integer gauge).
+_HISTOGRAM_SPECS = {
+    "ttft_seconds": lambda: Histogram.log_spaced(1e-4, 600.0, 48),
+    "itl_seconds": lambda: Histogram.log_spaced(1e-5, 60.0, 48),
+    "e2e_seconds": lambda: Histogram.log_spaced(1e-3, 3600.0, 48),
+    "queue_wait_seconds": lambda: Histogram.log_spaced(1e-5, 600.0, 48),
+    "queue_depth": lambda: Histogram([2 ** i for i in range(13)]),  # 1..4096
+    "megastep_seconds": lambda: Histogram.log_spaced(1e-4, 60.0, 40),
+}
+
+
+class Telemetry:
+    """Request-lifecycle tracing + latency histograms for ``LLMEngine``.
+
+    The engine calls the ``on_*`` hooks at its scheduling boundaries
+    (submit / admit / first token / finish — all host-side moments that
+    exist anyway); this class stamps ``time.monotonic()`` onto the
+    Request, derives the latency set at finish, feeds the histograms, and
+    appends one jsonl record per request. Monotonic time everywhere:
+    lifecycle deltas must survive wall-clock adjustments.
+
+    A queued GROUP (``n_samples > 1``) aborted before admission emits ONE
+    record (its followers were never materialized); the record carries
+    ``group_size`` so accounting still adds up.
+    """
+
+    #: patchable clock seam (tests pin it to verify derived latencies)
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(self, event_log: Union[None, str, EventLog] = None):
+        self.histograms: Dict[str, Histogram] = {
+            name: make() for name, make in _HISTOGRAM_SPECS.items()
+        }
+        self.events: Optional[EventLog] = (
+            EventLog(event_log) if isinstance(event_log, str) else event_log
+        )
+        self.enabled = True
+
+    # ------------------------------------------------------ lifecycle hooks
+    def on_submitted(self, req) -> None:
+        req.t_arrival = self._clock()
+
+    def on_admitted(self, req) -> None:
+        req.t_admitted = self._clock()
+
+    def on_first_token(self, req) -> None:
+        if req.t_first_token is None:
+            req.t_first_token = self._clock()
+
+    def on_finished(self, req, *, group_size: int = 1) -> None:
+        """Terminal hook: stamp ``t_finished``, observe the latency
+        histograms, append the lifecycle record. ``req.finish_reason``
+        must already be set (the engine decides eos/length/aborted/
+        truncated — it has the context)."""
+        now = self._clock()
+        req.t_finished = now
+        n_gen = len(req.output_ids)
+        queue_wait = ttft = itl = e2e = None
+        if req.t_arrival is not None:
+            e2e = now - req.t_arrival
+            if req.t_admitted is not None:
+                queue_wait = req.t_admitted - req.t_arrival
+            if req.t_first_token is not None:
+                ttft = req.t_first_token - req.t_arrival
+                if n_gen > 1:
+                    itl = (now - req.t_first_token) / (n_gen - 1)
+        h = self.histograms
+        if queue_wait is not None:
+            h["queue_wait_seconds"].observe(queue_wait)
+        if ttft is not None:
+            h["ttft_seconds"].observe(ttft)
+        if itl is not None:
+            h["itl_seconds"].observe(itl)
+        if e2e is not None:
+            h["e2e_seconds"].observe(e2e)
+        if self.events is not None:
+            record = {
+                "event": "request",
+                "request_id": req.request_id,
+                "finish_reason": req.finish_reason,
+                "prompt_tokens": len(req.prompt_ids),
+                "generated_tokens": n_gen,
+                "queue_wait_s": _r(queue_wait),
+                "ttft_s": _r(ttft),
+                "itl_mean_s": _r(itl),
+                "e2e_s": _r(e2e),
+                "prefix_hit_blocks": len(req.cached_blocks),
+                "spec_drafted": req.spec_drafted,
+                "spec_accepted": req.spec_accepted,
+            }
+            if group_size > 1:
+                record["group_size"] = group_size
+            self.events.emit(record)
+
+    # --------------------------------------------------- engine-level gauges
+    def observe_queue_depth(self, depth: int) -> None:
+        self.histograms["queue_depth"].observe(depth)
+
+    def observe_megastep(self, seconds: float) -> None:
+        """Wall time of one decode megastep, dispatch through host sync —
+        measured once per K tokens, so the hot loop never sees a timer."""
+        self.histograms["megastep_seconds"].observe(seconds)
+
+    # ----------------------------------------------------------------- misc
+    def reset(self) -> None:
+        """Zero the histograms (benchmarks reset after warmup); lifecycle
+        stamps live on the requests and are untouched."""
+        for h in self.histograms.values():
+            h.reset()
+
+    def percentiles(self, name: str, qs=(50.0, 90.0, 99.0)) -> Dict[str, float]:
+        h = self.histograms[name]
+        return {f"p{int(q) if q == int(q) else q}": h.percentile(q) for q in qs}
+
+    def close(self) -> None:
+        if self.events is not None:
+            self.events.close()
+
+
+class NullTelemetry:
+    """No-op stand-in (``LLMEngine(telemetry=False)``): same surface,
+    empty histogram dict, hooks that do nothing — the engine never has to
+    branch on whether telemetry is live."""
+
+    histograms: Dict[str, Histogram] = {}
+    events = None
+    enabled = False
+
+    def on_submitted(self, req) -> None:
+        pass
+
+    def on_admitted(self, req) -> None:
+        pass
+
+    def on_first_token(self, req) -> None:
+        pass
+
+    def on_finished(self, req, *, group_size: int = 1) -> None:
+        pass
+
+    def observe_queue_depth(self, depth: int) -> None:
+        pass
+
+    def observe_megastep(self, seconds: float) -> None:
+        pass
+
+    def reset(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    """Round a latency for the jsonl record (µs resolution — floats in
+    logs should be readable, not 17 digits)."""
+    return None if v is None else round(v, 6)
+
+
+def prometheus_exposition(
+    counters: Dict[str, Any],
+    gauges: Dict[str, Any],
+    histograms: Dict[str, Histogram],
+    prefix: str = "clt",
+) -> str:
+    """Prometheus text exposition (format 0.0.4) with zero dependencies:
+    ``# TYPE`` header + samples per metric, histograms as cumulative
+    ``_bucket``/``_sum``/``_count`` families. Metric names are
+    ``<prefix>_<name>``; non-numeric values are skipped (a counters dict
+    may carry strings like the scheduler policy)."""
+    lines: List[str] = []
+    for kind, metrics in (("counter", counters), ("gauge", gauges)):
+        for name in sorted(metrics):
+            v = metrics[name]
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                continue
+            full = f"{prefix}_{name}"
+            lines.append(f"# TYPE {full} {kind}")
+            lines.append(f"{full} {_fmt(v)}")
+    for name in sorted(histograms):
+        full = f"{prefix}_{name}"
+        lines.append(f"# TYPE {full} histogram")
+        lines.extend(histograms[name].prometheus_lines(full))
+    return "\n".join(lines) + "\n"

@@ -5,7 +5,15 @@ from .performance_evaluator import (
     count_params,
     peak_flops_per_device,
 )
-from .profiler import annotate, profile, step_annotation
+from .profiler import (
+    annotate,
+    is_profiling,
+    profile,
+    profiling_dir,
+    start_profile,
+    step_annotation,
+    stop_profile,
+)
 
 __all__ = [
     "TokenDataLoader",
@@ -15,6 +23,10 @@ __all__ = [
     "count_params",
     "peak_flops_per_device",
     "annotate",
+    "is_profiling",
     "profile",
+    "profiling_dir",
+    "start_profile",
     "step_annotation",
+    "stop_profile",
 ]

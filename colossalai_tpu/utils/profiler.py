@@ -4,14 +4,65 @@
 examples + memory tracer): on TPU the native story is ``jax.profiler`` —
 XLA-level traces viewable in TensorBoard/XProf/Perfetto, with named step
 and op annotations.
+
+Two entry styles share one active-trace guard:
+
+- the :func:`profile` context manager for scripted runs;
+- :func:`start_profile` / :func:`stop_profile` for ON-DEMAND capture of a
+  live process — the serving engine's ``POST /profile`` endpoint flips
+  these around running decode megasteps, so a production engine can be
+  traced for a bounded window without restarting (see
+  docs/observability.md).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Iterator, Optional
 
 import jax
+
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+def start_profile(log_dir: str) -> None:
+    """Begin capturing an XLA trace into ``log_dir``. Exactly one trace
+    may be active per process (``jax.profiler`` is a process-global
+    singleton); a second start raises instead of corrupting the first."""
+    global _active_dir
+    with _lock:
+        if _active_dir is not None:
+            raise RuntimeError(
+                f"a profile is already capturing into {_active_dir!r} — "
+                "stop it before starting another"
+            )
+        jax.profiler.start_trace(log_dir)
+        _active_dir = log_dir
+
+
+def stop_profile() -> str:
+    """Finish the active capture; returns the log_dir it wrote to."""
+    global _active_dir
+    with _lock:
+        if _active_dir is None:
+            raise RuntimeError("no profile is active — start one first")
+        log_dir = _active_dir
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _active_dir = None
+    return log_dir
+
+
+def is_profiling() -> bool:
+    return _active_dir is not None
+
+
+def profiling_dir() -> Optional[str]:
+    """The active capture's log_dir, or None."""
+    return _active_dir
 
 
 @contextlib.contextmanager
@@ -24,17 +75,21 @@ def profile(log_dir: str) -> Iterator[None]:
     ...             state, m = boosted.train_step(state, batch)
     ...         float(m["loss"])   # sync INSIDE the trace on tunneled TPUs
     """
-    jax.profiler.start_trace(log_dir)
+    start_profile(log_dir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_profile()
 
 
 @contextlib.contextmanager
-def step_annotation(step: int) -> Iterator[None]:
-    """Mark one training step in the trace (≙ torch.profiler.step())."""
-    with jax.profiler.StepTraceAnnotation("train_step", step_num=step):
+def step_annotation(step: int, name: str = "train_step") -> Iterator[None]:
+    """Mark one step in the trace (≙ torch.profiler.step()). ``name``
+    groups the step family in XProf — the trainer uses the default
+    "train_step"; the serving engine labels its decode megasteps
+    "decode_megastep" / "spec_megastep" so on-device time in a capture
+    attributes to engine phases."""
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
         yield
 
 

@@ -1,0 +1,431 @@
+"""Serving-engine observability: lifecycle tracing, histograms, /metrics,
+/profile, and the counter-invariant gate.
+
+Three contracts under test:
+
+- telemetry is pure host-side arithmetic — the decode path's transfer
+  counters are BYTE-IDENTICAL with telemetry on vs off (the megastep
+  O(1)-transfers promise survives observation);
+- every request id add_request hands out lands in exactly one terminal
+  bucket (completed + aborted == submitted once drained), with a
+  finish_reason and a complete, monotone lifecycle stamp chain;
+- the exported views (/metrics text exposition, the jsonl event log,
+  histogram percentiles) faithfully reflect the engine's counters.
+"""
+
+import glob
+import json
+import math
+import os
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import (
+    FINISH_REASONS,
+    EventLog,
+    GenerationConfig,
+    Histogram,
+    LLMEngine,
+    Telemetry,
+    make_server,
+    prometheus_exposition,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("prefill_buckets", (16, 32, 64))
+    return LLMEngine(params, cfg, **kw)
+
+
+# --------------------------------------------------------------- histogram
+def test_histogram_percentiles_vs_numpy():
+    rng = np.random.RandomState(0)
+    samples = rng.lognormal(mean=-2.0, sigma=1.5, size=5000)
+    h = Histogram.log_spaced(1e-4, 600.0, 48)
+    h.observe_many(samples)
+    assert h.count == 5000
+    assert h.sum == pytest.approx(samples.sum())
+    # interpolated percentile lands within one log bucket of the exact
+    # answer: bounds ratio = (hi/lo)**(1/47), so relative error < ratio-1
+    ratio = (600.0 / 1e-4) ** (1.0 / 47)
+    for q in (50, 90, 99):
+        exact = np.percentile(samples, q)
+        got = h.percentile(q)
+        assert exact / ratio <= got <= exact * ratio, (q, got, exact)
+
+
+def test_histogram_edge_cases_and_merge():
+    h = Histogram([1.0, 2.0, 4.0])
+    assert math.isnan(h.percentile(50))
+    h.observe(3.0)
+    # single observation: every percentile is that value (min==max clamp)
+    assert h.percentile(0) == h.percentile(50) == h.percentile(100) == 3.0
+    h.observe(100.0)  # lands in the implicit +Inf bucket
+    assert h.bucket_counts[-1] == 1
+    assert h.percentile(100) == 100.0
+
+    other = Histogram([1.0, 2.0, 4.0])
+    other.observe(0.5)
+    merged = h.merge(other)
+    assert merged is h
+    assert h.count == 3 and h.min == 0.5 and h.max == 100.0
+    with pytest.raises(ValueError):
+        h.merge(Histogram([1.0, 2.0]))
+    with pytest.raises(ValueError):
+        Histogram([2.0, 1.0])
+    with pytest.raises(ValueError):
+        Histogram([])
+
+
+def test_histogram_prometheus_lines_cumulative():
+    h = Histogram([1.0, 2.0])
+    h.observe_many([0.5, 1.5, 5.0])
+    lines = h.prometheus_lines("x")
+    assert lines == [
+        'x_bucket{le="1"} 1',
+        'x_bucket{le="2"} 2',
+        'x_bucket{le="+Inf"} 3',
+        "x_sum 7",
+        "x_count 3",
+    ]
+
+
+def test_prometheus_exposition_skips_non_numeric():
+    text = prometheus_exposition(
+        {"a": 3, "policy": "fifo", "bad": float("nan")},
+        {"g": True},
+        {"h": Histogram([1.0])},
+    )
+    assert "# TYPE clt_a counter\nclt_a 3" in text
+    assert "policy" not in text and "bad" not in text
+    assert "# TYPE clt_g gauge\nclt_g 1" in text
+    assert 'clt_h_bucket{le="+Inf"} 0' in text
+
+
+# ------------------------------------------------------- request lifecycle
+def _drain(eng):
+    """Run the engine dry, returning every finished Request object."""
+    done = []
+    while eng.has_work:
+        done.extend(eng.step())
+    return done
+
+
+def test_lifecycle_stamps_monotone_for_each_finish_reason(parts, tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    eng = _engine(parts, event_log=log)
+    gen_len = GenerationConfig(max_new_tokens=5)
+    eng.add_request([1, 2, 3], gen_len)
+    (req_len,) = _drain(eng)
+    # eos: replay greedy output, stopping at its third token
+    gen_eos = GenerationConfig(max_new_tokens=5,
+                               eos_token_id=req_len.output_ids[2])
+    eng.add_request([1, 2, 3], gen_eos)
+    (req_eos,) = _drain(eng)
+    # abort: cancel after the request started running
+    rid = eng.add_request([4, 5, 6], gen_len)
+    eng.step()
+    req_abort = eng.running[next(iter(eng.running))]
+    assert eng.abort(rid)
+
+    done = {"length": req_len, "eos": req_eos, "aborted": req_abort}
+    for reason, req in done.items():
+        assert req.finish_reason == reason
+        assert reason in FINISH_REASONS
+        assert req.t_arrival is not None and req.t_finished is not None
+        stamps = [t for t in (req.t_arrival, req.t_admitted,
+                              req.t_first_token, req.t_finished)
+                  if t is not None]
+        assert stamps == sorted(stamps), (reason, stamps)
+        if reason != "aborted":
+            # natural finishes pass through every stage
+            assert req.t_admitted is not None
+            assert req.t_first_token is not None
+    assert req_eos.output_ids[-1] == gen_eos.eos_token_id
+
+    by_reason = {r["finish_reason"]: r for r in EventLog.read(log)}
+    assert set(by_reason) == {"length", "eos", "aborted"}
+    rec = by_reason["length"]
+    assert rec["generated_tokens"] == 5
+    assert rec["ttft_s"] >= rec["queue_wait_s"] >= 0
+    assert rec["e2e_s"] >= rec["ttft_s"]
+    assert by_reason["eos"]["generated_tokens"] == 3
+
+
+def test_truncated_requests_counted_and_stamped(parts, tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    # pool of 3 usable pages: an 8-token prompt takes 1, decode outgrows
+    # the rest mid-flight → truncation
+    eng = _engine(parts, max_batch_size=1, num_blocks=4, event_log=log)
+    out = eng.generate([list(range(1, 9))], GenerationConfig(max_new_tokens=60))[0]
+    assert 0 < len(out) < 60
+    assert eng.stats.requests_truncated == 1
+    assert eng.stats.requests_completed == 1  # truncated ⊂ completed
+    (rec,) = EventLog.read(log)
+    assert rec["finish_reason"] == "truncated"
+    assert rec["generated_tokens"] == len(out)
+
+
+def test_event_log_round_trip_and_append(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    with EventLog(path) as log:
+        log.emit({"event": "request", "request_id": 0, "x": 1.5})
+    with EventLog(path) as log:  # append mode: restart extends history
+        log.emit({"event": "request", "request_id": 1, "x": None})
+    recs = EventLog.read(path)
+    assert recs == [
+        {"event": "request", "request_id": 0, "x": 1.5},
+        {"event": "request", "request_id": 1, "x": None},
+    ]
+
+
+def test_group_abort_emits_one_record_with_group_size(parts, tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    eng = _engine(parts, event_log=log)
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True, temperature=0.9)
+    ids = eng.add_request([1, 2, 3], gen, n_samples=3)
+    assert eng.stats.requests_submitted == 3
+    assert eng.abort(ids[1])  # queued: the whole group leaves
+    assert eng.stats.requests_aborted == 3
+    (rec,) = EventLog.read(log)
+    assert rec["group_size"] == 3 and rec["finish_reason"] == "aborted"
+
+
+def test_telemetry_constructor_validation(parts):
+    with pytest.raises(ValueError, match="event_log"):
+        _engine(parts, telemetry=False, event_log="/tmp/x.jsonl")
+    with pytest.raises(ValueError, match="event_log"):
+        _engine(parts, telemetry=Telemetry(), event_log="/tmp/x.jsonl")
+    # a shared Telemetry aggregates across engines
+    tel = Telemetry()
+    eng = _engine(parts, telemetry=tel)
+    assert eng.telemetry is tel
+
+
+# ------------------------------------------- device-traffic non-regression
+def test_transfer_counters_identical_with_telemetry_on_and_off(parts, tmp_path):
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8]]
+    gen = GenerationConfig(max_new_tokens=6)
+    results = {}
+    for mode in ("off", "on"):
+        kw = ({"telemetry": False} if mode == "off"
+              else {"event_log": str(tmp_path / "ev.jsonl")})
+        eng = _engine(parts, megastep_k=2, **kw)
+        outs = eng.generate([list(p) for p in prompts], gen)
+        results[mode] = (outs, eng.stats)
+    outs_off, st_off = results["off"]
+    outs_on, st_on = results["on"]
+    assert outs_off == outs_on
+    # the O(1)-transfers contract is untouched by observation
+    assert st_on.decode_syncs == st_off.decode_syncs
+    assert st_on.decode_h2d_scalars == st_off.decode_h2d_scalars
+    assert st_on.decode_d2h_elements == st_off.decode_d2h_elements
+    assert st_on.decode_megasteps == st_off.decode_megasteps
+
+
+def test_null_telemetry_observes_nothing(parts):
+    eng = _engine(parts, telemetry=False)
+    eng.generate([[1, 2, 3]], GenerationConfig(max_new_tokens=3))
+    assert eng.telemetry.histograms == {}
+    assert eng.stats.requests_completed == 1  # counters still accounted
+
+
+# ------------------------------------------------------ EngineStats surface
+def test_stats_as_dict_snapshot_reset(parts):
+    eng = _engine(parts)
+    eng.generate([[1, 2, 3]], GenerationConfig(max_new_tokens=3))
+    d = eng.stats.as_dict()
+    assert d["decode_tokens"] == 2  # first token comes from prefill
+    assert d["requests_submitted"] == d["requests_completed"] == 1
+    assert "spec_acceptance_rate" in d
+    snap = eng.stats.snapshot()
+    eng.generate([[1, 2, 3]], GenerationConfig(max_new_tokens=3))
+    assert eng.stats.decode_tokens > snap.decode_tokens  # independent copy
+    eng.stats.reset()
+    assert all(v == 0 for k, v in eng.stats.as_dict().items())
+
+
+# --------------------------------------------------- counter-invariant gate
+def test_counter_invariants_mixed_workload(parts):
+    """The accounting gate: a workload mixing greedy, sampled, grouped,
+    aborted, and prefix-cache-hitting requests must satisfy every
+    cross-counter invariant once the engine drains."""
+    eng = _engine(parts, prefix_cache=True)
+    sys_prompt = list(range(1, 33))  # two full blocks, shared prefix
+    gen = GenerationConfig(max_new_tokens=4)
+    sampled = GenerationConfig(max_new_tokens=4, do_sample=True, top_k=8)
+
+    eng.generate([sys_prompt + [40]], gen)  # cold: populates the tree
+    rids = [eng.add_request(sys_prompt + [41 + i], gen) for i in range(2)]
+    rids += eng.add_request([1, 2, 3], sampled, n_samples=2)
+    victim = eng.add_request([5, 6, 7], gen)
+    eng.step()
+    eng.abort(victim)  # mid-flight abort (running or still waiting)
+    while eng.has_work:
+        eng.step()
+
+    st = eng.stats
+    assert st.requests_submitted == 6
+    assert st.requests_completed + st.requests_aborted == st.requests_submitted
+    assert st.requests_aborted >= 1
+    assert st.requests_truncated == 0
+    assert st.prefix_saved_tokens == st.prefix_hit_blocks * eng.block_size
+    assert st.prefix_hit_blocks > 0  # the warm requests really hit
+    assert st.decode_syncs == st.decode_megasteps  # one sync per megastep
+    assert st.spec_draft_tokens == st.spec_accepted_tokens == 0
+
+
+def test_counter_invariants_speculative(parts):
+    eng = _engine(parts, draft_len=2, self_draft_layers=1, megastep_k=2)
+    eng.generate([[1, 2, 3], [4, 5, 6]], GenerationConfig(max_new_tokens=8))
+    st = eng.stats
+    assert st.spec_draft_tokens > 0
+    assert st.spec_accepted_tokens <= st.spec_draft_tokens
+    assert 0.0 <= st.spec_acceptance_rate <= 1.0
+    assert st.requests_completed == st.requests_submitted == 2
+    # per-request attribution sums to the global counters
+    hist = eng.telemetry.histograms
+    assert hist["megastep_seconds"].count == st.decode_megasteps
+
+
+# ----------------------------------------------------------- HTTP endpoints
+@pytest.fixture()
+def served(parts):
+    eng = _engine(parts)
+    server, sched = make_server(eng, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield eng, base
+    server.shutdown()
+    sched.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _parse_exposition(text):
+    """{name: {"type": t, "samples": [(label_suffix, value), ...]}} — every
+    sample line must belong to a declared # TYPE family."""
+    families, cur = {}, None
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split()
+            families[name] = {"type": typ, "samples": []}
+            cur = name
+        else:
+            metric, value = line.rsplit(" ", 1)
+            base = metric.split("{")[0]
+            if base.endswith(("_bucket", "_sum", "_count")):
+                base = base.rsplit("_", 1)[0]
+            assert cur is not None and base == cur or base in families, line
+            families[base]["samples"].append((metric, float(value)))
+    return families
+
+
+def test_metrics_exposition_parses_and_counters_monotone(served):
+    eng, base = served
+    status, headers, text1 = _get(base + "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    fam1 = _parse_exposition(text1)
+    # every # TYPE family carries at least one sample
+    assert all(f["samples"] for f in fam1.values())
+    # every EngineStats counter is exported
+    for key in eng.stats.as_dict():
+        if key == "spec_acceptance_rate":
+            assert fam1[f"clt_{key}"]["type"] == "gauge"
+        else:
+            assert fam1[f"clt_{key}"]["type"] == "counter"
+    for name in ("ttft_seconds", "itl_seconds", "e2e_seconds",
+                 "queue_depth", "megastep_seconds"):
+        assert fam1[f"clt_{name}"]["type"] == "histogram"
+
+    code, out = _post(base, "/generate",
+                      {"prompt_ids": [1, 2, 3], "max_new_tokens": 4})
+    assert code == 200 and len(out["output_ids"]) == 4
+    _, _, text2 = _get(base + "/metrics")
+    fam2 = _parse_exposition(text2)
+    for name, f1 in fam1.items():
+        if f1["type"] != "counter":
+            continue
+        v1 = dict(f1["samples"])
+        v2 = dict(fam2[name]["samples"])
+        for metric, val in v1.items():
+            assert v2[metric] >= val, metric
+    assert dict(fam2["clt_requests_completed"]["samples"])[
+        "clt_requests_completed"] == 1
+    # the request's latencies landed in the histograms
+    assert dict(fam2["clt_ttft_seconds"]["samples"])[
+        "clt_ttft_seconds_count"] == 1
+
+
+def test_health_serializes_through_as_dict(served):
+    eng, base = served
+    _, _, text = _get(base + "/health")
+    payload = json.loads(text)
+    assert payload["status"] == "ok"
+    for key, val in eng.stats.as_dict().items():
+        assert key in payload
+    for key in ("running", "waiting", "prefilling", "free_blocks",
+                "megastep_k", "scheduler_policy", "prefix_cache",
+                "prefix_cache_blocks", "draft_len"):
+        assert key in payload
+
+
+def test_profile_endpoint_captures_annotated_trace(served, tmp_path):
+    eng, base = served
+    log_dir = str(tmp_path / "trace")
+    code, out = _post(base, "/profile", {"action": "start", "log_dir": log_dir})
+    assert code == 200 and out["profiling"] is True
+    # double start → 409 (jax.profiler is a process-global singleton)
+    code, _ = _post(base, "/profile", {"action": "start", "log_dir": log_dir})
+    assert code == 409
+    code, out = _post(base, "/generate",
+                      {"prompt_ids": [1, 2, 3], "max_new_tokens": 4})
+    assert code == 200
+    code, out = _post(base, "/profile", {"action": "stop"})
+    assert code == 200 and out["log_dir"] == log_dir
+    code, _ = _post(base, "/profile", {"action": "stop"})
+    assert code == 409
+    code, _ = _post(base, "/profile", {"action": "bogus"})
+    assert code == 400
+    files = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert files, "capture produced no trace"
+    blob = b"".join(open(f, "rb").read() for f in files)
+    # the engine-phase annotations are greppable in the serialized trace
+    assert b"decode_megastep" in blob
+    assert b"prefill" in blob
