@@ -150,6 +150,11 @@ class EngineStats:
     prefill_chunks: int = 0
     #: megasteps demoted to K=1 because the page pool couldn't fund K tokens
     fallback_k1: int = 0
+    # ---- MoE serving: decode (token, layer, expert-choice) routings,
+    # summed over experts — the per-expert split lives on
+    # ``LLMEngine.expert_load`` (an array would break as_dict's
+    # scalars-only contract)
+    moe_tokens_routed: int = 0
     # ---- prefix cache (prefix_cache=True): cross-request prompt reuse
     #: full prompt pages fork-shared from the radix tree at admission
     prefix_hit_blocks: int = 0
@@ -297,6 +302,7 @@ class LLMEngine:
         self_draft_layers: Optional[int] = None,
         telemetry: Union[bool, Telemetry] = True,
         event_log: Optional[str] = None,
+        moe_impl: str = "auto",
     ):
         self.config = config
         # ---- observability: lifecycle stamps + histograms are host-side
@@ -449,6 +455,49 @@ class LLMEngine:
             self.draft_cache = init_paged_cache(
                 self.draft_config, num_blocks, block_size, dtype=dtype
             )
+        # ---- MoE serving (Mixtral/Qwen2-MoE param trees): the decode
+        # forwards route each token through the expert MLP; ``moe_impl``
+        # picks the expert path — "fused" resolves through the fused_moe
+        # kernel op (Pallas on TPU, the math-identical XLA slot-map
+        # reference elsewhere), "reference" forces dispatch/combine
+        # einsums, "auto" = fused on TPU. Greedy outputs are bitwise
+        # identical either way (the MoE engine tests pin it). Prefill
+        # always runs the reference path (both paths share it, and a
+        # long-prompt slot grid would not fit the kernel's VMEM budget).
+        if moe_impl not in ("auto", "fused", "reference"):
+            raise ValueError(
+                f"moe_impl={moe_impl!r}: pass 'auto', 'fused', or "
+                "'reference'"
+            )
+        self.moe_impl = moe_impl
+        _tree = params["params"] if "params" in params else params
+        self._moe = (
+            "moe" in _tree["layers"]["block"]
+            and getattr(config, "num_experts", 0) > 0
+        )
+        if self._moe:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "MoE serving is single-device only for now — drop the "
+                    "mesh (the expert stacks have no tp/pp placement)"
+                )
+            if draft_len > 0:
+                raise NotImplementedError(
+                    "speculative decoding does not compose with MoE "
+                    "serving yet — drop draft_len"
+                )
+        self._moe_fused = self._moe and (
+            moe_impl == "fused"
+            or (moe_impl == "auto" and jax.default_backend() == "tpu")
+        )
+        #: cumulative routed tokens per expert (host-side np.int64 [E]; a
+        #: plain array, NOT an EngineStats field — as_dict stays scalar).
+        #: Fed by the megastep's expert_counts output, which is fetched in
+        #: the same single sync as the token buffer REGARDLESS of whether
+        #: telemetry is enabled, so device traffic is invariant.
+        self.expert_load = (
+            np.zeros((config.num_experts,), np.int64) if self._moe else None
+        )
         self._pp = 0
         if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
             # pipeline-parallel decode: layers (weights AND pages) live on
@@ -1102,15 +1151,19 @@ class LLMEngine:
                     self._dev_sample, keys, k_steps=k, use_sampling=any_sample,
                 )
             else:
-                (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
-                 self._dev_budget, self.cache) = decode_megastep(
+                out = decode_megastep(
                     self.params, self.config, self._dev_tokens,
                     self._dev_tables, self._dev_lengths, self.cache,
                     self._dev_active, self._dev_budget, self._dev_eos,
                     self._dev_temp, self._dev_topk, self._dev_topp,
                     self._dev_sample, keys, k_steps=k,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
+                    moe_fused=self._moe_fused,
                 )
+                # MoE param trees append the [E] expert_counts tally
+                expert_counts = out[7] if self._moe else None
+                (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
+                 self._dev_budget, self.cache) = out[:7]
             # the ONE host sync per megastep: K×S ids + per-slot counts/flags
             buf_np = self._fetch(buf)
             emitted_np = self._fetch(emitted)
@@ -1119,6 +1172,12 @@ class LLMEngine:
                 passes_np = self._fetch(passes)
                 drafted_np = self._fetch(drafted)
                 accepted_np = self._fetch(accepted)
+            # ALWAYS fetched for MoE models — never gated on telemetry, so
+            # enabling/disabling observability cannot change device traffic
+            # (the PR-5 invariance contract test_telemetry pins)
+            counts_np = (
+                self._fetch(expert_counts) if self._moe and d == 0 else None
+            )
         self.telemetry.observe_megastep(time.perf_counter() - t_mega)
         self.stats.decode_megasteps += 1
         self.stats.decode_syncs += 1
@@ -1132,6 +1191,17 @@ class LLMEngine:
             self.stats.spec_target_passes += int(passes_np.sum())
             self.stats.spec_draft_tokens += int(drafted_np.sum())
             self.stats.spec_accepted_tokens += int(accepted_np.sum())
+        if counts_np is not None:
+            self.stats.decode_d2h_elements += counts_np.size
+            self.expert_load += counts_np.astype(np.int64)
+            routed = int(counts_np.sum())
+            self.stats.moe_tokens_routed += routed
+            if routed:
+                # load imbalance this megastep: max/mean tokens-per-expert
+                # (1.0 = perfectly balanced, num_experts = one hot expert)
+                self.telemetry.observe_moe_imbalance(
+                    float(counts_np.max()) * counts_np.size / routed
+                )
         for slot, req in list(self.running.items()):
             t = int(emitted_np[slot])
             toks = [int(x) for x in buf_np[slot, :t]]

@@ -54,7 +54,7 @@ def _proj(h, leaf, dtype):
 
 
 def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
-                tp_axis=None):
+                tp_axis=None, moe_fused=False, return_moe_routing=False):
     """One decoder block over x [B, S, H] attending to the cache + itself.
 
     k_cache/v_cache: [B, S_max, Hkv, D] already containing THIS x's K/V at
@@ -65,6 +65,13 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     column-sliced) and ``tp_axis`` names the axis to psum the o_proj /
     down_proj row-matmul partials over (the Megatron pattern, manual
     collectives because shard_map sees per-device values).
+
+    A layer with a ``"moe"`` param subtree (Mixtral/Qwen2-MoE families)
+    takes the routed expert MLP instead of the dense tail; ``moe_fused``
+    selects the fused-kernel expert path. With ``return_moe_routing`` the
+    return becomes ``(x, (routing, capacity) | None)`` so the decode paths
+    can derive per-expert load counts (pytree structure is static, so the
+    conditional arity is trace-safe).
     """
     dtype = x.dtype
     eps = cfg.rms_norm_eps
@@ -97,10 +104,21 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     x = x + _row_out(attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype))
 
     h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
+    if "moe" in p:
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "MoE layers are not supported under a tp shard_map"
+            )
+        from .moe_modeling import moe_ffn
+
+        y, routing, cap = moe_ffn(cfg, p["moe"], h, fused=moe_fused)
+        x = x + y
+        return (x, (routing, cap)) if return_moe_routing else x
     gate = h @ p["mlp"]["gate_proj"]["kernel"].astype(dtype)
     up = h @ p["mlp"]["up_proj"]["kernel"].astype(dtype)
     act = jax.nn.silu(gate) * up
-    return x + _row_out(act @ p["mlp"]["down_proj"]["kernel"].astype(dtype))
+    x = x + _row_out(act @ p["mlp"]["down_proj"]["kernel"].astype(dtype))
+    return (x, None) if return_moe_routing else x
 
 
 def _project_kv(cfg, p, h_normed, positions):

@@ -1,0 +1,137 @@
+"""Inference-side MoE expert MLP over raw (unwrapped) params.
+
+The serving forwards (``modeling.py`` / ``paged_modeling.py``) run the
+param tree functionally; a Mixtral/Qwen2-MoE layer carries a ``"moe"``
+subtree instead of ``"mlp"`` — :func:`moe_ffn` is the expert-MLP hook
+they call for those layers. Two expert paths, selectable per call:
+
+- ``fused=False`` — the XLA reference: ``top_k_routing_sorted`` →
+  ``dispatch_sorted`` → stacked-expert einsums (+ ``silu_and_mul``) →
+  ``combine_sorted``. CPU-testable, and the parity baseline.
+- ``fused=True`` — the same routing, then the ``fused_moe`` kernel op
+  (Pallas on TPU; the math-identical XLA slot-map reference elsewhere)
+  for gather + expert FFN + weighted combine in one kernel.
+
+Inference routing is DROPLESS: capacity covers every token's every
+choice (training's ``capacity_factor`` drops would corrupt decode
+deterministically). Both paths share one routing, so greedy outputs are
+bitwise-identical between them — the invariant the MoE engine tests pin.
+Shared experts (DeepSeek-MoE / Qwen2-MoE style) and DeepSeek's sigmoid /
+group-limited / score-correction-bias routing knobs follow the training
+module (``models/mixtral.py:MoEMLP``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from colossalai_tpu.kernel.ops import fused_moe, silu_and_mul
+from colossalai_tpu.moe.router import (
+    SortedRouting,
+    combine_sorted,
+    dispatch_sorted,
+    top_k_routing_sorted,
+)
+
+
+def inference_capacity(n_tokens: int) -> int:
+    """Dropless per-expert capacity for a batch of ``n_tokens`` (every
+    token could route its every choice to one expert), padded to the f32
+    sublane multiple so the fused kernel's slot grid tiles cleanly."""
+    return max(-(-n_tokens // 8) * 8, 8)
+
+
+def routing_slot_map(r: SortedRouting, num_experts: int, capacity: int,
+                     n_tokens: int):
+    """SortedRouting → the fused kernel's [E, C] layout: ``rows`` source
+    token per slot (``n_tokens`` = the zero parking row for empty slots)
+    and ``gates`` combine weight per slot (0 for empty)."""
+    ec = num_experts * capacity
+    # dest == E*C for dropped entries lands in the discarded overflow tail
+    rows = jnp.full((ec + 1,), n_tokens, jnp.int32).at[r.dest].set(
+        r.tok.astype(jnp.int32)
+    )
+    gates = jnp.zeros((ec + 1,), jnp.float32).at[r.dest].set(
+        r.gate.astype(jnp.float32)
+    )
+    return (rows[:ec].reshape(num_experts, capacity),
+            gates[:ec].reshape(num_experts, capacity))
+
+
+def moe_expert_counts(r: SortedRouting, capacity: int, num_experts: int,
+                      token_weight) -> jax.Array:
+    """Per-expert routed-token counts [E] int32, weighting each token by
+    ``token_weight`` [N] (0/1 — masks out inactive decode slots so their
+    garbage routing never pollutes the load statistics)."""
+    w = token_weight.astype(jnp.int32)[r.tok]
+    return jnp.zeros((num_experts + 1,), jnp.int32).at[
+        r.dest // capacity
+    ].add(w)[:num_experts]
+
+
+def moe_ffn(cfg, mp, h, fused: bool = False):
+    """Routed expert MLP over normalized hidden states h [..., H].
+
+    ``mp`` is the layer's ``"moe"`` param subtree (see
+    ``models/mixtral.py:MoEMLP`` for the key layout). Returns
+    ``(y [..., H], routing, capacity)`` — routing/capacity feed
+    :func:`moe_expert_counts` on the decode path.
+    """
+    dtype = h.dtype
+    lead = h.shape[:-1]
+    hidden = h.shape[-1]
+    h2 = h.reshape(-1, hidden)
+    n = h2.shape[0]
+    e = cfg.num_experts
+    k = cfg.num_experts_per_tok
+    cap = inference_capacity(n)
+
+    gate_kw = {}
+    if cfg.scoring_func != "softmax" or cfg.n_group > 1:
+        gate_kw = dict(
+            scoring=cfg.scoring_func, n_group=cfg.n_group,
+            topk_group=cfg.topk_group,
+        )
+    if cfg.use_score_correction_bias:
+        gate_kw["selection_bias"] = mp["router/e_score_correction_bias"]
+
+    logits = (h2 @ mp["router/kernel"].astype(dtype)).astype(jnp.float32)
+    r = top_k_routing_sorted(logits, k, cap, cfg.norm_topk_prob, **gate_kw)
+
+    w_gate = mp["experts_gate/kernel"].astype(dtype)
+    w_up = mp["experts_up/kernel"].astype(dtype)
+    w_down = mp["experts_down/kernel"].astype(dtype)
+
+    if fused:
+        rows, gates = routing_slot_map(r, e, cap, n)
+        y = fused_moe(h2, w_gate, w_up, w_down, rows, gates, top_k=k)
+    else:
+        expert_in = dispatch_sorted(h2, r, e, cap)  # [E, C, H]
+        gate = jnp.einsum("ech,ehi->eci", expert_in, w_gate,
+                          preferred_element_type=jnp.float32)
+        up = jnp.einsum("ech,ehi->eci", expert_in, w_up,
+                        preferred_element_type=jnp.float32)
+        act = silu_and_mul(jnp.concatenate([gate, up], axis=-1)).astype(dtype)
+        down = jnp.einsum("eci,eih->ech", act, w_down,
+                          preferred_element_type=jnp.float32)
+        y = combine_sorted(down.astype(dtype), r, n)
+
+    scale = getattr(cfg, "routed_scaling_factor", 1.0)
+    if scale != 1.0:
+        y = y * jnp.asarray(scale, y.dtype)
+
+    if cfg.n_shared_experts > 0:
+        sp = mp["shared_expert"]
+        sg = h2 @ sp["gate_proj"]["kernel"].astype(dtype)
+        su = h2 @ sp["up_proj"]["kernel"].astype(dtype)
+        so = silu_and_mul(jnp.concatenate([sg, su], axis=-1)) @ sp[
+            "down_proj"
+        ]["kernel"].astype(dtype)
+        if cfg.shared_expert_gate:
+            so = jax.nn.sigmoid(
+                h2 @ mp["shared_expert_gate/kernel"].astype(dtype)
+            ) * so
+        y = y + so
+
+    return y.reshape(*lead, hidden).astype(dtype), r, cap

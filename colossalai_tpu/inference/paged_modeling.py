@@ -36,6 +36,7 @@ from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
 from .kv_cache import PagedKVCache
 from .modeling import _block_step, _proj, _project_kv, _rms
+from .moe_modeling import moe_expert_counts, moe_ffn
 
 
 def _logits_head(p, cfg: LlamaConfig, x) -> jax.Array:
@@ -198,12 +199,21 @@ def prefill_chunk_paged(
 
 
 def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
-                 cache_v, active, use_kernel: bool):
+                 cache_v, active, use_kernel: bool, moe_fused: bool = False):
     """One decode iteration over unwrapped params: tokens [S] at positions
-    ``lengths`` → (logits [S, V], k pool, v pool). The shared core of
-    ``decode_paged`` (K=1, jitted per call) and ``decode_megastep`` (traced
-    K times inside one fori_loop)."""
+    ``lengths`` → (logits [S, V], k pool, v pool, expert_counts). The shared
+    core of ``decode_paged`` (K=1, jitted per call) and ``decode_megastep``
+    (traced K times inside one fori_loop).
+
+    For MoE param trees (a ``"moe"`` layer subtree) the MLP is the routed
+    expert path (``moe_fused`` picks the fused kernel vs the XLA
+    reference) and ``expert_counts`` is the [num_experts] int32 tokens-per-
+    expert tally summed over layers and ACTIVE slots — the device-side
+    source of the engine's expert-load telemetry. Dense models return
+    ``None`` (param structure is static, so the arity is trace-safe)."""
     stacked = p["layers"]["block"]
+    has_moe = "moe" in stacked and getattr(cfg, "num_experts", 0) > 0
+    n_experts = cfg.num_experts if has_moe else 0
     dtype = cfg.dtype or jnp.bfloat16
     n_slots = tokens.shape[0]
     bs = cache_k.shape[3]
@@ -220,7 +230,7 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
     attend = (kv_pos <= lengths[:, None])  # includes the new token's position
 
     def layer(carry, inputs):
-        x, i = carry
+        x, counts, i = carry
         layer_params, k_pool, v_pool = inputs
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
         k, v = _project_kv(cfg, layer_params, h, positions)  # [S,1,Hkv,D]
@@ -252,9 +262,14 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
                 x, attn_out, layer_params["post_attention_layernorm"]["scale"],
                 eps=cfg.rms_norm_eps,
             )
-            gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
-            up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
-            x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
+            if has_moe:
+                y, r, cap = moe_ffn(cfg, layer_params["moe"], h2, fused=moe_fused)
+                x = x + y
+                counts = counts + moe_expert_counts(r, cap, n_experts, active)
+            else:
+                gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
+                up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
+                x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
         else:
             # XLA path: gather this slot's pages into a contiguous view
             # [S, max_blocks, Hkv, bs, D] → [S, s_max, Hkv, D]
@@ -265,19 +280,28 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
 
             k_seq = to_seq(k_pool)
             v_seq = to_seq(v_pool)
-            x = _block_step(cfg, layer_params, x, k_seq, v_seq, positions, attend)
-        return (x, i + 1), (k_pool, v_pool)
+            x, moe_aux = _block_step(
+                cfg, layer_params, x, k_seq, v_seq, positions, attend,
+                moe_fused=moe_fused, return_moe_routing=True,
+            )
+            if has_moe:
+                r, cap = moe_aux
+                counts = counts + moe_expert_counts(r, cap, n_experts, active)
+        return (x, counts, i + 1), (k_pool, v_pool)
 
-    (x, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), 0), (stacked, cache_k, cache_v)
+    counts0 = jnp.zeros((n_experts,), jnp.int32)
+    (x, counts, _), (k_new, v_new) = jax.lax.scan(
+        layer, (x.astype(dtype), counts0, 0), (stacked, cache_k, cache_v)
     )
-    return _logits_head(p, cfg, x)[:, 0], k_new, v_new
+    return (_logits_head(p, cfg, x)[:, 0], k_new, v_new,
+            counts if has_moe else None)
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_kernel"), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg", "use_kernel", "moe_fused"),
+         donate_argnames=("cache",))
 def decode_paged(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
-    active, use_kernel: bool = False,
+    active, use_kernel: bool = False, moe_fused: bool = False,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """One token per slot through the paged pool.
 
@@ -285,14 +309,16 @@ def decode_paged(
     cache); active [S] bool. Returns (logits [S, V], cache).
     """
     p = params["params"] if "params" in params else params
-    logits, k_new, v_new = _decode_once(
-        p, cfg, tokens, block_tables, lengths, cache.k, cache.v, active, use_kernel
+    logits, k_new, v_new, _ = _decode_once(
+        p, cfg, tokens, block_tables, lengths, cache.k, cache.v, active,
+        use_kernel, moe_fused,
     )
     return logits, PagedKVCache(k=k_new, v=v_new)
 
 
 def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
-                 cache_k, cache_v, active, use_kernel: bool):
+                 cache_k, cache_v, active, use_kernel: bool,
+                 moe_fused: bool = False):
     """One MULTI-TOKEN decode iteration: tokens [S, W] at positions
     ``lengths .. lengths+W-1`` → (logits [S, W, V], k pool, v pool).
 
@@ -308,6 +334,7 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
     corrupt the LAST real page when a draft window overruns its funding.
     Their logits still compute (garbage) and the caller discards them."""
     stacked = p["layers"]["block"]
+    has_moe = "moe" in stacked and getattr(cfg, "num_experts", 0) > 0
     dtype = cfg.dtype or jnp.bfloat16
     n_slots, w = tokens.shape
     bs = cache_k.shape[3]
@@ -363,9 +390,13 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                 x, attn_out, layer_params["post_attention_layernorm"]["scale"],
                 eps=cfg.rms_norm_eps,
             )
-            gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
-            up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
-            x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
+            if has_moe:
+                y, _, _ = moe_ffn(cfg, layer_params["moe"], h2, fused=moe_fused)
+                x = x + y
+            else:
+                gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
+                up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
+                x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
         else:
             def to_seq(pool):
                 g = pool[block_tables]  # [S, mb, Hkv, bs, D]
@@ -373,7 +404,7 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                 return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
 
             x = _block_step(cfg, layer_params, x, to_seq(k_pool), to_seq(v_pool),
-                            positions, attend)
+                            positions, attend, moe_fused=moe_fused)
         return (x, i + 1), (k_pool, v_pool)
 
     (x, _), (k_new, v_new) = jax.lax.scan(
@@ -382,10 +413,11 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
     return _logits_head(p, cfg, x), k_new, v_new
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_kernel"), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg", "use_kernel", "moe_fused"),
+         donate_argnames=("cache",))
 def verify_paged(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
-    active, use_kernel: bool = False,
+    active, use_kernel: bool = False, moe_fused: bool = False,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """W tokens per slot through the paged pool in ONE forward — the
     standalone multi-token verify entry (the speculative megastep traces
@@ -397,21 +429,22 @@ def verify_paged(
     limits = lengths + tokens.shape[1]
     logits, k_new, v_new = _extend_once(
         p, cfg, tokens, block_tables, lengths, limits, cache.k, cache.v,
-        active, use_kernel,
+        active, use_kernel, moe_fused,
     )
     return logits, PagedKVCache(k=k_new, v=v_new)
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "k_steps", "use_kernel", "use_sampling"),
+    static_argnames=("cfg", "k_steps", "use_kernel", "use_sampling", "moe_fused"),
     donate_argnames=("cache",),
 )
 def decode_megastep(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
     k_steps: int, use_kernel: bool = False, use_sampling: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    moe_fused: bool = False,
+):
     """Device-resident decode loop: ``k_steps`` iterations of
     forward→sample→commit inside one ``lax.fori_loop`` — ONE dispatch and
     ONE host sync per K tokens instead of per token.
@@ -431,42 +464,55 @@ def decode_megastep(
     reserved null page, like an inactive slot). Returns
     ``(buf [S, k_steps] emitted ids (-1 = nothing), emitted [S], alive [S],
     tokens, lengths, budgets, cache)`` — the last three are the advanced
-    device state the scheduler keeps for the next megastep.
+    device state the scheduler keeps for the next megastep. MoE param
+    trees append an eighth element: ``expert_counts [num_experts]`` int32,
+    tokens-per-expert summed over the K iterations, layers, and active
+    slots (``moe_fused`` picks the fused vs reference expert path).
     """
     p = params["params"] if "params" in params else params
+    has_moe = "moe" in p["layers"]["block"] and getattr(cfg, "num_experts", 0) > 0
+    n_experts = cfg.num_experts if has_moe else 0
 
     def decode_once(tok, lens, ck, cv, alive):
         return _decode_once(
-            p, cfg, tok, block_tables, lens, ck, cv, alive, use_kernel
+            p, cfg, tok, block_tables, lens, ck, cv, alive, use_kernel,
+            moe_fused,
         )
 
     return megastep_loop(
         decode_once, tokens, lengths, cache, active, budgets, eos_ids,
         temp, topk, topp, do_sample, rng_keys, k_steps, use_sampling,
+        n_experts=n_experts,
     )
 
 
 def megastep_loop(
     decode_once, tokens, lengths, cache: PagedKVCache, active, budgets,
     eos_ids, temp, topk, topp, do_sample, rng_keys, k_steps: int,
-    use_sampling: bool,
+    use_sampling: bool, n_experts: int = 0,
 ):
     """The megastep's per-iteration bookkeeping (buffer commit, length/
     budget advance, eos/done flags) around any single-iteration decode —
-    ``decode_once(tok, lens, ck, cv, alive) → (logits [S, V], ck, cv)``.
-    Shared by :func:`decode_megastep` (single-stage ``_decode_once``) and
-    the pipeline-parallel megastep (pp_decode's shard_map relay), so both
-    advance device state identically. Must be called under jit (traces a
-    ``fori_loop``)."""
+    ``decode_once(tok, lens, ck, cv, alive) → (logits [S, V], ck, cv,
+    expert_counts | None)``. Shared by :func:`decode_megastep`
+    (single-stage ``_decode_once``) and the pipeline-parallel megastep
+    (pp_decode's shard_map relay), so both advance device state
+    identically. Must be called under jit (traces a ``fori_loop``).
+
+    With ``n_experts > 0`` the per-iteration expert counts accumulate on
+    device and the return gains a trailing ``expert_counts [n_experts]``
+    element."""
     n_slots = tokens.shape[0]
     buf0 = jnp.full((n_slots, k_steps), -1, jnp.int32)
 
     def body(i, carry):
-        ck, cv, tok, lens, alive, budg, buf, emitted = carry
+        ck, cv, tok, lens, alive, budg, buf, emitted, counts = carry
         # named HLO regions: a /profile capture splits each megastep
         # iteration into forward vs sample/commit time
         with jax.named_scope("decode_iter"):
-            logits, ck, cv = decode_once(tok, lens, ck, cv, alive)
+            logits, ck, cv, step_counts = decode_once(tok, lens, ck, cv, alive)
+        if n_experts:
+            counts = counts + step_counts
         if use_sampling:
             nxt = sample_tokens(logits, rng_keys[i], temp, topk, topp, do_sample)
         else:
@@ -480,11 +526,13 @@ def megastep_loop(
         hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
         tok = jnp.where(alive, nxt, tok)
         alive = alive & ~hit_eos & (budg > 0)
-        return (ck, cv, tok, lens, alive, budg, buf, emitted)
+        return (ck, cv, tok, lens, alive, budg, buf, emitted, counts)
 
     init = (cache.k, cache.v, tokens, lengths, active, budgets, buf0,
-            jnp.zeros((n_slots,), jnp.int32))
-    ck, cv, tok, lens, alive, budg, buf, emitted = jax.lax.fori_loop(
+            jnp.zeros((n_slots,), jnp.int32),
+            jnp.zeros((n_experts,), jnp.int32))
+    ck, cv, tok, lens, alive, budg, buf, emitted, counts = jax.lax.fori_loop(
         0, k_steps, body, init
     )
-    return buf, emitted, alive, tok, lens, budg, PagedKVCache(k=ck, v=cv)
+    out = (buf, emitted, alive, tok, lens, budg, PagedKVCache(k=ck, v=cv))
+    return out + (counts,) if n_experts else out

@@ -326,9 +326,10 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
         shape as :func:`..paged_modeling.decode_megastep`."""
 
         def decode_once(tok, lens, ck, cv, alive):
-            return _decode_relay(
+            logits, ck, cv = _decode_relay(
                 top, stacked, tok, block_tables, lens, ck, cv, alive
             )
+            return logits, ck, cv, None  # pp stages are dense-only (no MoE)
 
         return megastep_loop(
             decode_once, tokens, lengths, cache, active, budgets, eos_ids,

@@ -245,10 +245,19 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     # match the EngineStats field names, so /health can
                     # never drift from the dataclass again
                     payload.update(engine.stats.as_dict())
+                    if engine.expert_load is not None:
+                        payload["moe_expert_load"] = [
+                            int(c) for c in engine.expert_load
+                        ]
                 self._json(200, payload)
             elif self.path == "/metrics":
                 with sched.lock:
                     counters = engine.stats.as_dict()
+                    if engine.expert_load is not None:
+                        # per-expert cumulative routed tokens, one counter
+                        # series per expert index
+                        for i, c in enumerate(engine.expert_load):
+                            counters[f"moe_expert_tokens_{i}"] = int(c)
                     gauges = self._occupancy()
                     # a ratio is a gauge, not a counter (it can go down)
                     gauges["spec_acceptance_rate"] = \

@@ -231,6 +231,9 @@ _HISTOGRAM_SPECS = {
     "queue_wait_seconds": lambda: Histogram.log_spaced(1e-5, 600.0, 48),
     "queue_depth": lambda: Histogram([2 ** i for i in range(13)]),  # 1..4096
     "megastep_seconds": lambda: Histogram.log_spaced(1e-4, 60.0, 40),
+    # MoE expert-load imbalance per megastep: max/mean tokens-per-expert
+    # (1.0 = balanced … num_experts = every token on one expert)
+    "moe_imbalance": lambda: Histogram.log_spaced(1.0, 64.0, 13),
 }
 
 
@@ -326,6 +329,13 @@ class Telemetry:
         measured once per K tokens, so the hot loop never sees a timer."""
         self.histograms["megastep_seconds"].observe(seconds)
 
+    def observe_moe_imbalance(self, ratio: float) -> None:
+        """Expert-load imbalance of one MoE megastep (max/mean tokens per
+        expert) — computed from the expert_counts the engine fetches in
+        its single megastep sync anyway, so observing it costs no device
+        traffic."""
+        self.histograms["moe_imbalance"].observe(ratio)
+
     # ----------------------------------------------------------------- misc
     def reset(self) -> None:
         """Zero the histograms (benchmarks reset after warmup); lifecycle
@@ -367,6 +377,9 @@ class NullTelemetry:
         pass
 
     def observe_megastep(self, seconds: float) -> None:
+        pass
+
+    def observe_moe_imbalance(self, ratio: float) -> None:
         pass
 
     def reset(self) -> None:

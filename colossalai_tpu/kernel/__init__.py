@@ -12,6 +12,7 @@ from .ops import (
     flash_attention,
     fused_add_rms_norm,
     fused_layer_norm,
+    fused_moe,
     fused_rms_norm,
     fused_softmax,
     rope_and_cache_update,
@@ -24,6 +25,7 @@ __all__ = [
     "flash_attention",
     "fused_add_rms_norm",
     "fused_layer_norm",
+    "fused_moe",
     "fused_rms_norm",
     "fused_softmax",
     "rope_and_cache_update",

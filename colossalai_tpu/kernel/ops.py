@@ -228,3 +228,58 @@ def rope_and_cache_update(q, k, v, k_cache, v_cache, lengths, theta: float = 100
 def silu_and_mul(gate_up: jax.Array) -> jax.Array:
     gate, up = jnp.split(gate_up, 2, axis=-1)
     return jax.nn.silu(gate) * up
+
+
+# ---------------------------------------------------------------- fused MoE
+# ≙ the route→permute→expert-matmul→unpermute chain, collapsed: Pallas on
+# TPU (kernel/pallas/fused_moe.py), gather/einsum/scatter reference in XLA
+# (the same math as moe/router.py's dispatch_sorted + combine_sorted over
+# the slot-map layout).
+
+
+def _fused_moe_xla(x, w_gate, w_up, w_down, rows, gates, top_k=None,
+                   block_i=None):
+    n, h = x.shape
+    e, c = rows.shape
+    # gather: empty slots (rows == n) pull the zero parking row, exactly
+    # like dispatch_sorted's untouched zero buffer entries
+    xp = jnp.concatenate([x, jnp.zeros((1, h), x.dtype)], axis=0)
+    gathered = xp[rows]  # [E, C, H]
+    gate = jnp.einsum("ech,ehi->eci", gathered, w_gate,
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("ech,ehi->eci", gathered, w_up,
+                    preferred_element_type=jnp.float32)
+    act = silu_and_mul(jnp.concatenate([gate, up], axis=-1)).astype(x.dtype)
+    down = jnp.einsum("eci,eih->ech", act, w_down,
+                      preferred_element_type=jnp.float32)
+    out = down.astype(x.dtype) * gates.astype(x.dtype)[..., None]
+    # combine: gate-weighted scatter-add back onto source token rows; the
+    # parking row (index n) absorbs empty-slot zeros and is sliced off
+    acc = jnp.zeros((n + 1, h), x.dtype).at[rows.reshape(-1)].add(
+        out.reshape(e * c, h)
+    )
+    return acc[:n]
+
+
+def _fused_moe_pallas(x, w_gate, w_up, w_down, rows, gates, top_k=None,
+                      block_i=None):
+    from .pallas.fused_moe import fused_moe as impl
+
+    return impl(x, w_gate, w_up, w_down, rows, gates, top_k=top_k,
+                block_i=block_i)
+
+
+KernelLoader.register("fused_moe", "pallas", _pallas_module("fused_moe"), _fused_moe_pallas)
+KernelLoader.register("fused_moe", "xla", lambda: True, _fused_moe_xla)
+
+
+def fused_moe(x, w_gate, w_up, w_down, rows, gates, top_k=None):
+    """Fused top-k gather + per-expert gate/up/silu_and_mul/down + weighted
+    combine over a [E, C] slot→token map (see
+    ``inference/moe_modeling.py:routing_slot_map``). x [N, H]; w_gate/w_up
+    [E, H, I]; w_down [E, I, H]; rows [E, C] int32 (N = empty slot); gates
+    [E, C] combine weights. Returns [N, H]. ``top_k`` keys the Pallas
+    kernel's tuning-cache lookup."""
+    return KernelLoader.load("fused_moe")(
+        x, w_gate, w_up, w_down, rows, gates, top_k=top_k
+    )

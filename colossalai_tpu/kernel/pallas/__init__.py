@@ -10,6 +10,7 @@ from .flash_attention import (
     flash_attention,
     flash_attention_with_lse,
 )
+from .fused_moe import fused_moe
 from .layer_norm import layer_norm
 from .paged_attention import paged_attention
 from .rms_norm import fused_add_rms_norm, rms_norm
@@ -23,6 +24,7 @@ __all__ = [
     "flash_attention",
     "flash_attention_with_lse",
     "fused_add_rms_norm",
+    "fused_moe",
     "fused_rope",
     "layer_norm",
     "paged_attention",

@@ -1,0 +1,181 @@
+"""Fused MoE expert dispatch + FFN + combine.
+
+One ``pallas_call`` replaces the route → permute → expert-matmul →
+unpermute chain (the canonical MoE serving bottleneck: each stage is a
+separate op and the [E, C, H] dispatch buffer round-trips through HBM
+twice). Per expert the kernel
+
+- gathers the expert's routed tokens straight out of the [N, H] token
+  array via a scalar-prefetched slot→token map (``rows``),
+- runs gate/up projections + silu_and_mul + down projection as
+  intermediate-dim-tiled MXU matmuls (f32 accumulation), and
+- scatter-adds the gate-weighted result back into the shared [N, H]
+  output.
+
+Grid is (num_experts, I // block_i), expert-major: the gathered token
+tile loads once per expert and is reused across every intermediate tile.
+``block_i`` comes from the persistent tuning cache keyed per
+(device_kind, num_experts, top_k, H, I, dtype, qlen-bucket) — see
+``kernel/tuning.py:fused_moe_block_i``. Off-TPU the default is a single
+full-width tile, which keeps the math op-for-op identical to the XLA
+reference (``kernel/ops.py:_fused_moe_xla``) under interpret mode.
+
+Routing layout (produced by ``inference/moe_modeling.py:routing_slot_map``
+from ``moe/router.py:top_k_routing_sorted``):
+
+- ``rows`` [E, C] int32 — source token index per expert slot; empty slots
+  point at the zero parking row appended past the real tokens;
+- ``gates`` [E, C] f32 — combine weight per slot (0 for empty slots).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import tuning
+from ._common import interpret_mode
+
+
+def _kernel(rows_ref, x_ref, wg_ref, wu_ref, wd_ref, gates_ref, o_ref,
+            gath_ref, acc_ref, *, capacity: int, n_i: int):
+    e = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when((e == 0) & (i == 0))
+    def _init_out():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(i == 0)
+    def _gather():
+        # top-k gather: one dynamic row copy per expert slot (empty slots
+        # pull the zero parking row — their gate weight is 0 anyway)
+        def row(c, _):
+            src = rows_ref[e, c]
+            pl.store(
+                gath_ref, (pl.ds(c, 1), slice(None)),
+                pl.load(x_ref, (pl.ds(src, 1), slice(None))),
+            )
+            return 0
+
+        jax.lax.fori_loop(0, capacity, row, 0)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    toks = gath_ref[...]
+    g = jnp.dot(toks, wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(toks, wu_ref[0], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(g) * u).astype(toks.dtype)  # silu_and_mul, tiled
+    acc_ref[...] += jnp.dot(act, wd_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_i - 1)
+    def _combine():
+        w = gates_ref[0].astype(o_ref.dtype)  # [C]
+        out = acc_ref[...].astype(o_ref.dtype) * w[:, None]
+
+        # weighted combine: scatter-add each slot's contribution back onto
+        # its source token row (a token's k expert outputs accumulate in
+        # ascending expert order — the same order as the sorted-routing
+        # combine scatter)
+        def row(c, _):
+            dst = rows_ref[e, c]
+            contrib = jax.lax.dynamic_slice_in_dim(out, c, 1, axis=0)
+            cur = pl.load(o_ref, (pl.ds(dst, 1), slice(None)))
+            pl.store(o_ref, (pl.ds(dst, 1), slice(None)), cur + contrib)
+            return 0
+
+        jax.lax.fori_loop(0, capacity, row, 0)
+
+
+def _default_block_i(intermediate: int) -> int:
+    if intermediate <= 1024:
+        return intermediate
+    for b in (1024, 512, 256, 128):
+        if intermediate % b == 0:
+            return b
+    return intermediate
+
+
+def _tuned_block_i(num_experts: int, top_k: int, hidden: int,
+                   intermediate: int, dtype, qlen: int) -> int:
+    """Tuning-cache lookup with a benchmark closure over this kernel.
+    Never lets tuning break the hot path: any failure returns the static
+    default."""
+    default = _default_block_i(intermediate)
+    try:
+        if not tuning.tuning_enabled():
+            return default
+
+        def measure(bi: int) -> float:
+            n = tuning.bucket(qlen)
+            cap = max(-(-n // 8) * 8, 8)
+            key = jax.random.PRNGKey(0)
+            x = jax.random.normal(key, (n, hidden), dtype)
+            wg = jax.random.normal(key, (num_experts, hidden, intermediate), dtype)
+            wu = jax.random.normal(key, (num_experts, hidden, intermediate), dtype)
+            wd = jax.random.normal(key, (num_experts, intermediate, hidden), dtype)
+            # synthetic balanced routing: token t → experts t%E, (t+1)%E, ...
+            slot = jnp.arange(num_experts * cap) % cap
+            rows = jnp.where(slot < n, slot, n).reshape(num_experts, cap)
+            gates = jnp.where(slot < n, 1.0 / max(top_k, 1), 0.0).reshape(
+                num_experts, cap
+            ).astype(jnp.float32)
+            fn = jax.jit(functools.partial(fused_moe, block_i=bi))
+            return tuning.time_fn(fn, x, wg, wu, wd, rows, gates)
+
+        return tuning.fused_moe_block_i(
+            num_experts, top_k, hidden, intermediate, dtype, qlen, measure
+        )
+    except Exception:
+        return default
+
+
+def fused_moe(x, w_gate, w_up, w_down, rows, gates, top_k=None, block_i=None):
+    """Fused top-k gather + expert FFN + weighted combine.
+
+    x [N, H] tokens; w_gate/w_up [E, H, I], w_down [E, I, H] stacked expert
+    weights (pre-cast to x.dtype); rows [E, C] int32 slot→token map (N for
+    empty slots); gates [E, C] combine weights (0 for empty). Returns the
+    combined routed-expert output [N, H] in x.dtype. ``top_k`` only feeds
+    the tuning key; ``block_i`` overrides the tuned intermediate tile.
+    """
+    n, h = x.shape
+    e, cap = rows.shape
+    i_dim = w_gate.shape[-1]
+    if block_i is None:
+        block_i = _tuned_block_i(e, int(top_k or 0), h, i_dim, x.dtype, n)
+    if i_dim % block_i:
+        block_i = i_dim
+    n_i = i_dim // block_i
+
+    # one zero parking row past the real tokens (empty-slot gather/scatter
+    # target), then pad the row count up to the f32 sublane multiple
+    n1 = max(-(-(n + 1) // 8) * 8, 8)
+    xp = jnp.zeros((n1, h), x.dtype).at[:n].set(x)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, capacity=cap, n_i=n_i),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(e, n_i),
+            in_specs=[
+                pl.BlockSpec((n1, h), lambda ei, ii, rows_: (0, 0)),
+                pl.BlockSpec((1, h, block_i), lambda ei, ii, rows_: (ei, 0, ii)),
+                pl.BlockSpec((1, h, block_i), lambda ei, ii, rows_: (ei, 0, ii)),
+                pl.BlockSpec((1, block_i, h), lambda ei, ii, rows_: (ei, ii, 0)),
+                pl.BlockSpec((1, cap), lambda ei, ii, rows_: (ei, 0)),
+            ],
+            out_specs=pl.BlockSpec((n1, h), lambda ei, ii, rows_: (0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((cap, h), x.dtype),
+                pltpu.VMEM((cap, h), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n1, h), x.dtype),
+        interpret=interpret_mode(),
+    )(rows.astype(jnp.int32), xp, w_gate, w_up, w_down,
+      gates.astype(jnp.float32))
+    return out[:n]

@@ -311,6 +311,32 @@ def paged_heads_per_step(
     )
 
 
+def fused_moe_block_i(
+    num_experts: int, top_k: int, hidden: int, intermediate: int, dtype,
+    qlen: int, measure: Callable[[int], float],
+) -> int:
+    """Expert-FFN intermediate-dim tile for the fused MoE kernel. The
+    candidates are the divisors of the (per-expert) intermediate size, so
+    every tile is full; the key carries (num_experts, top_k, dtype,
+    qlen-bucket) plus the weight shape — routing fan-out changes how many
+    tokens land per expert, which changes the profitable tile. The default
+    is the whole intermediate dim when it is small (single tile — also the
+    bitwise-parity configuration used off-TPU) and the largest ≤1024
+    divisor otherwise."""
+    cands = [b for b in (128, 256, 512, 1024) if b < intermediate
+             and intermediate % b == 0]
+    default = intermediate if intermediate <= 1024 or not cands else cands[-1]
+    if not cands:
+        return default
+    cands = cands + [intermediate] if intermediate <= 4096 else cands
+    return get_tuner().tune(
+        "fused_moe",
+        (device_kind(), num_experts, top_k, hidden, intermediate, _dt(dtype),
+         bucket(qlen)),
+        cands, measure, default,
+    )
+
+
 def _dt(dtype) -> str:
     import jax.numpy as jnp
 

@@ -19,6 +19,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from colossalai_tpu.kernel.ops import silu_and_mul
 from colossalai_tpu.moe.router import (
     combine_sorted,
     dispatch_sorted,
@@ -156,7 +157,7 @@ class MoEMLP(nn.Module):
         def expert_ffn(expert_in):  # [G, E, C, H] -> [G, E, C, H]
             gate = jnp.einsum("bech,ehi->beci", expert_in, w_gate.astype(dtype))
             up = jnp.einsum("bech,ehi->beci", expert_in, w_up.astype(dtype))
-            act = nn.silu(gate) * up
+            act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
             return jnp.einsum("beci,eih->bech", act, w_down.astype(dtype))
 
         if cfg.router_impl not in ("einsum", "sort"):

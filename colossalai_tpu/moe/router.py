@@ -25,6 +25,22 @@ class RoutingResult(NamedTuple):
     router_z_loss: jax.Array  # logit magnitude regularizer
 
 
+def _validate_routing_shape(n: int, e: int, num_selected: int) -> None:
+    """Shared shape validation for both routing paths. Shapes are static
+    under jit, so these raise at trace time with a clear message instead of
+    letting ``lax.top_k`` / empty scatters fail obscurely downstream."""
+    if n == 0:
+        raise ValueError(
+            "router_logits has zero tokens (empty batch); routing needs at "
+            "least one token"
+        )
+    if num_selected > e:
+        raise ValueError(
+            f"top_k={num_selected} exceeds num_experts={e}: cannot select "
+            "more experts per token than exist"
+        )
+
+
 def _topk_gates(
     router_logits: jax.Array,
     num_selected: int,
@@ -91,6 +107,7 @@ def top_k_routing(
     **gate_kw,
 ) -> RoutingResult:
     n, e = router_logits.shape
+    _validate_routing_shape(n, e, num_selected)
     probs, gate_vals, expert_idx = _topk_gates(
         router_logits, num_selected, norm_topk, **gate_kw
     )
@@ -144,6 +161,7 @@ def top_k_routing_sorted(
     """
     n, e = router_logits.shape
     k = num_selected
+    _validate_routing_shape(n, e, k)
     probs, gate_vals, expert_idx = _topk_gates(router_logits, k, norm_topk, **gate_kw)
 
     # k-major flattening + stable sort: every slot-0 entry of an expert
@@ -169,6 +187,10 @@ def dispatch_sorted(x: jax.Array, r: SortedRouting, num_experts: int,
                     capacity: int) -> jax.Array:
     """[N, H] tokens → [E, C, H] expert inputs (dropped tokens land in a
     discarded overflow row)."""
+    if x.shape[0] == 0:
+        raise ValueError("dispatch_sorted: x has zero tokens (empty batch)")
+    if r.dest.shape[0] == 0:
+        raise ValueError("dispatch_sorted: routing has zero entries")
     h = x.shape[-1]
     buf = jnp.zeros((num_experts * capacity + 1, h), x.dtype)
     buf = buf.at[r.dest].set(x[r.tok])
@@ -177,6 +199,10 @@ def dispatch_sorted(x: jax.Array, r: SortedRouting, num_experts: int,
 
 def combine_sorted(expert_out: jax.Array, r: SortedRouting, n_tokens: int) -> jax.Array:
     """[E, C, H] expert outputs → [N, H] gate-weighted scatter-add back."""
+    if n_tokens == 0:
+        raise ValueError("combine_sorted: n_tokens is zero (empty batch)")
+    if r.dest.shape[0] == 0:
+        raise ValueError("combine_sorted: routing has zero entries")
     e, c, h = expert_out.shape
     flat = expert_out.reshape(e * c, h)
     vals = flat[jnp.minimum(r.dest, e * c - 1)] * r.gate[:, None].astype(flat.dtype)

@@ -30,3 +30,20 @@ def test_every_public_kernel_is_tested():
 def test_all_names_importable():
     for name in pallas_pkg.__all__:
         assert callable(getattr(pallas_pkg, name)), name
+
+
+def test_loader_ops_are_registered():
+    """Ops the serving/training forwards resolve through KernelLoader must
+    be registered (with a CPU-available fallback) the moment the package
+    imports — a missing registration would only surface as a RuntimeError
+    deep inside a jitted forward."""
+    from colossalai_tpu.kernel.loader import KernelLoader
+
+    for op in ("flash_attention", "rms_norm", "fused_moe"):
+        assert op in KernelLoader._registry, (
+            f"kernel op {op!r} never registered with KernelLoader"
+        )
+        assert KernelLoader.available_impls(op), (
+            f"kernel op {op!r} has no available implementation on this "
+            "backend — the XLA fallback must always be available"
+        )

@@ -131,3 +131,44 @@ def test_paged_heads_per_step_keys_on_query_window(tmp_path, monkeypatch):
     # second lookup at each width is a cache hit, no re-benchmark
     tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure, qlen=4)
     assert t.hits == 1 and t.misses == 2
+
+
+def test_fused_moe_block_i_round_trip(tmp_path, monkeypatch):
+    """The fused-MoE tile keys on (num_experts, top_k, dtype, qlen bucket)
+    plus the weight shape: routing fan-out changes tokens-per-expert, which
+    changes the profitable tile — distinct configs must get independent
+    cache entries, and a repeat lookup must hit without re-benchmarking."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    times = {128: 0.003, 256: 0.001, 512: 0.002, 1024: 0.005, 2048: 0.004}
+    calls = []
+
+    def measure(bi):
+        calls.append(bi)
+        return times[bi]
+
+    got = tuning.fused_moe_block_i(8, 2, 1024, 2048, "bfloat16", 130, measure)
+    assert got == 256  # the measured winner among the divisor candidates
+    assert sorted(set(calls)) == [128, 256, 512, 1024, 2048]
+    assert t.misses == 1
+
+    # same shape, different top_k → a distinct key, measured again
+    got2 = tuning.fused_moe_block_i(8, 4, 1024, 2048, "bfloat16", 130, measure)
+    assert got2 == 256 and t.misses == 2
+    # the key carries every part: experts, top_k, dims, dtype, qlen bucket
+    keys = list(t.chosen)
+    assert any("|8|2|1024|2048|bfloat16|256" in k for k in keys), keys
+    assert any("|8|4|1024|2048|bfloat16|256" in k for k in keys), keys
+
+    # repeat of the first config: pure cache hit, no re-benchmark
+    calls.clear()
+    assert tuning.fused_moe_block_i(
+        8, 2, 1024, 2048, "bfloat16", 130, measure) == 256
+    assert calls == [] and t.hits == 1 and t.misses == 2
+
+    # small intermediate: single full-width tile, tuner bypassed entirely
+    calls.clear()
+    assert tuning.fused_moe_block_i(4, 2, 64, 128, "float32", 16, measure) == 128
+    assert calls == [] and t.misses == 2

@@ -9,7 +9,13 @@ import pytest
 
 from colossalai_tpu.booster import Booster, HybridParallelPlugin, MoeHybridParallelPlugin
 from colossalai_tpu.models import MixtralConfig, MixtralForCausalLM
-from colossalai_tpu.moe.router import top_k_routing
+from colossalai_tpu.moe.router import (
+    SortedRouting,
+    combine_sorted,
+    dispatch_sorted,
+    top_k_routing,
+    top_k_routing_sorted,
+)
 
 RNG = np.random.RandomState(0)
 
@@ -208,3 +214,38 @@ def test_mixtral_sort_router_trains_and_matches():
     srt = losses("sort")
     assert np.all(np.isfinite(base)) and base[-1] < base[0], base
     np.testing.assert_allclose(srt, base, atol=1e-4)
+
+
+def test_routing_rejects_top_k_over_experts():
+    logits = jnp.asarray(RNG.randn(8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="top_k"):
+        top_k_routing(logits, num_selected=5, capacity=4)
+    with pytest.raises(ValueError, match="top_k"):
+        top_k_routing_sorted(logits, num_selected=5, capacity=8)
+
+
+def test_routing_rejects_empty_batch():
+    empty = jnp.zeros((0, 4), jnp.float32)
+    with pytest.raises(ValueError, match="zero tokens"):
+        top_k_routing(empty, num_selected=2, capacity=4)
+    with pytest.raises(ValueError, match="zero tokens"):
+        top_k_routing_sorted(empty, num_selected=2, capacity=8)
+
+
+def test_dispatch_combine_reject_empty_inputs():
+    logits = jnp.asarray(RNG.randn(8, 4), jnp.float32)
+    r = top_k_routing_sorted(logits, num_selected=2, capacity=8)
+    x = jnp.asarray(RNG.randn(8, 16), jnp.float32)
+    with pytest.raises(ValueError, match="zero tokens"):
+        dispatch_sorted(jnp.zeros((0, 16), jnp.float32), r, 4, 8)
+    with pytest.raises(ValueError):
+        combine_sorted(jnp.zeros((4, 8, 16), jnp.float32), r, 0)
+    empty_r = SortedRouting(
+        dest=jnp.zeros((0,), jnp.int32), tok=jnp.zeros((0,), jnp.int32),
+        gate=jnp.zeros((0,), jnp.float32),
+        aux_loss=jnp.zeros(()), router_z_loss=jnp.zeros(()),
+    )
+    with pytest.raises(ValueError):
+        dispatch_sorted(x, empty_r, 4, 8)
+    with pytest.raises(ValueError):
+        combine_sorted(jnp.zeros((4, 8, 16), jnp.float32), empty_r, 8)
