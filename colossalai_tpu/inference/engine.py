@@ -186,6 +186,15 @@ class EngineStats:
     requests_aborted: int = 0
     #: completed requests that ended early because the page pool ran dry
     requests_truncated: int = 0
+    # ---- KV-pool memory gauges (host-side: pool .nbytes + allocator
+    # bookkeeping — refreshing them moves NO device data, so telemetry
+    # on/off stays byte-identical on transfers). kv_pool_bytes counts the
+    # target pool, its int8 scale tensors, and the draft pool; it is the
+    # denominator of the int8 capacity win (same bytes, ~2x the tokens).
+    kv_pool_bytes: int = 0
+    #: physical pages currently allocated (live sequences + prefix-cache
+    #: retained pages; the reserved null page 0 never counts)
+    kv_blocks_in_use: int = 0
 
     @property
     def spec_acceptance_rate(self) -> float:
@@ -258,7 +267,16 @@ def _split_chain(rng, k: int):
 def _copy_block(cache: PagedKVCache, src, dst) -> PagedKVCache:
     """Copy-on-write of one page (grouped-sampling fork: the partial prompt
     page is the only one a follower would overwrite). src/dst are traced
-    int32 scalars so every block pair reuses one compiled program."""
+    int32 scalars so every block pair reuses one compiled program. Int8
+    pools copy the page's scales with it — the ints are meaningless under
+    another page's scale."""
+    if cache.quantized:
+        return PagedKVCache(
+            k=cache.k.at[:, dst].set(cache.k[:, src]),
+            v=cache.v.at[:, dst].set(cache.v[:, src]),
+            k_scale=cache.k_scale.at[:, dst].set(cache.k_scale[:, src]),
+            v_scale=cache.v_scale.at[:, dst].set(cache.v_scale[:, src]),
+        )
     return PagedKVCache(
         k=cache.k.at[:, dst].set(cache.k[:, src]),
         v=cache.v.at[:, dst].set(cache.v[:, src]),
@@ -303,6 +321,7 @@ class LLMEngine:
         telemetry: Union[bool, Telemetry] = True,
         event_log: Optional[str] = None,
         moe_impl: str = "auto",
+        kv_dtype: str = "bf16",
     ):
         self.config = config
         # ---- observability: lifecycle stamps + histograms are host-side
@@ -396,8 +415,29 @@ class LLMEngine:
         )
         self.use_kernel = use_kernel
         self.mesh = mesh
+        # ---- KV-pool dtype: "bf16" stores pages in the compute dtype;
+        # "int8" quantizes them (symmetric absmax per page per kv head, see
+        # kv_quant.py) for ~2x the resident KV tokens per HBM byte. The
+        # quantized pool composes with megastep K, chunked prefill, the
+        # prefix cache (shared pages carry their scales — they are indexed
+        # by PHYSICAL block id), speculative decoding (the draft pool
+        # quantizes too) and MoE serving; mesh sharding does not thread the
+        # scale tensors yet.
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r}: pass 'bf16' (pages in the compute "
+                "dtype) or 'int8' (quantized pages + per-page scales)"
+            )
+        if kv_dtype == "int8" and mesh is not None:
+            raise NotImplementedError(
+                "kv_dtype='int8' is single-device only for now — the tp/pp "
+                "paths don't shard the scale tensors; drop the mesh or use "
+                "kv_dtype='bf16'"
+            )
+        self.kv_dtype = kv_dtype
         dtype = config.dtype or jnp.bfloat16
-        cache = init_paged_cache(config, num_blocks, block_size, dtype=dtype)
+        pool_dtype = jnp.int8 if kv_dtype == "int8" else dtype
+        cache = init_paged_cache(config, num_blocks, block_size, dtype=pool_dtype)
         # ---- speculative decoding (draft_len > 0): the megastep drafts
         # draft_len tokens per iteration (separate draft model, or a
         # truncated-layer self-draft sharing the target's weights) and the
@@ -452,8 +492,11 @@ class LLMEngine:
                     f"target vocab_size={config.vocab_size} — acceptance "
                     "compares token ids, the vocabularies must match"
                 )
+            # the draft pool follows the target's kv_dtype: it mirrors the
+            # same block tables, and shrinking it was the PR 4 open item
+            # int8 pages close
             self.draft_cache = init_paged_cache(
-                self.draft_config, num_blocks, block_size, dtype=dtype
+                self.draft_config, num_blocks, block_size, dtype=pool_dtype
             )
         # ---- MoE serving (Mixtral/Qwen2-MoE param trees): the decode
         # forwards route each token through the expert MLP; ``moe_impl``
@@ -583,6 +626,14 @@ class LLMEngine:
         self._gen_topp = np.ones((max_batch_size,), np.float32)
         self._gen_sample = np.zeros((max_batch_size,), bool)
         self.stats = EngineStats()
+        # pool residency is static for the engine's lifetime: every page
+        # tensor (target + draft, int8 scales included) counts
+        self._kv_pool_nbytes = int(sum(
+            leaf.nbytes for leaf in jax.tree.leaves(self.cache)))
+        if self.draft_cache is not None:
+            self._kv_pool_nbytes += int(sum(
+                leaf.nbytes for leaf in jax.tree.leaves(self.draft_cache)))
+        self._refresh_kv_gauges()
         # ---- device-resident decode state: the scheduler PATCHES these
         # (O(1) scalars at admission / page growth / release) and the
         # megastep advances them in-graph; nothing per-token crosses the
@@ -831,7 +882,18 @@ class LLMEngine:
         self._admit(finished)
         self._advance_prefills(finished)
         self._decode_tick(finished)
+        self._refresh_kv_gauges()
         return finished
+
+    def _refresh_kv_gauges(self) -> None:
+        """KV-pool memory gauges from host-side bookkeeping only (pool
+        nbytes are static; blocks-in-use is the allocator's free-list
+        complement) — no device fetch, so telemetry on/off cannot change
+        transfer counters."""
+        self.stats.kv_pool_bytes = self._kv_pool_nbytes
+        self.stats.kv_blocks_in_use = (
+            self.allocator.num_blocks - 1 - self.allocator.num_free
+        )
 
     def _next_waiting(self) -> int:
         """Index of the waiting request the admission policy tries next

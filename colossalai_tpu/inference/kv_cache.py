@@ -26,6 +26,12 @@ import jax.numpy as jnp
 class PagedKVCache(NamedTuple):
     k: jax.Array  # [L, n_blocks, Hkv, block_size, D]
     v: jax.Array  # [L, n_blocks, Hkv, block_size, D]
+    #: int8 pools only: per-(layer, physical page, kv head) symmetric
+    #: absmax scales (see kv_quant.py); None for float pools. None leaves
+    #: give the two modes distinct pytree structures, so every jit in the
+    #: serving stack traces a separate (and for bf16, unchanged) program.
+    k_scale: Optional[jax.Array] = None  # [L, n_blocks, Hkv] f32
+    v_scale: Optional[jax.Array] = None  # [L, n_blocks, Hkv] f32
 
     @property
     def block_size(self) -> int:
@@ -35,12 +41,43 @@ class PagedKVCache(NamedTuple):
     def num_blocks(self) -> int:
         return self.k.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def init_paged_cache(cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16) -> PagedKVCache:
+    dt = jnp.dtype(dtype)
+    if not (jnp.issubdtype(dt, jnp.floating) or dt == jnp.dtype(jnp.int8)):
+        raise ValueError(
+            f"init_paged_cache dtype={dt.name!r} is not a supported pool "
+            "dtype: use a float dtype (bf16/f32 pages) or int8 (quantized "
+            "pages with per-page-per-head scales)"
+        )
+    from colossalai_tpu.kernel.loader import on_tpu
+
+    if on_tpu() and block_size % 128 != 0:
+        # fail at pool construction, not as a Mosaic tiling error deep in
+        # the first pallas_call: pages are (block_size, head_dim) tiles and
+        # the lane dim must be a multiple of 128 for every pool dtype
+        # (f32 sublane 8, bf16 16, int8 32 — 128 covers all of them)
+        raise ValueError(
+            f"block_size={block_size} must be a multiple of 128 on TPU — "
+            "the Pallas paged-attention kernel streams (block_size, "
+            "head_dim) page tiles and Mosaic requires 128-multiple tiling "
+            "(any block_size works on CPU/interpret meshes)"
+        )
     # heads BEFORE block_size: pages must be (block_size, head_dim) tiles
     # for the Pallas paged kernel (Mosaic last-two-dims constraint)
     shape = (cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads, block_size, cfg.head_dim_)
-    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    if dt == jnp.dtype(jnp.int8):
+        sshape = (cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v_scale=jnp.zeros(sshape, jnp.float32),
+        )
+    return PagedKVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
 
 
 class OutOfBlocks(RuntimeError):

@@ -1,0 +1,125 @@
+"""Int8 KV-page quantization: symmetric absmax per (page, kv-head).
+
+The paged pool (``kv_cache.PagedKVCache``) stores K/V pages either in the
+compute dtype (bf16 — the default) or as int8 with one f32 scale per
+(layer, physical page, kv head): ``scale = absmax / 127`` over the page's
+(block_size, head_dim) tile, ``q = clip(round(x / scale), -127, 127)``,
+``dequant = q * scale``. Halving the bytes per cached token doubles the
+concurrent-user / context capacity of a fixed HBM budget (the ROADMAP's
+~2x unlock); the Pallas paged-attention kernel dequantizes tiles
+in-register so a bf16 copy of the pool never materializes.
+
+Quantization granularity is per PAGE per KV HEAD — coarse enough that the
+scale tensors are negligible (``2 * L * n_blocks * Hkv`` f32 ≈ 0.8% of the
+pool at block_size=128, head_dim=64), fine enough that one outlier head or
+one loud page does not clip the rest of the cache.
+
+Three write shapes share these helpers:
+
+- whole pages (prefill / chunked prefill): :func:`page_scales` over the
+  page's VALID tokens + :func:`quantize_pages` — pad tokens are excluded
+  from the absmax so garbage K/V past ``n_tokens`` cannot inflate a scale;
+- single-token appends (decode, and the verify window's per-token loop):
+  :func:`append_token` — a running-absmax append that rescales the page's
+  existing ints only when the incoming token grows the scale. An append at
+  page offset 0 treats the page as fresh (scale 0), so recycled physical
+  blocks never inherit a stale scale from a freed sequence;
+- reads (the XLA gather fallback and the cold-prefill attention operand):
+  :func:`dequantize_pages` — int8 * f32 scale, cast to the compute dtype.
+  The cast point is fixed so the cold single-shot prefill and the warm
+  prefix-cache gather see BITWISE-identical values (the warm/cold identity
+  the prefix-cache tests assert survives int8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: symmetric int8 range: quantized values live in [-127, 127] (never -128,
+#: so negation round-trips and |q * scale| <= absmax)
+INT8_MAX = 127.0
+
+
+def safe_scale(scale: jax.Array) -> jax.Array:
+    """All-zero tiles quantize through scale 1.0 (to all-zero ints)
+    instead of dividing by zero."""
+    return jnp.where(scale > 0, scale, 1.0)
+
+
+def page_scales(pages: jax.Array, valid: jax.Array) -> jax.Array:
+    """Per-(page, kv-head) scales for whole-page writes.
+
+    pages [..., Hkv, block_size, D] (compute dtype); valid
+    [..., block_size] bool (True = real token — pad tokens are excluded
+    from the absmax). Returns [..., Hkv] f32.
+    """
+    a = jnp.abs(pages.astype(jnp.float32))
+    a = jnp.where(valid[..., None, :, None], a, 0.0)
+    return jnp.max(a, axis=(-2, -1)) / INT8_MAX
+
+
+def quantize_pages(pages: jax.Array, scales: jax.Array) -> jax.Array:
+    """pages [..., Hkv, block_size, D] / scales [..., Hkv] → int8 pages."""
+    q = jnp.round(pages.astype(jnp.float32) / safe_scale(scales)[..., None, None])
+    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+
+def dequantize_pages(q: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """int8 pages [..., Hkv, block_size, D] * scales [..., Hkv] → compute
+    dtype. The single cast point every read path shares (bitwise warm/cold
+    identity depends on this)."""
+    return (q.astype(jnp.float32) * scales[..., None, None]).astype(dtype)
+
+
+def append_token(pool, scales, wb, wo, tok, ok):
+    """Quantized single-token append: the int8 counterpart of the decode
+    scatter ``pool.at[wb, :, wo].set(tok)``.
+
+    pool [n_blocks, Hkv, block_size, D] int8; scales [n_blocks, Hkv] f32;
+    wb/wo [S] int32 write page / offset (callers mask both to the null
+    page 0 for slots with ``ok`` False); tok [S, Hkv, D] compute dtype;
+    ok [S] bool.
+
+    Running-absmax rescale: ``new_scale = max(old_scale, |tok| / 127)``
+    per (slot, head). When the scale grows, the page's existing ints are
+    re-quantized to the new scale IN int8 (one round per growth — the
+    bounded requantization error is covered by the round-trip test); when
+    it does not (the common case), ``ratio == 1`` and the
+    int→f32→round→int8 trip reproduces the page exactly, so appends are
+    drift-free. An append at offset 0 starts the page from scale 0: a
+    physical block recycled from a freed sequence must not inherit that
+    sequence's scale (the free list is host-side bookkeeping; nothing
+    resets device memory).
+
+    Slots with ``ok`` False write their gathered page back unchanged —
+    every such slot targets the reserved null page 0, so the duplicate
+    scatter writes identical values and stays deterministic, exactly like
+    the bf16 path's masked scatter. Returns (pool, scales).
+    """
+    old = scales[wb]  # [S, Hkv]
+    page = pool[wb]  # [S, Hkv, block_size, D] int8
+    block_size = pool.shape[2]
+    t32 = tok.astype(jnp.float32)
+    t_scale = jnp.max(jnp.abs(t32), axis=-1) / INT8_MAX  # [S, Hkv]
+    fresh = (wo == 0) & ok
+    old_eff = jnp.where(fresh[:, None], 0.0, old)
+    new = jnp.maximum(old_eff, t_scale)
+    new = jnp.where(ok[:, None], new, old)
+    # requantize the page to the (possibly grown) scale; ratio == 1 when
+    # the scale is unchanged, 0 when the page starts fresh at offset 0
+    ratio = old_eff / safe_scale(new)
+    repage = jnp.clip(
+        jnp.round(page.astype(jnp.float32) * ratio[..., None, None]),
+        -INT8_MAX, INT8_MAX,
+    ).astype(jnp.int8)
+    qtok = jnp.clip(
+        jnp.round(t32 / safe_scale(new)[..., None]), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+    at_wo = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
+        == wo[:, None, None]
+    )  # [S, 1, block_size]
+    page_new = jnp.where(at_wo[..., None], qtok[:, :, None, :], repage)
+    page_new = jnp.where(ok[:, None, None, None], page_new, page)
+    return pool.at[wb].set(page_new), scales.at[wb].set(new)

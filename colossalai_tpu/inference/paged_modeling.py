@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
+from . import kv_quant
 from .kv_cache import PagedKVCache
 from .modeling import _block_step, _proj, _project_kv, _rms
 from .moe_modeling import moe_expert_counts, moe_ffn
@@ -107,29 +108,46 @@ def prefill_paged(
 
     def layer(carry, inputs):
         x, i = carry
-        layer_params, k_pool, v_pool = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
         k, v = _project_kv(cfg, layer_params, h, positions)
         # page scatter: logical page j → physical block_table[j];
         # pool layout is [n_blocks, Hkv, bs, D]
         k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
         v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+        if k_sc is not None:
+            page_valid = valid[0].reshape(n_pages, bs)  # pad excluded from absmax
+            ks = kv_quant.page_scales(k_pages, page_valid)
+            vs = kv_quant.page_scales(v_pages, page_valid)
+            k_pages = kv_quant.quantize_pages(k_pages, ks)
+            v_pages = kv_quant.quantize_pages(v_pages, vs)
+            k_sc = k_sc.at[block_table[:n_pages]].set(ks)
+            v_sc = v_sc.at[block_table[:n_pages]].set(vs)
+            # attend to the round-tripped values the pool now holds, not
+            # the raw projections: a later gather through these pages (a
+            # prefix-cache hit's suffix chunk) must see bit-identical K/V
+            # to what this cold pass attended to
+            k = (kv_quant.dequantize_pages(k_pages, ks, dtype)
+                 .transpose(0, 2, 1, 3).reshape(1, s, *k.shape[2:]))
+            v = (kv_quant.dequantize_pages(v_pages, vs, dtype)
+                 .transpose(0, 2, 1, 3).reshape(1, s, *v.shape[2:]))
         k_pool = k_pool.at[block_table[:n_pages]].set(k_pages)
         v_pool = v_pool.at[block_table[:n_pages]].set(v_pages)
         # prompt attention is self-contained (causal over the prompt)
         x = _block_step(cfg, layer_params, x, k, v, positions, valid)
-        return (x, i + 1), (k_pool, v_pool)
+        return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     # named HLO region: a /profile capture attributes this op cluster to
     # the prefill phase (see docs/observability.md)
     with jax.named_scope("prefill"):
-        (x, _), (k_new, v_new) = jax.lax.scan(
-            layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+        (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, (x.astype(dtype), 0),
+            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
         )
 
     logits = _logits_head(p, cfg, x)
     last = jnp.take_along_axis(logits, (n_tokens - 1)[:, None, None].clip(0), axis=1)[:, 0]
-    return last, PagedKVCache(k=k_new, v=v_new)
+    return last, PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -168,42 +186,59 @@ def prefill_chunk_paged(
 
     def layer(carry, inputs):
         x, i = carry
-        layer_params, k_pool, v_pool = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
         k, v = _project_kv(cfg, layer_params, h, positions)
         k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
         v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+        if k_sc is not None:
+            # chunks are block-aligned, so each page is written by exactly
+            # one chunk and its validity is local: token i real iff i < n_valid
+            page_valid = (jnp.arange(c) < n_valid).reshape(n_pages, bs)
+            ks = kv_quant.page_scales(k_pages, page_valid)
+            vs = kv_quant.page_scales(v_pages, page_valid)
+            k_pages = kv_quant.quantize_pages(k_pages, ks)
+            v_pages = kv_quant.quantize_pages(v_pages, vs)
+            k_sc = k_sc.at[page_ids].set(ks)
+            v_sc = v_sc.at[page_ids].set(vs)
         k_pool = k_pool.at[page_ids].set(k_pages)
         v_pool = v_pool.at[page_ids].set(v_pages)
 
         # gather the whole table: prior chunks' pages + the ones just
         # written — [mb, Hkv, bs, D] → [1, s_max, Hkv, D]
-        def to_seq(pool):
-            g = pool[block_table].transpose(0, 2, 1, 3)
+        def to_seq(pool, sc):
+            g = pool[block_table]
+            if sc is not None:
+                g = kv_quant.dequantize_pages(g, sc[block_table], dtype)
+            g = g.transpose(0, 2, 1, 3)
             return g.reshape(s_max, pool.shape[1], pool.shape[3])[None]
 
-        x = _block_step(cfg, layer_params, x, to_seq(k_pool), to_seq(v_pool),
-                        positions, kv_valid)
-        return (x, i + 1), (k_pool, v_pool)
+        x = _block_step(cfg, layer_params, x, to_seq(k_pool, k_sc),
+                        to_seq(v_pool, v_sc), positions, kv_valid)
+        return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     with jax.named_scope("prefill_chunk"):
-        (x, _), (k_new, v_new) = jax.lax.scan(
-            layer, (x.astype(dtype), 0), (stacked, cache.k, cache.v)
+        (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, (x.astype(dtype), 0),
+            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
         )
 
     logits = _logits_head(p, cfg, x)
     last = jax.lax.dynamic_index_in_dim(
         logits, jnp.clip(n_valid - 1, 0), axis=1, keepdims=False
     )  # [1, V]: the chunk's last real token (meaningful on the final chunk)
-    return last, PagedKVCache(k=k_new, v=v_new)
+    return last, PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
 
 
-def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
-                 cache_v, active, use_kernel: bool, moe_fused: bool = False):
+def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
+                 cache: PagedKVCache, active, use_kernel: bool,
+                 moe_fused: bool = False):
     """One decode iteration over unwrapped params: tokens [S] at positions
-    ``lengths`` → (logits [S, V], k pool, v pool, expert_counts). The shared
+    ``lengths`` → (logits [S, V], cache, expert_counts). The shared
     core of ``decode_paged`` (K=1, jitted per call) and ``decode_megastep``
-    (traced K times inside one fori_loop).
+    (traced K times inside one fori_loop). Int8 pools (``cache.quantized``)
+    append through the running-absmax path (kv_quant.append_token) and
+    attend through dequantized gathers / the dequantizing kernel.
 
     For MoE param trees (a ``"moe"`` layer subtree) the MLP is the routed
     expert path (``moe_fused`` picks the fused kernel vs the XLA
@@ -216,7 +251,7 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
     n_experts = cfg.num_experts if has_moe else 0
     dtype = cfg.dtype or jnp.bfloat16
     n_slots = tokens.shape[0]
-    bs = cache_k.shape[3]
+    bs = cache.k.shape[3]
     max_blocks = block_tables.shape[1]
     positions = lengths[:, None]  # [S, 1]
 
@@ -231,18 +266,22 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
 
     def layer(carry, inputs):
         x, counts, i = carry
-        layer_params, k_pool, v_pool = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
         k, v = _project_kv(cfg, layer_params, h, positions)  # [S,1,Hkv,D]
         # masked scatter: inactive slots write to the reserved null page 0
         # at offset 0 — harmless garbage no table points to for reading
         wb = jnp.where(active, w_block, 0)
         wo = jnp.where(active, w_off, 0)
-        # pool [n_blocks, Hkv, bs, D]: advanced indices (wb, :, wo) → [S, Hkv, D]
-        k_new_tok = jnp.where(active[:, None, None], k[:, 0], k_pool[wb, :, wo])
-        v_new_tok = jnp.where(active[:, None, None], v[:, 0], v_pool[wb, :, wo])
-        k_pool = k_pool.at[wb, :, wo].set(k_new_tok)
-        v_pool = v_pool.at[wb, :, wo].set(v_new_tok)
+        if k_sc is not None:
+            k_pool, k_sc = kv_quant.append_token(k_pool, k_sc, wb, wo, k[:, 0], active)
+            v_pool, v_sc = kv_quant.append_token(v_pool, v_sc, wb, wo, v[:, 0], active)
+        else:
+            # pool [n_blocks, Hkv, bs, D]: advanced indices (wb, :, wo) → [S, Hkv, D]
+            k_new_tok = jnp.where(active[:, None, None], k[:, 0], k_pool[wb, :, wo])
+            v_new_tok = jnp.where(active[:, None, None], v[:, 0], v_pool[wb, :, wo])
+            k_pool = k_pool.at[wb, :, wo].set(k_new_tok)
+            v_pool = v_pool.at[wb, :, wo].set(v_new_tok)
         if use_kernel:
             from colossalai_tpu.kernel import fused_add_rms_norm
             from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
@@ -251,7 +290,8 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
             q = q.reshape(n_slots, cfg.num_attention_heads, cfg.head_dim_)
             cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
             q = apply_rope(q[:, None], cos, sin)[:, 0]
-            attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1)
+            attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1,
+                                   k_scale=k_sc, v_scale=v_sc)
             attn = attn.reshape(n_slots, 1, cfg.num_attention_heads * cfg.head_dim_)
             attn_out = (
                 attn.astype(dtype)
@@ -273,13 +313,15 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
         else:
             # XLA path: gather this slot's pages into a contiguous view
             # [S, max_blocks, Hkv, bs, D] → [S, s_max, Hkv, D]
-            def to_seq(pool):
+            def to_seq(pool, sc):
                 g = pool[block_tables]  # [S, mb, Hkv, bs, D]
+                if sc is not None:
+                    g = kv_quant.dequantize_pages(g, sc[block_tables], dtype)
                 g = g.transpose(0, 1, 3, 2, 4)
                 return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
 
-            k_seq = to_seq(k_pool)
-            v_seq = to_seq(v_pool)
+            k_seq = to_seq(k_pool, k_sc)
+            v_seq = to_seq(v_pool, v_sc)
             x, moe_aux = _block_step(
                 cfg, layer_params, x, k_seq, v_seq, positions, attend,
                 moe_fused=moe_fused, return_moe_routing=True,
@@ -287,13 +329,15 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, cache_k,
             if has_moe:
                 r, cap = moe_aux
                 counts = counts + moe_expert_counts(r, cap, n_experts, active)
-        return (x, counts, i + 1), (k_pool, v_pool)
+        return (x, counts, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     counts0 = jnp.zeros((n_experts,), jnp.int32)
-    (x, counts, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), counts0, 0), (stacked, cache_k, cache_v)
+    (x, counts, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+        layer, (x.astype(dtype), counts0, 0),
+        (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
-    return (_logits_head(p, cfg, x)[:, 0], k_new, v_new,
+    return (_logits_head(p, cfg, x)[:, 0],
+            PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new),
             counts if has_moe else None)
 
 
@@ -309,18 +353,18 @@ def decode_paged(
     cache); active [S] bool. Returns (logits [S, V], cache).
     """
     p = params["params"] if "params" in params else params
-    logits, k_new, v_new, _ = _decode_once(
-        p, cfg, tokens, block_tables, lengths, cache.k, cache.v, active,
+    logits, cache, _ = _decode_once(
+        p, cfg, tokens, block_tables, lengths, cache, active,
         use_kernel, moe_fused,
     )
-    return logits, PagedKVCache(k=k_new, v=v_new)
+    return logits, cache
 
 
 def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
-                 cache_k, cache_v, active, use_kernel: bool,
+                 cache: PagedKVCache, active, use_kernel: bool,
                  moe_fused: bool = False):
     """One MULTI-TOKEN decode iteration: tokens [S, W] at positions
-    ``lengths .. lengths+W-1`` → (logits [S, W, V], k pool, v pool).
+    ``lengths .. lengths+W-1`` → (logits [S, W, V], cache).
 
     The speculative verify pass (one forward scores a whole draft window)
     and the W=1 degenerate case share this core; with W=1 the math is
@@ -337,7 +381,7 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
     has_moe = "moe" in stacked and getattr(cfg, "num_experts", 0) > 0
     dtype = cfg.dtype or jnp.bfloat16
     n_slots, w = tokens.shape
-    bs = cache_k.shape[3]
+    bs = cache.k.shape[3]
     max_blocks = block_tables.shape[1]
     positions = lengths[:, None] + jnp.arange(w)[None, :]  # [S, W]
 
@@ -362,14 +406,25 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
 
     def layer(carry, inputs):
         x, i = carry
-        layer_params, k_pool, v_pool = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
         k, v = _project_kv(cfg, layer_params, h, positions)  # [S,W,Hkv,D]
-        # pool [n_blocks, Hkv, bs, D]: advanced indices (wb, :, wo) → [S, W, Hkv, D]
-        k_new = jnp.where(write_ok[..., None, None], k, k_pool[wb, :, wo])
-        v_new = jnp.where(write_ok[..., None, None], v, v_pool[wb, :, wo])
-        k_pool = k_pool.at[wb, :, wo].set(k_new)
-        v_pool = v_pool.at[wb, :, wo].set(v_new)
+        if k_sc is not None:
+            # sequential per-token appends: window tokens can share a page,
+            # and the running-absmax rescale must see each predecessor's
+            # write — same ordering as W sequential _decode_once appends,
+            # which keeps W=1 bitwise-identical to the decode path
+            for t in range(w):
+                k_pool, k_sc = kv_quant.append_token(
+                    k_pool, k_sc, wb[:, t], wo[:, t], k[:, t], write_ok[:, t])
+                v_pool, v_sc = kv_quant.append_token(
+                    v_pool, v_sc, wb[:, t], wo[:, t], v[:, t], write_ok[:, t])
+        else:
+            # pool [n_blocks, Hkv, bs, D]: advanced indices (wb, :, wo) → [S, W, Hkv, D]
+            k_new = jnp.where(write_ok[..., None, None], k, k_pool[wb, :, wo])
+            v_new = jnp.where(write_ok[..., None, None], v, v_pool[wb, :, wo])
+            k_pool = k_pool.at[wb, :, wo].set(k_new)
+            v_pool = v_pool.at[wb, :, wo].set(v_new)
         if use_kernel:
             from colossalai_tpu.kernel import fused_add_rms_norm
             from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
@@ -380,7 +435,8 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
             q = apply_rope(q, cos, sin)
             # kernel length semantics: valid tokens INCLUDING the first
             # query token; query i's causal frontier is lengths + 1 + i
-            attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1)
+            attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1,
+                                   k_scale=k_sc, v_scale=v_sc)
             attn = attn.reshape(n_slots, w, cfg.num_attention_heads * cfg.head_dim_)
             attn_out = (
                 attn.astype(dtype)
@@ -398,19 +454,24 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                 up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
                 x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
         else:
-            def to_seq(pool):
+            def to_seq(pool, sc):
                 g = pool[block_tables]  # [S, mb, Hkv, bs, D]
+                if sc is not None:
+                    g = kv_quant.dequantize_pages(g, sc[block_tables], dtype)
                 g = g.transpose(0, 1, 3, 2, 4)
                 return g.reshape(n_slots, s_max, pool.shape[1], pool.shape[3])
 
-            x = _block_step(cfg, layer_params, x, to_seq(k_pool), to_seq(v_pool),
-                            positions, attend, moe_fused=moe_fused)
-        return (x, i + 1), (k_pool, v_pool)
+            x = _block_step(cfg, layer_params, x, to_seq(k_pool, k_sc),
+                            to_seq(v_pool, v_sc), positions, attend,
+                            moe_fused=moe_fused)
+        return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
-    (x, _), (k_new, v_new) = jax.lax.scan(
-        layer, (x.astype(dtype), 0), (stacked, cache_k, cache_v)
+    (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+        layer, (x.astype(dtype), 0),
+        (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
-    return _logits_head(p, cfg, x), k_new, v_new
+    return (_logits_head(p, cfg, x),
+            PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new))
 
 
 @partial(jax.jit, static_argnames=("cfg", "use_kernel", "moe_fused"),
@@ -427,11 +488,10 @@ def verify_paged(
     returns (logits [S, W, V], cache)."""
     p = params["params"] if "params" in params else params
     limits = lengths + tokens.shape[1]
-    logits, k_new, v_new = _extend_once(
-        p, cfg, tokens, block_tables, lengths, limits, cache.k, cache.v,
+    return _extend_once(
+        p, cfg, tokens, block_tables, lengths, limits, cache,
         active, use_kernel, moe_fused,
     )
-    return logits, PagedKVCache(k=k_new, v=v_new)
 
 
 @partial(
@@ -473,9 +533,9 @@ def decode_megastep(
     has_moe = "moe" in p["layers"]["block"] and getattr(cfg, "num_experts", 0) > 0
     n_experts = cfg.num_experts if has_moe else 0
 
-    def decode_once(tok, lens, ck, cv, alive):
+    def decode_once(tok, lens, cache_i, alive):
         return _decode_once(
-            p, cfg, tok, block_tables, lens, ck, cv, alive, use_kernel,
+            p, cfg, tok, block_tables, lens, cache_i, alive, use_kernel,
             moe_fused,
         )
 
@@ -493,8 +553,10 @@ def megastep_loop(
 ):
     """The megastep's per-iteration bookkeeping (buffer commit, length/
     budget advance, eos/done flags) around any single-iteration decode —
-    ``decode_once(tok, lens, ck, cv, alive) → (logits [S, V], ck, cv,
-    expert_counts | None)``. Shared by :func:`decode_megastep`
+    ``decode_once(tok, lens, cache, alive) → (logits [S, V], cache,
+    expert_counts | None)`` where ``cache`` is the full
+    :class:`PagedKVCache` pytree (int8 pools carry their scale tensors
+    through the fori_loop with it). Shared by :func:`decode_megastep`
     (single-stage ``_decode_once``) and the pipeline-parallel megastep
     (pp_decode's shard_map relay), so both advance device state
     identically. Must be called under jit (traces a ``fori_loop``).
@@ -506,11 +568,11 @@ def megastep_loop(
     buf0 = jnp.full((n_slots, k_steps), -1, jnp.int32)
 
     def body(i, carry):
-        ck, cv, tok, lens, alive, budg, buf, emitted, counts = carry
+        kv, tok, lens, alive, budg, buf, emitted, counts = carry
         # named HLO regions: a /profile capture splits each megastep
         # iteration into forward vs sample/commit time
         with jax.named_scope("decode_iter"):
-            logits, ck, cv, step_counts = decode_once(tok, lens, ck, cv, alive)
+            logits, kv, step_counts = decode_once(tok, lens, kv, alive)
         if n_experts:
             counts = counts + step_counts
         if use_sampling:
@@ -526,13 +588,13 @@ def megastep_loop(
         hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
         tok = jnp.where(alive, nxt, tok)
         alive = alive & ~hit_eos & (budg > 0)
-        return (ck, cv, tok, lens, alive, budg, buf, emitted, counts)
+        return (kv, tok, lens, alive, budg, buf, emitted, counts)
 
-    init = (cache.k, cache.v, tokens, lengths, active, budgets, buf0,
+    init = (cache, tokens, lengths, active, budgets, buf0,
             jnp.zeros((n_slots,), jnp.int32),
             jnp.zeros((n_experts,), jnp.int32))
-    ck, cv, tok, lens, alive, budg, buf, emitted, counts = jax.lax.fori_loop(
+    kv, tok, lens, alive, budg, buf, emitted, counts = jax.lax.fori_loop(
         0, k_steps, body, init
     )
-    out = (buf, emitted, alive, tok, lens, budg, PagedKVCache(k=ck, v=cv))
+    out = (buf, emitted, alive, tok, lens, budg, kv)
     return out + (counts,) if n_experts else out

@@ -325,11 +325,13 @@ def build_pp_paged(mesh, cfg: LlamaConfig, block_size: int, max_blocks: int):
         """K relay iterations in one program — same contract and return
         shape as :func:`..paged_modeling.decode_megastep`."""
 
-        def decode_once(tok, lens, ck, cv, alive):
+        def decode_once(tok, lens, kv, alive):
             logits, ck, cv = _decode_relay(
-                top, stacked, tok, block_tables, lens, ck, cv, alive
+                top, stacked, tok, block_tables, lens, kv.k, kv.v, alive
             )
-            return logits, ck, cv, None  # pp stages are dense-only (no MoE)
+            # pp stages are dense-only (no MoE) and bf16-only (no int8
+            # pool: the engine rejects kv_dtype="int8" with a mesh)
+            return logits, PagedKVCache(k=ck, v=cv), None
 
         return megastep_loop(
             decode_once, tokens, lengths, cache, active, budgets, eos_ids,

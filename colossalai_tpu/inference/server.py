@@ -239,6 +239,7 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         "status": "ok",
                         "scheduler_policy": engine.scheduler_policy,
                         "prefix_cache": engine.prefix_cache is not None,
+                        "kv_dtype": engine.kv_dtype,
                         **self._occupancy(),
                     }
                     # one serialization for every counter: as_dict() keys
@@ -262,6 +263,11 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     # a ratio is a gauge, not a counter (it can go down)
                     gauges["spec_acceptance_rate"] = \
                         counters.pop("spec_acceptance_rate")
+                    # pool footprint is fixed at init and blocks-in-use
+                    # shrinks on free — both gauges, not counters
+                    gauges["kv_pool_bytes"] = counters.pop("kv_pool_bytes")
+                    gauges["kv_blocks_in_use"] = \
+                        counters.pop("kv_blocks_in_use")
                     body = prometheus_exposition(
                         counters, gauges, engine.telemetry.histograms,
                     ).encode()

@@ -208,8 +208,10 @@ def spec_megastep_loop(
     """The speculative megastep's per-iteration bookkeeping around a pair
     of extend callables (must be called under jit; traces a fori_loop):
 
-    - ``draft_extend(tokens [S, W'], lens, limits, ck, cv, alive)`` →
-      ``(logits [S, W', V], ck, cv)`` over the DRAFT pool;
+    - ``draft_extend(tokens [S, W'], lens, limits, cache, alive)`` →
+      ``(logits [S, W', V], cache)`` over the DRAFT pool (the full
+      :class:`PagedKVCache` pytree — int8 pools carry their scale tensors
+      through the fori_loop with it);
     - ``target_extend(...)`` — same signature over the target pool.
 
     Each of the ``k_steps`` iterations: (1) ``d`` sequential single-token
@@ -247,7 +249,7 @@ def spec_megastep_loop(
     limits = lengths + jnp.minimum(width, jnp.maximum(budgets, 1))
 
     def body(j, carry):
-        (ck, cv, dk, dv, tok, lens, alive, budg, buf, emitted,
+        (t_kv, d_kv, tok, lens, alive, budg, buf, emitted,
          passes, drafted, accepted) = carry
         key = rng_keys[j]
 
@@ -259,7 +261,7 @@ def spec_megastep_loop(
             q_list = []
             t = tok
             for i in range(d):
-                dlog, dk, dv = draft_extend(t[:, None], lens + i, limits, dk, dv, alive)
+                dlog, d_kv = draft_extend(t[:, None], lens + i, limits, d_kv, alive)
                 dlog = dlog[:, 0]
                 if use_sampling:
                     dmask = filter_logits(dlog, temp, topk, topp)
@@ -276,13 +278,13 @@ def spec_megastep_loop(
             # back-fill d_d's K/V so a full acceptance leaves no hole at
             # position lens + d (when a < d the garbage is re-fed next round
             # before anything reads it); logits discarded
-            _, dk, dv = draft_extend(t[:, None], lens + d, limits, dk, dv, alive)
+            _, d_kv = draft_extend(t[:, None], lens + d, limits, d_kv, alive)
             drafts_arr = jnp.stack(drafts, axis=1)  # [S, d]
 
         # ---- verify: ONE multi-token forward over [t0, d_1 .. d_d]
         with jax.named_scope("spec_verify"):
             window = jnp.concatenate([tok[:, None], drafts_arr], axis=1)  # [S, W]
-            vlog, ck, cv = target_extend(window, lens, limits, ck, cv, alive)
+            vlog, t_kv = target_extend(window, lens, limits, t_kv, alive)
             tgt = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [S, W]
 
         # ---- acceptance: longest matching prefix + correction token
@@ -358,15 +360,14 @@ def spec_megastep_loop(
         budg = budg - e
         stopped = eos_idx < e  # an emitted token was eos
         alive = alive & ~stopped & (budg > 0)
-        return (ck, cv, dk, dv, tok, lens, alive, budg, buf, emitted,
+        return (t_kv, d_kv, tok, lens, alive, budg, buf, emitted,
                 passes, drafted, accepted)
 
-    init = (cache.k, cache.v, draft_cache.k, draft_cache.v, tokens, lengths,
+    init = (cache, draft_cache, tokens, lengths,
             active, budgets, buf0, zeros, zeros, zeros, zeros)
-    (ck, cv, dk, dv, tok, lens, alive, budg, buf, emitted,
+    (t_kv, d_kv, tok, lens, alive, budg, buf, emitted,
      passes, drafted, accepted) = jax.lax.fori_loop(0, k_steps, body, init)
-    return (buf, emitted, alive, tok, lens, budg,
-            PagedKVCache(k=ck, v=cv), PagedKVCache(k=dk, v=dv),
+    return (buf, emitted, alive, tok, lens, budg, t_kv, d_kv,
             passes, drafted, accepted)
 
 
@@ -396,13 +397,13 @@ def decode_spec_megastep(
     p = params["params"] if "params" in params else params
     dp = draft_params["params"] if "params" in draft_params else draft_params
 
-    def target_extend(toks, lens, limits, ck, cv, alive):
+    def target_extend(toks, lens, limits, kv, alive):
         return _extend_once(
-            p, cfg, toks, block_tables, lens, limits, ck, cv, alive, use_kernel)
+            p, cfg, toks, block_tables, lens, limits, kv, alive, use_kernel)
 
-    def draft_extend(toks, lens, limits, ck, cv, alive):
+    def draft_extend(toks, lens, limits, kv, alive):
         return _extend_once(
-            dp, draft_cfg, toks, block_tables, lens, limits, ck, cv, alive,
+            dp, draft_cfg, toks, block_tables, lens, limits, kv, alive,
             use_kernel)
 
     return spec_megastep_loop(

@@ -230,6 +230,83 @@ def silu_and_mul(gate_up: jax.Array) -> jax.Array:
     return jax.nn.silu(gate) * up
 
 
+# ---------------------------------------------------------- paged attention
+# ≙ flash_decoding_attention_kernel.cu over the paged KV pool. The Pallas
+# kernel (kernel/pallas/paged_attention.py) streams exactly the pages each
+# slot owns via scalar-prefetch block tables and dequantizes int8 pages
+# in-register; this XLA reference gathers the padded [S, s_max] view and
+# applies the IDENTICAL dequant cast (int8 → f32 * scale → compute dtype)
+# so the two paths agree bitwise off-TPU and to matmul tolerance on it.
+
+
+def _paged_attention_xla(q, k_pool, v_pool, block_tables, lengths, *,
+                         k_scale=None, v_scale=None, softmax_scale=None,
+                         heads_per_step=None):
+    multi = q.ndim == 4
+    if not multi:
+        q = q[:, None]
+    n_slots, w, h, d = q.shape
+    _, hkv, block_size, _ = k_pool.shape
+    group = h // hkv
+    max_blocks = block_tables.shape[1]
+    s_max = max_blocks * block_size
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    def gather(pool, sc):
+        g = pool[block_tables]  # [S, max_blocks, Hkv, bs, D]
+        if sc is not None:
+            g = (g.astype(jnp.float32)
+                 * sc[block_tables][..., None, None]).astype(q.dtype)
+        # [S, s_max, Hkv, D]
+        return g.transpose(0, 1, 3, 2, 4).reshape(n_slots, s_max, hkv, d)
+
+    k_seq = gather(k_pool, k_scale)
+    v_seq = gather(v_pool, v_scale)
+    # GQA: fold query heads onto their kv head, rows query-major like the
+    # kernel's [W*G] tile
+    qg = q.reshape(n_slots, w, hkv, group, d)
+    sc_ = jnp.einsum("swkgd,stkd->swkgt", qg.astype(jnp.float32),
+                     k_seq.astype(jnp.float32)) * scale
+    pos = jnp.arange(s_max, dtype=jnp.int32)
+    # query w sits at position lengths - 1 + w: it sees pos < lengths + w
+    in_len = (pos[None, None, :]
+              < (lengths[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :])[
+                  ..., None])  # [S, W, s_max]
+    sc_ = jnp.where(in_len[:, :, None, None, :], sc_, -1e30)
+    p = jax.nn.softmax(sc_, axis=-1)
+    out = jnp.einsum("swkgt,stkd->swkgd", p, v_seq.astype(jnp.float32))
+    out = out.reshape(n_slots, w, h, d).astype(q.dtype)
+    return out if multi else out[:, 0]
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, block_tables, lengths, *,
+                            k_scale=None, v_scale=None, softmax_scale=None,
+                            heads_per_step=None):
+    from .pallas.paged_attention import paged_attention as impl
+
+    return impl(q, k_pool, v_pool, block_tables, lengths, k_scale=k_scale,
+                v_scale=v_scale, softmax_scale=softmax_scale,
+                heads_per_step=heads_per_step)
+
+
+KernelLoader.register("paged_attention", "pallas", _pallas_module("paged_attention"), _paged_attention_pallas)
+KernelLoader.register("paged_attention", "xla", lambda: True, _paged_attention_xla)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, k_scale=None,
+                    v_scale=None, softmax_scale=None, heads_per_step=None):
+    """Decode attention over the paged KV pool. q [S, H, D] (one token per
+    slot) or [S, W, H, D] (speculative verify window — query w sits at
+    position ``lengths - 1 + w``); pool [n_blocks, Hkv, block_size, D];
+    ``lengths`` counts valid tokens INCLUDING the first query. Int8 pools
+    pass ``k_scale``/``v_scale`` [n_blocks, Hkv] f32 per-(page, kv-head)
+    scales; both backends dequantize with the same cast chain."""
+    fn = KernelLoader.load("paged_attention")
+    return fn(q, k_pool, v_pool, block_tables, lengths, k_scale=k_scale,
+              v_scale=v_scale, softmax_scale=softmax_scale,
+              heads_per_step=heads_per_step)
+
+
 # ---------------------------------------------------------------- fused MoE
 # ≙ the route→permute→expert-matmul→unpermute chain, collapsed: Pallas on
 # TPU (kernel/pallas/fused_moe.py), gather/einsum/scatter reference in XLA

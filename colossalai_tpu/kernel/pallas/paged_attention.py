@@ -46,14 +46,26 @@ from ._common import mask_value as _mask_value
 _MASK_FILL = _mask_value(jnp.float32)
 
 
-def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
-            scale, block_size, max_blocks, hps, group, w):
+def _kernel(bt_ref, len_ref, *rest, scale, block_size, max_blocks, hps,
+            group, w, quantized):
     """Grid (slots, head-groups, pages); ``hps`` kv heads per step (static
     loop) — per-step overhead, not MXU work, dominates single-token
     decode. Each kv head's q tile has ``w * group`` rows: row r belongs to
     query token ``r // group``, whose causal frontier is ``length + r //
-    group`` (``length`` counts valid tokens INCLUDING the first query)."""
+    group`` (``length`` counts valid tokens INCLUDING the first query).
+
+    ``quantized`` pools store int8 pages; their per-(page, kv-head) scales
+    arrive as two extra scalar-prefetch operands (``ks_ref``/``vs_ref``,
+    [n_blocks, Hkv] f32 in SMEM, addressed through the same block table
+    the k/v index maps dereference) and each tile is dequantized to the
+    compute dtype IN-REGISTER before the QK/PV matmuls — a bf16 copy of
+    the pool never materializes."""
+    if quantized:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc, m, l = rest
     s = pl.program_id(0)
+    hg = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -73,6 +85,15 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
             q = q_ref[0, hh]  # [W*G, D]
             k = k_ref[0, hh]  # [block_size, D]
             v = v_ref[0, hh]
+            if quantized:
+                # under ``needed``, j indexes a REAL page of this slot, so
+                # bt_ref[s, j] is the physical block whose scale applies;
+                # the dequant matches kv_quant.dequantize_pages' cast point
+                # bit-for-bit (int8 * f32 scale → compute dtype)
+                block = bt_ref[s, j]
+                head = hg * hps + hh
+                k = (k.astype(jnp.float32) * ks_ref[block, head]).astype(q.dtype)
+                v = (v.astype(jnp.float32) * vs_ref[block, head]).astype(q.dtype)
             sc = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             ) * scale  # [W*G, block_size]
@@ -99,11 +120,13 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
 
 
 def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype,
-                          qlen=1) -> int:
+                          qlen=1, pool_dtype=None) -> int:
     from .. import tuning
 
     if not tuning.tuning_enabled():
         return hkv
+    pool_dtype = pool_dtype if pool_dtype is not None else dtype
+    quantized = jnp.dtype(pool_dtype) == jnp.dtype(jnp.int8)
 
     def measure(hps):
         n_slots = 8
@@ -111,16 +134,19 @@ def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype,
             q = jnp.zeros((n_slots, qlen, hkv * group, d), dtype)
         else:
             q = jnp.zeros((n_slots, hkv * group, d), dtype)
-        pool = jnp.zeros((max_blocks, hkv, block_size, d), dtype)
+        pool = jnp.zeros((max_blocks, hkv, block_size, d), pool_dtype)
+        sc = jnp.ones((max_blocks, hkv), jnp.float32) if quantized else None
         bt = jnp.broadcast_to(
             jnp.arange(max_blocks, dtype=jnp.int32)[None], (n_slots, max_blocks))
         ln = jnp.full((n_slots,), max_blocks * block_size - (qlen - 1), jnp.int32)
-        fn = jax.jit(functools.partial(paged_attention, heads_per_step=hps))
+        fn = jax.jit(functools.partial(
+            paged_attention, heads_per_step=hps, k_scale=sc, v_scale=sc))
         return tuning.time_fn(fn, q, pool, pool, bt, ln)
 
     try:
         return tuning.paged_heads_per_step(
-            hkv, group, d, block_size, dtype, measure, qlen=qlen)
+            hkv, group, d, block_size, dtype, measure, qlen=qlen,
+            pool_dtype=pool_dtype)
     except Exception:  # never let tuning break the hot path
         return hkv
 
@@ -132,13 +158,27 @@ def paged_attention(
     block_tables: jax.Array,  # [S, max_blocks] int32
     lengths: jax.Array,       # [S] valid tokens INCLUDING the first query
     *,
+    k_scale: jax.Array | None = None,  # [n_blocks, Hkv] f32 (int8 pools)
+    v_scale: jax.Array | None = None,
     softmax_scale: float | None = None,
     heads_per_step: int | None = None,
 ) -> jax.Array:
     """Returns [S, H, D] (or [S, W, H, D] for a multi-token window, whose
     query w sits at position ``lengths - 1 + w``). ``heads_per_step`` must
     divide Hkv; ``None`` consults the tuning cache on TPU (all heads per
-    step elsewhere)."""
+    step elsewhere — the cache key carries the POOL dtype, since an int8
+    page tile halves the VMEM working set and shifts the profitable
+    split). Int8 pools pass their per-(page, kv-head) scales via
+    ``k_scale``/``v_scale``; tiles are dequantized in-register (see
+    ``_kernel``)."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if k_pool.dtype == jnp.int8 and k_scale is None:
+        raise ValueError(
+            "int8 KV pool without scales — quantized pages are meaningless "
+            "without their k_scale/v_scale tensors"
+        )
+    quantized = k_scale is not None
     multi = q.ndim == 4
     if not multi:
         q = q[:, None]
@@ -149,7 +189,8 @@ def paged_attention(
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     if heads_per_step is None:
         heads_per_step = _tuned_heads_per_step(
-            hkv, group, d, block_size, max_blocks, q.dtype, qlen=w)
+            hkv, group, d, block_size, max_blocks, q.dtype, qlen=w,
+            pool_dtype=k_pool.dtype)
     hps = heads_per_step
     if hkv % hps:
         raise ValueError(f"heads_per_step={hps} must divide Hkv={hkv}")
@@ -163,7 +204,14 @@ def paged_attention(
           .transpose(0, 2, 1, 3, 4)
           .reshape(n_slots, hkv, rows, d))
 
-    def page_map(s, hg, j, bt, ln):
+    # scalar-prefetch operands: (bt, ln) — plus the scale tensors for int8
+    # pools, which the index maps ignore but the kernel body reads through
+    # the same prefetched block table
+    def q_map(s, hg, j, *pf):
+        return (s, hg, 0, 0)
+
+    def page_map(s, hg, j, *pf):
+        bt, ln = pf[0], pf[1]
         # clamp to the last REAL page (of the deepest query's frontier):
         # steps past it keep the previous origin, so Mosaic never
         # re-fetches for skipped pages
@@ -173,29 +221,32 @@ def paged_attention(
 
     kernel = functools.partial(
         _kernel, scale=scale, block_size=block_size, max_blocks=max_blocks,
-        hps=hps, group=group, w=w,
+        hps=hps, group=group, w=w, quantized=quantized,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4 if quantized else 2,
         grid=(n_slots, n_hgroups, max_blocks),
         in_specs=[
-            pl.BlockSpec((1, hps, rows, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
+            pl.BlockSpec((1, hps, rows, d), q_map),
             pl.BlockSpec((1, hps, block_size, d), page_map),
             pl.BlockSpec((1, hps, block_size, d), page_map),
         ],
-        out_specs=pl.BlockSpec((1, hps, rows, d), lambda s, hg, j, bt, ln: (s, hg, 0, 0)),
+        out_specs=pl.BlockSpec((1, hps, rows, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((hps, rows, d), jnp.float32),
             pltpu.VMEM((hps, rows, 1), jnp.float32),
             pltpu.VMEM((hps, rows, 1), jnp.float32),
         ],
     )
+    prefetch = (block_tables.astype(jnp.int32), lengths.astype(jnp.int32))
+    if quantized:
+        prefetch += (k_scale.astype(jnp.float32), v_scale.astype(jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    )(*prefetch, qg, k_pool, v_pool)
     out = (out.reshape(n_slots, hkv, w, group, d)
            .transpose(0, 2, 1, 3, 4)
            .reshape(n_slots, w, h, d))

@@ -292,21 +292,26 @@ def norm_rows(
 
 def paged_heads_per_step(
     hkv: int, group: int, d: int, block_size: int, dtype,
-    measure: Callable[[int], float], qlen: int = 1,
+    measure: Callable[[int], float], qlen: int = 1, pool_dtype=None,
 ) -> int:
     """KV-heads processed per grid step in the paged decode kernel: all
     heads (fewest grid steps, current default) vs smaller groups (smaller
     VMEM working set, more pipeline overlap). ``qlen`` is the query window
     width — 1 for plain decode, draft_len+1 for the speculative verify
     pass — a separate key because the q tile (and the profitable tiling)
-    scales with it."""
+    scales with it. ``pool_dtype`` is the PAGE dtype (int8 for quantized
+    pools, else the compute dtype): an int8 page tile halves the per-step
+    HBM traffic and VMEM footprint, so the profitable split differs from
+    bf16 at the same geometry and the two must not share a cache entry."""
     cands = sorted({h for h in (hkv, max(hkv // 2, 1), 1) if hkv % h == 0},
                    reverse=True)
     if len(cands) == 1:
         return hkv
+    pool_dtype = pool_dtype if pool_dtype is not None else dtype
     return get_tuner().tune(
         "paged_attention",
-        (device_kind(), hkv, group, d, block_size, _dt(dtype), qlen),
+        (device_kind(), hkv, group, d, block_size, _dt(dtype), qlen,
+         _dt(pool_dtype)),
         cands, measure, hkv,
     )
 

@@ -1,0 +1,268 @@
+"""Int8 KV-page quantization (kv_quant.py + the kv_dtype engine knob).
+
+The contracts under test:
+
+- quantize→dequant round-trip error is bounded by half a quantization step
+  per element (and the running-absmax append stays within a small multiple
+  of it, rescales included);
+- at an EQUAL ``num_blocks * block_size`` HBM budget in BYTES, the int8
+  pool holds >= 1.9x the resident KV tokens of bf16 — the capacity claim,
+  asserted from real ``.nbytes``;
+- the quantized engine composes: greedy int8 tracks bf16 token-for-token
+  on short prompts, megastep K never changes content, prefix-cache warm
+  hits are token-identical to cold runs, and speculative rollback refunds
+  pages with a quantized draft pool;
+- config validation fails fast (bad kv_dtype / pool dtype / TPU-illegal
+  block_size) and the KV-pool gauges report from host bookkeeping.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine
+from colossalai_tpu.inference import kv_quant
+from colossalai_tpu.inference.kv_cache import init_paged_cache
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    """f32 compute so the bf16-pool engine stores pages losslessly — the
+    int8 engine's only numeric delta is the quantization under test."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("seed", 0)
+    return LLMEngine(params, cfg, **kw)
+
+
+# ---------------------------------------------------------- round-trip math
+def test_round_trip_error_bound_per_page():
+    """Whole-page quantization: every element lands within half a step
+    (scale/2) of its source, per (page, head) scale."""
+    rng = np.random.RandomState(0)
+    pages = jnp.asarray(rng.randn(5, 2, 16, 8) * 3.0, jnp.float32)
+    valid = jnp.ones((5, 16), bool)
+    scales = kv_quant.page_scales(pages, valid)
+    assert scales.shape == (5, 2)
+    q = kv_quant.quantize_pages(pages, scales)
+    deq = kv_quant.dequantize_pages(q, scales, jnp.float32)
+    err = np.abs(np.asarray(deq) - np.asarray(pages))
+    bound = np.asarray(scales)[:, :, None, None] / 2 + 1e-7
+    assert (err <= bound).all(), err.max()
+    # nothing clips: |q| stays inside the symmetric range
+    assert np.abs(np.asarray(q)).max() <= 127
+
+
+def test_page_scales_exclude_pad_tokens():
+    """Garbage K/V past n_tokens must not inflate the absmax."""
+    pages = jnp.zeros((1, 1, 4, 2), jnp.float32)
+    pages = pages.at[0, 0, 1].set(2.0)     # valid token
+    pages = pages.at[0, 0, 3].set(1e6)     # pad garbage
+    valid = jnp.asarray([[True, True, False, False]])
+    scales = kv_quant.page_scales(pages, valid)
+    np.testing.assert_allclose(np.asarray(scales), [[2.0 / 127.0]])
+
+
+def test_append_token_running_absmax_and_fresh_reset():
+    rng = np.random.RandomState(1)
+    bs, hkv, d = 8, 2, 4
+    pool = jnp.zeros((3, hkv, bs, d), jnp.int8)
+    # block 2 simulates a recycled page: stale ints and a loud stale scale
+    pool = pool.at[2].set(jnp.full((hkv, bs, d), 99, jnp.int8))
+    scales = jnp.zeros((3, hkv), jnp.float32).at[2].set(50.0)
+    toks = rng.randn(bs, 1, hkv, d).astype(np.float32)
+
+    seen = []
+    for i in range(bs):
+        tok = jnp.asarray(toks[i])
+        prev = np.asarray(scales)
+        pool, scales = kv_quant.append_token(
+            pool, scales, jnp.asarray([2], jnp.int32),
+            jnp.asarray([i], jnp.int32), tok, jnp.asarray([True]))
+        seen.append(np.abs(toks[: i + 1, 0]).max(axis=(0, 2)) / 127.0)
+        if i == 0:
+            # offset-0 append resets the recycled block's stale scale
+            assert (np.asarray(scales)[2] < 1.0).all(), np.asarray(scales)[2]
+        else:
+            assert (np.asarray(scales)[2] >= prev[2] - 1e-9).all()
+        # the running scale IS the absmax of the tokens appended so far
+        np.testing.assert_allclose(np.asarray(scales)[2], seen[-1], rtol=1e-6)
+
+    deq = kv_quant.dequantize_pages(pool[2], scales[2], jnp.float32)
+    err = np.abs(np.asarray(deq) - toks[:, 0].transpose(1, 0, 2))
+    # growth rescales re-round the page's ints: allow a few half-steps
+    bound = np.asarray(scales)[2][:, None, None] * 1.5 + 1e-7
+    assert (err <= bound).all(), err.max()
+    # inactive appends touch nothing
+    p2, s2 = kv_quant.append_token(
+        pool, scales, jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32),
+        jnp.full((1, hkv, d), 1e6, jnp.float32), jnp.asarray([False]))
+    np.testing.assert_array_equal(np.asarray(p2), np.asarray(pool))
+    np.testing.assert_array_equal(np.asarray(s2), np.asarray(scales))
+
+
+# ---------------------------------------------------------- capacity claim
+def test_int8_capacity_at_equal_byte_budget():
+    """THE acceptance gate: same ``num_blocks * block_size`` geometry, real
+    ``.nbytes`` — tokens-per-byte must favor int8 by >= 1.9x (pages halve,
+    scales cost ~0.8% back at block_size=128)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    nb, bs = 16, 128
+    bf16 = init_paged_cache(cfg, nb, bs, dtype=jnp.bfloat16)
+    i8 = init_paged_cache(cfg, nb, bs, dtype=jnp.int8)
+    bytes_bf16 = sum(leaf.nbytes for leaf in jax.tree.leaves(bf16))
+    bytes_i8 = sum(leaf.nbytes for leaf in jax.tree.leaves(i8))
+    tokens = nb * bs  # both pools hold the same token capacity...
+    per_tok_bf16 = bytes_bf16 / tokens
+    per_tok_i8 = bytes_i8 / tokens
+    # ...so at a FIXED byte budget, resident tokens scale inversely with
+    # bytes/token: budget/per_tok_i8 >= 1.9 * budget/per_tok_bf16
+    assert per_tok_bf16 / per_tok_i8 >= 1.9, (per_tok_bf16, per_tok_i8)
+    # the scale tensors exist and are the only f32 leaves
+    assert i8.quantized and not bf16.quantized
+    assert i8.k_scale.shape == (
+        cfg.num_hidden_layers, nb, cfg.num_key_value_heads)
+
+
+# ------------------------------------------------------------- validation
+def test_init_paged_cache_rejects_bad_dtype():
+    cfg = LlamaConfig.tiny()
+    with pytest.raises(ValueError, match="dtype"):
+        init_paged_cache(cfg, 4, 16, dtype=jnp.int32)
+
+
+def test_init_paged_cache_rejects_tpu_illegal_block_size(monkeypatch):
+    """On TPU the page is the kernel tile: block_size % 128 fails fast at
+    init with a readable error instead of a Mosaic lowering crash."""
+    from colossalai_tpu.kernel import loader
+
+    cfg = LlamaConfig.tiny()
+    monkeypatch.setattr(loader, "on_tpu", lambda: True)
+    with pytest.raises(ValueError, match="128"):
+        init_paged_cache(cfg, 4, 16)
+    init_paged_cache(cfg, 4, 128)  # multiple of 128: fine
+    monkeypatch.setattr(loader, "on_tpu", lambda: False)
+    init_paged_cache(cfg, 4, 16)   # CPU/interpret: any size
+
+
+def test_engine_kv_dtype_validation(parts):
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _engine(parts, kv_dtype="fp8")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("pp",))
+    with pytest.raises(NotImplementedError, match="int8"):
+        _engine(parts, kv_dtype="int8", mesh=mesh)
+
+
+# ------------------------------------------------------ engine composition
+_RNG = np.random.RandomState(3)
+PROMPTS = [list(map(int, _RNG.randint(0, 256, size=(n,))))
+           for n in (6, 11, 19)]
+
+
+@pytest.fixture(scope="module")
+def int8_greedy(parts):
+    eng = _engine(parts, kv_dtype="int8")
+    return eng.generate([list(p) for p in PROMPTS],
+                        GenerationConfig(max_new_tokens=12))
+
+
+def test_greedy_int8_tracks_bf16(parts, int8_greedy):
+    """Token-level parity gate on short prompts: quantization noise must
+    not flip >= 5% of greedy argmaxes (near-ties may flip — and a flip
+    cascades — so this is a tolerance, not an identity)."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS],
+                                  GenerationConfig(max_new_tokens=12))
+    total = agree = 0
+    for a, b in zip(ref, int8_greedy):
+        assert len(a) == len(b) == 12
+        total += len(a)
+        agree += sum(int(x == y) for x, y in zip(a, b))
+    assert agree / total >= 0.95, (agree, total, ref, int8_greedy)
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_int8_megastep_k_invariance(parts, int8_greedy, k):
+    """K changes sync granularity, never content: the quantized append
+    order per token is identical, so outputs are bit-identical across K."""
+    out = _engine(parts, kv_dtype="int8", megastep_k=k).generate(
+        [list(p) for p in PROMPTS], GenerationConfig(max_new_tokens=12))
+    assert out == int8_greedy
+
+
+def test_int8_prefix_cache_warm_cold_identity(parts, int8_greedy):
+    """Warm requests gather cached int8 pages + their scales by PHYSICAL
+    block id; cold prefill attends to the round-tripped values — so warm
+    output == cold output exactly, same as the bf16 contract."""
+    eng = _engine(parts, kv_dtype="int8", prefix_cache=True)
+    gen = GenerationConfig(max_new_tokens=12)
+    cold = eng.generate([list(p) for p in PROMPTS], gen)
+    assert eng.stats.prefix_hit_blocks == 0
+    warm = eng.generate([list(p) for p in PROMPTS], gen)
+    assert warm == cold == int8_greedy
+    assert eng.stats.prefix_hit_blocks > 0
+
+
+def test_int8_chunked_prefill_matches_single_shot(parts, int8_greedy):
+    """Chunked prefill writes the same quantized pages (chunks are whole
+    pages, so per-page absmax sees the same tokens) — content identical."""
+    out = _engine(parts, kv_dtype="int8", prefill_chunk=16).generate(
+        [list(p) for p in PROMPTS], GenerationConfig(max_new_tokens=12))
+    assert out == int8_greedy
+
+
+def test_int8_spec_rollback_refunds_pages(parts):
+    """Speculative decoding over quantized target AND draft pools: rejected
+    tokens' pages refund each megastep (no slot over-holds mid-flight) and
+    the end-state accounting covers the whole pool."""
+    cfg, params = parts
+    dc = dataclasses.replace(cfg, num_hidden_layers=1)
+    dp = LlamaForCausalLM(dc).init(
+        jax.random.PRNGKey(7), jnp.ones((1, 8), jnp.int32))
+    eng = _engine(parts, kv_dtype="int8", megastep_k=2, draft_len=3,
+                  draft_params=dp, draft_config=dc, prefix_cache=True)
+    assert eng.draft_cache.quantized  # the draft pool follows kv_dtype
+    gen = GenerationConfig(max_new_tokens=16)
+    for p in PROMPTS:
+        eng.add_request(list(p), gen)
+    while eng.has_work:
+        eng.step()
+        for req in eng.running.values():
+            assert len(req.table.blocks) == \
+                eng.allocator.blocks_needed(req.table.length)
+    assert eng.stats.spec_draft_tokens > 0
+    nb = eng.allocator.num_blocks
+    assert eng.allocator.num_free + len(eng.prefix_cache) == nb - 1
+
+
+# ----------------------------------------------------------- memory gauges
+def test_kv_pool_gauges(parts):
+    eng_bf = _engine(parts)
+    eng_q = _engine(parts, kv_dtype="int8")
+    st_bf, st_q = eng_bf.stats, eng_q.stats
+    assert st_bf.kv_pool_bytes > 0 and st_q.kv_pool_bytes > 0
+    # f32 compute pool vs int8 pool: ~4x smaller (scales are noise)
+    assert st_q.kv_pool_bytes < st_bf.kv_pool_bytes / 2
+    assert st_q.kv_blocks_in_use == 0
+    rid = eng_q.add_request([1, 2, 3, 4, 5], GenerationConfig(max_new_tokens=4))
+    eng_q.step()
+    assert st_q.kv_blocks_in_use > 0  # live pages show up while running
+    while eng_q.has_work:
+        eng_q.step()
+    assert st_q.kv_blocks_in_use == 0  # released pages leave the gauge
+    assert st_q.kv_pool_bytes == eng_q._kv_pool_nbytes  # static footprint
+    assert rid is not None

@@ -239,6 +239,10 @@ def test_transfer_counters_identical_with_telemetry_on_and_off(parts, tmp_path):
     assert st_on.decode_h2d_scalars == st_off.decode_h2d_scalars
     assert st_on.decode_d2h_elements == st_off.decode_d2h_elements
     assert st_on.decode_megasteps == st_off.decode_megasteps
+    # the KV-pool gauges are host-side bookkeeping: they report the same
+    # values either way and (per the counters above) moved no device data
+    assert st_on.kv_pool_bytes == st_off.kv_pool_bytes > 0
+    assert st_on.kv_blocks_in_use == st_off.kv_blocks_in_use
 
 
 def test_null_telemetry_observes_nothing(parts):
@@ -363,12 +367,17 @@ def test_metrics_exposition_parses_and_counters_monotone(served):
     fam1 = _parse_exposition(text1)
     # every # TYPE family carries at least one sample
     assert all(f["samples"] for f in fam1.values())
-    # every EngineStats counter is exported
+    # every EngineStats counter is exported; the non-monotone stats
+    # (ratios, pool-occupancy gauges) are declared gauges
     for key in eng.stats.as_dict():
-        if key == "spec_acceptance_rate":
+        if key in ("spec_acceptance_rate", "kv_pool_bytes",
+                   "kv_blocks_in_use"):
             assert fam1[f"clt_{key}"]["type"] == "gauge"
         else:
             assert fam1[f"clt_{key}"]["type"] == "counter"
+    # the pool-footprint gauge is live and non-zero (pages were allocated
+    # at engine init)
+    assert dict(fam1["clt_kv_pool_bytes"]["samples"])["clt_kv_pool_bytes"] > 0
     for name in ("ttft_seconds", "itl_seconds", "e2e_seconds",
                  "queue_depth", "megastep_seconds"):
         assert fam1[f"clt_{name}"]["type"] == "histogram"

@@ -221,3 +221,63 @@ def test_paged_attention_heads_per_step_splits_match():
             np.asarray(split), np.asarray(full), atol=2e-5, rtol=2e-5)
     with pytest.raises(ValueError):
         paged_attention(q, k_pool, v_pool, tables, lengths, heads_per_step=3)
+
+
+# -------------------------------------------- paged attention, int8 pages
+
+
+def _quantized_pool(rng, nb, hkv, bs, d):
+    """Random int8 pool + per-(page, head) scales and its exact dequantized
+    f32 view (shared read path: int8 * f32 scale)."""
+    pool = jnp.asarray(rng.integers(-127, 128, (nb, hkv, bs, d)), jnp.int8)
+    sc = jnp.asarray(rng.uniform(0.01, 0.2, (nb, hkv)), jnp.float32)
+    dense = pool.astype(jnp.float32) * sc[:, :, None, None]
+    return pool, sc, dense
+
+
+@pytest.mark.parametrize("w", [1, 4])
+def test_paged_attention_int8_matches_dense_reference(w):
+    """In-kernel dequant gate (decode W=1 AND the verify window): the int8
+    kernel over (pages, scales) == the f32 kernel over the pre-dequantized
+    pool, and == the registered XLA gather fallback — all three share the
+    int8 -> f32*scale -> compute-dtype cast point."""
+    from colossalai_tpu.kernel.ops import _paged_attention_xla
+    from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(5)
+    S, H, Hkv, D, bs, nb, mb = 3, 4, 2, 128, 16, 24, 6
+    qshape = (S, w, H, D) if w > 1 else (S, H, D)
+    q = jnp.asarray(rng.standard_normal(qshape), jnp.float32)
+    kp, ksc, kd = _quantized_pool(rng, nb, Hkv, bs, D)
+    vp, vsc, vd = _quantized_pool(rng, nb, Hkv, bs, D)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, nb))[: S * mb].reshape(S, mb), jnp.int32)
+    lengths = jnp.asarray([5, bs * 2, bs * mb - w + 1], jnp.int32)
+
+    out = paged_attention(q, kp, vp, tables, lengths, k_scale=ksc, v_scale=vsc)
+    dense = paged_attention(q, kd, vd, tables, lengths)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), atol=2e-5, rtol=2e-5)
+    xla = _paged_attention_xla(q, kp, vp, tables, lengths,
+                               k_scale=ksc, v_scale=vsc)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(xla), atol=2e-5, rtol=2e-5)
+    # the tuner's candidate splits agree under quantization too
+    split = paged_attention(q, kp, vp, tables, lengths, k_scale=ksc,
+                            v_scale=vsc, heads_per_step=1)
+    np.testing.assert_allclose(
+        np.asarray(split), np.asarray(out), atol=2e-5, rtol=2e-5)
+
+
+def test_paged_attention_scale_validation():
+    from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.zeros((1, 2, 16), jnp.float32)
+    kp, ksc, _ = _quantized_pool(rng, 4, 1, 16, 16)
+    tables = jnp.zeros((1, 2), jnp.int32)
+    lengths = jnp.ones((1,), jnp.int32)
+    with pytest.raises(ValueError, match="both"):
+        paged_attention(q, kp, kp, tables, lengths, k_scale=ksc)
+    with pytest.raises(ValueError, match="scale"):
+        paged_attention(q, kp, kp, tables, lengths)

@@ -39,7 +39,7 @@ def test_loader_ops_are_registered():
     deep inside a jitted forward."""
     from colossalai_tpu.kernel.loader import KernelLoader
 
-    for op in ("flash_attention", "rms_norm", "fused_moe"):
+    for op in ("flash_attention", "rms_norm", "fused_moe", "paged_attention"):
         assert op in KernelLoader._registry, (
             f"kernel op {op!r} never registered with KernelLoader"
         )
@@ -47,3 +47,17 @@ def test_loader_ops_are_registered():
             f"kernel op {op!r} has no available implementation on this "
             "backend — the XLA fallback must always be available"
         )
+
+
+def test_quantized_paged_attention_variant_is_tested():
+    """The int8 page path is a distinct kernel variant (extra scalar-
+    prefetch operands, in-register dequant): it must keep its own
+    interpret-mode parity coverage, not just ride the bf16 tests."""
+    sources = "\n".join(
+        p.read_text() for p in TEST_DIR.glob("test_*.py")
+        if p.name != pathlib.Path(__file__).name
+    )
+    assert "k_scale=" in sources and "v_scale=" in sources, (
+        "no test exercises paged_attention's quantized (k_scale/v_scale) "
+        "variant — add an int8 parity test (see docs/kernels.md)"
+    )

@@ -125,11 +125,36 @@ def test_paged_heads_per_step_keys_on_query_window(tmp_path, monkeypatch):
     assert got1 == gotw == 2  # same fake timings -> same winner...
     assert t.misses == 2      # ...but measured under two distinct keys
     keys = list(t.chosen)
-    assert any(k.endswith("|1") for k in keys)
-    assert any(k.endswith("|4") for k in keys)
+    assert any("|1|" in k for k in keys)
+    assert any("|4|" in k for k in keys)
 
     # second lookup at each width is a cache hit, no re-benchmark
     tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure, qlen=4)
+    assert t.hits == 1 and t.misses == 2
+
+
+def test_paged_heads_per_step_keys_on_pool_dtype(tmp_path, monkeypatch):
+    """An int8 page tile halves the per-step HBM traffic at the same
+    geometry, so quantized pools must tune under their own key — the pool
+    dtype is appended (defaulting to the compute dtype for bf16 pools)."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    def measure(hps):
+        return {4: 0.003, 2: 0.001, 1: 0.002}[hps]
+
+    tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure)
+    tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure,
+                                pool_dtype="int8")
+    assert t.misses == 2  # distinct keys, both measured
+    keys = list(t.chosen)
+    assert any(k.endswith("|float32") for k in keys)
+    assert any(k.endswith("|int8") for k in keys)
+
+    # repeat int8 lookup hits the quantized entry
+    tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure,
+                                pool_dtype="int8")
     assert t.hits == 1 and t.misses == 2
 
 
