@@ -141,6 +141,22 @@ def measure(cfg, bs: int, seq: int, n_dev: int, steps: int):
     )
     tokens = batch["input_ids"].size
     denom = dt * peak_flops_per_device() * max(n_dev, 1)
+
+    # monitored tail: two extra steps AFTER the timed loop (the monitor's
+    # per-step sync would serialize the deliberately sync-free timed
+    # window above) purely to capture a TrainMonitor summary — phase wall
+    # times, HBM watermark, grad-norm percentiles — for the BENCH json
+    from colossalai_tpu.telemetry import TrainMonitor, fetch_scalars
+
+    mon = TrainMonitor(flops_per_token=fpt, n_devices=max(n_dev, 1))
+    for i in range(2):
+        mon.start_step(i)
+        with mon.phase("dispatch"):
+            state, m = boosted.train_step(state, sharded)
+        with mon.phase("sync"):
+            host = fetch_scalars(m)
+        mon.end_step(host_metrics=host, n_tokens=tokens)
+
     return {
         "mfu": round(fpt * tokens / denom, 4),
         "mfu_full_attn": round(fpt_full * tokens / denom, 4),
@@ -148,6 +164,7 @@ def measure(cfg, bs: int, seq: int, n_dev: int, steps: int):
         "step_ms": round(dt * 1e3, 1),
         "n_params_b": round(n_params / 1e9, 2),
         "loss": round(loss, 4),
+        "train_monitor": mon.summary(),
     }
 
 
@@ -844,6 +861,9 @@ def child_main():
         "peak_tflops": peak_flops_per_device() / 1e12,
         "n_devices": n_dev,
         "loss": primary["loss"],
+        # training observability snapshot (phase times, HBM watermark,
+        # grad-norm percentiles) from the primary config's monitored tail
+        "train_monitor": primary.get("train_monitor"),
         **extras,
     }
     if fast:

@@ -87,6 +87,27 @@ class BaseAccelerator(ABC):
     def memory_allocated(self, device: Optional[jax.Device] = None) -> int:
         return int(self.memory_stats(device).get("bytes_in_use", 0))
 
+    def memory_watermarks(self) -> List[Dict[str, int]]:
+        """Per-local-device HBM occupancy for telemetry gauges: one dict
+        per device with ``bytes_in_use`` / ``peak_bytes_in_use`` (plus the
+        device id/kind for attribution). Devices whose runtime exposes no
+        memory stats (CPU backends) are omitted — an empty list means "no
+        watermark available", not "zero bytes"."""
+        marks = []
+        for d in self.local_devices():
+            stats = self.memory_stats(d)
+            if not stats:
+                continue
+            marks.append(
+                {
+                    "device_id": int(getattr(d, "id", len(marks))),
+                    "device_kind": str(getattr(d, "device_kind", self.platform)),
+                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+                }
+            )
+        return marks
+
     def empty_cache(self) -> None:
         """Drop JAX's jitted-computation caches (used between tests)."""
         jax.clear_caches()

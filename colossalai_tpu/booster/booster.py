@@ -35,14 +35,25 @@ class Booster:
         policy: Optional[Policy] = None,
         devices: Optional[Sequence[jax.Device]] = None,
         lora: Optional[Any] = None,
+        monitor: Optional[Any] = None,
     ) -> Boosted:
         """Wrap model + optimizer into a sharded, compiled training bundle.
 
         ``lora``: a :class:`colossalai_tpu.peft.LoraConfig` — only the adapter
         tree trains (≙ reference ``booster.enable_lora``); pretrained base
         weights can then be swapped in via :meth:`load_model`.
+
+        ``monitor``: a :class:`colossalai_tpu.telemetry.TrainMonitor` to
+        attach to the bundle (``boosted.monitor``; training loops like
+        ``ElasticTrainer`` pick it up from there). When its
+        ``nonfinite_action`` is ``"skip_step"`` the plugin compiles a
+        non-finite guard into the train step — this MUST happen before
+        ``configure`` because the donated state makes rollback impossible
+        once a NaN step has run.
         """
-        return self.plugin.configure(
+        if monitor is not None and getattr(monitor, "nonfinite_action", None) == "skip_step":
+            self.plugin.nonfinite_guard = True
+        boosted = self.plugin.configure(
             model=model,
             optimizer=optimizer,
             loss_fn=loss_fn,
@@ -52,6 +63,8 @@ class Booster:
             devices=devices,
             lora=lora,
         )
+        boosted.monitor = monitor
+        return boosted
 
     def prepare_dataloader(
         self,

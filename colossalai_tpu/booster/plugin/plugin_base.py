@@ -63,6 +63,9 @@ class Boosted:
     plugin: "Plugin"
     model: Any = None
     lora_config: Any = None
+    #: optional colossalai_tpu.telemetry.TrainMonitor attached by
+    #: Booster.boost(monitor=...); training loops pick it up from here
+    monitor: Any = None
 
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
         """Place a host batch onto the mesh with the data-parallel layout.
@@ -111,6 +114,15 @@ class Plugin(abc.ABC):
     fsdp: bool = False
     max_norm: float = 0.0
     grad_accum_steps: int = 1
+    #: compile a non-finite guard into the train step: when loss or any
+    #: grad goes NaN/inf the update is rolled back IN-GRAPH (params and
+    #: optimizer state keep their old values) and ``metrics["skipped"]``
+    #: reports 1.0. Required for TrainMonitor's ``skip_step`` action —
+    #: the step donates its input state, so a host-side rollback is
+    #: impossible by the time the loss is fetched. fp16 already has this
+    #: via the loss-scaler overflow path. Set by
+    #: ``Booster.boost(monitor=...)``; harmless to enable directly.
+    nonfinite_guard: bool = False
 
     def modify_model(self, model):
         """Hook for plugins to adjust the module (e.g. attention impl)."""
@@ -381,6 +393,7 @@ class Plugin(abc.ABC):
         precision = self.precision
 
         fp8_comm = getattr(self, "fp8_communication", False)
+        nonfinite_guard = getattr(self, "nonfinite_guard", False)
 
         def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
             inputs = _model_inputs(batch, model)
@@ -407,7 +420,10 @@ class Plugin(abc.ABC):
                     params = jax.tree.map(
                         lambda p: fp8_param_gather(p, mesh.mesh), params
                     )
-                out = model.apply({"params": params}, **inputs)
+                # named_scope: XLA traces (utils/profiler captures) group the
+                # forward — and its transposed backward — under train phases
+                with jax.named_scope("train_fwd"):
+                    out = model.apply({"params": params}, **inputs)
                 loss = loss_fn(out, batch)
                 # model-side auxiliary objectives (MoE balancing/z-loss) are
                 # added here so EVERY loss_fn gets them — a user loss must
@@ -427,29 +443,54 @@ class Plugin(abc.ABC):
                 grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
             if precision == "fp16":
-                grads = unscale(grads, state.scaler)
-                finite = all_finite(grads)
-                safe_grads = jax.tree.map(lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
-                updates, new_opt = optimizer.update(safe_grads, state.opt_state, train_view)
-                new_params = optax.apply_updates(train_view, updates)
-                # overflow step: keep old params/opt state
-                new_params = jax.tree.map(
-                    lambda new, old: jnp.where(finite, new, old), new_params, train_view
-                )
-                new_opt = jax.tree.map(
-                    lambda new, old: jnp.where(finite, new, old) if new.shape == old.shape else new,
-                    new_opt, state.opt_state,
-                )
-                new_scaler = update_scaler(state.scaler, finite)
+                with jax.named_scope("train_opt"):
+                    grads = unscale(grads, state.scaler)
+                    finite = all_finite(grads)
+                    safe_grads = jax.tree.map(lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+                    updates, new_opt = optimizer.update(safe_grads, state.opt_state, train_view)
+                    new_params = optax.apply_updates(train_view, updates)
+                    # overflow step: keep old params/opt state
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old), new_params, train_view
+                    )
+                    new_opt = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old) if new.shape == old.shape else new,
+                        new_opt, state.opt_state,
+                    )
+                    new_scaler = update_scaler(state.scaler, finite)
                 metrics = {
                     "loss": loss,
                     "grad_norm": optax.global_norm(grads),
                     "loss_scale": state.scaler.scale,
                     "overflow": (~finite).astype(jnp.float32),
                 }
+            elif nonfinite_guard:
+                # the fp16 overflow discipline without a scaler: a NaN/inf
+                # loss or grad rolls the whole update back in-graph — the
+                # only rollback possible, since the step donates its input
+                # state and the host learns about the NaN after the fact
+                with jax.named_scope("train_opt"):
+                    finite = all_finite(grads) & jnp.isfinite(loss)
+                    safe_grads = jax.tree.map(lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+                    updates, new_opt = optimizer.update(safe_grads, state.opt_state, train_view)
+                    new_params = optax.apply_updates(train_view, updates)
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old), new_params, train_view
+                    )
+                    new_opt = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old) if new.shape == old.shape else new,
+                        new_opt, state.opt_state,
+                    )
+                new_scaler = None
+                metrics = {
+                    "loss": loss,
+                    "grad_norm": optax.global_norm(grads),
+                    "skipped": (~finite).astype(jnp.float32),
+                }
             else:
-                updates, new_opt = optimizer.update(grads, state.opt_state, train_view)
-                new_params = optax.apply_updates(train_view, updates)
+                with jax.named_scope("train_opt"):
+                    updates, new_opt = optimizer.update(grads, state.opt_state, train_view)
+                    new_params = optax.apply_updates(train_view, updates)
                 new_scaler = None
                 metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
             if lora_cfg:

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 
 from colossalai_tpu.logging import get_dist_logger
+from colossalai_tpu.telemetry import NonFiniteLossError, NullTrainMonitor, fetch_scalars
 
 
 class PreemptionGuard:
@@ -48,6 +49,22 @@ class PreemptionGuard:
         return False
 
 
+def _batch_tokens(batch) -> int:
+    """Token count of one host batch for throughput accounting: the
+    input_ids element count, or the first array leaf's leading-dims size."""
+    try:
+        ids = batch.get("input_ids") if hasattr(batch, "get") else None
+        if ids is not None:
+            return int(getattr(ids, "size", 0) or 0)
+        for leaf in jax.tree_util.tree_leaves(batch):
+            size = getattr(leaf, "size", None)
+            if size:
+                return int(size)
+    except Exception:
+        pass
+    return 0
+
+
 class ElasticTrainer:
     """Checkpointed train loop with bounded crash-retry.
 
@@ -62,7 +79,7 @@ class ElasticTrainer:
 
     def __init__(self, booster, boosted, ckpt_dir: str, *,
                  save_every: int = 100, max_restarts: int = 3,
-                 log_every: int = 0):
+                 log_every: int = 0, monitor=None):
         self.booster = booster
         self.boosted = boosted
         self.ckpt_dir = ckpt_dir
@@ -71,6 +88,12 @@ class ElasticTrainer:
         self.log_every = log_every
         self.logger = get_dist_logger()
         self.restarts = 0
+        # a TrainMonitor attached via Booster.boost(monitor=...) is picked
+        # up automatically; the Null object keeps the loop branch-free —
+        # and the loop's device traffic IDENTICAL — either way
+        if monitor is None:
+            monitor = getattr(boosted, "monitor", None)
+        self.monitor = monitor if monitor is not None else NullTrainMonitor()
 
     # ------------------------------------------------------------- lifecycle
     def _latest_step(self) -> Optional[int]:
@@ -112,13 +135,22 @@ class ElasticTrainer:
                         self._checkpoint(step0)
                         self.booster.wait()
                     step = self._resume_if_possible()
+                    mon = self.monitor
                     while step < total_steps:
-                        batch = data_fn(step)
-                        self.boosted.state, metrics = self.boosted.train_step(
-                            self.boosted.state, batch
-                        )
-                        # scalar fetch = real sync point on tunneled TPUs
-                        loss = float(metrics["loss"])
+                        mon.start_step(step)
+                        with mon.phase("data"):
+                            batch = data_fn(step)
+                        with mon.phase("dispatch"):
+                            self.boosted.state, metrics = self.boosted.train_step(
+                                self.boosted.state, batch
+                            )
+                        # scalar fetch = real sync point on tunneled TPUs;
+                        # ONE fetch of all scalar metrics, monitor or not —
+                        # monitoring must never change device traffic
+                        with mon.phase("sync"):
+                            host = fetch_scalars(metrics)
+                        loss = host["loss"]
+                        mon.end_step(host_metrics=host, n_tokens=_batch_tokens(batch))
                         loss_by_step[step] = loss
                         step += 1
                         if self.log_every and step % self.log_every == 0:
@@ -138,7 +170,10 @@ class ElasticTrainer:
                     self._checkpoint(step)
                     self.booster.wait()
                     return [loss_by_step[k] for k in sorted(loss_by_step)]
-                except (KeyboardInterrupt, SystemExit):
+                except (KeyboardInterrupt, SystemExit, NonFiniteLossError):
+                    # NonFiniteLossError is deterministic: replaying the
+                    # same batch from the same state NaNs again, so the
+                    # crash-retry path would just burn max_restarts
                     raise
                 except Exception as exc:  # crash path: bounded resume
                     self.restarts += 1

@@ -2,18 +2,14 @@
 
 The reference framework ships a monitoring/tracing layer for TRAINING
 (trainer hooks, memory tracer, torch.profiler wrappers — SURVEY §5); this
-module is its serving-side counterpart for the paged engine. Three pieces:
+module is its serving-side counterpart for the paged engine. The generic
+primitives (:class:`Histogram`, :class:`EventLog`,
+:func:`prometheus_exposition`) were promoted to the shared
+:mod:`colossalai_tpu.telemetry` package — the training-side
+``TrainMonitor`` observes through the same machinery — and are
+re-exported here unchanged so existing serving imports keep working.
+What remains serving-specific:
 
-- :class:`Histogram` — a fixed-bucket streaming histogram (log-spaced
-  bounds, O(1) observe, mergeable, p50/p90/p99 queries, Prometheus
-  ``_bucket/_sum/_count`` rendering). Fixed buckets matter: the decode hot
-  path stays device-resident, so every observation happens at the
-  once-per-megastep host sync and costs one list increment — no
-  reservoirs, no sorting, no allocation;
-- :class:`EventLog` — an append-only jsonl sink (the
-  ``logging/metrics.py`` design: one json object per line, flushed per
-  write, so the log survives preemption and a restarted server keeps
-  appending to the same history);
 - :class:`Telemetry` — the engine-facing facade: stamps each
   :class:`~.engine.Request` with monotonic ``arrival → admitted →
   first_token → finished`` times, folds the derived latencies (queue
@@ -29,198 +25,19 @@ telemetry provably changes NOTHING about device traffic
 
 from __future__ import annotations
 
-import json
-import math
-import os
-import threading
 import time
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+from typing import Dict, Optional, Union
+
+from colossalai_tpu.telemetry.core import (  # noqa: F401  (re-exports)
+    EventLog,
+    Histogram,
+    _fmt,
+    prometheus_exposition,
+)
 
 #: every terminal state a request can reach — the ``finish_reason`` field
 #: of lifecycle records is always one of these
 FINISH_REASONS = ("eos", "length", "aborted", "truncated")
-
-
-class Histogram:
-    """Fixed-bucket streaming histogram.
-
-    ``bounds`` are the strictly increasing bucket UPPER bounds; an
-    implicit +Inf bucket catches overflow. Observation is O(buckets) in
-    the worst case (a bisect over ~50 floats — trivial next to the host
-    sync it piggybacks on); ``merge`` composes histograms observed by
-    different engines (bench sweeps, multi-engine frontends).
-
-    Percentile queries interpolate linearly inside the bracketing bucket
-    and clamp to the observed min/max, so the error is bounded by one
-    bucket's width — with the default log spacing that is a small,
-    constant RELATIVE error across six decades of latency.
-    """
-
-    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
-
-    def __init__(self, bounds: Sequence[float]):
-        bounds = tuple(float(b) for b in bounds)
-        if not bounds:
-            raise ValueError("histogram needs at least one bucket bound")
-        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
-            raise ValueError(f"bounds must be strictly increasing: {bounds}")
-        if not all(math.isfinite(b) for b in bounds):
-            raise ValueError("bounds must be finite (+Inf is implicit)")
-        self.bounds = bounds
-        self.bucket_counts = [0] * (len(bounds) + 1)  # last = +Inf
-        self.count = 0
-        self.sum = 0.0
-        self.min = math.inf
-        self.max = -math.inf
-
-    @classmethod
-    def log_spaced(cls, lo: float, hi: float, n_buckets: int) -> "Histogram":
-        """``n_buckets`` geometrically spaced bounds over [lo, hi] — the
-        right shape for latencies, whose interesting range spans decades
-        (a 100µs megastep and a 100s queue wait in one histogram)."""
-        if not (0 < lo < hi):
-            raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
-        if n_buckets < 1:
-            raise ValueError(f"n_buckets={n_buckets} must be >= 1")
-        ratio = (hi / lo) ** (1.0 / max(n_buckets - 1, 1))
-        return cls([lo * ratio ** i for i in range(n_buckets)])
-
-    def observe(self, value: float) -> None:
-        v = float(value)
-        self.count += 1
-        self.sum += v
-        if v < self.min:
-            self.min = v
-        if v > self.max:
-            self.max = v
-        lo, hi = 0, len(self.bounds)
-        while lo < hi:  # first bound >= v (bisect_left over upper bounds)
-            mid = (lo + hi) // 2
-            if self.bounds[mid] < v:
-                lo = mid + 1
-            else:
-                hi = mid
-        self.bucket_counts[lo] += 1
-
-    def observe_many(self, values: Iterable[float]) -> None:
-        for v in values:
-            self.observe(v)
-
-    def percentile(self, q: float) -> float:
-        """The ``q``-th percentile (0..100), interpolated within its
-        bucket and clamped to the observed [min, max]. NaN when empty."""
-        if not 0 <= q <= 100:
-            raise ValueError(f"q={q} must be in [0, 100]")
-        if self.count == 0:
-            return math.nan
-        target = (q / 100.0) * self.count
-        cum = 0
-        for i, c in enumerate(self.bucket_counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo = self.bounds[i - 1] if i > 0 else min(self.min, self.bounds[0])
-                hi = self.bounds[i] if i < len(self.bounds) else self.max
-                frac = (target - cum) / c
-                v = lo + frac * (hi - lo)
-                return min(max(v, self.min), self.max)
-            cum += c
-        return self.max  # pragma: no cover - unreachable (counts sum to count)
-
-    def merge(self, other: "Histogram") -> "Histogram":
-        """Fold ``other`` into self (bounds must match). Returns self."""
-        if self.bounds != other.bounds:
-            raise ValueError("cannot merge histograms with different bounds")
-        for i, c in enumerate(other.bucket_counts):
-            self.bucket_counts[i] += c
-        self.count += other.count
-        self.sum += other.sum
-        self.min = min(self.min, other.min)
-        self.max = max(self.max, other.max)
-        return self
-
-    def reset(self) -> None:
-        self.bucket_counts = [0] * (len(self.bounds) + 1)
-        self.count = 0
-        self.sum = 0.0
-        self.min = math.inf
-        self.max = -math.inf
-
-    def snapshot(self) -> Dict[str, Any]:
-        return {
-            "count": self.count,
-            "sum": self.sum,
-            "min": self.min if self.count else None,
-            "max": self.max if self.count else None,
-            "bounds": list(self.bounds),
-            "bucket_counts": list(self.bucket_counts),
-        }
-
-    def prometheus_lines(self, name: str) -> List[str]:
-        """Text-exposition sample lines: cumulative ``_bucket`` counts per
-        ``le`` bound (+Inf last), then ``_sum`` and ``_count``."""
-        lines = []
-        cum = 0
-        for b, c in zip(self.bounds, self.bucket_counts):
-            cum += c
-            lines.append(f'{name}_bucket{{le="{_fmt(b)}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
-        lines.append(f"{name}_sum {_fmt(self.sum)}")
-        lines.append(f"{name}_count {self.count}")
-        return lines
-
-
-def _fmt(v: float) -> str:
-    """Prometheus float formatting: integral values without the trailing
-    .0, everything else repr-roundtrippable."""
-    f = float(v)
-    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
-
-
-class EventLog:
-    """Append-only jsonl event sink (≙ ``logging/metrics.py``'s file
-    discipline: one record per line, flush per write, open in append mode
-    so restarts extend the same history). Thread-safe — the engine's
-    scheduler thread and a server's handler threads may both emit."""
-
-    def __init__(self, path: str):
-        self.path = path
-        parent = os.path.dirname(os.path.abspath(path))
-        os.makedirs(parent, exist_ok=True)
-        self._file = open(path, "a", encoding="utf-8")
-        self._lock = threading.Lock()
-
-    def emit(self, record: Dict[str, Any]) -> None:
-        line = json.dumps(record) + "\n"
-        with self._lock:
-            if self._file is not None:
-                self._file.write(line)
-                self._file.flush()
-
-    def close(self) -> None:
-        with self._lock:
-            if self._file is not None:
-                self._file.close()
-                self._file = None
-
-    @staticmethod
-    def read(path: str) -> List[Dict[str, Any]]:
-        """Load every record back (the round-trip helper tests and offline
-        analysis use — one json.loads per line, blank lines skipped)."""
-        out = []
-        with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    out.append(json.loads(line))
-        return out
-
-    def __enter__(self) -> "EventLog":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.close()
-
 
 #: histogram catalog: name → constructor. Latencies get log-spaced bounds
 #: spanning 100µs–1h; queue depth gets powers of two (an integer gauge).
@@ -393,32 +210,3 @@ def _r(v: Optional[float]) -> Optional[float]:
     """Round a latency for the jsonl record (µs resolution — floats in
     logs should be readable, not 17 digits)."""
     return None if v is None else round(v, 6)
-
-
-def prometheus_exposition(
-    counters: Dict[str, Any],
-    gauges: Dict[str, Any],
-    histograms: Dict[str, Histogram],
-    prefix: str = "clt",
-) -> str:
-    """Prometheus text exposition (format 0.0.4) with zero dependencies:
-    ``# TYPE`` header + samples per metric, histograms as cumulative
-    ``_bucket``/``_sum``/``_count`` families. Metric names are
-    ``<prefix>_<name>``; non-numeric values are skipped (a counters dict
-    may carry strings like the scheduler policy)."""
-    lines: List[str] = []
-    for kind, metrics in (("counter", counters), ("gauge", gauges)):
-        for name in sorted(metrics):
-            v = metrics[name]
-            if isinstance(v, bool):
-                v = int(v)
-            if not isinstance(v, (int, float)) or not math.isfinite(v):
-                continue
-            full = f"{prefix}_{name}"
-            lines.append(f"# TYPE {full} {kind}")
-            lines.append(f"{full} {_fmt(v)}")
-    for name in sorted(histograms):
-        full = f"{prefix}_{name}"
-        lines.append(f"# TYPE {full} histogram")
-        lines.extend(histograms[name].prometheus_lines(full))
-    return "\n".join(lines) + "\n"

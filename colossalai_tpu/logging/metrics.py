@@ -24,6 +24,7 @@ non-numerics are ignored (a metrics dict can carry logits/debug cargo).
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from typing import Any, Dict, Optional
@@ -32,8 +33,21 @@ from .logger import DistributedLogger, get_dist_logger
 
 
 def _scalar(v: Any) -> Optional[float]:
-    """float(v) for scalars, None for everything else. Non-finite values
-    pass through — a NaN loss in the record is the signal, not noise."""
+    """float(v) for finite scalars, None for everything else. Non-finite
+    values are dropped: ONE NaN in a window would poison the whole
+    windowed mean (NaN is absorbing under +), silently corrupting every
+    other metric in the record. NaN *detection* is the TrainMonitor's job
+    (``nonfinite_action``) — it sees the raw values via the mirror hook."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+def _raw_scalar(v: Any) -> Optional[float]:
+    """float(v) including NaN/inf — the mirror path must not hide the
+    non-finite values the monitor exists to detect."""
     try:
         return float(v)
     except (TypeError, ValueError):
@@ -48,11 +62,18 @@ class MetricsLogger:
         path: Optional[str] = None,
         log_every: int = 10,
         logger: Optional[DistributedLogger] = None,
+        monitor: Any = None,
     ):
+        """``monitor``: optional :class:`colossalai_tpu.telemetry.
+        TrainMonitor` — every ``log()`` call mirrors the step's raw floats
+        into it (``observe_scalars``), so loops already using a
+        MetricsLogger get grad-health detection and loss/grad-norm series
+        without double bookkeeping."""
         if log_every < 1:
             raise ValueError(f"log_every={log_every} must be >= 1")
         self.path = path
         self.log_every = log_every
+        self.monitor = monitor
         self.logger = logger or get_dist_logger()
         self._file = None
         self._is_writer = self._process_index() == 0
@@ -79,12 +100,19 @@ class MetricsLogger:
         """Accumulate one step's metrics; flushes every ``log_every``
         calls. Fetching ``float(...)`` here is the device sync point —
         call it once per step, not per metric consumer."""
+        raw: Dict[str, float] = {}
         for k, v in metrics.items():
-            f = _scalar(v)
+            f = _raw_scalar(v)
             if f is None:
                 continue
+            raw[k] = f
+            if not math.isfinite(f):
+                continue  # see _scalar: one NaN would poison the window mean
             self._sums[k] = self._sums.get(k, 0.0) + f
             self._counts[k] = self._counts.get(k, 0) + 1
+        if self.monitor is not None:
+            # raw (non-finite included): detection is the monitor's job
+            self.monitor.observe_scalars(int(step), raw)
         self._window += 1
         self._last_step = int(step)
         if self._window >= self.log_every:

@@ -148,14 +148,17 @@ def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
             )
             p_c = jax.tree.map(lambda l: l[c, 0], params_l)
             inp = inp.astype(x_dtype)
-            h, a = stage_fn(p_c, inp, aux_t)
+            # named_scope: trace-only phase marker for XLA captures
+            with jax.named_scope("pp_fwd"):
+                h, a = stage_fn(p_c, inp, aux_t)
             h = jnp.where(valid, h, inp)
             a = jnp.where(valid, a, 0.0)
             return h.astype(x_mb_l.dtype), a
 
         def tick(carry, t):
             send, outputs, aux_acc = carry
-            recv = jax.lax.ppermute(send, pp_axis, fwd_perm)
+            with jax.named_scope("pp_ring"):
+                recv = jax.lax.ppermute(send, pp_axis, fwd_perm)
             lanes = []
             for c in range(chunks):
                 u = c * pp + s
@@ -275,7 +278,8 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
 
         def fwd_compute(c, valid, inp, f):
             inp = inp.astype(x_dtype)
-            h, _ = stage_fn(p_at(c), inp, aux_at(f))
+            with jax.named_scope("pp_fwd"):
+                h, _ = stage_fn(p_at(c), inp, aux_at(f))
             h = jnp.where(valid, h, inp)
             return h.astype(x_mb_l.dtype)
 
@@ -294,16 +298,18 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
 
             if split_dw:
                 # dX (+dAux) only: params closed over (≙ ZB's B pass)
-                _, vjp = jax.vjp(
-                    lambda hh, at: stage_fn(p_c, hh, at), h_in, aux_t
-                )
-                dx, da = vjp(g)
+                with jax.named_scope("pp_bwd"):
+                    _, vjp = jax.vjp(
+                        lambda hh, at: stage_fn(p_c, hh, at), h_in, aux_t
+                    )
+                    dx, da = vjp(g)
                 return None, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype), da
 
-            _, vjp = jax.vjp(
-                lambda p, hh, at: stage_fn(p, hh, at), p_c, h_in, aux_t
-            )
-            dp, dx, da = vjp(g)
+            with jax.named_scope("pp_bwd"):
+                _, vjp = jax.vjp(
+                    lambda p, hh, at: stage_fn(p, hh, at), p_c, h_in, aux_t
+                )
+                dp, dx, da = vjp(g)
             dp = jax.tree.map(lambda g_: jnp.where(valid, g_, 0.0), dp)
             return dp, jnp.where(valid, dx, 0.0).astype(x_mb_l.dtype), da
 
@@ -312,8 +318,9 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
             p_c = p_at(c)
             aux_t = aux_at(b)
             g = (g_out.astype(x_dtype), daux_l.astype(jnp.float32))
-            _, vjp = jax.vjp(lambda p: stage_fn(p, h_in.astype(x_dtype), aux_t), p_c)
-            dp = vjp(g)[0]
+            with jax.named_scope("pp_dw"):
+                _, vjp = jax.vjp(lambda p: stage_fn(p, h_in.astype(x_dtype), aux_t), p_c)
+                dp = vjp(g)[0]
             return jax.tree.map(lambda g_: jnp.where(valid, g_, 0.0), dp)
 
         def acc_daux(acc, a, g_, valid, idx):
@@ -327,8 +334,9 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
 
         def tick(carry, t):
             send_f, send_b, stash, wstash, dparams, dx_acc, daux_acc = carry
-            recv_f = jax.lax.ppermute(send_f, pp_axis, fwd_perm)
-            recv_b = jax.lax.ppermute(send_b, pp_axis, rev_perm)
+            with jax.named_scope("pp_ring"):
+                recv_f = jax.lax.ppermute(send_f, pp_axis, fwd_perm)
+                recv_b = jax.lax.ppermute(send_b, pp_axis, rev_perm)
             lanes_f, lanes_b = [], []
             for c in range(chunks):
                 u = c * pp + s

@@ -98,7 +98,10 @@ def pipeline_blocks(
             def body(h, p_layer):
                 return stage_body(p_layer, h, aux_t), None
 
-            h, _ = jax.lax.scan(body, h, params_l)
+            # named_scope: XLA traces attribute stage compute vs ring
+            # transfer separately (trace-only, no effect on lowering)
+            with jax.named_scope("pp_stage"):
+                h, _ = jax.lax.scan(body, h, params_l)
             return h
 
         zero_state = jnp.zeros_like(x_mb_l[0])
@@ -117,7 +120,8 @@ def pipeline_blocks(
             out = run_stage(inp, aux_t)
             # rotate to next stage; stage pp-1 -> 0 edge carries garbage that
             # stage 0 never reads (it reads x_mb)
-            recv_next = jax.lax.ppermute(out, pp_axis, fwd_perm)
+            with jax.named_scope("pp_ring"):
+                recv_next = jax.lax.ppermute(out, pp_axis, fwd_perm)
             out_idx = jnp.clip(t - (pp - 1), 0, num_microbatches - 1)
             collect = jnp.logical_and(stage == pp - 1, t >= pp - 1)
             prev = jax.lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)

@@ -294,7 +294,7 @@ def ring_attention(
     """
     sp_size = mesh.shape[sp_axis]
     # inside another (partial-)manual region the context mesh must be used
-    ctx = jax.sharding.get_abstract_mesh()
+    ctx = getattr(jax.sharding, "get_abstract_mesh", lambda: None)()
     mesh_arg = ctx if (ctx is not None and sp_axis in getattr(ctx, "shape", {})) else mesh
 
     from colossalai_tpu.kernel.pallas.flash_attention import supports

@@ -1,0 +1,30 @@
+"""Shared observability package: primitives in :mod:`.core` (histograms,
+jsonl event logs, Prometheus exposition — used by BOTH the serving engine
+and the training stack) and the training-side :class:`TrainMonitor` in
+:mod:`.train_monitor`. Serving-specific telemetry (request lifecycle
+tracing) stays in :mod:`colossalai_tpu.inference.telemetry`."""
+
+from .core import METRIC_NAME_RE, EventLog, Histogram, prometheus_exposition
+from .train_monitor import (
+    NONFINITE_ACTIONS,
+    NonFiniteLossError,
+    NullTrainMonitor,
+    TrainMonitor,
+    TransferCounter,
+    fetch_scalars,
+    transfer_counter,
+)
+
+__all__ = [
+    "METRIC_NAME_RE",
+    "EventLog",
+    "Histogram",
+    "prometheus_exposition",
+    "NONFINITE_ACTIONS",
+    "NonFiniteLossError",
+    "NullTrainMonitor",
+    "TrainMonitor",
+    "TransferCounter",
+    "fetch_scalars",
+    "transfer_counter",
+]

@@ -1,0 +1,580 @@
+"""Training-run observability: per-step phase timing, throughput/MFU,
+HBM watermarks, and gradient-health monitoring.
+
+The serving engine got lifecycle tracing + ``/metrics`` in PR 5; this is
+the training counterpart, built on the same shared primitives
+(:mod:`colossalai_tpu.telemetry.core`). One :class:`TrainMonitor` per run
+observes at the host boundaries every training loop already has:
+
+- **phases** — ``with monitor.phase("data"): ...`` wall-times the host
+  side of a step (``data`` / ``dispatch`` / ``sync`` / ``optimizer`` by
+  convention, any ``[a-z0-9_]`` name works) into per-phase histograms and
+  wraps the region in a ``jax.profiler.TraceAnnotation`` so an on-demand
+  XLA capture (``utils/profiler.start_profile`` or a ``POST /profile``-
+  style endpoint) attributes host time to train phases. ``start_step``
+  additionally opens a ``StepTraceAnnotation("train_step")`` so on-device
+  time groups per step in XProf;
+- **throughput / MFU** — a :class:`~colossalai_tpu.utils.performance_evaluator.
+  PerformanceEvaluator` rides inside the monitor (``flops_per_token`` via
+  ``causal_lm_flops_per_token``), giving rolling tokens/s and MFU gauges;
+- **HBM watermarks** — per-local-device ``bytes_in_use`` /
+  ``peak_bytes_in_use`` from ``accelerator.memory_stats()`` sampled at
+  each step end (a runtime stats query — no device transfer);
+- **gradient health** — a global grad-norm histogram plus non-finite
+  loss/grad detection with a configurable ``nonfinite_action``:
+  ``"warn"`` (log and keep going), ``"raise"`` (abort the run with
+  :class:`NonFiniteLossError`), ``"skip_step"`` (requires the in-graph
+  guard ``Booster.boost(..., monitor=...)`` enables — the compiled step
+  rolls back params/optimizer when grads or loss go non-finite, and the
+  monitor accounts the skipped step).
+
+The invariance contract (same discipline as serving telemetry): the
+monitor only consumes host floats the loop fetches ANYWAY through
+:func:`fetch_scalars` — enabling it changes nothing about device traffic,
+asserted by the transfer-counter gate in
+``tests/test_core/test_train_monitor.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from .core import METRIC_NAME_RE, EventLog, Histogram, prometheus_exposition
+
+#: the configurable responses to a non-finite loss / grad norm
+NONFINITE_ACTIONS = ("warn", "raise", "skip_step")
+
+_PHASE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised by ``nonfinite_action="raise"`` when a step's loss or grad
+    norm comes back NaN/inf."""
+
+
+@dataclasses.dataclass
+class TransferCounter:
+    """Host↔device fetch accounting for training loops — the analog of
+    ``EngineStats``' decode transfer counters. Every loop that fetches
+    step metrics through :func:`fetch_scalars` ticks these, so
+    monitor-on vs monitor-off traffic is assertable, not just claimed."""
+
+    fetches: int = 0
+    elements: int = 0
+
+    def snapshot(self) -> "TransferCounter":
+        return dataclasses.replace(self)
+
+    def reset(self) -> None:
+        self.fetches = 0
+        self.elements = 0
+
+
+#: process-global counter ticked by :func:`fetch_scalars`
+transfer_counter = TransferCounter()
+
+
+def fetch_scalars(metrics: Dict[str, Any]) -> Dict[str, float]:
+    """Fetch every scalar leaf of a step's metrics dict in ONE
+    ``jax.device_get`` and return python floats.
+
+    This is THE device sync point of a training step (on tunneled TPU
+    backends ``block_until_ready`` is unreliable — a value fetch is the
+    only real barrier; device execution is in-order, so fetching any step
+    output waits for the whole step). Loops call it once per step whether
+    or not a :class:`TrainMonitor` is attached — the monitor then works
+    entirely off the returned host floats, which is what makes the
+    telemetry-on/off transfer counts byte-identical."""
+    import jax
+    import numpy as np
+
+    scalars = {}
+    for k, v in metrics.items():
+        size = getattr(v, "size", None)
+        if size == 1 or isinstance(v, (int, float)):
+            scalars[k] = v
+    host = jax.device_get(scalars)
+    transfer_counter.fetches += 1
+    transfer_counter.elements += len(host)
+    return {k: float(np.asarray(v).ravel()[0]) for k, v in host.items()}
+
+
+#: histogram catalog for training metrics. Step/phase wall times get
+#: log-spaced bounds spanning µs–1h; grad norms span 1e-8–1e6 (56 log
+#: buckets ≈ one bucket per fifth of a decade).
+_TRAIN_HISTOGRAM_SPECS = {
+    "step_seconds": lambda: Histogram.log_spaced(1e-4, 3600.0, 48),
+    "grad_norm": lambda: Histogram.log_spaced(1e-8, 1e6, 56),
+}
+
+
+def _phase_histogram() -> Histogram:
+    return Histogram.log_spaced(1e-6, 600.0, 40)
+
+
+class TrainMonitor:
+    """Per-step training telemetry facade.
+
+    >>> mon = TrainMonitor(event_log="runs/exp1/steps.jsonl",
+    ...                    flops_per_token=fpt, n_devices=8)
+    >>> for step in range(total):
+    ...     mon.start_step(step)
+    ...     with mon.phase("data"):
+    ...         batch = next(loader)
+    ...     with mon.phase("dispatch"):
+    ...         state, metrics = boosted.train_step(state, batch)
+    ...     with mon.phase("sync"):
+    ...         host = fetch_scalars(metrics)   # the step's ONE device sync
+    ...     mon.end_step(host_metrics=host, n_tokens=batch["input_ids"].size)
+    >>> mon.summary()["mfu"]
+
+    All bookkeeping is host-side arithmetic on the floats ``fetch_scalars``
+    returns; ``phase``/``start_step`` additionally emit profiler
+    annotations so XLA captures attribute to train phases.
+    """
+
+    #: patchable clock seam (tests pin it to verify derived timings)
+    _clock = staticmethod(time.perf_counter)
+
+    def __init__(
+        self,
+        event_log: Union[None, str, EventLog] = None,
+        *,
+        flops_per_token: float = 0.0,
+        n_devices: Optional[int] = None,
+        nonfinite_action: str = "warn",
+        loss_key: str = "loss",
+        grad_norm_key: str = "grad_norm",
+        prometheus_textfile: Optional[str] = None,
+        hbm_every: int = 1,
+        logger: Any = None,
+    ):
+        if nonfinite_action not in NONFINITE_ACTIONS:
+            raise ValueError(
+                f"nonfinite_action={nonfinite_action!r} not in {NONFINITE_ACTIONS}"
+            )
+        if hbm_every < 1:
+            raise ValueError(f"hbm_every={hbm_every} must be >= 1")
+        self.nonfinite_action = nonfinite_action
+        self.loss_key = loss_key
+        self.grad_norm_key = grad_norm_key
+        self.prometheus_textfile = prometheus_textfile
+        self.hbm_every = hbm_every
+        self.events: Optional[EventLog] = (
+            EventLog(event_log) if isinstance(event_log, str) else event_log
+        )
+        if logger is None:
+            from colossalai_tpu.logging import get_dist_logger
+
+            logger = get_dist_logger()
+        self.logger = logger
+        self.enabled = True
+
+        if n_devices is None:
+            try:
+                import jax
+
+                n_devices = len(jax.devices())
+            except Exception:
+                n_devices = 1
+        from colossalai_tpu.utils.performance_evaluator import PerformanceEvaluator
+
+        self.perf = PerformanceEvaluator(
+            flops_per_token=float(flops_per_token), n_devices=max(int(n_devices), 1)
+        )
+
+        self.histograms: Dict[str, Histogram] = {
+            name: make() for name, make in _TRAIN_HISTOGRAM_SPECS.items()
+        }
+        self.counters: Dict[str, int] = {
+            "steps_total": 0,
+            "tokens_total": 0,
+            "nonfinite_steps": 0,
+            "skipped_steps": 0,
+        }
+        # gauges that persist across steps (last-seen / watermark values)
+        self._last_loss = math.nan
+        self._last_step = -1
+        self._hbm_peak = 0          # monotonic watermark over the run
+        self._hbm_in_use = 0
+        self._hbm_per_device: List[Dict[str, int]] = []
+        # in-flight step state
+        self._step: Optional[int] = None
+        self._t_step: Optional[float] = None
+        self._phase_acc: Dict[str, float] = {}
+        self._step_cm = None
+        self._warned_no_guard = False
+
+    # ------------------------------------------------------------ step cycle
+    def start_step(self, step: int) -> None:
+        """Open step ``step``: reset per-step phase accumulators and enter
+        a ``StepTraceAnnotation`` so live XLA captures group device time
+        per train step."""
+        if self._step_cm is not None:  # unterminated previous step
+            self._exit_annotation()
+        self._step = int(step)
+        self._t_step = self._clock()
+        self._phase_acc = {}
+        try:
+            import jax
+
+            self._step_cm = jax.profiler.StepTraceAnnotation(
+                "train_step", step_num=int(step)
+            )
+            self._step_cm.__enter__()
+        except Exception:
+            self._step_cm = None
+        self.perf.on_step_start()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Wall-time one host phase of the current step (``data``,
+        ``dispatch``, ``sync``, ``optimizer``, ...). Nests a profiler
+        ``TraceAnnotation("train_<name>")`` so captures see it too."""
+        if not _PHASE_RE.match(name):
+            raise ValueError(
+                f"phase name {name!r} must match {_PHASE_RE.pattern} "
+                "(it becomes part of a Prometheus metric name)"
+            )
+        t0 = self._clock()
+        cm = contextlib.nullcontext()
+        try:
+            import jax
+
+            cm = jax.profiler.TraceAnnotation(f"train_{name}")
+        except Exception:
+            pass
+        try:
+            with cm:
+                yield
+        finally:
+            dt = self._clock() - t0
+            self._phase_acc[name] = self._phase_acc.get(name, 0.0) + dt
+            hist_name = f"phase_{name}_seconds"
+            if hist_name not in self.histograms:
+                self.histograms[hist_name] = _phase_histogram()
+            self.histograms[hist_name].observe(dt)
+
+    def end_step(
+        self,
+        metrics: Optional[Dict[str, Any]] = None,
+        *,
+        host_metrics: Optional[Dict[str, float]] = None,
+        n_tokens: int = 0,
+    ) -> bool:
+        """Close the current step: health-check the fetched metrics, feed
+        the histograms/throughput accounting, sample HBM, emit one jsonl
+        record. Returns ``False`` when the step was non-finite/skipped
+        (callers may exclude it from loss curves).
+
+        Pass ``host_metrics`` (from :func:`fetch_scalars`) when the loop
+        already fetched — the invariant-preserving path. Passing device
+        ``metrics`` instead makes THIS call the step's sync point."""
+        if self._step is None:
+            raise RuntimeError("end_step without start_step")
+        if host_metrics is None and metrics is not None:
+            host_metrics = fetch_scalars(metrics)
+        host_metrics = host_metrics or {}
+        step, t0 = self._step, self._t_step
+        self._step = None
+        self._exit_annotation()
+        step_s = self._clock() - t0
+        self.histograms["step_seconds"].observe(step_s)
+
+        ok = self._health_check(step, host_metrics)
+        loss = host_metrics.get(self.loss_key)
+        if loss is not None and math.isfinite(loss):
+            self._last_loss = loss
+        self._last_step = step
+
+        self.counters["steps_total"] += 1
+        counted_tokens = int(n_tokens) if ok else 0
+        self.counters["tokens_total"] += counted_tokens
+        self.perf.on_step_end(counted_tokens)
+
+        if self.counters["steps_total"] % self.hbm_every == 0:
+            self._sample_hbm()
+
+        if self.events is not None:
+            record: Dict[str, Any] = {
+                "event": "train_step",
+                "step": step,
+                "step_s": _r(step_s),
+                "tokens": int(n_tokens),
+            }
+            for k, v in host_metrics.items():
+                # json has no NaN/inf literal — encode non-finite as None,
+                # the presence of the key (+ the nonfinite flag below) is
+                # the signal
+                record[k] = v if math.isfinite(v) else None
+            for name, dt in sorted(self._phase_acc.items()):
+                record[f"phase_{name}_s"] = _r(dt)
+            if not ok:
+                record["nonfinite"] = True
+            if self._skipped(host_metrics):
+                record["skipped"] = True
+            if self._hbm_per_device:
+                record["hbm_peak_bytes"] = self._hbm_peak
+                record["hbm_bytes_in_use"] = self._hbm_in_use
+            if self.perf.flops_per_token:
+                record["tokens_per_s"] = round(self.perf.tokens_per_second, 2)
+                record["mfu"] = round(self.perf.mfu, 4)
+            self.events.emit(record)
+        if self.prometheus_textfile is not None:
+            self.write_textfile(self.prometheus_textfile)
+        return ok
+
+    # --------------------------------------------------------- health checks
+    def _skipped(self, host_metrics: Dict[str, float]) -> bool:
+        """Did the in-graph guard roll this step back? ``skipped`` is the
+        nonfinite-guard flag; ``overflow`` the fp16 scaler's."""
+        return (
+            host_metrics.get("skipped", 0.0) > 0.0
+            or host_metrics.get("overflow", 0.0) > 0.0
+        )
+
+    def _health_check(self, step: int, host_metrics: Dict[str, float]) -> bool:
+        gn = host_metrics.get(self.grad_norm_key)
+        if gn is not None and math.isfinite(gn):
+            self.histograms["grad_norm"].observe(gn)
+        loss = host_metrics.get(self.loss_key)
+        bad = [
+            k for k in (self.loss_key, self.grad_norm_key)
+            if host_metrics.get(k) is not None
+            and not math.isfinite(host_metrics[k])
+        ]
+        skipped = self._skipped(host_metrics)
+        if not bad and not skipped:
+            return True
+        self.counters["nonfinite_steps"] += 1
+        detail = ", ".join(f"{k}={host_metrics[k]}" for k in bad) or "guard fired"
+        if self.nonfinite_action == "raise":
+            raise NonFiniteLossError(
+                f"non-finite training metrics at step {step}: {detail}"
+            )
+        if self.nonfinite_action == "skip_step":
+            if skipped:
+                self.counters["skipped_steps"] += 1
+                self.logger.warning(
+                    f"train monitor: step {step} non-finite ({detail}); "
+                    "update rolled back by the in-graph guard"
+                )
+            else:
+                if not self._warned_no_guard:
+                    self._warned_no_guard = True
+                    self.logger.warning(
+                        "train monitor: nonfinite_action='skip_step' but the "
+                        "compiled step has no non-finite guard — the update "
+                        "was already applied and cannot be rolled back. Pass "
+                        "this monitor to Booster.boost(monitor=...) so the "
+                        "plugin builds the guard into the step."
+                    )
+                self.logger.warning(
+                    f"train monitor: non-finite metrics at step {step}: {detail}"
+                )
+        else:  # warn
+            self.logger.warning(
+                f"train monitor: non-finite metrics at step {step}: {detail}"
+            )
+        return False
+
+    def observe_scalars(self, step: int, host_metrics: Dict[str, float]) -> bool:
+        """Mirror one step's host scalars into the monitor WITHOUT the
+        step-timing machinery — the :class:`~colossalai_tpu.logging.
+        MetricsLogger` integration path (it already fetched the floats).
+        Applies gradient-health actions and the loss/grad-norm series."""
+        ok = self._health_check(int(step), host_metrics)
+        loss = host_metrics.get(self.loss_key)
+        if loss is not None and math.isfinite(loss):
+            self._last_loss = loss
+        self._last_step = int(step)
+        return ok
+
+    # --------------------------------------------------------------- memory
+    def _sample_hbm(self) -> None:
+        """Per-local-device HBM gauges from the runtime's memory stats —
+        a host-side query, not a device transfer."""
+        try:
+            from colossalai_tpu.accelerator import get_accelerator
+
+            marks = get_accelerator().memory_watermarks()
+        except Exception:
+            marks = []
+        if not marks:
+            return
+        self._hbm_per_device = marks
+        self._hbm_in_use = max(m["bytes_in_use"] for m in marks)
+        peak = max(m["peak_bytes_in_use"] for m in marks)
+        if peak > self._hbm_peak:
+            self._hbm_peak = peak
+
+    # ------------------------------------------------------------- rendering
+    def gauges(self) -> Dict[str, float]:
+        g: Dict[str, float] = {
+            "last_step": self._last_step,
+            "hbm_peak_bytes": self._hbm_peak,
+            "hbm_bytes_in_use": self._hbm_in_use,
+            "tokens_per_second": self.perf.tokens_per_second,
+            "tokens_per_second_per_device": self.perf.tokens_per_second_per_device,
+        }
+        if math.isfinite(self._last_loss):
+            g["loss"] = self._last_loss
+        if self.perf.flops_per_token:
+            g["mfu"] = self.perf.mfu
+            g["tflops_per_device"] = self.perf.tflops_per_device
+        return g
+
+    def render_prometheus(self) -> str:
+        """Prometheus text snapshot of every counter/gauge/histogram.
+        Metric names are ``clt_train_<name>`` — disjoint by construction
+        from the serving renderer's ``clt_<name>`` families (linted in
+        ``tests/test_core/test_metric_names.py``)."""
+        return prometheus_exposition(
+            dict(self.counters), self.gauges(), self.histograms, prefix="clt_train"
+        )
+
+    def write_textfile(self, path: Optional[str] = None) -> str:
+        """Write the Prometheus snapshot atomically (tmp + rename) for the
+        node-exporter textfile collector — scrape-less runs (batch jobs on
+        borgless TPU pods) still land in the same dashboards."""
+        path = path or self.prometheus_textfile
+        if path is None:
+            raise ValueError("no textfile path configured")
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self.render_prometheus())
+        os.replace(tmp, path)
+        return path
+
+    def percentiles(self, name: str, qs=(50.0, 90.0, 99.0)) -> Dict[str, float]:
+        h = self.histograms[name]
+        return {f"p{int(q) if q == int(q) else q}": h.percentile(q) for q in qs}
+
+    def summary(self) -> Dict[str, Any]:
+        """One dict for BENCH json extras / end-of-run reports: throughput
+        + MFU (via the embedded PerformanceEvaluator), HBM watermark,
+        grad-health accounting, and phase wall-time percentiles."""
+        out: Dict[str, Any] = dict(self.perf.summary())
+        out.update(
+            steps_total=self.counters["steps_total"],
+            tokens_total=self.counters["tokens_total"],
+            nonfinite_steps=self.counters["nonfinite_steps"],
+            skipped_steps=self.counters["skipped_steps"],
+            hbm_peak_bytes=self._hbm_peak,
+            hbm_bytes_in_use=self._hbm_in_use,
+        )
+        try:
+            from colossalai_tpu.accelerator import get_accelerator
+
+            hbm = get_accelerator().hbm_bytes_per_device()
+        except Exception:
+            hbm = None
+        if hbm and self._hbm_peak:
+            out["hbm_watermark_ratio"] = round(self._hbm_peak / hbm, 4)
+        if math.isfinite(self._last_loss):
+            out["last_loss"] = round(self._last_loss, 4)
+        if self.histograms["grad_norm"].count:
+            out["grad_norm_p50"] = round(self.histograms["grad_norm"].percentile(50), 4)
+            out["grad_norm_p99"] = round(self.histograms["grad_norm"].percentile(99), 4)
+        phases = {}
+        for name, h in sorted(self.histograms.items()):
+            if name.startswith("phase_") and h.count:
+                phases[name.removeprefix("phase_").removesuffix("_seconds")] = {
+                    "p50_s": _r(h.percentile(50)),
+                    "p99_s": _r(h.percentile(99)),
+                }
+        if phases:
+            out["phases"] = phases
+        if self.histograms["step_seconds"].count:
+            out["step_p50_s"] = _r(self.histograms["step_seconds"].percentile(50))
+            out["step_p99_s"] = _r(self.histograms["step_seconds"].percentile(99))
+        return out
+
+    # ----------------------------------------------------------------- misc
+    def _exit_annotation(self) -> None:
+        if self._step_cm is not None:
+            try:
+                self._step_cm.__exit__(None, None, None)
+            finally:
+                self._step_cm = None
+
+    def reset(self) -> None:
+        """Zero histograms/counters (benchmarks reset after warmup); the
+        HBM watermark is a run-level high-water mark and survives."""
+        for h in self.histograms.values():
+            h.reset()
+        for k in self.counters:
+            self.counters[k] = 0
+        from colossalai_tpu.utils.performance_evaluator import PerformanceEvaluator
+
+        self.perf = PerformanceEvaluator(
+            flops_per_token=self.perf.flops_per_token, n_devices=self.perf.n_devices
+        )
+
+    def close(self) -> None:
+        self._exit_annotation()
+        if self.prometheus_textfile is not None:
+            try:
+                self.write_textfile(self.prometheus_textfile)
+            except Exception:
+                pass
+        if self.events is not None:
+            self.events.close()
+
+    def __enter__(self) -> "TrainMonitor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullTrainMonitor:
+    """No-op stand-in: same surface, hooks that do nothing — loops never
+    branch on whether monitoring is live (≙ serving's ``NullTelemetry``)."""
+
+    histograms: Dict[str, Histogram] = {}
+    counters: Dict[str, int] = {}
+    events = None
+    enabled = False
+    nonfinite_action = "warn"
+
+    def start_step(self, step: int) -> None:
+        pass
+
+    def phase(self, name: str):
+        return contextlib.nullcontext()
+
+    def end_step(self, metrics=None, *, host_metrics=None, n_tokens=0) -> bool:
+        return True
+
+    def observe_scalars(self, step: int, host_metrics) -> bool:
+        return True
+
+    def gauges(self) -> Dict[str, float]:
+        return {}
+
+    def summary(self) -> Dict[str, Any]:
+        return {}
+
+    def render_prometheus(self) -> str:
+        return prometheus_exposition({}, {}, {}, prefix="clt_train")
+
+    def reset(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    """Round a duration for the jsonl record (µs resolution — floats in
+    logs should be readable, not 17 digits)."""
+    return None if v is None else round(v, 6)

@@ -57,7 +57,11 @@ def constrain(x: jax.Array, *spec) -> jax.Array:
     mesh = _CURRENT_MESH
     if mesh is None or mesh.size == 1:
         return x
-    ctx = jax.sharding.get_abstract_mesh()
+    # jax < 0.5 has no abstract-mesh introspection; there manual regions
+    # can't be entered through the jax.shard_map surface this package uses
+    # either, so the NamedSharding branch is always the right one
+    get_ctx = getattr(jax.sharding, "get_abstract_mesh", None)
+    ctx = get_ctx() if get_ctx is not None else None
     if ctx is not None and not ctx.empty and not ctx.are_all_axes_auto:
         return jax.lax.with_sharding_constraint(x, PartitionSpec(*spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*spec)))

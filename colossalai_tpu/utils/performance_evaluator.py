@@ -29,7 +29,16 @@ _PEAK_TFLOPS = {
 
 
 def peak_flops_per_device() -> float:
-    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    """Peak bf16 flops/s of the first device; 1e12 for device kinds not in
+    the table (an explicit "MFU denominator unknown" sentinel — better a
+    wrong-but-stable scale than a crash mid-run) and for backends where
+    device enumeration itself fails."""
+    try:
+        devices = jax.devices()
+        kind = getattr(devices[0], "device_kind", "cpu") if devices else "cpu"
+    except Exception:
+        kind = "cpu"
+    kind = str(kind).lower()
     for key, tf in _PEAK_TFLOPS.items():
         if key in kind:
             return tf * 1e12
@@ -68,8 +77,12 @@ class PerformanceEvaluator:
     _t0: Optional[float] = None
     _steps: int = 0
 
+    #: patchable clock seam (tests pin it to verify MFU arithmetic
+    #: against hand-computed values)
+    _clock = staticmethod(time.perf_counter)
+
     def on_step_start(self) -> None:
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock()
 
     def on_step_end(self, n_tokens: int, sync: bool = False, sync_on=None) -> None:
         """End-of-step accounting. Pass ``sync_on`` (e.g. the step's loss) to
@@ -86,21 +99,27 @@ class PerformanceEvaluator:
             import numpy as np
 
             float(np.asarray(jax.numpy.zeros(()) + 0))
-        self._time += time.perf_counter() - self._t0
+        if self._t0 is not None:  # tolerate a missing on_step_start
+            self._time += self._clock() - self._t0
+            self._t0 = None
         self._tokens += n_tokens
         self._steps += 1
 
     @property
     def tokens_per_second(self) -> float:
-        return self._tokens / max(self._time, 1e-9)
+        # 0.0 (not a ~1e18 garbage rate) before any time has elapsed —
+        # sub-resolution clocks can report zero-elapsed steps
+        if self._time <= 0.0:
+            return 0.0
+        return self._tokens / self._time
 
     @property
     def tokens_per_second_per_device(self) -> float:
-        return self.tokens_per_second / self.n_devices
+        return self.tokens_per_second / max(self.n_devices, 1)
 
     @property
     def tflops_per_device(self) -> float:
-        return self.flops_per_token * self.tokens_per_second / self.n_devices / 1e12
+        return self.flops_per_token * self.tokens_per_second / max(self.n_devices, 1) / 1e12
 
     @property
     def mfu(self) -> float:

@@ -10,7 +10,15 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+    _flags += " --xla_force_host_platform_device_count=8"
+# the suite checks numerics (with tolerances), not CPU codegen quality —
+# skip LLVM's expensive optimization pipeline; compile time dominates the
+# run (~2x wall clock on the full suite) and test outcomes are identical
+if "xla_backend_optimization_level" not in _flags:
+    _flags += " --xla_backend_optimization_level=0"
+if "xla_llvm_disable_expensive_passes" not in _flags:
+    _flags += " --xla_llvm_disable_expensive_passes=true"
+os.environ["XLA_FLAGS"] = _flags
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402

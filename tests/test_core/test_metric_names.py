@@ -1,0 +1,83 @@
+"""Metric-name lint: every name either Prometheus renderer (serving
+``clt_*``, training ``clt_train_*``) emits must match the Prometheus
+grammar, and the two catalogs must never collide — both sides land in the
+same scrape target."""
+
+import math
+
+from colossalai_tpu.inference.engine import EngineStats
+from colossalai_tpu.inference.telemetry import _HISTOGRAM_SPECS, Telemetry
+from colossalai_tpu.telemetry import METRIC_NAME_RE, TrainMonitor, prometheus_exposition
+
+
+def _family_names(text):
+    names = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            names.add(line.split()[2])
+        else:
+            base = line.rsplit(" ", 1)[0].split("{")[0]
+            if base.endswith(("_bucket", "_sum", "_count")):
+                base = base.rsplit("_", 1)[0]
+            names.add(base)
+    return names
+
+
+def _serving_names():
+    """The full serving catalog: every EngineStats counter/derived rate +
+    every serving histogram, rendered exactly as ``GET /metrics`` does."""
+    tele = Telemetry()
+    stats = EngineStats().as_dict()
+    counters = {k: v for k, v in stats.items() if isinstance(v, (int, float))}
+    return _family_names(
+        prometheus_exposition(counters, {}, tele.histograms, prefix="clt")
+    )
+
+
+def _training_names():
+    """The full training catalog: run a monitor through one step with the
+    conventional phases so the lazily-created phase families render too."""
+    mon = TrainMonitor(flops_per_token=1.0, n_devices=1)
+    mon.start_step(0)
+    for phase in ("data", "dispatch", "sync", "optimizer"):
+        with mon.phase(phase):
+            pass
+    mon.end_step(host_metrics={"loss": 1.0, "grad_norm": 1.0}, n_tokens=1)
+    try:
+        return _family_names(mon.render_prometheus())
+    finally:
+        mon.close()
+
+
+def test_serving_names_match_grammar():
+    names = _serving_names()
+    assert names  # the catalog is non-empty
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+    assert {f"clt_{h}" for h in _HISTOGRAM_SPECS} <= names
+
+
+def test_training_names_match_grammar():
+    names = _training_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+    assert {"clt_train_steps_total", "clt_train_grad_norm",
+            "clt_train_mfu", "clt_train_phase_data_seconds"} <= names
+
+
+def test_serving_and_training_catalogs_disjoint():
+    overlap = _serving_names() & _training_names()
+    assert not overlap, f"metric-name collision between renderers: {overlap}"
+
+
+def test_exposition_skips_unrenderable_values():
+    """Strings and non-finite floats must never produce a sample line the
+    grammar test above would have to special-case."""
+    text = prometheus_exposition(
+        {"good": 1, "policy": "fcfs", "bad": math.nan},
+        {"ratio": math.inf, "flag": True},
+        {},
+        prefix="clt",
+    )
+    names = _family_names(text)
+    assert names == {"clt_good", "clt_flag"}

@@ -54,3 +54,50 @@ def test_flush_returns_record_and_resets():
 def test_log_every_validated():
     with pytest.raises(ValueError, match="log_every"):
         MetricsLogger(None, log_every=0)
+
+
+def test_nonfinite_values_dropped_from_window_means():
+    """One NaN must not poison the windowed mean of the other steps (NaN is
+    absorbing under +); detection is the TrainMonitor's job, not the mean's."""
+    m = MetricsLogger(None, log_every=100)
+    m.log(0, {"loss": 2.0, "aux": 1.0})
+    m.log(1, {"loss": float("nan"), "aux": float("inf")})
+    m.log(2, {"loss": 4.0, "aux": 1.0})
+    rec = m.flush()
+    assert rec["loss"] == pytest.approx(3.0)  # mean over the finite samples
+    assert rec["aux"] == pytest.approx(1.0)
+    m.close()
+
+
+def test_monitor_mirror_receives_raw_nonfinite_values():
+    """The mirror hook must see the RAW floats (NaN included) even though
+    the windowed means drop them — the monitor exists to detect those."""
+
+    class Spy:
+        def __init__(self):
+            self.seen = []
+
+        def observe_scalars(self, step, host):
+            self.seen.append((step, host))
+            return True
+
+    spy = Spy()
+    with MetricsLogger(None, log_every=100, monitor=spy) as m:
+        m.log(0, {"loss": 2.0, "grad_norm": jnp.asarray(1.5), "note": "text"})
+        m.log(1, {"loss": float("nan")})
+    assert spy.seen[0] == (0, {"loss": 2.0, "grad_norm": 1.5})
+    import math
+
+    assert spy.seen[1][0] == 1 and math.isnan(spy.seen[1][1]["loss"])
+
+
+def test_monitor_raise_action_propagates_through_logger():
+    from colossalai_tpu.telemetry import NonFiniteLossError, TrainMonitor
+
+    mon = TrainMonitor(n_devices=1, nonfinite_action="raise")
+    m = MetricsLogger(None, log_every=100, monitor=mon)
+    m.log(0, {"loss": 1.0})
+    with pytest.raises(NonFiniteLossError):
+        m.log(1, {"loss": float("inf")})
+    m.close()
+    mon.close()

@@ -1,0 +1,460 @@
+"""TrainMonitor: phase timing, grad-health actions, MFU arithmetic, the
+in-graph skip_step guard, and the transfer-invariance contract (telemetry
+on vs off must produce byte-identical device traffic)."""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from colossalai_tpu.booster import Booster
+from colossalai_tpu.booster.plugin.plugin_base import default_causal_lm_loss
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.telemetry import (
+    METRIC_NAME_RE,
+    NonFiniteLossError,
+    NullTrainMonitor,
+    TrainMonitor,
+    fetch_scalars,
+    transfer_counter,
+)
+from colossalai_tpu.utils.performance_evaluator import (
+    PerformanceEvaluator,
+    peak_flops_per_device,
+)
+
+RNG = np.random.RandomState(0)
+
+
+def _pin_clocks(monkeypatch, t):
+    """Freeze both clock seams to the mutable cell ``t`` — tests advance
+    time by mutating ``t[0]``, making every derived duration exact."""
+    monkeypatch.setattr(TrainMonitor, "_clock", staticmethod(lambda: t[0]))
+    monkeypatch.setattr(
+        PerformanceEvaluator, "_clock", staticmethod(lambda: t[0])
+    )
+
+
+# ------------------------------------------------------------- fetch_scalars
+def test_fetch_scalars_one_fetch_scalars_only():
+    before = transfer_counter.snapshot()
+    host = fetch_scalars(
+        {
+            "loss": jnp.asarray(2.5),
+            "grad_norm": jnp.ones((1,)),  # size-1 array counts as scalar
+            "lr": 0.125,
+            "logits": jnp.zeros((4, 8)),  # non-scalar: never fetched
+            "note": "text",
+        }
+    )
+    assert host == {"loss": 2.5, "grad_norm": 1.0, "lr": 0.125}
+    assert all(isinstance(v, float) for v in host.values())
+    assert transfer_counter.fetches - before.fetches == 1
+    assert transfer_counter.elements - before.elements == 3
+
+
+# ------------------------------------------------------------- phase timing
+def test_phase_timing_pinned_clock(monkeypatch, tmp_path):
+    t = [100.0]
+    _pin_clocks(monkeypatch, t)
+    log = tmp_path / "steps.jsonl"
+    mon = TrainMonitor(str(log), n_devices=1)
+    mon.start_step(0)
+    with mon.phase("data"):
+        t[0] += 0.25
+    with mon.phase("dispatch"):
+        t[0] += 0.5
+    with mon.phase("sync"):
+        t[0] += 0.125
+    t[0] += 0.1  # unattributed host time (loop bookkeeping)
+    ok = mon.end_step(host_metrics={"loss": 1.0}, n_tokens=64)
+    mon.close()
+    assert ok
+
+    (rec,) = [json.loads(l) for l in log.read_text().splitlines()]
+    assert rec["event"] == "train_step" and rec["step"] == 0
+    assert rec["phase_data_s"] == pytest.approx(0.25)
+    assert rec["phase_dispatch_s"] == pytest.approx(0.5)
+    assert rec["phase_sync_s"] == pytest.approx(0.125)
+    assert rec["step_s"] == pytest.approx(0.975)
+    # monotonicity: attributed phase time never exceeds the step wall time
+    phase_sum = sum(v for k, v in rec.items() if k.startswith("phase_"))
+    assert phase_sum <= rec["step_s"]
+    # phase histograms exist and saw exactly one observation each
+    for name in ("phase_data_seconds", "phase_dispatch_seconds",
+                 "phase_sync_seconds", "step_seconds"):
+        assert mon.histograms[name].count == 1
+    assert mon.histograms["phase_data_seconds"].sum == pytest.approx(0.25)
+
+
+def test_repeated_phase_accumulates(monkeypatch):
+    t = [0.0]
+    _pin_clocks(monkeypatch, t)
+    mon = TrainMonitor(n_devices=1)
+    mon.start_step(0)
+    for _ in range(3):  # e.g. gradient accumulation: 3 dispatches per step
+        with mon.phase("dispatch"):
+            t[0] += 0.1
+    mon.end_step(host_metrics={"loss": 1.0})
+    assert mon.histograms["phase_dispatch_seconds"].count == 3
+    assert mon._phase_acc["dispatch"] == pytest.approx(0.3)  # summed per step
+    mon.start_step(1)
+    assert mon._phase_acc == {}  # next step starts clean
+    mon.end_step(host_metrics={"loss": 1.0})
+    mon.close()
+
+
+def test_phase_name_validated():
+    mon = TrainMonitor(n_devices=1)
+    with pytest.raises(ValueError, match="phase name"):
+        with mon.phase("Bad Name"):
+            pass
+    mon.close()
+
+
+def test_end_step_requires_start_step():
+    mon = TrainMonitor(n_devices=1)
+    with pytest.raises(RuntimeError, match="start_step"):
+        mon.end_step(host_metrics={"loss": 1.0})
+    mon.close()
+
+
+# --------------------------------------------------------------- throughput
+def test_mfu_matches_hand_computed(monkeypatch):
+    t = [10.0]
+    _pin_clocks(monkeypatch, t)
+    fpt, n_dev = 1e9, 4
+    mon = TrainMonitor(flops_per_token=fpt, n_devices=n_dev)
+    for step in range(2):
+        mon.start_step(step)
+        t[0] += 2.0
+        mon.end_step(host_metrics={"loss": 1.0}, n_tokens=8000)
+    # 16000 tokens over 4.0s of step time
+    tps = 16000 / 4.0
+    assert mon.perf.tokens_per_second == pytest.approx(tps)
+    assert mon.perf.tokens_per_second_per_device == pytest.approx(tps / n_dev)
+    tflops = fpt * tps / n_dev / 1e12
+    assert mon.perf.tflops_per_device == pytest.approx(tflops)
+    assert mon.perf.mfu == pytest.approx(tflops * 1e12 / peak_flops_per_device())
+    s = mon.summary()
+    assert s["steps_total"] == 2 and s["tokens_total"] == 16000
+    assert s["tokens_per_second"] == pytest.approx(tps, rel=1e-2)
+    assert s["mfu"] == pytest.approx(mon.perf.mfu, abs=1e-4)
+    mon.close()
+
+
+def test_zero_elapsed_time_is_not_infinite_throughput(monkeypatch):
+    t = [0.0]
+    _pin_clocks(monkeypatch, t)
+    mon = TrainMonitor(flops_per_token=1e9, n_devices=1)
+    mon.start_step(0)
+    mon.end_step(host_metrics={"loss": 1.0}, n_tokens=1000)  # 0s elapsed
+    assert mon.perf.tokens_per_second == 0.0
+    assert mon.perf.mfu == 0.0
+    mon.close()
+
+
+def test_nonfinite_steps_do_not_count_tokens(monkeypatch):
+    t = [0.0]
+    _pin_clocks(monkeypatch, t)
+    mon = TrainMonitor(n_devices=1)
+    mon.start_step(0)
+    t[0] += 1.0
+    assert mon.end_step(host_metrics={"loss": 2.0}, n_tokens=100)
+    mon.start_step(1)
+    t[0] += 1.0
+    assert not mon.end_step(host_metrics={"loss": math.nan}, n_tokens=100)
+    assert mon.counters["tokens_total"] == 100  # the NaN step's tokens excluded
+    assert mon.counters["steps_total"] == 2
+    mon.close()
+
+
+# ----------------------------------------------------------- health actions
+def test_action_warn_returns_false_and_counts():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="warn")
+    mon.start_step(0)
+    assert not mon.end_step(host_metrics={"loss": math.nan, "grad_norm": 1.0})
+    assert mon.counters["nonfinite_steps"] == 1
+    assert mon.counters["skipped_steps"] == 0
+    # grad-norm histogram only sees finite values
+    assert mon.histograms["grad_norm"].count == 1
+    mon.close()
+
+
+def test_action_raise():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="raise")
+    mon.start_step(0)
+    with pytest.raises(NonFiniteLossError, match="step 0"):
+        mon.end_step(host_metrics={"loss": math.inf})
+    mon.close()
+
+
+def test_action_raise_on_nonfinite_grad_norm_alone():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="raise")
+    mon.start_step(0)
+    with pytest.raises(NonFiniteLossError, match="grad_norm"):
+        mon.end_step(host_metrics={"loss": 2.0, "grad_norm": math.nan})
+    mon.close()
+
+
+def test_action_skip_step_without_guard_warns_once():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="skip_step")
+    mon.start_step(0)
+    assert not mon.end_step(host_metrics={"loss": math.nan})
+    # no "skipped" flag in the metrics: the compiled step had no guard, so
+    # nothing was actually rolled back — must NOT count as skipped
+    assert mon.counters["skipped_steps"] == 0
+    assert mon.counters["nonfinite_steps"] == 1
+    assert mon._warned_no_guard
+    mon.close()
+
+
+def test_action_skip_step_with_guard_flag():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="skip_step")
+    mon.start_step(0)
+    assert not mon.end_step(host_metrics={"loss": math.nan, "skipped": 1.0})
+    assert mon.counters["skipped_steps"] == 1
+    mon.close()
+
+
+def test_fp16_overflow_counts_as_skipped():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="skip_step")
+    mon.start_step(0)
+    # loss scaler overflow: metrics finite but the update was dropped
+    assert not mon.end_step(host_metrics={"loss": 2.0, "overflow": 1.0})
+    assert mon.counters["skipped_steps"] == 1
+    mon.close()
+
+
+def test_finite_step_is_ok():
+    mon = TrainMonitor(n_devices=1, nonfinite_action="raise")
+    mon.start_step(0)
+    assert mon.end_step(host_metrics={"loss": 2.0, "grad_norm": 0.5})
+    assert mon.counters["nonfinite_steps"] == 0
+    mon.close()
+
+
+def test_invalid_action_and_hbm_every_rejected():
+    with pytest.raises(ValueError, match="nonfinite_action"):
+        TrainMonitor(nonfinite_action="explode")
+    with pytest.raises(ValueError, match="hbm_every"):
+        TrainMonitor(hbm_every=0)
+
+
+def test_observe_scalars_mirror_path():
+    """The MetricsLogger integration surface: health actions fire without
+    any step-timing bracketing."""
+    mon = TrainMonitor(n_devices=1, nonfinite_action="raise")
+    assert mon.observe_scalars(3, {"loss": 1.5, "grad_norm": 0.1})
+    assert mon.gauges()["loss"] == 1.5 and mon.gauges()["last_step"] == 3
+    with pytest.raises(NonFiniteLossError):
+        mon.observe_scalars(4, {"loss": math.nan})
+    mon.close()
+
+
+# ---------------------------------------------------------------- rendering
+def _parse_exposition(text):
+    """{name: {"type": t, "samples": [(label_suffix, value), ...]}} — every
+    sample line must belong to a declared # TYPE family."""
+    families, cur = {}, None
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split()
+            families[name] = {"type": typ, "samples": []}
+            cur = name
+        else:
+            metric, value = line.rsplit(" ", 1)
+            base = metric.split("{")[0]
+            if base.endswith(("_bucket", "_sum", "_count")):
+                base = base.rsplit("_", 1)[0]
+            assert cur is not None and base == cur or base in families, line
+            families[base]["samples"].append((metric, float(value)))
+    return families
+
+
+def test_render_prometheus_parses_and_is_prefixed():
+    mon = TrainMonitor(flops_per_token=1e9, n_devices=1)
+    for step in range(3):
+        mon.start_step(step)
+        with mon.phase("data"):
+            pass
+        with mon.phase("dispatch"):
+            pass
+        mon.end_step(host_metrics={"loss": 2.0 - step * 0.1, "grad_norm": 1.0},
+                     n_tokens=64)
+    fams = _parse_exposition(mon.render_prometheus())
+    assert all(name.startswith("clt_train_") for name in fams)
+    assert all(METRIC_NAME_RE.match(name) for name in fams)
+    assert fams["clt_train_steps_total"]["type"] == "counter"
+    assert dict(fams["clt_train_steps_total"]["samples"])["clt_train_steps_total"] == 3
+    assert fams["clt_train_loss"]["type"] == "gauge"
+    for h in ("clt_train_step_seconds", "clt_train_grad_norm",
+              "clt_train_phase_data_seconds", "clt_train_phase_dispatch_seconds"):
+        assert fams[h]["type"] == "histogram"
+        assert dict(fams[h]["samples"])[f"{h}_count"] == 3
+    mon.close()
+
+
+def test_write_textfile_atomic(tmp_path):
+    path = tmp_path / "metrics" / "train.prom"
+    mon = TrainMonitor(n_devices=1, prometheus_textfile=str(path))
+    mon.start_step(0)
+    mon.end_step(host_metrics={"loss": 1.0})
+    assert path.exists()
+    fams = _parse_exposition(path.read_text())
+    assert dict(fams["clt_train_steps_total"]["samples"])["clt_train_steps_total"] == 1
+    assert not list(path.parent.glob("*.tmp.*"))  # no temp litter
+    mon.close()
+
+
+def test_reset_keeps_hbm_watermark():
+    mon = TrainMonitor(n_devices=1)
+    mon.start_step(0)
+    mon.end_step(host_metrics={"loss": 1.0}, n_tokens=10)
+    mon._hbm_peak = 12345  # simulate a sampled watermark
+    mon.reset()
+    assert mon.counters["steps_total"] == 0
+    assert mon.histograms["step_seconds"].count == 0
+    assert mon._hbm_peak == 12345  # run-level high-water mark survives
+    mon.close()
+
+
+def test_null_monitor_surface():
+    mon = NullTrainMonitor()
+    mon.start_step(0)
+    with mon.phase("anything goes"):  # no validation on the null object
+        pass
+    assert mon.end_step(host_metrics={"loss": math.nan})  # never flags
+    assert mon.observe_scalars(0, {"loss": math.nan})
+    assert mon.summary() == {} and mon.gauges() == {}
+    assert mon.render_prometheus().endswith("\n")
+    mon.reset()
+    mon.close()
+
+
+# --------------------------------------------------- end-to-end (1 device)
+# multi-device Booster paths need jax.sharding.get_abstract_mesh; on a
+# single device the sharding constraint is a no-op, which keeps these
+# runnable everywhere the suite runs.
+def _tiny_batch(cfg, loss_scale=None, rng=None):
+    rng = rng if rng is not None else RNG
+    batch = {"input_ids": jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 16)))}
+    if loss_scale is not None:
+        batch["loss_scale"] = jnp.asarray(loss_scale, jnp.float32)
+    return batch
+
+
+def _boost_tiny(monitor=None, loss_fn=None):
+    cfg = LlamaConfig.tiny()
+    boosted = Booster().boost(
+        LlamaForCausalLM(cfg), optax.adam(1e-3), loss_fn=loss_fn,
+        example_batch=_tiny_batch(cfg, 1.0 if loss_fn else None),
+        rng=jax.random.PRNGKey(0), monitor=monitor, devices=jax.devices()[:1],
+    )
+    return cfg, boosted
+
+
+def test_skip_step_rolls_back_and_recovers(tmp_path):
+    """NaN injected into one step's loss: the in-graph guard must leave
+    params byte-identical, the monitor must account the skip, and the next
+    clean step must train normally."""
+
+    def loss_fn(out, batch):  # loss_scale=NaN poisons loss AND grads
+        return default_causal_lm_loss(out, batch) * batch["loss_scale"]
+
+    log = tmp_path / "steps.jsonl"
+    mon = TrainMonitor(str(log), n_devices=1, nonfinite_action="skip_step")
+    cfg, boosted = _boost_tiny(monitor=mon, loss_fn=loss_fn)
+    assert boosted.plugin.nonfinite_guard  # boost() armed the guard
+    state = boosted.state
+
+    losses, scales = [], [1.0, float("nan"), 1.0]
+    for step, scale in enumerate(scales):
+        mon.start_step(step)
+        with mon.phase("data"):
+            batch = _tiny_batch(cfg, scale)
+        if step == 1:
+            params_before = jax.device_get(state.params)
+        with mon.phase("dispatch"):
+            state, metrics = boosted.train_step(state, batch)
+        with mon.phase("sync"):
+            host = fetch_scalars(metrics)
+        ok = mon.end_step(host_metrics=host, n_tokens=batch["input_ids"].size)
+        losses.append(host["loss"])
+        if step == 1:
+            assert not ok and host["skipped"] == 1.0
+            params_after = jax.device_get(state.params)
+            jax.tree_util.tree_map(
+                np.testing.assert_array_equal, params_before, params_after
+            )
+
+    assert math.isfinite(losses[0]) and math.isnan(losses[1])
+    assert math.isfinite(losses[2])  # recovered: the poisoned update never landed
+    assert mon.counters == {
+        "steps_total": 3, "tokens_total": 128,
+        "nonfinite_steps": 1, "skipped_steps": 1,
+    }
+    mon.close()
+
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert recs[1]["nonfinite"] and recs[1]["skipped"]
+    assert recs[1]["loss"] is None  # json has no NaN literal
+    assert "nonfinite" not in recs[0] and "nonfinite" not in recs[2]
+
+
+def test_transfer_counts_identical_monitor_on_vs_off(tmp_path):
+    """THE invariance gate: the same 3-step loop with a live TrainMonitor
+    and with the Null monitor must issue identical device fetches and
+    produce identical losses. One boost is shared: the state is restored
+    from a host snapshot between runs, so both exercise the SAME compiled
+    step — which a warn-mode monitor must not have changed (no guard)."""
+    mon = TrainMonitor(n_devices=1, nonfinite_action="warn")
+    cfg, boosted = _boost_tiny(monitor=mon)
+    assert not boosted.plugin.nonfinite_guard  # warn never arms the guard
+    assert boosted.monitor is mon
+    init = jax.device_get(boosted.state)
+    device = jax.devices()[0]
+
+    def run(monitor):
+        # device_put of a host numpy array can be ZERO-COPY on CPU, and
+        # train_step donates its state — without the np.copy the first run
+        # would overwrite the shared snapshot in place
+        state = jax.device_put(jax.tree.map(np.copy, init), device)
+        data_rng = np.random.RandomState(7)
+        before = transfer_counter.snapshot()
+        losses = []
+        for step in range(3):
+            monitor.start_step(step)
+            with monitor.phase("data"):
+                batch = _tiny_batch(cfg, rng=data_rng)
+            with monitor.phase("dispatch"):
+                state, metrics = boosted.train_step(state, batch)
+            with monitor.phase("sync"):
+                host = fetch_scalars(metrics)
+            monitor.end_step(host_metrics=host, n_tokens=batch["input_ids"].size)
+            losses.append(host["loss"])
+        return losses, (transfer_counter.fetches - before.fetches,
+                        transfer_counter.elements - before.elements)
+
+    on_losses, on_transfers = run(mon)
+    off_losses, off_transfers = run(NullTrainMonitor())
+    assert on_transfers == off_transfers
+    assert on_transfers[0] == 3  # exactly one fetch per step
+    assert on_losses == off_losses
+    mon.close()
+
+    # piggybacked on the same Boosted handle (no extra boost/compile):
+    # ElasticTrainer auto-picks the monitor boost() attached; explicit wins
+    from colossalai_tpu.elastic import ElasticTrainer
+
+    trainer = ElasticTrainer(Booster(), boosted, str(tmp_path / "ckpt"))
+    assert trainer.monitor is mon
+    override = NullTrainMonitor()
+    trainer2 = ElasticTrainer(Booster(), boosted, str(tmp_path / "ckpt"),
+                              monitor=override)
+    assert trainer2.monitor is override

@@ -115,6 +115,44 @@ def test_pp_with_tp_and_zero():
     np.testing.assert_allclose(combo, base, rtol=5e-4)
 
 
+@pytest.mark.slow
+def test_pp_training_with_monitor(tmp_path):
+    """The observability acceptance loop, pipeline-schedule variant: a
+    monitored pp train run must produce per-step jsonl and a Prometheus
+    snapshot with phase and grad-health series."""
+    from colossalai_tpu.telemetry import EventLog, TrainMonitor, fetch_scalars
+
+    ids = jnp.asarray(RNG.randint(0, 256, size=(8, 16)))
+    batch = {"input_ids": ids}
+    log = tmp_path / "steps.jsonl"
+    mon = TrainMonitor(str(log), n_devices=jax.device_count())
+    plugin = HybridParallelPlugin(pp_size=2, num_microbatches=4, precision="fp32")
+    boosted = Booster(plugin=plugin).boost(
+        LlamaForCausalLM(LlamaConfig.tiny()), optax.adamw(1e-3),
+        example_batch=batch, rng=jax.random.PRNGKey(0), monitor=mon,
+    )
+    state = boosted.state
+    for step in range(3):
+        mon.start_step(step)
+        with mon.phase("data"):
+            sharded = boosted.shard_batch(batch)
+        with mon.phase("dispatch"):
+            state, metrics = boosted.train_step(state, sharded)
+        with mon.phase("sync"):
+            host = fetch_scalars(metrics)
+        mon.end_step(host_metrics=host, n_tokens=int(ids.size))
+    mon.close()
+
+    recs = EventLog.read(str(log))
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert all(np.isfinite(r["loss"]) for r in recs)
+    assert all(r["phase_dispatch_s"] > 0 for r in recs)
+    text = mon.render_prometheus()
+    assert "clt_train_steps_total 3" in text
+    assert "clt_train_phase_dispatch_seconds_bucket" in text
+    assert "clt_train_grad_norm_count" in text
+
+
 def test_pp_requires_microbatches():
     with pytest.raises(ValueError):
         HybridParallelPlugin(pp_size=2)
