@@ -25,6 +25,7 @@ from .paged_modeling import (
     verify_paged,
 )
 from .prefix_cache import PrefixCache
+from .router import ROUTER_POLICIES, Router, make_router_server
 from .server import make_server
 from .telemetry import (
     FINISH_REASONS,
@@ -70,6 +71,9 @@ __all__ = [
     "self_draft_params",
     "verify_paged",
     "make_server",
+    "make_router_server",
+    "ROUTER_POLICIES",
+    "Router",
     "extend_step",
     "SpeculativeEngine",
     "SpecStats",

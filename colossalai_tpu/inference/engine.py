@@ -51,6 +51,7 @@ Design deltas for TPU/XLA:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import itertools
@@ -421,18 +422,21 @@ class LLMEngine:
         # quantized pool composes with megastep K, chunked prefill, the
         # prefix cache (shared pages carry their scales — they are indexed
         # by PHYSICAL block id), speculative decoding (the draft pool
-        # quantizes too) and MoE serving; mesh sharding does not thread the
-        # scale tensors yet.
+        # quantizes too), MoE serving, and GSPMD tp meshes (the scales
+        # shard their kv-head dim next to the pool); the pp relay's
+        # [pp, L/pp, ...] pool resharding has no scale path.
         if kv_dtype not in ("bf16", "int8"):
             raise ValueError(
                 f"kv_dtype={kv_dtype!r}: pass 'bf16' (pages in the compute "
                 "dtype) or 'int8' (quantized pages + per-page scales)"
             )
-        if kv_dtype == "int8" and mesh is not None:
+        mesh_axes = dict(mesh.shape) if mesh is not None else {}
+        if kv_dtype == "int8" and mesh_axes.get("pp", 1) > 1:
             raise NotImplementedError(
-                "kv_dtype='int8' is single-device only for now — the tp/pp "
-                "paths don't shard the scale tensors; drop the mesh or use "
-                "kv_dtype='bf16'"
+                "kv_dtype='int8' does not compose with pipeline-parallel "
+                "decode — the pp relay's stage-resharded pool carries no "
+                "scale tensors; use a tp-only mesh (GSPMD shards the "
+                "scales) or kv_dtype='bf16'"
             )
         self.kv_dtype = kv_dtype
         dtype = config.dtype or jnp.bfloat16
@@ -458,10 +462,12 @@ class LLMEngine:
                 "to the number of tokens to draft per verify pass"
             )
         if draft_len > 0:
-            if mesh is not None:
+            if mesh_axes.get("pp", 1) > 1:
                 raise NotImplementedError(
-                    "speculative decoding (draft_len > 0) is single-device "
-                    "only — drop the mesh or draft_len"
+                    "speculative decoding (draft_len > 0) has no "
+                    "pipeline-parallel relay path — use a tp-only mesh "
+                    "(the GSPMD spec megastep shards the draft pool too) "
+                    "or drop draft_len"
                 )
             if draft_params is not None:
                 if draft_config is None:
@@ -601,11 +607,25 @@ class LLMEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             params = self._place_params(params)
-            # pool [L, n_blocks, Hkv, bs, D]: heads over tp
+            # pool [L, n_blocks, Hkv, bs, D]: heads over tp; int8 scale
+            # tensors [L, n_blocks, Hkv] shard the SAME head dim (a
+            # replicated scale next to a sharded pool would force an
+            # all-gather on every quantized append)
             kv_spec = P(None, None, "tp", None, None)
-            cache = PagedKVCache(
-                k=self._put(cache.k, kv_spec), v=self._put(cache.v, kv_spec)
-            )
+            sc_spec = P(None, None, "tp")
+            cache = self._place_kv(cache, kv_spec, sc_spec)
+            if self.draft_len > 0:
+                if self_draft_layers is not None:
+                    # re-slice the self-draft from the PLACED target tree:
+                    # embed/norm/lm-head leaves stay aliases of the sharded
+                    # arrays and the sliced blocks inherit their tp layout
+                    self.draft_params, self.draft_config = self_draft_params(
+                        params, config, self_draft_layers
+                    )
+                else:
+                    self.draft_params = self._place_params(self.draft_params)
+                self.draft_cache = self._place_kv(
+                    self.draft_cache, kv_spec, sc_spec)
         # pp mode only ever reads _pp_top/_pp_stacked — don't pin a second
         # full copy of the weights for the engine's lifetime
         self.params = None if self._pp else params
@@ -676,6 +696,18 @@ class LLMEngine:
         from jax.sharding import PartitionSpec as P
 
         return self._put(x, P()) if self._global else jnp.asarray(x)
+
+    def _place_kv(self, kv: PagedKVCache, kv_spec, sc_spec) -> PagedKVCache:
+        """Mesh placement of a page pool: K/V pages shard their kv-head
+        dim; int8 pools place their scale tensors with the same head
+        sharding (bf16 pools keep the None leaves — distinct pytrees)."""
+        return PagedKVCache(
+            k=self._put(kv.k, kv_spec), v=self._put(kv.v, kv_spec),
+            k_scale=(None if kv.k_scale is None
+                     else self._put(kv.k_scale, sc_spec)),
+            v_scale=(None if kv.v_scale is None
+                     else self._put(kv.v_scale, sc_spec)),
+        )
 
     @staticmethod
     def _fetch(arr) -> np.ndarray:
@@ -1186,8 +1218,22 @@ class LLMEngine:
         # host sync) feeds the megastep_seconds histogram — measured once
         # per K tokens, so the device loop itself never sees a timer
         t_mega = time.perf_counter()
-        with step_annotation(self.stats.decode_megasteps,
-                             name="spec_megastep" if d > 0 else "decode_megastep"):
+        # GSPMD tp path: install the ambient mesh around the dispatch so
+        # the loop-carry sharding annotations (constrain_cache in the
+        # megastep bodies, the scale constraints in kv_quant.append_token,
+        # the tuning-key tp lookup in the Pallas frontend) resolve at
+        # trace time; tp_shard is STATIC on the megastep jits, so a meshed
+        # and a mesh-free engine never share a trace.
+        tp_shard = self._tp_mesh is not None
+        if tp_shard:
+            from colossalai_tpu.tensor.sharding import use_mesh
+
+            mesh_ctx = use_mesh(self._tp_mesh)
+        else:
+            mesh_ctx = contextlib.nullcontext()
+        with mesh_ctx, step_annotation(
+                self.stats.decode_megasteps,
+                name="spec_megastep" if d > 0 else "decode_megastep"):
             if d > 0:
                 # draft/verify/commit runs entirely on device; the extra
                 # outputs are the per-slot speculative counters, fetched in
@@ -1202,6 +1248,7 @@ class LLMEngine:
                     self._dev_temp, self._dev_topk, self._dev_topp,
                     self._dev_sample, keys, k_steps=k, draft_len=d,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
+                    tp_shard=tp_shard,
                 )
             elif self._pp:
                 (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
@@ -1220,7 +1267,7 @@ class LLMEngine:
                     self._dev_temp, self._dev_topk, self._dev_topp,
                     self._dev_sample, keys, k_steps=k,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
-                    moe_fused=self._moe_fused,
+                    moe_fused=self._moe_fused, tp_shard=tp_shard,
                 )
                 # MoE param trees append the [E] expert_counts tally
                 expert_counts = out[7] if self._moe else None

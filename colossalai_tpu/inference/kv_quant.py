@@ -36,6 +36,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from colossalai_tpu.tensor.sharding import constrain
+
 #: symmetric int8 range: quantized values live in [-127, 127] (never -128,
 #: so negation round-trips and |q * scale| <= absmax)
 INT8_MAX = 127.0
@@ -122,4 +124,11 @@ def append_token(pool, scales, wb, wo, tok, ok):
     )  # [S, 1, block_size]
     page_new = jnp.where(at_wo[..., None], qtok[:, :, None, :], repage)
     page_new = jnp.where(ok[:, None, None, None], page_new, page)
-    return pool.at[wb].set(page_new), scales.at[wb].set(new)
+    # re-assert the tp layout on the updated pool AND its scales: under a
+    # GSPMD tp mesh the pool shards its kv-head dim and the scales must
+    # shard the SAME dim (a replicated scale tensor next to a sharded pool
+    # would force an all-gather per append). No ambient mesh → no-op.
+    return (
+        constrain(pool.at[wb].set(page_new), None, "tp", None, None),
+        constrain(scales.at[wb].set(new), None, "tp"),
+    )

@@ -38,15 +38,28 @@ def _bcast(arr: np.ndarray) -> np.ndarray:
 # Wire format: one float per GenerationConfig field, in dataclass field
 # order. None encodes as -1 (only eos_token_id is Optional). NOTE the
 # broadcast downcasts to float32 on device — ints survive exactly only up
-# to 2^24 (plenty for token ids / lengths today; revisit if a field ever
-# exceeds that).
+# to 2^24, so _pack_gen REFUSES larger int fields: a silently rounded
+# eos_token_id would make followers stop on a different token than the
+# driver and desync the lockstep loops with no error anywhere.
 _GEN_FIELDS = tuple(f.name for f in dataclasses.fields(GenerationConfig))
+
+#: largest int exactly representable in float32 (the broadcast dtype)
+_F32_EXACT_INT_MAX = 2 ** 24
 
 
 def _pack_gen(gen: GenerationConfig) -> np.ndarray:
     vals = []
-    for name in _GEN_FIELDS:
+    for name, f in zip(_GEN_FIELDS, dataclasses.fields(GenerationConfig)):
         v = getattr(gen, name)
+        if v is not None and f.type not in ("float", float):
+            iv = int(v)
+            if abs(iv) > _F32_EXACT_INT_MAX:
+                raise ValueError(
+                    f"GenerationConfig.{name}={iv} exceeds 2^24 and would "
+                    "lose precision in the float32 lockstep broadcast — "
+                    "followers would decode a different config than the "
+                    "driver"
+                )
         vals.append(-1.0 if v is None else float(v))
     return np.asarray(vals, np.float64)
 

@@ -40,6 +40,27 @@ from .modeling import _block_step, _proj, _project_kv, _rms
 from .moe_modeling import moe_expert_counts, moe_ffn
 
 
+def constrain_cache(kv: PagedKVCache) -> PagedKVCache:
+    """Re-assert the GSPMD tp layout of the page pool (and, for int8
+    pools, its scale tensors) on a megastep loop carry: pool
+    ``[L, n_blocks, Hkv, bs, D]`` shards kv heads, scales
+    ``[L, n_blocks, Hkv]`` shard the SAME dim. Annotating the carry once
+    per iteration keeps XLA from resharding the donated pool mid-loop —
+    the GSPMD idiom (annotate the loop state, let propagation do the
+    rest) instead of hand-written per-feature tp paths. A no-op without
+    an ambient mesh (``tensor.sharding.use_mesh``)."""
+    from colossalai_tpu.tensor.sharding import constrain
+
+    return PagedKVCache(
+        k=constrain(kv.k, None, None, "tp", None, None),
+        v=constrain(kv.v, None, None, "tp", None, None),
+        k_scale=(None if kv.k_scale is None
+                 else constrain(kv.k_scale, None, None, "tp")),
+        v_scale=(None if kv.v_scale is None
+                 else constrain(kv.v_scale, None, None, "tp")),
+    )
+
+
 def _logits_head(p, cfg: LlamaConfig, x) -> jax.Array:
     """Final norm + lm head over hidden states x [B, S, H] → [B, S, V]."""
     x = _rms(x, p["norm"]["scale"], cfg.rms_norm_eps)
@@ -496,14 +517,15 @@ def verify_paged(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "k_steps", "use_kernel", "use_sampling", "moe_fused"),
+    static_argnames=("cfg", "k_steps", "use_kernel", "use_sampling", "moe_fused",
+                     "tp_shard"),
     donate_argnames=("cache",),
 )
 def decode_megastep(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
     k_steps: int, use_kernel: bool = False, use_sampling: bool = False,
-    moe_fused: bool = False,
+    moe_fused: bool = False, tp_shard: bool = False,
 ):
     """Device-resident decode loop: ``k_steps`` iterations of
     forward→sample→commit inside one ``lax.fori_loop`` — ONE dispatch and
@@ -528,6 +550,12 @@ def decode_megastep(
     trees append an eighth element: ``expert_counts [num_experts]`` int32,
     tokens-per-expert summed over the K iterations, layers, and active
     slots (``moe_fused`` picks the fused vs reference expert path).
+
+    ``tp_shard=True`` (a static flag — the engine sets it when it holds a
+    GSPMD tp mesh) applies :func:`constrain_cache` to the loop carry each
+    iteration so the donated pool (and its int8 scales) keep their tp
+    layout; the flag also keys the trace cache, so a meshed and a
+    mesh-free engine in one process never share a trace.
     """
     p = params["params"] if "params" in params else params
     has_moe = "moe" in p["layers"]["block"] and getattr(cfg, "num_experts", 0) > 0
@@ -542,14 +570,14 @@ def decode_megastep(
     return megastep_loop(
         decode_once, tokens, lengths, cache, active, budgets, eos_ids,
         temp, topk, topp, do_sample, rng_keys, k_steps, use_sampling,
-        n_experts=n_experts,
+        n_experts=n_experts, tp_shard=tp_shard,
     )
 
 
 def megastep_loop(
     decode_once, tokens, lengths, cache: PagedKVCache, active, budgets,
     eos_ids, temp, topk, topp, do_sample, rng_keys, k_steps: int,
-    use_sampling: bool, n_experts: int = 0,
+    use_sampling: bool, n_experts: int = 0, tp_shard: bool = False,
 ):
     """The megastep's per-iteration bookkeeping (buffer commit, length/
     budget advance, eos/done flags) around any single-iteration decode —
@@ -573,6 +601,8 @@ def megastep_loop(
         # iteration into forward vs sample/commit time
         with jax.named_scope("decode_iter"):
             logits, kv, step_counts = decode_once(tok, lens, kv, alive)
+        if tp_shard:
+            kv = constrain_cache(kv)
         if n_experts:
             counts = counts + step_counts
         if use_sampling:

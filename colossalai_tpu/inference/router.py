@@ -1,0 +1,430 @@
+"""Cache-aware multi-replica front door for the paged engine.
+
+≙ reference ``inference/executor/rpc_worker.py``'s deployment half: one
+request-facing process fronting N model replicas. Here a replica is an
+in-process :class:`~.engine.LLMEngine` handle (each may itself span a tp
+mesh — mesh-complete megasteps make ``draft_len > 0`` and
+``kv_dtype='int8'`` legal under tp — or be the process-0 side of a
+``multiprocess.MultiProcessFrontend`` lockstep group), and the router is
+the single front door that decides WHICH replica serves each request:
+
+- **cache-aware placement** (default): probe every replica's prefix
+  cache with :meth:`~.prefix_cache.PrefixCache.peek` — a read-only walk
+  that neither pins nor LRU-touches — and place the request on the
+  replica holding the longest cached prefix. Requests sharing a system
+  prompt converge on the replica that already holds its pages, so the
+  prefill-skip compounds instead of every replica re-computing the same
+  prefix (the same machinery as the engine's ``cache_aware`` admission
+  policy, lifted one level up);
+- **least-loaded fallback**: no cache hit anywhere (or
+  ``policy="least_loaded"``) places on the replica with the fewest
+  queued + prefilling + running requests; ties rotate round-robin.
+  ``policy="round_robin"`` ignores load entirely (the bench's baseline);
+- **per-replica health/draining**: :meth:`drain` excludes a replica from
+  placement while it keeps stepping its in-flight work dry (rolling
+  restarts / elastic downscale); :meth:`replica_health` reports each
+  replica's queues, pool headroom, and terminal counters;
+- **merged observability**: :meth:`merged_stats` sums every
+  ``EngineStats`` counter across replicas (rates are re-derived from the
+  summed numerators/denominators, never averaged), and
+  :meth:`merged_histograms` folds the per-replica latency histograms
+  through :meth:`~colossalai_tpu.telemetry.core.Histogram.merge` — so the
+  router's ``GET /metrics`` (:func:`make_router_server`) is one scrape
+  target whose ``_count`` equals the sum over replicas.
+
+Request ids are globally unique WITHOUT a translation table: the router
+re-seeds each fresh replica's id counter to ``count(i, n_replicas)``, so
+replica ``i`` only ever mints ids ≡ i (mod n) and ``rid % n_replicas``
+IS the owning replica — abort/streaming lookups are O(1) and the ids a
+replica hands back (including grouped-sampling member lists) need no
+rewriting.
+
+``step()`` advances every busy replica; with ``parallel_step=True`` (the
+default) each busy replica steps on its own worker thread — the host
+scheduler work is per-replica Python, but the megastep device time
+dominates and JAX releases the GIL while blocked on device results, so N
+replicas decode concurrently (pass ``devices=`` to pin each replica's
+dispatch to its own XLA device; on CPU pair it with
+``--xla_force_host_platform_device_count=N``). Routing itself is
+host-side arithmetic over host-side bookkeeping: it moves NOTHING across
+the host↔device boundary, so the per-token transfer counters of an
+engine behind the router are byte-identical to the same engine driven
+directly (pinned by ``tests/test_inference/test_router.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Union
+
+from colossalai_tpu.telemetry.core import Histogram, prometheus_exposition
+
+from .engine import GenerationConfig, LLMEngine, Request
+
+#: placement policies — ``cache_aware`` degrades to ``least_loaded`` on a
+#: cold cache, which degrades to round-robin when loads tie
+ROUTER_POLICIES = ("cache_aware", "least_loaded", "round_robin")
+
+
+class Router:
+    """Front N engine replicas behind one engine-shaped surface.
+
+    The request surface (``add_request`` / ``step`` / ``has_work`` /
+    ``abort`` / ``running`` / ``generate``) duck-types
+    :class:`~.engine.LLMEngine`, so ``server._Scheduler`` — and any other
+    engine driver — runs unmodified on top of a router.
+
+    Replicas must be FRESH (nothing submitted yet): the router re-seeds
+    their id counters for the ``rid % n`` ownership contract.
+    """
+
+    def __init__(
+        self,
+        engines: Sequence[LLMEngine],
+        policy: str = "cache_aware",
+        parallel_step: bool = True,
+        devices: Optional[Sequence] = None,
+    ):
+        if not engines:
+            raise ValueError("Router needs at least one engine replica")
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"policy={policy!r}: pass one of {ROUTER_POLICIES}"
+            )
+        if policy == "cache_aware":
+            missing = [i for i, e in enumerate(engines)
+                       if e.prefix_cache is None]
+            if missing:
+                raise ValueError(
+                    f"policy='cache_aware' probes each replica's prefix "
+                    f"cache but replicas {missing} were built without "
+                    "prefix_cache=True — enable it or pick "
+                    "'least_loaded'/'round_robin'"
+                )
+        for i, e in enumerate(engines):
+            if e.stats.requests_submitted or e.has_work:
+                raise ValueError(
+                    f"replica {i} already served requests — the router "
+                    "re-seeds replica id counters (rid % n ownership) and "
+                    "can only front fresh engines"
+                )
+        if devices is not None and len(devices) != len(engines):
+            raise ValueError(
+                f"devices has {len(devices)} entries for "
+                f"{len(engines)} replicas — pass one device per replica"
+            )
+        self.engines = list(engines)
+        n = len(self.engines)
+        for i, e in enumerate(self.engines):
+            # replica i mints ids i, i+n, i+2n, ... — globally unique and
+            # self-describing (rid % n == i)
+            e._ids = itertools.count(i, n)
+        self.policy = policy
+        self._devices = list(devices) if devices is not None else None
+        self._draining = [False] * n
+        self._rr = 0
+        self._pool = (
+            ThreadPoolExecutor(max_workers=n, thread_name_prefix="router-step")
+            if parallel_step and n > 1 else None
+        )
+        # ---- router-level counters (host-side ints; /metrics renders them
+        # as clt_router_* counter families — linted in test_metric_names)
+        self.requests_routed = 0
+        self.cache_hit_placements = 0
+        self.least_loaded_placements = 0
+        self.round_robin_placements = 0
+        self.replica_drains = 0
+
+    # ------------------------------------------------------------- placement
+    @property
+    def n_replicas(self) -> int:
+        return len(self.engines)
+
+    def replica_of(self, request_id: int) -> int:
+        """Owning replica of a request id — pure arithmetic, no table."""
+        return request_id % len(self.engines)
+
+    def _load(self, i: int) -> int:
+        e = self.engines[i]
+        return len(e.waiting) + len(e.prefilling) + len(e.running)
+
+    def _pick_balanced(self, candidates: List[int]) -> int:
+        """Least-loaded among ``candidates``; ties rotate round-robin so a
+        burst of identical requests still spreads."""
+        loads = [self._load(i) for i in candidates]
+        lo = min(loads)
+        tied = [i for i, l in zip(candidates, loads) if l == lo]
+        pick = tied[self._rr % len(tied)]
+        self._rr += 1
+        return pick
+
+    def _place(self, prompt_ids: List[int]) -> int:
+        eligible = [i for i in range(len(self.engines))
+                    if not self._draining[i]]
+        if not eligible:
+            raise RuntimeError(
+                "every replica is draining — undrain one before routing "
+                "new requests"
+            )
+        if self.policy == "round_robin":
+            pick = eligible[self._rr % len(eligible)]
+            self._rr += 1
+            self.round_robin_placements += 1
+            return pick
+        if self.policy == "cache_aware":
+            hits = [self.engines[i].prefix_cache.peek(prompt_ids)
+                    for i in eligible]
+            best = max(hits)
+            if best > 0:
+                self.cache_hit_placements += 1
+                return self._pick_balanced(
+                    [i for i, h in zip(eligible, hits) if h == best])
+        self.least_loaded_placements += 1
+        return self._pick_balanced(eligible)
+
+    # -------------------------------------------------------- engine surface
+    def add_request(
+        self, prompt_ids, gen: Optional[GenerationConfig] = None,
+        n_samples: int = 1, priority: int = 0,
+    ) -> Union[int, List[int]]:
+        """Route one prompt (or one grouped-sampling request — a group
+        lands whole on one replica, same as one engine requires) and
+        return the replica's request id(s), already globally unique."""
+        prompt_ids = list(map(int, prompt_ids))
+        i = self._place(prompt_ids)
+        self.requests_routed += n_samples
+        return self.engines[i].add_request(
+            prompt_ids, gen, n_samples=n_samples, priority=priority)
+
+    def abort(self, request_id: int) -> bool:
+        return self.engines[self.replica_of(request_id)].abort(request_id)
+
+    @property
+    def has_work(self) -> bool:
+        return any(e.has_work for e in self.engines)
+
+    @property
+    def running(self) -> Dict:
+        """Merged slot→Request view over all replicas (keys are
+        ``(replica, slot)`` — stream pushers only read the values)."""
+        return {(i, s): r for i, e in enumerate(self.engines)
+                for s, r in e.running.items()}
+
+    def _step_one(self, i: int) -> List[Request]:
+        if self._devices is not None:
+            import jax
+
+            with jax.default_device(self._devices[i]):
+                return self.engines[i].step()
+        return self.engines[i].step()
+
+    def step(self) -> List[Request]:
+        """One tick of every busy replica; returns all finished requests.
+        Busy replicas step CONCURRENTLY on worker threads (unless
+        ``parallel_step=False``): the megasteps overlap on device while
+        each replica's host scheduler runs its own slice of Python."""
+        busy = [i for i, e in enumerate(self.engines) if e.has_work]
+        if not busy:
+            return []
+        finished: List[Request] = []
+        if self._pool is not None and len(busy) > 1:
+            for fut in [self._pool.submit(self._step_one, i) for i in busy]:
+                finished.extend(fut.result())
+        else:
+            for i in busy:
+                finished.extend(self._step_one(i))
+        return finished
+
+    def generate(self, prompts, gen: Optional[GenerationConfig] = None):
+        """Blocking batch convenience, same contract as
+        :meth:`LLMEngine.generate`."""
+        order = [self.add_request(p, gen) for p in prompts]
+        done: Dict[int, Request] = {}
+        while self.has_work:
+            for req in self.step():
+                done[req.request_id] = req
+        return [done[rid].output_ids for rid in order]
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+
+    # ------------------------------------------------------ health / draining
+    def drain(self, i: int) -> None:
+        """Take replica ``i`` out of placement. It keeps stepping — its
+        queued/running requests finish normally — it just receives no new
+        ones (rolling restart / downscale)."""
+        self.engines[i]  # index check
+        if not self._draining[i]:
+            self._draining[i] = True
+            self.replica_drains += 1
+
+    def undrain(self, i: int) -> None:
+        self.engines[i]
+        self._draining[i] = False
+
+    def draining(self, i: int) -> bool:
+        return self._draining[i]
+
+    def replica_health(self) -> List[Dict]:
+        """Per-replica point-in-time health: queues, pool headroom,
+        terminal counters, drain state. ``idle & not draining`` is the
+        ready signal a balancer would scrape."""
+        out = []
+        for i, e in enumerate(self.engines):
+            out.append({
+                "replica": i,
+                "draining": self._draining[i],
+                "running": len(e.running),
+                "waiting": len(e.waiting),
+                "prefilling": len(e.prefilling),
+                "free_blocks": e.allocator.num_free,
+                "requests_submitted": e.stats.requests_submitted,
+                "requests_completed": e.stats.requests_completed,
+                "requests_aborted": e.stats.requests_aborted,
+            })
+        return out
+
+    # -------------------------------------------------------- merged metrics
+    def router_counters(self) -> Dict[str, int]:
+        """The router's own counters (placements by reason, drains)."""
+        return {
+            "router_requests_routed": self.requests_routed,
+            "router_cache_hit_placements": self.cache_hit_placements,
+            "router_least_loaded_placements": self.least_loaded_placements,
+            "router_round_robin_placements": self.round_robin_placements,
+            "router_replica_drains": self.replica_drains,
+        }
+
+    def merged_stats(self) -> Dict[str, float]:
+        """Every ``EngineStats`` counter summed across replicas. Derived
+        RATES are re-computed from the summed counters — a mean of
+        per-replica acceptance rates would weight an idle replica equal
+        to a loaded one."""
+        merged: Dict[str, float] = {}
+        for e in self.engines:
+            for k, v in e.stats.as_dict().items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                merged[k] = merged.get(k, 0) + v
+        merged["spec_acceptance_rate"] = (
+            merged.get("spec_accepted_tokens", 0)
+            / max(merged.get("spec_draft_tokens", 0), 1)
+        )
+        return merged
+
+    def merged_histograms(self) -> Dict[str, Histogram]:
+        """Per-name fold of every replica's latency histograms through
+        :meth:`Histogram.merge` (the specs — and so the bounds — are
+        identical across replicas); built fresh per call so a scrape
+        never mutates replica state. ``_count`` of each merged family
+        equals the sum of the per-replica counts."""
+        merged: Dict[str, Histogram] = {}
+        for e in self.engines:
+            for name, h in e.telemetry.histograms.items():
+                if name not in merged:
+                    merged[name] = Histogram(h.bounds)
+                merged[name].merge(h)
+        return merged
+
+    def occupancy(self) -> Dict[str, int]:
+        """Router-wide scheduler/pool gauges (the non-counter half of
+        /health and /metrics)."""
+        return {
+            "running": sum(len(e.running) for e in self.engines),
+            "waiting": sum(len(e.waiting) for e in self.engines),
+            "prefilling": sum(len(e.prefilling) for e in self.engines),
+            "free_blocks": sum(e.allocator.num_free for e in self.engines),
+            "router_replicas": len(self.engines),
+            "router_replicas_draining": sum(self._draining),
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the merged view: summed engine
+        counters + router placement counters as ``clt_*`` counters,
+        occupancy and rate/footprint gauges, merged histograms."""
+        counters = self.merged_stats()
+        counters.update(self.router_counters())
+        gauges = self.occupancy()
+        # same counter→gauge splits as the single-engine /metrics: a rate
+        # can go down, the pool footprint is static, blocks-in-use shrinks
+        gauges["spec_acceptance_rate"] = counters.pop("spec_acceptance_rate")
+        gauges["kv_pool_bytes"] = counters.pop("kv_pool_bytes", 0)
+        gauges["kv_blocks_in_use"] = counters.pop("kv_blocks_in_use", 0)
+        return prometheus_exposition(counters, gauges,
+                                     self.merged_histograms())
+
+
+def make_router_server(router: Router, host: str = "127.0.0.1",
+                       port: int = 8000, request_timeout: float = 300.0,
+                       tokenizer=None, detokenizer=None):
+    """HTTP front door over a :class:`Router` — the multi-replica
+    counterpart of :func:`~.server.make_server`, running the SAME
+    scheduler thread (the router duck-types the engine surface it
+    drains). Returns ``(ThreadingHTTPServer, scheduler)``.
+
+    Endpoints: ``POST /generate`` (ids or text, SSE streaming included)
+    and ``POST /abort`` exactly as the single-engine server;
+    ``GET /health`` adds the per-replica health list and drain states;
+    ``GET /metrics`` serves the MERGED exposition
+    (:meth:`Router.metrics_text` — one scrape target, ``_count`` = sum
+    over replicas); ``POST /drain`` ``{"replica": i, "drain": bool}``
+    toggles placement eligibility for rolling restarts."""
+    import json
+
+    from .server import make_server
+
+    server, sched = make_server(
+        router, host=host, port=port, request_timeout=request_timeout,
+        tokenizer=tokenizer, detokenizer=detokenizer,
+    )
+    base_handler = server.RequestHandlerClass
+
+    class RouterHandler(base_handler):
+        def do_GET(self):
+            if self.path == "/health":
+                with sched.lock:
+                    payload = {
+                        "status": "ok",
+                        "router_policy": router.policy,
+                        "replicas": router.replica_health(),
+                        **router.occupancy(),
+                        **router.merged_stats(),
+                        **router.router_counters(),
+                    }
+                self._json(200, payload)
+            elif self.path == "/metrics":
+                with sched.lock:
+                    body = router.metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path == "/drain":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    i = int(req["replica"])
+                    if not 0 <= i < router.n_replicas:
+                        self._json(400, {"error": f"no replica {i}"})
+                        return
+                    if bool(req.get("drain", True)):
+                        router.drain(i)
+                    else:
+                        router.undrain(i)
+                    self._json(200, {"replica": i,
+                                     "draining": router.draining(i)})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            base_handler.do_POST(self)
+
+    server.RequestHandlerClass = RouterHandler
+    return server, sched

@@ -45,7 +45,7 @@ import numpy as np
 
 from .kv_cache import PagedKVCache
 from .modeling import KVCache, decode_step, extend_step, init_cache, prefill
-from .paged_modeling import _extend_once, filter_logits
+from .paged_modeling import _extend_once, constrain_cache, filter_logits
 
 
 @dataclasses.dataclass
@@ -204,6 +204,7 @@ def spec_megastep_loop(
     target_extend, draft_extend, tokens, lengths, cache: PagedKVCache,
     draft_cache: PagedKVCache, active, budgets, eos_ids, temp, topk, topp,
     do_sample, rng_keys, k_steps: int, draft_len: int, use_sampling: bool,
+    tp_shard: bool = False,
 ):
     """The speculative megastep's per-iteration bookkeeping around a pair
     of extend callables (must be called under jit; traces a fori_loop):
@@ -234,7 +235,13 @@ def spec_megastep_loop(
     nothing), emitted [S], alive [S], tokens, lengths, budgets, cache,
     draft_cache, target_passes [S], drafted [S], accepted [S])`` — the
     last three are per-slot speculative counters accumulated on device and
-    fetched in the megastep's single host sync."""
+    fetched in the megastep's single host sync.
+
+    ``tp_shard=True`` re-asserts the GSPMD tp layout on BOTH donated loop
+    carries each iteration (:func:`~.paged_modeling.constrain_cache` over
+    the target and draft pools, int8 scales included) — the annotation
+    that lets speculative decoding run under a tp mesh without a
+    hand-written parallel path."""
     n_slots = tokens.shape[0]
     d = draft_len
     w = d + 1
@@ -360,6 +367,9 @@ def spec_megastep_loop(
         budg = budg - e
         stopped = eos_idx < e  # an emitted token was eos
         alive = alive & ~stopped & (budg > 0)
+        if tp_shard:
+            t_kv = constrain_cache(t_kv)
+            d_kv = constrain_cache(d_kv)
         return (t_kv, d_kv, tok, lens, alive, budg, buf, emitted,
                 passes, drafted, accepted)
 
@@ -374,7 +384,7 @@ def spec_megastep_loop(
 @partial(
     jax.jit,
     static_argnames=("cfg", "draft_cfg", "k_steps", "draft_len",
-                     "use_kernel", "use_sampling"),
+                     "use_kernel", "use_sampling", "tp_shard"),
     donate_argnames=("cache", "draft_cache"),
 )
 def decode_spec_megastep(
@@ -382,6 +392,7 @@ def decode_spec_megastep(
     cache: PagedKVCache, draft_cache: PagedKVCache, active, budgets, eos_ids,
     temp, topk, topp, do_sample, rng_keys, k_steps: int, draft_len: int,
     use_kernel: bool = False, use_sampling: bool = False,
+    tp_shard: bool = False,
 ):
     """Device-resident SPECULATIVE decode megastep over the paged pool —
     ``decode_megastep`` with a draft/verify inner loop: per iteration the
@@ -409,5 +420,5 @@ def decode_spec_megastep(
     return spec_megastep_loop(
         target_extend, draft_extend, tokens, lengths, cache, draft_cache,
         active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
-        k_steps, draft_len, use_sampling,
+        k_steps, draft_len, use_sampling, tp_shard=tp_shard,
     )

@@ -125,17 +125,29 @@ def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype,
 
     if not tuning.tuning_enabled():
         return hkv
+    # tp degree of the ambient mesh (the engine installs it around its
+    # megastep dispatch): a tp-sharded pool streams hkv/tp heads per
+    # shard, so the measured winner must be keyed — and its candidates
+    # sized — for the per-shard geometry, not the full pool's
+    from colossalai_tpu.tensor.sharding import current_mesh
+
+    mesh = current_mesh()
+    tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
     pool_dtype = pool_dtype if pool_dtype is not None else dtype
     quantized = jnp.dtype(pool_dtype) == jnp.dtype(jnp.int8)
+
+    # benchmark the PER-SHARD geometry: under tp each device streams
+    # hkv/tp heads of the pool, so that is the shape the winner runs at
+    hkv_l = max(hkv // max(tp, 1), 1)
 
     def measure(hps):
         n_slots = 8
         if qlen > 1:
-            q = jnp.zeros((n_slots, qlen, hkv * group, d), dtype)
+            q = jnp.zeros((n_slots, qlen, hkv_l * group, d), dtype)
         else:
-            q = jnp.zeros((n_slots, hkv * group, d), dtype)
-        pool = jnp.zeros((max_blocks, hkv, block_size, d), pool_dtype)
-        sc = jnp.ones((max_blocks, hkv), jnp.float32) if quantized else None
+            q = jnp.zeros((n_slots, hkv_l * group, d), dtype)
+        pool = jnp.zeros((max_blocks, hkv_l, block_size, d), pool_dtype)
+        sc = jnp.ones((max_blocks, hkv_l), jnp.float32) if quantized else None
         bt = jnp.broadcast_to(
             jnp.arange(max_blocks, dtype=jnp.int32)[None], (n_slots, max_blocks))
         ln = jnp.full((n_slots,), max_blocks * block_size - (qlen - 1), jnp.int32)
@@ -146,7 +158,7 @@ def _tuned_heads_per_step(hkv, group, d, block_size, max_blocks, dtype,
     try:
         return tuning.paged_heads_per_step(
             hkv, group, d, block_size, dtype, measure, qlen=qlen,
-            pool_dtype=pool_dtype)
+            pool_dtype=pool_dtype, tp=tp)
     except Exception:  # never let tuning break the hot path
         return hkv
 

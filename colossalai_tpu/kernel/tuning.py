@@ -293,6 +293,7 @@ def norm_rows(
 def paged_heads_per_step(
     hkv: int, group: int, d: int, block_size: int, dtype,
     measure: Callable[[int], float], qlen: int = 1, pool_dtype=None,
+    tp: int = 1,
 ) -> int:
     """KV-heads processed per grid step in the paged decode kernel: all
     heads (fewest grid steps, current default) vs smaller groups (smaller
@@ -302,17 +303,27 @@ def paged_heads_per_step(
     scales with it. ``pool_dtype`` is the PAGE dtype (int8 for quantized
     pools, else the compute dtype): an int8 page tile halves the per-step
     HBM traffic and VMEM footprint, so the profitable split differs from
-    bf16 at the same geometry and the two must not share a cache entry."""
-    cands = sorted({h for h in (hkv, max(hkv // 2, 1), 1) if hkv % h == 0},
-                   reverse=True)
+    bf16 at the same geometry and the two must not share a cache entry.
+    ``tp`` is the tensor-parallel degree of the ambient mesh: under GSPMD
+    each shard streams ``hkv / tp`` heads, so a measurement taken at tp=1
+    must not decide the tiling for the per-shard geometry (and vice
+    versa) — the degree is part of the cache key. The candidate split
+    must divide the PER-SHARD head count, or a winner chosen on the full
+    pool would be illegal inside a shard."""
+    tp = max(int(tp), 1)
+    hkv_local = max(hkv // tp, 1)
+    cands = sorted(
+        {h for h in (hkv_local, max(hkv_local // 2, 1), 1)
+         if hkv_local % h == 0},
+        reverse=True)
     if len(cands) == 1:
-        return hkv
+        return hkv_local
     pool_dtype = pool_dtype if pool_dtype is not None else dtype
     return get_tuner().tune(
         "paged_attention",
         (device_kind(), hkv, group, d, block_size, _dt(dtype), qlen,
-         _dt(pool_dtype)),
-        cands, measure, hkv,
+         _dt(pool_dtype), tp),
+        cands, measure, hkv_local,
     )
 
 

@@ -1,9 +1,10 @@
-"""Metric-name lint: every name either Prometheus renderer (serving
-``clt_*``, training ``clt_train_*``) emits must match the Prometheus
-grammar, and the two catalogs must never collide — both sides land in the
-same scrape target."""
+"""Metric-name lint: every name any Prometheus renderer (serving
+``clt_*``, router ``clt_router_*``, training ``clt_train_*``) emits must
+match the Prometheus grammar, and the catalogs must never collide — all
+sides land in the same scrape target."""
 
 import math
+from types import SimpleNamespace
 
 from colossalai_tpu.inference.engine import EngineStats
 from colossalai_tpu.inference.telemetry import _HISTOGRAM_SPECS, Telemetry
@@ -32,6 +33,32 @@ def _serving_names():
     return _family_names(
         prometheus_exposition(counters, {}, tele.histograms, prefix="clt")
     )
+
+
+def _router_names():
+    """The multi-replica catalog: ``Router.metrics_text()`` rendered over
+    a stub replica — no model is built, the router only reads the
+    bookkeeping surface (stats / telemetry / queues / allocator), which is
+    exactly what makes this a pure name lint."""
+    from colossalai_tpu.inference.router import Router
+
+    class _StubEngine:
+        has_work = False
+        prefix_cache = None
+
+        def __init__(self):
+            self.stats = EngineStats()
+            self.telemetry = Telemetry()
+            self.waiting = []
+            self.prefilling = {}
+            self.running = {}
+            self.allocator = SimpleNamespace(num_free=0)
+
+    router = Router([_StubEngine(), _StubEngine()], policy="least_loaded")
+    try:
+        return _family_names(router.metrics_text())
+    finally:
+        router.close()
 
 
 def _training_names():
@@ -65,8 +92,24 @@ def test_training_names_match_grammar():
             "clt_train_mfu", "clt_train_phase_data_seconds"} <= names
 
 
+def test_router_names_match_grammar():
+    names = _router_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+    # the router's own counter/gauge families
+    assert {"clt_router_requests_routed", "clt_router_cache_hit_placements",
+            "clt_router_least_loaded_placements",
+            "clt_router_round_robin_placements", "clt_router_replica_drains",
+            "clt_router_replicas", "clt_router_replicas_draining"} <= names
+    # the merged view keeps every single-engine family name, so one
+    # dashboard reads a bare engine and a router interchangeably
+    assert _serving_names() <= names
+
+
 def test_serving_and_training_catalogs_disjoint():
     overlap = _serving_names() & _training_names()
+    assert not overlap, f"metric-name collision between renderers: {overlap}"
+    overlap = _router_names() & _training_names()
     assert not overlap, f"metric-name collision between renderers: {overlap}"
 
 

@@ -163,7 +163,10 @@ def test_engine_kv_dtype_validation(parts):
         _engine(parts, kv_dtype="fp8")
     from jax.sharding import Mesh
 
-    mesh = Mesh(np.array(jax.devices()[:1]), ("pp",))
+    # mesh-complete means TP-complete: a tp mesh now composes with int8
+    # (GSPMD shards the scales), but the pp relay still carries no scale
+    # tensors — only a REAL pp axis (> 1 stage) rejects
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
     with pytest.raises(NotImplementedError, match="int8"):
         _engine(parts, kv_dtype="int8", mesh=mesh)
 
@@ -266,3 +269,45 @@ def test_kv_pool_gauges(parts):
     assert st_q.kv_blocks_in_use == 0  # released pages leave the gauge
     assert st_q.kv_pool_bytes == eng_q._kv_pool_nbytes  # static footprint
     assert rid is not None
+
+
+# ------------------------------------------------- GSPMD tp-mesh composition
+def test_int8_tp_mesh_matches_mesh_free(parts, int8_greedy):
+    """Quantized pages under a 2-device tp mesh: pool AND scale tensors
+    shard on the kv-head axis (the scales via the constrained append), and
+    greedy output is bit-identical to the mesh-free int8 engine. A bf16
+    mesh engine rides along to pin the int8-vs-bf16 agreement rate under
+    tp — the same >= 95% tolerance as the mesh-free gate."""
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a tp mesh")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    gen = GenerationConfig(max_new_tokens=12)
+    out = _engine(parts, kv_dtype="int8", mesh=mesh).generate(
+        [list(p) for p in PROMPTS], gen)
+    assert out == int8_greedy
+
+    ref = _engine(parts, mesh=mesh).generate([list(p) for p in PROMPTS], gen)
+    total = sum(len(a) for a in ref)
+    agree = sum(int(x == y) for a, b in zip(ref, out)
+                for x, y in zip(a, b))
+    assert agree / total >= 0.95, (agree, total, ref, out)
+
+
+def test_int8_spec_tp_mesh_matches_mesh_free(parts):
+    """The full composition the guards used to reject: int8 pages +
+    speculative megasteps + tp mesh, token-identical to the same engine
+    without the mesh."""
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a tp mesh")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    gen = GenerationConfig(max_new_tokens=12)
+    kw = dict(kv_dtype="int8", draft_len=2, self_draft_layers=1,
+              megastep_k=2)
+    ref = _engine(parts, **kw).generate([list(p) for p in PROMPTS], gen)
+    out = _engine(parts, mesh=mesh, **kw).generate(
+        [list(p) for p in PROMPTS], gen)
+    assert out == ref

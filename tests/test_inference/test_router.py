@@ -1,0 +1,367 @@
+"""Multi-replica front door (inference/router.py).
+
+Contracts under test:
+
+- routing is TRANSPARENT: a router's outputs are token-identical to one
+  engine serving the same workload, and a single-replica router leaves
+  the engine's per-token transfer counters byte-identical to driving the
+  engine directly (routing adds ZERO device traffic);
+- the ``rid % n_replicas`` ownership contract: ids are globally unique,
+  self-describing, and abort routes without a translation table;
+- cache-aware placement converges shared-prefix requests onto the
+  replica holding the pages — and saves strictly more prefill work than
+  round-robin on the same workload (placement quality asserted through
+  the engines' prefix counters, not wall clock, so CI stays stable);
+- least-loaded fallback and drain semantics;
+- merged observability: summed stats, re-derived rates, and merged
+  histograms whose ``_count`` equals the sum of per-replica counts — and
+  whose construction never mutates replica state;
+- the HTTP front door (``make_router_server``): /generate unchanged,
+  /health grows the replica list, /metrics serves the merged exposition,
+  /drain toggles placement eligibility.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from colossalai_tpu.inference import (
+    ROUTER_POLICIES,
+    GenerationConfig,
+    LLMEngine,
+    Router,
+    make_router_server,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("prefill_buckets", (16, 32, 64))
+    return LLMEngine(params, cfg, **kw)
+
+
+GEN = GenerationConfig(max_new_tokens=8)
+PROMPTS = [[3, 14, 15, 9, 2, 6], list(range(40, 59)), [5] * 33, [7, 8, 9]]
+
+# two full blocks of shared system prompt + per-request suffixes: the
+# cache-aware placement workload
+SYS = list(range(100, 132))
+
+
+def _drain(router):
+    while router.has_work:
+        router.step()
+
+
+# ------------------------------------------------------------ transparency
+def test_output_identity_vs_single_engine(parts):
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)])
+    try:
+        assert router.generate([list(p) for p in PROMPTS], GEN) == ref
+    finally:
+        router.close()
+
+
+def test_single_replica_router_adds_zero_device_traffic(parts):
+    """The transfer-counter gate extended to the router path: fronting an
+    engine must leave decode_syncs / h2d scalars / d2h elements / megastep
+    counts byte-identical — routing is host arithmetic only."""
+    bare = _engine(parts, megastep_k=2)
+    outs_bare = bare.generate([list(p) for p in PROMPTS], GEN)
+
+    routed_eng = _engine(parts, megastep_k=2)
+    router = Router([routed_eng], policy="least_loaded", parallel_step=False)
+    try:
+        outs_routed = router.generate([list(p) for p in PROMPTS], GEN)
+    finally:
+        router.close()
+
+    assert outs_routed == outs_bare
+    for f in ("decode_syncs", "decode_h2d_scalars", "decode_d2h_elements",
+              "decode_megasteps", "decode_tokens", "prefill_chunks"):
+        assert getattr(routed_eng.stats, f) == getattr(bare.stats, f), f
+
+
+# ------------------------------------------------------------ id ownership
+def test_rid_ownership_and_abort(parts):
+    router = Router([_engine(parts, prefix_cache=True) for _ in range(3)])
+    try:
+        rids = [router.add_request(list(p), GEN) for p in PROMPTS]
+        assert len(set(rids)) == len(rids)  # globally unique
+        for rid in rids:
+            i = router.replica_of(rid)
+            assert rid % router.n_replicas == i
+        # abort routes by arithmetic: the owning replica loses the work
+        victim = rids[0]
+        assert router.abort(victim)
+        assert router.engines[router.replica_of(victim)].stats.requests_aborted == 1
+        _drain(router)
+        ms = router.merged_stats()
+        assert ms["requests_completed"] + ms["requests_aborted"] == len(rids)
+    finally:
+        router.close()
+
+
+def test_grouped_sampling_lands_whole_on_one_replica(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)])
+    try:
+        gen = GenerationConfig(max_new_tokens=4, do_sample=True, top_k=8)
+        rids = router.add_request([1, 2, 3], gen, n_samples=3)
+        assert len(rids) == 3
+        assert len({router.replica_of(r) for r in rids}) == 1
+        assert router.requests_routed == 3  # counts group members
+        _drain(router)
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------- placement
+def _shared_prefix_workload(router, n_requests=6):
+    """Submit shared-prefix requests one at a time, draining between them
+    so every finished request donates its pages before the next placement
+    decision. Returns the placements in order."""
+    placements = []
+    for k in range(n_requests):
+        rid = router.add_request(SYS + [200 + k, 201 + k], GEN)
+        placements.append(router.replica_of(rid))
+        _drain(router)
+    return placements
+
+
+def test_cache_aware_converges_and_beats_round_robin(parts):
+    ca = Router([_engine(parts, prefix_cache=True),
+                 _engine(parts, prefix_cache=True)])
+    rr = Router([_engine(parts, prefix_cache=True),
+                 _engine(parts, prefix_cache=True)], policy="round_robin")
+    try:
+        ca_places = _shared_prefix_workload(ca)
+        rr_places = _shared_prefix_workload(rr)
+
+        # cache-aware: the first request is a cold miss, every later one
+        # follows the pages to the same replica
+        owner = ca_places[0]
+        assert all(p == owner for p in ca_places[1:]), ca_places
+        assert ca.cache_hit_placements == 5
+        assert ca.least_loaded_placements == 1  # only the cold first
+
+        # round-robin alternates regardless of where the pages live
+        assert rr_places == [0, 1, 0, 1, 0, 1]
+        assert rr.round_robin_placements == 6
+        assert rr.cache_hit_placements == 0
+
+        # ...and that costs real prefill work: round-robin pays the cold
+        # prefix once PER replica, cache-aware once total
+        ca_saved = sum(e.stats.prefix_saved_tokens for e in ca.engines)
+        rr_saved = sum(e.stats.prefix_saved_tokens for e in rr.engines)
+        assert ca_saved > rr_saved > 0, (ca_saved, rr_saved)
+    finally:
+        ca.close()
+        rr.close()
+
+
+def test_cold_cache_falls_back_to_least_loaded(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)])
+    try:
+        # nothing cached: both placements are load-balanced, and with
+        # equal (zero, then one) loads the two requests spread
+        r0 = router.add_request([1, 2, 3], GEN)
+        r1 = router.add_request([9, 8, 7], GEN)
+        assert router.replica_of(r0) != router.replica_of(r1)
+        assert router.least_loaded_placements == 2
+        assert router.cache_hit_placements == 0
+        _drain(router)
+    finally:
+        router.close()
+
+
+def test_least_loaded_prefers_idle_replica(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)],
+                    policy="least_loaded")
+    try:
+        busy = router.replica_of(router.add_request(list(range(20)), GEN))
+        # while that request is queued/in-flight, new work avoids its replica
+        rid = router.add_request([4, 5, 6], GEN)
+        assert router.replica_of(rid) != busy
+        # with loads now tied at 1/1 the next placement rotates, so a burst
+        # keeps spreading instead of pinning to one index
+        third = router.add_request([6, 5, 4], GEN)
+        fourth = router.add_request([2, 2, 2], GEN)
+        assert {router.replica_of(third), router.replica_of(fourth)} == {0, 1}
+        _drain(router)
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------------- drain
+def test_drain_excludes_replica_but_lets_it_finish(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)])
+    try:
+        inflight = router.add_request(list(range(24)), GEN)
+        victim = router.replica_of(inflight)
+        router.drain(victim)
+        assert router.draining(victim)
+        assert router.replica_drains == 1
+        router.drain(victim)  # idempotent: no double count
+        assert router.replica_drains == 1
+
+        # new work all lands on the survivor...
+        for _ in range(3):
+            rid = router.add_request([1, 2, 3], GEN)
+            assert router.replica_of(rid) != victim
+        # ...while the draining replica's in-flight request still finishes
+        _drain(router)
+        assert router.engines[victim].stats.requests_completed == 1
+
+        health = router.replica_health()
+        assert health[victim]["draining"] is True
+        assert health[1 - victim]["requests_completed"] == 3
+
+        # draining everything is a routing error, not a hang
+        router.drain(1 - victim)
+        with pytest.raises(RuntimeError, match="draining"):
+            router.add_request([1, 2, 3], GEN)
+        router.undrain(victim)
+        rid = router.add_request([1, 2, 3], GEN)
+        assert router.replica_of(rid) == victim
+        _drain(router)
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------- merged metrics
+def test_merged_stats_and_histograms_sum_over_replicas(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)],
+                    policy="least_loaded")
+    try:
+        router.generate([list(p) for p in PROMPTS], GEN)
+        # least-loaded spreads 4 requests 2/2: both replicas really served
+        assert all(e.stats.requests_completed > 0 for e in router.engines)
+
+        ms = router.merged_stats()
+        for f in ("requests_submitted", "requests_completed",
+                  "decode_tokens", "decode_syncs"):
+            assert ms[f] == sum(getattr(e.stats, f) for e in router.engines), f
+        # rates are re-derived from summed counters, never averaged
+        assert ms["spec_acceptance_rate"] == 0.0
+
+        mh = router.merged_histograms()
+        for name in ("ttft_seconds", "itl_seconds", "e2e_seconds"):
+            per_replica = [e.telemetry.histograms[name].count
+                           for e in router.engines]
+            assert all(c > 0 for c in per_replica)
+            assert mh[name].count == sum(per_replica), name
+        # a scrape builds fresh histograms: re-scraping changes nothing
+        again = router.merged_histograms()
+        assert {n: h.count for n, h in again.items()} == \
+               {n: h.count for n, h in mh.items()}
+
+        text = router.metrics_text()
+        assert "clt_router_requests_routed 4" in text
+        assert f"clt_ttft_seconds_count {mh['ttft_seconds'].count}" in text
+        assert "clt_router_replicas 2" in text
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- validation
+def test_constructor_validation(parts):
+    with pytest.raises(ValueError, match="at least one"):
+        Router([])
+    with pytest.raises(ValueError, match="one of"):
+        Router([_engine(parts)], policy="random")
+    assert "cache_aware" in ROUTER_POLICIES
+    # cache_aware needs every replica's prefix cache
+    with pytest.raises(ValueError, match="prefix_cache"):
+        Router([_engine(parts, prefix_cache=True), _engine(parts)])
+    # used engines are rejected: the rid % n contract needs fresh counters
+    used = _engine(parts)
+    used.generate([[1, 2, 3]], GenerationConfig(max_new_tokens=2))
+    with pytest.raises(ValueError, match="fresh"):
+        Router([used], policy="least_loaded")
+    # one device per replica
+    with pytest.raises(ValueError, match="devices"):
+        Router([_engine(parts)], policy="least_loaded",
+               devices=jax.devices()[:2])
+
+
+# --------------------------------------------------------- HTTP front door
+@pytest.fixture()
+def served_router(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)])
+    server, sched = make_router_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield router, base
+    server.shutdown()
+    sched.stop()
+    router.close()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_router_server_endpoints(parts, served_router):
+    router, base = served_router
+    ref = _engine(parts).generate([[1, 2, 3]], GenerationConfig(max_new_tokens=6))
+
+    # /generate is the unchanged single-engine contract
+    out = _post(base, "/generate", {"prompt_ids": [1, 2, 3],
+                                    "max_new_tokens": 6})
+    assert out["output_ids"] == ref[0]
+
+    # /health grows the per-replica view
+    with urllib.request.urlopen(f"{base}/health", timeout=30) as r:
+        health = json.loads(r.read())
+    assert health["router_policy"] == "cache_aware"
+    assert [rep["replica"] for rep in health["replicas"]] == [0, 1]
+    assert health["router_replicas"] == 2
+    assert health["requests_completed"] == 1
+
+    # /drain toggles placement eligibility
+    assert _post(base, "/drain", {"replica": 1}) == \
+           {"replica": 1, "draining": True}
+    assert router.draining(1)
+    assert _post(base, "/drain", {"replica": 1, "drain": False}) == \
+           {"replica": 1, "draining": False}
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post(base, "/drain", {"replica": 7})
+    assert excinfo.value.code == 400
+
+    # /metrics serves the merged exposition from one scrape target
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "clt_router_requests_routed 1" in text
+    assert "clt_requests_completed 1" in text
+    assert "clt_ttft_seconds_count 1" in text

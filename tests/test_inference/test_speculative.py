@@ -416,3 +416,44 @@ def test_self_draft_params_shares_leaves(f32_models):
     dr_leaf = jax.tree.leaves(d["layers"]["block"])[0]
     assert dr_leaf.shape[0] == 1 and tgt_leaf.shape[0] == tc.num_hidden_layers
     np.testing.assert_array_equal(np.asarray(dr_leaf[0]), np.asarray(tgt_leaf[0]))
+
+
+# --------------------------------------------------------------------------
+# Mesh-complete megasteps: speculative decoding under a GSPMD tp mesh
+# (MULTICHIP-style over forced host devices) must be token-identical to the
+# mesh-free engine — sharding annotations relocate compute, never content
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 4])
+@pytest.mark.parametrize("draft_len", [0, 2])
+def test_tp_mesh_greedy_matches_mesh_free(f32_models, plain_greedy,
+                                          draft_len, k):
+    """The full (draft_len, K) grid on a 2-device tp mesh: draft_len=0 is
+    the plain megastep under tp (the constrained donated carry), draft_len=2
+    runs spec_megastep_loop with BOTH caches constrained; either way greedy
+    output equals the mesh-free plain engine token for token."""
+    from jax.sharding import Mesh
+
+    tp_, tc, _, _ = f32_models
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a tp mesh")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    spec = ({"draft_len": draft_len, "self_draft_layers": 1}
+            if draft_len else {})
+    eng = _engine(tp_, tc, mesh=mesh, megastep_k=k, **spec)
+    out = eng.generate(PROMPTS, GenerationConfig(max_new_tokens=24))
+    assert out == plain_greedy, (draft_len, k)
+    if draft_len:
+        assert eng.stats.spec_target_passes > 0
+
+
+def test_pp_mesh_spec_still_guarded(f32_models):
+    """Mesh-complete means TP-complete: the pipeline relay has no
+    speculative path, so a pp axis > 1 must still fail fast."""
+    from jax.sharding import Mesh
+
+    tp_, tc, _, _ = f32_models
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        _engine(tp_, tc, mesh=mesh, draft_len=2, self_draft_layers=1)

@@ -149,13 +149,45 @@ def test_paged_heads_per_step_keys_on_pool_dtype(tmp_path, monkeypatch):
                                 pool_dtype="int8")
     assert t.misses == 2  # distinct keys, both measured
     keys = list(t.chosen)
-    assert any(k.endswith("|float32") for k in keys)
-    assert any(k.endswith("|int8") for k in keys)
+    # pool dtype is second-to-last (the tp degree terminates the key)
+    assert any(k.split("|")[-2] == "float32" for k in keys)
+    assert any(k.split("|")[-2] == "int8" for k in keys)
 
     # repeat int8 lookup hits the quantized entry
     tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure,
                                 pool_dtype="int8")
     assert t.hits == 1 and t.misses == 2
+
+
+def test_paged_heads_per_step_keys_on_tp_degree(tmp_path, monkeypatch):
+    """Under a tp mesh each GSPMD shard streams hkv/tp heads: every
+    candidate must divide the PER-SHARD head count (a winner chosen on
+    the full pool would be illegal inside a shard), and the degree joins
+    the cache key so tp=1 and tp=2 never share a measurement."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    seen = []
+
+    def measure(hps):
+        seen.append(hps)
+        return {4: 0.003, 2: 0.001, 1: 0.002}[hps]
+
+    got = tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure,
+                                      tp=2)
+    assert got in (1, 2)
+    assert seen and all(h <= 2 for h in seen)  # per-shard-legal candidates
+    tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure)
+    assert t.misses == 2  # tp=2 and tp=1 measured under distinct keys
+    keys = list(t.chosen)
+    assert any(k.endswith("|2") for k in keys)
+    assert any(k.endswith("|1") for k in keys)
+
+    # hkv/tp == 1 leaves a single legal split: resolved with no benchmark
+    assert tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure,
+                                       tp=4) == 1
+    assert t.misses == 2
 
 
 def test_fused_moe_block_i_round_trip(tmp_path, monkeypatch):
