@@ -32,7 +32,10 @@ from .telemetry import (
     EventLog,
     Histogram,
     NullTelemetry,
+    SLOTracker,
+    Span,
     Telemetry,
+    Tracer,
     prometheus_exposition,
 )
 from .speculative import (
@@ -81,6 +84,9 @@ __all__ = [
     "EventLog",
     "Histogram",
     "NullTelemetry",
+    "SLOTracker",
+    "Span",
     "Telemetry",
+    "Tracer",
     "prometheus_exposition",
 ]

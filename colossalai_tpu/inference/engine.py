@@ -67,7 +67,7 @@ from colossalai_tpu.utils.profiler import annotate, step_annotation
 
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
 from .prefix_cache import PrefixCache
-from .telemetry import NullTelemetry, Telemetry
+from .telemetry import NullTelemetry, SLOTracker, Telemetry, Tracer
 from .paged_modeling import (
     decode_megastep,
     prefill_chunk_paged,
@@ -321,6 +321,8 @@ class LLMEngine:
         self_draft_layers: Optional[int] = None,
         telemetry: Union[bool, Telemetry] = True,
         event_log: Optional[str] = None,
+        tracer: Union[bool, Tracer, None] = None,
+        slo: Union[bool, SLOTracker, None] = True,
         moe_impl: str = "auto",
         kv_dtype: str = "bf16",
     ):
@@ -329,20 +331,30 @@ class LLMEngine:
         # floats observed at scheduling boundaries that exist anyway, so
         # the default is ON (device traffic provably unchanged — asserted
         # in test_telemetry.py); event_log= adds the per-request jsonl.
+        # tracer= (default OFF) attaches a span tracer — pass True for a
+        # private one or a shared Tracer so a router stitches over
+        # replicas; slo= (default ON) tracks windowed SLO attainment —
+        # pass an SLOTracker to set targets, False to disable.
         if isinstance(telemetry, Telemetry):
-            if event_log is not None:
+            if event_log is not None or tracer not in (None, False) \
+                    or isinstance(slo, SLOTracker):
                 raise ValueError(
-                    "pass event_log= to the Telemetry you constructed, not "
-                    "alongside it"
+                    "pass event_log=/tracer=/slo= to the Telemetry you "
+                    "constructed, not alongside it"
                 )
             self.telemetry = telemetry
         elif telemetry:
-            self.telemetry = Telemetry(event_log=event_log)
+            self.telemetry = Telemetry(
+                event_log=event_log,
+                tracer=(Tracer() if tracer is True else (tracer or None)),
+                slo=(SLOTracker() if slo is True else (slo or None)),
+            )
         else:
-            if event_log is not None:
+            if event_log is not None or tracer not in (None, False) \
+                    or isinstance(slo, SLOTracker):
                 raise ValueError(
-                    "event_log= needs telemetry enabled — drop "
-                    "telemetry=False or the event_log path"
+                    "event_log=/tracer=/slo= need telemetry enabled — drop "
+                    "telemetry=False or the observability knobs"
                 )
             self.telemetry = NullTelemetry()
         self.max_batch = max_batch_size
@@ -638,6 +650,9 @@ class LLMEngine:
         self.prefilling: Dict[int, Request] = {}
         #: follower slots held while a group leader's chunked prefill runs
         self._reserved: Set[int] = set()
+        #: did any prefill program run this tick (set by the prefill
+        #: paths, read by step() for stall attribution)
+        self._tick_prefilled = False
         self._slot_tokens = np.zeros((max_batch_size,), np.int64)
         self._tables: Dict[int, SequenceTable] = {}
         # per-slot generation params mirrored as arrays for _sample_slots
@@ -911,8 +926,23 @@ class LLMEngine:
         Returns finished requests."""
         finished: List[Request] = []
         self.telemetry.observe_queue_depth(len(self.waiting))
+        tracing = self.telemetry.tracer is not None
+        t_wave0 = time.monotonic() if tracing else 0.0
+        self._tick_prefilled = False
         self._admit(finished)
         self._advance_prefills(finished)
+        if tracing and self._tick_prefilled:
+            # attribute the prefill wave to the requests it STALLED: every
+            # decoding request spends this interval parked behind
+            # batch-mates' prompt ingestion, outside all of its own spans.
+            # A request prefilled mid-wave stalls only from its own ready
+            # moment (~ its first-token stamp) to the end of the wave.
+            t_wave1 = time.monotonic()
+            for req in self.running.values():
+                t0 = max(t_wave0, req.t_first_token or t_wave0)
+                if t_wave1 > t0:
+                    self.telemetry.trace_interval(
+                        req, "prefill_stall", t0, t_wave1)
         self._decode_tick(finished)
         self._refresh_kv_gauges()
         return finished
@@ -956,13 +986,14 @@ class LLMEngine:
             )
             need -= hit
             if self.allocator.num_free < need:
-                self._evict_for(need - self.allocator.num_free)
+                self._evict_for(need - self.allocator.num_free, req=req)
             if self.allocator.num_free < need:
                 break  # no pages: stay queued until frees arrive
             self.waiting.pop(i)
             req.slot = free.pop(0)
             self.telemetry.on_admitted(req)
             if hit:
+                self.telemetry.trace_instant(req, "prefix_cache_hit", blocks=hit)
                 # fork-share the matched full prompt pages (bump tree refs,
                 # grouped-sampling style) and allocate only the rest
                 shared = list(req.cached_blocks)
@@ -989,8 +1020,9 @@ class LLMEngine:
                 self._reserved.update(req.group_slots)
                 self.prefilling[req.slot] = req
                 continue
-            logits = self._prefill_into_slot(req, bucket)
-            self._finish_prefill(req, logits, free, finished)
+            with self.telemetry.trace_phase(req, "prefill", cached_tokens=start):
+                logits = self._prefill_into_slot(req, bucket)
+                self._finish_prefill(req, logits, free, finished)
 
     def _advance_prefills(self, finished: List[Request]) -> None:
         """One chunk of prompt ingestion per prefilling slot per tick."""
@@ -1003,39 +1035,42 @@ class LLMEngine:
             ids = np.zeros((1, c), np.int32)
             ids[0, :n_valid] = req.prompt_ids[pos:pos + n_valid]
             table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-            with annotate("prefill_chunk"):
-                if self._pp:
-                    logits, self.cache = self._pp_prefill_chunk(
-                        self._pp_top, self._pp_stacked, jnp.asarray(ids),
-                        jnp.asarray(pos, jnp.int32), jnp.asarray(n_valid, jnp.int32),
-                        self.cache, jnp.asarray(table),
-                    )
-                else:
-                    logits, self.cache = prefill_chunk_paged(
-                        self.params, self.config, self._put_rep(ids),
-                        self._put_rep(np.asarray(pos, np.int32)),
-                        self._put_rep(np.asarray(n_valid, np.int32)),
-                        self.cache, self._put_rep(table),
-                    )
-                    if self.draft_len:
-                        # mirror the chunk into the draft pool (same physical
-                        # pages) so the draft's prompt KV is ready when the
-                        # slot starts drafting
-                        _, self.draft_cache = prefill_chunk_paged(
-                            self.draft_params, self.draft_config,
-                            self._put_rep(ids),
+            with self.telemetry.trace_phase(req, "prefill_chunk",
+                                            pos=pos, tokens=n_valid):
+                with annotate("prefill_chunk"):
+                    if self._pp:
+                        logits, self.cache = self._pp_prefill_chunk(
+                            self._pp_top, self._pp_stacked, jnp.asarray(ids),
+                            jnp.asarray(pos, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+                            self.cache, jnp.asarray(table),
+                        )
+                    else:
+                        logits, self.cache = prefill_chunk_paged(
+                            self.params, self.config, self._put_rep(ids),
                             self._put_rep(np.asarray(pos, np.int32)),
                             self._put_rep(np.asarray(n_valid, np.int32)),
-                            self.draft_cache, self._put_rep(table),
+                            self.cache, self._put_rep(table),
                         )
-            self.stats.prefill_chunks += 1
-            req.prefill_pos = pos + n_valid
-            if req.prefill_pos >= n:
-                self.prefilling.pop(slot)
-                req.table.length = n
-                followers = req.group_slots or []
-                self._reserved.difference_update(followers)
-                self._finish_prefill(req, logits, followers, finished)
+                        if self.draft_len:
+                            # mirror the chunk into the draft pool (same physical
+                            # pages) so the draft's prompt KV is ready when the
+                            # slot starts drafting
+                            _, self.draft_cache = prefill_chunk_paged(
+                                self.draft_params, self.draft_config,
+                                self._put_rep(ids),
+                                self._put_rep(np.asarray(pos, np.int32)),
+                                self._put_rep(np.asarray(n_valid, np.int32)),
+                                self.draft_cache, self._put_rep(table),
+                            )
+                self.stats.prefill_chunks += 1
+                self._tick_prefilled = True
+                req.prefill_pos = pos + n_valid
+                if req.prefill_pos >= n:
+                    self.prefilling.pop(slot)
+                    req.table.length = n
+                    followers = req.group_slots or []
+                    self._reserved.difference_update(followers)
+                    self._finish_prefill(req, logits, followers, finished)
 
     def _finish_prefill(self, req: Request, logits, follower_slots: List[int],
                         finished: List[Request]) -> None:
@@ -1134,7 +1169,8 @@ class LLMEngine:
         shortfall = (self.allocator.blocks_needed(target) - len(t.blocks)
                      - self.allocator.num_free)
         if shortfall > 0:
-            self._evict_for(shortfall)  # cached pages yield before fallback
+            # cached pages yield before fallback
+            self._evict_for(shortfall, req=req)
         base = len(t.blocks)
         try:
             fresh = self.allocator.fund(t, target)
@@ -1172,10 +1208,15 @@ class LLMEngine:
             extra = t.blocks[keep:]
             del t.blocks[keep:]
             self.allocator.free(extra)
+            self.telemetry.trace_instant(req, "page_refund", pages=len(extra))
 
     def _decode_tick(self, finished: List[Request]) -> None:
         if not self.running:
             return
+        # span attribution: ONE wall interval per tick (funding through
+        # commit), attributed below to every sampled request that lived
+        # through it — two monotonic() calls, no device traffic
+        t_tick0 = time.monotonic()
         # pre-fund the whole megastep's worth of pages per slot so the
         # device loop never needs a host allocation decision; demote when
         # tight: (K, d) -> (1, d) -> (1, 0) plain -> per-slot truncation
@@ -1311,6 +1352,8 @@ class LLMEngine:
                 self.telemetry.observe_moe_imbalance(
                     float(counts_np.max()) * counts_np.size / routed
                 )
+        t_tick1 = time.monotonic()
+        span_name = "spec_megastep" if d > 0 else "decode_megastep"
         for slot, req in list(self.running.items()):
             t = int(emitted_np[slot])
             toks = [int(x) for x in buf_np[slot, :t]]
@@ -1324,6 +1367,15 @@ class LLMEngine:
                 # reports each request's own acceptance, not the global rate)
                 req.spec_drafted += int(drafted_np[slot])
                 req.spec_accepted += int(accepted_np[slot])
+                self.telemetry.trace_interval(
+                    req, span_name, t_tick0, t_tick1, k=k, tokens=t,
+                    drafted=int(drafted_np[slot]),
+                    accepted=int(accepted_np[slot]),
+                )
+            else:
+                self.telemetry.trace_interval(
+                    req, span_name, t_tick0, t_tick1, k=k, tokens=t,
+                )
             if not alive_np[slot]:
                 self._release(slot, req)
                 self._finish(req, self._natural_reason(req))
@@ -1415,6 +1467,7 @@ class LLMEngine:
         single chunk-prefill call starting at the first uncached block,
         attending to the shared pages through the block table."""
         n = len(req.prompt_ids)
+        self._tick_prefilled = True
         start = (len(req.cached_blocks) * self.block_size
                  if self.prefix_cache is not None else 0)
         if start:
@@ -1484,14 +1537,18 @@ class LLMEngine:
         req.table.length = n
         return logits
 
-    def _evict_for(self, n_blocks: int) -> int:
+    def _evict_for(self, n_blocks: int, req: Optional[Request] = None) -> int:
         """Try to reclaim ``n_blocks`` pages from the prefix cache — the
         pre-OutOfBlocks relief valve: cache residency yields to live
-        sequences, so caching never shrinks effective pool capacity."""
+        sequences, so caching never shrinks effective pool capacity.
+        ``req`` (when the eviction is on behalf of a specific request)
+        attributes the event to that request's trace."""
         if self.prefix_cache is None or n_blocks <= 0:
             return 0
         freed = self.prefix_cache.evict(n_blocks, self.allocator)
         self.stats.prefix_evictions = self.prefix_cache.evictions
+        if freed and req is not None:
+            self.telemetry.trace_instant(req, "prefix_cache_evict", blocks=freed)
         return freed
 
     def _alloc_blocks(self, n_blocks: int) -> List[int]:

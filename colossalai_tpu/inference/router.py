@@ -59,6 +59,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Union
 
 from colossalai_tpu.telemetry.core import Histogram, prometheus_exposition
+from colossalai_tpu.telemetry.slo import SLOTracker
+from colossalai_tpu.telemetry.tracing import Tracer
 
 from .engine import GenerationConfig, LLMEngine, Request
 
@@ -85,6 +87,7 @@ class Router:
         policy: str = "cache_aware",
         parallel_step: bool = True,
         devices: Optional[Sequence] = None,
+        tracer: Optional[Tracer] = None,
     ):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
@@ -120,6 +123,19 @@ class Router:
             # replica i mints ids i, i+n, i+2n, ... — globally unique and
             # self-describing (rid % n == i)
             e._ids = itertools.count(i, n)
+            # each replica's spans render on their own named track in the
+            # Chrome export (harmless when no tracer is attached)
+            e.telemetry.track = f"replica{i}"
+        # router→replica span stitching needs ONE tracer shared by every
+        # replica (build the engines with the same `tracer=` instance);
+        # auto-adopt it when the replicas agree, else stitching is off
+        if tracer is None:
+            distinct = {id(t): t for e in self.engines
+                        for t in [getattr(e.telemetry, "tracer", None)]
+                        if t is not None}
+            if len(distinct) == 1:
+                tracer = next(iter(distinct.values()))
+        self.tracer = tracer
         self.policy = policy
         self._devices = list(devices) if devices is not None else None
         self._draining = [False] * n
@@ -192,10 +208,20 @@ class Router:
         lands whole on one replica, same as one engine requires) and
         return the replica's request id(s), already globally unique."""
         prompt_ids = list(map(int, prompt_ids))
+        tr = self.tracer
+        t0 = tr._clock() if tr is not None else 0.0
         i = self._place(prompt_ids)
         self.requests_routed += n_samples
-        return self.engines[i].add_request(
+        rids = self.engines[i].add_request(
             prompt_ids, gen, n_samples=n_samples, priority=priority)
+        if tr is not None:
+            # stitch the routing decision UNDER the root the replica just
+            # opened (groups trace through their leader) — the root widens
+            # to cover it, so child ⊆ parent holds across the boundary
+            rid0 = rids[0] if isinstance(rids, list) else rids
+            tr.stitch(rid0, "router.place", t0, tr._clock(),
+                      replica=i, policy=self.policy)
+        return rids
 
     def abort(self, request_id: int) -> bool:
         return self.engines[self.replica_of(request_id)].abort(request_id)
@@ -219,6 +245,31 @@ class Router:
                 return self.engines[i].step()
         return self.engines[i].step()
 
+    def _trace_sync_waits(self, busy: List[int], t_step0: float,
+                          intervals: Dict[int, tuple]) -> None:
+        """Attribute fleet-barrier waits: while the router waits for its
+        slowest replica this step, every other replica's live requests sit
+        idle outside all of their own spans. Each gets a ``router.sync``
+        span covering [own step end → step end] (and the lead-in for
+        sequential stepping) — in Perfetto a straggler replica shows up as
+        the OTHER replicas' sync time."""
+        tr = self.tracer
+        t_step1 = tr._clock()
+        for i in busy:
+            a, b = intervals[i]
+            waits = []
+            if a - t_step0 > 1e-6:
+                waits.append((t_step0, a))  # sequential mode lead-in
+            if t_step1 - b > 1e-6:
+                waits.append((b, t_step1))  # barrier tail
+            if not waits:
+                continue
+            e = self.engines[i]
+            for req in list(e.running.values()) + list(e.prefilling.values()):
+                for w0, w1 in waits:
+                    tr.add(req.request_id, "router.sync", w0, w1,
+                           track="router", replica=i)
+
     def step(self) -> List[Request]:
         """One tick of every busy replica; returns all finished requests.
         Busy replicas step CONCURRENTLY on worker threads (unless
@@ -228,12 +279,26 @@ class Router:
         if not busy:
             return []
         finished: List[Request] = []
+        tr = self.tracer
+        t_step0 = tr._clock() if tr is not None else 0.0
+        intervals: Dict[int, tuple] = {}
+
+        def timed(i: int) -> List[Request]:
+            t0 = tr._clock()
+            try:
+                return self._step_one(i)
+            finally:
+                intervals[i] = (t0, tr._clock())
+
+        run = self._step_one if tr is None else timed
         if self._pool is not None and len(busy) > 1:
-            for fut in [self._pool.submit(self._step_one, i) for i in busy]:
+            for fut in [self._pool.submit(run, i) for i in busy]:
                 finished.extend(fut.result())
         else:
             for i in busy:
-                finished.extend(self._step_one(i))
+                finished.extend(run(i))
+        if tr is not None and len(busy) > 1:
+            self._trace_sync_waits(busy, t_step0, intervals)
         return finished
 
     def generate(self, prompts, gen: Optional[GenerationConfig] = None):
@@ -273,7 +338,7 @@ class Router:
         ready signal a balancer would scrape."""
         out = []
         for i, e in enumerate(self.engines):
-            out.append({
+            entry = {
                 "replica": i,
                 "draining": self._draining[i],
                 "running": len(e.running),
@@ -283,7 +348,13 @@ class Router:
                 "requests_submitted": e.stats.requests_submitted,
                 "requests_completed": e.stats.requests_completed,
                 "requests_aborted": e.stats.requests_aborted,
-            })
+            }
+            slo = getattr(e.telemetry, "slo", None)
+            if slo is not None:
+                # windowed SLO brief per replica: the scrape a breach-aware
+                # balancer reads (breached flag + live windowed percentiles)
+                entry["slo"] = slo.brief()
+            out.append(entry)
         return out
 
     # -------------------------------------------------------- merged metrics
@@ -328,6 +399,18 @@ class Router:
                 merged[name].merge(h)
         return merged
 
+    def slo_trackers(self) -> List[SLOTracker]:
+        """Every replica's attached :class:`SLOTracker` (replicas built
+        with ``slo=False`` contribute nothing)."""
+        return [t for t in (getattr(e.telemetry, "slo", None)
+                            for e in self.engines) if t is not None]
+
+    def merged_slo(self) -> Dict:
+        """Fleet SLO view: per-replica windows folded bucket-wise, goodput
+        counters summed, ``breached`` = any replica (the ``GET /slo``
+        payload's ``merged`` half)."""
+        return SLOTracker.merged_snapshot(self.slo_trackers())
+
     def occupancy(self) -> Dict[str, int]:
         """Router-wide scheduler/pool gauges (the non-counter half of
         /health and /metrics)."""
@@ -352,6 +435,14 @@ class Router:
         gauges["spec_acceptance_rate"] = counters.pop("spec_acceptance_rate")
         gauges["kv_pool_bytes"] = counters.pop("kv_pool_bytes", 0)
         gauges["kv_blocks_in_use"] = counters.pop("kv_blocks_in_use", 0)
+        trackers = self.slo_trackers()
+        if trackers:
+            # fleet clt_slo_* families: windows merged bucket-wise, same
+            # names as the single-engine exposition so dashboards read a
+            # bare engine and a router interchangeably
+            slo_counters, slo_gauges = SLOTracker.merged_prom(trackers)
+            counters.update(slo_counters)
+            gauges.update(slo_gauges)
         return prometheus_exposition(counters, gauges,
                                      self.merged_histograms())
 
@@ -366,11 +457,15 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
 
     Endpoints: ``POST /generate`` (ids or text, SSE streaming included)
     and ``POST /abort`` exactly as the single-engine server;
-    ``GET /health`` adds the per-replica health list and drain states;
-    ``GET /metrics`` serves the MERGED exposition
-    (:meth:`Router.metrics_text` — one scrape target, ``_count`` = sum
-    over replicas); ``POST /drain`` ``{"replica": i, "drain": bool}``
-    toggles placement eligibility for rolling restarts."""
+    ``GET /health`` adds the per-replica health list (each with its
+    windowed SLO brief) and drain states; ``GET /metrics`` serves the
+    MERGED exposition (:meth:`Router.metrics_text` — one scrape target,
+    ``_count`` = sum over replicas, ``clt_slo_*`` folded bucket-wise);
+    ``GET /slo`` pairs the fleet view with the per-replica snapshots;
+    ``GET /trace?rid=`` / ``POST /trace/dump`` serve the shared tracer
+    (replicas built with one ``tracer=`` instance stitch into one trace);
+    ``POST /drain`` ``{"replica": i, "drain": bool}`` toggles placement
+    eligibility for rolling restarts."""
     import json
 
     from .server import make_server
@@ -382,6 +477,17 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
     base_handler = server.RequestHandlerClass
 
     class RouterHandler(base_handler):
+        def _slo_payload(self):
+            # fleet override of the single-engine /slo body: the merged
+            # (bucket-wise folded) view plus each replica's own snapshot
+            trackers = router.slo_trackers()
+            if not trackers:
+                return None
+            return {
+                "merged": router.merged_slo(),
+                "replicas": [t.snapshot() for t in trackers],
+            }
+
         def do_GET(self):
             if self.path == "/health":
                 with sched.lock:
@@ -404,7 +510,10 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                 self.end_headers()
                 self.wfile.write(body)
             else:
-                self._json(404, {"error": "not found"})
+                # /slo and /trace fall through to the single-engine handler
+                # (its _slo_payload/_attached_tracer hooks resolve against
+                # the router: merged SLO view, shared tracer)
+                base_handler.do_GET(self)
 
         def do_POST(self):
             if self.path == "/drain":

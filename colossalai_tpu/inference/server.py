@@ -34,6 +34,16 @@ Endpoints:
   ITL, e2e, queue wait, queue depth, megastep wall time) as
   ``_bucket``/``_sum``/``_count`` families — drop the URL into any
   standard scrape pipeline (see docs/observability.md).
+- ``GET /slo``        → windowed SLO attainment from the engine's
+  :class:`~colossalai_tpu.telemetry.SLOTracker` (p50/p90/p99 TTFT/ITL/e2e
+  over the sliding window, per-target evaluation, goodput counters, the
+  breach flag). 404 when the engine was built with ``slo=False``.
+- ``GET /trace?rid=i`` → the span tree of one request from the tracer's
+  flight recorder (``GET /trace`` alone returns tracer counters). 404
+  when no tracer is attached (``tracer=`` engine knob).
+- ``POST /trace/dump`` {"path": p}? → export the flight recorder as
+  Chrome trace-event JSON — written to ``path`` when given, else returned
+  inline; load it at https://ui.perfetto.dev.
 - ``POST /profile``   {"action": "start", "log_dir": d} | {"action": "stop"}
   → on-demand XLA trace capture of the LIVE engine: start begins a
   ``jax.profiler`` trace into ``log_dir``, stop finishes it and returns
@@ -54,6 +64,7 @@ import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
 
 from colossalai_tpu.utils.profiler import start_profile, stop_profile
 
@@ -63,6 +74,15 @@ from .telemetry import prometheus_exposition
 #: sentinel pushed to a stream queue when its request leaves the engine
 _DONE = object()
 _ABORTED = object()
+
+
+def _attached_tracer(obj):
+    """The span tracer behind an engine-shaped object: an engine carries
+    it on its telemetry facade, a Router directly as ``.tracer``."""
+    tel = getattr(obj, "telemetry", None)
+    if tel is not None and getattr(tel, "tracer", None) is not None:
+        return tel.tracer
+    return getattr(obj, "tracer", None)
 
 
 class _Scheduler(threading.Thread):
@@ -232,8 +252,47 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                 "draft_len": engine.draft_len,
             }
 
+        def _slo_payload(self) -> Optional[dict]:
+            """The ``GET /slo`` body (caller holds the lock); None when SLO
+            tracking is off. ``make_router_server`` overrides this with the
+            merged + per-replica fleet view."""
+            tel = getattr(engine, "telemetry", None)
+            slo = getattr(tel, "slo", None) if tel is not None else None
+            return None if slo is None else slo.snapshot()
+
+        def _get_slo(self):
+            with sched.lock:
+                payload = self._slo_payload()
+            if payload is None:
+                self._json(404, {"error": "slo windows disabled "
+                                 "(engine slo= knob)"})
+            else:
+                self._json(200, payload)
+
+        def _get_trace(self, query: str):
+            tracer = _attached_tracer(engine)
+            if tracer is None:
+                self._json(404, {"error": "tracing disabled "
+                                 "(engine tracer= knob)"})
+                return
+            qs = parse_qs(query)
+            if "rid" in qs:
+                try:
+                    rid = int(qs["rid"][0])
+                except ValueError:
+                    self._json(400, {"error": "rid must be an int"})
+                    return
+                with sched.lock:
+                    spans = [s.as_dict() for s in tracer.spans(rid)]
+                self._json(200, {"request_id": rid,
+                                 "sampled": tracer.sampled(rid),
+                                 "spans": spans})
+            else:
+                self._json(200, tracer.snapshot())
+
         def do_GET(self):
-            if self.path == "/health":
+            parsed = urlparse(self.path)
+            if parsed.path == "/health":
                 with sched.lock:
                     payload = {
                         "status": "ok",
@@ -250,8 +309,13 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         payload["moe_expert_load"] = [
                             int(c) for c in engine.expert_load
                         ]
+                    slo = getattr(engine.telemetry, "slo", None)
+                    if slo is not None:
+                        # the compact windowed view (breached flag + live
+                        # percentiles) — full detail lives at GET /slo
+                        payload["slo"] = slo.brief()
                 self._json(200, payload)
-            elif self.path == "/metrics":
+            elif parsed.path == "/metrics":
                 with sched.lock:
                     counters = engine.stats.as_dict()
                     if engine.expert_load is not None:
@@ -268,6 +332,12 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     gauges["kv_pool_bytes"] = counters.pop("kv_pool_bytes")
                     gauges["kv_blocks_in_use"] = \
                         counters.pop("kv_blocks_in_use")
+                    slo = getattr(engine.telemetry, "slo", None)
+                    if slo is not None:
+                        # clt_slo_* families: windowed percentiles vs
+                        # targets, goodput, breach flag
+                        counters.update(slo.prom_counters())
+                        gauges.update(slo.prom_gauges())
                     body = prometheus_exposition(
                         counters, gauges, engine.telemetry.histograms,
                     ).encode()
@@ -277,6 +347,10 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif parsed.path == "/slo":
+                self._get_slo()
+            elif parsed.path == "/trace":
+                self._get_trace(parsed.query)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -330,6 +404,24 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
             if self.path == "/abort":
                 try:
                     self._json(200, {"aborted": sched.abort(int(req["request_id"]))})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/trace/dump":
+                tracer = _attached_tracer(engine)
+                if tracer is None:
+                    self._json(404, {"error": "tracing disabled "
+                                     "(engine tracer= knob)"})
+                    return
+                try:
+                    path = req.get("path")
+                    with sched.lock:
+                        trace = tracer.export_chrome(path)
+                    if path is not None:
+                        self._json(200, {"path": path,
+                                         "events": len(trace["traceEvents"])})
+                    else:
+                        self._json(200, trace)
                 except Exception as e:
                     self._json(400, {"error": str(e)})
                 return

@@ -17,6 +17,14 @@ What remains serving-specific:
   per-request jsonl record at finish. :class:`NullTelemetry` is the
   zero-cost off switch (``LLMEngine(telemetry=False)``).
 
+Two optional attachments (PR 10) hang off the same facade so the engine
+still calls exactly one object: a shared-telemetry
+:class:`~colossalai_tpu.telemetry.Tracer` decomposes each sampled
+request's lifetime into a span tree (queue → prefill chunks → decode
+megasteps, plus cache/refund instants), and an
+:class:`~colossalai_tpu.telemetry.SLOTracker` folds finish-time
+latencies into sliding-window percentiles with goodput accounting.
+
 Everything here is host-side arithmetic on python floats — enabling
 telemetry provably changes NOTHING about device traffic
 (``decode_syncs`` / ``decode_h2d_scalars`` are asserted byte-identical in
@@ -25,6 +33,7 @@ telemetry provably changes NOTHING about device traffic
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Dict, Optional, Union
 
@@ -34,6 +43,10 @@ from colossalai_tpu.telemetry.core import (  # noqa: F401  (re-exports)
     _fmt,
     prometheus_exposition,
 )
+from colossalai_tpu.telemetry.slo import SLOTracker  # noqa: F401  (re-export)
+from colossalai_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
+
+_NULL_CM = contextlib.nullcontext()
 
 #: every terminal state a request can reach — the ``finish_reason`` field
 #: of lifecycle records is always one of these
@@ -72,25 +85,56 @@ class Telemetry:
     #: patchable clock seam (tests pin it to verify derived latencies)
     _clock = staticmethod(time.monotonic)
 
-    def __init__(self, event_log: Union[None, str, EventLog] = None):
+    def __init__(
+        self,
+        event_log: Union[None, str, EventLog] = None,
+        tracer: Optional[Tracer] = None,
+        slo: Optional[SLOTracker] = None,
+        track: str = "engine",
+    ):
         self.histograms: Dict[str, Histogram] = {
             name: make() for name, make in _HISTOGRAM_SPECS.items()
         }
         self.events: Optional[EventLog] = (
             EventLog(event_log) if isinstance(event_log, str) else event_log
         )
+        self.tracer: Optional[Tracer] = tracer
+        self.slo: Optional[SLOTracker] = slo
+        #: span-track label — the router renames this to ``replica<i>`` so
+        #: each replica's phases get their own track in the Chrome export
+        self.track = track
         self.enabled = True
 
     # ------------------------------------------------------ lifecycle hooks
     def on_submitted(self, req) -> None:
         req.t_arrival = self._clock()
+        tr = self.tracer
+        if tr is not None:
+            req._trace_begun = True
+            if tr.begin(req.request_id, t0=req.t_arrival,
+                        track=self.track) is not None:
+                req._queue_span = tr.start(
+                    req.request_id, "queue", t0=req.t_arrival, track=self.track
+                )
 
     def on_admitted(self, req) -> None:
         req.t_admitted = self._clock()
+        tr = self.tracer
+        if tr is not None:
+            self.tracer.end(getattr(req, "_queue_span", None), t1=req.t_admitted)
 
     def on_first_token(self, req) -> None:
         if req.t_first_token is None:
             req.t_first_token = self._clock()
+            tr = self.tracer
+            if tr is not None:
+                if not getattr(req, "_trace_begun", False):
+                    # group follower: materialized mid-flight, never saw
+                    # on_submitted — anchor its root on the leader's stamps
+                    req._trace_begun = True
+                    tr.begin(req.request_id, t0=req.t_arrival, track=self.track)
+                tr.instant(req.request_id, "first_token",
+                           t=req.t_first_token, track=self.track)
 
     def on_finished(self, req, *, group_size: int = 1) -> None:
         """Terminal hook: stamp ``t_finished``, observe the latency
@@ -118,6 +162,17 @@ class Telemetry:
             h["itl_seconds"].observe(itl)
         if e2e is not None:
             h["e2e_seconds"].observe(e2e)
+        within = None
+        if self.slo is not None:
+            within = self.slo.record_request(
+                ttft=ttft, itl=itl, e2e=e2e, queue_wait=queue_wait,
+                tokens=n_gen, reason=req.finish_reason,
+            )
+        if self.tracer is not None:
+            self.tracer.end_trace(
+                req.request_id, t1=now,
+                finish_reason=req.finish_reason, tokens=n_gen,
+            )
         if self.events is not None:
             record = {
                 "event": "request",
@@ -133,9 +188,36 @@ class Telemetry:
                 "spec_drafted": req.spec_drafted,
                 "spec_accepted": req.spec_accepted,
             }
+            if within is not None:
+                record["within_slo"] = within
             if group_size > 1:
                 record["group_size"] = group_size
             self.events.emit(record)
+
+    # ------------------------------------------------------------- span hooks
+    # All three are cheap no-ops unless a tracer is attached AND the
+    # request is sampled — the engine calls them unconditionally.
+    def trace_phase(self, req, name: str, **args):
+        """Context manager spanning a host-side phase of one request
+        (prefill, prefill chunk) on this engine's track."""
+        tr = self.tracer
+        if tr is None:
+            return _NULL_CM
+        return tr.span_cm(req.request_id, name, track=self.track, **args)
+
+    def trace_instant(self, req, name: str, **args) -> None:
+        """Point event inside a request's trace (cache hit, page refund)."""
+        tr = self.tracer
+        if tr is not None:
+            tr.instant(req.request_id, name, track=self.track, **args)
+
+    def trace_interval(self, req, name: str, t0: float, t1: float, **args) -> None:
+        """Attribute an already-measured wall interval to a request — the
+        decode megastep path: ONE (t0, t1) pair per tick, attributed to
+        every sampled request that lived through it."""
+        tr = self.tracer
+        if tr is not None:
+            tr.add(req.request_id, name, t0, t1, track=self.track, **args)
 
     # --------------------------------------------------- engine-level gauges
     def observe_queue_depth(self, depth: int) -> None:
@@ -167,6 +249,8 @@ class Telemetry:
     def close(self) -> None:
         if self.events is not None:
             self.events.close()
+        if self.tracer is not None:
+            self.tracer.close()
 
 
 class NullTelemetry:
@@ -176,6 +260,9 @@ class NullTelemetry:
 
     histograms: Dict[str, Histogram] = {}
     events = None
+    tracer = None
+    slo = None
+    track = "engine"
     enabled = False
 
     def on_submitted(self, req) -> None:
@@ -188,6 +275,15 @@ class NullTelemetry:
         pass
 
     def on_finished(self, req, *, group_size: int = 1) -> None:
+        pass
+
+    def trace_phase(self, req, name: str, **args):
+        return _NULL_CM
+
+    def trace_instant(self, req, name: str, **args) -> None:
+        pass
+
+    def trace_interval(self, req, name: str, t0: float, t1: float, **args) -> None:
         pass
 
     def observe_queue_depth(self, depth: int) -> None:

@@ -1,10 +1,14 @@
 """Shared observability package: primitives in :mod:`.core` (histograms,
 jsonl event logs, Prometheus exposition — used by BOTH the serving engine
-and the training stack) and the training-side :class:`TrainMonitor` in
-:mod:`.train_monitor`. Serving-specific telemetry (request lifecycle
-tracing) stays in :mod:`colossalai_tpu.inference.telemetry`."""
+and the training stack), request tracing in :mod:`.tracing` (span trees,
+flight recorder, Chrome export), windowed SLO attainment in :mod:`.slo`,
+and the training-side :class:`TrainMonitor` in :mod:`.train_monitor`.
+Serving-specific telemetry (request lifecycle stamps + span wiring) stays
+in :mod:`colossalai_tpu.inference.telemetry`."""
 
 from .core import METRIC_NAME_RE, EventLog, Histogram, prometheus_exposition
+from .slo import DEFAULT_TARGETS, SLO_TARGET_RE, SLOTracker, WindowedHistogram
+from .tracing import SPAN_NAME_RE, Span, Tracer
 from .train_monitor import (
     NONFINITE_ACTIONS,
     NonFiniteLossError,
@@ -20,6 +24,13 @@ __all__ = [
     "EventLog",
     "Histogram",
     "prometheus_exposition",
+    "DEFAULT_TARGETS",
+    "SLO_TARGET_RE",
+    "SLOTracker",
+    "WindowedHistogram",
+    "SPAN_NAME_RE",
+    "Span",
+    "Tracer",
     "NONFINITE_ACTIONS",
     "NonFiniteLossError",
     "NullTrainMonitor",

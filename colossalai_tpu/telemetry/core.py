@@ -29,7 +29,7 @@ import math
 import os
 import re
 import threading
-from typing import Any, Dict, Iterable, List, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 #: the Prometheus metric-name grammar — every name either renderer emits
 #: must match (tests/test_core/test_metric_names.py lints both catalogs)
@@ -49,9 +49,15 @@ class Histogram:
     and clamp to the observed min/max, so the error is bounded by one
     bucket's width — with the default log spacing that is a small,
     constant RELATIVE error across six decades of latency.
+
+    Non-finite observations (NaN, ±Inf) are DROPPED, not folded in: a
+    single NaN would otherwise poison ``sum`` (Prometheus ``_sum`` becomes
+    NaN forever) and a NaN/-Inf miscounts into bucket 0 because every
+    ``bound < v`` comparison is False. Drops are tallied in ``dropped``.
     """
 
-    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max",
+                 "dropped")
 
     def __init__(self, bounds: Sequence[float]):
         bounds = tuple(float(b) for b in bounds)
@@ -67,6 +73,7 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self.dropped = 0
 
     @classmethod
     def log_spaced(cls, lo: float, hi: float, n_buckets: int) -> "Histogram":
@@ -82,6 +89,9 @@ class Histogram:
 
     def observe(self, value: float) -> None:
         v = float(value)
+        if not math.isfinite(v):
+            self.dropped += 1
+            return
         self.count += 1
         self.sum += v
         if v < self.min:
@@ -132,6 +142,7 @@ class Histogram:
         self.sum += other.sum
         self.min = min(self.min, other.min)
         self.max = max(self.max, other.max)
+        self.dropped += other.dropped
         return self
 
     def reset(self) -> None:
@@ -140,6 +151,7 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self.dropped = 0
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -149,6 +161,7 @@ class Histogram:
             "max": self.max if self.count else None,
             "bounds": list(self.bounds),
             "bucket_counts": list(self.bucket_counts),
+            "dropped": self.dropped,
         }
 
     def prometheus_lines(self, name: str) -> List[str]:
@@ -176,21 +189,41 @@ class EventLog:
     """Append-only jsonl event sink (≙ ``logging/metrics.py``'s file
     discipline: one record per line, flush per write, open in append mode
     so restarts extend the same history). Thread-safe — the engine's
-    scheduler thread and a server's handler threads may both emit."""
+    scheduler thread and a server's handler threads may both emit.
 
-    def __init__(self, path: str):
+    ``max_bytes`` (optional) caps the live file: when the next record
+    would push it past the cap, the file rotates to ``<path>.1`` (one
+    generation — long serving runs keep a bounded recent history instead
+    of growing without limit). :meth:`read` is unchanged — it always reads
+    the live file.
+    """
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes={max_bytes} must be >= 1")
         self.path = path
+        self.max_bytes = max_bytes
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._file = open(path, "a", encoding="utf-8")
+        self._size = self._file.tell()
         self._lock = threading.Lock()
 
     def emit(self, record: Dict[str, Any]) -> None:
         line = json.dumps(record) + "\n"
         with self._lock:
-            if self._file is not None:
-                self._file.write(line)
-                self._file.flush()
+            if self._file is None:
+                return
+            n = len(line.encode("utf-8"))
+            if (self.max_bytes is not None and self._size > 0
+                    and self._size + n > self.max_bytes):
+                self._file.close()
+                os.replace(self.path, self.path + ".1")
+                self._file = open(self.path, "a", encoding="utf-8")
+                self._size = 0
+            self._file.write(line)
+            self._file.flush()
+            self._size += n
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,375 @@
+"""Sliding-window latency percentiles, SLO targets, and goodput.
+
+The PR-5 histograms are cumulative-forever: ten minutes after a burst, a
+p99 TTFT regression has been averaged back into invisibility. This module
+adds the time-local view an operator (and, next PR, the scheduler) needs:
+
+- :class:`WindowedHistogram` — a ring of per-interval
+  :class:`~.core.Histogram` buckets folded on demand with the existing
+  ``Histogram.merge``; percentile queries see only the last
+  ``interval_s × n_intervals`` seconds. O(1) observe, O(buckets ×
+  intervals) query, zero allocation in steady state.
+- :class:`SLOTracker` — per-metric targets (``ttft_p99: 0.5`` reads
+  "windowed p99 TTFT must stay under 500 ms"), per-request goodput
+  accounting (a request is *good* when it finished, un-aborted, within
+  every targeted bound), and breach detection with callbacks plus a
+  ``breached`` flag that scheduler policies and router placement can
+  read. This PR is the observational half — nothing acts on the flag yet
+  (the ROADMAP's SLO-aware admission/preemption loop is the next PR);
+  the contract is: ``breached`` flips True on the rising edge of any
+  windowed percentile crossing its target, callbacks fire once per edge,
+  and the flag clears itself when the window drains below target.
+
+Everything is host-side float arithmetic; the transfer-counter gates
+prove device traffic is unchanged with SLO windows on vs off.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from .core import Histogram
+
+#: target-key grammar: ``<metric>_p<percentile>`` over the windowed metrics
+SLO_TARGET_RE = re.compile(r"^(ttft|itl|e2e|queue_wait)_p(\d{1,2}(?:\.\d+)?)$")
+
+#: windowed-metric catalog — bounds mirror the cumulative serving specs in
+#: ``inference/telemetry.py`` so windowed and cumulative percentiles are
+#: directly comparable (same bucket quantization)
+_WINDOW_BOUNDS = {
+    "ttft": lambda: Histogram.log_spaced(1e-4, 600.0, 48).bounds,
+    "itl": lambda: Histogram.log_spaced(1e-5, 60.0, 48).bounds,
+    "e2e": lambda: Histogram.log_spaced(1e-3, 3600.0, 48).bounds,
+    "queue_wait": lambda: Histogram.log_spaced(1e-5, 600.0, 48).bounds,
+}
+
+#: generous defaults — real deployments pass their own; these exist so
+#: ``LLMEngine(slo=True)`` (the default) is meaningful out of the box
+DEFAULT_TARGETS = {"ttft_p99": 1.0, "itl_p99": 0.1}
+
+
+class WindowedHistogram:
+    """A ring of per-interval histograms; the merged view covers the last
+    ``n_intervals × interval_s`` seconds (±one interval of quantization).
+
+    Advancing is lazy: each observe/query computes the current interval
+    index from the clock and resets every ring slot skipped since the
+    last call — an idle window costs nothing and reads as empty.
+    """
+
+    #: patchable clock seam (tests pin it to drive the window by hand)
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(self, bounds, interval_s: float = 10.0, n_intervals: int = 6):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s={interval_s} must be > 0")
+        if n_intervals < 1:
+            raise ValueError(f"n_intervals={n_intervals} must be >= 1")
+        self.bounds = tuple(float(b) for b in bounds)
+        self.interval_s = float(interval_s)
+        self.n_intervals = int(n_intervals)
+        self._ring = [Histogram(self.bounds) for _ in range(self.n_intervals)]
+        self._idx: Optional[int] = None
+
+    @property
+    def window_s(self) -> float:
+        return self.interval_s * self.n_intervals
+
+    def _advance(self) -> int:
+        idx = int(self._clock() // self.interval_s)
+        if self._idx is None:
+            self._idx = idx
+        elif idx > self._idx:
+            for step in range(1, min(idx - self._idx, self.n_intervals) + 1):
+                self._ring[(self._idx + step) % self.n_intervals].reset()
+            self._idx = idx
+        return self._idx
+
+    def observe(self, value: float) -> None:
+        self._ring[self._advance() % self.n_intervals].observe(value)
+
+    def merged(self) -> Histogram:
+        """Fold the live window into a fresh cumulative-style histogram
+        (callers get the full ``Histogram`` query surface)."""
+        self._advance()
+        h = Histogram(self.bounds)
+        for part in self._ring:
+            h.merge(part)
+        return h
+
+    def percentile(self, q: float) -> float:
+        return self.merged().percentile(q)
+
+    @property
+    def count(self) -> int:
+        self._advance()
+        return sum(part.count for part in self._ring)
+
+    def reset(self) -> None:
+        for part in self._ring:
+            part.reset()
+        self._idx = None
+
+
+class SLOTracker:
+    """Windowed SLO attainment + goodput for the serving engine.
+
+    ``targets`` maps ``<metric>_p<q>`` keys to latency bounds in seconds
+    (metrics: ttft, itl, e2e, queue_wait). Two readings per target:
+
+    - **windowed percentile vs target** → the ``breached`` flag and
+      ``on_breach`` callbacks (``cb(key, value, target)``, fired once per
+      rising edge per metric);
+    - **per-request attainment** → goodput: a finished request counts as
+      *within SLO* when it was not aborted and each of its targeted
+      latencies is ≤ the target bound. ``goodput_tokens`` accumulates
+      generated tokens of within-SLO requests only — tokens/s you could
+      have charged for, the overload bench's ground truth.
+    """
+
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(
+        self,
+        targets: Optional[Dict[str, float]] = None,
+        window_s: float = 60.0,
+        n_intervals: int = 6,
+        on_breach: Optional[Callable[[str, float, float], None]] = None,
+    ):
+        if window_s <= 0:
+            raise ValueError(f"window_s={window_s} must be > 0")
+        targets = dict(DEFAULT_TARGETS if targets is None else targets)
+        self._parsed: List[Tuple[str, str, float, float]] = []
+        for key in sorted(targets):
+            m = SLO_TARGET_RE.match(key)
+            if m is None:
+                raise ValueError(
+                    f"bad SLO target {key!r}: expected <metric>_p<q> with "
+                    f"metric in {sorted(_WINDOW_BOUNDS)}"
+                )
+            bound = float(targets[key])
+            if not (math.isfinite(bound) and bound > 0):
+                raise ValueError(f"target {key}={targets[key]!r} must be finite > 0")
+            self._parsed.append((key, m.group(1), float(m.group(2)), bound))
+        self.targets = targets
+        self.windows: Dict[str, WindowedHistogram] = {
+            metric: WindowedHistogram(
+                make(), interval_s=window_s / n_intervals, n_intervals=n_intervals
+            )
+            for metric, make in _WINDOW_BOUNDS.items()
+        }
+        self.requests_total = 0
+        self.requests_within_slo = 0
+        self.goodput_tokens = 0
+        self.breached = False
+        self.breaches = 0
+        self.breached_metrics: Tuple[str, ...] = ()
+        self._callbacks: List[Callable[[str, float, float], None]] = []
+        if on_breach is not None:
+            self._callbacks.append(on_breach)
+
+    @property
+    def window_s(self) -> float:
+        return next(iter(self.windows.values())).window_s
+
+    def add_breach_callback(self, cb: Callable[[str, float, float], None]) -> None:
+        self._callbacks.append(cb)
+
+    # ------------------------------------------------------------- recording
+    def record_request(
+        self,
+        *,
+        ttft: Optional[float] = None,
+        itl: Optional[float] = None,
+        e2e: Optional[float] = None,
+        queue_wait: Optional[float] = None,
+        tokens: int = 0,
+        reason: Optional[str] = None,
+    ) -> bool:
+        """Feed one finished request; returns whether it landed within
+        SLO. Aborted requests count toward ``requests_total`` but never
+        toward goodput — shed load is not good load."""
+        values = {"ttft": ttft, "itl": itl, "e2e": e2e, "queue_wait": queue_wait}
+        for metric, v in values.items():
+            if v is not None:
+                self.windows[metric].observe(v)
+        within = reason != "aborted"
+        if within:
+            for _key, metric, _q, bound in self._parsed:
+                v = values[metric]
+                if v is not None and v > bound:
+                    within = False
+                    break
+        self.requests_total += 1
+        if within:
+            self.requests_within_slo += 1
+            self.goodput_tokens += int(tokens)
+        self.evaluate()
+        return within
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self) -> Dict[str, Dict[str, float]]:
+        """Re-read every windowed percentile against its target, update
+        the ``breached`` flag, and fire rising-edge callbacks. Returns
+        ``{target_key: {value, target, breached}}``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        now_breached = []
+        for key, metric, q, bound in self._parsed:
+            v = self.windows[metric].percentile(q)
+            hit = math.isfinite(v) and v > bound
+            out[key] = {"value": v, "target": bound, "breached": hit}
+            if hit:
+                now_breached.append((key, v, bound))
+        new_keys = tuple(k for k, _v, _b in now_breached)
+        for key, v, bound in now_breached:
+            if key not in self.breached_metrics:
+                self.breaches += 1
+                for cb in self._callbacks:
+                    cb(key, v, bound)
+        self.breached_metrics = new_keys
+        self.breached = bool(new_keys)
+        return out
+
+    # ------------------------------------------------------------- reporting
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``GET /slo`` payload: windowed p50/p90/p99 per metric,
+        target evaluation, goodput counters, breach state."""
+        evaluation = self.evaluate()
+        windowed = {}
+        for metric, w in self.windows.items():
+            h = w.merged()
+            windowed[metric] = {
+                "count": h.count,
+                "p50": h.percentile(50.0),
+                "p90": h.percentile(90.0),
+                "p99": h.percentile(99.0),
+            }
+        total = self.requests_total
+        return {
+            "window_s": self.window_s,
+            "targets": dict(self.targets),
+            "evaluation": evaluation,
+            "windowed": windowed,
+            "goodput": {
+                "requests_total": total,
+                "requests_within_slo": self.requests_within_slo,
+                "goodput_ratio": (self.requests_within_slo / total) if total else 0.0,
+                "goodput_tokens": self.goodput_tokens,
+            },
+            "breached": self.breached,
+            "breaches": self.breaches,
+            "breached_metrics": list(self.breached_metrics),
+        }
+
+    def brief(self) -> Dict[str, Any]:
+        """The compact per-replica view ``/health`` embeds."""
+        total = self.requests_total
+        out: Dict[str, Any] = {
+            "breached": self.breached,
+            "goodput_ratio": (self.requests_within_slo / total) if total else 0.0,
+        }
+        for key, metric, q, _bound in self._parsed:
+            out[key] = self.windows[metric].percentile(q)
+        return out
+
+    def prom_counters(self) -> Dict[str, int]:
+        """``clt_slo_*`` counter families for ``GET /metrics``."""
+        return {
+            "slo_requests_total": self.requests_total,
+            "slo_requests_within": self.requests_within_slo,
+            "slo_goodput_tokens": self.goodput_tokens,
+            "slo_breaches_total": self.breaches,
+        }
+
+    def prom_gauges(self) -> Dict[str, float]:
+        """``clt_slo_*`` gauge families: windowed value + target per SLO
+        key, goodput ratio, live breach flag. NaN values (empty window)
+        are skipped by ``prometheus_exposition`` — correct Prometheus
+        behavior for 'no data yet'."""
+        total = self.requests_total
+        gauges: Dict[str, float] = {
+            "slo_breached": 1.0 if self.breached else 0.0,
+            "slo_goodput_ratio": (self.requests_within_slo / total) if total else 0.0,
+            "slo_window_seconds": self.window_s,
+        }
+        for key, metric, q, bound in self._parsed:
+            gauges[f"slo_{key}_seconds"] = self.windows[metric].percentile(q)
+            gauges[f"slo_{key}_target_seconds"] = bound
+        return gauges
+
+    # ---------------------------------------------------------------- fleet
+    @staticmethod
+    def merged_snapshot(trackers: Iterable["SLOTracker"]) -> Dict[str, Any]:
+        """Fold per-replica trackers into one fleet view (the router's
+        merged ``/metrics`` and ``/slo``): windows merge bucket-wise,
+        counters sum, ``breached`` is any-replica. Requires identical
+        window configuration across replicas (the router builds them that
+        way)."""
+        trackers = list(trackers)
+        if not trackers:
+            return {}
+        first = trackers[0]
+        windowed = {}
+        for metric in first.windows:
+            h = Histogram(first.windows[metric].bounds)
+            for t in trackers:
+                h.merge(t.windows[metric].merged())
+            windowed[metric] = {
+                "count": h.count,
+                "p50": h.percentile(50.0),
+                "p90": h.percentile(90.0),
+                "p99": h.percentile(99.0),
+            }
+        total = sum(t.requests_total for t in trackers)
+        within = sum(t.requests_within_slo for t in trackers)
+        return {
+            "window_s": first.window_s,
+            "targets": dict(first.targets),
+            "windowed": windowed,
+            "goodput": {
+                "requests_total": total,
+                "requests_within_slo": within,
+                "goodput_ratio": (within / total) if total else 0.0,
+                "goodput_tokens": sum(t.goodput_tokens for t in trackers),
+            },
+            "breached": any(t.breached for t in trackers),
+            "breaches": sum(t.breaches for t in trackers),
+            "breached_metrics": sorted(
+                {m for t in trackers for m in t.breached_metrics}
+            ),
+        }
+
+    @staticmethod
+    def merged_prom(trackers: Iterable["SLOTracker"]) -> Tuple[Dict[str, int], Dict[str, float]]:
+        """(counters, gauges) for the router's merged exposition. Gauge
+        percentiles come from the bucket-wise window merge; targets must
+        agree across replicas (first replica's are rendered)."""
+        trackers = list(trackers)
+        if not trackers:
+            return {}, {}
+        first = trackers[0]
+        counters = {
+            "slo_requests_total": sum(t.requests_total for t in trackers),
+            "slo_requests_within": sum(t.requests_within_slo for t in trackers),
+            "slo_goodput_tokens": sum(t.goodput_tokens for t in trackers),
+            "slo_breaches_total": sum(t.breaches for t in trackers),
+        }
+        total = counters["slo_requests_total"]
+        gauges: Dict[str, float] = {
+            "slo_breached": 1.0 if any(t.breached for t in trackers) else 0.0,
+            "slo_goodput_ratio": (counters["slo_requests_within"] / total) if total else 0.0,
+            "slo_window_seconds": first.window_s,
+        }
+        merged: Dict[str, Histogram] = {}
+        for key, metric, q, bound in first._parsed:
+            if metric not in merged:
+                h = Histogram(first.windows[metric].bounds)
+                for t in trackers:
+                    h.merge(t.windows[metric].merged())
+                merged[metric] = h
+            gauges[f"slo_{key}_seconds"] = merged[metric].percentile(q)
+            gauges[f"slo_{key}_target_seconds"] = bound
+        return counters, gauges

@@ -1,0 +1,385 @@
+"""Host-side request tracing: spans, a flight recorder, Chrome export.
+
+PR 5 gave each request four lifecycle stamps; this module decomposes the
+interval BETWEEN those stamps into a causal span tree — queue, prefill
+chunks, decode megasteps (with speculative draft/verify attribution),
+prefix-cache and page-refund events — so "why was this request slow?"
+has an answer minutes after the fact.
+
+Design constraints, in order:
+
+- **Zero device traffic.** Everything here is ``time.monotonic()``
+  arithmetic and python-object bookkeeping on the host. The PR-5/8/9
+  transfer-counter gates assert byte-identical device traffic with
+  tracing on vs off.
+- **Bounded memory.** Finished spans land in a ring buffer (the *flight
+  recorder*, ``max_spans`` deep) — a serving process that runs for weeks
+  keeps the recent past, not the whole history. A ``sample_every`` knob
+  traces 1-in-N requests; unsampled requests cost one modulo.
+- **Trace-id = request id.** No id generation, no context propagation
+  machinery: the engine already threads the request everywhere, and the
+  router's ``rid % n_replicas`` ownership convention means the id alone
+  names the replica.
+
+Spans come in three kinds, matching the Chrome trace-event phases they
+export to: ``async`` for request lifecycles (concurrent requests overlap
+freely; Perfetto gives each ``id`` its own sub-track), ``complete`` for
+engine phases (prefill / megastep — serialized per replica, so they tile
+a per-replica track cleanly), and ``instant`` for point events
+(prefix-cache hit/evict, page refund, first token).
+
+``export_chrome`` writes the standard trace-event JSON — load it at
+https://ui.perfetto.dev — with one named track per replica/phase and the
+request id on every event's ``args``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import itertools
+import json
+import re
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+from .core import EventLog
+
+#: span-name grammar: lowercase dotted identifiers
+#: (tests/test_core/test_metric_names.py lints every emitted name)
+SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+
+
+@dataclasses.dataclass
+class Span:
+    """One named interval of one trace. ``trace_id`` is the request id;
+    ``parent_id`` is the ``span_id`` of the enclosing span (None for the
+    root). Times are ``time.monotonic()`` seconds."""
+
+    trace_id: int
+    span_id: int
+    parent_id: Optional[int]
+    name: str
+    t0: float
+    t1: Optional[float] = None
+    track: str = "engine"
+    kind: str = "complete"  # complete | async | instant
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def closed(self) -> bool:
+        return self.t1 is not None
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "t0": self.t0,
+            "t1": self.t1,
+            "duration_s": self.duration,
+            "track": self.track,
+            "kind": self.kind,
+            "args": dict(self.args),
+        }
+
+
+class Tracer:
+    """Span recorder with a bounded flight recorder and 1-in-N sampling.
+
+    One ``Tracer`` instance may be SHARED by a router and all its replica
+    engines — that is how router placement spans stitch over replica
+    spans into one trace (all mutation is under one lock; engine step
+    threads and router handler threads both write).
+
+    ``sample_every=N`` records every request whose id is ≡ 0 (mod N).
+    With the router's ``rid % n_replicas`` ownership convention every
+    replica still contributes sampled requests as long as ``sample_every``
+    and ``n_replicas`` are not both even — prefer odd sample rates (or 1)
+    behind a router.
+    """
+
+    #: patchable clock seam — keep in sync with ``Telemetry._clock``
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(
+        self,
+        sample_every: int = 1,
+        max_spans: int = 4096,
+        event_log: Union[None, str, EventLog] = None,
+    ):
+        if sample_every < 1:
+            raise ValueError(f"sample_every={sample_every} must be >= 1")
+        if max_spans < 1:
+            raise ValueError(f"max_spans={max_spans} must be >= 1")
+        self.sample_every = int(sample_every)
+        self.max_spans = int(max_spans)
+        self.events: Optional[EventLog] = (
+            EventLog(event_log) if isinstance(event_log, str) else event_log
+        )
+        self._buf: collections.deque = collections.deque(maxlen=self.max_spans)
+        self._roots: Dict[int, Span] = {}
+        self._open: Dict[int, List[Span]] = {}  # trace_id -> open spans, root first
+        self._ids = itertools.count()
+        self._lock = threading.RLock()
+        self.traces_started = 0
+        self.traces_sampled = 0
+        self.spans_recorded = 0
+
+    # ------------------------------------------------------------- recording
+    def sampled(self, trace_id: int) -> bool:
+        return trace_id % self.sample_every == 0
+
+    def begin(
+        self,
+        trace_id: int,
+        name: str = "request",
+        t0: Optional[float] = None,
+        track: str = "engine",
+        **args,
+    ) -> Optional[Span]:
+        """Open the root span of a trace (idempotent — a group follower
+        materialized mid-flight re-anchors on the same root). Returns None
+        when the trace is not sampled."""
+        with self._lock:
+            if trace_id not in self._roots:
+                self.traces_started += 1
+            if not self.sampled(trace_id):
+                return None
+            root = self._roots.get(trace_id)
+            if root is not None:
+                return root
+            root = Span(trace_id, next(self._ids), None, name,
+                        self._clock() if t0 is None else t0,
+                        track=track, kind="async", args=dict(args))
+            self._roots[trace_id] = root
+            self._open[trace_id] = [root]
+            self.traces_sampled += 1
+            return root
+
+    def start(
+        self,
+        trace_id: int,
+        name: str,
+        parent: Optional[Span] = None,
+        t0: Optional[float] = None,
+        track: str = "engine",
+        kind: str = "complete",
+        **args,
+    ) -> Optional[Span]:
+        """Open a child span (parent defaults to the trace root). Returns
+        None for unsampled traces / unknown roots — callers pass that
+        straight back to :meth:`end`, which tolerates it."""
+        with self._lock:
+            root = self._roots.get(trace_id)
+            if root is None:
+                return None
+            span = Span(trace_id, next(self._ids),
+                        (parent or root).span_id, name,
+                        self._clock() if t0 is None else t0,
+                        track=track, kind=kind, args=dict(args))
+            self._open[trace_id].append(span)
+            return span
+
+    def end(self, span: Optional[Span], t1: Optional[float] = None, **args) -> None:
+        """Close a span and commit it to the flight recorder. No-op for
+        None and for spans already closed (``end_trace`` may have swept
+        them when the request finished inside the span)."""
+        if span is None:
+            return
+        with self._lock:
+            if span.t1 is not None:
+                return
+            span.t1 = self._clock() if t1 is None else t1
+            span.args.update(args)
+            open_spans = self._open.get(span.trace_id)
+            if open_spans is not None and span in open_spans:
+                open_spans.remove(span)
+            self._commit(span)
+
+    def add(
+        self,
+        trace_id: int,
+        name: str,
+        t0: float,
+        t1: float,
+        parent: Optional[Span] = None,
+        track: str = "engine",
+        kind: str = "complete",
+        **args,
+    ) -> Optional[Span]:
+        """Record an already-measured closed interval (the decode megastep
+        path: one wall interval, attributed to every sampled live request
+        after the single host sync)."""
+        with self._lock:
+            root = self._roots.get(trace_id)
+            if root is None:
+                return None
+            span = Span(trace_id, next(self._ids),
+                        (parent or root).span_id, name, t0, t1,
+                        track=track, kind=kind, args=dict(args))
+            self._commit(span)
+            return span
+
+    def instant(
+        self, trace_id: int, name: str, t: Optional[float] = None,
+        track: str = "engine", **args,
+    ) -> Optional[Span]:
+        """A point event inside a trace (cache hit, page refund, …)."""
+        with self._lock:
+            root = self._roots.get(trace_id)
+            if root is None:
+                return None
+            t = self._clock() if t is None else t
+            span = Span(trace_id, next(self._ids), root.span_id, name,
+                        t, t, track=track, kind="instant", args=dict(args))
+            self._commit(span)
+            return span
+
+    def end_trace(self, trace_id: int, t1: Optional[float] = None, **args) -> None:
+        """Close the root (and sweep any still-open children — a request
+        aborted while queued closes its queue span here) so 'every span
+        closed' is a structural invariant of finished traces."""
+        with self._lock:
+            root = self._roots.pop(trace_id, None)
+            open_spans = self._open.pop(trace_id, [])
+            if root is None:
+                return
+            t1 = self._clock() if t1 is None else t1
+            root.args.update(args)
+            for span in reversed(open_spans):  # children first, root last
+                if span.t1 is None:
+                    span.t1 = t1
+                self._commit(span)
+
+    def stitch(
+        self, trace_id: int, name: str, t0: float, t1: float,
+        track: str = "router", **args,
+    ) -> Optional[Span]:
+        """Router-parent stitching: record the placement decision (made
+        BEFORE the replica stamped arrival) as a child span and widen the
+        root to cover it, so child ⊆ parent holds across the router →
+        engine boundary."""
+        with self._lock:
+            root = self._roots.get(trace_id)
+            if root is None:
+                return None
+            if t0 < root.t0:
+                root.t0 = t0
+            return self.add(trace_id, name, t0, t1, track=track, **args)
+
+    @contextlib.contextmanager
+    def span_cm(
+        self, trace_id: int, name: str, track: str = "engine", **args,
+    ) -> Iterator[Optional[Span]]:
+        span = self.start(trace_id, name, track=track, **args)
+        try:
+            yield span
+        finally:
+            self.end(span)
+
+    def _commit(self, span: Span) -> None:
+        # lock held by caller
+        self._buf.append(span)
+        self.spans_recorded += 1
+        if self.events is not None:
+            self.events.emit({"event": "span", **span.as_dict()})
+
+    # --------------------------------------------------------------- reading
+    def spans(self, trace_id: Optional[int] = None) -> List[Span]:
+        """Snapshot of the flight recorder (plus still-open spans), oldest
+        first, optionally filtered to one trace."""
+        with self._lock:
+            out = list(self._buf)
+            for open_spans in self._open.values():
+                out.extend(open_spans)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        out.sort(key=lambda s: (s.t0, s.span_id))
+        return out
+
+    @property
+    def spans_dropped(self) -> int:
+        """Finished spans the ring buffer has already overwritten."""
+        with self._lock:
+            return self.spans_recorded - len(self._buf)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "sample_every": self.sample_every,
+                "max_spans": self.max_spans,
+                "traces_started": self.traces_started,
+                "traces_sampled": self.traces_sampled,
+                "spans_recorded": self.spans_recorded,
+                "spans_dropped": self.spans_recorded - len(self._buf),
+                "spans_buffered": len(self._buf),
+                "traces_open": len(self._roots),
+            }
+
+    # -------------------------------------------------------------- exporters
+    def export_chrome(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Chrome trace-event JSON (Perfetto-loadable). Lifecycle spans
+        export as async ``b``/``e`` pairs keyed by request id; engine
+        phases as ``X`` complete events; instants as ``i``. One named
+        track per ``span.track`` (replica/phase), timestamps in µs
+        relative to the earliest span. Still-open spans are clamped to
+        'now' and flagged ``open`` so a mid-flight dump is loadable."""
+        spans = self.spans()
+        now = self._clock()
+        tracks: List[str] = []
+        for s in spans:
+            if s.track not in tracks:
+                tracks.append(s.track)
+        tid = {t: i + 1 for i, t in enumerate(sorted(tracks))}
+        epoch = min((s.t0 for s in spans), default=0.0)
+        events: List[Dict[str, Any]] = [
+            {"ph": "M", "pid": 0, "tid": 0, "name": "process_name", "ts": 0,
+             "args": {"name": "colossalai_tpu-serving"}}
+        ]
+        for t, i in sorted(tid.items(), key=lambda kv: kv[1]):
+            events.append({"ph": "M", "pid": 0, "tid": i, "ts": 0,
+                           "name": "thread_name", "args": {"name": t}})
+        us = lambda t: round((t - epoch) * 1e6, 3)  # noqa: E731
+        for s in spans:
+            t1 = s.t1 if s.t1 is not None else now
+            args = {"rid": s.trace_id, **s.args}
+            if s.t1 is None:
+                args["open"] = True
+            base = {"name": s.name, "pid": 0, "tid": tid[s.track], "args": args}
+            if s.kind == "async":
+                events.append({**base, "ph": "b", "cat": s.track,
+                               "id": s.trace_id, "ts": us(s.t0)})
+                events.append({**base, "ph": "e", "cat": s.track,
+                               "id": s.trace_id, "ts": us(t1)})
+            elif s.kind == "instant":
+                events.append({**base, "ph": "i", "s": "t", "ts": us(s.t0)})
+            else:
+                events.append({**base, "ph": "X", "ts": us(s.t0),
+                               "dur": round(max(t1 - s.t0, 0.0) * 1e6, 3)})
+        # monotone ts; 'e' sorts after everything else at the same stamp
+        events.sort(key=lambda e: (e["ts"], e["ph"] == "e"))
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(trace, f)
+        return trace
+
+    # ------------------------------------------------------------------ misc
+    def clear(self) -> None:
+        """Drop the flight recorder and all open traces (bench warmup)."""
+        with self._lock:
+            self._buf.clear()
+            self._roots.clear()
+            self._open.clear()
+
+    def close(self) -> None:
+        if self.events is not None:
+            self.events.close()

@@ -8,7 +8,12 @@ from types import SimpleNamespace
 
 from colossalai_tpu.inference.engine import EngineStats
 from colossalai_tpu.inference.telemetry import _HISTOGRAM_SPECS, Telemetry
-from colossalai_tpu.telemetry import METRIC_NAME_RE, TrainMonitor, prometheus_exposition
+from colossalai_tpu.telemetry import (
+    METRIC_NAME_RE,
+    SLOTracker,
+    TrainMonitor,
+    prometheus_exposition,
+)
 
 
 def _family_names(text):
@@ -76,6 +81,20 @@ def _training_names():
         mon.close()
 
 
+def _slo_names():
+    """The ``clt_slo_*`` catalog, rendered as ``GET /metrics`` renders it.
+    One request is recorded first: empty windows yield NaN percentile
+    gauges, which the exposition (correctly) skips — the lint must see
+    the families as they render on a live server."""
+    slo = SLOTracker()
+    slo.record_request(ttft=0.01, itl=0.001, e2e=0.1, queue_wait=0.001,
+                       tokens=4)
+    return _family_names(
+        prometheus_exposition(slo.prom_counters(), slo.prom_gauges(), {},
+                              prefix="clt")
+    )
+
+
 def test_serving_names_match_grammar():
     names = _serving_names()
     assert names  # the catalog is non-empty
@@ -111,6 +130,83 @@ def test_serving_and_training_catalogs_disjoint():
     assert not overlap, f"metric-name collision between renderers: {overlap}"
     overlap = _router_names() & _training_names()
     assert not overlap, f"metric-name collision between renderers: {overlap}"
+
+
+def test_slo_names_match_grammar_and_collide_with_nothing():
+    names = _slo_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+        assert name.startswith("clt_slo_"), name
+    assert {"clt_slo_requests_total", "clt_slo_requests_within",
+            "clt_slo_goodput_tokens", "clt_slo_breaches_total",
+            "clt_slo_breached", "clt_slo_goodput_ratio",
+            "clt_slo_window_seconds", "clt_slo_ttft_p99_seconds",
+            "clt_slo_ttft_p99_target_seconds"} <= names
+    assert not names & _serving_names()
+    assert not names & _training_names()
+
+
+def test_router_metrics_carry_merged_slo_families():
+    """With SLO trackers attached to the replicas, the router's merged
+    exposition grows exactly the ``clt_slo_*`` catalog — same family
+    names as a bare engine, so the dashboard stays interchangeable."""
+    from colossalai_tpu.inference.router import Router
+
+    class _StubEngine:
+        has_work = False
+        prefix_cache = None
+
+        def __init__(self):
+            self.stats = EngineStats()
+            self.telemetry = Telemetry(slo=SLOTracker())
+            self.waiting = []
+            self.prefilling = {}
+            self.running = {}
+            self.allocator = SimpleNamespace(num_free=0)
+
+    router = Router([_StubEngine(), _StubEngine()], policy="least_loaded")
+    try:
+        for e in router.engines:
+            e.telemetry.slo.record_request(ttft=0.01, itl=0.001, tokens=2)
+        names = _family_names(router.metrics_text())
+    finally:
+        router.close()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+    assert _slo_names() <= names
+
+
+def test_span_names_match_grammar_over_engine_smoke():
+    """Every span name a traced engine run emits obeys the span grammar
+    and stays inside the documented catalog — a new span name added
+    without updating the docs/catalog fails here."""
+    import jax
+    import jax.numpy as jnp
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+    from colossalai_tpu.telemetry import SPAN_NAME_RE
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    eng = LLMEngine(params, cfg, max_batch_size=2, max_seq_len=64,
+                    block_size=16, prefill_buckets=(16, 32),
+                    megastep_k=2, prefix_cache=True, tracer=True)
+    eng.generate([[1, 2, 3], [1, 2, 3, 4, 5]],
+                 GenerationConfig(max_new_tokens=6))
+    spans = eng.telemetry.tracer.spans()
+    assert spans
+    names = {s.name for s in spans}
+    for name in names:
+        assert SPAN_NAME_RE.match(name), name
+    # the documented catalog (docs/observability.md) — extend both or
+    # neither
+    catalog = {"request", "queue", "prefill", "prefill_chunk",
+               "prefill_stall", "first_token", "decode_megastep",
+               "spec_megastep", "prefix_cache_hit", "prefix_cache_evict",
+               "page_refund", "router.place", "router.sync"}
+    assert names <= catalog, names - catalog
 
 
 def test_exposition_skips_unrenderable_values():

@@ -1,0 +1,238 @@
+"""Sliding-window SLO primitives (PR 10): WindowedHistogram ring
+semantics, SLOTracker breach edges + goodput accounting, and the core
+hardening that rode along (non-finite Histogram drops, EventLog size
+rotation).
+
+The load-bearing property is windowed-vs-cumulative DIVERGENCE: after a
+slow burst ages out of the window, the windowed p99 recovers while the
+cumulative histogram remembers the burst forever — that recovery is the
+whole reason the SLO tracker exists.
+"""
+
+import json
+import math
+
+import pytest
+
+from colossalai_tpu.telemetry import (
+    DEFAULT_TARGETS,
+    SLO_TARGET_RE,
+    EventLog,
+    Histogram,
+    SLOTracker,
+    WindowedHistogram,
+)
+
+BOUNDS = Histogram.log_spaced(1e-4, 600.0, 48).bounds
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """Pin the window clock so tests drive time by hand."""
+    state = {"t": 1_000_000.0}
+    monkeypatch.setattr(
+        WindowedHistogram, "_clock", staticmethod(lambda: state["t"]))
+    monkeypatch.setattr(
+        SLOTracker, "_clock", staticmethod(lambda: state["t"]))
+    return state
+
+
+# --------------------------------------------------------- WindowedHistogram
+def test_windowed_matches_cumulative_inside_window(clock):
+    """While every sample is younger than the window, the windowed
+    percentile IS the cumulative percentile (same bounds, same data)."""
+    w = WindowedHistogram(BOUNDS, interval_s=10.0, n_intervals=6)
+    cum = Histogram(BOUNDS)
+    samples = [0.001 * (i % 7 + 1) for i in range(200)]
+    for i, s in enumerate(samples):
+        clock["t"] += 0.25  # 50s total — inside the 60s window
+        w.observe(s)
+        cum.observe(s)
+    assert w.count == cum.count == len(samples)
+    for q in (50.0, 90.0, 99.0):
+        assert w.percentile(q) == cum.percentile(q)
+
+
+def test_windowed_diverges_from_cumulative_after_burst_ages_out(clock):
+    """A slow burst, then the window drains, then fast traffic: windowed
+    p99 recovers to the fast regime; cumulative p99 never forgets."""
+    w = WindowedHistogram(BOUNDS, interval_s=10.0, n_intervals=6)
+    cum = Histogram(BOUNDS)
+    for _ in range(100):  # the burst: 5s TTFTs
+        w.observe(5.0)
+        cum.observe(5.0)
+    assert w.percentile(99) > 1.0
+    clock["t"] += 61.0  # burst ages out of the 60s window
+    assert w.count == 0
+    for _ in range(100):  # recovery traffic: 10ms
+        w.observe(0.01)
+        cum.observe(0.01)
+    assert w.percentile(99) < 0.05  # windowed view recovered
+    assert cum.percentile(99) > 1.0  # cumulative still reports the burst
+
+
+def test_windowed_lazy_advance_resets_skipped_slots(clock):
+    w = WindowedHistogram(BOUNDS, interval_s=10.0, n_intervals=6)
+    for _ in range(6):  # one sample per interval fills the ring
+        w.observe(1.0)
+        clock["t"] += 10.0
+    # the last += 10 already expired the oldest slot
+    assert w.count == 5
+    clock["t"] += 30.0  # skip 3 intervals without observing
+    assert w.count == 2
+    clock["t"] += 600.0  # idle far past the window: reads as empty
+    assert w.count == 0
+    assert math.isnan(w.percentile(99))
+    w.observe(2.0)
+    assert w.count == 1 and w.percentile(50) == 2.0
+
+
+def test_windowed_validation_and_reset(clock):
+    with pytest.raises(ValueError):
+        WindowedHistogram(BOUNDS, interval_s=0.0)
+    with pytest.raises(ValueError):
+        WindowedHistogram(BOUNDS, n_intervals=0)
+    w = WindowedHistogram(BOUNDS, interval_s=10.0, n_intervals=6)
+    assert w.window_s == 60.0
+    w.observe(1.0)
+    w.reset()
+    assert w.count == 0
+
+
+# ---------------------------------------------------------------- SLOTracker
+def test_target_key_grammar_and_validation():
+    for key in DEFAULT_TARGETS:
+        assert SLO_TARGET_RE.match(key), key
+    assert SLO_TARGET_RE.match("queue_wait_p99.9")
+    for bad in ("tft_p99", "ttft_p999", "ttft", "TTFT_p99", "ttft_p"):
+        with pytest.raises(ValueError):
+            SLOTracker(targets={bad: 1.0})
+    for bad_bound in (0.0, -1.0, float("nan"), float("inf")):
+        with pytest.raises(ValueError):
+            SLOTracker(targets={"ttft_p99": bad_bound})
+    with pytest.raises(ValueError):
+        SLOTracker(window_s=0.0)
+
+
+def test_breach_rising_edge_callbacks_and_recovery(clock):
+    fired = []
+    t = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0,
+                   on_breach=lambda k, v, b: fired.append((k, v, b)))
+    assert not t.breached
+    for _ in range(5):
+        assert t.record_request(ttft=2.0, tokens=4) is False
+    assert t.breached and t.breached_metrics == ("ttft_p99",)
+    # edge-triggered: five breaching requests, ONE breach + ONE callback
+    assert t.breaches == 1 and len(fired) == 1
+    key, value, bound = fired[0]
+    assert key == "ttft_p99" and value > bound == 0.5
+
+    clock["t"] += 61.0  # the bad window drains
+    assert t.record_request(ttft=0.01, tokens=4) is True
+    assert not t.breached and t.breached_metrics == ()
+
+    for _ in range(3):  # a second burst is a second edge
+        t.record_request(ttft=2.0, tokens=4)
+    assert t.breaches == 2 and len(fired) == 2
+
+
+def test_goodput_accounting(clock):
+    t = SLOTracker(targets={"ttft_p99": 0.5, "itl_p99": 0.05}, window_s=60.0)
+    for _ in range(3):  # good: inside every targeted bound
+        assert t.record_request(ttft=0.1, itl=0.01, tokens=10) is True
+    # bad latency: counted, not goodput
+    assert t.record_request(ttft=0.1, itl=0.2, tokens=10) is False
+    # aborted: shed load is never good load, even with fast latencies
+    assert t.record_request(ttft=0.1, itl=0.01, tokens=5,
+                            reason="aborted") is False
+    # untargeted metrics don't affect attainment
+    assert t.record_request(ttft=0.1, e2e=999.0, tokens=7) is True
+    snap = t.snapshot()
+    good = snap["goodput"]
+    assert good["requests_total"] == 6
+    assert good["requests_within_slo"] == 4
+    assert good["goodput_tokens"] == 37
+    assert good["goodput_ratio"] == pytest.approx(4 / 6)
+    assert snap["windowed"]["ttft"]["count"] == 6
+    assert snap["window_s"] == 60.0
+
+
+def test_prom_views_and_brief(clock):
+    t = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0)
+    t.record_request(ttft=0.1, tokens=3)
+    counters = t.prom_counters()
+    assert counters["slo_requests_total"] == 1
+    assert counters["slo_requests_within"] == 1
+    assert counters["slo_goodput_tokens"] == 3
+    gauges = t.prom_gauges()
+    assert gauges["slo_breached"] == 0.0
+    assert gauges["slo_window_seconds"] == 60.0
+    assert gauges["slo_ttft_p99_target_seconds"] == 0.5
+    assert math.isfinite(gauges["slo_ttft_p99_seconds"])
+    brief = t.brief()
+    assert brief["breached"] is False
+    assert brief["goodput_ratio"] == 1.0
+    assert "ttft_p99" in brief
+
+
+def test_merged_snapshot_sums_fleet(clock):
+    a = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0)
+    b = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0)
+    for _ in range(4):
+        a.record_request(ttft=0.1, tokens=2)
+    for _ in range(2):
+        b.record_request(ttft=2.0, tokens=2)  # replica b is breaching
+    merged = SLOTracker.merged_snapshot([a, b])
+    assert merged["goodput"]["requests_total"] == 6
+    assert merged["goodput"]["requests_within_slo"] == 4
+    assert merged["goodput"]["goodput_tokens"] == 8
+    assert merged["windowed"]["ttft"]["count"] == 6
+    assert merged["breached"] is True  # any-replica semantics
+    assert merged["breached_metrics"] == ["ttft_p99"]
+    counters, gauges = SLOTracker.merged_prom([a, b])
+    assert counters["slo_requests_total"] == 6
+    assert gauges["slo_breached"] == 1.0
+    # bucket-wise merge: fleet p99 sees replica b's slow tail
+    assert gauges["slo_ttft_p99_seconds"] > 0.5
+    assert SLOTracker.merged_snapshot([]) == {}
+    assert SLOTracker.merged_prom([]) == ({}, {})
+
+
+# ------------------------------------------------------- core hardening
+def test_histogram_drops_non_finite(clock):
+    h = Histogram([1.0, 2.0])
+    for bad in (float("nan"), float("inf"), float("-inf")):
+        h.observe(bad)
+    assert h.count == 0 and h.dropped == 3
+    h.observe(1.5)
+    assert h.count == 1 and h.sum == 1.5
+    other = Histogram([1.0, 2.0])
+    other.observe(float("nan"))
+    h.merge(other)
+    assert h.dropped == 4
+    assert h.snapshot()["dropped"] == 4
+    h.reset()
+    assert h.dropped == 0
+
+
+def test_event_log_rotates_at_max_bytes(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    with pytest.raises(ValueError):
+        EventLog(str(path), max_bytes=0)
+    log = EventLog(str(path), max_bytes=256)
+    n = 40
+    for i in range(n):
+        log.emit({"event": "x", "i": i, "pad": "p" * 16})
+    log.close()
+    rotated = tmp_path / "ev.jsonl.1"
+    assert rotated.exists()
+    # the live file respects the cap
+    assert path.stat().st_size <= 256
+    # one-deep rotation is flight-recorder semantics: older overflow is
+    # discarded, but what's kept is a CONTIGUOUS suffix of the stream
+    # ending at the newest record — no torn lines, no gaps
+    records = EventLog.read(str(rotated)) + EventLog.read(str(path))
+    got = [r["i"] for r in records]
+    assert got == list(range(n - len(got), n))
+    for r in records:
+        json.dumps(r)  # every line round-trips
