@@ -774,22 +774,24 @@ def measure_router(cfg=None, n_replicas=(1, 2), bs_each: int = 4,
 
 def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
                      new_tokens: int = 16, k: int = 4,
-                     factors=(2, 5, 10)):
+                     factors=(1, 2, 5, 10)):
     """Overload behaviour through the SLO window (ROADMAP ground truth):
-    goodput and SLO-attainment fraction at sustained oversubscription.
+    goodput at sustained oversubscription, control OFF vs ON.
 
     Calibrates peak capacity first — a fixed ``bs``-slot engine draining a
     full batch closed-loop gives peak tokens/s, the sustainable request
     rate, and the unloaded latency tails. SLO targets come from that
     calibration (2x the unloaded TTFT/ITL tail: "no worse than twice the
-    empty-system latency"). Each overload factor then replays an OPEN-LOOP
-    arrival schedule at ``factor`` times the sustainable request rate into
-    a fresh engine carrying an ``SLOTracker`` — open loop is the point: a
-    closed-loop client self-throttles and hides exactly the queue growth
-    that breaches TTFT. Reported per factor: raw tokens/s, goodput
-    tokens/s (tokens from requests that met every target), the
-    SLO-attainment fraction, the windowed TTFT p99, and whether the
-    tracker's breach flag latched during the run."""
+    empty-system latency"). Each overload factor then replays the SAME
+    OPEN-LOOP arrival schedule (``factor`` times the sustainable request
+    rate, identical prompts) into two fresh engines — one bare, one
+    running the :class:`~colossalai_tpu.inference.OverloadController`
+    loop (shedding + preemption + adaptive draft) — and reports both arms
+    side by side plus the controlled/uncontrolled goodput ratio. Open
+    loop is the point: a closed-loop client self-throttles and hides
+    exactly the queue growth that breaches TTFT. ``factors`` should
+    include 1: at nominal load the controller must be a near-no-op
+    (gain ≈ 1), which the tier-1 overload smoke pins."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -802,23 +804,29 @@ def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
     model = LlamaForCausalLM(cfg)
     params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
     rng = np.random.RandomState(0)
+    # 6 batches worth of arrivals per factor: breach detection rides on
+    # OBSERVED finish-time latencies, so the signal lags the queue by
+    # about one system drain — a schedule much shorter than that would
+    # end before the controller can act on it
     prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
-               for _ in range(bs * max(factors))]
+               for _ in range(6 * bs * max(factors))]
     gen = GenerationConfig(max_new_tokens=new_tokens)
 
-    def make_engine(slo=None):
-        # slo=False during warm-up: the throwaway requests pay program
-        # compilation and would poison the tracker's windows with
-        # compile-time TTFTs; the real tracker attaches after the warm
+    def make_engine(slo=None, overload=False):
+        # the controller registers breach callbacks at construction, so
+        # the tracker must ride in from the start; slo.reset() below
+        # drops the compile-poisoned warm-up samples instead
         e = LLMEngine(params, cfg, max_batch_size=bs, max_seq_len=512,
-                      block_size=32, megastep_k=k, slo=False)
+                      block_size=32, megastep_k=k, prefix_cache=True,
+                      slo=(slo if slo is not None else False),
+                      overload=(True if overload else None))
         # warm the prefill bucket + K-step megastep off the clock; the
         # XOR'd family keeps the timed prompts out of any cache
         throwaway = [[int(t) ^ 1 for t in prompts[0]]] * bs
         e.generate([list(p) for p in throwaway],
                    GenerationConfig(max_new_tokens=k + 2))
         if slo is not None:
-            e.telemetry.slo = slo
+            slo.reset()  # drop warm-up samples + any compile-time breach
         return e
 
     # -- calibration: closed-loop full batch = peak sustainable rate
@@ -852,15 +860,10 @@ def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
     targets = {"ttft_p99": max(2.0 * ttft_tail, 1e-3),
                "itl_p99": max(4.0 * itl_tail, 1e-4)}
 
-    out = {
-        "peak_tokens_per_s": round(peak_tps, 1),
-        "peak_req_per_s": round(peak_req_rate, 2),
-        "targets_ms": {kk: round(1e3 * v, 1) for kk, v in targets.items()},
-    }
-    for factor in factors:
+    def run_arm(factor, overload):
         slo = SLOTracker(targets=dict(targets), window_s=30.0)
-        eng = make_engine(slo=slo)
-        n_req = bs * factor
+        eng = make_engine(slo=slo, overload=overload)
+        n_req = 6 * bs * factor
         interarrival = 1.0 / (factor * peak_req_rate)
         i = toks = 0
         t0 = time.perf_counter()
@@ -878,7 +881,7 @@ def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
         snap = slo.snapshot()
         good = snap["goodput"]
         w_ttft = snap["windowed"]["ttft"]
-        out[f"x{factor}"] = {
+        arm = {
             "n_requests": n_req,
             "tokens_per_s": round(toks / dt, 1),
             "goodput_tokens_per_s": round(good["goodput_tokens"] / dt, 1),
@@ -889,6 +892,29 @@ def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
                 round(1e3 * w_ttft["p99"], 1) if w_ttft["count"] else None),
             "breached": snap["breached"],
             "breaches": snap["breaches"],
+        }
+        if overload:
+            s = eng.stats
+            arm["shed"] = s.requests_shed
+            arm["preempted"] = s.requests_preempted
+            arm["resumed"] = s.requests_resumed
+            arm["draft_len_adjustments"] = s.spec_draft_len_adjustments
+        return arm
+
+    out = {
+        "peak_tokens_per_s": round(peak_tps, 1),
+        "peak_req_per_s": round(peak_req_rate, 2),
+        "targets_ms": {kk: round(1e3 * v, 1) for kk, v in targets.items()},
+    }
+    for factor in factors:
+        un = run_arm(factor, overload=False)
+        ctl = run_arm(factor, overload=True)
+        out[f"x{factor}"] = {
+            "uncontrolled": un,
+            "controlled": ctl,
+            "goodput_gain": round(
+                ctl["goodput_tokens_per_s"]
+                / max(un["goodput_tokens_per_s"], 1e-9), 3),
         }
     return out
 
@@ -1082,8 +1108,9 @@ def child_main():
         except Exception as e:
             print(f"router bench failed: {e}", file=sys.stderr)
         try:
-            # overload ground truth: goodput + SLO-attainment fraction at
-            # 2x/5x/10x sustained oversubscription vs calibrated peak
+            # overload ground truth: goodput + SLO attainment at 1x/2x/
+            # 5x/10x the calibrated peak, control OFF vs ON (shedding +
+            # preemption + adaptive speculation) on the same schedules
             extras["overload"] = measure_overload()
         except Exception as e:
             print(f"overload bench failed: {e}", file=sys.stderr)
@@ -1170,7 +1197,7 @@ def cpu_child_main():
         print(f"cpu router bench failed: {e}", file=sys.stderr)
     try:
         extras["overload_cpu"] = measure_overload(
-            bs=2, prompt_len=32, new_tokens=12, factors=(2, 5))
+            bs=2, prompt_len=32, new_tokens=12, factors=(1, 2, 5))
     except Exception as e:
         print(f"cpu overload bench failed: {e}", file=sys.stderr)
     # compact headline for the supervisor's final line: the driver records
@@ -1191,12 +1218,14 @@ def cpu_child_main():
     if "shared_prefix_ttft_ms" in rtr:
         summary["router_shared_prefix_ttft_ms"] = rtr["shared_prefix_ttft_ms"]
     ov = extras.get("overload_cpu", {})
-    for fk in ("x2", "x5", "x10"):
+    for fk in ("x1", "x2", "x5", "x10"):
         if fk in ov:
-            summary[f"overload_{fk}_slo_attainment"] = \
-                ov[fk]["slo_attainment"]
-            summary[f"overload_{fk}_goodput_tokens_per_s"] = \
-                ov[fk]["goodput_tokens_per_s"]
+            for arm in ("uncontrolled", "controlled"):
+                summary[f"overload_{fk}_{arm}_slo_attainment"] = \
+                    ov[fk][arm]["slo_attainment"]
+                summary[f"overload_{fk}_{arm}_goodput_tokens_per_s"] = \
+                    ov[fk][arm]["goodput_tokens_per_s"]
+            summary[f"overload_{fk}_goodput_gain"] = ov[fk]["goodput_gain"]
     print(json.dumps({
         "metric": "cpu_serving_fallback", "value": 0.0, "unit": "MFU",
         "vs_baseline": 0.0, "cpu_fallback": True, "summary": summary,

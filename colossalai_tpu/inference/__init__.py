@@ -24,6 +24,11 @@ from .paged_modeling import (
     sample_tokens,
     verify_paged,
 )
+from .overload import (
+    SHED_POLICIES,
+    OverloadConfig,
+    OverloadController,
+)
 from .prefix_cache import PrefixCache
 from .router import ROUTER_POLICIES, Router, make_router_server
 from .server import make_server
@@ -39,6 +44,7 @@ from .telemetry import (
     prometheus_exposition,
 )
 from .speculative import (
+    DraftLenController,
     SpeculativeEngine,
     SpecStats,
     decode_spec_megastep,
@@ -78,6 +84,10 @@ __all__ = [
     "ROUTER_POLICIES",
     "Router",
     "extend_step",
+    "DraftLenController",
+    "OverloadConfig",
+    "OverloadController",
+    "SHED_POLICIES",
     "SpeculativeEngine",
     "SpecStats",
     "FINISH_REASONS",

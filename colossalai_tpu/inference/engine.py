@@ -66,6 +66,7 @@ from colossalai_tpu.models.llama import LlamaConfig
 from colossalai_tpu.utils.profiler import annotate, step_annotation
 
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
+from .overload import OverloadConfig, OverloadController
 from .prefix_cache import PrefixCache
 from .telemetry import NullTelemetry, SLOTracker, Telemetry, Tracer
 from .paged_modeling import (
@@ -74,7 +75,7 @@ from .paged_modeling import (
     prefill_paged,
     sample_tokens,
 )
-from .speculative import decode_spec_megastep, self_draft_params
+from .speculative import DraftLenController, decode_spec_megastep, self_draft_params
 
 
 @dataclasses.dataclass
@@ -127,6 +128,12 @@ class Request:
     #: per-request speculative accounting (attributed at each megastep sync)
     spec_drafted: int = 0
     spec_accepted: int = 0
+    #: acceptance-adaptive speculation (overload control): EWMA of this
+    #: request's observed draft acceptance rate, None until first observed
+    spec_accept_ewma: Optional[float] = None
+    #: the draft_len the acceptance controller recommends for this
+    #: request (0 = no recommendation yet — use the engine's configured max)
+    spec_draft_rec: int = 0
 
     @property
     def n_samples(self) -> int:
@@ -187,6 +194,20 @@ class EngineStats:
     requests_aborted: int = 0
     #: completed requests that ended early because the page pool ran dry
     requests_truncated: int = 0
+    # ---- overload control (overload=True/OverloadConfig): the SLO
+    # control loop's own accounting. The terminal invariant widens to
+    # completed + aborted + shed == submitted.
+    #: requests rejected by admission control under a latched TTFT/
+    #: queue-wait breach (finish_reason="shed")
+    requests_shed: int = 0
+    #: running sequences evicted back to the waiting queue (pages donated
+    #: to the prefix cache when present, so resume is a cache hit)
+    requests_preempted: int = 0
+    #: preempted requests re-admitted (each resume counts once)
+    requests_resumed: int = 0
+    #: megasteps where the acceptance controller changed some request's
+    #: recommended draft_len
+    spec_draft_len_adjustments: int = 0
     # ---- KV-pool memory gauges (host-side: pool .nbytes + allocator
     # bookkeeping — refreshing them moves NO device data, so telemetry
     # on/off stays byte-identical on transfers). kv_pool_bytes counts the
@@ -323,6 +344,7 @@ class LLMEngine:
         event_log: Optional[str] = None,
         tracer: Union[bool, Tracer, None] = None,
         slo: Union[bool, SLOTracker, None] = True,
+        overload: Union[bool, OverloadConfig, None] = None,
         moe_impl: str = "auto",
         kv_dtype: str = "bf16",
     ):
@@ -412,8 +434,12 @@ class LLMEngine:
                     "prefix-cache hits — build the engine with "
                     "prefix_cache=True"
                 )
+            # ties (same saved pages — incl. the all-cold queue) break by
+            # priority (default 0), then FIFO: a high-priority arrival is
+            # not stuck behind equally-warm background work
             self._policy_key = lambda req: (
-                -self.prefix_cache.peek(req.prompt_ids), req.request_id)
+                -self.prefix_cache.peek(req.prompt_ids + req.output_ids),
+                -req.priority, req.request_id)
         else:
             try:
                 self._policy_key = SCHEDULER_POLICIES[scheduler_policy]
@@ -646,6 +672,9 @@ class LLMEngine:
         self._ids = itertools.count()
         self.waiting: List[Request] = []
         self.running: Dict[int, Request] = {}  # slot -> request
+        #: requests shed at admission control, drained by the next step()
+        #: into its finished list (so pollers/servers see their terminal)
+        self._shed_done: List[Request] = []
         #: slot -> request mid-chunked-prefill (not yet decoding)
         self.prefilling: Dict[int, Request] = {}
         #: follower slots held while a group leader's chunked prefill runs
@@ -661,6 +690,31 @@ class LLMEngine:
         self._gen_topp = np.ones((max_batch_size,), np.float32)
         self._gen_sample = np.zeros((max_batch_size,), bool)
         self.stats = EngineStats()
+        # ---- overload control (the SLO control loop): overload=True for
+        # the default OverloadConfig, or pass one. The controller reads the
+        # tracker's breach state (shedding), drives preemption, and — with
+        # draft_len > 0 — makes the per-tick draft_len acceptance-adaptive.
+        # Every decision is host-side scheduling: when no action fires the
+        # device traffic is byte-identical to a control-free engine.
+        self._overload: Optional[OverloadController] = None
+        self._draft_ctl: Optional[DraftLenController] = None
+        if overload:
+            ocfg = (overload if isinstance(overload, OverloadConfig)
+                    else OverloadConfig())
+            slo_tracker = getattr(self.telemetry, "slo", None)
+            if slo_tracker is None:
+                raise ValueError(
+                    "overload control acts on SLO breach state — keep "
+                    "telemetry and slo enabled (or pass an SLOTracker) "
+                    "when setting overload="
+                )
+            self._overload = OverloadController(slo_tracker, ocfg)
+            if ocfg.adaptive_draft and self.draft_len > 0:
+                self._draft_ctl = DraftLenController(
+                    self.draft_len, ewma=ocfg.draft_ewma,
+                    raise_at=ocfg.draft_raise_at,
+                    lower_at=ocfg.draft_lower_at,
+                )
         # pool residency is static for the engine's lifetime: every page
         # tensor (target + draft, int8 scales included) counts
         self._kv_pool_nbytes = int(sum(
@@ -828,19 +882,50 @@ class LLMEngine:
             )
         self.telemetry.on_submitted(req)
         self.stats.requests_submitted += n_samples
-        if self.prefix_cache is not None:
-            # walk the radix tree now (pins the matched path); _admit
-            # re-walks so later donations extend a queued request's hit
-            req.cache_node, req.cached_blocks = \
-                self.prefix_cache.match(prompt_ids)
         if n_samples > 1:
             req.group_ids = [req.request_id] + [
                 next(self._ids) for _ in range(n_samples - 1)
             ]
+        # overload control: under a latched admission-side breach with a
+        # full queue, one request is shed here (maybe this one) — its id(s)
+        # are still returned, and the next step() reports it finished with
+        # finish_reason="shed"
+        if self._admission_control(req) is not req:
+            if self.prefix_cache is not None:
+                # walk the radix tree now (pins the matched path); _admit
+                # re-walks so later donations extend a queued request's hit
+                req.cache_node, req.cached_blocks = \
+                    self.prefix_cache.match(prompt_ids)
             self.waiting.append(req)
-            return list(req.group_ids)
-        self.waiting.append(req)
-        return req.request_id
+        return list(req.group_ids) if req.group_ids else req.request_id
+
+    def _admission_control(self, req: Request) -> Optional[Request]:
+        """The shedding gate: while a TTFT/queue-wait target is in breach
+        AND the waiting queue is at the configured depth, shed one request
+        — the arrival itself (``reject_new``) or the oldest request of the
+        lowest priority level among queue + arrival
+        (``oldest_low_priority_first``, so a high-priority arrival can
+        displace queued background work). Returns the shed request (which
+        may be ``req``), or None when nothing was shed."""
+        ctl = self._overload
+        if ctl is None or not ctl.shedding:
+            return None
+        if len(self.waiting) < ctl.shed_queue_depth(self.max_batch):
+            return None
+        victim = req
+        if ctl.config.shed_policy == "oldest_low_priority_first":
+            victim = min(self.waiting + [req],
+                         key=lambda r: (r.priority, r.request_id))
+        if victim is not req:
+            self.waiting.remove(victim)
+            if self.prefix_cache is not None:
+                self.prefix_cache.unpin(victim.cache_node)
+                victim.cache_node = None
+        self.telemetry.trace_instant(victim, "shed",
+                                     policy=ctl.config.shed_policy)
+        self._finish(victim, "shed", count=victim.n_samples)
+        self._shed_done.append(victim)
+        return victim
 
     def abort(self, request_id: int) -> bool:
         """Cancel a request mid-flight (≙ the reference server's abort
@@ -888,8 +973,10 @@ class LLMEngine:
 
     @property
     def has_work(self) -> bool:
-        """Anything queued, mid-prefill, or decoding."""
-        return bool(self.waiting or self.prefilling or self.running)
+        """Anything queued, mid-prefill, decoding, or shed-but-unreported
+        (a shed request still needs one step() to surface as finished)."""
+        return bool(self.waiting or self.prefilling or self.running
+                    or self._shed_done)
 
     # ------------------------------------------------------------ scheduler
     def _free_slots(self) -> List[int]:
@@ -925,10 +1012,15 @@ class LLMEngine:
         host sync; K=1 degenerates to the classic per-token loop).
         Returns finished requests."""
         finished: List[Request] = []
+        if self._shed_done:
+            # report admission-control sheds (already finished/counted)
+            finished.extend(self._shed_done)
+            self._shed_done.clear()
         self.telemetry.observe_queue_depth(len(self.waiting))
         tracing = self.telemetry.tracer is not None
         t_wave0 = time.monotonic() if tracing else 0.0
         self._tick_prefilled = False
+        self._preempt_for_priority()
         self._admit(finished)
         self._advance_prefills(finished)
         if tracing and self._tick_prefilled:
@@ -970,13 +1062,20 @@ class LLMEngine:
             req = self.waiting[i]
             if req.n_samples > len(free):
                 break  # a group is admitted whole or not at all
-            n = len(req.prompt_ids)
+            # the INGEST context: prompt plus any pre-preemption output —
+            # a resumed request re-enters exactly like a fresh one whose
+            # prompt is everything it had committed (empty output for
+            # fresh requests, so this IS the prompt then)
+            ctx = req.prompt_ids + req.output_ids
+            n = len(ctx)
             if self.prefix_cache is not None:
                 # refresh the tree walk: prefixes donated while this
-                # request waited in the queue extend its hit now
+                # request waited in the queue extend its hit now — for a
+                # preempted request that includes its OWN donated pages,
+                # which is what makes resume nearly free
                 self.prefix_cache.unpin(req.cache_node)
                 req.cache_node, req.cached_blocks = \
-                    self.prefix_cache.match(req.prompt_ids)
+                    self.prefix_cache.match(ctx)
             hit = len(req.cached_blocks)
             # fund the whole prefill (padded bucket); group followers share
             # the full prompt pages and fund only their own tail pages;
@@ -991,6 +1090,10 @@ class LLMEngine:
                 break  # no pages: stay queued until frees arrive
             self.waiting.pop(i)
             req.slot = free.pop(0)
+            if req.output_ids:  # re-admission after a preemption
+                self.stats.requests_resumed += 1
+                self.telemetry.trace_instant(req, "resume",
+                                             tokens=n, cached_blocks=hit)
             self.telemetry.on_admitted(req)
             if hit:
                 self.telemetry.trace_instant(req, "prefix_cache_hit", blocks=hit)
@@ -1029,11 +1132,12 @@ class LLMEngine:
         for slot in sorted(self.prefilling):
             req = self.prefilling[slot]
             c = self.prefill_chunk
-            n = len(req.prompt_ids)
+            ctx = req.prompt_ids + req.output_ids  # = prompt unless resumed
+            n = len(ctx)
             pos = req.prefill_pos
             n_valid = min(n - pos, c)
             ids = np.zeros((1, c), np.int32)
-            ids[0, :n_valid] = req.prompt_ids[pos:pos + n_valid]
+            ids[0, :n_valid] = ctx[pos:pos + n_valid]
             table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
             with self.telemetry.trace_phase(req, "prefill_chunk",
                                             pos=pos, tokens=n_valid):
@@ -1076,8 +1180,11 @@ class LLMEngine:
                         finished: List[Request]) -> None:
         """Prefill logits → first sampled token for the leader and every
         group member (fork-shared pages, CoW partial page), then activate
-        the survivors' device-resident decode state."""
-        n = len(req.prompt_ids)
+        the survivors' device-resident decode state. For a resumed request
+        the "prefill" covered prompt + prior output, and the token sampled
+        here is its next decode token — greedy-identical to the token an
+        uninterrupted run would have committed at this position."""
+        n = len(req.prompt_ids) + len(req.output_ids)
         _, _, full, tail, _ = self._group_page_needs(n, req.n_samples)
         g = req.gen
         self._set_slot_gen(req.slot, g)
@@ -1221,7 +1328,7 @@ class LLMEngine:
         # device loop never needs a host allocation decision; demote when
         # tight: (K, d) -> (1, d) -> (1, 0) plain -> per-slot truncation
         k = self.megastep_k
-        d = self.draft_len
+        d = self._tick_draft_len()
         if d > 0:
             # a speculative iteration can commit up to d+1 tokens
             if not self._fund_all(k * (d + 1)):
@@ -1236,7 +1343,18 @@ class LLMEngine:
         if d == 0 and k == 1:
             for slot, req in list(self.running.items()):
                 if not self._fund_slot(slot, req, 1):
-                    # out of pages mid-flight: truncate this request —
+                    # out of pages mid-flight. With preemption on and other
+                    # work to yield to, park the sequence instead of
+                    # truncating it: pages donate to the prefix cache and
+                    # the request resumes (token-identical) when pressure
+                    # lifts. The lone-request case still truncates — there
+                    # is nobody to yield to.
+                    if (self._overload is not None
+                            and self._overload.config.preempt
+                            and req.group_ids is None
+                            and (self.waiting or len(self.running) > 1)):
+                        self._preempt_slot(slot, req)
+                        continue
                     # _release frees exactly the pages the slot owns
                     req.truncated = True
                     self._release(slot, req)
@@ -1367,6 +1485,9 @@ class LLMEngine:
                 # reports each request's own acceptance, not the global rate)
                 req.spec_drafted += int(drafted_np[slot])
                 req.spec_accepted += int(accepted_np[slot])
+                if self._draft_ctl is not None and self._draft_ctl.update(
+                        req, int(drafted_np[slot]), int(accepted_np[slot])):
+                    self.stats.spec_draft_len_adjustments += 1
                 self.telemetry.trace_interval(
                     req, span_name, t_tick0, t_tick1, k=k, tokens=t,
                     drafted=int(drafted_np[slot]),
@@ -1430,16 +1551,126 @@ class LLMEngine:
         ``count`` members sharing a single Request object): finished flag,
         finish_reason, the requests_* counters, and the telemetry record.
         Every id add_request hands out passes through here exactly once,
-        which is what makes completed + aborted == submitted assertable."""
+        which is what makes completed + aborted + shed == submitted
+        assertable."""
         req.finished = True
         req.finish_reason = reason
         if reason == "aborted":
             self.stats.requests_aborted += count
+        elif reason == "shed":
+            self.stats.requests_shed += count
         else:
             self.stats.requests_completed += count
             if reason == "truncated":
                 self.stats.requests_truncated += count
         self.telemetry.on_finished(req, group_size=count)
+
+    # ----------------------------------------------------------- preemption
+    def preempt(self, request_id: int) -> bool:
+        """Evict one RUNNING request back into the waiting queue (the
+        overload loop's eviction primitive, public for tests and ops).
+        The slot's complete KV pages are donated into the prefix cache
+        (when present), so re-admission restores them as a prefix hit and
+        only the final partial block recomputes; without the cache, resume
+        re-prefills prompt + committed output from scratch. Either way the
+        resumed greedy output is token-identical to an uninterrupted run.
+        Group members are not preemptable (their pages interleave with
+        their siblings'); returns whether a request was preempted."""
+        for slot, req in list(self.running.items()):
+            if req.request_id == request_id:
+                if req.group_ids is not None:
+                    return False
+                self._preempt_slot(slot, req)
+                return True
+        return False
+
+    def _preempt_slot(self, slot: int, req: Request) -> None:
+        """Release a running slot WITHOUT finishing its request: donate
+        every complete context page to the prefix cache, free the rest,
+        reset the per-slot state, and requeue the request for resume."""
+        self.running.pop(slot, None)
+        self._gen_temp[slot] = 1.0
+        self._gen_topk[slot] = 0
+        self._gen_topp[slot] = 1.0
+        self._gen_sample[slot] = False
+        self._dev_active = _patch1(
+            self._dev_active, self._put_rep(np.asarray(slot, np.int32)),
+            self._put_rep(np.asarray(False)))
+        pc = self.prefix_cache
+        if pc is not None and req.cache_node is not None:
+            pc.unpin(req.cache_node)
+            req.cache_node = None
+        table = self._tables.pop(slot)
+        ctx = req.prompt_ids + req.output_ids
+        if pc is not None:
+            # donate every page whose tokens ALL hold valid KV. The pool
+            # has KV for table.length tokens (the newest sampled token is
+            # the next decode input, not yet written); a speculative
+            # engine's draft pool only mirrors PROMPT pages via prefill,
+            # so with a draft attached the donation stops at the prompt —
+            # generated positions would hand out pages with no draft KV.
+            n_valid = (table.length if self.draft_len == 0
+                       else min(table.length, len(req.prompt_ids)))
+            full = n_valid // self.block_size
+            pc.insert(ctx[:full * self.block_size], table.blocks[:full],
+                      self.allocator)
+            self.stats.prefix_insertions = pc.insertions
+            self.stats.prefix_evictions = pc.evictions
+            self.allocator.free(table.blocks[full:])
+        else:
+            self.allocator.free(table.blocks)
+        req.slot = None
+        req.table = None
+        req.prefill_pos = 0
+        req.cached_blocks = []
+        self.stats.requests_preempted += 1
+        self.telemetry.trace_instant(req, "preempt", tokens=len(ctx))
+        self.waiting.append(req)
+
+    def _preempt_for_priority(self) -> None:
+        """Priority preemption (step() runs this before _admit): when the
+        next waiting request strictly outranks the weakest running victim
+        AND could not otherwise be admitted, evict the victim. Guarded on
+        the scheduler policy agreeing the waiter goes first once the
+        victim is requeued — otherwise _admit would re-admit the victim
+        immediately and the pair would livelock."""
+        ctl = self._overload
+        if ctl is None or not ctl.config.preempt or not self.waiting:
+            return
+        for _ in range(ctl.config.preempt_max_per_tick):
+            if not self.waiting:
+                return
+            waiter = self.waiting[self._next_waiting()]
+            victims = [(s, r) for s, r in self.running.items()
+                       if r.group_ids is None]
+            if not victims:
+                return
+            # weakest victim: lowest priority, oldest (longest-running)
+            slot, victim = min(
+                victims, key=lambda sr: (sr[1].priority, sr[1].request_id))
+            if (waiter.priority <= victim.priority
+                    or self._policy_key(waiter) >= self._policy_key(victim)):
+                return
+            ctx = waiter.prompt_ids + waiter.output_ids
+            hit = (self.prefix_cache.peek(ctx)
+                   if self.prefix_cache is not None else 0)
+            _, _, _, _, need = self._group_page_needs(
+                len(ctx), waiter.n_samples)
+            blocked = (len(self._free_slots()) < waiter.n_samples
+                       or self.allocator.num_free < need - hit)
+            if not blocked:
+                return  # plain admission will seat the waiter
+            self._preempt_slot(slot, victim)
+
+    def _tick_draft_len(self) -> int:
+        """This tick's draft window: the configured draft_len, or — with
+        the acceptance controller on — the batch consensus of per-request
+        recommendations (draft_len is static in the megastep jit, so the
+        whole tick drafts one width; each width compiles once)."""
+        d = self.draft_len
+        if d > 0 and self._draft_ctl is not None and self.running:
+            d = self._draft_ctl.tick_draft_len(self.running.values())
+        return d
 
     # -------------------------------------------------------------- internal
     def _set_slot_gen(self, slot: int, g: GenerationConfig) -> None:
@@ -1465,15 +1696,17 @@ class LLMEngine:
         [1, V] (grouped sampling draws every member's first token from
         them). With a prefix-cache hit, only the uncached SUFFIX runs — a
         single chunk-prefill call starting at the first uncached block,
-        attending to the shared pages through the block table."""
-        n = len(req.prompt_ids)
+        attending to the shared pages through the block table. Resumed
+        requests ingest prompt + prior output as one context."""
+        ctx = req.prompt_ids + req.output_ids
+        n = len(ctx)
         self._tick_prefilled = True
         start = (len(req.cached_blocks) * self.block_size
                  if self.prefix_cache is not None else 0)
         if start:
             return self._prefill_suffix_into_slot(req, bucket, start)
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.prompt_ids
+        ids[0, :n] = ctx
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
         with annotate("prefill"):
             if self._pp:
@@ -1503,10 +1736,11 @@ class LLMEngine:
         bucket from the left). The chunk attends to the cached pages
         through the table, exactly like chunked prefill attends to prior
         chunks, so warm logits match cold ones."""
-        n = len(req.prompt_ids)
+        ctx = req.prompt_ids + req.output_ids
+        n = len(ctx)
         c = bucket - start
         ids = np.zeros((1, c), np.int32)
-        ids[0, :n - start] = req.prompt_ids[start:]
+        ids[0, :n - start] = ctx[start:]
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
         with annotate("prefill_suffix"):
             if self._pp:

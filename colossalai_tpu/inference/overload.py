@@ -1,0 +1,125 @@
+"""Goodput-first overload control: the hands for PR 10's SLO eyes.
+
+The ``SLOTracker`` gives the engine windowed p99s and edge-triggered
+breach callbacks; nothing acted on them. This module turns those signals
+into scheduling decisions, all host-side (the transfer-counter gates
+prove device traffic is byte-identical when no action fires):
+
+- **admission control** — while a TTFT/queue-wait target is breached and
+  the waiting queue is at least ``shed_queue_depth`` deep, incoming
+  requests are shed (``finish_reason="shed"``) instead of queued. Two
+  policies: ``reject_new`` sheds the arriving request; with
+  ``oldest_low_priority_first`` the arrival competes with the queue and
+  the lowest-priority (oldest within a level) request is shed, so a
+  high-priority arrival can displace queued background work.
+- **preemption** — under page pressure (or a priority inversion at the
+  admission gate) the engine evicts a running low-priority sequence,
+  donating its full KV pages into the ``PrefixCache`` radix tree before
+  re-queueing it. On re-admission the prefix match restores those pages,
+  so the "recompute" is a near-free cache hit; greedy resumed output is
+  token-identical to an uninterrupted run.
+- **acceptance-adaptive speculation** — a per-request EWMA of the draft
+  acceptance rate recommends a ``draft_len`` per tick (see
+  ``speculative.DraftLenController``), so drafting spends FLOPs only
+  where it pays.
+
+The controller deliberately carries NO latch of its own: ``shedding`` is
+re-derived from ``slo.breached_metrics`` on every read, so it stays
+correct across ``SLOTracker.reset()`` and across breach/recover edges
+even if a callback was lost. The edge callbacks only feed counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+#: breaches of these windowed metrics indicate queueing (admission-side)
+#: pressure — the only kind shedding can relieve. ITL/e2e breaches are
+#: decode-side and are left to preemption/adaptive speculation.
+SHED_METRICS = ("ttft", "queue_wait")
+
+SHED_POLICIES = ("reject_new", "oldest_low_priority_first", "off")
+
+
+@dataclasses.dataclass
+class OverloadConfig:
+    """Knobs for the SLO control loop (see docs/inference.md, "Overload
+    control"). Attach via ``LLMEngine(..., overload=OverloadConfig(...))``
+    or ``overload=True`` for the defaults."""
+
+    #: what to shed once breached AND the queue is at the depth cap
+    shed_policy: str = "reject_new"
+    #: waiting-queue depth at which shedding engages while breached;
+    #: ``None`` defaults to ``2 * max_batch_size`` — one queued batch is
+    #: normal jitter at full utilization (a transient breach + a shallow
+    #: queue must not shed at nominal load), two is real backlog
+    shed_queue_depth: Optional[int] = None
+    #: evict running low-priority sequences under page pressure /
+    #: priority inversion, pages donated to the prefix cache for resume
+    preempt: bool = True
+    #: at most this many priority preemptions per engine step
+    preempt_max_per_tick: int = 1
+    #: drive per-request draft_len from the observed acceptance EWMA
+    adaptive_draft: bool = True
+    #: EWMA smoothing for per-request acceptance (weight of the newest
+    #: megastep's observation)
+    draft_ewma: float = 0.5
+    #: acceptance above this recommends a longer draft ...
+    draft_raise_at: float = 0.8
+    #: ... below this, a shorter one; between the two, hold
+    draft_lower_at: float = 0.4
+
+    def __post_init__(self) -> None:
+        if self.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed_policy={self.shed_policy!r} not in {SHED_POLICIES}")
+        if self.shed_queue_depth is not None and self.shed_queue_depth < 1:
+            raise ValueError(
+                f"shed_queue_depth={self.shed_queue_depth} must be >= 1")
+        if self.preempt_max_per_tick < 1:
+            raise ValueError(
+                f"preempt_max_per_tick={self.preempt_max_per_tick} must be >= 1")
+        if not 0.0 < self.draft_ewma <= 1.0:
+            raise ValueError(f"draft_ewma={self.draft_ewma} must be in (0, 1]")
+        if not 0.0 <= self.draft_lower_at <= self.draft_raise_at <= 1.0:
+            raise ValueError(
+                "need 0 <= draft_lower_at <= draft_raise_at <= 1, got "
+                f"{self.draft_lower_at} / {self.draft_raise_at}")
+
+
+class OverloadController:
+    """Binds an :class:`OverloadConfig` to an engine's ``SLOTracker``.
+
+    Stateless w.r.t. breach: ``shedding`` re-reads the tracker every time
+    (robust to ``reset()``); the registered edge callbacks only count
+    edges for observability.
+    """
+
+    def __init__(self, slo, config: OverloadConfig):
+        self.slo = slo
+        self.config = config
+        self.breach_edges = 0
+        self.recover_edges = 0
+        slo.add_breach_callback(self._on_breach)
+        slo.add_recover_callback(self._on_recover)
+
+    def _on_breach(self, key: str, value: float, bound: float) -> None:
+        self.breach_edges += 1
+
+    def _on_recover(self, key: str, value: float, bound: float) -> None:
+        self.recover_edges += 1
+
+    @property
+    def shedding(self) -> bool:
+        """True while any admission-side (TTFT/queue-wait) target is in
+        breach — the precondition for shedding; the queue-depth cap is
+        checked by the engine at each arrival."""
+        if self.config.shed_policy == "off":
+            return False
+        return any(k.rsplit("_p", 1)[0] in SHED_METRICS
+                   for k in self.slo.breached_metrics)
+
+    def shed_queue_depth(self, max_batch_size: int) -> int:
+        d = self.config.shed_queue_depth
+        return int(d) if d is not None else 2 * int(max_batch_size)

@@ -192,6 +192,22 @@ class PrefixCache:
             self._touch(node)
         return created
 
+    # ------------------------------------------------------------- auditing
+    def resident_blocks(self) -> List[int]:
+        """Every physical page id the tree currently owns a ref on, in no
+        particular order. An audit surface for refcount-invariant tests
+        (preempt → evict → resume cycles must neither leak nor double-free
+        pages): ``len(resident_blocks()) == num_blocks`` always, and each
+        id holds exactly the tree's own allocator reference plus one per
+        live sequence that fork-shared it."""
+        out: List[int] = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            out.append(n.block)
+        return out
+
     # ------------------------------------------------------------ eviction
     def evict(self, want: int, allocator: BlockAllocator) -> int:
         """Free up to ``want`` pages back to ``allocator`` — LRU unpinned

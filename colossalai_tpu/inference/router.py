@@ -24,6 +24,14 @@ the single front door that decides WHICH replica serves each request:
   placement while it keeps stepping its in-flight work dry (rolling
   restarts / elastic downscale); :meth:`replica_health` reports each
   replica's queues, pool headroom, and terminal counters;
+- **SLO-aware placement** (``slo_aware=True``, the default): a replica
+  whose attached :class:`~colossalai_tpu.telemetry.slo.SLOTracker` is in
+  breach is treated like a soft drain — skipped by placement while ANY
+  non-breached replica exists, so new load steers away from the replica
+  already missing its targets instead of piling on. When every replica
+  is breached (fleet-wide overload) placement falls back to all eligible
+  replicas and each engine's own admission control takes over (shedding,
+  preemption — see ``inference/overload.py``);
 - **merged observability**: :meth:`merged_stats` sums every
   ``EngineStats`` counter across replicas (rates are re-derived from the
   summed numerators/denominators, never averaged), and
@@ -88,6 +96,7 @@ class Router:
         parallel_step: bool = True,
         devices: Optional[Sequence] = None,
         tracer: Optional[Tracer] = None,
+        slo_aware: bool = True,
     ):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
@@ -137,6 +146,7 @@ class Router:
                 tracer = next(iter(distinct.values()))
         self.tracer = tracer
         self.policy = policy
+        self.slo_aware = slo_aware
         self._devices = list(devices) if devices is not None else None
         self._draining = [False] * n
         self._rr = 0
@@ -151,6 +161,7 @@ class Router:
         self.least_loaded_placements = 0
         self.round_robin_placements = 0
         self.replica_drains = 0
+        self.slo_avoided_placements = 0
 
     # ------------------------------------------------------------- placement
     @property
@@ -175,6 +186,25 @@ class Router:
         self._rr += 1
         return pick
 
+    def _slo_healthy(self, candidates: List[int]) -> List[int]:
+        """Drop replicas whose SLO tracker is currently in breach — unless
+        that would empty the candidate set (fleet-wide breach routes like
+        no breach at all; the engines' own overload control is the
+        backstop there). ``evaluate()`` re-reads the live window so a
+        replica whose breach drained out rejoins placement immediately,
+        not at its next request finish."""
+        breached = []
+        for i in candidates:
+            slo = getattr(self.engines[i].telemetry, "slo", None)
+            if slo is not None:
+                slo.evaluate()
+                if slo.breached:
+                    breached.append(i)
+        if not breached or len(breached) == len(candidates):
+            return candidates
+        self.slo_avoided_placements += 1
+        return [i for i in candidates if i not in breached]
+
     def _place(self, prompt_ids: List[int]) -> int:
         eligible = [i for i in range(len(self.engines))
                     if not self._draining[i]]
@@ -183,6 +213,8 @@ class Router:
                 "every replica is draining — undrain one before routing "
                 "new requests"
             )
+        if self.slo_aware:
+            eligible = self._slo_healthy(eligible)
         if self.policy == "round_robin":
             pick = eligible[self._rr % len(eligible)]
             self._rr += 1
@@ -206,7 +238,14 @@ class Router:
     ) -> Union[int, List[int]]:
         """Route one prompt (or one grouped-sampling request — a group
         lands whole on one replica, same as one engine requires) and
-        return the replica's request id(s), already globally unique."""
+        return the replica's request id(s), already globally unique.
+
+        ``priority`` (default 0 — higher is more urgent) rides through to
+        the replica untouched: under its ``cache_aware`` admission policy
+        equal-cache-hit ties admit higher priority first, and the overload
+        controller's shed/preempt victims are chosen lowest-priority
+        first. Placement itself ignores priority — a replica choice is
+        about WHERE pages live, not WHO goes first."""
         prompt_ids = list(map(int, prompt_ids))
         tr = self.tracer
         t0 = tr._clock() if tr is not None else 0.0
@@ -366,6 +405,7 @@ class Router:
             "router_least_loaded_placements": self.least_loaded_placements,
             "router_round_robin_placements": self.round_robin_placements,
             "router_replica_drains": self.replica_drains,
+            "router_slo_avoided_placements": self.slo_avoided_placements,
         }
 
     def merged_stats(self) -> Dict[str, float]:

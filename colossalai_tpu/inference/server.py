@@ -53,8 +53,15 @@ Endpoints:
   Perfetto. 409 when a capture is already running (start) or none is
   (stop) — ``jax.profiler`` is a process-global singleton.
 
-``/generate`` also accepts ``"priority"`` (int, default 0) — it orders
-admission when the engine runs ``scheduler_policy="priority"``.
+``/generate`` also accepts ``"priority"`` (int, default 0; higher is more
+urgent) — it orders admission under ``scheduler_policy="priority"``,
+breaks equal-cache-hit ties under ``cache_aware``, and picks shed/preempt
+victims (lowest first) when overload control is on. Non-streaming
+responses carry ``finish_reason``; a request shed by overload admission
+control answers **503** ``{"error": "shed"}`` — the retry-elsewhere
+signal for a load balancer. ``GET /health`` adds an ``"overload"`` block
+(live shed-gate state + knobs) when the engine runs an
+:class:`~.overload.OverloadController`.
 """
 
 from __future__ import annotations
@@ -95,7 +102,9 @@ class _Scheduler(threading.Thread):
         self.engine = engine
         self.request_timeout = request_timeout
         self.lock = threading.Lock()
-        self.done: Dict[int, list] = {}
+        #: rid → (output_ids, finish_reason) for completed non-streaming
+        #: requests a waiter hasn't consumed yet
+        self.done: Dict[int, tuple] = {}
         self.events: Dict[int, threading.Event] = {}
         #: per-streaming-request token queues + how many tokens were pushed
         self.streams: Dict[int, queue.Queue] = {}
@@ -126,7 +135,10 @@ class _Scheduler(threading.Thread):
         return (rid, q) if stream else rid
 
     def wait(self, rid: int, timeout: Optional[float] = None):
-        """Block until the request resolves: ``(output_ids, "done")``,
+        """Block until the request resolves: ``(output_ids,
+        finish_reason)`` when the engine finished it (reason is the
+        request's terminal state — "eos"/"length"/"truncated", or "shed"
+        when overload admission control rejected it before it ever ran),
         ``(None, "aborted")`` (a concurrent /abort), or
         ``(None, "timeout")`` — a timed-out request is aborted so its
         pages free instead of decoding for a client that already gave
@@ -139,13 +151,13 @@ class _Scheduler(threading.Thread):
         )
         with self.lock:
             self.events.pop(rid, None)
-            out = self.done.pop(rid, None)
+            entry = self.done.pop(rid, None)
             aborted = rid in self._client_aborted
             self._client_aborted.discard(rid)
-            if not ok and out is None and not aborted:
+            if not ok and entry is None and not aborted:
                 self.engine.abort(rid)
-        if out is not None:
-            return out, "done"
+        if entry is not None:
+            return entry
         return None, ("aborted" if aborted else "timeout")
 
     def abort(self, rid: int) -> bool:
@@ -203,7 +215,7 @@ class _Scheduler(threading.Thread):
                     ev = self.events.get(rid)
                     if ev is None:
                         continue  # client gave up (timeout): drop the result
-                    self.done[rid] = req.output_ids
+                    self.done[rid] = (req.output_ids, req.finish_reason)
                     ev.set()
 
     def stop(self):
@@ -314,6 +326,20 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         # the compact windowed view (breached flag + live
                         # percentiles) — full detail lives at GET /slo
                         payload["slo"] = slo.brief()
+                    ctl = getattr(engine, "_overload", None)
+                    if ctl is not None:
+                        # live overload-control state: is the shed gate
+                        # armed right now, and which knobs are active
+                        payload["overload"] = {
+                            "shedding": ctl.shedding,
+                            "shed_policy": ctl.config.shed_policy,
+                            "shed_queue_depth":
+                                ctl.shed_queue_depth(engine.max_batch),
+                            "preempt": ctl.config.preempt,
+                            "adaptive_draft": ctl.config.adaptive_draft,
+                            "breach_edges": ctl.breach_edges,
+                            "recover_edges": ctl.recover_edges,
+                        }
                 self._json(200, payload)
             elif parsed.path == "/metrics":
                 with sched.lock:
@@ -486,10 +512,16 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                 out, status = sched.wait(rid)
                 if status == "aborted":
                     self._json(409, {"request_id": rid, "error": "aborted"})
+                elif status == "shed":
+                    # overload admission control rejected the request
+                    # before it ran — the load-balancer retry signal
+                    self._json(503, {"request_id": rid, "error": "shed",
+                                     "finish_reason": "shed"})
                 elif out is None:
                     self._json(504, {"error": "generation timed out"})
                 else:
-                    payload = {"request_id": rid, "output_ids": out}
+                    payload = {"request_id": rid, "output_ids": out,
+                               "finish_reason": status}
                     if detokenizer is not None:
                         payload["text"] = detokenizer(out)
                     self._json(200, payload)

@@ -64,6 +64,72 @@ class SpecStats:
         return (self.accepted_tokens + self.target_passes) / max(self.target_passes, 1)
 
 
+class DraftLenController:
+    """Acceptance-adaptive ``draft_len`` (the overload loop's speculation
+    half): drafting spends draft-model FLOPs and verify-window width, which
+    only pay off while the target keeps accepting. Per request, an EWMA of
+    the observed acceptance rate drives a recommendation — raise the draft
+    window while acceptance is high, shrink it toward 1 while drafts keep
+    getting rejected. ``draft_len`` is STATIC in the megastep jit, so the
+    engine collapses the per-request recommendations into one per-tick
+    width (the rounded batch mean); every distinct width compiles once and
+    the programs are cached, exactly like the (K, d) demotion fallbacks.
+    The floor is 1, never 0 — a d=0 tick would run the plain megastep and
+    leave the draft pool's KV behind the committed frontier.
+
+    All host-side integer/float arithmetic on megastep results the engine
+    already fetched: device traffic is byte-identical until the tick width
+    actually changes (and then only the compiled program differs, not the
+    per-token transfer pattern).
+    """
+
+    def __init__(self, max_draft_len: int, ewma: float = 0.5,
+                 raise_at: float = 0.8, lower_at: float = 0.4):
+        if max_draft_len < 1:
+            raise ValueError(f"max_draft_len={max_draft_len} must be >= 1")
+        if not 0.0 < ewma <= 1.0:
+            raise ValueError(f"ewma={ewma} must be in (0, 1]")
+        if not 0.0 <= lower_at <= raise_at <= 1.0:
+            raise ValueError(
+                f"need 0 <= lower_at <= raise_at <= 1, got {lower_at}/{raise_at}")
+        self.max_draft_len = int(max_draft_len)
+        self.ewma = float(ewma)
+        self.raise_at = float(raise_at)
+        self.lower_at = float(lower_at)
+
+    def update(self, req, drafted: int, accepted: int) -> bool:
+        """Fold one megastep's (drafted, accepted) observation into the
+        request's EWMA and move its recommendation one step. Returns
+        whether the recommendation changed (the engine counts these as
+        ``spec_draft_len_adjustments``)."""
+        if drafted <= 0:
+            return False
+        rate = accepted / drafted
+        prev = req.spec_accept_ewma
+        req.spec_accept_ewma = (
+            rate if prev is None else (1 - self.ewma) * prev + self.ewma * rate
+        )
+        rec = req.spec_draft_rec or self.max_draft_len
+        if req.spec_accept_ewma >= self.raise_at:
+            new = min(rec + 1, self.max_draft_len)
+        elif req.spec_accept_ewma <= self.lower_at:
+            new = max(rec - 1, 1)
+        else:
+            new = rec
+        req.spec_draft_rec = new
+        return new != rec
+
+    def tick_draft_len(self, requests) -> int:
+        """One width for the whole tick: the rounded mean of per-request
+        recommendations (unobserved requests vote the configured max),
+        clamped to [1, max_draft_len]."""
+        recs = [r.spec_draft_rec or self.max_draft_len for r in requests]
+        if not recs:
+            return self.max_draft_len
+        mean = round(sum(recs) / len(recs))
+        return max(1, min(int(mean), self.max_draft_len))
+
+
 class SpeculativeEngine:
     """Greedy speculative generation over (draft, target) llama models.
 

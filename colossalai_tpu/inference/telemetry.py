@@ -49,8 +49,9 @@ from colossalai_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
 _NULL_CM = contextlib.nullcontext()
 
 #: every terminal state a request can reach — the ``finish_reason`` field
-#: of lifecycle records is always one of these
-FINISH_REASONS = ("eos", "length", "aborted", "truncated")
+#: of lifecycle records is always one of these ("shed" = rejected by
+#: overload admission control before ever being admitted)
+FINISH_REASONS = ("eos", "length", "aborted", "truncated", "shed")
 
 #: histogram catalog: name → constructor. Latencies get log-spaced bounds
 #: spanning 100µs–1h; queue depth gets powers of two (an integer gauge).

@@ -26,12 +26,15 @@ prove device traffic is unchanged with SLO windows on vs off.
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from .core import Histogram
+
+_LOG = logging.getLogger(__name__)
 
 #: target-key grammar: ``<metric>_p<percentile>`` over the windowed metrics
 SLO_TARGET_RE = re.compile(r"^(ttft|itl|e2e|queue_wait)_p(\d{1,2}(?:\.\d+)?)$")
@@ -138,6 +141,7 @@ class SLOTracker:
         window_s: float = 60.0,
         n_intervals: int = 6,
         on_breach: Optional[Callable[[str, float, float], None]] = None,
+        on_recover: Optional[Callable[[str, float, float], None]] = None,
     ):
         if window_s <= 0:
             raise ValueError(f"window_s={window_s} must be > 0")
@@ -167,9 +171,13 @@ class SLOTracker:
         self.breached = False
         self.breaches = 0
         self.breached_metrics: Tuple[str, ...] = ()
+        self.callback_errors = 0
         self._callbacks: List[Callable[[str, float, float], None]] = []
+        self._recover_callbacks: List[Callable[[str, float, float], None]] = []
         if on_breach is not None:
             self._callbacks.append(on_breach)
+        if on_recover is not None:
+            self._recover_callbacks.append(on_recover)
 
     @property
     def window_s(self) -> float:
@@ -177,6 +185,37 @@ class SLOTracker:
 
     def add_breach_callback(self, cb: Callable[[str, float, float], None]) -> None:
         self._callbacks.append(cb)
+
+    def add_recover_callback(self, cb: Callable[[str, float, float], None]) -> None:
+        """Falling-edge twin of ``add_breach_callback``: fires once per
+        metric when a previously-breached key drops back under target
+        (``cb(key, value, target)``)."""
+        self._recover_callbacks.append(cb)
+
+    def _fire(self, cbs: List[Callable[[str, float, float], None]],
+              key: str, value: float, bound: float) -> None:
+        """Dispatch one edge to every callback. A raising callback must
+        never break the engine's step loop — catch, count, log, move on."""
+        for cb in cbs:
+            try:
+                cb(key, value, bound)
+            except Exception:
+                self.callback_errors += 1
+                _LOG.exception("SLO callback failed for %s", key)
+
+    def reset(self) -> None:
+        """Clear windows, goodput counters, and breach state (targets and
+        callbacks survive). Benchmarks use this to drop compile-poisoned
+        warm-up samples; recover callbacks do NOT fire — derived
+        controllers should re-read ``breached_metrics`` rather than latch."""
+        for w in self.windows.values():
+            w.reset()
+        self.requests_total = 0
+        self.requests_within_slo = 0
+        self.goodput_tokens = 0
+        self.breached = False
+        self.breaches = 0
+        self.breached_metrics = ()
 
     # ------------------------------------------------------------- recording
     def record_request(
@@ -190,13 +229,13 @@ class SLOTracker:
         reason: Optional[str] = None,
     ) -> bool:
         """Feed one finished request; returns whether it landed within
-        SLO. Aborted requests count toward ``requests_total`` but never
-        toward goodput — shed load is not good load."""
+        SLO. Aborted and shed requests count toward ``requests_total`` but
+        never toward goodput — shed load is not good load."""
         values = {"ttft": ttft, "itl": itl, "e2e": e2e, "queue_wait": queue_wait}
         for metric, v in values.items():
             if v is not None:
                 self.windows[metric].observe(v)
-        within = reason != "aborted"
+        within = reason not in ("aborted", "shed")
         if within:
             for _key, metric, _q, bound in self._parsed:
                 v = values[metric]
@@ -227,8 +266,11 @@ class SLOTracker:
         for key, v, bound in now_breached:
             if key not in self.breached_metrics:
                 self.breaches += 1
-                for cb in self._callbacks:
-                    cb(key, v, bound)
+                self._fire(self._callbacks, key, v, bound)
+        for key in self.breached_metrics:
+            if key not in new_keys:  # falling edge: back under target
+                self._fire(self._recover_callbacks, key,
+                           out[key]["value"], out[key]["target"])
         self.breached_metrics = new_keys
         self.breached = bool(new_keys)
         return out
@@ -282,6 +324,7 @@ class SLOTracker:
             "slo_requests_within": self.requests_within_slo,
             "slo_goodput_tokens": self.goodput_tokens,
             "slo_breaches_total": self.breaches,
+            "slo_callback_errors": self.callback_errors,
         }
 
     def prom_gauges(self) -> Dict[str, float]:
@@ -356,6 +399,7 @@ class SLOTracker:
             "slo_requests_within": sum(t.requests_within_slo for t in trackers),
             "slo_goodput_tokens": sum(t.goodput_tokens for t in trackers),
             "slo_breaches_total": sum(t.breaches for t in trackers),
+            "slo_callback_errors": sum(t.callback_errors for t in trackers),
         }
         total = counters["slo_requests_total"]
         gauges: Dict[str, float] = {

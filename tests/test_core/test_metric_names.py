@@ -119,6 +119,7 @@ def test_router_names_match_grammar():
     assert {"clt_router_requests_routed", "clt_router_cache_hit_placements",
             "clt_router_least_loaded_placements",
             "clt_router_round_robin_placements", "clt_router_replica_drains",
+            "clt_router_slo_avoided_placements",
             "clt_router_replicas", "clt_router_replicas_draining"} <= names
     # the merged view keeps every single-engine family name, so one
     # dashboard reads a bare engine and a router interchangeably
@@ -139,6 +140,7 @@ def test_slo_names_match_grammar_and_collide_with_nothing():
         assert name.startswith("clt_slo_"), name
     assert {"clt_slo_requests_total", "clt_slo_requests_within",
             "clt_slo_goodput_tokens", "clt_slo_breaches_total",
+            "clt_slo_callback_errors",
             "clt_slo_breached", "clt_slo_goodput_ratio",
             "clt_slo_window_seconds", "clt_slo_ttft_p99_seconds",
             "clt_slo_ttft_p99_target_seconds"} <= names
@@ -205,7 +207,8 @@ def test_span_names_match_grammar_over_engine_smoke():
     catalog = {"request", "queue", "prefill", "prefill_chunk",
                "prefill_stall", "first_token", "decode_megastep",
                "spec_megastep", "prefix_cache_hit", "prefix_cache_evict",
-               "page_refund", "router.place", "router.sync"}
+               "page_refund", "router.place", "router.sync",
+               "shed", "preempt", "resume"}
     assert names <= catalog, names - catalog
 
 

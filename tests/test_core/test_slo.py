@@ -136,6 +136,91 @@ def test_breach_rising_edge_callbacks_and_recovery(clock):
     assert t.breaches == 2 and len(fired) == 2
 
 
+def test_recover_falling_edge_callbacks(clock):
+    """``on_recover`` is the falling-edge twin: it fires once when a
+    previously-breached key drops back under target, with the recovered
+    value — the signal the overload controller counts to stand down."""
+    breached, recovered = [], []
+    t = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0,
+                   on_breach=lambda k, v, b: breached.append(k),
+                   on_recover=lambda k, v, b: recovered.append((k, v, b)))
+    for _ in range(5):
+        t.record_request(ttft=2.0, tokens=4)
+    assert breached == ["ttft_p99"] and recovered == []
+    clock["t"] += 61.0  # bad window drains; next record sees recovery
+    t.record_request(ttft=0.01, tokens=4)
+    assert len(recovered) == 1
+    key, value, bound = recovered[0]
+    assert key == "ttft_p99" and bound == 0.5 and value <= bound
+    # steady good traffic: no further falling edges
+    for _ in range(3):
+        t.record_request(ttft=0.01, tokens=4)
+    assert len(recovered) == 1
+    # a fresh breach + recovery is a second edge on each side
+    for _ in range(5):
+        t.record_request(ttft=2.0, tokens=4)
+    clock["t"] += 61.0
+    t.record_request(ttft=0.01, tokens=4)
+    assert len(breached) == 2 and len(recovered) == 2
+    # late registration works like late breach callbacks
+    extra = []
+    t.add_recover_callback(lambda k, v, b: extra.append(k))
+    for _ in range(5):
+        t.record_request(ttft=2.0, tokens=4)
+    clock["t"] += 61.0
+    t.record_request(ttft=0.01, tokens=4)
+    assert extra == ["ttft_p99"]
+
+
+def test_callback_errors_counted_not_raised(clock):
+    """A raising callback must never break the engine step loop that
+    called ``record_request``: the dispatch catches it, counts it in
+    ``callback_errors`` (a ``clt_slo_*`` counter), and keeps going —
+    including to the callbacks registered after the raising one."""
+    seen = []
+    t = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0)
+
+    def bad(k, v, b):
+        raise RuntimeError("observer bug")
+
+    t.add_breach_callback(bad)
+    t.add_breach_callback(lambda k, v, b: seen.append(("breach", k)))
+    t.add_recover_callback(bad)
+    t.add_recover_callback(lambda k, v, b: seen.append(("recover", k)))
+    for _ in range(5):
+        t.record_request(ttft=2.0, tokens=4)  # must not raise
+    assert t.callback_errors == 1
+    clock["t"] += 61.0
+    t.record_request(ttft=0.01, tokens=4)  # recovery must not raise either
+    assert t.callback_errors == 2
+    # the well-behaved callbacks after the raiser still saw both edges
+    assert seen == [("breach", "ttft_p99"), ("recover", "ttft_p99")]
+    assert t.prom_counters()["slo_callback_errors"] == 2
+
+
+def test_reset_clears_windows_and_breach_state(clock):
+    """``reset()`` drops samples, goodput, and breach state but keeps
+    targets and callbacks — and fires NO recover edges (controllers
+    re-derive from ``breached_metrics``, they never latch)."""
+    recovered = []
+    t = SLOTracker(targets={"ttft_p99": 0.5}, window_s=60.0,
+                   on_recover=lambda k, v, b: recovered.append(k))
+    for _ in range(5):
+        t.record_request(ttft=2.0, tokens=4)
+    assert t.breached and t.requests_total == 5
+    t.reset()
+    assert not t.breached and t.breached_metrics == ()
+    assert t.requests_total == 0 and t.goodput_tokens == 0
+    assert t.windows["ttft"].count == 0
+    assert recovered == []  # reset is not a recovery
+    # targets and callbacks survive: the next burst is a fresh edge
+    t.record_request(ttft=0.01, tokens=2)
+    assert t.requests_within_slo == 1
+    for _ in range(5):
+        t.record_request(ttft=2.0, tokens=4)
+    assert t.breached and t.breaches == 1  # counter restarted from zero
+
+
 def test_goodput_accounting(clock):
     t = SLOTracker(targets={"ttft_p99": 0.5, "itl_p99": 0.05}, window_s=60.0)
     for _ in range(3):  # good: inside every targeted bound
@@ -145,14 +230,16 @@ def test_goodput_accounting(clock):
     # aborted: shed load is never good load, even with fast latencies
     assert t.record_request(ttft=0.1, itl=0.01, tokens=5,
                             reason="aborted") is False
+    # shed by admission control: counted, no latencies, never goodput
+    assert t.record_request(tokens=0, reason="shed") is False
     # untargeted metrics don't affect attainment
     assert t.record_request(ttft=0.1, e2e=999.0, tokens=7) is True
     snap = t.snapshot()
     good = snap["goodput"]
-    assert good["requests_total"] == 6
+    assert good["requests_total"] == 7
     assert good["requests_within_slo"] == 4
     assert good["goodput_tokens"] == 37
-    assert good["goodput_ratio"] == pytest.approx(4 / 6)
+    assert good["goodput_ratio"] == pytest.approx(4 / 7)
     assert snap["windowed"]["ttft"]["count"] == 6
     assert snap["window_s"] == 60.0
 

@@ -1,0 +1,550 @@
+"""The SLO control loop (PR 11): goodput-first scheduling under overload.
+
+Four mechanisms under test, all host-side decisions over PR 10's SLO
+signals: admission control (shedding), low-priority preemption with
+page donation into the prefix cache, acceptance-adaptive speculation,
+and the accounting that ties them together. The load-bearing contracts:
+
+- the controlled engine's goodput RATE under deterministic
+  oversubscription is at least the uncontrolled engine's, and shed
+  requests get a clean terminal record (``finish_reason="shed"``,
+  stamped lifecycle, jsonl record, never goodput);
+- a preempted-and-resumed greedy request is token-identical to an
+  uninterrupted run — for megastep K in {1, 4}, prefix cache on and off,
+  and on the speculative path;
+- preempt → evict → resume cycles neither leak nor double-free KV pages
+  (``PrefixCache.resident_blocks`` + allocator free-count audit);
+- with control ON but no action firing, the decode path's transfer
+  counters are byte-identical to control OFF (the control loop observes
+  for free, like telemetry before it).
+
+Every latency in here is driven by a fake clock advanced one second per
+scheduler tick, so breach timing — and therefore every assertion — is
+deterministic.
+"""
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from colossalai_tpu.inference import (
+    DraftLenController,
+    EngineStats,
+    EventLog,
+    GenerationConfig,
+    LLMEngine,
+    OverloadConfig,
+    OverloadController,
+    SLOTracker,
+    Telemetry,
+)
+from colossalai_tpu.telemetry.slo import WindowedHistogram
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("prefill_buckets", (16, 32, 64))
+    return LLMEngine(params, cfg, **kw)
+
+
+def _drain(eng):
+    done = []
+    while eng.has_work:
+        done.extend(eng.step())
+    return done
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """One fake clock behind every latency stamp: lifecycle telemetry,
+    the SLO windows, and the tracker's evaluation all read it, so a test
+    advancing it by hand fully determines TTFT/queue-wait."""
+    state = {"t": 1_000_000.0}
+    tick = staticmethod(lambda: state["t"])
+    monkeypatch.setattr(WindowedHistogram, "_clock", tick)
+    monkeypatch.setattr(SLOTracker, "_clock", tick)
+    monkeypatch.setattr(Telemetry, "_clock", tick)
+    return state
+
+
+def _force_breach(slo, n=5, ttft=50.0):
+    """Latch an admission-side breach by hand (windowed p99 over target)."""
+    for _ in range(n):
+        slo.record_request(ttft=ttft, tokens=1, reason="eos")
+    assert slo.breached
+
+
+# ----------------------------------------------------- tier-1 overload smoke
+def test_controlled_goodput_rate_beats_uncontrolled(parts, clock):
+    """The headline A/B, deterministically: the same oversubscribed
+    arrival schedule (2 requests/tick into a 2-slot engine, ~3x the
+    service rate) with control OFF vs ON. Shedding keeps the tail of the
+    schedule out of the queue, so the controlled engine banks the same
+    goodput tokens in strictly fewer ticks — a higher goodput rate —
+    and every shed request still resolves through step()."""
+    n_req, gen = 30, GenerationConfig(max_new_tokens=3)
+
+    def run(overload):
+        slo = SLOTracker(targets={"ttft_p99": 2.5}, window_s=600.0)
+        eng = _engine(parts, max_batch_size=2, prefix_cache=True,
+                      megastep_k=1, slo=slo, overload=overload)
+        done, submitted, busy_ticks = [], 0, 0
+        for tick in range(300):
+            while submitted < n_req and submitted < 2 * (tick + 1):
+                eng.add_request([1 + submitted, 2 + submitted,
+                                 3 + submitted, 4 + submitted], gen)
+                submitted += 1
+            if eng.has_work:
+                done.extend(eng.step())
+                busy_ticks = tick + 1
+            clock["t"] += 1.0
+            if submitted == n_req and not eng.has_work:
+                break
+        assert submitted == n_req and not eng.has_work
+        return slo, eng.stats, done, busy_ticks
+
+    slo_u, st_u, done_u, ticks_u = run(overload=None)
+    slo_c, st_c, done_c, ticks_c = run(overload=True)
+    # every submitted id reaches a terminal state in both arms
+    assert len(done_u) == len(done_c) == n_req
+    for st in (st_u, st_c):
+        assert (st.requests_completed + st.requests_aborted
+                + st.requests_shed == st.requests_submitted == n_req)
+    assert st_u.requests_shed == 0
+    assert st_c.requests_shed > 0
+    # shedding never costs goodput tokens (the shed tail was going to
+    # breach anyway) and strictly shortens the drain
+    assert slo_c.goodput_tokens >= slo_u.goodput_tokens > 0
+    assert ticks_c < ticks_u
+    rate_u = slo_u.goodput_tokens / ticks_u
+    rate_c = slo_c.goodput_tokens / ticks_c
+    assert rate_c >= rate_u
+    # control never touches the device: per-token transfer shape is the
+    # same O(1) megastep pattern, just fewer of them
+    assert st_c.decode_megasteps <= st_u.decode_megasteps
+
+
+def test_no_control_action_at_nominal_load(parts, clock):
+    """Under capacity (1 request per 2 ticks into 2 slots) the controller
+    must be a spectator: nothing shed, nothing preempted, token-identical
+    outputs, equal goodput."""
+    gen = GenerationConfig(max_new_tokens=3)
+
+    def run(overload):
+        slo = SLOTracker(targets={"ttft_p99": 3.5}, window_s=600.0)
+        eng = _engine(parts, max_batch_size=2, prefix_cache=True,
+                      slo=slo, overload=overload)
+        outs, submitted = {}, 0
+        for tick in range(100):
+            if submitted < 6 and tick % 2 == 0:
+                eng.add_request([1 + submitted, 2, 3, 4], gen)
+                submitted += 1
+            if eng.has_work:
+                for req in eng.step():
+                    outs[req.request_id] = list(req.output_ids)
+            clock["t"] += 1.0
+            if submitted == 6 and not eng.has_work:
+                break
+        return slo, eng.stats, outs
+
+    slo_u, st_u, outs_u = run(overload=None)
+    slo_c, st_c, outs_c = run(overload=True)
+    assert st_c.requests_shed == st_c.requests_preempted == 0
+    assert outs_c == outs_u
+    assert slo_c.goodput_tokens == slo_u.goodput_tokens
+
+
+# ------------------------------------------------------- shedding semantics
+def test_shed_requests_get_clean_terminal_telemetry(parts, clock, tmp_path):
+    """A shed request resolves like any other terminal state: it comes
+    back from step() with ``finish_reason="shed"``, empty output, a full
+    lifecycle stamp (arrival + finish), one jsonl record with
+    ``within_slo: false``, and it never counts toward goodput."""
+    log = str(tmp_path / "ev.jsonl")
+    slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    eng = _engine(parts, max_batch_size=2, prefix_cache=True, slo=slo,
+                  overload=OverloadConfig(shed_queue_depth=2),
+                  event_log=log)
+    _force_breach(slo)
+    good_before = slo.goodput_tokens
+    rids = [eng.add_request([1, 2, 3, i], GenerationConfig(max_new_tokens=2))
+            for i in range(4, 10)]
+    assert eng.stats.requests_shed > 0  # gate fired at submit time
+    done = {r.request_id: r for r in _drain(eng)}
+    assert sorted(done) == sorted(rids)
+    shed = [r for r in done.values() if r.finish_reason == "shed"]
+    assert len(shed) == eng.stats.requests_shed > 0
+    for req in shed:
+        assert req.output_ids == []
+        assert req.slot is None and req.table is None
+        assert req.t_arrival is not None and req.t_finished is not None
+    # the controller saw the breach edge; goodput gained nothing from shed
+    assert eng._overload.breach_edges >= 1
+    assert slo.goodput_tokens == good_before + sum(
+        len(r.output_ids) for r in done.values()
+        if r.finish_reason != "shed")
+    eng.telemetry.close()
+    records = {r["request_id"]: r for r in EventLog.read(log)
+               if r.get("event") == "request"}
+    for req in shed:
+        rec = records[req.request_id]
+        assert rec["finish_reason"] == "shed"
+        assert rec["generated_tokens"] == 0
+        assert rec["within_slo"] is False
+
+
+def test_shed_policy_oldest_low_priority_first(parts, clock):
+    """Under ``oldest_low_priority_first`` the arrival competes with the
+    queue: a high-priority arrival displaces the oldest queued request of
+    the lowest priority level instead of being rejected itself."""
+    slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    eng = _engine(parts, prefix_cache=True, slo=slo,
+                  overload=OverloadConfig(
+                      shed_policy="oldest_low_priority_first",
+                      shed_queue_depth=4))
+    _force_breach(slo)
+    gen = GenerationConfig(max_new_tokens=2)
+    queued = [eng.add_request([1, 2, 3, i], gen, priority=0)
+              for i in range(4, 8)]  # fills the queue to the depth cap
+    assert eng.stats.requests_shed == 0
+    vip = eng.add_request([9, 9, 9, 9], gen, priority=5)
+    # the oldest low-priority request was shed, the VIP is queued
+    assert eng.stats.requests_shed == 1
+    assert vip in [r.request_id for r in eng.waiting]
+    done = {r.request_id: r.finish_reason for r in _drain(eng)}
+    assert done[queued[0]] == "shed"
+    assert done[vip] in ("eos", "length")
+
+
+def test_shed_policy_off_and_reject_new_victim(parts, clock):
+    """``off`` never sheds even while breached; ``reject_new`` sheds the
+    arrival itself and leaves the queue untouched."""
+    for policy, expect_shed in (("off", 0), ("reject_new", 1)):
+        slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+        eng = _engine(parts, prefix_cache=True, slo=slo,
+                      overload=OverloadConfig(shed_policy=policy,
+                                              shed_queue_depth=2))
+        _force_breach(slo)
+        gen = GenerationConfig(max_new_tokens=2)
+        queued = [eng.add_request([1, 2, 3, i], gen) for i in range(4, 6)]
+        arrival = eng.add_request([7, 7, 7, 7], gen)
+        assert eng.stats.requests_shed == expect_shed
+        if expect_shed:
+            assert [r.request_id for r in eng.waiting] == queued
+        done = {r.request_id: r.finish_reason for r in _drain(eng)}
+        assert done[arrival] == ("shed" if expect_shed else "length")
+
+
+def test_overload_requires_slo_tracker(parts):
+    with pytest.raises(ValueError, match="SLO"):
+        _engine(parts, slo=False, overload=True)
+    with pytest.raises(ValueError):
+        OverloadConfig(shed_policy="nope")
+    with pytest.raises(ValueError):
+        OverloadConfig(shed_queue_depth=0)
+    with pytest.raises(ValueError):
+        OverloadConfig(draft_lower_at=0.9, draft_raise_at=0.2)
+
+
+def test_controller_shedding_rederives_across_reset(clock):
+    """``shedding`` reads the tracker live — a ``reset()`` (bench warm-up
+    hygiene) stands the gate down without any recover edge having fired."""
+    slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    ctl = OverloadController(slo, OverloadConfig())
+    assert not ctl.shedding
+    _force_breach(slo)
+    assert ctl.shedding and ctl.breach_edges == 1
+    slo.reset()
+    assert not ctl.shedding  # no stale latch
+    # ITL/e2e breaches are decode-side: they never arm the shed gate
+    slo2 = SLOTracker(targets={"itl_p99": 0.001}, window_s=600.0)
+    ctl2 = OverloadController(slo2, OverloadConfig())
+    for _ in range(5):
+        slo2.record_request(itl=1.0, tokens=4)
+    assert slo2.breached and not ctl2.shedding
+
+
+# ------------------------------------------------- preemption and resumption
+@pytest.mark.parametrize("k", [1, 4])
+@pytest.mark.parametrize("cache", [True, False])
+def test_preempt_resume_greedy_identity(parts, k, cache):
+    """The resume contract: evict a running greedy request mid-decode,
+    let it re-enter through the waiting queue, and its final output is
+    token-identical to a run that was never interrupted — with and
+    without the prefix cache (pages donated vs recomputed), K in {1, 4}."""
+    prompt = list(range(1, 18))
+    gen = GenerationConfig(max_new_tokens=12)
+    eng = _engine(parts, overload=True, megastep_k=k, prefix_cache=cache)
+    rid = eng.add_request(prompt, gen)
+    for _ in range(4 if k == 1 else 1):
+        eng.step()
+    req = eng.running.get(next(iter(eng.running), None))
+    assert req is not None and 0 < len(req.output_ids) < 12
+    assert eng.preempt(rid)
+    assert rid not in {r.request_id for r in eng.running.values()}
+    assert eng.stats.requests_preempted == 1
+    done = _drain(eng)
+    assert [r.request_id for r in done] == [rid]
+    assert eng.stats.requests_resumed == 1
+    baseline = _engine(parts, megastep_k=k, prefix_cache=cache).generate(
+        [list(prompt)], gen)[0]
+    assert done[0].output_ids == baseline
+    assert done[0].finish_reason in ("eos", "length")
+
+
+def test_preempt_resume_identity_speculative(parts):
+    """Same contract on the speculative path: only prompt-span pages are
+    donated (generated positions have no mirrored draft-pool KV), and the
+    resumed greedy output still matches an uninterrupted spec run."""
+    prompt = list(range(1, 18))
+    gen = GenerationConfig(max_new_tokens=12)
+    for cache in (True, False):
+        eng = _engine(parts, overload=True, draft_len=3,
+                      self_draft_layers=1, prefix_cache=cache)
+        rid = eng.add_request(prompt, gen)
+        eng.step(); eng.step()
+        assert eng.running
+        assert eng.preempt(rid)
+        done = _drain(eng)
+        baseline = _engine(parts, draft_len=3, self_draft_layers=1).generate(
+            [list(prompt)], gen)[0]
+        assert done[0].output_ids == baseline, cache
+
+
+def test_priority_preemption_evicts_lowest_priority_runner(parts):
+    """A blocked high-priority waiter evicts the lowest-priority runner:
+    the VIP finishes first, the victim resumes and completes with its
+    uninterrupted greedy output."""
+    gen_long = GenerationConfig(max_new_tokens=16)
+    gen_short = GenerationConfig(max_new_tokens=3)
+    eng = _engine(parts, max_batch_size=1, overload=True, prefix_cache=True,
+                  scheduler_policy="priority")
+    low = eng.add_request(list(range(1, 18)), gen_long, priority=0)
+    eng.step()
+    assert eng.running
+    vip = eng.add_request(list(range(30, 40)), gen_short, priority=5)
+    done = _drain(eng)
+    assert eng.stats.requests_preempted == 1
+    assert eng.stats.requests_resumed == 1
+    assert [r.request_id for r in done] == [vip, low]
+    baseline = _engine(parts, prefix_cache=True).generate(
+        [list(range(1, 18))], gen_long)[0]
+    assert {r.request_id: r.output_ids for r in done}[low] == baseline
+
+
+def test_preemption_never_fires_without_strict_priority_win(parts):
+    """Anti-livelock: equal priority never preempts (strict inequality),
+    and under fifo the requeued victim would win the next admission, so
+    the policy-key guard keeps preemption off entirely."""
+    gen = GenerationConfig(max_new_tokens=8)
+    for policy in ("priority", "fifo"):
+        eng = _engine(parts, max_batch_size=1, overload=True,
+                      prefix_cache=True, scheduler_policy=policy)
+        eng.add_request(list(range(1, 18)), gen, priority=0)
+        eng.step()
+        eng.add_request(list(range(30, 40)), gen, priority=0)
+        _drain(eng)
+        assert eng.stats.requests_preempted == 0, policy
+
+
+def test_preempt_refcount_invariants_across_evict_and_resume(parts):
+    """Page accounting across the full preempt → evict → resume cycle:
+    donated pages are owned by the tree (auditable via
+    ``resident_blocks``), stay evictable, and the allocator returns to
+    its starting free count once the request finishes and the cache is
+    emptied — no leak, no double-free (the allocator raises on one)."""
+    prompt = list(range(1, 40))  # 39 tokens: 2 full 16-token pages
+    gen = GenerationConfig(max_new_tokens=8)
+    eng = _engine(parts, overload=True, prefix_cache=True)
+    pc = eng.prefix_cache
+    free0 = eng.allocator.num_free
+    rid = eng.add_request(prompt, gen)
+    for _ in range(5):
+        eng.step()
+    assert eng.running
+    assert eng.preempt(rid)
+    # ctx = 39 prompt + >=2 generated, KV valid to len(ctx)-1 → at least
+    # the two full prompt pages were donated, all tree-owned and unpinned
+    assert pc.num_blocks >= 2
+    assert len(pc.resident_blocks()) == pc.num_blocks
+    evicted = pc.evict(10_000, eng.allocator)
+    assert evicted >= 2 and pc.num_blocks == 0
+    # resume from a cold cache: full re-prefill, identical output
+    done = _drain(eng)
+    baseline = _engine(parts, prefix_cache=True).generate(
+        [list(prompt)], gen)[0]
+    assert done[0].output_ids == baseline
+    # finish donated the prompt pages again; empty the tree and audit
+    pc.evict(10_000, eng.allocator)
+    assert pc.num_blocks == 0 and len(pc.resident_blocks()) == 0
+    assert eng.allocator.num_free == free0
+
+    # cycle 2: resume THROUGH the warm cache (donated pages re-matched)
+    eng2 = _engine(parts, overload=True, prefix_cache=True)
+    free0 = eng2.allocator.num_free
+    rid = eng2.add_request(list(prompt), gen)
+    for _ in range(5):
+        eng2.step()
+    assert eng2.preempt(rid)
+    donated = eng2.prefix_cache.num_blocks
+    assert donated >= 2
+    done = _drain(eng2)
+    assert done[0].output_ids == baseline
+    assert eng2.prefix_cache.hit_blocks >= donated  # resume hit the tree
+    eng2.prefix_cache.evict(10_000, eng2.allocator)
+    assert eng2.allocator.num_free == free0
+
+
+# --------------------------------------------------------- transfer parity
+def test_transfer_counters_identical_with_control_on_and_off(parts):
+    """Control that never acts is free: same workload, no breach, no
+    priority inversion → the decode path's device-transfer counters are
+    byte-identical with the controller on vs off."""
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8]]
+    gen = GenerationConfig(max_new_tokens=6)
+    results = {}
+    for mode in (None, True):
+        eng = _engine(parts, megastep_k=2, prefix_cache=True,
+                      slo=SLOTracker(targets={"ttft_p99": 1e6}),
+                      overload=mode)
+        outs = eng.generate([list(p) for p in prompts], gen)
+        results[mode] = (outs, eng.stats)
+    outs_off, st_off = results[None]
+    outs_on, st_on = results[True]
+    assert outs_off == outs_on
+    assert st_on.requests_shed == st_on.requests_preempted == 0
+    assert st_on.decode_syncs == st_off.decode_syncs
+    assert st_on.decode_h2d_scalars == st_off.decode_h2d_scalars
+    assert st_on.decode_d2h_elements == st_off.decode_d2h_elements
+    assert st_on.decode_megasteps == st_off.decode_megasteps
+
+
+# ------------------------------------------------------ adaptive speculation
+def test_draft_len_controller_unit():
+    ctl = DraftLenController(4, ewma=1.0, raise_at=0.8, lower_at=0.4)
+    req = SimpleNamespace(spec_accept_ewma=None, spec_draft_rec=0)
+    # zero drafted: no observation, no change
+    assert ctl.update(req, drafted=0, accepted=0) is False
+    # high acceptance at the max: recommendation pegged, not "changed"
+    assert ctl.update(req, drafted=4, accepted=4) is False
+    assert req.spec_draft_rec == 4
+    # sustained rejection walks down one step per tick to the floor of 1
+    steps = [ctl.update(req, drafted=4, accepted=0) for _ in range(5)]
+    assert steps == [True, True, True, False, False]
+    assert req.spec_draft_rec == 1  # never 0: draft KV must stay aligned
+    # recovery walks back up
+    assert ctl.update(req, drafted=1, accepted=1) is True
+    assert req.spec_draft_rec == 2
+    # the tick width is the rounded mean of recommendations, clamped
+    a = SimpleNamespace(spec_accept_ewma=None, spec_draft_rec=1)
+    b = SimpleNamespace(spec_accept_ewma=None, spec_draft_rec=4)
+    c = SimpleNamespace(spec_accept_ewma=None, spec_draft_rec=0)  # no vote yet
+    assert ctl.tick_draft_len([a, b]) == 2  # round(2.5) banker's → 2
+    assert ctl.tick_draft_len([c]) == 4  # unobserved votes the max
+    assert ctl.tick_draft_len([]) == 4
+    with pytest.raises(ValueError):
+        DraftLenController(0)
+    with pytest.raises(ValueError):
+        DraftLenController(4, ewma=0.0)
+    with pytest.raises(ValueError):
+        DraftLenController(4, raise_at=0.2, lower_at=0.9)
+
+
+def test_adaptive_draft_keeps_greedy_outputs_and_counts_adjustments(parts):
+    """Changing the per-tick draft width is a scheduling decision, not a
+    sampling one: greedy spec output is lossless at ANY width, so the
+    adaptive engine's outputs match a fixed-width engine token for token
+    while the adjustment counter records the controller working."""
+    prompts = [list(range(1, 18)), list(range(30, 40))]
+    gen = GenerationConfig(max_new_tokens=10)
+    fixed = _engine(parts, draft_len=3, self_draft_layers=1, megastep_k=2)
+    adaptive = _engine(parts, draft_len=3, self_draft_layers=1, megastep_k=2,
+                       overload=True)
+    outs_fixed = fixed.generate([list(p) for p in prompts], gen)
+    outs_adaptive = adaptive.generate([list(p) for p in prompts], gen)
+    assert outs_fixed == outs_adaptive
+    assert adaptive.stats.spec_draft_len_adjustments > 0
+    assert fixed.stats.spec_draft_len_adjustments == 0
+
+
+# ------------------------------------------------------ router SLO placement
+class _StubEngine:
+    has_work = False
+    prefix_cache = None
+
+    def __init__(self):
+        self.stats = EngineStats()
+        self.telemetry = Telemetry(slo=SLOTracker(
+            targets={"ttft_p99": 0.5}, window_s=600.0))
+        self.waiting = []
+        self.prefilling = {}
+        self.running = {}
+        self.allocator = SimpleNamespace(num_free=0)
+
+
+def test_router_slo_aware_placement_avoids_breached_replicas(clock):
+    """A breached replica is a soft drain: placement steers to healthy
+    replicas (counted in ``slo_avoided_placements``) until every replica
+    is breached, then falls back to all of them — and ``evaluate()`` is
+    re-read live, so a drained window rejoins placement on its own."""
+    from colossalai_tpu.inference.router import Router
+
+    router = Router([_StubEngine(), _StubEngine()], policy="least_loaded",
+                    parallel_step=False)
+    try:
+        _force_breach(router.engines[1].telemetry.slo)
+        picks = [router._place([1, 2, 3]) for _ in range(4)]
+        assert picks == [0, 0, 0, 0]
+        assert router.slo_avoided_placements == 4
+        assert router.router_counters()[
+            "router_slo_avoided_placements"] == 4
+        # fleet-wide breach: fall back to every eligible replica
+        _force_breach(router.engines[0].telemetry.slo)
+        picks = {router._place([1, 2, 3]) for _ in range(4)}
+        assert picks == {0, 1}
+        assert router.slo_avoided_placements == 4  # fallback isn't avoidance
+        # the breach drains out of the window → both replicas healthy again
+        clock["t"] += 700.0
+        picks = {router._place([1, 2, 3]) for _ in range(4)}
+        assert picks == {0, 1}
+        assert router.slo_avoided_placements == 4
+    finally:
+        router.close()
+
+
+def test_router_slo_aware_off_and_drain_interaction(clock):
+    from colossalai_tpu.inference.router import Router
+
+    blind = Router([_StubEngine(), _StubEngine()], policy="least_loaded",
+                   parallel_step=False, slo_aware=False)
+    try:
+        _force_breach(blind.engines[1].telemetry.slo)
+        picks = {blind._place([1, 2, 3]) for _ in range(4)}
+        assert picks == {0, 1}  # breach ignored entirely
+        assert blind.slo_avoided_placements == 0
+    finally:
+        blind.close()
+    router = Router([_StubEngine(), _StubEngine()], policy="least_loaded",
+                    parallel_step=False)
+    try:
+        # the only non-draining replica is breached: hard drain wins and
+        # the breached replica still takes the traffic (soft vs hard)
+        _force_breach(router.engines[1].telemetry.slo)
+        router.drain(0)
+        assert [router._place([1, 2, 3]) for _ in range(2)] == [1, 1]
+    finally:
+        router.close()
