@@ -919,6 +919,174 @@ def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
     return out
 
 
+def measure_disagg(cfg=None, bs: int = 4, prompt_len: int = 48,
+                   new_tokens: int = 24, n_batches: int = 6,
+                   load_factor: float = 1.5, k: int = 4,
+                   repeats: int = 2):
+    """Colocated vs disaggregated prefill/decode A/B on the SAME
+    open-loop arrival schedule (the PR-12 ground truth).
+
+    The colocated arm is one monolithic engine: every arriving prompt's
+    prefill wave parks the running decodes, and the tracer attributes
+    that interval to them as ``prefill_stall`` spans. The disaggregated
+    arm is a :class:`~colossalai_tpu.inference.DisaggEngine` — prefill
+    runs on its own worker, pages move over KVTransport, and the decode
+    worker structurally never prefills, so its ``prefill_stall`` total is
+    the thing this bench exists to show shrinking. Both arms replay the
+    identical schedule (``load_factor`` times the calibrated sustainable
+    rate, same prompts) with the same decode megastep K; the report pairs
+    total stall seconds with the decode ITL tail so a stall win bought by
+    slower decode ticks (transfer overhead) cannot hide.
+
+    Decode ITL is sampled per token from the gaps between successive
+    output-length observations of requests RESIDENT IN THE DECODE ROLE —
+    uniformly in both arms — so a request parked in the handoff buffer
+    waiting for a decode slot counts as queueing (it surfaces in the e2e
+    tail), not as inter-token latency, exactly as a colocated request
+    parked in the waiting queue does.
+
+    The A/B runs as ``repeats`` back-to-back (colocated, disagg) pairs
+    with the order flipped on alternating pairs, and the reported arms
+    are the MEDIAN pair by ITL-p99 ratio. Tail latencies on a shared
+    host drift at whole-run granularity (a slow scheduling window slows
+    every sample in whichever arm occupies it); pairing keeps the two
+    arms of each comparison adjacent in time so drift hits both, and the
+    median pair discards the comparisons a glitch still skewed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import (
+        DisaggEngine,
+        GenerationConfig,
+        LLMEngine,
+    )
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    if cfg is None:
+        cfg = _small_serving_config()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    n_req = n_batches * bs
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
+               for _ in range(n_req)]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+
+    def make_engine(kind):
+        kw = dict(max_batch_size=bs, max_seq_len=512, block_size=32,
+                  megastep_k=k, prefix_cache=True, tracer=True)
+        if kind == "colocated":
+            e = LLMEngine(params, cfg, **kw)
+        else:
+            e = DisaggEngine(params, cfg, **kw)
+        # warm prefill bucket + K-megastep (+ transfer jits on the disagg
+        # arm) off the clock; the XOR'd family keeps the timed prompts
+        # out of the prefix tiers
+        throwaway = [[int(t) ^ 1 for t in prompts[0]]] * bs
+        e.generate([list(p) for p in throwaway],
+                   GenerationConfig(max_new_tokens=k + 2))
+        e.telemetry.tracer.clear()  # drop warm-up spans
+        return e
+
+    # -- calibration: closed-loop full batch = sustainable request rate
+    eng = make_engine("colocated")
+    t0 = time.perf_counter()
+    for p in prompts[:bs]:
+        eng.add_request(list(p), gen)
+    while eng.has_work:
+        eng.step()
+    peak_req_rate = bs / (time.perf_counter() - t0)
+
+    def run_arm(kind):
+        eng = make_engine(kind)
+        tracer = eng.telemetry.tracer
+        s0 = eng.stats  # warm-up baseline for the transfer counters
+        base = (s0.kv_transfers, s0.kv_transfer_blocks, s0.kv_transfer_bytes)
+        decode_running = (eng.decode.running if kind == "disagg"
+                          else eng.running)
+        interarrival = 1.0 / (load_factor * peak_req_rate)
+        t_submit, t_done, n_toks = {}, {}, {}
+        last = {}  # rid -> (t, n_tokens) at its previous decode observation
+        itls = []
+
+        def observe(req, now):
+            rid, n = req.request_id, len(req.output_ids)
+            if rid in last:
+                t_prev, n_prev = last[rid]
+                if n > n_prev:
+                    itls.extend([(now - t_prev) / (n - n_prev)] * (n - n_prev))
+            last[rid] = (now, n)
+
+        i = 0
+        t0 = time.perf_counter()
+        while i < n_req or eng.has_work:
+            now = time.perf_counter()
+            while i < n_req and now - t0 >= i * interarrival:
+                rid = eng.add_request(list(prompts[i]), gen)
+                t_submit[rid] = time.perf_counter()
+                i += 1
+            if eng.has_work:
+                finished = eng.step()
+                now = time.perf_counter()
+                for req in decode_running.values():
+                    observe(req, now)
+                for req in finished:
+                    if req.request_id in last:
+                        observe(req, now)
+                        del last[req.request_id]
+                    t_done[req.request_id] = now
+                    n_toks[req.request_id] = len(req.output_ids)
+            else:
+                time.sleep(min(interarrival, 0.002))
+        dt = time.perf_counter() - t0
+        stalls = [s.duration or 0.0 for s in tracer.spans()
+                  if s.name == "prefill_stall"]
+        itl_p50, itl_p99 = _tail_ms(itls)
+        e2e_p50, e2e_p99 = _tail_ms(
+            [t_done[r] - t_submit[r] for r in t_done])
+        arm = {
+            "n_requests": n_req,
+            "tokens_per_s": round(sum(n_toks.values()) / dt, 1),
+            "itl_ms_p50": itl_p50,
+            "itl_ms_p99": itl_p99,
+            "e2e_ms_p50": e2e_p50,
+            "e2e_ms_p99": e2e_p99,
+            "prefill_stall_s_total": round(sum(stalls), 4),
+            "prefill_stall_spans": len(stalls),
+        }
+        if kind == "disagg":
+            s = eng.stats
+            arm["kv_transfers"] = s.kv_transfers - base[0]
+            arm["kv_transfer_blocks"] = s.kv_transfer_blocks - base[1]
+            arm["kv_transfer_mb"] = round(
+                (s.kv_transfer_bytes - base[2]) / 1e6, 3)
+        return arm
+
+    pairs = []
+    for r in range(repeats):
+        if r % 2 == 0:
+            colo = run_arm("colocated")
+            dis = run_arm("disagg")
+        else:
+            dis = run_arm("disagg")
+            colo = run_arm("colocated")
+        pairs.append((dis["itl_ms_p99"] / max(colo["itl_ms_p99"], 1e-9),
+                      colo, dis))
+    pairs.sort(key=lambda t: t[0])
+    ratio, colo, dis = pairs[len(pairs) // 2]
+    return {
+        "load_factor": load_factor,
+        "peak_req_per_s": round(peak_req_rate, 2),
+        "repeats": repeats,
+        "colocated": colo,
+        "disagg": dis,
+        "prefill_stall_reduction_s": round(
+            colo["prefill_stall_s_total"] - dis["prefill_stall_s_total"], 4),
+        "itl_p99_ratio": round(ratio, 3),
+    }
+
+
 def measure_moe(n_dev: int, steps: int = 5):
     """MoE pretraining throughput: a ~0.8B-active mixtral-shaped model
     (tokens/s/device — MoE MFU accounting is convention-laden, so the raw
@@ -1115,6 +1283,13 @@ def child_main():
         except Exception as e:
             print(f"overload bench failed: {e}", file=sys.stderr)
         try:
+            # disaggregated prefill/decode: colocated vs split-role A/B
+            # on the same open-loop schedule — decode prefill_stall
+            # seconds + ITL tail + KV-transfer volume
+            extras["disagg"] = measure_disagg()
+        except Exception as e:
+            print(f"disagg bench failed: {e}", file=sys.stderr)
+        try:
             extras.update(measure_flash_kernels())
         except Exception as e:
             print(f"flash kernel bench failed: {e}", file=sys.stderr)
@@ -1200,6 +1375,11 @@ def cpu_child_main():
             bs=2, prompt_len=32, new_tokens=12, factors=(1, 2, 5))
     except Exception as e:
         print(f"cpu overload bench failed: {e}", file=sys.stderr)
+    try:
+        extras["disagg_cpu"] = measure_disagg(
+            bs=2, prompt_len=32, new_tokens=32, n_batches=5, repeats=3)
+    except Exception as e:
+        print(f"cpu disagg bench failed: {e}", file=sys.stderr)
     # compact headline for the supervisor's final line: the driver records
     # a bounded output tail, so the merged failure JSON carries THIS, not
     # the full nested dicts
@@ -1226,6 +1406,14 @@ def cpu_child_main():
                 summary[f"overload_{fk}_{arm}_goodput_tokens_per_s"] = \
                     ov[fk][arm]["goodput_tokens_per_s"]
             summary[f"overload_{fk}_goodput_gain"] = ov[fk]["goodput_gain"]
+    dg = extras.get("disagg_cpu", {})
+    for arm in ("colocated", "disagg"):
+        if arm in dg:
+            summary[f"disagg_{arm}_prefill_stall_s"] = \
+                dg[arm]["prefill_stall_s_total"]
+            summary[f"disagg_{arm}_itl_ms_p99"] = dg[arm]["itl_ms_p99"]
+    if "itl_p99_ratio" in dg:
+        summary["disagg_itl_p99_ratio"] = dg["itl_p99_ratio"]
     print(json.dumps({
         "metric": "cpu_serving_fallback", "value": 0.0, "unit": "MFU",
         "vs_baseline": 0.0, "cpu_fallback": True, "summary": summary,

@@ -1,4 +1,5 @@
 from .diffusion import ddim_sample, ddim_schedule
+from .disagg import DISAGG_ROLES, DisaggEngine
 from .engine import (
     SCHEDULER_POLICIES,
     EngineStats,
@@ -24,10 +25,18 @@ from .paged_modeling import (
     sample_tokens,
     verify_paged,
 )
+from .kv_transport import (
+    DeviceKVTransport,
+    HostKVTransport,
+    KVTransport,
+    PageBlockWire,
+)
 from .overload import (
+    PREEMPT_VICTIM_POLICIES,
     SHED_POLICIES,
     OverloadConfig,
     OverloadController,
+    retry_after_hint,
 )
 from .prefix_cache import PrefixCache
 from .router import ROUTER_POLICIES, Router, make_router_server
@@ -85,8 +94,16 @@ __all__ = [
     "Router",
     "extend_step",
     "DraftLenController",
+    "DISAGG_ROLES",
+    "DisaggEngine",
+    "DeviceKVTransport",
+    "HostKVTransport",
+    "KVTransport",
+    "PageBlockWire",
     "OverloadConfig",
     "OverloadController",
+    "PREEMPT_VICTIM_POLICIES",
+    "retry_after_hint",
     "SHED_POLICIES",
     "SpeculativeEngine",
     "SpecStats",

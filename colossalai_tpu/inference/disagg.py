@@ -1,0 +1,508 @@
+"""Disaggregated prefill/decode serving over a :class:`KVTransport`.
+
+Chunked prefill interleaves prompt ingestion with decode on ONE mesh —
+PR 10's ``prefill_stall`` spans measure how batch-mates' prompt waves
+still stall decode ticks. This module splits the two phases onto
+dedicated engine replicas:
+
+- a **prefill worker** (:class:`_PrefillWorker`, an ``LLMEngine``
+  subclass) runs prompt ingestion exactly as the monolithic engine does —
+  padded-bucket or chunked prefill, grouped-sampling forks, prefix-cache
+  warm paths, overload admission control — but freshly prefilled
+  sequences never decode there: they divert into a handoff queue with
+  their pages held live;
+- a :class:`~.kv_transport.KVTransport` moves each sequence's KV pages
+  (bf16, or int8 pages with their k/v scales) into the **decode
+  worker**'s pool;
+- the decode worker splices the arrived blocks into a fresh block table,
+  seats the request directly into a decode slot (no prefill on this
+  side), and the stock megastep loop takes over. Greedy output is
+  token-identical to the monolithic engine: the spliced pages are
+  byte-copies and decode starts from the same committed first token.
+
+``PrefixCache`` becomes a cross-engine tier: the prefill worker's tree
+keeps serving warm hits for repeated prompts (handed-off prompt pages
+are donated back into it), and at splice time the transferred full
+prompt pages are ALSO inserted into the decode worker's tree, so the
+prompt is matchable on the decode side (preemption resume, grouped
+forks, future decode-side admissions).
+
+:class:`DisaggEngine` pairs the two workers behind the exact engine
+duck-type surface ``server._Scheduler`` and the ``Router`` drive
+(``add_request/step/has_work/abort/running/generate`` + the
+observability surface), so both run unmodified. One shared
+:class:`~.telemetry.Telemetry` facade spans the pair: request lifecycles
+stamp across the handoff, ``kv_transfer`` spans time each page move, and
+``EngineStats.kv_transfer*`` counters account blocks/bytes moved.
+
+Role control plane: ``drain_role("prefill")`` stops new admissions while
+in-flight work (including pending handoffs) flushes;
+``drain_role("decode")`` pauses splices — pending handoffs hold with
+their prefill-side pages intact — while resident decodes drain dry
+(weight swaps, rolling restarts). The Router's ``drain(i, role=...)``
+delegates here, and ``role_health()``/``breached_roles()`` expose the
+per-role view its SLO-aware placement and ``/health`` report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Set, Union
+
+from .engine import EngineStats, GenerationConfig, LLMEngine, Request
+from .kv_cache import SequenceTable
+from .kv_transport import DeviceKVTransport, KVTransport, page_nbytes
+from .telemetry import SLOTracker, Telemetry, Tracer
+
+DISAGG_ROLES = ("prefill", "decode")
+
+#: which worker class each windowed SLO metric indicts when breached:
+#: admission-side latencies point at prompt ingestion, decode-side at
+#: token generation (e2e spans both; it lands on decode, where requests
+#: spend the bulk of their lifetime)
+_ROLE_OF_METRIC = {"ttft": "prefill", "queue_wait": "prefill",
+                   "itl": "decode", "e2e": "decode"}
+
+
+class _PrefillWorker(LLMEngine):
+    """Prefill-role engine: stock prompt ingestion, no decode. Survivors
+    of ``_finish_prefill`` (first token sampled, pages complete) move to
+    ``_handoff`` instead of the running set; their slots stay reserved
+    and their pages stay allocated until :meth:`complete_handoff` — the
+    decode side owns copies by then. With the running set always empty,
+    the decode tick and the prefill-stall attribution are structural
+    no-ops here."""
+
+    def __init__(self, *args, **kwargs):
+        #: slot → prefilled Request awaiting transport, insertion-ordered
+        self._handoff: Dict[int, Request] = {}
+        super().__init__(*args, **kwargs)
+
+    def _finish_prefill(self, req, logits, follower_slots, finished) -> None:
+        super()._finish_prefill(req, logits, follower_slots, finished)
+        # divert every survivor the stock path just seated: requests that
+        # finished ON the first token (eos / max_new_tokens=1) were
+        # already released+reported and never reach the queue
+        for slot in sorted(self.running):
+            m = self.running.pop(slot)
+            self._reserved.add(slot)
+            self._handoff[slot] = m
+
+    def complete_handoff(self, slot: int) -> None:
+        """The decode side holds copies: release the prefill-side pages
+        (full prompt pages donate into THIS worker's prefix tree — repeat
+        prompts keep prefilling warm) and free the held slot."""
+        req = self._handoff.pop(slot)
+        self._release(slot, req)
+        self._reserved.discard(slot)
+
+    def abort(self, request_id: int) -> bool:
+        for slot, req in list(self._handoff.items()):
+            if req.request_id == request_id:
+                self._handoff.pop(slot)
+                self._release(slot, req)
+                self._reserved.discard(slot)
+                self._finish(req, "aborted")
+                return True
+        return super().abort(request_id)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._handoff) or super().has_work
+
+
+class _PoolView:
+    """Merged read-only allocator gauges over the two workers' pools —
+    the ``engine.allocator`` surface ``/health``, ``/metrics`` and the
+    router read (``num_free`` headroom)."""
+
+    def __init__(self, *allocators):
+        self._allocators = allocators
+
+    @property
+    def num_free(self) -> int:
+        return sum(a.num_free for a in self._allocators)
+
+    @property
+    def num_blocks(self) -> int:
+        return sum(a.num_blocks for a in self._allocators)
+
+
+class DisaggEngine:
+    """Prefill-role + decode-role ``LLMEngine`` pair behind one
+    engine-shaped surface.
+
+    Construction mirrors ``LLMEngine``: pass the same params/config and
+    knobs; every knob applies to both workers except the role split
+    baked in (the prefill worker runs ``megastep_k=1`` — it never
+    decodes — and owns the ``overload`` admission gate; the decode
+    worker owns the megastep knobs). ``prefill_overrides`` /
+    ``decode_overrides`` tweak one side (e.g. a deeper prefill pool via
+    ``{"num_blocks": ...}``). Telemetry/tracing/SLO attach ONCE and are
+    shared by both workers, so request lifecycles, spans, and windowed
+    SLOs read exactly like a monolithic engine's.
+
+    ``transport`` defaults to the in-process
+    :class:`~.kv_transport.DeviceKVTransport`; pass any
+    :class:`~.kv_transport.KVTransport` (e.g. ``HostKVTransport`` to
+    rehearse the wire format).
+    """
+
+    role = "disagg"
+
+    def __init__(
+        self,
+        params,
+        config,
+        *,
+        transport: Optional[KVTransport] = None,
+        prefill_overrides: Optional[Dict] = None,
+        decode_overrides: Optional[Dict] = None,
+        telemetry: Union[bool, Telemetry] = True,
+        event_log: Optional[str] = None,
+        tracer: Union[bool, Tracer, None] = None,
+        slo: Union[bool, SLOTracker, None] = True,
+        overload=None,
+        **engine_kwargs,
+    ):
+        self.transport = transport if transport is not None else DeviceKVTransport()
+        # ---- ONE telemetry facade for the pair (same validation contract
+        # as LLMEngine): lifecycle stamps survive the handoff because the
+        # Request object itself crosses, and both workers report into the
+        # same histograms/tracer/SLO window.
+        if isinstance(telemetry, Telemetry):
+            if event_log is not None or tracer not in (None, False) \
+                    or isinstance(slo, SLOTracker):
+                raise ValueError(
+                    "pass event_log=/tracer=/slo= to the Telemetry you "
+                    "constructed, not alongside it"
+                )
+            tele = telemetry
+        elif telemetry:
+            tele = Telemetry(
+                event_log=event_log,
+                tracer=(Tracer() if tracer is True else (tracer or None)),
+                slo=(SLOTracker() if slo is True else (slo or None)),
+            )
+        else:
+            if event_log is not None or tracer not in (None, False) \
+                    or isinstance(slo, SLOTracker):
+                raise ValueError(
+                    "event_log=/tracer=/slo= need telemetry enabled — drop "
+                    "telemetry=False or the observability knobs"
+                )
+            tele = None
+        pre_kw = dict(engine_kwargs)
+        pre_kw["megastep_k"] = 1  # ingestion only — this side never decodes
+        pre_kw["overload"] = overload  # admission control gates HERE
+        pre_kw.update(prefill_overrides or {})
+        dec_kw = dict(engine_kwargs)
+        dec_kw.update(decode_overrides or {})
+        self.prefill = _PrefillWorker(
+            params, config,
+            telemetry=(tele if tele is not None else False), **pre_kw)
+        self.decode = LLMEngine(
+            params, config,
+            telemetry=(tele if tele is not None else False), **dec_kw)
+        if self.prefill.kv_dtype != self.decode.kv_dtype:
+            raise ValueError(
+                f"kv_dtype mismatch across roles: prefill="
+                f"{self.prefill.kv_dtype!r} vs decode="
+                f"{self.decode.kv_dtype!r} — pages move bit-for-bit, both "
+                "pools must share one dtype"
+            )
+        if self.prefill.block_size != self.decode.block_size:
+            raise ValueError(
+                f"block_size mismatch across roles: "
+                f"{self.prefill.block_size} vs {self.decode.block_size}"
+            )
+        #: the shared facade (identical object on both workers)
+        self.telemetry = self.prefill.telemetry
+        self.allocator = _PoolView(self.prefill.allocator,
+                                   self.decode.allocator)
+        self._draining: Set[str] = set()
+        #: bytes one transferred page moves on the target (and draft) pool
+        self._page_bytes = page_nbytes(self.decode.cache)
+        self._draft_page_bytes = (
+            page_nbytes(self.decode.draft_cache)
+            if self.decode.draft_cache is not None else 0
+        )
+
+    # ------------------------------------------------------ engine surface
+    def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None,
+                    n_samples: int = 1, priority: int = 0):
+        """Queue one prompt on the prefill worker. Decode-side capacity is
+        validated up front: a prompt whose pages could never fit the
+        decode pool would prefill fine and then wedge the handoff queue
+        forever."""
+        if "prefill" in self._draining:
+            raise RuntimeError(
+                "prefill role is draining — undrain it before submitting "
+                "new requests"
+            )
+        d = self.decode
+        need = d.allocator.blocks_needed(len(list(prompt_ids)) + 1)
+        if need > d.allocator.num_blocks - 1:
+            raise ValueError(
+                f"prompt needs {need} decode-side pages but the decode "
+                f"pool only has {d.allocator.num_blocks - 1} — raise the "
+                "decode worker's num_blocks"
+            )
+        return self.prefill.add_request(prompt_ids, gen,
+                                        n_samples=n_samples,
+                                        priority=priority)
+
+    def step(self) -> List[Request]:
+        """One disaggregated tick: advance prompt ingestion, move every
+        finished handoff the decode side can seat, then advance decode
+        megasteps. Both workers' finishes merge into one list."""
+        finished = list(self.prefill.step())
+        self._pump_handoffs()
+        finished.extend(self.decode.step())
+        return finished
+
+    def abort(self, request_id: int) -> bool:
+        return self.decode.abort(request_id) or self.prefill.abort(request_id)
+
+    @property
+    def has_work(self) -> bool:
+        return self.prefill.has_work or self.decode.has_work
+
+    def generate(self, prompts: List[List[int]],
+                 gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        """Blocking batch API, same contract as ``LLMEngine.generate``."""
+        order = [self.add_request(p, gen) for p in prompts]
+        done: Dict[int, Request] = {}
+        while self.has_work:
+            for req in self.step():
+                done[req.request_id] = req
+        return [done[rid].output_ids for rid in order]
+
+    # ------------------------------------------------------------- handoff
+    def _pump_handoffs(self) -> None:
+        """Splice finished prefills into the decode worker, FIFO. The
+        per-pump ``dst_map`` keeps grouped-sampling page sharing intact
+        across the boundary: a source page two members share is moved
+        once and fork-shared on the decode side. Stops at the first
+        request the decode side can't seat (no free slot / pages) — the
+        queue holds, prefill-side pages stay live, and prompt ingestion
+        backpressures naturally."""
+        if "decode" in self._draining:
+            return
+        p = self.prefill
+        dst_map: Dict[int, int] = {}
+        for slot in list(p._handoff):
+            if not self._try_splice(p._handoff[slot], dst_map):
+                break
+            p.complete_handoff(slot)
+
+    def _try_splice(self, req: Request, dst_map: Dict[int, int]) -> bool:
+        """Move one request's KV pages into the decode pool and seat it
+        directly into a decode slot (block-table splice — no prefill runs
+        on this side). Returns False, allocator untouched, when the
+        decode side lacks a slot or pages right now."""
+        p, d = self.prefill, self.decode
+        free = d._free_slots()
+        if not free:
+            return False
+        n = req.table.length  # tokens with valid KV (newest token pending)
+        src_blocks = req.table.blocks[:d.allocator.blocks_needed(n)]
+        fresh_src = [b for b in src_blocks if b not in dst_map]
+        if d.allocator.num_free < len(fresh_src):
+            d._evict_for(len(fresh_src) - d.allocator.num_free, req=req)
+            if d.allocator.num_free < len(fresh_src):
+                return False
+        t0 = time.monotonic()
+        fresh_dst = d.allocator.allocate(len(fresh_src))
+        dst_blocks: List[int] = []
+        for b in src_blocks:
+            if b in dst_map:
+                d.allocator.fork([dst_map[b]])  # group-shared page: reuse
+            else:
+                dst_map[b] = fresh_dst.pop(0)
+            dst_blocks.append(dst_map[b])
+        # transfer only the pages not already landed this pump (a group
+        # follower whose table is fully shared moves zero pages)
+        copy_dst = [dst_map[s] for s in fresh_src]
+        moved = 0
+        nbytes = 0
+        if fresh_src:
+            d.cache = self.transport.transfer(
+                p.cache, d.cache, fresh_src, copy_dst)
+            moved = len(fresh_src)
+            nbytes = moved * self._page_bytes
+            if d.draft_len and d.draft_cache is not None:
+                # the draft pool mirrors the target's block ids on both
+                # sides: the prefill worker ingested the prompt into its
+                # draft pool at these src ids, so the same index move lands
+                # draft KV at the same dst ids the decode-side spec
+                # megastep will read
+                d.draft_cache = self.transport.transfer(
+                    p.draft_cache, d.draft_cache, fresh_src, copy_dst)
+                moved += len(fresh_src)
+                nbytes += len(fresh_src) * self._draft_page_bytes
+        t1 = time.monotonic()
+        d.stats.kv_transfers += 1
+        d.stats.kv_transfer_blocks += moved
+        d.stats.kv_transfer_bytes += nbytes
+        d.telemetry.trace_interval(req, "kv_transfer", t0, t1,
+                                   blocks=moved, nbytes=nbytes)
+        # ---- block-table splice + direct seat in the decode batch
+        slot = free[0]
+        table = SequenceTable(dst_blocks)
+        table.length = n
+        req.slot = slot
+        req.table = table
+        d._tables[slot] = table
+        d._set_slot_gen(slot, req.gen)
+        d._slot_tokens[slot] = req.output_ids[-1]
+        d.running[slot] = req
+        d._activate_slot(req)
+        # ---- cross-engine prefix tier: the transferred prompt becomes
+        # matchable on the decode side (preemption resume, grouped forks);
+        # fork first so the tree's ownership never races the live request,
+        # and let insert() dedup repeat chunks (group members after the
+        # first net out to a plain free)
+        if d.prefix_cache is not None:
+            full = len(req.prompt_ids) // d.block_size
+            if full:
+                share = list(dst_blocks[:full])
+                d.allocator.fork(share)
+                d.prefix_cache.insert(req.prompt_ids, share, d.allocator)
+                d.stats.prefix_insertions = d.prefix_cache.insertions
+                d.stats.prefix_evictions = d.prefix_cache.evictions
+        return True
+
+    # ------------------------------------------------------- role control
+    def drain_role(self, role: str, drain: bool = True) -> None:
+        """The two-worker-class control plane: drain ``"prefill"`` to
+        stop new admissions while queued/prefilling/handoff work flushes
+        through to decode; drain ``"decode"`` to pause splices (pending
+        handoffs hold, prefill-side pages intact) while resident decodes
+        run dry — the quiesce point for a decode-side weight swap."""
+        if role not in DISAGG_ROLES:
+            raise ValueError(f"role={role!r}: pass one of {DISAGG_ROLES}")
+        if drain:
+            self._draining.add(role)
+        else:
+            self._draining.discard(role)
+
+    def role_draining(self, role: str) -> bool:
+        if role not in DISAGG_ROLES:
+            raise ValueError(f"role={role!r}: pass one of {DISAGG_ROLES}")
+        return role in self._draining
+
+    def role_health(self) -> Dict[str, Dict]:
+        """Per-role point-in-time health — the disagg half of the
+        router's ``replica_health()`` and ``GET /health``."""
+        p, d = self.prefill, self.decode
+        return {
+            "prefill": {
+                "draining": "prefill" in self._draining,
+                "waiting": len(p.waiting),
+                "prefilling": len(p.prefilling),
+                "pending_handoff": len(p._handoff),
+                "free_blocks": p.allocator.num_free,
+            },
+            "decode": {
+                "draining": "decode" in self._draining,
+                "running": len(d.running),
+                "free_blocks": d.allocator.num_free,
+            },
+        }
+
+    def breached_roles(self) -> Set[str]:
+        """Roles the live SLO window currently indicts (ttft/queue-wait
+        breaches → prefill, itl/e2e → decode) — the per-role signal the
+        router's breach-skip placement reads off a disagg replica."""
+        slo = getattr(self.telemetry, "slo", None)
+        if slo is None:
+            return set()
+        slo.evaluate()
+        return {_ROLE_OF_METRIC[k.rsplit("_p", 1)[0]]
+                for k in slo.breached_metrics
+                if k.rsplit("_p", 1)[0] in _ROLE_OF_METRIC}
+
+    # -------------------------------------------------- observability surface
+    @property
+    def stats(self) -> EngineStats:
+        """Both workers' counters summed into one ``EngineStats`` — the
+        terminal invariant (completed + aborted + shed == submitted)
+        holds across the pair because submissions count on the prefill
+        side and every terminal state counts wherever it fires."""
+        merged = EngineStats()
+        for src in (self.prefill.stats, self.decode.stats):
+            for f in dataclasses.fields(EngineStats):
+                setattr(merged, f.name,
+                        getattr(merged, f.name) + getattr(src, f.name))
+        return merged
+
+    @property
+    def running(self) -> Dict:
+        """Merged in-flight view: decoding slots plus prefilled requests
+        awaiting transport (keys are (role, slot) — stream pushers only
+        read the values, and a pending request's first token must stream
+        without waiting for the splice)."""
+        out = {("prefill", s): r for s, r in self.prefill._handoff.items()}
+        out.update(
+            {("decode", s): r for s, r in self.decode.running.items()})
+        return out
+
+    @property
+    def waiting(self):
+        return self.prefill.waiting
+
+    @property
+    def prefilling(self):
+        return self.prefill.prefilling
+
+    @property
+    def prefix_cache(self):
+        """The admission-side tree (what router ``cache_aware`` placement
+        probes — prompts land on the prefill worker)."""
+        return self.prefill.prefix_cache
+
+    @property
+    def expert_load(self):
+        return self.decode.expert_load
+
+    @property
+    def scheduler_policy(self):
+        return self.prefill.scheduler_policy
+
+    @property
+    def kv_dtype(self):
+        return self.decode.kv_dtype
+
+    @property
+    def max_batch(self):
+        return self.prefill.max_batch
+
+    @property
+    def max_seq(self):
+        return self.decode.max_seq
+
+    @property
+    def block_size(self):
+        return self.decode.block_size
+
+    @property
+    def megastep_k(self):
+        return self.decode.megastep_k
+
+    @property
+    def draft_len(self):
+        return self.decode.draft_len
+
+    @property
+    def _overload(self):
+        return self.prefill._overload
+
+    @property
+    def _ids(self):
+        return self.prefill._ids
+
+    @_ids.setter
+    def _ids(self, value):
+        self.prefill._ids = value

@@ -66,7 +66,7 @@ from colossalai_tpu.models.llama import LlamaConfig
 from colossalai_tpu.utils.profiler import annotate, step_annotation
 
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
-from .overload import OverloadConfig, OverloadController
+from .overload import OverloadConfig, OverloadController, retry_after_hint
 from .prefix_cache import PrefixCache
 from .telemetry import NullTelemetry, SLOTracker, Telemetry, Tracer
 from .paged_modeling import (
@@ -134,6 +134,10 @@ class Request:
     #: the draft_len the acceptance controller recommends for this
     #: request (0 = no recommendation yet — use the engine's configured max)
     spec_draft_rec: int = 0
+    #: shed-aware retry hint (finish_reason="shed" only): seconds the
+    #: client should wait before retrying, derived from the live SLO
+    #: window at shed time — surfaced as the 503 Retry-After header
+    retry_after: Optional[float] = None
 
     @property
     def n_samples(self) -> int:
@@ -217,6 +221,15 @@ class EngineStats:
     #: physical pages currently allocated (live sequences + prefix-cache
     #: retained pages; the reserved null page 0 never counts)
     kv_blocks_in_use: int = 0
+    # ---- disaggregated serving (DisaggEngine): KVTransport accounting —
+    # each counted transfer moves one finished prefill's pages (target +
+    # draft pool) into the decode worker's pool
+    #: page-move operations (one per handed-off request)
+    kv_transfers: int = 0
+    #: physical pages moved across pools (scale rows ride along for int8)
+    kv_transfer_blocks: int = 0
+    #: bytes those pages represent (k + v + int8 k/v scales, both pools)
+    kv_transfer_bytes: int = 0
 
     @property
     def spec_acceptance_rate(self) -> float:
@@ -921,6 +934,11 @@ class LLMEngine:
             if self.prefix_cache is not None:
                 self.prefix_cache.unpin(victim.cache_node)
                 victim.cache_node = None
+        # shed-aware retry hint: the live admission-side tail is roughly
+        # how long this backlog keeps hurting — stamp it so the server's
+        # 503 carries a Retry-After and the shed jsonl record logs it
+        victim.retry_after = retry_after_hint(getattr(self.telemetry, "slo",
+                                                      None))
         self.telemetry.trace_instant(victim, "shed",
                                      policy=ctl.config.shed_policy)
         self._finish(victim, "shed", count=victim.n_samples)
@@ -1645,9 +1663,20 @@ class LLMEngine:
                        if r.group_ids is None]
             if not victims:
                 return
-            # weakest victim: lowest priority, oldest (longest-running)
-            slot, victim = min(
-                victims, key=lambda sr: (sr[1].priority, sr[1].request_id))
+            # weakest victim: lowest priority first; within a level the
+            # configured order — oldest (longest-running, most KV already
+            # bankable in the prefix cache) or the most remaining token
+            # budget (least sunk decode work lost, pages freed longest)
+            if ctl.config.preempt_victim == "longest_remaining":
+                slot, victim = min(
+                    victims,
+                    key=lambda sr: (sr[1].priority,
+                                    -self._budget_left(sr[1]),
+                                    sr[1].request_id))
+            else:
+                slot, victim = min(
+                    victims, key=lambda sr: (sr[1].priority,
+                                             sr[1].request_id))
             if (waiter.priority <= victim.priority
                     or self._policy_key(waiter) >= self._policy_key(victim)):
                 return

@@ -41,6 +41,14 @@ SHED_METRICS = ("ttft", "queue_wait")
 
 SHED_POLICIES = ("reject_new", "oldest_low_priority_first", "off")
 
+#: how the engine picks which running sequence to preempt (lowest
+#: priority always goes first; the policy orders WITHIN a level):
+#: ``oldest_first`` evicts the longest-running (most KV already banked in
+#: the prefix cache — cheapest resume); ``longest_remaining`` evicts the
+#: sequence with the most tokens still to generate (least sunk decode
+#: work lost, frees its pages for the longest time)
+PREEMPT_VICTIM_POLICIES = ("oldest_first", "longest_remaining")
+
 
 @dataclasses.dataclass
 class OverloadConfig:
@@ -60,6 +68,9 @@ class OverloadConfig:
     preempt: bool = True
     #: at most this many priority preemptions per engine step
     preempt_max_per_tick: int = 1
+    #: victim order within the lowest priority level (see
+    #: PREEMPT_VICTIM_POLICIES)
+    preempt_victim: str = "oldest_first"
     #: drive per-request draft_len from the observed acceptance EWMA
     adaptive_draft: bool = True
     #: EWMA smoothing for per-request acceptance (weight of the newest
@@ -80,6 +91,10 @@ class OverloadConfig:
         if self.preempt_max_per_tick < 1:
             raise ValueError(
                 f"preempt_max_per_tick={self.preempt_max_per_tick} must be >= 1")
+        if self.preempt_victim not in PREEMPT_VICTIM_POLICIES:
+            raise ValueError(
+                f"preempt_victim={self.preempt_victim!r} not in "
+                f"{PREEMPT_VICTIM_POLICIES}")
         if not 0.0 < self.draft_ewma <= 1.0:
             raise ValueError(f"draft_ewma={self.draft_ewma} must be in (0, 1]")
         if not 0.0 <= self.draft_lower_at <= self.draft_raise_at <= 1.0:
@@ -123,3 +138,30 @@ class OverloadController:
     def shed_queue_depth(self, max_batch_size: int) -> int:
         d = self.config.shed_queue_depth
         return int(d) if d is not None else 2 * int(max_batch_size)
+
+
+def retry_after_hint(slo) -> Optional[float]:
+    """Seconds a shed client should wait before retrying, read off the
+    live SLO window: the worst breached admission-side percentile (the
+    observed TTFT/queue-wait tail IS roughly how long the current backlog
+    keeps hurting), clamped to [1s, window_s] — never hint a retry beyond
+    the window that latched the breach. None when no admission-side
+    metric is in breach (shouldn't happen on the shed path) or the
+    tracker is absent."""
+    if slo is None:
+        return None
+    worst = 0.0
+    for key in slo.breached_metrics:
+        metric, _, q = key.rpartition("_p")
+        if metric not in SHED_METRICS:
+            continue
+        win = slo.windows.get(metric)
+        if win is None:
+            continue
+        try:
+            worst = max(worst, float(win.percentile(float(q))))
+        except (TypeError, ValueError):
+            continue
+    if worst <= 0.0:
+        return None
+    return min(max(worst, 1.0), float(slo.window_s))

@@ -195,7 +195,16 @@ class Router:
         not at its next request finish."""
         breached = []
         for i in candidates:
-            slo = getattr(self.engines[i].telemetry, "slo", None)
+            e = self.engines[i]
+            if hasattr(e, "breached_roles"):
+                # disaggregated replica: placement sends PROMPTS, so only
+                # an admission-side (prefill-role) breach steers new load
+                # away — a decode-side breach is preemption/adaptive-spec
+                # territory and starving prefill wouldn't relieve it
+                if "prefill" in e.breached_roles():
+                    breached.append(i)
+                continue
+            slo = getattr(e.telemetry, "slo", None)
             if slo is not None:
                 slo.evaluate()
                 if slo.breached:
@@ -355,18 +364,46 @@ class Router:
             self._pool.shutdown(wait=True)
 
     # ------------------------------------------------------ health / draining
-    def drain(self, i: int) -> None:
+    def drain(self, i: int, role: str = "all") -> None:
         """Take replica ``i`` out of placement. It keeps stepping — its
         queued/running requests finish normally — it just receives no new
-        ones (rolling restart / downscale)."""
-        self.engines[i]  # index check
-        if not self._draining[i]:
+        ones (rolling restart / downscale).
+
+        On a disaggregated replica (one exposing ``drain_role``) a
+        ``role`` narrows the drain to one worker class: ``"prefill"``
+        stops new admissions (the replica also leaves placement — prompts
+        land on prefill workers) while queued/handoff work flushes
+        through to decode; ``"decode"`` pauses KV splices so resident
+        decodes run dry (weight swap quiesce) while the replica KEEPS
+        taking new prompts — they queue on the prefill side."""
+        e = self.engines[i]  # index check
+        if role != "all":
+            if not hasattr(e, "drain_role"):
+                raise ValueError(
+                    f"replica {i} is not disaggregated — role drains need "
+                    "a DisaggEngine replica (use role='all')"
+                )
+            e.drain_role(role, True)
+        if role in ("all", "prefill") and not self._draining[i]:
             self._draining[i] = True
             self.replica_drains += 1
 
-    def undrain(self, i: int) -> None:
-        self.engines[i]
-        self._draining[i] = False
+    def undrain(self, i: int, role: str = "all") -> None:
+        e = self.engines[i]
+        if role != "all":
+            if not hasattr(e, "drain_role"):
+                raise ValueError(
+                    f"replica {i} is not disaggregated — role drains need "
+                    "a DisaggEngine replica (use role='all')"
+                )
+            e.drain_role(role, False)
+        elif hasattr(e, "drain_role"):
+            # a full undrain clears any narrower role drains too — the
+            # replica returns to service whole
+            for r in ("prefill", "decode"):
+                e.drain_role(r, False)
+        if role in ("all", "prefill"):
+            self._draining[i] = False
 
     def draining(self, i: int) -> bool:
         return self._draining[i]
@@ -393,6 +430,10 @@ class Router:
                 # windowed SLO brief per replica: the scrape a breach-aware
                 # balancer reads (breached flag + live windowed percentiles)
                 entry["slo"] = slo.brief()
+            if hasattr(e, "role_health"):
+                # disaggregated replica: the per-role view (queues, pending
+                # handoffs, per-pool headroom, role drain flags)
+                entry["roles"] = e.role_health()
             out.append(entry)
         return out
 
@@ -505,7 +546,9 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
     ``GET /trace?rid=`` / ``POST /trace/dump`` serve the shared tracer
     (replicas built with one ``tracer=`` instance stitch into one trace);
     ``POST /drain`` ``{"replica": i, "drain": bool}`` toggles placement
-    eligibility for rolling restarts."""
+    eligibility for rolling restarts — an optional ``"role"``
+    (``"prefill"``/``"decode"``) narrows the drain to one worker class
+    of a disaggregated replica."""
     import json
 
     from .server import make_server
@@ -564,12 +607,22 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                     if not 0 <= i < router.n_replicas:
                         self._json(400, {"error": f"no replica {i}"})
                         return
+                    role = str(req.get("role", "all"))
                     if bool(req.get("drain", True)):
-                        router.drain(i)
+                        router.drain(i, role=role)
                     else:
-                        router.undrain(i)
-                    self._json(200, {"replica": i,
-                                     "draining": router.draining(i)})
+                        router.undrain(i, role=role)
+                    payload = {"replica": i,
+                               "draining": router.draining(i)}
+                    if "role" in req:
+                        # role-scoped drains are a disagg extension — a
+                        # plain {"replica": ...} request keeps the exact
+                        # pre-disagg response shape
+                        payload["role"] = role
+                        e = router.engines[i]
+                        if hasattr(e, "role_health"):
+                            payload["roles"] = e.role_health()
+                    self._json(200, payload)
                 except Exception as e:
                     self._json(400, {"error": str(e)})
                 return

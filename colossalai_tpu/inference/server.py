@@ -67,6 +67,7 @@ signal for a load balancer. ``GET /health`` adds an ``"overload"`` block
 from __future__ import annotations
 
 import json
+import math
 import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -109,6 +110,9 @@ class _Scheduler(threading.Thread):
         #: per-streaming-request token queues + how many tokens were pushed
         self.streams: Dict[int, queue.Queue] = {}
         self._pushed: Dict[int, int] = {}
+        #: rid → retry hint (seconds) stamped on shed requests — consumed
+        #: by the handler to emit the 503 Retry-After header
+        self._retry_after: Dict[int, float] = {}
         #: rids a /abort cancelled while a waiter was blocked — lets the
         #: waiter report "aborted" instead of a misleading timeout
         self._client_aborted: set = set()
@@ -168,6 +172,7 @@ class _Scheduler(threading.Thread):
                 # bookkeeping — an already-finished request keeps its
                 # unconsumed result for the waiter
                 self.done.pop(rid, None)
+                self._retry_after.pop(rid, None)
                 ev = self.events.pop(rid, None)
                 if ev is not None:
                     self._client_aborted.add(rid)
@@ -215,8 +220,17 @@ class _Scheduler(threading.Thread):
                     ev = self.events.get(rid)
                     if ev is None:
                         continue  # client gave up (timeout): drop the result
+                    if (req.finish_reason == "shed"
+                            and getattr(req, "retry_after", None) is not None):
+                        self._retry_after[rid] = req.retry_after
                     self.done[rid] = (req.output_ids, req.finish_reason)
                     ev.set()
+
+    def pop_retry_after(self, rid: int) -> Optional[float]:
+        """Consume the shed retry hint for ``rid`` (None when the shed
+        fired without an SLO-derived hint)."""
+        with self.lock:
+            return self._retry_after.pop(rid, None)
 
     def stop(self):
         self._stop = True
@@ -242,11 +256,14 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
         def log_message(self, *a):  # quiet
             pass
 
-        def _json(self, code: int, payload: dict):
+        def _json(self, code: int, payload: dict,
+                  headers: Optional[dict] = None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
 
@@ -514,9 +531,18 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     self._json(409, {"request_id": rid, "error": "aborted"})
                 elif status == "shed":
                     # overload admission control rejected the request
-                    # before it ran — the load-balancer retry signal
-                    self._json(503, {"request_id": rid, "error": "shed",
-                                     "finish_reason": "shed"})
+                    # before it ran — the load-balancer retry signal.
+                    # Retry-After carries the SLO-window-derived hint the
+                    # engine stamped at shed time (same value the shed
+                    # jsonl record logs as retry_after_s).
+                    hint = sched.pop_retry_after(rid)
+                    payload = {"request_id": rid, "error": "shed",
+                               "finish_reason": "shed"}
+                    headers = None
+                    if hint is not None:
+                        payload["retry_after_s"] = hint
+                        headers = {"Retry-After": max(1, int(math.ceil(hint)))}
+                    self._json(503, payload, headers=headers)
                 elif out is None:
                     self._json(504, {"error": "generation timed out"})
                 else:

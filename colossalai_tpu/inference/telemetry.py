@@ -193,6 +193,11 @@ class Telemetry:
                 record["within_slo"] = within
             if group_size > 1:
                 record["group_size"] = group_size
+            if (req.finish_reason == "shed"
+                    and getattr(req, "retry_after", None) is not None):
+                # the same hint the 503 Retry-After header carries —
+                # logged so shed analysis can audit what clients were told
+                record["retry_after_s"] = _r(req.retry_after)
             self.events.emit(record)
 
     # ------------------------------------------------------------- span hooks

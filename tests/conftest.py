@@ -153,3 +153,83 @@ def mesh8():
 
 def pytest_configure(config):
     assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 runtime budget: pyproject's marker contract promises a <10min
+# suite under ``-m 'not slow'``, but accumulated equivalence tests pushed
+# the deselect tier past 18min. The heavyweights below (>=5s call time on
+# the warm-cache 8-virtual-device CPU mesh; measured with --durations=200,
+# ~550s of the total) carry the ``slow`` marker centrally so the tier-1
+# sweep fits its budget again; run ``pytest -m slow`` for the full
+# equivalence tier. Regenerate after adding expensive tests:
+#   pytest tests/ -q --durations=200 --durations-min=5.0
+_SLOW_NODEIDS = frozenset((
+    "tests/test_applications/test_eval_runners.py::test_raw_and_boosted_scoring_agree",
+    "tests/test_applications/test_rlhf_eval.py::test_eval_harness",
+    "tests/test_applications/test_rlhf_full.py::test_reward_model_tp2_matches_dp",
+    "tests/test_auto_parallel/test_advisor.py::test_big_model_fits_on_pod_with_sharding",
+    "tests/test_auto_parallel/test_advisor.py::test_sp_mode_choice_changes_compiled_program",
+    "tests/test_auto_parallel/test_solver.py::test_search_overrides_train_identically",
+    "tests/test_auto_parallel/test_solver.py::test_search_tight_budget_engages_fsdp_and_shrinks_compiled_memory",
+    "tests/test_booster/test_lora.py::test_lora_tp2_matches_dp",
+    "tests/test_booster/test_qlora.py::test_int8_lora_tracks_fp32_lora",
+    "tests/test_booster/test_qlora.py::test_qlora_composes_with_tp",
+    "tests/test_checkpoint_io/test_checkpoint.py::test_moe_checkpoint_ep_reshard_roundtrip",
+    "tests/test_checkpoint_io/test_hf_interop.py::test_new_decoder_families_roundtrip",
+    "tests/test_inference/test_engine.py::test_decode_matches_training_forward",
+    "tests/test_inference/test_engine.py::test_engine_attention_bias_matches_training_forward",
+    "tests/test_inference/test_kv_quant.py::test_int8_spec_rollback_refunds_pages",
+    "tests/test_inference/test_kv_quant.py::test_int8_spec_tp_mesh_matches_mesh_free",
+    "tests/test_inference/test_megastep.py::test_megastep_greedy_parity_k1_vs_k4",
+    "tests/test_inference/test_overload.py::test_preempt_resume_identity_speculative",
+    "tests/test_inference/test_telemetry.py::test_profile_endpoint_captures_annotated_trace",
+    "tests/test_models/test_bert_vit_fp8.py::test_bert_tp_training",
+    "tests/test_models/test_dit.py::test_dit_conditioning_matters",
+    "tests/test_models/test_dit.py::test_dit_tp_matches_dp",
+    "tests/test_models/test_encdec_deepseek.py::test_deepseek_mla_shapes",
+    "tests/test_models/test_encdec_deepseek.py::test_whisper_forward_shapes",
+    "tests/test_models/test_encdec_deepseek.py::test_whisper_pp_matches_dp",
+    "tests/test_models/test_families.py::test_family_tp_matches_dp[bloom]",
+    "tests/test_models/test_families.py::test_family_tp_matches_dp[opt]",
+    "tests/test_models/test_families.py::test_family_tp_matches_dp[qwen3]",
+    "tests/test_models/test_fp8_wired.py::test_fp8_generalized_decoder_families[falcon]",
+    "tests/test_models/test_fp8_wired.py::test_fp8_generalized_decoder_families[gemma]",
+    "tests/test_models/test_fp8_wired.py::test_fp8_generalized_decoder_families[gpt_neox]",
+    "tests/test_models/test_fp8_wired.py::test_fp8_matmul_trains",
+    "tests/test_models/test_gemma2_qwen3.py::test_gemma2_alternating_window_masks_only_local_layers",
+    "tests/test_models/test_heads.py::test_lengths_reach_model_through_booster",
+    "tests/test_models/test_heads.py::test_sequence_classifier_tp_matches_dp",
+    "tests/test_models/test_hf_parity.py::test_deepseek_v3_matches_hf",
+    "tests/test_models/test_hf_parity.py::test_llama_matches_hf",
+    "tests/test_models/test_hf_parity.py::test_whisper_tp2_matches_hf",
+    "tests/test_models/test_llama.py::test_llama_forward[True]",
+    "tests/test_models/test_multimodal.py::test_blip2_forward_shapes",
+    "tests/test_models/test_multimodal.py::test_blip2_image_conditions_text",
+    "tests/test_models/test_multimodal.py::test_blip2_tp_matches_dp",
+    "tests/test_models/test_multimodal.py::test_sam_forward_shapes",
+    "tests/test_models/test_multimodal.py::test_sam_tp_matches_dp",
+    "tests/test_models/test_multimodal.py::test_sam_window_padding",
+    "tests/test_models/test_t5.py::test_t5_gated_variant_runs",
+    "tests/test_models/test_t5.py::test_t5_pp_matches_dp[1f1b]",
+    "tests/test_models/test_t5.py::test_t5_pp_matches_dp[gpipe]",
+    "tests/test_models/test_t5.py::test_t5_pp_matches_dp[zb]",
+    "tests/test_moe/test_moe.py::test_mixtral_forward",
+    "tests/test_moe/test_moe.py::test_mixtral_sort_router_trains_and_matches",
+    "tests/test_optimizer/test_galore.py::test_galore_trains_a_model_via_booster",
+    "tests/test_optimizer/test_optimizers.py::test_adafactor_trains",
+    "tests/test_optimizer/test_optimizers.py::test_came_trains",
+    "tests/test_optimizer/test_optimizers.py::test_lamb_trains",
+    "tests/test_pipeline/test_schedules.py::test_layer_ids_flow_through_pipeline",
+    "tests/test_pipeline/test_schedules.py::test_pp_remat_ratio_matches_baseline",
+    "tests/test_pipeline/test_sim_calibration.py::test_auto_picks_correctly_with_calibrated_costs",
+    "tests/test_pipeline/test_sim_calibration.py::test_calibration_reproduces_measured_ordering_and_magnitude",
+    "tests/test_utils/test_elastic.py::test_crash_before_first_periodic_checkpoint_recovers",
+    "tests/test_utils/test_placement_profiler.py::test_auto_placement_decides",
+))
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.nodeid in _SLOW_NODEIDS:
+            item.add_marker(pytest.mark.slow)

@@ -208,8 +208,25 @@ def test_span_names_match_grammar_over_engine_smoke():
                "prefill_stall", "first_token", "decode_megastep",
                "spec_megastep", "prefix_cache_hit", "prefix_cache_evict",
                "page_refund", "router.place", "router.sync",
-               "shed", "preempt", "resume"}
+               "shed", "preempt", "resume", "kv_transfer"}
     assert names <= catalog, names - catalog
+
+
+def test_disagg_span_and_counter_names():
+    """The disaggregated-serving additions stay lint-clean: the
+    ``kv_transfer`` span name obeys the span grammar, and the transfer
+    counters render as ``clt_*`` families (they live on ``EngineStats``,
+    so they surface through the one ``as_dict()`` serialization both
+    ``/health`` and ``/metrics`` use — and through the router's merged
+    exposition)."""
+    from colossalai_tpu.telemetry import SPAN_NAME_RE
+
+    assert SPAN_NAME_RE.match("kv_transfer")
+    names = _serving_names()
+    assert {"clt_kv_transfers", "clt_kv_transfer_blocks",
+            "clt_kv_transfer_bytes"} <= names
+    assert {"clt_kv_transfers", "clt_kv_transfer_blocks",
+            "clt_kv_transfer_bytes"} <= _router_names()
 
 
 def test_exposition_skips_unrenderable_values():

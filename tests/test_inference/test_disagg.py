@@ -1,0 +1,355 @@
+"""Disaggregated prefill/decode serving (KVTransport + DisaggEngine).
+
+The load-bearing contracts:
+
+- **token identity** — greedy output of the disaggregated pair equals the
+  monolithic engine across megastep K in {1, 4} x {bf16, int8 KV} x
+  {prefix cache on/off}, plus chunked prefill and the speculative path:
+  transferred pages are byte-copies (int8 scales ride along) and decode
+  resumes from the same committed first token, so nothing else is
+  possible — any drift is a transport bug;
+- **wire seam** — ``HostKVTransport`` (pack → bytes → from_bytes →
+  deliver) lands pools byte-identical to ``DeviceKVTransport``, and the
+  ``PageBlockWire`` buffer round-trips shape/dtype/scales/meta exactly;
+- **no leaks** — after a full drain, every page a transfer touched is
+  either free or prefix-cache-resident on BOTH pools (free-count +
+  resident audit; transferred pages never strand);
+- **duck-type surface** — ``server._Scheduler`` and the ``Router`` drive
+  a ``DisaggEngine`` unmodified (running view spans pending handoffs so
+  first tokens stream; merged stats keep the terminal invariant), and the
+  router's drain machinery narrows to one role (``drain(i,
+  role="decode")`` pauses splices while placement continues).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import (
+    DeviceKVTransport,
+    DisaggEngine,
+    GenerationConfig,
+    HostKVTransport,
+    LLMEngine,
+    PageBlockWire,
+    Router,
+    init_paged_cache,
+)
+from colossalai_tpu.inference.kv_transport import page_nbytes, pool_geometry
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+BASE = dict(max_batch_size=4, max_seq_len=64, block_size=16,
+            prefill_buckets=(16, 32, 64))
+#: the third prompt repeats the first, so prefix_cache=True exercises the
+#: warm (suffix-prefill) admission path through the handoff
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [1, 2, 3, 4, 5],
+           [2, 4, 6, 8, 10, 12, 14, 16, 18]]
+GEN = GenerationConfig(max_new_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _mono(parts, **kw):
+    cfg, params = parts
+    return LLMEngine(params, cfg, **{**BASE, **kw})
+
+
+def _disagg(parts, **kw):
+    cfg, params = parts
+    return DisaggEngine(params, cfg, **{**BASE, **kw})
+
+
+def _audit_no_leak(dis):
+    """Every page on both pools is free or prefix-resident (block 0, the
+    reserved null page, is neither)."""
+    for eng in (dis.prefill, dis.decode):
+        resident = (len(eng.prefix_cache.resident_blocks())
+                    if eng.prefix_cache is not None else 0)
+        assert eng.allocator.num_free + resident \
+            == eng.allocator.num_blocks - 1
+    assert not dis.prefill._handoff and not dis.prefill._reserved
+
+
+# ------------------------------------------------------------ token identity
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_greedy_token_identity_grid(parts, kv_dtype):
+    """The acceptance grid: K x prefix-cache for each KV dtype. One
+    monolithic reference per combo; the disaggregated pair must match
+    token-for-token, and the transfer counters must show real moves."""
+    for k in (1, 4):
+        for pc in (False, True):
+            kw = dict(kv_dtype=kv_dtype, megastep_k=k, prefix_cache=pc)
+            ref = _mono(parts, **kw).generate(PROMPTS, GEN)
+            dis = _disagg(parts, **kw)
+            out = dis.generate(PROMPTS, GEN)
+            assert out == ref, (kv_dtype, k, pc)
+            s = dis.stats
+            assert s.kv_transfers == len(PROMPTS)
+            assert s.kv_transfer_blocks > 0
+            assert s.kv_transfer_bytes \
+                >= s.kv_transfer_blocks * 1  # accounted, not guessed
+            _audit_no_leak(dis)
+
+
+def test_greedy_token_identity_chunked_prefill(parts):
+    kw = dict(prefill_chunk=16, prefix_cache=True)
+    ref = _mono(parts, **kw).generate(PROMPTS, GEN)
+    dis = _disagg(parts, **kw)
+    assert dis.generate(PROMPTS, GEN) == ref
+    _audit_no_leak(dis)
+
+
+def test_greedy_token_identity_speculative(parts):
+    """Spec decode on the decode worker reads the draft pool at the same
+    block ids as the target pool — the transfer mirrors both."""
+    kw = dict(megastep_k=2, draft_len=2, self_draft_layers=1)
+    ref = _mono(parts, **kw).generate(PROMPTS[:2], GEN)
+    dis = _disagg(parts, **kw)
+    assert dis.generate(PROMPTS[:2], GEN) == ref
+    # every transfer moved target AND draft pages (same count each)
+    assert dis.stats.kv_transfer_blocks % 2 == 0
+    _audit_no_leak(dis)
+
+
+def test_grouped_sampling_shares_transferred_pages(parts):
+    """A greedy group (n_samples=3) forks its full prompt pages; the
+    splice must re-share them on the decode side — pages move ONCE, and
+    every member decodes the monolithic output."""
+    prompt = list(range(1, 17))  # exactly one full page at block_size=16
+    gen = GenerationConfig(max_new_tokens=6)
+    ref = _mono(parts).generate([prompt], gen)[0]
+    dis = _disagg(parts)
+    rids = dis.add_request(prompt, gen, n_samples=3)
+    done = {}
+    while dis.has_work:
+        for r in dis.step():
+            done[r.request_id] = r.output_ids
+    assert [done[r] for r in rids] == [ref] * 3
+    # 3 members over a 1-full-page prompt: the shared page transfers once;
+    # each member also lands its own partial/CoW page
+    assert dis.stats.kv_transfer_blocks < 3 * (len(prompt) // 16 + 1)
+    _audit_no_leak(dis)
+
+
+# ---------------------------------------------------------------- transport
+def _tiny_pools(cfg, dtype, n_src=6, n_dst=5):
+    src = init_paged_cache(cfg, n_src, 16, dtype=dtype)
+    # distinguishable page contents: fill by block index
+    ramp = jnp.arange(n_src, dtype=jnp.float32)[None, :, None, None, None]
+    src = src._replace(k=(src.k + ramp.astype(src.k.dtype)),
+                       v=(src.v - ramp.astype(src.v.dtype)))
+    if src.quantized:
+        sramp = jnp.arange(n_src, dtype=jnp.float32)[None, :, None]
+        src = src._replace(k_scale=src.k_scale + 0.5 * sramp,
+                           v_scale=src.v_scale + 0.25 * sramp)
+    dst = init_paged_cache(cfg, n_dst, 16, dtype=dtype)
+    return src, dst
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.int8])
+def test_host_transport_byte_identical_to_device(parts, dtype):
+    cfg, _ = parts
+    src, dst_a = _tiny_pools(cfg, dtype)
+    _, dst_b = _tiny_pools(cfg, dtype)
+    moves = ([3, 1, 4], [2, 4, 1])
+    out_a = DeviceKVTransport().transfer(src, dst_a, *moves)
+    out_b = HostKVTransport().transfer(src, dst_b, *moves)
+    for la, lb in zip(jax.tree.leaves(out_a), jax.tree.leaves(out_b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # moved pages equal the source pages; untouched pages stayed zero
+    np.testing.assert_array_equal(np.asarray(out_a.k[:, 2]),
+                                  np.asarray(src.k[:, 3]))
+    np.testing.assert_array_equal(np.asarray(out_a.k[:, 3]), 0)
+    if out_a.quantized:
+        np.testing.assert_array_equal(np.asarray(out_a.k_scale[:, 4]),
+                                      np.asarray(src.k_scale[:, 1]))
+
+
+@pytest.mark.parametrize("dtype,name", [(jnp.bfloat16, "bf16"),
+                                        (jnp.int8, "int8")])
+def test_wire_roundtrip(parts, dtype, name):
+    cfg, _ = parts
+    src, _dst = _tiny_pools(cfg, dtype)
+    wire = DeviceKVTransport().pack(src, [2, 5], kv_dtype=name,
+                                    meta={"request_id": 7, "tokens": 33})
+    buf = wire.to_bytes()
+    back = PageBlockWire.from_bytes(buf)
+    assert back.kv_dtype == name and back.block_size == 16
+    assert back.n_blocks == 2 and back.meta == {"request_id": 7, "tokens": 33}
+    assert back.quantized == (name == "int8")
+    np.testing.assert_array_equal(back.k, wire.k)
+    np.testing.assert_array_equal(back.v, wire.v)
+    if back.quantized:
+        np.testing.assert_array_equal(back.k_scale, wire.k_scale)
+        np.testing.assert_array_equal(back.v_scale, wire.v_scale)
+    assert back.nbytes() == wire.nbytes()
+    assert len(buf) > back.nbytes()  # header rides in front of the payload
+
+
+def test_wire_and_transfer_guards(parts):
+    cfg, _ = parts
+    src, dst = _tiny_pools(cfg, jnp.bfloat16)
+    t = DeviceKVTransport()
+    with pytest.raises(ValueError, match="1:1"):
+        t.transfer(src, dst, [1, 2], [3])
+    src_q, dst_q = _tiny_pools(cfg, jnp.int8)
+    assert pool_geometry(src) != pool_geometry(src_q)
+    with pytest.raises(ValueError, match="geometry"):
+        t.transfer(src, dst_q, [1], [1])
+    with pytest.raises(ValueError, match="magic"):
+        PageBlockWire.from_bytes(b"nope" + b"\x00" * 32)
+    wire = t.pack(src, [1, 2])
+    with pytest.raises(ValueError, match="destination blocks"):
+        t.deliver(dst, wire, [1])
+    with pytest.raises(ValueError, match="quantized"):
+        t.deliver(dst_q, wire, [1, 2])
+    # block counts may differ (deep prefill pool, tight decode pool) —
+    # only the per-page geometry is pinned
+    assert pool_geometry(src) == pool_geometry(dst)
+    assert page_nbytes(src_q) > 0
+
+
+def test_disagg_rejects_mismatched_roles(parts):
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _disagg(parts, decode_overrides={"kv_dtype": "int8"})
+    with pytest.raises(ValueError, match="block_size"):
+        _disagg(parts, decode_overrides={"block_size": 32})
+
+
+# -------------------------------------------------- scheduler surface / roles
+def test_backpressure_holds_handoffs_without_losing_tokens(parts):
+    """A decode pool sized for ~one resident sequence forces the pump to
+    hold handoffs (prefill-side pages stay live) — outputs still match
+    the monolithic engine and nothing leaks."""
+    ref = _mono(parts).generate(PROMPTS, GEN)
+    dis = _disagg(parts, decode_overrides={"num_blocks": 6})
+    assert dis.generate(PROMPTS, GEN) == ref
+    _audit_no_leak(dis)
+
+
+def test_running_view_spans_pending_handoffs(parts):
+    """server._Scheduler streams first tokens by iterating
+    ``engine.running`` — a request between prefill and splice must stay
+    visible there."""
+    dis = _disagg(parts)
+    dis.add_request(PROMPTS[0], GEN)
+    dis.drain_role("decode")  # pin the request in the handoff queue
+    while not dis.prefill._handoff:
+        dis.prefill.step()
+    view = dis.running
+    assert len(view) == 1
+    (key, req), = view.items()
+    assert key[0] == "prefill" and len(req.output_ids) == 1
+    dis.drain_role("decode", drain=False)
+    done = []
+    while dis.has_work:
+        done.extend(dis.step())
+    assert done and done[0].finish_reason in ("eos", "length")
+    _audit_no_leak(dis)
+
+
+def test_role_drains(parts):
+    dis = _disagg(parts)
+    dis.drain_role("prefill")
+    with pytest.raises(RuntimeError, match="draining"):
+        dis.add_request(PROMPTS[0], GEN)
+    dis.drain_role("prefill", drain=False)
+    rid = dis.add_request(PROMPTS[0], GEN)
+    dis.drain_role("decode")
+    for _ in range(10):
+        dis.step()
+    h = dis.role_health()
+    assert h["decode"]["draining"] and h["decode"]["running"] == 0
+    assert h["prefill"]["pending_handoff"] == 1
+    dis.drain_role("decode", drain=False)
+    done = {}
+    while dis.has_work:
+        for r in dis.step():
+            done[r.request_id] = r
+    assert rid in done
+    with pytest.raises(ValueError, match="role"):
+        dis.drain_role("training")
+    # capacity guard: a prompt that can never fit the decode pool is
+    # rejected at submit, not wedged in the handoff queue forever
+    big = _disagg(parts, decode_overrides={"num_blocks": 2})
+    with pytest.raises(ValueError, match="decode"):
+        big.add_request(list(range(40)), GEN)
+
+
+def test_stats_merge_and_terminal_invariant(parts):
+    dis = _disagg(parts)
+    dis.generate(PROMPTS, GEN)
+    s = dis.stats
+    assert s.requests_submitted == len(PROMPTS)
+    assert s.requests_completed + s.requests_aborted + s.requests_shed \
+        == s.requests_submitted
+    assert s.kv_transfers == len(PROMPTS)
+    d = s.as_dict()
+    assert {"kv_transfers", "kv_transfer_blocks",
+            "kv_transfer_bytes"} <= set(d)
+
+
+def test_kv_transfer_spans_and_abort_in_handoff(parts):
+    dis = _disagg(parts, tracer=True)
+    rid = dis.add_request(PROMPTS[0], GEN)
+    dis.drain_role("decode")
+    while not dis.prefill._handoff:
+        dis.prefill.step()
+    assert dis.abort(rid)  # aborted while parked between the roles
+    dis.drain_role("decode", drain=False)
+    rid2 = dis.add_request(PROMPTS[1], GEN)
+    while dis.has_work:
+        dis.step()
+    spans = [s for s in dis.telemetry.tracer.spans()
+             if s.name == "kv_transfer"]
+    assert len(spans) == 1  # the aborted request never transferred
+    assert spans[0].args["blocks"] >= 1
+    assert spans[0].args["nbytes"] \
+        == spans[0].args["blocks"] * page_nbytes(dis.decode.cache)
+    s = dis.stats
+    assert s.requests_aborted == 1 and s.requests_completed == 1
+    assert s.requests_completed + s.requests_aborted == s.requests_submitted
+    _audit_no_leak(dis)
+
+
+def test_router_fronts_disagg_replicas_with_role_drains(parts):
+    """The drain/undrain control plane, one level up: a Router fronting
+    disagg replicas places prompts normally, narrows a drain to one role,
+    and reports per-role health."""
+    mk = lambda: _disagg(parts)
+    router = Router([mk(), mk()], policy="least_loaded",
+                    parallel_step=False)
+    try:
+        out = router.generate(PROMPTS, GEN)
+        assert [len(o) for o in out] == [GEN.max_new_tokens] * len(PROMPTS)
+        health = router.replica_health()
+        assert all("roles" in h for h in health)
+        assert health[0]["roles"]["decode"]["running"] == 0
+        # decode-role drain: replica KEEPS taking prompts (placement
+        # unchanged), splices pause
+        router.drain(0, role="decode")
+        assert not router.draining(0)
+        assert router.engines[0].role_draining("decode")
+        # prefill-role drain: replica leaves placement too
+        router.drain(0, role="prefill")
+        assert router.draining(0)
+        rid = router.add_request(PROMPTS[0], GEN)
+        assert router.replica_of(rid) == 1
+        # full undrain clears every role drain
+        router.undrain(0)
+        assert not router.engines[0].role_draining("decode")
+        assert not router.engines[0].role_draining("prefill")
+        while router.has_work:
+            router.step()
+        with pytest.raises(ValueError, match="not disaggregated"):
+            Router([_mono(parts), _mono(parts)], policy="least_loaded",
+                   parallel_step=False).drain(0, role="decode")
+    finally:
+        router.close()

@@ -548,3 +548,151 @@ def test_router_slo_aware_off_and_drain_interaction(clock):
         assert [router._place([1, 2, 3]) for _ in range(2)] == [1, 1]
     finally:
         router.close()
+
+
+# ------------------------------------ PR 12 satellites: victim cost + retry
+def test_preempt_victim_longest_remaining(parts):
+    """Cost-aware victim selection: within the lowest priority level the
+    default evicts the oldest runner; ``preempt_victim=
+    "longest_remaining"`` evicts the one with the most token budget left
+    (least sunk decode work lost). Same setup, different victim."""
+    from colossalai_tpu.inference import PREEMPT_VICTIM_POLICIES
+
+    assert "longest_remaining" in PREEMPT_VICTIM_POLICIES
+    with pytest.raises(ValueError, match="preempt_victim"):
+        OverloadConfig(preempt_victim="newest")
+
+    def run(victim_policy):
+        eng = _engine(parts, max_batch_size=2, prefix_cache=True,
+                      scheduler_policy="priority",
+                      overload=OverloadConfig(preempt_victim=victim_policy))
+        short = eng.add_request([1, 2, 3, 4],
+                                GenerationConfig(max_new_tokens=4))
+        long = eng.add_request([5, 6, 7, 8],
+                               GenerationConfig(max_new_tokens=16))
+        while len(eng.running) < 2:
+            eng.step()
+        vip = eng.add_request([9, 9, 9, 9],
+                              GenerationConfig(max_new_tokens=2), priority=5)
+        first = eng.step()  # preemption fires: both slots are priority-0
+        assert eng.stats.requests_preempted == 1
+        evicted = {r.request_id for r in eng.waiting} - {vip}
+        done = {r.request_id: r for r in first + _drain(eng)}
+        assert sorted(done) == sorted([short, long, vip])
+        return short, long, evicted
+
+    short, long, evicted = run("oldest_first")
+    assert evicted == {short}  # oldest = lowest rid within the level
+    short, long, evicted = run("longest_remaining")
+    assert evicted == {long}  # most budget left loses its slot instead
+
+
+def test_retry_after_hint_reads_breached_window(clock):
+    """The hint is the worst breached admission-side windowed percentile,
+    clamped to [1s, window_s]; decode-side breaches and healthy windows
+    yield no hint."""
+    from colossalai_tpu.inference import retry_after_hint
+
+    assert retry_after_hint(None) is None
+    slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    assert retry_after_hint(slo) is None  # healthy: no hint
+    _force_breach(slo, ttft=50.0)
+    hint = retry_after_hint(slo)
+    assert hint is not None and 1.0 <= hint <= 600.0
+    assert hint >= 45.0  # tracks the observed tail, not a constant
+    # sub-second breach clamps up to the 1s floor
+    slo2 = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    _force_breach(slo2, ttft=0.7)
+    assert retry_after_hint(slo2) == 1.0
+    # a decode-side (ITL) breach alone is not an admission signal
+    slo3 = SLOTracker(targets={"itl_p99": 0.01}, window_s=600.0)
+    for _ in range(5):
+        slo3.record_request(itl=5.0, tokens=4, reason="eos")
+    assert slo3.breached and retry_after_hint(slo3) is None
+
+
+def test_shed_requests_carry_retry_hint_in_record(parts, clock, tmp_path):
+    """Engine + telemetry half of the satellite: a shed request is
+    stamped with ``retry_after`` at shed time and its jsonl record logs
+    the same value as ``retry_after_s``."""
+    log = str(tmp_path / "ev.jsonl")
+    slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    eng = _engine(parts, max_batch_size=2, prefix_cache=True, slo=slo,
+                  overload=OverloadConfig(shed_queue_depth=2),
+                  event_log=log)
+    _force_breach(slo, ttft=50.0)
+    for i in range(6):
+        eng.add_request([1, 2, 3, 4 + i], GenerationConfig(max_new_tokens=2))
+    done = {r.request_id: r for r in _drain(eng)}
+    shed = [r for r in done.values() if r.finish_reason == "shed"]
+    assert shed
+    for req in shed:
+        assert req.retry_after is not None and 1.0 <= req.retry_after <= 600.0
+    for req in done.values():
+        if req.finish_reason != "shed":
+            assert req.retry_after is None
+    eng.telemetry.close()
+    records = {r["request_id"]: r for r in EventLog.read(log)
+               if r.get("event") == "request"}
+    for req in shed:
+        assert records[req.request_id]["retry_after_s"] == pytest.approx(
+            req.retry_after, abs=1e-6)
+    for req in done.values():
+        if req.finish_reason != "shed":
+            assert "retry_after_s" not in records[req.request_id]
+
+
+def test_http_503_carries_retry_after_header(parts):
+    """Server half: the 503 shed response carries a ``Retry-After``
+    header (ceil of the hint) and the hint itself as ``retry_after_s``.
+    The scheduler's admission is frozen (``_admit`` no-op) so the queue
+    depth — and therefore the shed decision — is deterministic."""
+    import http.client
+    import json as _json
+    import math
+    import threading
+
+    from colossalai_tpu.inference import make_server
+
+    slo = SLOTracker(targets={"ttft_p99": 0.5}, window_s=600.0)
+    eng = _engine(parts, max_batch_size=1, slo=slo,
+                  overload=OverloadConfig(shed_queue_depth=1))
+    _force_breach(slo, ttft=50.0)
+    orig_admit = eng._admit
+    eng._admit = lambda *a: None  # freeze admission: queue holds
+    server, sched = make_server(eng, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        results = {}
+
+        def post(key, prompt):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+            conn.request("POST", "/generate", _json.dumps(
+                {"prompt_ids": prompt, "max_new_tokens": 2}),
+                {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            results[key] = (r.status, r.getheader("Retry-After"),
+                            _json.loads(r.read()))
+            conn.close()
+
+        t1 = threading.Thread(target=post, args=("first", [1, 2, 3]))
+        t1.start()
+        import time
+        while not eng.waiting:  # the first request is parked in the queue
+            time.sleep(0.005)
+        post("shed", [4, 5, 6])  # queue at depth cap + breach -> shed
+        status, header, payload = results["shed"]
+        assert status == 503 and payload["error"] == "shed"
+        hint = payload["retry_after_s"]
+        assert 1.0 <= hint <= 600.0
+        assert header == str(max(1, int(math.ceil(hint))))
+        eng._admit = orig_admit  # release the queue; the survivor finishes
+        sched._wake.set()
+        t1.join(timeout=120)
+        status, header, payload = results["first"]
+        assert status == 200 and header is None
+        assert len(payload["output_ids"]) == 2
+    finally:
+        sched.stop()
+        server.shutdown()
