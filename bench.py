@@ -919,6 +919,161 @@ def measure_overload(cfg=None, bs: int = 4, prompt_len: int = 48,
     return out
 
 
+def measure_capacity(cfg=None, bs: int = 4, prompt_len: int = 48,
+                     new_tokens: int = 16, k: int = 4,
+                     factors=(0.25, 0.5, 1.0, 2.0, 4.0)):
+    """Capacity-signal ramp (the PR-13 ground truth): drive the SAME
+    open-loop arrival schedule as ``measure_overload`` through a ramp of
+    offered-load factors and report what the :class:`CapacityMonitor`
+    *said* at each stage. Two orderings must hold for the signal plane to
+    be trustworthy as the autoscaler's input:
+
+    1. below saturation (factor <= 1) busy-fraction and goodput-per-chip
+       both rise monotonically with offered load — the signals track load,
+       not noise;
+    2. the :class:`ScalingSignal` flips to ``scale_up`` at or before the
+       first stage whose windowed SLO attainment collapses (< 0.5) — the
+       signal leads the failure it exists to pre-empt, it does not trail
+       it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import (
+        CapacityMonitor,
+        GenerationConfig,
+        LLMEngine,
+        SLOTracker,
+    )
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    if cfg is None:
+        cfg = _small_serving_config()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    # enough arrivals per stage that the low-load stages measure a steady
+    # state, not two isolated bursts (the monotonicity claim needs the
+    # open-loop mixing, not the drain tail)
+    max_req = max(3 * bs, int(round(6 * bs * max(factors))))
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
+               for _ in range(max_req)]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+
+    # -- calibration: closed-loop full batch = peak rate + unloaded tails
+    eng = LLMEngine(params, cfg, max_batch_size=bs, max_seq_len=512,
+                    block_size=32, megastep_k=k, slo=False)
+    throwaway = [[int(t) ^ 1 for t in prompts[0]]] * bs
+    eng.generate([list(p) for p in throwaway],
+                 GenerationConfig(max_new_tokens=k + 2))
+    t_submit, t_first, t_done, n_toks = {}, {}, {}, {}
+    rids = []
+    for p in prompts[:bs]:
+        rids.append(eng.add_request(list(p), gen))
+        t_submit[rids[-1]] = time.perf_counter()
+    t0 = time.perf_counter()
+    while eng.has_work:
+        finished = eng.step()
+        now = time.perf_counter()
+        for req in eng.running.values():
+            if req.output_ids and req.request_id not in t_first:
+                t_first[req.request_id] = now
+        for req in finished:
+            t_first.setdefault(req.request_id, now)
+            t_done[req.request_id] = now
+            n_toks[req.request_id] = len(req.output_ids)
+    dt = time.perf_counter() - t0
+    peak_req_rate = len(rids) / dt
+    ttft_tail = max(t_first[r] - t_submit[r] for r in rids)
+    itl_tail = max((t_done[r] - t_first[r]) / max(n_toks[r] - 1, 1)
+                   for r in rids)
+    targets = {"ttft_p99": max(2.0 * ttft_tail, 1e-3),
+               "itl_p99": max(4.0 * itl_tail, 1e-4)}
+
+    def run_stage(factor):
+        slo = SLOTracker(targets=dict(targets), window_s=30.0)
+        # the window must cover the whole stage or the post-drain read
+        # would only see the tail; short intervals keep busy-fraction
+        # responsive at bench timescales
+        cap = CapacityMonitor(interval_s=0.5, n_intervals=240,
+                              storm_warmup_intervals=4)
+        e = LLMEngine(params, cfg, max_batch_size=bs, max_seq_len=512,
+                      block_size=32, megastep_k=k, slo=slo, capacity=cap)
+        e.generate([list(p) for p in throwaway],
+                   GenerationConfig(max_new_tokens=k + 2))
+        slo.reset()
+        cap.reset()  # drop the warm-up compiles + busy time off the window
+        n_req = max(3 * bs, int(round(6 * bs * factor)))
+        interarrival = 1.0 / (factor * peak_req_rate)
+        i = toks = 0
+        scale_up_seen = False
+        t0 = time.perf_counter()
+        while i < n_req or e.has_work:
+            now = time.perf_counter()
+            while i < n_req and now - t0 >= i * interarrival:
+                e.add_request(list(prompts[i]), gen)
+                i += 1
+            if e.has_work:
+                for req in e.step():
+                    toks += len(req.output_ids)
+                if not scale_up_seen and cap.signal().action == "scale_up":
+                    scale_up_seen = True
+            else:
+                time.sleep(min(interarrival, 0.002))
+        dt = time.perf_counter() - t0
+        snap = slo.snapshot()
+        good = snap["goodput"]
+        sig = cap.signal()
+        return {
+            "n_requests": n_req,
+            "offered_req_per_s": round(factor * peak_req_rate, 2),
+            "tokens_per_s": round(toks / dt, 1),
+            "busy_fraction": round(cap.busy_fraction(), 4),
+            "tokens_per_chip_s": round(cap.tokens_per_chip_s(), 2),
+            "goodput_per_chip_s": round(cap.goodput_per_chip_s(), 2),
+            "kv_pressure": cap.kv_pressure(),
+            "recompiles": (cap.sentinel.total
+                           if cap.sentinel is not None else None),
+            "storm": cap.storm,
+            "slo_attainment": round(
+                good["requests_within_slo"] / max(good["requests_total"], 1),
+                3),
+            "breached": snap["breached"],
+            "signal": sig.action,
+            "signal_reasons": list(sig.reasons),
+            "scale_up_seen": scale_up_seen,
+        }
+
+    out = {
+        "peak_req_per_s": round(peak_req_rate, 2),
+        "targets_ms": {kk: round(1e3 * v, 1) for kk, v in targets.items()},
+        "factors": list(factors),
+    }
+    stages = []
+    for factor in factors:
+        stage = run_stage(factor)
+        out[f"x{factor}"] = stage
+        stages.append((factor, stage))
+    # ordering 1: signals track offered load below saturation
+    below = [s for f, s in stages if f <= 1.0]
+    out["busy_monotone_below_sat"] = all(
+        a["busy_fraction"] <= b["busy_fraction"] + 1e-9
+        for a, b in zip(below, below[1:]))
+    out["goodput_per_chip_monotone_below_sat"] = all(
+        a["goodput_per_chip_s"] <= b["goodput_per_chip_s"] + 1e-9
+        for a, b in zip(below, below[1:]))
+    # ordering 2: scale_up leads the attainment collapse
+    first_up = next((f for f, s in stages if s["scale_up_seen"]), None)
+    first_collapse = next(
+        (f for f, s in stages if s["slo_attainment"] < 0.5), None)
+    out["first_scale_up_factor"] = first_up
+    out["first_collapse_factor"] = first_collapse
+    out["signal_before_collapse"] = (
+        first_collapse is None
+        or (first_up is not None and first_up <= first_collapse))
+    return out
+
+
 def measure_disagg(cfg=None, bs: int = 4, prompt_len: int = 48,
                    new_tokens: int = 24, n_batches: int = 6,
                    load_factor: float = 1.5, k: int = 4,
@@ -1380,6 +1535,12 @@ def cpu_child_main():
             bs=2, prompt_len=32, new_tokens=32, n_batches=5, repeats=3)
     except Exception as e:
         print(f"cpu disagg bench failed: {e}", file=sys.stderr)
+    try:
+        extras["capacity_cpu"] = measure_capacity(
+            bs=2, prompt_len=32, new_tokens=12,
+            factors=(0.25, 0.5, 1.0, 2.0))
+    except Exception as e:
+        print(f"cpu capacity bench failed: {e}", file=sys.stderr)
     # compact headline for the supervisor's final line: the driver records
     # a bounded output tail, so the merged failure JSON carries THIS, not
     # the full nested dicts
@@ -1414,6 +1575,19 @@ def cpu_child_main():
             summary[f"disagg_{arm}_itl_ms_p99"] = dg[arm]["itl_ms_p99"]
     if "itl_p99_ratio" in dg:
         summary["disagg_itl_p99_ratio"] = dg["itl_p99_ratio"]
+    capn = extras.get("capacity_cpu", {})
+    for kk in ("busy_monotone_below_sat",
+               "goodput_per_chip_monotone_below_sat",
+               "signal_before_collapse", "first_scale_up_factor"):
+        if kk in capn:
+            summary[f"capacity_{kk}"] = capn[kk]
+    for fk in ("x0.25", "x0.5", "x1.0", "x2.0"):
+        if fk in capn:
+            summary[f"capacity_{fk}_busy_fraction"] = \
+                capn[fk]["busy_fraction"]
+            summary[f"capacity_{fk}_goodput_per_chip_s"] = \
+                capn[fk]["goodput_per_chip_s"]
+            summary[f"capacity_{fk}_signal"] = capn[fk]["signal"]
     print(json.dumps({
         "metric": "cpu_serving_fallback", "value": 0.0, "unit": "MFU",
         "vs_baseline": 0.0, "cpu_fallback": True, "summary": summary,
@@ -1448,6 +1622,97 @@ def _cpu_fallback(budget_s: float):
     except OSError:
         return None
     return _last_json_line(proc.stdout or "")
+
+
+#: summary-key substrings where a HIGHER value is a regression
+_LOWER_BETTER = ("ttft", "itl", "stall", "latency")
+#: summary-key substrings where a LOWER value is a regression
+_HIGHER_BETTER = ("tokens_per_s", "goodput", "attainment", "scaling_x",
+                  "mfu", "agreement", "gain")
+
+
+def _compare_summaries(current: dict, baseline: dict,
+                       threshold: float = 0.1) -> dict:
+    """Direction-aware regression gate over flat summary dicts: every
+    numeric key present in BOTH sides is diffed relative to the baseline;
+    a delta beyond ``threshold`` in the bad direction (higher TTFT/ITL,
+    lower tokens-per-s/goodput/attainment) lands in ``regressions``, in
+    the good direction in ``improvements``. Keys whose direction is
+    unknown (or boolean flags) are diffed but never flagged — the gate
+    must not invent a preference it can't defend. Baseline keys the
+    current run no longer reports land in ``missing`` (a silently dropped
+    scenario is itself a regression signal)."""
+    out = {
+        "threshold": threshold,
+        "compared": 0,
+        "regressions": {},
+        "improvements": {},
+        "missing": [],
+        "regressed": False,
+    }
+    for key in sorted(baseline):
+        base = baseline[key]
+        if isinstance(base, bool) or not isinstance(base, (int, float)):
+            continue
+        cur = current.get(key)
+        if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+            out["missing"].append(key)
+            continue
+        out["compared"] += 1
+        # clamp so a zero baseline can't print an unparseable Infinity
+        rel = (cur - base) / abs(base) if base else (
+            0.0 if cur == 0 else (99.0 if cur > 0 else -99.0))
+        rel = max(-99.0, min(99.0, rel))
+        lower = any(t in key for t in _LOWER_BETTER)
+        higher = any(t in key for t in _HIGHER_BETTER)
+        if lower == higher:  # unknown or ambiguous direction: never flag
+            continue
+        entry = {"baseline": base, "current": cur, "rel": round(rel, 4)}
+        if (lower and rel > threshold) or (higher and rel < -threshold):
+            out["regressions"][key] = entry
+        elif (lower and rel < -threshold) or (higher and rel > threshold):
+            out["improvements"][key] = entry
+    out["regressed"] = bool(out["regressions"])
+    return out
+
+
+def _summary_of(record: dict) -> dict:
+    """The flat numeric summary a record carries: the child's ``summary``
+    block when present, the failure path's ``cpu_serving`` block, else
+    the record's own top-level numerics."""
+    for key in ("summary", "cpu_serving"):
+        v = record.get(key)
+        if isinstance(v, dict) and v:
+            return v
+    return {k: v for k, v in record.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def _apply_compare(record):
+    """``--compare <baseline.json>`` (or BENCH_COMPARE=): attach the
+    regression diff vs the stored baseline to the outgoing JSON record.
+    The baseline file may be a full bench record (its summary block is
+    used) or a bare summary dict. Never raises — an unreadable baseline
+    reports as ``compare.error`` instead of eating the round's number."""
+    path = os.environ.get("BENCH_COMPARE")
+    if not path or not isinstance(record, dict):
+        return record
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            baseline = json.load(f)
+        if not isinstance(baseline, dict):
+            raise ValueError("baseline JSON is not an object")
+    except Exception as e:
+        record["compare"] = {"baseline_path": path,
+                             "error": f"baseline unreadable: {e}"}
+        return record
+    cmp_out = _compare_summaries(
+        _summary_of(record), _summary_of(baseline),
+        threshold=float(os.environ.get("BENCH_COMPARE_THRESHOLD", "0.1")),
+    )
+    cmp_out["baseline_path"] = path
+    record["compare"] = cmp_out
+    return record
 
 
 def _last_json_line(text: str):
@@ -1649,7 +1914,7 @@ def supervise():
                 if attempt > 1 or probe_failures:
                     found["bench_attempts"] = attempt
                     found["probe_failures"] = probe_failures
-                print(json.dumps(found), flush=True)
+                print(json.dumps(_apply_compare(found)), flush=True)
                 return
             err_tail = ((proc.stderr or "") + (proc.stdout or "")).strip()[-2000:]
             last_err = f"attempt {attempt}: rc={proc.returncode}: {err_tail}"
@@ -1680,10 +1945,18 @@ def supervise():
     if cpu is not None:
         failure["cpu_fallback"] = True
         failure["cpu_serving"] = cpu.get("summary", {})
-    print(json.dumps(failure), flush=True)
+    print(json.dumps(_apply_compare(failure)), flush=True)
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        # regression gate: diff the outgoing summary against a stored
+        # baseline (see _apply_compare); env form: BENCH_COMPARE=path
+        _i = sys.argv.index("--compare")
+        if _i + 1 >= len(sys.argv):
+            print("--compare needs a baseline.json path", file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_COMPARE"] = sys.argv[_i + 1]
     if "--child" in sys.argv:
         child_main()
     elif "--cpu-child" in sys.argv:

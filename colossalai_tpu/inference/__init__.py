@@ -1,3 +1,10 @@
+from colossalai_tpu.telemetry import (
+    CapacityMonitor,
+    RecompileSentinel,
+    ScalingSignal,
+    TimeSeries,
+)
+
 from .diffusion import ddim_sample, ddim_schedule
 from .disagg import DISAGG_ROLES, DisaggEngine
 from .engine import (
@@ -107,6 +114,10 @@ __all__ = [
     "SHED_POLICIES",
     "SpeculativeEngine",
     "SpecStats",
+    "CapacityMonitor",
+    "RecompileSentinel",
+    "ScalingSignal",
+    "TimeSeries",
     "FINISH_REASONS",
     "EventLog",
     "Histogram",

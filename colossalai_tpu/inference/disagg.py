@@ -50,6 +50,8 @@ import dataclasses
 import time
 from typing import Dict, List, Optional, Set, Union
 
+from colossalai_tpu.telemetry.capacity import CapacityMonitor, fleet_capacity
+
 from .engine import EngineStats, GenerationConfig, LLMEngine, Request
 from .kv_cache import SequenceTable
 from .kv_transport import DeviceKVTransport, KVTransport, page_nbytes
@@ -164,6 +166,7 @@ class DisaggEngine:
         tracer: Union[bool, Tracer, None] = None,
         slo: Union[bool, SLOTracker, None] = True,
         overload=None,
+        capacity=None,
         **engine_kwargs,
     ):
         self.transport = transport if transport is not None else DeviceKVTransport()
@@ -193,11 +196,29 @@ class DisaggEngine:
                     "telemetry=False or the observability knobs"
                 )
             tele = None
+        # ---- per-role capacity monitors (capacity=True/monitor): the
+        # decode worker carries the full monitor (goodput + HBM); the
+        # prefill worker's skips goodput (the SLO tracker is SHARED —
+        # counting its goodput counter from both roles would double the
+        # fleet per-chip rate) and HBM (same process, same devices — one
+        # watermark sampler is enough).
+        if capacity:
+            dec_cap = (capacity if isinstance(capacity, CapacityMonitor)
+                       else CapacityMonitor())
+            pre_cap = CapacityMonitor(
+                interval_s=dec_cap.series.interval_s,
+                n_intervals=dec_cap.series.n_intervals,
+                goodput=False, hbm=False,
+            )
+        else:
+            dec_cap = pre_cap = None
         pre_kw = dict(engine_kwargs)
         pre_kw["megastep_k"] = 1  # ingestion only — this side never decodes
         pre_kw["overload"] = overload  # admission control gates HERE
+        pre_kw["capacity"] = pre_cap
         pre_kw.update(prefill_overrides or {})
         dec_kw = dict(engine_kwargs)
+        dec_kw["capacity"] = dec_cap
         dec_kw.update(decode_overrides or {})
         self.prefill = _PrefillWorker(
             params, config,
@@ -425,6 +446,35 @@ class DisaggEngine:
                 if k.rsplit("_p", 1)[0] in _ROLE_OF_METRIC}
 
     # -------------------------------------------------- observability surface
+    @property
+    def capacity(self) -> Optional[CapacityMonitor]:
+        """The decode-role monitor (the one with goodput + HBM) — what a
+        single-engine scrape (``/health`` brief, ``/metrics`` families)
+        reads; per-role detail lives in :meth:`capacity_snapshot`."""
+        return self.decode.capacity
+
+    def capacity_monitors(self) -> Dict[str, CapacityMonitor]:
+        """Per-role live monitors — role-asymmetric meshes get their
+        signal per role, and the router merges them under
+        ``replica<i>.<role>`` keys."""
+        out: Dict[str, CapacityMonitor] = {}
+        if self.prefill.capacity is not None:
+            out["prefill"] = self.prefill.capacity
+        if self.decode.capacity is not None:
+            out["decode"] = self.decode.capacity
+        return out
+
+    def capacity_snapshot(self) -> Optional[Dict]:
+        """The disagg ``GET /capacity`` payload: per-role snapshots plus
+        the merged series and combined signal (None when capacity
+        monitoring is off)."""
+        mons = self.capacity_monitors()
+        if not mons:
+            return None
+        payload = fleet_capacity(mons)
+        payload["roles"] = sorted(mons)
+        return payload
+
     @property
     def stats(self) -> EngineStats:
         """Both workers' counters summed into one ``EngineStats`` — the

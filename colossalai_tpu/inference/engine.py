@@ -65,6 +65,8 @@ import numpy as np
 from colossalai_tpu.models.llama import LlamaConfig
 from colossalai_tpu.utils.profiler import annotate, step_annotation
 
+from colossalai_tpu.telemetry import CapacityMonitor
+
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
 from .overload import OverloadConfig, OverloadController, retry_after_hint
 from .prefix_cache import PrefixCache
@@ -358,6 +360,7 @@ class LLMEngine:
         tracer: Union[bool, Tracer, None] = None,
         slo: Union[bool, SLOTracker, None] = True,
         overload: Union[bool, OverloadConfig, None] = None,
+        capacity: Union[bool, CapacityMonitor, None] = None,
         moe_impl: str = "auto",
         kv_dtype: str = "bf16",
     ):
@@ -392,6 +395,24 @@ class LLMEngine:
                     "telemetry=False or the observability knobs"
                 )
             self.telemetry = NullTelemetry()
+        # ---- capacity signal plane (default OFF): utilization /
+        # goodput-per-chip / KV-pressure time series + recompile sentinel,
+        # sampled once per step() from host floats the engine already
+        # holds — device traffic is byte-identical on vs off (asserted in
+        # test_capacity.py). Pass True for defaults or a configured
+        # CapacityMonitor.
+        if capacity is True:
+            self.capacity: Optional[CapacityMonitor] = CapacityMonitor()
+        else:
+            self.capacity = capacity or None
+        if self.capacity is not None and self.capacity.sentinel is not None:
+            # fallback attribution only (no jax.monitoring): poll these
+            # jits' compile-cache growth; no-ops when the listener is live
+            for fn, ph in ((decode_megastep, "decode"),
+                           (decode_spec_megastep, "spec"),
+                           (prefill_paged, "prefill"),
+                           (prefill_chunk_paged, "prefill")):
+                self.capacity.sentinel.watch(fn, ph)
         self.max_batch = max_batch_size
         if max_seq_len % block_size:
             raise ValueError(
@@ -1038,9 +1059,16 @@ class LLMEngine:
         tracing = self.telemetry.tracer is not None
         t_wave0 = time.monotonic() if tracing else 0.0
         self._tick_prefilled = False
-        self._preempt_for_priority()
-        self._admit(finished)
-        self._advance_prefills(finished)
+        t_pre = time.perf_counter() if self.capacity is not None else 0.0
+        with self._compile_phase("prefill"):
+            self._preempt_for_priority()
+            self._admit(finished)
+            self._advance_prefills(finished)
+        if self.capacity is not None and self._tick_prefilled:
+            # prefill wall time is the other half of the duty cycle (and
+            # the only half a disagg prefill worker has); host clock only,
+            # so the transfer counters stay byte-identical
+            self.capacity.on_prefill(time.perf_counter() - t_pre)
         if tracing and self._tick_prefilled:
             # attribute the prefill wave to the requests it STALLED: every
             # decoding request spends this interval parked behind
@@ -1055,7 +1083,44 @@ class LLMEngine:
                         req, "prefill_stall", t0, t_wave1)
         self._decode_tick(finished)
         self._refresh_kv_gauges()
+        if self.capacity is not None:
+            self._sample_capacity()
         return finished
+
+    def _compile_phase(self, name: str):
+        """Recompile-sentinel attribution scope — a no-op nullcontext
+        unless a capacity monitor with a sentinel is attached."""
+        if self.capacity is not None and self.capacity.sentinel is not None:
+            return self.capacity.sentinel.phase(name)
+        return contextlib.nullcontext()
+
+    def _sample_capacity(self) -> None:
+        """Feed the capacity monitor from host-side bookkeeping already on
+        hand at the end of the tick — no device fetch, so the transfer
+        counters are byte-identical monitor on vs off."""
+        cap = self.capacity
+        slo = self.telemetry.slo
+        pc = self.prefix_cache
+        cap.sample(
+            queue_depth=len(self.waiting),
+            running=len(self.running),
+            kv_blocks_in_use=self.stats.kv_blocks_in_use,
+            kv_blocks_total=self.allocator.num_blocks - 1,
+            prefix_cache_blocks=(pc.num_blocks if pc is not None else None),
+            decode_tokens=self.stats.decode_tokens,
+            goodput_tokens=(slo.goodput_tokens if slo is not None else None),
+            slo_breached=(slo.breached if slo is not None else None),
+        )
+
+    def capacity_snapshot(self) -> Optional[Dict]:
+        """The single-engine `/capacity` payload (None when the monitor
+        is off)."""
+        return self.capacity.snapshot() if self.capacity is not None else None
+
+    def capacity_monitors(self) -> Dict[str, CapacityMonitor]:
+        """Live monitors keyed by role, for fleet merging (a monolithic
+        engine is one role, ``engine``; disagg reports per-role)."""
+        return {"engine": self.capacity} if self.capacity is not None else {}
 
     def _refresh_kv_gauges(self) -> None:
         """KV-pool memory gauges from host-side bookkeeping only (pool
@@ -1408,7 +1473,8 @@ class LLMEngine:
             mesh_ctx = use_mesh(self._tp_mesh)
         else:
             mesh_ctx = contextlib.nullcontext()
-        with mesh_ctx, step_annotation(
+        with mesh_ctx, self._compile_phase(
+                "spec" if d > 0 else "decode"), step_annotation(
                 self.stats.decode_megasteps,
                 name="spec_megastep" if d > 0 else "decode_megastep"):
             if d > 0:
@@ -1464,7 +1530,11 @@ class LLMEngine:
             counts_np = (
                 self._fetch(expert_counts) if self._moe and d == 0 else None
             )
-        self.telemetry.observe_megastep(time.perf_counter() - t_mega)
+        dt_mega = time.perf_counter() - t_mega
+        self.telemetry.observe_megastep(dt_mega)
+        if self.capacity is not None:
+            # same host float, second consumer: busy-fraction numerator
+            self.capacity.on_megastep(dt_mega)
         self.stats.decode_megasteps += 1
         self.stats.decode_syncs += 1
         self.stats.decode_d2h_elements += (

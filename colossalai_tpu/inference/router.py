@@ -66,6 +66,11 @@ import itertools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Union
 
+from colossalai_tpu.telemetry.capacity import (
+    CapacityMonitor,
+    fleet_capacity,
+    merged_capacity_prom,
+)
 from colossalai_tpu.telemetry.core import Histogram, prometheus_exposition
 from colossalai_tpu.telemetry.slo import SLOTracker
 from colossalai_tpu.telemetry.tracing import Tracer
@@ -430,6 +435,11 @@ class Router:
                 # windowed SLO brief per replica: the scrape a breach-aware
                 # balancer reads (breached flag + live windowed percentiles)
                 entry["slo"] = slo.brief()
+            cap = getattr(e, "capacity", None)
+            if cap is not None:
+                # compact capacity view per replica (busy fraction,
+                # per-chip rates, scaling signal) — detail at /capacity
+                entry["capacity"] = cap.brief()
             if hasattr(e, "role_health"):
                 # disaggregated replica: the per-role view (queues, pending
                 # handoffs, per-pool headroom, role drain flags)
@@ -492,6 +502,33 @@ class Router:
         payload's ``merged`` half)."""
         return SLOTracker.merged_snapshot(self.slo_trackers())
 
+    def capacity_monitors(self) -> Dict[str, CapacityMonitor]:
+        """Every replica's live capacity monitor(s), keyed
+        ``replica<i>`` (monolithic) or ``replica<i>.<role>`` (disagg);
+        replicas without a monitor contribute nothing."""
+        out: Dict[str, CapacityMonitor] = {}
+        for i, e in enumerate(self.engines):
+            fn = getattr(e, "capacity_monitors", None)
+            mons = fn() if callable(fn) else {}
+            for role, m in mons.items():
+                key = (f"replica{i}" if role == "engine"
+                       else f"replica{i}.{role}")
+                out[key] = m
+        return out
+
+    def merged_capacity(self) -> Optional[Dict]:
+        """Fleet capacity view: merged time series, chip-weighted
+        utilization, summed per-chip throughput, worst-case pressure, and
+        the combined :class:`~colossalai_tpu.telemetry.capacity.
+        ScalingSignal` — the ``GET /capacity`` payload. None when no
+        replica carries a monitor."""
+        mons = self.capacity_monitors()
+        if not mons:
+            return None
+        payload = fleet_capacity(mons)
+        payload["replica_count"] = self.n_replicas
+        return payload
+
     def occupancy(self) -> Dict[str, int]:
         """Router-wide scheduler/pool gauges (the non-counter half of
         /health and /metrics)."""
@@ -524,6 +561,14 @@ class Router:
             slo_counters, slo_gauges = SLOTracker.merged_prom(trackers)
             counters.update(slo_counters)
             gauges.update(slo_gauges)
+        mons = self.capacity_monitors()
+        if mons:
+            # fleet clt_capacity_* families: counters summed, per-chip
+            # rates recomputed over the summed chip count — same names as
+            # a bare engine's exposition
+            cap_counters, cap_gauges = merged_capacity_prom(mons.values())
+            counters.update(cap_counters)
+            gauges.update(cap_gauges)
         return prometheus_exposition(counters, gauges,
                                      self.merged_histograms())
 
@@ -543,6 +588,9 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
     MERGED exposition (:meth:`Router.metrics_text` — one scrape target,
     ``_count`` = sum over replicas, ``clt_slo_*`` folded bucket-wise);
     ``GET /slo`` pairs the fleet view with the per-replica snapshots;
+    ``GET /capacity`` serves the fleet capacity view (merged time series,
+    per-replica utilization / goodput-per-chip / pressure, combined
+    ``ScalingSignal``);
     ``GET /trace?rid=`` / ``POST /trace/dump`` serve the shared tracer
     (replicas built with one ``tracer=`` instance stitch into one trace);
     ``POST /drain`` ``{"replica": i, "drain": bool}`` toggles placement
@@ -570,6 +618,11 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                 "merged": router.merged_slo(),
                 "replicas": [t.snapshot() for t in trackers],
             }
+
+        def _capacity_payload(self):
+            # fleet override of the single-engine /capacity body: merged
+            # series + per-replica snapshots + combined ScalingSignal
+            return router.merged_capacity()
 
         def do_GET(self):
             if self.path == "/health":

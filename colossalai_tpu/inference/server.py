@@ -298,6 +298,22 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
             else:
                 self._json(200, payload)
 
+        def _capacity_payload(self) -> Optional[dict]:
+            """The ``GET /capacity`` body (caller holds the lock); None
+            when no capacity monitor is attached. ``make_router_server``
+            overrides this with the fleet-merged per-replica view."""
+            snap = getattr(engine, "capacity_snapshot", None)
+            return snap() if callable(snap) else None
+
+        def _get_capacity(self):
+            with sched.lock:
+                payload = self._capacity_payload()
+            if payload is None:
+                self._json(404, {"error": "capacity monitoring disabled "
+                                 "(engine capacity= knob)"})
+            else:
+                self._json(200, payload)
+
         def _get_trace(self, query: str):
             tracer = _attached_tracer(engine)
             if tracer is None:
@@ -343,6 +359,12 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         # the compact windowed view (breached flag + live
                         # percentiles) — full detail lives at GET /slo
                         payload["slo"] = slo.brief()
+                    cap = getattr(engine, "capacity", None)
+                    if cap is not None:
+                        # the compact capacity view (busy fraction,
+                        # per-chip rates, scaling signal) — full detail
+                        # lives at GET /capacity
+                        payload["capacity"] = cap.brief()
                     ctl = getattr(engine, "_overload", None)
                     if ctl is not None:
                         # live overload-control state: is the shed gate
@@ -381,6 +403,12 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         # targets, goodput, breach flag
                         counters.update(slo.prom_counters())
                         gauges.update(slo.prom_gauges())
+                    cap = getattr(engine, "capacity", None)
+                    if cap is not None:
+                        # clt_capacity_* families: utilization, per-chip
+                        # rates, pressure, recompile sentinel
+                        counters.update(cap.prom_counters())
+                        gauges.update(cap.prom_gauges())
                     body = prometheus_exposition(
                         counters, gauges, engine.telemetry.histograms,
                     ).encode()
@@ -392,6 +420,8 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                 self.wfile.write(body)
             elif parsed.path == "/slo":
                 self._get_slo()
+            elif parsed.path == "/capacity":
+                self._get_capacity()
             elif parsed.path == "/trace":
                 self._get_trace(parsed.query)
             else:

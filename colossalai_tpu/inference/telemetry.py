@@ -25,10 +25,18 @@ megasteps, plus cache/refund instants), and an
 :class:`~colossalai_tpu.telemetry.SLOTracker` folds finish-time
 latencies into sliding-window percentiles with goodput accounting.
 
+The capacity signal plane (engine ``capacity=`` knob) sits NEXT TO this
+facade rather than on it: the engine owns its
+:class:`~colossalai_tpu.telemetry.CapacityMonitor` directly so a
+disaggregated pair — whose two workers SHARE one facade — still gets
+per-role utilization series without double-counting deltas. It obeys the
+same contract below.
+
 Everything here is host-side arithmetic on python floats — enabling
-telemetry provably changes NOTHING about device traffic
-(``decode_syncs`` / ``decode_h2d_scalars`` are asserted byte-identical in
-``tests/test_inference/test_telemetry.py``).
+telemetry (and the capacity monitor) provably changes NOTHING about
+device traffic (``decode_syncs`` / ``decode_h2d_scalars`` are asserted
+byte-identical in ``tests/test_inference/test_telemetry.py`` and
+``test_capacity.py``).
 """
 
 from __future__ import annotations

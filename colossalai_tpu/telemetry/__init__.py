@@ -6,9 +6,18 @@ and the training-side :class:`TrainMonitor` in :mod:`.train_monitor`.
 Serving-specific telemetry (request lifecycle stamps + span wiring) stays
 in :mod:`colossalai_tpu.inference.telemetry`."""
 
+from .capacity import (
+    CapacityMonitor,
+    RecompileSentinel,
+    ScalingSignal,
+    combine_signals,
+    fleet_capacity,
+    merged_capacity_prom,
+)
 from .core import METRIC_NAME_RE, EventLog, Histogram, prometheus_exposition
 from .slo import DEFAULT_TARGETS, SLO_TARGET_RE, SLOTracker, WindowedHistogram
-from .tracing import SPAN_NAME_RE, Span, Tracer
+from .timeseries import TimeSeries
+from .tracing import SPAN_CATALOG, SPAN_NAME_RE, Span, Tracer
 from .train_monitor import (
     NONFINITE_ACTIONS,
     NonFiniteLossError,
@@ -24,10 +33,18 @@ __all__ = [
     "EventLog",
     "Histogram",
     "prometheus_exposition",
+    "CapacityMonitor",
+    "RecompileSentinel",
+    "ScalingSignal",
+    "combine_signals",
+    "fleet_capacity",
+    "merged_capacity_prom",
+    "TimeSeries",
     "DEFAULT_TARGETS",
     "SLO_TARGET_RE",
     "SLOTracker",
     "WindowedHistogram",
+    "SPAN_CATALOG",
     "SPAN_NAME_RE",
     "Span",
     "Tracer",

@@ -1,0 +1,618 @@
+"""Capacity signals: utilization, goodput-per-chip, KV/HBM pressure, and
+a recompile sentinel — the observational half of the autoscaler.
+
+:class:`CapacityMonitor` is sampled by the engine once per ``step()`` at
+the existing megastep sync boundary; every input is a host-side float the
+engine already holds (wall-clock megastep time, cumulative token
+counters, queue lengths, allocator block counts), so device traffic is
+byte-identical monitor-on vs monitor-off — the same zero-overhead
+contract the event log, tracer, and SLO windows obey. History lives in a
+:class:`~.timeseries.TimeSeries`; derived signals:
+
+- **busy fraction** — windowed busy wall seconds (decode megasteps +
+  prefill waves) ÷ covered wall seconds: the share of real time the
+  engine spent inside dispatched device work. ≥ ``saturation_busy``
+  reads "this replica has no slack".
+- **tokens/goodput per chip-second** — windowed rates over
+  ``jax.local_device_count()`` chips; goodput comes from the SLOTracker's
+  within-SLO token counter, so it is the ROADMAP's scaling signal.
+- **KV pressure** — ``kv_blocks_in_use / kv_blocks_total`` plus resident
+  prefix-cache blocks (admission stalls follow KV exhaustion, not FLOPs).
+- **HBM watermarks** — ``BaseAccelerator.memory_watermarks()`` sampled at
+  most once per interval (the training-side TrainMonitor idiom, now on
+  the serving path). Empty on backends without the stats API.
+- **headroom** — ``tokens_per_s / busy_fraction − tokens_per_s``: the
+  linear-extrapolation estimate of additional tokens/s before the decode
+  loop saturates, clamped to 0 while the SLO window is breached (a
+  breached replica has no usable headroom whatever the extrapolation
+  says).
+
+:class:`RecompileSentinel` counts XLA backend compilations via
+``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+duration event (fires once per actual backend compile; jit cache hits do
+not fire it), attributed to engine phase through a thread-local scope the
+engine holds around its dispatch points. When several sentinels live in
+one process (multi-replica router), a compile is charged to the
+sentinel(s) holding an active phase on the dispatching thread; compiles
+nobody claims (imports, helper ops) land in every sentinel's ``other``
+bucket. Where ``jax.monitoring`` is unavailable the sentinel falls back
+to polling the tracked jit functions' ``_cache_size()``. A "recompile
+storm" flag rises when compiles in the current interval reach
+``storm_threshold`` after the warmup intervals — steady-state serving
+recompiling means the shape-bucket plan is broken.
+
+:class:`ScalingSignal` is the recommendation the fleet view serves —
+``scale_up | scale_down | hold`` with human-readable reasons. This PR is
+observational: nothing acts on it yet.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from .timeseries import TimeSeries
+
+__all__ = ["CapacityMonitor", "RecompileSentinel", "ScalingSignal",
+           "combine_signals", "fleet_capacity", "merged_capacity_prom"]
+
+#: the jax.monitoring duration event that fires once per XLA backend
+#: compile (verified: cache hits do not fire it; helper-op compiles do)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_SENTINELS: "weakref.WeakSet[RecompileSentinel]" = weakref.WeakSet()
+#: None = not probed yet; True/False = jax.monitoring listener installed
+_LISTENER_AVAILABLE: Optional[bool] = None
+
+
+def _dispatch_compile_event(event: str, *args, **kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    sentinels = list(_SENTINELS)
+    # charge the compile to whoever holds a phase on this thread (compiles
+    # run synchronously on the dispatching thread); unclaimed compiles go
+    # to everyone's "other" bucket
+    claimed = [s for s in sentinels if s._active_phase() is not None]
+    for s in (claimed or sentinels):
+        s._on_compile()
+
+
+def _install_listener() -> bool:
+    """Register the module-level dispatch listener once per process.
+    jax.monitoring has no unregister API, so one process-lifetime listener
+    fans out to a WeakSet of live sentinels."""
+    global _LISTENER_AVAILABLE
+    if _LISTENER_AVAILABLE is not None:
+        return _LISTENER_AVAILABLE
+    try:
+        import jax
+
+        mon = getattr(jax, "monitoring", None)
+        reg = getattr(mon, "register_event_duration_secs_listener", None)
+        if reg is None:
+            _LISTENER_AVAILABLE = False
+        else:
+            reg(_dispatch_compile_event)
+            _LISTENER_AVAILABLE = True
+    except Exception:
+        _LISTENER_AVAILABLE = False
+    return _LISTENER_AVAILABLE
+
+
+class RecompileSentinel:
+    """Count XLA backend compiles, attributed to an engine phase."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.total = 0
+        self.by_phase: Dict[str, int] = {}
+        #: fallback registry: [fn, phase, last_cache_size]
+        self._watched: List[list] = []
+        self.listener = _install_listener()
+        if self.listener:
+            _SENTINELS.add(self)
+
+    def _active_phase(self) -> Optional[str]:
+        return getattr(self._tls, "phase", None)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Scope compiles fired on this thread to ``name``."""
+        prev = getattr(self._tls, "phase", None)
+        self._tls.phase = name
+        try:
+            yield
+        finally:
+            self._tls.phase = prev
+
+    def _on_compile(self, n: int = 1) -> None:
+        phase = self._active_phase() or "other"
+        with self._lock:
+            self.by_phase[phase] = self.by_phase.get(phase, 0) + n
+            self.total += n
+
+    # -- fallback path (no jax.monitoring) ---------------------------------
+
+    @staticmethod
+    def _cache_size(fn) -> Optional[int]:
+        try:
+            return int(fn._cache_size())
+        except Exception:
+            return None
+
+    def watch(self, fn, phase: str) -> None:
+        """Fallback only: track a jitted callable's compile-cache size and
+        charge growth to ``phase`` on the next :meth:`poll`. No-op when
+        the event listener is live (it already sees every compile)."""
+        if self.listener:
+            return
+        size = self._cache_size(fn)
+        if size is not None:
+            self._watched.append([fn, phase, size])
+
+    def poll(self) -> None:
+        """Fallback only: convert cache-size growth since the last poll
+        into compile counts."""
+        if self.listener:
+            return
+        for rec in self._watched:
+            size = self._cache_size(rec[0])
+            if size is not None and size > rec[2]:
+                self._on_compile_phase(rec[1], size - rec[2])
+                rec[2] = size
+
+    def _on_compile_phase(self, phase: str, n: int) -> None:
+        with self._lock:
+            self.by_phase[phase] = self.by_phase.get(phase, 0) + n
+            self.total += n
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"total": self.total, "by_phase": dict(self.by_phase),
+                    "listener": self.listener}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.total = 0
+            self.by_phase.clear()
+            for rec in self._watched:
+                size = self._cache_size(rec[0])
+                if size is not None:
+                    rec[2] = size
+
+
+@dataclass
+class ScalingSignal:
+    """Observational scaling recommendation — acted on next PR."""
+
+    action: str  # "scale_up" | "scale_down" | "hold"
+    reasons: Tuple[str, ...] = field(default_factory=tuple)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"action": self.action, "reasons": list(self.reasons)}
+
+
+def combine_signals(per_replica: Mapping[str, ScalingSignal]) -> ScalingSignal:
+    """Fleet fold: any replica asking to scale up wins (name it in the
+    reasons); scale down only when *every* replica is idle; else hold."""
+    if not per_replica:
+        return ScalingSignal("hold", ("no_replicas",))
+    ups = {name: s for name, s in per_replica.items()
+           if s.action == "scale_up"}
+    if ups:
+        reasons = tuple(f"{name}: {r}" for name, s in sorted(ups.items())
+                        for r in s.reasons)
+        return ScalingSignal("scale_up", reasons or ("replica_saturated",))
+    if all(s.action == "scale_down" for s in per_replica.values()):
+        return ScalingSignal("scale_down", ("all_replicas_idle",))
+    return ScalingSignal("hold", ())
+
+
+class CapacityMonitor:
+    """Per-engine capacity signal plane (see module docstring)."""
+
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(
+        self,
+        *,
+        interval_s: float = 10.0,
+        n_intervals: int = 30,
+        chips: Optional[int] = None,
+        sentinel=True,
+        storm_threshold: int = 8,
+        storm_warmup_intervals: int = 1,
+        hbm: bool = True,
+        goodput: bool = True,
+        saturation_busy: float = 0.85,
+        idle_busy: float = 0.10,
+        kv_pressure_hi: float = 0.90,
+    ):
+        self.series = TimeSeries(interval_s=interval_s,
+                                 n_intervals=n_intervals)
+        self._chips = int(chips) if chips else None
+        if sentinel is True:
+            self.sentinel: Optional[RecompileSentinel] = RecompileSentinel()
+        else:
+            self.sentinel = sentinel or None
+        self.storm_threshold = int(storm_threshold)
+        self.storm_warmup_intervals = int(storm_warmup_intervals)
+        self.hbm_enabled = bool(hbm)
+        self.goodput_enabled = bool(goodput)
+        self.saturation_busy = float(saturation_busy)
+        self.idle_busy = float(idle_busy)
+        self.kv_pressure_hi = float(kv_pressure_hi)
+        self.storm = False
+        self.storms = 0
+        #: cumulative-feed baselines (first sample of a key sets the
+        #: baseline without counting, so a monitor attached to a warm
+        #: engine doesn't dump the engine's whole history into one slot)
+        self._last: Dict[str, float] = {}
+        self._start_idx: Optional[int] = None
+        self._hbm_idx: Optional[int] = None
+        self._hbm: Optional[Dict[str, object]] = None
+
+    # -- chips -------------------------------------------------------------
+
+    @property
+    def chips(self) -> int:
+        if self._chips is None:
+            try:
+                import jax
+
+                self._chips = max(1, jax.local_device_count())
+            except Exception:
+                self._chips = 1
+        return self._chips
+
+    # -- feeds (engine-side, host floats only) ----------------------------
+
+    def on_megastep(self, seconds: float) -> None:
+        """Feed one megastep's wall time (the engine already measures it
+        for the cumulative histogram — same float, second consumer)."""
+        self.series.inc("busy_seconds", seconds)
+
+    def on_prefill(self, seconds: float) -> None:
+        """Feed one prefill wave's wall time — the other half of the duty
+        cycle (and the *only* half a disagg prefill worker has). Kept as
+        its own series too so the fleet view can split the busy mix."""
+        self.series.inc("busy_seconds", seconds)
+        self.series.inc("prefill_seconds", seconds)
+
+    def _delta(self, key: str, current: float) -> Optional[float]:
+        prev = self._last.get(key)
+        self._last[key] = current
+        if prev is None:
+            return None
+        return max(0.0, current - prev)
+
+    def sample(
+        self,
+        *,
+        queue_depth: Optional[int] = None,
+        running: Optional[int] = None,
+        kv_blocks_in_use: Optional[int] = None,
+        kv_blocks_total: Optional[int] = None,
+        prefix_cache_blocks: Optional[int] = None,
+        decode_tokens: Optional[float] = None,
+        goodput_tokens: Optional[float] = None,
+        slo_breached: Optional[bool] = None,
+        attainment: Optional[float] = None,
+    ) -> None:
+        """One capacity sample; cumulative feeds (``decode_tokens``,
+        ``goodput_tokens``) are differenced internally."""
+        idx = int(self._clock() // self.series.interval_s)
+        if self._start_idx is None:
+            self._start_idx = idx
+        if queue_depth is not None:
+            self.series.gauge("queue_depth", queue_depth)
+        if running is not None:
+            self.series.gauge("running", running)
+        if kv_blocks_in_use is not None:
+            self.series.gauge("kv_blocks_in_use", kv_blocks_in_use)
+            if kv_blocks_total:
+                self.series.gauge("kv_blocks_total", kv_blocks_total)
+                self.series.gauge(
+                    "kv_pressure", kv_blocks_in_use / kv_blocks_total)
+        if prefix_cache_blocks is not None:
+            self.series.gauge("prefix_cache_blocks", prefix_cache_blocks)
+        if decode_tokens is not None:
+            d = self._delta("decode_tokens", float(decode_tokens))
+            if d:
+                self.series.inc("tokens", d)
+        if self.goodput_enabled and goodput_tokens is not None:
+            d = self._delta("goodput_tokens", float(goodput_tokens))
+            if d:
+                self.series.inc("goodput_tokens", d)
+        if slo_breached is not None:
+            self.series.gauge("slo_breached", 1.0 if slo_breached else 0.0)
+        if attainment is not None:
+            self.series.gauge("attainment", attainment)
+        if self.sentinel is not None:
+            self.sentinel.poll()
+            d = self._delta("recompiles", float(self.sentinel.total))
+            if d:
+                self.series.inc("recompiles", d)
+            in_warmup = idx < self._start_idx + self.storm_warmup_intervals
+            now = (not in_warmup and
+                   (self.series.latest("recompiles") or 0.0)
+                   >= self.storm_threshold)
+            if now and not self.storm:
+                self.storms += 1
+            self.storm = now
+        if self.hbm_enabled and self._hbm_idx != idx:
+            self._hbm_idx = idx
+            self._sample_hbm()
+
+    def _sample_hbm(self) -> None:
+        try:
+            from colossalai_tpu.accelerator import get_accelerator
+
+            marks = get_accelerator().memory_watermarks()
+        except Exception:
+            marks = []
+        if not marks:
+            return  # backend has no memory stats — absent, not zero
+        in_use = float(sum(m.get("bytes_in_use", 0) for m in marks))
+        peak = float(sum(m.get("peak_bytes_in_use", 0) for m in marks))
+        self._hbm = {"devices": len(marks), "bytes_in_use": in_use,
+                     "peak_bytes_in_use": peak}
+        self.series.gauge("hbm_bytes_in_use", in_use)
+        self.series.gauge("hbm_peak_bytes", peak)
+
+    # -- derived signals ---------------------------------------------------
+
+    def busy_fraction(self) -> float:
+        return min(1.0, max(0.0, self.series.rate("busy_seconds")))
+
+    def tokens_per_s(self) -> float:
+        return self.series.rate("tokens")
+
+    def goodput_per_s(self) -> float:
+        return self.series.rate("goodput_tokens")
+
+    def tokens_per_chip_s(self) -> float:
+        return self.tokens_per_s() / self.chips
+
+    def goodput_per_chip_s(self) -> float:
+        return self.goodput_per_s() / self.chips
+
+    def kv_pressure(self) -> Optional[float]:
+        return self.series.latest("kv_pressure")
+
+    def breached(self) -> bool:
+        return bool(self.series.latest("slo_breached"))
+
+    def headroom_tokens_per_s(self) -> Optional[float]:
+        """Linear extrapolation: at the current tokens-per-busy-second
+        efficiency, how many *more* tokens/s fit before busy ≈ 1.0. None
+        while there is no throughput signal; 0 while the SLO window is
+        breached."""
+        if self.breached():
+            return 0.0
+        busy = self.busy_fraction()
+        tps = self.tokens_per_s()
+        if busy <= 1e-6 or tps <= 0.0:
+            return None
+        return max(0.0, tps / busy - tps)
+
+    def signal(self) -> ScalingSignal:
+        reasons: List[str] = []
+        busy = self.busy_fraction()
+        if self.breached():
+            reasons.append("slo_breach")
+        if busy >= self.saturation_busy:
+            reasons.append(
+                f"busy_fraction {busy:.2f} >= {self.saturation_busy:.2f}")
+        kvp = self.kv_pressure()
+        if kvp is not None and kvp >= self.kv_pressure_hi:
+            reasons.append(
+                f"kv_pressure {kvp:.2f} >= {self.kv_pressure_hi:.2f}")
+        if reasons:
+            if self.storm:
+                reasons.append("recompile_storm")
+            return ScalingSignal("scale_up", tuple(reasons))
+        if self.series.covered_s() < self.series.interval_s:
+            return ScalingSignal("hold", ("warming_up",))
+        if self.storm:
+            # a storm alone is a bug signal, not a load signal
+            return ScalingSignal("hold", ("recompile_storm",))
+        queue = self.series.latest("queue_depth")
+        if busy <= self.idle_busy and not queue:
+            return ScalingSignal("scale_down", (f"idle busy_fraction "
+                                                f"{busy:.2f}",))
+        return ScalingSignal("hold", ())
+
+    # -- export ------------------------------------------------------------
+
+    def brief(self) -> Dict[str, object]:
+        sig = self.signal()
+        return {
+            "busy_fraction": round(self.busy_fraction(), 4),
+            "tokens_per_chip_s": round(self.tokens_per_chip_s(), 3),
+            "goodput_per_chip_s": round(self.goodput_per_chip_s(), 3),
+            "kv_pressure": self.kv_pressure(),
+            "storm": self.storm,
+            "signal": sig.action,
+        }
+
+    def snapshot(self) -> Dict[str, object]:
+        headroom = self.headroom_tokens_per_s()
+        payload: Dict[str, object] = {
+            "chips": self.chips,
+            "interval_s": self.series.interval_s,
+            "window_s": self.series.window_s,
+            "utilization": {
+                "busy_fraction": round(self.busy_fraction(), 4),
+                "running": self.series.latest("running"),
+                "queue_depth": self.series.latest("queue_depth"),
+            },
+            "throughput": {
+                "tokens_per_s": round(self.tokens_per_s(), 3),
+                "tokens_per_chip_s": round(self.tokens_per_chip_s(), 3),
+                "goodput_per_s": round(self.goodput_per_s(), 3),
+                "goodput_per_chip_s": round(self.goodput_per_chip_s(), 3),
+            },
+            "kv": {
+                "pressure": self.kv_pressure(),
+                "blocks_in_use": self.series.latest("kv_blocks_in_use"),
+                "blocks_total": self.series.latest("kv_blocks_total"),
+                "prefix_cache_blocks":
+                    self.series.latest("prefix_cache_blocks"),
+            },
+            "hbm": self._hbm,
+            "headroom_tokens_per_s": headroom,
+            "slo_breached": self.breached(),
+            "signal": self.signal().as_dict(),
+            "series": self.series.snapshot(),
+        }
+        if self.sentinel is not None:
+            rec = self.sentinel.snapshot()
+            rec["storm"] = self.storm
+            rec["storms"] = self.storms
+            rec["storm_threshold"] = self.storm_threshold
+            payload["recompiles"] = rec
+        else:
+            payload["recompiles"] = None
+        return payload
+
+    def prom_counters(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        if self.sentinel is not None:
+            out["capacity_recompiles_total"] = float(self.sentinel.total)
+            out["capacity_recompile_storms_total"] = float(self.storms)
+        return out
+
+    def prom_gauges(self) -> Dict[str, float]:
+        out: Dict[str, float] = {
+            "capacity_busy_fraction": self.busy_fraction(),
+            "capacity_tokens_per_chip_s": self.tokens_per_chip_s(),
+            "capacity_chips": float(self.chips),
+            "capacity_storm": 1.0 if self.storm else 0.0,
+        }
+        if self.goodput_enabled:
+            out["capacity_goodput_per_chip_s"] = self.goodput_per_chip_s()
+        kvp = self.kv_pressure()
+        if kvp is not None:
+            out["capacity_kv_pressure"] = kvp
+        queue = self.series.latest("queue_depth")
+        if queue is not None:
+            out["capacity_queue_depth"] = queue
+        headroom = self.headroom_tokens_per_s()
+        if headroom is not None:
+            out["capacity_headroom_tokens_per_s"] = headroom
+        if self._hbm is not None:
+            out["capacity_hbm_bytes_in_use"] = self._hbm["bytes_in_use"]
+            out["capacity_hbm_peak_bytes"] = self._hbm["peak_bytes_in_use"]
+        return out
+
+    def reset(self) -> None:
+        self.series.reset()
+        self._last.clear()
+        self.storm = False
+        self.storms = 0
+        self._start_idx = None
+        self._hbm_idx = None
+        self._hbm = None
+        if self.sentinel is not None:
+            self.sentinel.reset()
+
+
+def merged_capacity_prom(
+    monitors,
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Fleet ``clt_capacity_*`` families, same names as a single engine's
+    exposition so dashboards read either: counters summed, per-chip rates
+    recomputed over the summed chip count (a mean of per-replica rates
+    would weight an idle replica equal to a loaded one), pressure gauges
+    worst-case."""
+    monitors = list(monitors)
+    counters: Dict[str, float] = {}
+    for m in monitors:
+        for k, v in m.prom_counters().items():
+            counters[k] = counters.get(k, 0.0) + v
+    if not monitors:
+        return counters, {}
+    chips = sum(m.chips for m in monitors)
+    tps = sum(m.tokens_per_s() for m in monitors)
+    gps = sum(m.goodput_per_s() for m in monitors)
+    gauges: Dict[str, float] = {
+        "capacity_chips": float(chips),
+        "capacity_busy_fraction": (
+            sum(m.busy_fraction() * m.chips for m in monitors) / chips
+            if chips else 0.0),
+        "capacity_tokens_per_chip_s": tps / chips if chips else 0.0,
+        "capacity_storm": 1.0 if any(m.storm for m in monitors) else 0.0,
+    }
+    if any(m.goodput_enabled for m in monitors):
+        gauges["capacity_goodput_per_chip_s"] = gps / chips if chips else 0.0
+    pressures = [p for p in (m.kv_pressure() for m in monitors)
+                 if p is not None]
+    if pressures:
+        gauges["capacity_kv_pressure"] = max(pressures)
+    queues = [q for q in (m.series.latest("queue_depth") for m in monitors)
+              if q is not None]
+    if queues:
+        gauges["capacity_queue_depth"] = float(sum(queues))
+    headrooms = [h for h in (m.headroom_tokens_per_s() for m in monitors)
+                 if h is not None]
+    if headrooms:
+        gauges["capacity_headroom_tokens_per_s"] = float(sum(headrooms))
+    hbm = [m._hbm for m in monitors if m._hbm is not None]
+    if hbm:
+        gauges["capacity_hbm_bytes_in_use"] = float(
+            sum(h["bytes_in_use"] for h in hbm))
+        gauges["capacity_hbm_peak_bytes"] = float(
+            sum(h["peak_bytes_in_use"] for h in hbm))
+    return counters, gauges
+
+
+def fleet_capacity(
+    monitors: Mapping[str, CapacityMonitor],
+) -> Dict[str, object]:
+    """Merge per-replica monitors into the fleet `/capacity` payload:
+    merged time series (same-geometry stores only), chip-weighted
+    utilization, summed throughput, worst-case pressure, and the combined
+    :class:`ScalingSignal`."""
+    replicas = {name: m.snapshot() for name, m in sorted(monitors.items())}
+    signals = {name: m.signal() for name, m in monitors.items()}
+    chips = sum(m.chips for m in monitors.values())
+    busy = (sum(m.busy_fraction() * m.chips for m in monitors.values())
+            / chips) if chips else 0.0
+    pressures = [p for p in (m.kv_pressure() for m in monitors.values())
+                 if p is not None]
+    merged_series: Optional[Dict[str, object]] = None
+    stores = [m.series for m in monitors.values()]
+    if stores and all(s.interval_s == stores[0].interval_s
+                      and s.n_intervals == stores[0].n_intervals
+                      for s in stores):
+        merged_series = TimeSeries.merged(stores).snapshot()
+    return {
+        "replicas": replicas,
+        "chips": chips,
+        "utilization": {"busy_fraction": round(busy, 4)},
+        "throughput": {
+            "tokens_per_s": round(
+                sum(m.tokens_per_s() for m in monitors.values()), 3),
+            "tokens_per_chip_s": round(
+                sum(m.tokens_per_s() for m in monitors.values())
+                / chips, 3) if chips else 0.0,
+            "goodput_per_s": round(
+                sum(m.goodput_per_s() for m in monitors.values()), 3),
+            "goodput_per_chip_s": round(
+                sum(m.goodput_per_s() for m in monitors.values())
+                / chips, 3) if chips else 0.0,
+        },
+        "kv_pressure_max": max(pressures) if pressures else None,
+        "storm": any(m.storm for m in monitors.values()),
+        "headroom_tokens_per_s": sum(
+            h for h in (m.headroom_tokens_per_s()
+                        for m in monitors.values()) if h is not None),
+        "signal": combine_signals(signals).as_dict(),
+        "merged_series": merged_series,
+    }

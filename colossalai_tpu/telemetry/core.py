@@ -276,4 +276,9 @@ def prometheus_exposition(
         full = f"{prefix}_{name}"
         lines.append(f"# TYPE {full} histogram")
         lines.extend(histograms[name].prometheus_lines(full))
+        # non-finite observations are dropped at observe(); surface the
+        # count as its own counter family so a NaN-producing regression
+        # is visible on the scrape, not silently discarded
+        lines.append(f"# TYPE {full}_dropped_total counter")
+        lines.append(f"{full}_dropped_total {histograms[name].dropped}")
     return "\n".join(lines) + "\n"

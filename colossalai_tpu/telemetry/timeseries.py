@@ -1,0 +1,289 @@
+"""Fixed-interval ring-buffer time series — the retained-history substrate
+for the capacity signal plane.
+
+The windowed histograms in :mod:`.slo` answer "what is the p99 *right
+now*"; they deliberately keep no trend. Capacity decisions (is this
+replica saturating? is goodput-per-chip falling while load rises?) need a
+short bounded *history* of scalar samples, so this module adds
+:class:`TimeSeries`: a dict of named series over one shared ring of
+``n_intervals`` slots of ``interval_s`` seconds each, with the same lazy
+slot advance as :class:`~.slo.WindowedHistogram` — each touch computes
+the current interval index from the clock and zeroes every slot skipped
+since the last touch, so an idle store costs nothing and stale samples
+can never resurface after a gap.
+
+Two series kinds:
+
+- **gauge** — per-interval mean + last value (``gauge(name, v)``); a slot
+  with no samples reads as ``None`` (absent), not zero.
+- **counter** — per-interval sums of deltas (``inc(name, d)``);
+  ``rate(name)`` divides the windowed sum by the seconds the window has
+  actually covered (not the full window while the store is young), which
+  is what makes tokens-per-second honest right after a reset.
+
+``merge()`` adds another store's slots elementwise (same geometry, same
+clock ⇒ same slot alignment) — the fleet view the router serves is just
+``TimeSeries.merged(per_replica_stores)``.
+
+Everything is host-side float arithmetic on plain lists; no device
+traffic, no locks (writers are the engine step loop; scrape-side readers
+already serialize under the server's scheduler lock).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["TimeSeries"]
+
+_KINDS = ("gauge", "counter")
+
+
+class _Series:
+    __slots__ = ("kind", "sum", "count", "last")
+
+    def __init__(self, kind: str, n: int):
+        self.kind = kind
+        self.sum = [0.0] * n
+        self.count = [0] * n
+        self.last = [0.0] * n
+
+    def clear_slot(self, i: int) -> None:
+        self.sum[i] = 0.0
+        self.count[i] = 0
+        self.last[i] = 0.0
+
+
+class TimeSeries:
+    """Bounded multi-series store: ``n_intervals`` slots × ``interval_s``
+    seconds, lazily advanced from a patchable clock."""
+
+    #: patchable clock seam (tests pin it to drive the window by hand);
+    #: shared with ``WindowedHistogram`` semantics, not its instance
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(self, interval_s: float = 10.0, n_intervals: int = 60):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s={interval_s} must be > 0")
+        if n_intervals < 1:
+            raise ValueError(f"n_intervals={n_intervals} must be >= 1")
+        self.interval_s = float(interval_s)
+        self.n_intervals = int(n_intervals)
+        self._series: Dict[str, _Series] = {}
+        self._idx: Optional[int] = None
+        #: first interval index ever touched — bounds rate coverage so a
+        #: young store doesn't dilute rates over slots it never lived
+        self._first_idx: Optional[int] = None
+
+    @property
+    def window_s(self) -> float:
+        return self.interval_s * self.n_intervals
+
+    # -- slot bookkeeping --------------------------------------------------
+
+    def _advance(self) -> int:
+        idx = int(self._clock() // self.interval_s)
+        if self._idx is None:
+            self._idx = idx
+            self._first_idx = idx
+        elif idx > self._idx:
+            for step in range(1, min(idx - self._idx, self.n_intervals) + 1):
+                slot = (self._idx + step) % self.n_intervals
+                for s in self._series.values():
+                    s.clear_slot(slot)
+            self._idx = idx
+        return self._idx
+
+    def _get(self, name: str, kind: str) -> _Series:
+        s = self._series.get(name)
+        if s is None:
+            s = self._series[name] = _Series(kind, self.n_intervals)
+        elif s.kind != kind:
+            raise ValueError(
+                f"series {name!r} is a {s.kind}, not a {kind}"
+            )
+        return s
+
+    # -- writers -----------------------------------------------------------
+
+    def gauge(self, name: str, value: float) -> None:
+        """Record one gauge sample into the current interval."""
+        v = float(value)
+        if not math.isfinite(v):
+            return
+        slot = self._advance() % self.n_intervals
+        s = self._get(name, "gauge")
+        s.sum[slot] += v
+        s.count[slot] += 1
+        s.last[slot] = v
+
+    def inc(self, name: str, delta: float = 1.0) -> None:
+        """Add a counter delta into the current interval."""
+        d = float(delta)
+        if not math.isfinite(d):
+            return
+        slot = self._advance() % self.n_intervals
+        s = self._get(name, "counter")
+        s.sum[slot] += d
+        s.count[slot] += 1
+        s.last[slot] = d
+
+    # -- readers -----------------------------------------------------------
+
+    def names(self) -> List[str]:
+        return sorted(self._series)
+
+    def kind(self, name: str) -> Optional[str]:
+        s = self._series.get(name)
+        return s.kind if s is not None else None
+
+    def latest(self, name: str) -> Optional[float]:
+        """Most recent sample in the current interval; for a counter, the
+        current interval's running sum. ``None`` when the current slot is
+        empty (and, for gauges, that means *no reading*, not zero)."""
+        s = self._series.get(name)
+        if s is None:
+            return None
+        slot = self._advance() % self.n_intervals
+        if s.count[slot] == 0:
+            return None
+        return s.last[slot] if s.kind == "gauge" else s.sum[slot]
+
+    def window_sum(self, name: str) -> float:
+        s = self._series.get(name)
+        if s is None:
+            return 0.0
+        self._advance()
+        return float(sum(s.sum))
+
+    def covered_s(self) -> float:
+        """Seconds of wall time the live window actually spans — the full
+        window once the store is older than it, else first-touch → now."""
+        if self._idx is None:
+            return 0.0
+        idx = self._advance()
+        lived = (idx - self._first_idx) * self.interval_s
+        lived += self._clock() - idx * self.interval_s  # partial slot
+        return min(self.window_s, max(lived, 0.0))
+
+    def rate(self, name: str) -> float:
+        """Windowed per-second rate for a counter series (0.0 when the
+        window has covered no time yet)."""
+        covered = self.covered_s()
+        if covered <= 0.0:
+            return 0.0
+        return self.window_sum(name) / covered
+
+    def mean(self, name: str) -> Optional[float]:
+        """Windowed mean of a gauge's samples (``None`` when empty)."""
+        s = self._series.get(name)
+        if s is None:
+            return None
+        self._advance()
+        n = sum(s.count)
+        return (sum(s.sum) / n) if n else None
+
+    def values(self, name: str) -> List[Optional[float]]:
+        """Per-interval values oldest → newest. Gauges render per-interval
+        means (``None`` for empty slots); counters render per-interval
+        sums (0.0 for empty slots — an idle counter *is* zero)."""
+        s = self._series.get(name)
+        if s is None:
+            return []
+        idx = self._advance()
+        out: List[Optional[float]] = []
+        for i in range(idx - self.n_intervals + 1, idx + 1):
+            slot = i % self.n_intervals
+            if s.kind == "gauge":
+                out.append(s.sum[slot] / s.count[slot] if s.count[slot] else None)
+            else:
+                out.append(s.sum[slot])
+        return out
+
+    # -- fleet merge -------------------------------------------------------
+
+    def merge(self, other: "TimeSeries") -> "TimeSeries":
+        """Add ``other``'s live slots into this store elementwise. Both
+        stores must share the same geometry; sharing the same clock means
+        interval indices align, so slot ``i`` means the same wall-clock
+        interval on both sides."""
+        if (other.interval_s != self.interval_s
+                or other.n_intervals != self.n_intervals):
+            raise ValueError(
+                f"geometry mismatch: {self.interval_s}x{self.n_intervals} "
+                f"vs {other.interval_s}x{other.n_intervals}"
+            )
+        self._advance()
+        other._advance()
+        if other._idx is None:
+            return self
+        if other._first_idx is not None:
+            self._first_idx = (other._first_idx
+                               if self._first_idx is None
+                               else min(self._first_idx, other._first_idx))
+        for name, src in other._series.items():
+            dst = self._get(name, src.kind)
+            for i in range(self.n_intervals):
+                dst.sum[i] += src.sum[i]
+                dst.count[i] += src.count[i]
+                if src.count[i]:
+                    dst.last[i] = src.last[i]
+        return self
+
+    @classmethod
+    def merged(cls, stores: Iterable["TimeSeries"]) -> "TimeSeries":
+        """Fold N same-geometry stores into a fresh fleet view."""
+        stores = list(stores)
+        if not stores:
+            return cls()
+        out = cls(interval_s=stores[0].interval_s,
+                  n_intervals=stores[0].n_intervals)
+        for s in stores:
+            out.merge(s)
+        return out
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-ready dump: geometry + every series' per-interval values,
+        latest reading, and (counters) windowed rate."""
+        self._advance()
+        series: Dict[str, object] = {}
+        for name in self.names():
+            s = self._series[name]
+            entry: Dict[str, object] = {
+                "kind": s.kind,
+                "values": self.values(name),
+                "latest": self.latest(name),
+            }
+            if s.kind == "counter":
+                entry["rate_per_s"] = round(self.rate(name), 6)
+            series[name] = entry
+        return {
+            "interval_s": self.interval_s,
+            "n_intervals": self.n_intervals,
+            "window_s": self.window_s,
+            "series": series,
+        }
+
+    def prom_gauges(self, prefix: str = "") -> Dict[str, float]:
+        """Flatten to Prometheus gauges: a gauge series exports its latest
+        reading under its own name; a counter exports its windowed rate as
+        ``<name>_per_s``. Empty gauges are skipped (absent ≠ zero)."""
+        out: Dict[str, float] = {}
+        for name in self.names():
+            s = self._series[name]
+            if s.kind == "counter":
+                out[f"{prefix}{name}_per_s"] = self.rate(name)
+            else:
+                latest = self.latest(name)
+                if latest is not None:
+                    out[f"{prefix}{name}"] = latest
+        return out
+
+    def reset(self) -> None:
+        self._series.clear()
+        self._idx = None
+        self._first_idx = None

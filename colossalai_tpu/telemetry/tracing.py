@@ -51,6 +51,17 @@ from .core import EventLog
 #: (tests/test_core/test_metric_names.py lints every emitted name)
 SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
 
+#: the full span-name catalog any component may emit — the single source
+#: the name lint, ``tools/check_metric_catalog.py``, and the span table
+#: in docs/observability.md are all checked against; extend all three
+#: together or none
+SPAN_CATALOG = frozenset({
+    "request", "queue", "prefill", "prefill_chunk", "prefill_stall",
+    "first_token", "decode_megastep", "spec_megastep", "prefix_cache_hit",
+    "prefix_cache_evict", "page_refund", "router.place", "router.sync",
+    "shed", "preempt", "resume", "kv_transfer",
+})
+
 
 @dataclasses.dataclass
 class Span:

@@ -1,0 +1,70 @@
+"""docs/observability.md ↔ code catalog cross-check (PR 13).
+
+``tools/check_metric_catalog.py`` renders every Prometheus catalog the
+code can emit and diffs it against the metric names and span table in
+the docs. This test runs the same checks in tier 1 so catalog drift
+fails CI, and mutation-tests the checker itself so a silently-broken
+parser can't report a vacuous pass.
+"""
+
+import importlib.util
+from pathlib import Path
+
+import pytest
+
+_TOOL = Path(__file__).resolve().parents[2] / "tools" / "check_metric_catalog.py"
+
+
+@pytest.fixture(scope="module")
+def checker():
+    spec = importlib.util.spec_from_file_location("check_metric_catalog",
+                                                  _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_docs_and_code_catalogs_in_sync(checker):
+    failures = checker.run_checks()
+    assert not failures, "\n".join(failures)
+
+
+def test_checker_catches_undocumented_capacity_family(checker):
+    text = checker.DOC.read_text()
+    mutated = "\n".join(ln for ln in text.splitlines()
+                        if "clt_capacity_storm`" not in ln)
+    assert mutated != text  # the row really exists to remove
+    failures = checker.run_checks(mutated)
+    assert any("clt_capacity_storm" in f for f in failures)
+
+
+def test_checker_catches_phantom_doc_metric(checker):
+    text = checker.DOC.read_text() + "\nSee `clt_capacity_bogus_gauge`.\n"
+    failures = checker.run_checks(text)
+    assert any("clt_capacity_bogus_gauge" in f for f in failures)
+
+
+def test_checker_catches_span_table_drift(checker):
+    text = checker.DOC.read_text().replace(
+        "| `shed`, `preempt`, `resume` |", "| `preempt`, `resume` |")
+    failures = checker.run_checks(text)
+    assert any("'shed'" in f for f in failures)
+
+
+def test_capacity_catalog_documented_names(checker):
+    """The full forced-on capacity family — pinned here so a renamed
+    gauge shows up as an explicit diff, not just a checker failure."""
+    assert checker.capacity_families() == {
+        "clt_capacity_busy_fraction",
+        "clt_capacity_tokens_per_chip_s",
+        "clt_capacity_goodput_per_chip_s",
+        "clt_capacity_chips",
+        "clt_capacity_storm",
+        "clt_capacity_kv_pressure",
+        "clt_capacity_queue_depth",
+        "clt_capacity_headroom_tokens_per_s",
+        "clt_capacity_hbm_bytes_in_use",
+        "clt_capacity_hbm_peak_bytes",
+        "clt_capacity_recompiles_total",
+        "clt_capacity_recompile_storms_total",
+    }

@@ -10,6 +10,7 @@ from colossalai_tpu.inference.engine import EngineStats
 from colossalai_tpu.inference.telemetry import _HISTOGRAM_SPECS, Telemetry
 from colossalai_tpu.telemetry import (
     METRIC_NAME_RE,
+    CapacityMonitor,
     SLOTracker,
     TrainMonitor,
     prometheus_exposition,
@@ -95,6 +96,21 @@ def _slo_names():
     )
 
 
+def _capacity_names():
+    """The ``clt_capacity_*`` catalog with every conditional gauge
+    (goodput, KV, queue, headroom, HBM) forced on, rendered as ``GET
+    /metrics`` renders it."""
+    m = CapacityMonitor(chips=1, hbm=False)
+    m.sample(queue_depth=1, running=1, kv_blocks_in_use=1,
+             kv_blocks_total=4, decode_tokens=0.0, goodput_tokens=0.0,
+             slo_breached=False)
+    m.on_megastep(0.01)
+    m.sample(decode_tokens=8.0, goodput_tokens=8.0)
+    m._hbm = {"devices": 1, "bytes_in_use": 1.0, "peak_bytes_in_use": 2.0}
+    return _family_names(prometheus_exposition(
+        m.prom_counters(), m.prom_gauges(), {}, prefix="clt"))
+
+
 def test_serving_names_match_grammar():
     names = _serving_names()
     assert names  # the catalog is non-empty
@@ -146,6 +162,41 @@ def test_slo_names_match_grammar_and_collide_with_nothing():
             "clt_slo_ttft_p99_target_seconds"} <= names
     assert not names & _serving_names()
     assert not names & _training_names()
+
+
+def test_capacity_names_match_grammar_and_collide_with_nothing():
+    names = _capacity_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+        assert name.startswith("clt_capacity_"), name
+    assert {"clt_capacity_busy_fraction", "clt_capacity_tokens_per_chip_s",
+            "clt_capacity_goodput_per_chip_s", "clt_capacity_chips",
+            "clt_capacity_kv_pressure", "clt_capacity_queue_depth",
+            "clt_capacity_headroom_tokens_per_s", "clt_capacity_storm",
+            "clt_capacity_hbm_bytes_in_use", "clt_capacity_hbm_peak_bytes",
+            "clt_capacity_recompiles_total",
+            "clt_capacity_recompile_storms_total"} <= names
+    assert not names & _serving_names()
+    assert not names & _training_names()
+    assert not names & _slo_names()
+
+
+def test_every_histogram_family_exports_dropped_total():
+    """``Histogram.dropped`` (non-finite refusals) renders as a
+    ``<family>_dropped_total`` counter family of its own — for every
+    serving histogram, with a grammar-clean name."""
+    tele = Telemetry()
+    text = prometheus_exposition({}, {}, tele.histograms, prefix="clt")
+    names = _family_names(text)
+    for h in _HISTOGRAM_SPECS:
+        family = f"clt_{h}_dropped_total"
+        assert family in names, family
+        assert METRIC_NAME_RE.match(family), family
+        assert f"# TYPE {family} counter" in text, family
+    # a refused sample really shows up in the counter
+    tele.histograms["ttft_seconds"].observe(math.nan)
+    text = prometheus_exposition({}, {}, tele.histograms, prefix="clt")
+    assert "clt_ttft_seconds_dropped_total 1" in text
 
 
 def test_router_metrics_carry_merged_slo_families():
@@ -203,12 +254,17 @@ def test_span_names_match_grammar_over_engine_smoke():
     for name in names:
         assert SPAN_NAME_RE.match(name), name
     # the documented catalog (docs/observability.md) — extend both or
-    # neither
+    # neither; SPAN_CATALOG is the code-side source of truth the
+    # catalog checker (tools/check_metric_catalog.py) lints the docs
+    # against, so this literal, the frozenset, and the docs must agree
+    from colossalai_tpu.telemetry import SPAN_CATALOG
+
     catalog = {"request", "queue", "prefill", "prefill_chunk",
                "prefill_stall", "first_token", "decode_megastep",
                "spec_megastep", "prefix_cache_hit", "prefix_cache_evict",
                "page_refund", "router.place", "router.sync",
                "shed", "preempt", "resume", "kv_transfer"}
+    assert catalog == set(SPAN_CATALOG)
     assert names <= catalog, names - catalog
 
 

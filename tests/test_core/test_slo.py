@@ -87,6 +87,27 @@ def test_windowed_lazy_advance_resets_skipped_slots(clock):
     assert w.count == 1 and w.percentile(50) == 2.0
 
 
+def test_windowed_gap_of_exact_window_multiples_cannot_alias(clock):
+    """The nasty wraparound case: an idle gap that is an EXACT multiple
+    of the window makes ``idx % n`` re-land on the very slots the old
+    samples live in — the advance must still zero them (it clamps the
+    skip count at n_intervals), never resurface them."""
+    w = WindowedHistogram(BOUNDS, interval_s=10.0, n_intervals=6)
+    for _ in range(6):
+        w.observe(100.0)  # a slow regime filling every slot
+        clock["t"] += 10.0
+    clock["t"] += w.window_s * 4 - 10.0  # land exactly on the same slots
+    assert w.count == 0
+    w.observe(0.001)
+    # only the new sample exists: the old 100s regime is gone even though
+    # the new sample shares a physical slot with an expired one
+    assert w.count == 1
+    assert w.percentile(99) == pytest.approx(0.001, rel=0.2)
+    # and another exact-window hop later the ring is empty again
+    clock["t"] += w.window_s
+    assert w.count == 0
+
+
 def test_windowed_validation_and_reset(clock):
     with pytest.raises(ValueError):
         WindowedHistogram(BOUNDS, interval_s=0.0)

@@ -1,0 +1,432 @@
+"""Capacity signal plane units (PR 13): TimeSeries ring semantics and the
+CapacityMonitor's derived signals, all under a pinned clock.
+
+The load-bearing properties:
+
+- the lazy slot advance zeroes every skipped slot, so an idle gap longer
+  than the whole window can never resurface stale samples (the same
+  wraparound contract WindowedHistogram carries in test_slo.py);
+- counter rates divide by *covered* seconds, so a freshly reset store
+  reports honest tokens/s immediately instead of diluting over slots it
+  never lived;
+- the ScalingSignal ordering: hold (warming_up) -> scale_down when idle
+  -> hold -> scale_up on breach/saturation/KV pressure, with the storm
+  flag as a bug annotation, not a load signal.
+"""
+
+import math
+
+import pytest
+
+from colossalai_tpu.telemetry import (
+    CapacityMonitor,
+    RecompileSentinel,
+    ScalingSignal,
+    TimeSeries,
+    combine_signals,
+    fleet_capacity,
+    merged_capacity_prom,
+)
+from colossalai_tpu.telemetry import capacity as capacity_mod
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """Pin both clocks so tests drive the window by hand."""
+    state = {"t": 1_000_000.0}
+    monkeypatch.setattr(
+        TimeSeries, "_clock", staticmethod(lambda: state["t"]))
+    monkeypatch.setattr(
+        CapacityMonitor, "_clock", staticmethod(lambda: state["t"]))
+    return state
+
+
+def _monitor(clock, **kw):
+    """A CapacityMonitor with every environment dependency pinned off:
+    no sentinel (unless the test provides one), no HBM probe, explicit
+    chip count."""
+    kw.setdefault("interval_s", 10.0)
+    kw.setdefault("n_intervals", 6)
+    kw.setdefault("chips", 1)
+    kw.setdefault("sentinel", False)
+    kw.setdefault("hbm", False)
+    return CapacityMonitor(**kw)
+
+
+def _offline_sentinel(monkeypatch):
+    """A sentinel with the jax.monitoring listener forced unavailable, so
+    compiles from *other* tests in this process can never leak into it;
+    tests feed it by hand through the fallback accounting."""
+    monkeypatch.setattr(capacity_mod, "_LISTENER_AVAILABLE", False)
+    s = RecompileSentinel()
+    assert s.listener is False
+    return s
+
+
+# ------------------------------------------------------------- TimeSeries
+def test_gauge_and_counter_basics(clock):
+    ts = TimeSeries(interval_s=10.0, n_intervals=6)
+    ts.gauge("depth", 3.0)
+    ts.gauge("depth", 5.0)
+    ts.inc("tokens", 40.0)
+    ts.inc("tokens", 20.0)
+    assert ts.kind("depth") == "gauge" and ts.kind("tokens") == "counter"
+    assert ts.latest("depth") == 5.0          # gauge: last sample
+    assert ts.latest("tokens") == 60.0        # counter: running slot sum
+    assert ts.mean("depth") == 4.0
+    assert ts.window_sum("tokens") == 60.0
+    assert ts.latest("missing") is None and ts.kind("missing") is None
+    assert ts.names() == ["depth", "tokens"]
+
+
+def test_kind_conflict_and_validation(clock):
+    ts = TimeSeries(interval_s=10.0, n_intervals=6)
+    ts.gauge("x", 1.0)
+    with pytest.raises(ValueError, match="gauge"):
+        ts.inc("x", 1.0)
+    with pytest.raises(ValueError):
+        TimeSeries(interval_s=0.0)
+    with pytest.raises(ValueError):
+        TimeSeries(n_intervals=0)
+
+
+def test_non_finite_samples_dropped(clock):
+    ts = TimeSeries(interval_s=10.0, n_intervals=6)
+    ts.gauge("g", float("nan"))
+    ts.inc("c", float("inf"))
+    assert ts.names() == []  # never even created the series
+
+
+def test_rate_uses_covered_not_full_window(clock):
+    """A store 10s old that saw 100 tokens reports 10 tok/s, not
+    100/window — the young-store honesty that makes post-reset rates
+    usable immediately."""
+    ts = TimeSeries(interval_s=10.0, n_intervals=6)
+    ts.inc("tokens", 50.0)
+    clock["t"] += 10.0
+    ts.inc("tokens", 50.0)
+    assert ts.covered_s() == pytest.approx(10.0)
+    assert ts.rate("tokens") == pytest.approx(10.0)
+    # once older than the window, coverage caps at window_s
+    clock["t"] += 1000.0
+    ts.inc("tokens", 0.0)
+    assert ts.covered_s() == pytest.approx(ts.window_s)
+
+
+def test_idle_gap_longer_than_window_zeroes_everything(clock):
+    """THE wraparound contract: after an idle gap of more than the full
+    window, no stale sample may resurface — `idx % n` re-lands on old
+    slots and they must read as empty/zero, not as the old data."""
+    ts = TimeSeries(interval_s=10.0, n_intervals=6)
+    for i in range(6):  # fill every slot
+        ts.inc("tokens", 100.0)
+        ts.gauge("depth", float(i + 1))
+        if i < 5:
+            clock["t"] += 10.0
+    assert ts.window_sum("tokens") == 600.0
+    clock["t"] += 10.0 * 6 * 3 + 5.0  # idle three full windows
+    assert ts.window_sum("tokens") == 0.0
+    assert ts.latest("depth") is None
+    assert ts.rate("tokens") == 0.0
+    assert all(v == 0.0 for v in ts.values("tokens"))
+    assert all(v is None for v in ts.values("depth"))
+    # and the store still works after the gap
+    ts.inc("tokens", 30.0)
+    assert ts.window_sum("tokens") == 30.0
+
+
+def test_values_oldest_to_newest(clock):
+    ts = TimeSeries(interval_s=10.0, n_intervals=3)
+    ts.inc("c", 1.0)
+    clock["t"] += 10.0
+    ts.inc("c", 2.0)
+    ts.gauge("g", 7.0)
+    clock["t"] += 10.0
+    ts.inc("c", 3.0)
+    assert ts.values("c") == [1.0, 2.0, 3.0]
+    assert ts.values("g") == [None, 7.0, None]  # empty gauge slot is absent
+
+
+def test_merge_and_merged(clock):
+    a = TimeSeries(interval_s=10.0, n_intervals=6)
+    b = TimeSeries(interval_s=10.0, n_intervals=6)
+    a.inc("tokens", 100.0)
+    b.inc("tokens", 50.0)
+    a.gauge("depth", 2.0)
+    b.gauge("depth", 4.0)
+    clock["t"] += 10.0
+    a.inc("tokens", 10.0)
+    fleet = TimeSeries.merged([a, b])
+    assert fleet.window_sum("tokens") == 160.0
+    assert fleet.mean("depth") == 3.0  # per-sample mean across stores
+    # same clock => same covered window => fleet rate is the summed rate
+    assert fleet.rate("tokens") == pytest.approx(a.rate("tokens")
+                                                 + b.rate("tokens"))
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(TimeSeries(interval_s=5.0, n_intervals=6))
+
+
+def test_snapshot_and_prom_gauges(clock):
+    ts = TimeSeries(interval_s=10.0, n_intervals=3)
+    ts.inc("tokens", 30.0)
+    ts.gauge("depth", 2.0)
+    clock["t"] += 10.0
+    ts.inc("tokens", 10.0)
+    snap = ts.snapshot()
+    assert snap["window_s"] == 30.0
+    assert snap["series"]["tokens"]["kind"] == "counter"
+    assert snap["series"]["tokens"]["values"] == [0.0, 30.0, 10.0]
+    assert snap["series"]["tokens"]["rate_per_s"] == pytest.approx(4.0)
+    assert snap["series"]["depth"]["latest"] is None
+    prom = ts.prom_gauges(prefix="cap_")
+    assert prom["cap_tokens_per_s"] == pytest.approx(4.0)
+    assert "cap_depth" not in prom  # empty current slot => absent, not 0
+    ts.gauge("depth", 9.0)
+    assert ts.prom_gauges()["depth"] == 9.0
+
+
+def test_reset(clock):
+    ts = TimeSeries(interval_s=10.0, n_intervals=3)
+    ts.inc("tokens", 5.0)
+    ts.reset()
+    assert ts.names() == [] and ts.covered_s() == 0.0
+
+
+# -------------------------------------------------------- CapacityMonitor
+def test_busy_fraction_and_throughput(clock):
+    m = _monitor(clock, chips=2)
+    m.sample(decode_tokens=0.0)      # baseline the cumulative feed
+    m.on_megastep(5.0)
+    clock["t"] += 10.0
+    m.sample(decode_tokens=200.0)
+    assert m.busy_fraction() == pytest.approx(0.5)
+    assert m.tokens_per_s() == pytest.approx(20.0)
+    assert m.tokens_per_chip_s() == pytest.approx(10.0)
+    # headroom: linear extrapolation to busy == 1.0
+    assert m.headroom_tokens_per_s() == pytest.approx(20.0)
+
+
+def test_first_sample_baselines_without_counting(clock):
+    """A monitor attached to a warm engine must not dump the engine's
+    whole token history into one slot."""
+    m = _monitor(clock)
+    m.sample(decode_tokens=1_000_000.0, goodput_tokens=900_000.0)
+    assert m.tokens_per_s() == 0.0 and m.goodput_per_s() == 0.0
+    clock["t"] += 10.0
+    m.sample(decode_tokens=1_000_100.0, goodput_tokens=900_050.0)
+    assert m.series.window_sum("tokens") == 100.0
+    assert m.series.window_sum("goodput_tokens") == 50.0
+
+
+def test_headroom_edge_cases(clock):
+    m = _monitor(clock)
+    assert m.headroom_tokens_per_s() is None  # no throughput signal yet
+    m.sample(decode_tokens=0.0, slo_breached=True)
+    assert m.headroom_tokens_per_s() == 0.0   # breached => no headroom
+
+
+def test_kv_pressure_and_breach(clock):
+    m = _monitor(clock)
+    m.sample(kv_blocks_in_use=45, kv_blocks_total=50, slo_breached=False)
+    assert m.kv_pressure() == pytest.approx(0.9)
+    assert m.breached() is False
+    m.sample(slo_breached=True)
+    assert m.breached() is True
+
+
+def test_signal_ordering(clock):
+    """warming_up hold -> idle scale_down -> hold -> scale_up, in the
+    order the engine would traverse them as load ramps."""
+    m = _monitor(clock)
+    m.sample(queue_depth=0)
+    sig = m.signal()
+    assert sig.action == "hold" and "warming_up" in sig.reasons
+
+    clock["t"] += 20.0  # window now covers >= one interval
+    m.sample(queue_depth=0)
+    assert m.signal().action == "scale_down"  # idle, nothing queued
+
+    m.sample(queue_depth=3)  # queued work vetoes scale_down
+    assert m.signal().action == "hold"
+
+    for _ in range(18):  # 18 busy seconds over 20 covered => 0.9
+        m.on_megastep(1.0)
+    assert m.busy_fraction() >= m.saturation_busy
+    sig = m.signal()
+    assert sig.action == "scale_up"
+    assert any("busy_fraction" in r for r in sig.reasons)
+
+
+def test_signal_scale_up_on_breach_and_kv(clock):
+    m = _monitor(clock)
+    clock["t"] += 20.0
+    m.sample(slo_breached=True)
+    assert m.signal().action == "scale_up"
+    assert "slo_breach" in m.signal().reasons
+
+    m2 = _monitor(clock)
+    clock["t"] += 20.0
+    m2.sample(kv_blocks_in_use=95, kv_blocks_total=100)
+    sig = m2.signal()
+    assert sig.action == "scale_up"
+    assert any("kv_pressure" in r for r in sig.reasons)
+
+
+def test_recompile_storm_rising_edge(clock, monkeypatch):
+    """A burst of compiles past the threshold AFTER warmup raises the
+    storm flag exactly once per edge; the flag clears when the current
+    interval stops compiling."""
+    s = _offline_sentinel(monkeypatch)
+    m = _monitor(clock, sentinel=s, storm_threshold=4,
+                 storm_warmup_intervals=1)
+    # warmup interval: a compile burst here (bucket warmup) is NOT a storm
+    s._on_compile_phase("prefill", 6)
+    m.sample()
+    assert m.storm is False and m.storms == 0
+
+    clock["t"] += 10.0  # past warmup
+    s._on_compile_phase("decode", 5)
+    m.sample()
+    assert m.storm is True and m.storms == 1
+    m.sample()  # still storming, same edge
+    assert m.storms == 1
+
+    clock["t"] += 10.0  # compiles stop -> flag clears
+    m.sample()
+    assert m.storm is False and m.storms == 1
+    # storm alone is a bug signal, not a load signal
+    s._on_compile_phase("decode", 5)
+    m.sample()
+    assert m.storm is True
+    sig = m.signal()
+    assert sig.action == "hold" and "recompile_storm" in sig.reasons
+
+
+def test_sentinel_phase_attribution_fallback(monkeypatch):
+    """Fallback path: cache-size growth on watched jit functions lands in
+    the declared phase; growth is differenced, not re-counted."""
+    s = _offline_sentinel(monkeypatch)
+
+    class FakeJit:
+        def __init__(self):
+            self.n = 1
+
+        def _cache_size(self):
+            return self.n
+
+    f = FakeJit()
+    s.watch(f, "decode")
+    s.poll()
+    assert s.total == 0  # baseline, nothing new
+    f.n = 3
+    s.poll()
+    s.poll()  # second poll sees no further growth
+    assert s.total == 2 and s.by_phase == {"decode": 2}
+    with s.phase("prefill"):
+        assert s._active_phase() == "prefill"
+        s._on_compile()
+    assert s.by_phase["prefill"] == 1
+    assert s._active_phase() is None
+    snap = s.snapshot()
+    assert snap["total"] == 3 and snap["listener"] is False
+    s.reset()
+    assert s.total == 0 and s.by_phase == {}
+    f.n = 5  # reset re-baselines the watched cache sizes
+    s.poll()
+    assert s.total == 2
+
+
+def test_combine_signals():
+    up = ScalingSignal("scale_up", ("slo_breach",))
+    down = ScalingSignal("scale_down", ("idle",))
+    hold = ScalingSignal("hold", ())
+    assert combine_signals({}).action == "hold"
+    sig = combine_signals({"r0": hold, "r1": up})
+    assert sig.action == "scale_up" and sig.reasons == ("r1: slo_breach",)
+    assert combine_signals({"a": down, "b": down}).action == "scale_down"
+    assert combine_signals({"a": down, "b": hold}).action == "hold"
+    assert up.as_dict() == {"action": "scale_up", "reasons": ["slo_breach"]}
+
+
+def test_fleet_capacity_merges(clock):
+    a = _monitor(clock, chips=1)
+    b = _monitor(clock, chips=3)
+    for m in (a, b):
+        m.sample(decode_tokens=0.0)
+    a.on_megastep(8.0)   # a saturates
+    b.on_megastep(1.0)
+    clock["t"] += 10.0
+    a.sample(decode_tokens=100.0, queue_depth=4,
+             kv_blocks_in_use=9, kv_blocks_total=10)
+    b.sample(decode_tokens=300.0, queue_depth=0,
+             kv_blocks_in_use=1, kv_blocks_total=10)
+    fleet = fleet_capacity({"r0": a, "r1": b})
+    assert fleet["chips"] == 4
+    assert set(fleet["replicas"]) == {"r0", "r1"}
+    # chip-weighted busy: (0.8*1 + 0.1*3) / 4
+    assert fleet["utilization"]["busy_fraction"] == pytest.approx(0.275)
+    assert fleet["throughput"]["tokens_per_s"] == pytest.approx(40.0)
+    assert fleet["throughput"]["tokens_per_chip_s"] == pytest.approx(10.0)
+    assert fleet["kv_pressure_max"] == pytest.approx(0.9)
+    assert fleet["signal"]["action"] == "scale_up"  # r0's kv pressure wins
+    assert any(r.startswith("r0:") for r in fleet["signal"]["reasons"])
+    merged = fleet["merged_series"]
+    assert merged["series"]["tokens"]["rate_per_s"] == pytest.approx(40.0)
+
+
+def test_merged_capacity_prom(clock, monkeypatch):
+    s = _offline_sentinel(monkeypatch)
+    a = _monitor(clock, chips=1, sentinel=s)
+    b = _monitor(clock, chips=1)
+    for m in (a, b):
+        # queue_depth touches the series at the baseline sample, so both
+        # stores' covered window starts here, not at the first delta
+        m.sample(decode_tokens=0.0, queue_depth=0)
+    a.on_megastep(6.0)
+    s._on_compile_phase("decode", 3)
+    clock["t"] += 10.0
+    a.sample(decode_tokens=100.0, queue_depth=2)
+    b.sample(decode_tokens=100.0, queue_depth=1)
+    counters, gauges = merged_capacity_prom([a, b])
+    assert counters["capacity_recompiles_total"] == 3.0
+    assert gauges["capacity_chips"] == 2.0
+    assert gauges["capacity_busy_fraction"] == pytest.approx(0.3)
+    assert gauges["capacity_tokens_per_chip_s"] == pytest.approx(10.0)
+    assert gauges["capacity_queue_depth"] == 3.0
+    assert all(k.startswith("capacity_") for k in {**counters, **gauges})
+
+
+def test_snapshot_shape(clock):
+    m = _monitor(clock)
+    m.sample(decode_tokens=0.0, queue_depth=1, running=2,
+             kv_blocks_in_use=3, kv_blocks_total=10, attainment=0.99)
+    snap = m.snapshot()
+    for key in ("chips", "utilization", "throughput", "kv", "hbm",
+                "headroom_tokens_per_s", "slo_breached", "signal",
+                "series", "recompiles"):
+        assert key in snap
+    assert snap["recompiles"] is None  # sentinel disabled in _monitor
+    assert snap["kv"]["blocks_in_use"] == 3.0
+    assert snap["utilization"]["queue_depth"] == 1.0
+    assert snap["signal"]["action"] in ("hold", "scale_up", "scale_down")
+    # JSON-clean
+    import json
+    json.dumps(snap)
+
+
+def test_monitor_reset(clock, monkeypatch):
+    s = _offline_sentinel(monkeypatch)
+    m = _monitor(clock, sentinel=s)
+    m.sample(decode_tokens=0.0)
+    m.on_megastep(2.0)
+    clock["t"] += 10.0
+    s._on_compile_phase("decode", 9)
+    m.sample(decode_tokens=50.0)
+    assert m.tokens_per_s() > 0
+    m.reset()
+    assert m.tokens_per_s() == 0.0 and m.busy_fraction() == 0.0
+    assert m.storm is False and m.storms == 0
+    assert s.total == 0
+    # post-reset: first sample re-baselines, no history dump
+    m.sample(decode_tokens=75.0)
+    assert m.series.window_sum("tokens") == 0.0

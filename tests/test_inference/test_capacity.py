@@ -1,0 +1,308 @@
+"""Capacity signal plane on the serving path (PR 13).
+
+Contracts under test:
+
+- the zero-overhead gate: the decode path's transfer counters are
+  BYTE-IDENTICAL with the capacity monitor on vs off — every capacity
+  feed is a host-side float the engine already holds, so observation
+  moves no device data;
+- the recompile sentinel: warming a fresh shape bucket increments the
+  compile count under the phase that dispatched it, and steady-state
+  decode after warmup compiles NOTHING (the shape-bucket plan holds);
+- the HTTP surface: ``GET /capacity`` serves the engine snapshot on a
+  single-engine server and the merged fleet view (per-replica snapshots
+  + combined ScalingSignal) on the router; /health carries the compact
+  brief; /metrics gains the ``clt_capacity_*`` families and the
+  ``_dropped_total`` companions;
+- disaggregated serving reports per-role (prefill/decode) capacity.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from colossalai_tpu.inference import (
+    CapacityMonitor,
+    DisaggEngine,
+    GenerationConfig,
+    LLMEngine,
+    Router,
+    SLOTracker,
+    make_router_server,
+    make_server,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("prefill_buckets", (16, 32, 64))
+    return LLMEngine(params, cfg, **kw)
+
+
+GEN = GenerationConfig(max_new_tokens=6)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.status, r.read().decode()
+
+
+# ------------------------------------------- device-traffic non-regression
+def test_transfer_counters_identical_with_capacity_on_and_off(parts):
+    """THE acceptance gate: monitoring utilization must not change what
+    the engine sends to or reads from the device."""
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8]]
+    results = {}
+    for mode in ("off", "on"):
+        eng = _engine(parts, megastep_k=2,
+                      capacity=(True if mode == "on" else None))
+        outs = eng.generate([list(p) for p in prompts], GEN)
+        results[mode] = (outs, eng.stats)
+    outs_off, st_off = results["off"]
+    outs_on, st_on = results["on"]
+    assert outs_off == outs_on
+    assert st_on.decode_syncs == st_off.decode_syncs
+    assert st_on.decode_h2d_scalars == st_off.decode_h2d_scalars
+    assert st_on.decode_d2h_elements == st_off.decode_d2h_elements
+    assert st_on.decode_megasteps == st_off.decode_megasteps
+
+
+# ---------------------------------------------------------------- defaults
+def test_capacity_off_by_default(parts):
+    eng = _engine(parts)
+    assert eng.capacity is None
+    assert eng.capacity_snapshot() is None
+    assert eng.capacity_monitors() == {}
+
+
+def test_engine_feeds_monitor(parts):
+    slo = SLOTracker(targets={"ttft_p99": 60.0}, window_s=600.0)
+    eng = _engine(parts, capacity=True, prefix_cache=True, slo=slo)
+    eng.generate([[1, 2, 3], [9, 8, 7, 6]], GEN)
+    cap = eng.capacity
+    assert eng.capacity_monitors() == {"engine": cap}
+    # megastep wall time and decode-token deltas landed in the series
+    assert cap.series.window_sum("busy_seconds") > 0.0
+    assert cap.series.window_sum("tokens") > 0.0
+    assert cap.busy_fraction() > 0.0
+    snap = eng.capacity_snapshot()
+    assert snap["kv"]["blocks_total"] > 0
+    assert snap["utilization"]["queue_depth"] == 0.0  # drained
+    assert snap["signal"]["action"] in ("hold", "scale_up", "scale_down")
+    json.dumps(snap)  # the /capacity body must be JSON-clean
+
+
+def test_custom_monitor_accepted(parts):
+    mon = CapacityMonitor(interval_s=0.25, n_intervals=8, sentinel=False)
+    eng = _engine(parts, capacity=mon)
+    assert eng.capacity is mon
+    eng.generate([[1, 2, 3]], GEN)
+    assert mon.series.window_sum("busy_seconds") > 0.0
+
+
+# -------------------------------------------------------- recompile sentinel
+def test_recompile_sentinel_buckets_and_steady_state(parts):
+    """One engine geometry nothing else in this process uses, so the jit
+    caches are cold: warmup compiles with phase attribution, steady-state
+    decode compiles nothing, and a fresh prefill bucket compiles under
+    the prefill phase only."""
+    kw = dict(max_batch_size=3, max_seq_len=96, block_size=8,
+              prefill_buckets=(24, 48), megastep_k=3, capacity=True)
+    eng = _engine(parts, **kw)
+    sent = eng.capacity.sentinel
+
+    eng.generate([[1, 2, 3, 4, 5]], GEN)  # warm: bucket 24 + decode
+    warm = sent.snapshot()
+    assert warm["total"] > 0
+    assert warm["by_phase"].get("prefill", 0) >= 1
+    assert warm["by_phase"].get("decode", 0) >= 1
+
+    # steady state: same prompt bucket, same batch => ZERO new compiles
+    eng.generate([[11, 12, 13]], GEN)
+    steady = sent.snapshot()
+    assert steady["total"] == warm["total"], (warm, steady)
+
+    # fresh shape bucket (prompt pads to 48): prefill compiles, decode
+    # does not — the megastep shapes are bucket-independent
+    eng.generate([list(range(1, 31))], GEN)
+    fresh = sent.snapshot()
+    assert fresh["by_phase"]["prefill"] > steady["by_phase"]["prefill"]
+    assert fresh["by_phase"].get("decode") == steady["by_phase"].get("decode")
+
+    # and the monitor's recompile series picked the deltas up
+    assert eng.capacity.series.window_sum("recompiles") > 0
+    snap = eng.capacity.snapshot()
+    assert snap["recompiles"]["total"] == fresh["total"]
+
+
+# ----------------------------------------------------------- HTTP endpoints
+@pytest.fixture()
+def served(parts):
+    slo = SLOTracker(targets={"ttft_p99": 60.0}, window_s=600.0)
+    eng = _engine(parts, capacity=True, slo=slo)
+    server, sched = make_server(eng, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield eng, base
+    server.shutdown()
+    sched.stop()
+
+
+def _post_generate(base, prompt, n):
+    req = urllib.request.Request(
+        base + "/generate",
+        json.dumps({"prompt_ids": prompt, "max_new_tokens": n}).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_server_capacity_endpoint(served):
+    eng, base = served
+    _post_generate(base, [1, 2, 3], 5)
+
+    status, body = _get(base + "/capacity")
+    assert status == 200
+    snap = json.loads(body)
+    assert snap["utilization"]["busy_fraction"] > 0.0
+    assert snap["throughput"]["tokens_per_s"] >= 0.0
+    assert snap["signal"]["action"] in ("hold", "scale_up", "scale_down")
+    assert "series" in snap and "recompiles" in snap
+
+    # /health carries the compact brief
+    status, body = _get(base + "/health")
+    health = json.loads(body)
+    assert health["capacity"]["signal"] == snap["signal"]["action"]
+    assert "busy_fraction" in health["capacity"]
+
+    # /metrics grows clt_capacity_* and the histogram drop companions
+    status, text = _get(base + "/metrics")
+    assert "# TYPE clt_capacity_busy_fraction gauge" in text
+    assert "# TYPE clt_capacity_recompiles_total counter" in text
+    assert "clt_capacity_chips" in text
+    dropped = [ln for ln in text.splitlines()
+               if "# TYPE" in ln and ln.split()[2].endswith("_dropped_total")]
+    assert dropped and all(ln.split()[3] == "counter" for ln in dropped)
+
+
+def test_server_capacity_404_when_disabled(parts):
+    eng = _engine(parts)  # no capacity monitor
+    server, sched = make_server(eng, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/capacity", timeout=60)
+        assert exc.value.code == 404
+    finally:
+        server.shutdown()
+        sched.stop()
+
+
+import urllib.error  # noqa: E402  (used above; keep import block tidy)
+
+
+# ----------------------------------------------------------------- router
+def test_router_fleet_capacity(parts):
+    router = Router([_engine(parts, capacity=True, prefix_cache=True),
+                     _engine(parts, capacity=True, prefix_cache=True)])
+    try:
+        router.generate([[1, 2, 3], [4, 5, 6, 7], [9, 9, 9]], GEN)
+        mons = router.capacity_monitors()
+        assert set(mons) == {"replica0", "replica1"}
+        fleet = router.merged_capacity()
+        assert fleet["replica_count"] == 2
+        assert set(fleet["replicas"]) == {"replica0", "replica1"}
+        assert fleet["chips"] == sum(m.chips for m in mons.values())
+        assert fleet["signal"]["action"] in ("hold", "scale_up",
+                                             "scale_down")
+        # same-geometry stores merge into one fleet series
+        assert fleet["merged_series"] is not None
+        json.dumps(fleet)
+        # merged exposition carries the fleet clt_capacity_* families
+        text = router.metrics_text()
+        assert "# TYPE clt_capacity_busy_fraction gauge" in text
+        chips_line = next(ln for ln in text.splitlines()
+                          if ln.startswith("clt_capacity_chips "))
+        assert float(chips_line.split()[1]) == float(fleet["chips"])
+        # /health replica entries carry the compact brief
+        for entry in router.replica_health():
+            assert "busy_fraction" in entry["capacity"]
+    finally:
+        router.close()
+
+
+def test_router_capacity_none_without_monitors(parts):
+    router = Router([_engine(parts, prefix_cache=True),
+                     _engine(parts, prefix_cache=True)])
+    try:
+        assert router.capacity_monitors() == {}
+        assert router.merged_capacity() is None
+        assert "clt_capacity_" not in router.metrics_text()
+    finally:
+        router.close()
+
+
+def test_router_server_capacity_endpoint(parts):
+    router = Router([_engine(parts, capacity=True, prefix_cache=True),
+                     _engine(parts, capacity=True, prefix_cache=True)])
+    server, sched = make_router_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        _post_generate(base, [1, 2, 3], 5)
+        status, body = _get(base + "/capacity")
+        assert status == 200
+        fleet = json.loads(body)
+        assert fleet["replica_count"] == 2
+        assert set(fleet["replicas"]) == {"replica0", "replica1"}
+        assert fleet["signal"]["action"] in ("hold", "scale_up",
+                                             "scale_down")
+    finally:
+        server.shutdown()
+        sched.stop()
+        router.close()
+
+
+# ------------------------------------------------------------------ disagg
+def test_disagg_per_role_capacity(parts):
+    cfg, params = parts
+    dis = DisaggEngine(params, cfg, max_batch_size=4, max_seq_len=64,
+                       block_size=16, prefill_buckets=(16, 32, 64),
+                       capacity=True)
+    dis.generate([[1, 2, 3, 4, 5], [7, 8, 9]], GEN)
+    mons = dis.capacity_monitors()
+    assert set(mons) == {"prefill", "decode"}
+    # the prefill role must not double-count goodput (shared SLO tracker)
+    # or HBM (same process, same devices)
+    assert mons["prefill"].goodput_enabled is False
+    assert mons["prefill"].hbm_enabled is False
+    assert mons["decode"].goodput_enabled is True
+    assert dis.capacity is mons["decode"]
+    snap = dis.capacity_snapshot()
+    assert snap["roles"] == ["decode", "prefill"]
+    assert set(snap["replicas"]) == {"prefill", "decode"}
+    # both roles really ran work through their monitors
+    for role in ("prefill", "decode"):
+        assert mons[role].series.window_sum("busy_seconds") > 0.0, role
+    json.dumps(snap)

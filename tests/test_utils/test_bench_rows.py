@@ -45,3 +45,63 @@ def test_ring_sp_row_runs_tiny(bench):
                            max_position_embeddings=2048)
     rate = bench.measure_ring_sp(8, steps=2, seq=1024, cfg=cfg)
     assert rate > 0
+
+
+@pytest.mark.slow
+def test_capacity_row_runs_tiny(bench):
+    out = bench.measure_capacity(bs=2, prompt_len=16, new_tokens=8,
+                                 factors=(0.5, 2.0))
+    assert out["peak_req_per_s"] > 0
+    for stage in ("x0.5", "x2.0"):
+        assert out[stage]["tokens_per_s"] > 0
+        assert 0.0 <= out[stage]["busy_fraction"] <= 1.0
+        assert out[stage]["signal"] in ("hold", "scale_up", "scale_down")
+    assert "signal_before_collapse" in out
+
+
+# ---------------------------------------------------- --compare gate (fast)
+def test_compare_summaries_directions(bench):
+    baseline = {"ttft_p99_ms": 100.0, "tokens_per_s": 1000.0,
+                "goodput_ratio": 0.9, "policy_flag": True,
+                "mystery_knob": 5.0, "dropped_key": 1.0}
+    current = {"ttft_p99_ms": 150.0,       # +50% latency: regression
+               "tokens_per_s": 1200.0,     # +20% throughput: improvement
+               "goodput_ratio": 0.5,       # -44% goodput: regression
+               "policy_flag": False,       # bool: ignored
+               "mystery_knob": 50.0}       # unknown direction: never flagged
+    out = bench._compare_summaries(current, baseline, threshold=0.1)
+    assert out["regressed"] is True
+    assert set(out["regressions"]) == {"ttft_p99_ms", "goodput_ratio"}
+    assert set(out["improvements"]) == {"tokens_per_s"}
+    assert out["missing"] == ["dropped_key"]
+    assert "mystery_knob" not in out["regressions"]
+    assert out["regressions"]["ttft_p99_ms"]["rel"] == 0.5
+
+
+def test_compare_summaries_zero_baseline_clamped(bench):
+    out = bench._compare_summaries({"ttft_ms": 3.0}, {"ttft_ms": 0.0})
+    assert out["regressions"]["ttft_ms"]["rel"] == 99.0  # never Infinity
+    out = bench._compare_summaries({"ttft_ms": 0.0}, {"ttft_ms": 0.0})
+    assert not out["regressed"]
+
+
+def test_compare_summaries_within_threshold_clean(bench):
+    baseline = {"ttft_p99_ms": 100.0, "tokens_per_s": 1000.0}
+    current = {"ttft_p99_ms": 105.0, "tokens_per_s": 960.0}
+    out = bench._compare_summaries(current, baseline, threshold=0.1)
+    assert not out["regressed"] and not out["improvements"]
+    assert out["compared"] == 2
+
+
+def test_apply_compare_reads_baseline_file(bench, tmp_path, monkeypatch):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text('{"summary": {"tokens_per_s": 1000.0}}')
+    monkeypatch.setenv("BENCH_COMPARE", str(baseline))
+    record = {"metric": "m", "summary": {"tokens_per_s": 500.0}}
+    out = bench._apply_compare(record)
+    assert out["compare"]["regressed"] is True
+    assert out["compare"]["baseline_path"] == str(baseline)
+    # an unreadable baseline must not eat the round's number
+    monkeypatch.setenv("BENCH_COMPARE", str(tmp_path / "missing.json"))
+    out = bench._apply_compare({"metric": "m", "summary": {"x": 1.0}})
+    assert "error" in out["compare"]

@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Cross-check docs/observability.md against the live metric catalogs.
+
+Docs drift silently: a renamed gauge or a new span keeps working while
+the documentation describes a dashboard that no longer exists. This tool
+renders every Prometheus catalog the code can emit (serving ``clt_*``,
+SLO ``clt_slo_*``, router ``clt_router_*``, training ``clt_train_*``,
+capacity ``clt_capacity_*``) the same way the HTTP endpoints render
+them, parses the metric names and span table out of the docs, and fails
+on any mismatch:
+
+- every ``clt_*`` family the docs mention must be emitted by some
+  renderer and obey the Prometheus grammar;
+- every ``clt_capacity_*`` family the code emits must be documented
+  (the strict direction for the newest family);
+- the span table in the docs must equal ``SPAN_CATALOG`` exactly —
+  extend both or neither;
+- every histogram family must export its ``_dropped_total`` companion.
+
+Run directly (``python tools/check_metric_catalog.py``) or through
+``tests/test_core/test_metric_catalog.py``.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+DOC = REPO / "docs" / "observability.md"
+
+if str(REPO) not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, str(REPO))
+
+#: a ``clt_...`` token in prose/code-spans; the lookbehind skips path
+#: components like ``/tmp/clt_trace.json``
+_DOC_NAME_RE = re.compile(r"(?<![\w/])clt_[a-z0-9_]+")
+#: histogram sample suffixes collapse into their family name
+_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+
+
+def doc_metric_families(text):
+    """Every concrete ``clt_*`` family the docs mention. Namespace
+    mentions (``clt_``, ``clt_slo_``, ...) and sample-line suffixes are
+    normalized away."""
+    names = set()
+    for tok in _DOC_NAME_RE.findall(text):
+        if tok.endswith("_"):
+            continue  # a namespace mention, not a family
+        names.add(_SUFFIX_RE.sub("", tok))
+    return names
+
+
+def doc_span_names(text):
+    """The span catalog as documented: backticked names in the first
+    column of the span table inside the "Request tracing" section (rows
+    like ``| `prefill` / `prefill_chunk` | complete | ... |``)."""
+    spans = set()
+    in_section = False
+    for line in text.splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == "## Request tracing"
+            continue
+        if in_section and line.startswith("| `"):
+            first_cell = line.split("|")[1]
+            spans.update(re.findall(r"`([\w.]+)`", first_cell))
+    return spans
+
+
+def _family_names(text):
+    names = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            names.add(line.split()[2])
+        else:
+            base = line.rsplit(" ", 1)[0].split("{")[0]
+            if base.endswith(("_bucket", "_sum", "_count")):
+                base = base.rsplit("_", 1)[0]
+            names.add(base)
+    return names
+
+
+def serving_families():
+    """Everything a single-engine ``GET /metrics`` can emit: EngineStats
+    counters, the occupancy gauges the handler adds, and every serving
+    histogram (with its ``_dropped_total`` companion)."""
+    from colossalai_tpu.inference.engine import EngineStats
+    from colossalai_tpu.inference.telemetry import Telemetry
+    from colossalai_tpu.telemetry import prometheus_exposition
+
+    counters = {k: v for k, v in EngineStats().as_dict().items()
+                if isinstance(v, (int, float))}
+    # the point-in-time gauges Handler._occupancy() adds (server.py)
+    gauges = {k: 0 for k in ("running", "waiting", "prefilling",
+                             "free_blocks", "megastep_k",
+                             "prefix_cache_blocks", "draft_len")}
+    return _family_names(prometheus_exposition(
+        counters, gauges, Telemetry().histograms, prefix="clt"))
+
+
+def slo_families():
+    from colossalai_tpu.telemetry import SLOTracker, prometheus_exposition
+
+    slo = SLOTracker()
+    slo.record_request(ttft=0.01, itl=0.001, e2e=0.1, queue_wait=0.001,
+                       tokens=4)
+    return _family_names(prometheus_exposition(
+        slo.prom_counters(), slo.prom_gauges(), {}, prefix="clt"))
+
+
+def train_families():
+    from colossalai_tpu.telemetry import TrainMonitor
+
+    mon = TrainMonitor(flops_per_token=1.0, n_devices=1)
+    mon.start_step(0)
+    for phase in ("data", "dispatch", "sync", "optimizer"):
+        with mon.phase(phase):
+            pass
+    mon.end_step(host_metrics={"loss": 1.0, "grad_norm": 1.0}, n_tokens=1)
+    try:
+        return _family_names(mon.render_prometheus())
+    finally:
+        mon.close()
+
+
+def router_families():
+    """``Router.metrics_text()`` over bookkeeping-only stub replicas (no
+    model ever builds — the same trick test_metric_names.py uses)."""
+    from types import SimpleNamespace
+
+    from colossalai_tpu.inference.engine import EngineStats
+    from colossalai_tpu.inference.router import Router
+    from colossalai_tpu.inference.telemetry import Telemetry
+
+    class _StubEngine:
+        has_work = False
+        prefix_cache = None
+
+        def __init__(self):
+            self.stats = EngineStats()
+            self.telemetry = Telemetry()
+            self.waiting = []
+            self.prefilling = {}
+            self.running = {}
+            self.allocator = SimpleNamespace(num_free=0)
+
+    router = Router([_StubEngine(), _StubEngine()], policy="least_loaded")
+    try:
+        return _family_names(router.metrics_text())
+    finally:
+        router.close()
+
+
+def capacity_families():
+    """Every ``clt_capacity_*`` family a fully-lit monitor emits — all
+    conditional gauges (goodput, KV, queue, headroom, HBM) forced on."""
+    from colossalai_tpu.telemetry import CapacityMonitor, prometheus_exposition
+
+    m = CapacityMonitor(chips=1, hbm=False)
+    m.sample(queue_depth=1, running=1, kv_blocks_in_use=1,
+             kv_blocks_total=4, decode_tokens=0.0, goodput_tokens=0.0,
+             slo_breached=False)
+    m.on_megastep(0.01)
+    m.sample(decode_tokens=8.0, goodput_tokens=8.0)
+    m._hbm = {"devices": 1, "bytes_in_use": 1.0, "peak_bytes_in_use": 2.0}
+    names = _family_names(prometheus_exposition(
+        m.prom_counters(), m.prom_gauges(), {}, prefix="clt"))
+    assert all(n.startswith("clt_capacity_") for n in names), names
+    return names
+
+
+def run_checks(doc_text=None):
+    """Returns a list of human-readable failures (empty == clean)."""
+    from colossalai_tpu.telemetry import METRIC_NAME_RE, SPAN_CATALOG
+
+    text = doc_text if doc_text is not None else DOC.read_text()
+    failures = []
+
+    catalogs = {
+        "serving": serving_families(),
+        "slo": slo_families(),
+        "train": train_families(),
+        "router": router_families(),
+        "capacity": capacity_families(),
+    }
+    known = set().union(*catalogs.values())
+
+    for name in sorted(known):
+        if not METRIC_NAME_RE.match(name):
+            failures.append(f"code emits ungrammatical metric name: {name}")
+
+    documented = doc_metric_families(text)
+    for name in sorted(documented - known):
+        failures.append(
+            f"docs mention {name} but no renderer emits it "
+            "(renamed or removed?)")
+
+    for name in sorted(catalogs["capacity"] - documented):
+        failures.append(
+            f"code emits {name} but docs/observability.md does not "
+            "document it (extend the clt_capacity_* table)")
+
+    doc_spans = doc_span_names(text)
+    code_spans = set(SPAN_CATALOG)
+    for name in sorted(code_spans - doc_spans):
+        failures.append(f"span {name!r} is in SPAN_CATALOG but not in the "
+                        "docs span table")
+    for name in sorted(doc_spans - code_spans):
+        failures.append(f"docs span table lists {name!r} which is not in "
+                        "SPAN_CATALOG")
+
+    # every histogram family carries its _dropped_total companion
+    from colossalai_tpu.inference.telemetry import (
+        _HISTOGRAM_SPECS,
+        Telemetry,
+    )
+    from colossalai_tpu.telemetry import prometheus_exposition
+
+    serving_text = prometheus_exposition({}, {}, Telemetry().histograms,
+                                         prefix="clt")
+    for h in _HISTOGRAM_SPECS:
+        family = f"clt_{h}_dropped_total"
+        if f"# TYPE {family} counter" not in serving_text:
+            failures.append(
+                f"histogram {h} has no {family} counter in the exposition")
+    return failures
+
+
+def main():
+    failures = run_checks()
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        print(f"\n{len(failures)} catalog mismatch(es)")
+        return 1
+    print("metric catalog, span catalog, and docs are in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
