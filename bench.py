@@ -1074,6 +1074,109 @@ def measure_capacity(cfg=None, bs: int = 4, prompt_len: int = 48,
     return out
 
 
+def measure_long_context(cfg=None, lengths=(256, 512, 1024),
+                         new_tokens: int = 4, block_size: int = 32,
+                         max_seq_len: int = 2048):
+    """Long-context prefill A/B: TTFT vs context length with
+    sequence-parallel prefill (``sp_prefill=``) on vs off, on a 2-device
+    tp mesh. The ``lengths`` ramp is the CPU stand-in for the 8k/32k/128k
+    points — same engine code path, scaled to what a CPU host can prefill
+    in bench budget. Three numbers per length:
+
+    - ``ttft_ms_sp_off`` / ``ttft_ms_sp_on``: measured, programs warmed
+      first so neither arm pays compile time. On CPU the ring adds
+      collective-emulation overhead, so sp_on is NOT expected to win wall
+      clock here — the claim a CPU can check is that the sp path works
+      end-to-end at every length while holding per-chip attention memory
+      ~sp× lower (on TPU that memory ceiling is what caps context length
+      per chip);
+    - ``attn_score_mib_per_chip_{sp_off,sp_on}``: the modelled peak fp32
+      score-tensor footprint — monolithic GSPMD holds ``[Hq/tp, C,
+      s_max]`` per chip, the ring ``[Hq, C/sp, s_max/sp]`` — and their
+      ratio ``attn_mem_reduction_x ≈ sp`` (the acceptance-criterion
+      number);
+    - ``concurrent_users_at_budget``: how many users of this context
+      length the FIXED page pool holds at once — the capacity side of the
+      long-context story (independent of sp: the pool layout is
+      unchanged, which is the point).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    if cfg is None:
+        cfg = _small_serving_config()
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError("measure_long_context needs >= 2 devices "
+                           "for the sp/tp mesh")
+    sp = 2
+    mesh = Mesh(np.array(devs[:sp]), ("tp",))
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+
+    def build(sp_on):
+        return LLMEngine(
+            params, cfg, max_batch_size=2, max_seq_len=max_seq_len,
+            block_size=block_size, mesh=mesh,
+            prefill_buckets=tuple(sorted({*lengths, max_seq_len})),
+            sp_prefill=(0 if sp_on else None),
+        )
+
+    def ttft_ms(eng, prompt):
+        # warm this length's prefill program + the decode megastep on a
+        # throwaway, then measure submit -> first token
+        eng.generate([[int(t) ^ 1 for t in prompt]],
+                     GenerationConfig(max_new_tokens=2))
+        eng.add_request(list(prompt), gen)
+        t0 = time.perf_counter()
+        t_first = None
+        while eng.has_work:
+            finished = eng.step()
+            if t_first is None and (
+                    any(r.output_ids for r in eng.running.values())
+                    or finished):
+                t_first = time.perf_counter()
+        return (t_first - t0) * 1e3
+
+    hq = cfg.num_attention_heads
+    out = {"sp_degree": sp, "block_size": block_size,
+           "max_seq_len": max_seq_len, "lengths": {}}
+    eng_probe = build(False)
+    usable = eng_probe.allocator.num_blocks - 1
+    out["pool_blocks"] = usable
+    for L in lengths:
+        prompt = list(rng.randint(0, cfg.vocab_size, size=(L,)))
+        row = {}
+        row["ttft_ms_sp_off"] = ttft_ms(build(False), prompt)
+        eng_on = build(True)
+        row["ttft_ms_sp_on"] = ttft_ms(eng_on, prompt)
+        if eng_on.stats.prefill_sp_chunks < 1:
+            raise RuntimeError(f"sp arm never ran the ring at L={L}")
+        # modelled fp32 score footprint of the padded prefill bucket C
+        # against the full table gather s_max — the L²-ish term that
+        # walls off long contexts per chip
+        C = eng_probe._bucket(L)
+        s_max = max_seq_len
+        mono = (hq // sp) * C * s_max * 4
+        ring = hq * (C // sp) * (s_max // sp) * 4
+        row["attn_score_mib_per_chip_sp_off"] = round(mono / 2**20, 3)
+        row["attn_score_mib_per_chip_sp_on"] = round(ring / 2**20, 3)
+        row["attn_mem_reduction_x"] = round(mono / ring, 2)
+        per_user = -(-(L + new_tokens) // block_size)  # ceil
+        row["concurrent_users_at_budget"] = usable // per_user
+        out["lengths"][f"L{L}"] = row
+    out["attn_mem_reduction_x"] = out["lengths"][
+        f"L{lengths[-1]}"]["attn_mem_reduction_x"]
+    return out
+
+
 def measure_disagg(cfg=None, bs: int = 4, prompt_len: int = 48,
                    new_tokens: int = 24, n_batches: int = 6,
                    load_factor: float = 1.5, k: int = 4,
@@ -1469,6 +1572,14 @@ def child_main():
                 )
             except Exception as e:
                 print(f"ring-sp bench failed: {e}", file=sys.stderr)
+            try:
+                # long-context prefill: TTFT + per-chip attention memory,
+                # sp_prefill on vs off at a ramp of context lengths
+                extras["long_context"] = measure_long_context(
+                    lengths=(1024, 4096, 8192), max_seq_len=16384,
+                    block_size=128)
+            except Exception as e:
+                print(f"long context bench failed: {e}", file=sys.stderr)
 
     try:
         # autotuner visibility: chosen tilings per (kernel, device, shape
@@ -1541,6 +1652,11 @@ def cpu_child_main():
             factors=(0.25, 0.5, 1.0, 2.0))
     except Exception as e:
         print(f"cpu capacity bench failed: {e}", file=sys.stderr)
+    try:
+        extras["long_context_cpu"] = measure_long_context(
+            lengths=(128, 256, 512), max_seq_len=1024)
+    except Exception as e:
+        print(f"cpu long context bench failed: {e}", file=sys.stderr)
     # compact headline for the supervisor's final line: the driver records
     # a bounded output tail, so the merged failure JSON carries THIS, not
     # the full nested dicts
@@ -1588,6 +1704,15 @@ def cpu_child_main():
             summary[f"capacity_{fk}_goodput_per_chip_s"] = \
                 capn[fk]["goodput_per_chip_s"]
             summary[f"capacity_{fk}_signal"] = capn[fk]["signal"]
+    lc = extras.get("long_context_cpu", {})
+    for lk, row in lc.get("lengths", {}).items():
+        summary[f"long_context_{lk}_ttft_ms_sp_off"] = row["ttft_ms_sp_off"]
+        summary[f"long_context_{lk}_ttft_ms_sp_on"] = row["ttft_ms_sp_on"]
+        summary[f"long_context_{lk}_concurrent_users"] = \
+            row["concurrent_users_at_budget"]
+    if "attn_mem_reduction_x" in lc:
+        summary["long_context_attn_mem_reduction_x"] = \
+            lc["attn_mem_reduction_x"]
     print(json.dumps({
         "metric": "cpu_serving_fallback", "value": 0.0, "unit": "MFU",
         "vs_baseline": 0.0, "cpu_fallback": True, "summary": summary,
@@ -1628,7 +1753,8 @@ def _cpu_fallback(budget_s: float):
 _LOWER_BETTER = ("ttft", "itl", "stall", "latency")
 #: summary-key substrings where a LOWER value is a regression
 _HIGHER_BETTER = ("tokens_per_s", "goodput", "attainment", "scaling_x",
-                  "mfu", "agreement", "gain")
+                  "mfu", "agreement", "gain", "concurrent_users",
+                  "reduction_x")
 
 
 def _compare_summaries(current: dict, baseline: dict,

@@ -75,9 +75,18 @@ from .paged_modeling import (
     decode_megastep,
     prefill_chunk_paged,
     prefill_paged,
+    prefill_sp,
     sample_tokens,
 )
 from .speculative import DraftLenController, decode_spec_megastep, self_draft_params
+
+
+#: ``sp_prefill=True`` threshold: prompts at or above this many tokens
+#: shard their prefill over the tp axis; shorter ones stay monolithic
+#: (the ring's per-hop dispatch overhead beats the memory win there).
+#: Pass an int to ``sp_prefill=`` to pick a different threshold (0 =
+#: shard every prefill).
+SP_PREFILL_DEFAULT_THRESHOLD = 2048
 
 
 @dataclasses.dataclass
@@ -118,6 +127,12 @@ class Request:
     cached_blocks: List[int] = dataclasses.field(default_factory=list)
     #: prefix cache: deepest matched tree node (pin handle, opaque)
     cache_node: Optional[object] = None
+    #: chunked prefill of a GROUP: every follower's tail pages, ALLOCATED
+    #: at admission (one list per follower) — the admission gate funds
+    #: them, but without physical allocation a later admission could
+    #: drain the pool mid-chunked-prefill and the leader's final chunk
+    #: would die in OutOfBlocks with the group half-built
+    group_tail_blocks: Optional[List[List[int]]] = None
     # ---- lifecycle telemetry (monotonic clock, stamped by Telemetry):
     # arrival (add_request) → admitted (slot granted) → first_token
     # (prefill sample lands on the host) → finished (terminal)
@@ -162,6 +177,9 @@ class EngineStats:
     decode_h2d_scalars: int = 0
     decode_d2h_elements: int = 0
     prefill_chunks: int = 0
+    #: chunk prefills that ran the sequence-parallel ring (sp_prefill=,
+    #: prompt over threshold, chunk divisible by the tp size)
+    prefill_sp_chunks: int = 0
     #: megasteps demoted to K=1 because the page pool couldn't fund K tokens
     fallback_k1: int = 0
     # ---- MoE serving: decode (token, layer, expert-choice) routings,
@@ -363,6 +381,7 @@ class LLMEngine:
         capacity: Union[bool, CapacityMonitor, None] = None,
         moe_impl: str = "auto",
         kv_dtype: str = "bf16",
+        sp_prefill: Union[bool, int, None] = None,
     ):
         self.config = config
         # ---- observability: lifecycle stamps + histograms are host-side
@@ -411,7 +430,8 @@ class LLMEngine:
             for fn, ph in ((decode_megastep, "decode"),
                            (decode_spec_megastep, "spec"),
                            (prefill_paged, "prefill"),
-                           (prefill_chunk_paged, "prefill")):
+                           (prefill_chunk_paged, "prefill"),
+                           (prefill_sp, "prefill")):
                 self.capacity.sentinel.watch(fn, ph)
         self.max_batch = max_batch_size
         if max_seq_len % block_size:
@@ -675,6 +695,36 @@ class LLMEngine:
         self._global = mesh is not None and not all(
             d.process_index == jax.process_index() for d in mesh.devices.flat
         )
+        # ---- sequence-parallel long-context prefill (sp_prefill=): shard
+        # a long prompt chunk's QUERY ROWS over the tp mesh axis and ring
+        # the table-gathered K/V around it (paged_modeling.prefill_sp) —
+        # per-chip attention score memory drops ~tp×, which is what lets a
+        # prompt too long for one chip's attention pass prefill at all.
+        # True enables above SP_PREFILL_DEFAULT_THRESHOLD tokens; an int
+        # sets the threshold (0 = every prefill). Pages and scales land
+        # bit-wherever the monolithic path puts them, so decode, the
+        # prefix cache, and int8 KV are untouched downstream.
+        self._sp_size = 1
+        self._sp_threshold: Optional[int] = None
+        # identity checks: sp_prefill=0 means "shard every prefill", and
+        # 0 == False would swallow it in a membership test
+        if sp_prefill is not None and sp_prefill is not False:
+            if self._pp:
+                raise NotImplementedError(
+                    "sp_prefill has no pipeline-parallel path — the pp "
+                    "relay owns the layer loop; use a tp-only mesh"
+                )
+            tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
+            if tp < 2:
+                raise ValueError(
+                    "sp_prefill shards prefill over the tp mesh axis — "
+                    "pass mesh= with a tp axis of size >= 2"
+                )
+            self._sp_size = tp
+            self._sp_threshold = (
+                SP_PREFILL_DEFAULT_THRESHOLD if sp_prefill is True
+                else int(sp_prefill)
+            )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1031,6 +1081,54 @@ class LLMEngine:
                 return b
         return self.max_seq
 
+    def _sp_degree(self, c: int, n_total: int) -> int:
+        """sp degree for one prefill call of chunk length ``c`` from a
+        prompt of ``n_total`` tokens: the configured tp size when the
+        knob is on, the prompt crosses the length threshold, and both the
+        chunk and the table gather (max_seq) split evenly over the axis —
+        else 1 (the monolithic path, same numerics)."""
+        sp = self._sp_size
+        if (sp <= 1 or self._sp_threshold is None
+                or n_total < self._sp_threshold):
+            return 1
+        if c % sp or self.max_seq % sp:
+            return 1
+        return sp
+
+    def _run_chunk_prefill(self, ids, start, n_valid, table, sp: int):
+        """One chunk-prefill dispatch (plus its draft-pool mirror):
+        ``prefill_sp`` over the tp axis when ``sp > 1``, else the
+        monolithic ``prefill_chunk_paged``. Returns the chunk logits."""
+        a_ids = self._put_rep(ids)
+        a_start = self._put_rep(np.asarray(start, np.int32))
+        a_n = self._put_rep(np.asarray(n_valid, np.int32))
+        a_table = self._put_rep(table)
+        if sp > 1:
+            logits, self.cache = prefill_sp(
+                self.params, self.config, a_ids, a_start, a_n,
+                self.cache, a_table, self._tp_mesh,
+            )
+            self.stats.prefill_sp_chunks += 1
+        else:
+            logits, self.cache = prefill_chunk_paged(
+                self.params, self.config, a_ids, a_start, a_n,
+                self.cache, a_table,
+            )
+        if self.draft_len:
+            # mirror into the draft pool (same physical pages) so the
+            # draft's prompt KV is ready when the slot starts drafting
+            if sp > 1:
+                _, self.draft_cache = prefill_sp(
+                    self.draft_params, self.draft_config, a_ids, a_start,
+                    a_n, self.draft_cache, a_table, self._tp_mesh,
+                )
+            else:
+                _, self.draft_cache = prefill_chunk_paged(
+                    self.draft_params, self.draft_config, a_ids, a_start,
+                    a_n, self.draft_cache, a_table,
+                )
+        return logits
+
     def _group_page_needs(self, n: int, n_samples: int):
         """Page accounting for one (possibly grouped) prompt of ``n``
         tokens — the SINGLE source both add_request's static validation and
@@ -1204,9 +1302,20 @@ class LLMEngine:
                     free.pop(0) for _ in (req.group_ids or [])[1:]
                 ]
                 self._reserved.update(req.group_slots)
+                if tail and req.group_slots:
+                    # allocate (not just fund) every follower's tail pages
+                    # now — the num_free gate above covered them, so this
+                    # cannot fail, and holding them physically means no
+                    # admission on a later tick can starve the leader's
+                    # final chunk into OutOfBlocks
+                    req.group_tail_blocks = [
+                        self.allocator.allocate(tail) for _ in req.group_slots
+                    ]
                 self.prefilling[req.slot] = req
                 continue
-            with self.telemetry.trace_phase(req, "prefill", cached_tokens=start):
+            with self.telemetry.trace_phase(
+                    req, "prefill", cached_tokens=start,
+                    sp=self._sp_degree(bucket - start, n)):
                 logits = self._prefill_into_slot(req, bucket)
                 self._finish_prefill(req, logits, free, finished)
 
@@ -1222,9 +1331,11 @@ class LLMEngine:
             ids = np.zeros((1, c), np.int32)
             ids[0, :n_valid] = ctx[pos:pos + n_valid]
             table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-            with self.telemetry.trace_phase(req, "prefill_chunk",
-                                            pos=pos, tokens=n_valid):
-                with annotate("prefill_chunk"):
+            sp = self._sp_degree(c, n)
+            span = "prefill_sp" if sp > 1 else "prefill_chunk"
+            with self.telemetry.trace_phase(req, span,
+                                            pos=pos, tokens=n_valid, sp=sp):
+                with annotate(span):
                     if self._pp:
                         logits, self.cache = self._pp_prefill_chunk(
                             self._pp_top, self._pp_stacked, jnp.asarray(ids),
@@ -1232,23 +1343,8 @@ class LLMEngine:
                             self.cache, jnp.asarray(table),
                         )
                     else:
-                        logits, self.cache = prefill_chunk_paged(
-                            self.params, self.config, self._put_rep(ids),
-                            self._put_rep(np.asarray(pos, np.int32)),
-                            self._put_rep(np.asarray(n_valid, np.int32)),
-                            self.cache, self._put_rep(table),
-                        )
-                        if self.draft_len:
-                            # mirror the chunk into the draft pool (same physical
-                            # pages) so the draft's prompt KV is ready when the
-                            # slot starts drafting
-                            _, self.draft_cache = prefill_chunk_paged(
-                                self.draft_params, self.draft_config,
-                                self._put_rep(ids),
-                                self._put_rep(np.asarray(pos, np.int32)),
-                                self._put_rep(np.asarray(n_valid, np.int32)),
-                                self.draft_cache, self._put_rep(table),
-                            )
+                        logits = self._run_chunk_prefill(
+                            ids, pos, n_valid, table, sp)
                 self.stats.prefill_chunks += 1
                 self._tick_prefilled = True
                 req.prefill_pos = pos + n_valid
@@ -1287,7 +1383,12 @@ class LLMEngine:
             f.slot = follower_slots.pop(0)
             shared = req.table.blocks[:full]
             self.allocator.fork(shared)
-            fresh = self._alloc_blocks(tail) if tail else []
+            if req.group_tail_blocks:
+                # chunked-group admission pre-allocated this follower's
+                # tail — consume the reservation instead of racing the pool
+                fresh = req.group_tail_blocks.pop(0)
+            else:
+                fresh = self._alloc_blocks(tail) if tail else []
             if n % self.block_size:
                 # the partial prompt page would be overwritten by this
                 # member's first tokens: copy-on-write it
@@ -1807,12 +1908,18 @@ class LLMEngine:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = ctx
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-        with annotate("prefill"):
+        sp = self._sp_degree(bucket, n)
+        with annotate("prefill_sp" if sp > 1 else "prefill"):
             if self._pp:
                 logits, self.cache = self._pp_prefill(
                     self._pp_top, self._pp_stacked, jnp.asarray(ids),
                     jnp.asarray([n], jnp.int32), self.cache, jnp.asarray(table),
                 )
+            elif sp > 1:
+                # the whole bucket as ONE sp chunk at start=0 — chunk
+                # prefill over the full table is bit-compatible with the
+                # single-shot program (prefill_chunk_paged docstring)
+                logits = self._run_chunk_prefill(ids, 0, n, table, sp)
             else:
                 logits, self.cache = prefill_paged(
                     self.params, self.config, self._put_rep(ids),
@@ -1841,7 +1948,11 @@ class LLMEngine:
         ids = np.zeros((1, c), np.int32)
         ids[0, :n - start] = ctx[start:]
         table = np.asarray(req.table.padded(self.max_blocks_per_seq), np.int32)
-        with annotate("prefill_suffix"):
+        # uncached-SUFFIX-only sharding: only the c = bucket - start fresh
+        # rows enter the ring; cached pages are attended through the table
+        # gather exactly like the monolithic suffix path
+        sp = self._sp_degree(c, n)
+        with annotate("prefill_sp" if sp > 1 else "prefill_suffix"):
             if self._pp:
                 logits, self.cache = self._pp_prefill_chunk(
                     self._pp_top, self._pp_stacked, jnp.asarray(ids),
@@ -1849,6 +1960,9 @@ class LLMEngine:
                     jnp.asarray(n - start, jnp.int32),
                     self.cache, jnp.asarray(table),
                 )
+            elif sp > 1:
+                logits = self._run_chunk_prefill(ids, start, n - start,
+                                                 table, sp)
             else:
                 logits, self.cache = prefill_chunk_paged(
                     self.params, self.config, self._put_rep(ids),
@@ -1904,6 +2018,12 @@ class LLMEngine:
             self._dev_active, self._put_rep(np.asarray(slot, np.int32)),
             self._put_rep(np.asarray(False)))
         pc = self.prefix_cache
+        if req is not None and req.group_tail_blocks:
+            # chunked-group prefill died/aborted before the followers
+            # materialized: return their pre-allocated tail reservations
+            for blocks in req.group_tail_blocks:
+                self.allocator.free(blocks)
+            req.group_tail_blocks = None
         if pc is not None and req is not None and req.cache_node is not None:
             pc.unpin(req.cache_node)
             req.cache_node = None

@@ -32,6 +32,22 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+try:  # jax >= 0.6 re-exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+#: replication checking renamed check_rep -> check_vma across jax
+#: versions; either way it must be off — the ring's scan-carried
+#: ppermute state defeats the static replication analysis
+_SM_UNCHECKED = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
+
 from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
 from . import kv_quant
@@ -248,6 +264,181 @@ def prefill_chunk_paged(
     last = jax.lax.dynamic_index_in_dim(
         logits, jnp.clip(n_valid - 1, 0), axis=1, keepdims=False
     )  # [1, V]: the chunk's last real token (meaningful on the final chunk)
+    return last, PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
+
+
+#: out-of-range kv position for never-written / beyond-frontier pool rows:
+#: the ring's position-exact causal mask (``q_pos >= kv_pos``) excludes
+#: them, which is exactly ``causal & kv_valid`` in ``_block_step`` — the
+#: validity mask folds into the positions so the ring rotates ONE extra
+#: operand instead of two
+_SP_INVALID_POS = jnp.int32(2**30)
+
+
+def _sp_attention(mesh, q, k_seq, v_seq, q_pos, kv_pos):
+    """Sequence-parallel chunk attention: shard query rows AND the
+    table-gathered K/V over the ``tp`` mesh axis, rotate K/V ring-wise.
+
+    q ``[1, C, Hq, D]``; k_seq/v_seq ``[1, s_max, Hkv, D]`` (the whole
+    table gather); q_pos ``[1, C]``; kv_pos ``[1, s_max]`` (invalid rows
+    already at :data:`_SP_INVALID_POS`). C and s_max must divide by the
+    tp size (the engine guards). Entering the shard_map re-lays the
+    GSPMD head-sharded projections out as sequence shards (the
+    all-to-all IS the sp "fold" of TASP / Folding-TSP: the same wires
+    that carried head shards now carry sequence shards), so each chip
+    holds full heads over ``C/sp`` query rows and one ``s_max/sp`` K/V
+    slice per hop — per-chip score memory drops from
+    ``[Hq/tp, C, s_max]`` to ``[Hq, C/sp, s_max/sp]``, ~sp× at sp = tp.
+    Each hop runs the ``sp_prefill_attention`` kernel op (Pallas flash
+    machinery on TPU, ``ring_attention._attn_with_lse`` elsewhere) and
+    folds into the running (out, lse) via the streaming-softmax merge.
+    Returns fp32 ``[1, C, Hq, D]``, resharded back to GSPMD auto on
+    exit."""
+    from jax.sharding import PartitionSpec as P
+
+    from colossalai_tpu.kernel.ops import sp_prefill_attention
+    from colossalai_tpu.shardformer.layer.ring_attention import _merge
+
+    sp = mesh.shape["tp"]
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+    seq_spec = P(None, "tp", None, None)
+    pos_spec = P(None, "tp")
+
+    def local_fn(q_l, k_l, v_l, qp_l, kp_l):
+        step = lambda k_c, v_c, kp_c: sp_prefill_attention(
+            q_l, k_c, v_c, qp_l, kp_c, sp_degree=sp,
+        )
+        out, lse = step(k_l, v_l, kp_l)
+
+        def body(carry, _):
+            out, lse, k_c, v_c, kp_c = carry
+            k_c = jax.lax.ppermute(k_c, "tp", perm)
+            v_c = jax.lax.ppermute(v_c, "tp", perm)
+            kp_c = jax.lax.ppermute(kp_c, "tp", perm)
+            o_i, lse_i = step(k_c, v_c, kp_c)
+            out, lse = _merge(out, lse, o_i, lse_i)
+            return (out, lse, k_c, v_c, kp_c), None
+
+        (out, _, *_), _ = jax.lax.scan(
+            body, (out, lse, k_l, v_l, kp_l), None, length=sp - 1
+        )
+        return out
+
+    fn = _shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec),
+        out_specs=seq_spec, **_SM_UNCHECKED,
+    )
+    return fn(q, k_seq, v_seq, q_pos, kv_pos)
+
+
+def _block_step_sp(cfg, p, x, k_seq, v_seq, positions, kv_valid, mesh):
+    """``_block_step`` with the attention swapped for the sp ring — the
+    projections, rope, residuals, and dense MLP are op-for-op the same
+    (MoE never reaches here: the engine guards MoE+mesh at
+    construction). Merge ordering makes the output not bitwise equal to
+    the monolithic softmax, but the math is the identical streamed
+    decomposition — greedy outputs stay token-identical (pinned by
+    tests/test_inference/test_sp_prefill.py)."""
+    dtype = x.dtype
+    eps = cfg.rms_norm_eps
+    hd = cfg.head_dim_
+    b, s, _ = x.shape
+
+    h = _rms(x, p["input_layernorm"]["scale"], eps)
+    q = _proj(h, p["self_attn"]["q_proj"], dtype)
+    n_heads = q.shape[-1] // hd
+    q = q.reshape(b, s, n_heads, hd)
+    cos, sin = rope_table(positions, hd, cfg.rope_theta)
+    q = apply_rope(q, cos, sin)
+
+    s_max = k_seq.shape[1]
+    kv_pos = jnp.broadcast_to(jnp.arange(s_max, dtype=jnp.int32), (b, s_max))
+    kv_pos = jnp.where(kv_valid, kv_pos, _SP_INVALID_POS)
+    attn = _sp_attention(mesh, q, k_seq, v_seq, positions, kv_pos)
+    attn = attn.reshape(b, s, n_heads * hd).astype(dtype)
+    x = x + attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype)
+
+    h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
+    gate = h @ p["mlp"]["gate_proj"]["kernel"].astype(dtype)
+    up = h @ p["mlp"]["up_proj"]["kernel"].astype(dtype)
+    x = x + (jax.nn.silu(gate) * up) @ p["mlp"]["down_proj"]["kernel"].astype(dtype)
+    return x
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("cache",))
+def prefill_sp(
+    params, cfg: LlamaConfig, input_ids, start, n_valid, cache: PagedKVCache,
+    block_table, mesh,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """:func:`prefill_chunk_paged` with the attention sharded over the tp
+    mesh axis — the sequence-parallel long-context prefill path.
+
+    Same contract: one chunk [1, C] (C a page multiple, and here also a
+    multiple of the tp size, like s_max), ``start`` tokens already in the
+    pool, ``n_valid`` real tokens; K/V page writes and int8 per-page
+    scale writes are IDENTICAL to the monolithic path (GSPMD keeps them
+    head-sharded, so each chip writes its own head slice of every page —
+    "scales written shard-locally"), which is what lets decode, the
+    prefix cache, CoW, and KV transport proceed unmodified on the pages
+    an sp prefill wrote. Only the chunk-vs-table attention differs: a
+    ring over query-row shards (see :func:`_sp_attention`), cutting
+    per-chip attention memory ~sp× so prompts whose score matrix cannot
+    fit one chip prefill across the mesh. ``mesh`` is static: its
+    identity keys the trace cache like ``cfg``."""
+    p = params["params"] if "params" in params else params
+    stacked = p["layers"]["block"]
+    dtype = cfg.dtype or jnp.bfloat16
+    b, c = input_ids.shape
+    bs = cache.block_size
+    n_pages = c // bs
+    max_blocks = block_table.shape[0]
+    s_max = max_blocks * bs
+    positions = start + jnp.broadcast_to(jnp.arange(c), (b, c))  # [1, C]
+    kv_valid = (jnp.arange(s_max)[None, :] < start + n_valid)  # [1, s_max]
+    page_ids = jax.lax.dynamic_slice(block_table, (start // bs,), (n_pages,))
+
+    x = p["embed_tokens"]["embedding"].astype(dtype)[input_ids]
+
+    def layer(carry, inputs):
+        x, i = carry
+        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
+        h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        k, v = _project_kv(cfg, layer_params, h, positions)
+        k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
+        v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
+        if k_sc is not None:
+            page_valid = (jnp.arange(c) < n_valid).reshape(n_pages, bs)
+            ks = kv_quant.page_scales(k_pages, page_valid)
+            vs = kv_quant.page_scales(v_pages, page_valid)
+            k_pages = kv_quant.quantize_pages(k_pages, ks)
+            v_pages = kv_quant.quantize_pages(v_pages, vs)
+            k_sc = k_sc.at[page_ids].set(ks)
+            v_sc = v_sc.at[page_ids].set(vs)
+        k_pool = k_pool.at[page_ids].set(k_pages)
+        v_pool = v_pool.at[page_ids].set(v_pages)
+
+        def to_seq(pool, sc):
+            g = pool[block_table]
+            if sc is not None:
+                g = kv_quant.dequantize_pages(g, sc[block_table], dtype)
+            g = g.transpose(0, 2, 1, 3)
+            return g.reshape(s_max, pool.shape[1], pool.shape[3])[None]
+
+        x = _block_step_sp(cfg, layer_params, x, to_seq(k_pool, k_sc),
+                           to_seq(v_pool, v_sc), positions, kv_valid, mesh)
+        return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
+
+    with jax.named_scope("prefill_sp"):
+        (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, (x.astype(dtype), 0),
+            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        )
+
+    logits = _logits_head(p, cfg, x)
+    last = jax.lax.dynamic_index_in_dim(
+        logits, jnp.clip(n_valid - 1, 0), axis=1, keepdims=False
+    )  # [1, V]
     return last, PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
 
 

@@ -18,6 +18,7 @@ from .ops import (
     rope_and_cache_update,
     rope_embed,
     silu_and_mul,
+    sp_prefill_attention,
 )
 
 __all__ = [
@@ -31,5 +32,6 @@ __all__ = [
     "rope_and_cache_update",
     "rope_embed",
     "silu_and_mul",
+    "sp_prefill_attention",
     "tuning",
 ]

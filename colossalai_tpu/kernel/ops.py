@@ -307,6 +307,48 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, *, k_scale=None,
               heads_per_step=heads_per_step)
 
 
+# ------------------------------------------- sequence-parallel prefill hop
+# the local step of ``inference/paged_modeling.py::prefill_sp``'s KV ring:
+# causal attention of a query-row shard against one rotating K/V shard,
+# returning (out fp32, lse fp32) for the streaming-softmax merge. The
+# Pallas impl rides the flash-attention block machinery under its own
+# tuning key ("sp_prefill"); the XLA reference is ring_attention's
+# ``_attn_with_lse`` — the SAME function the training-side jnp ring uses,
+# so serving and training sp paths can never drift numerically.
+
+
+def _sp_prefill_attention_xla(q, k, v, q_positions, kv_positions, *,
+                              sp_degree=1, block_q=None, block_kv=None):
+    from colossalai_tpu.shardformer.layer.ring_attention import _attn_with_lse
+
+    return _attn_with_lse(q, k, v, q_positions, kv_positions, causal=True)
+
+
+def _sp_prefill_attention_pallas(q, k, v, q_positions, kv_positions, *,
+                                 sp_degree=1, block_q=None, block_kv=None):
+    from .pallas.sp_prefill import sp_prefill_attention as impl
+
+    return impl(q, k, v, q_positions, kv_positions, sp_degree=sp_degree,
+                block_q=block_q, block_kv=block_kv)
+
+
+KernelLoader.register("sp_prefill_attention", "pallas", _pallas_module("sp_prefill"), _sp_prefill_attention_pallas)
+KernelLoader.register("sp_prefill_attention", "xla", lambda: True, _sp_prefill_attention_xla)
+
+
+def sp_prefill_attention(q, k, v, q_positions, kv_positions, *, sp_degree=1):
+    """One ring hop of sequence-parallel prefill attention. q
+    [B, Sq, Hq, D]; k/v [B, Skv, Hkv, D]; positions [B, Sq] / [B, Skv]
+    global token ids — invalid KV rows carry an out-of-range sentinel so
+    the position-exact causal mask (``q_pos >= kv_pos``) drops them.
+    Returns ``(out [B, Sq, Hq, D] fp32, lse [B, Hq, Sq] fp32)`` for
+    ``ring_attention._merge``. ``sp_degree`` keys the kernel's
+    tuning-cache dispatch (ring width changes the profitable tiling, not
+    the math)."""
+    fn = KernelLoader.load("sp_prefill_attention")
+    return fn(q, k, v, q_positions, kv_positions, sp_degree=sp_degree)
+
+
 # ---------------------------------------------------------------- fused MoE
 # ≙ the route→permute→expert-matmul→unpermute chain, collapsed: Pallas on
 # TPU (kernel/pallas/fused_moe.py), gather/einsum/scatter reference in XLA

@@ -19,6 +19,7 @@ from .softmax import (
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,
 )
+from .sp_prefill import sp_prefill_attention
 
 __all__ = [
     "flash_attention",
@@ -32,4 +33,5 @@ __all__ = [
     "rope_and_cache_update",
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",
+    "sp_prefill_attention",
 ]

@@ -1,0 +1,111 @@
+"""Sequence-parallel prefill attention: one ring hop's local compute.
+
+``inference/paged_modeling.py::prefill_sp`` shards a prefill chunk's
+query rows across the tp mesh axis and rotates the table-gathered K/V
+shards ring-wise (``jax.lax.ppermute``). Each hop computes masked
+attention between the LOCAL query shard ``[B, Sq/sp, Hq, D]`` and ONE
+K/V shard ``[B, Skv/sp, Hkv, D]`` and returns ``(out fp32, lse fp32)``
+— the streaming-softmax statistics ``ring_attention._merge`` folds
+across hops.
+
+This module is the hop's TPU path: the flash-attention block machinery
+(position-exact causal mask, GQA head folding) under ``(block_q,
+block_kv)`` caps tuned separately from the training flash keys
+(:func:`tuning.sp_prefill_blocks`) — the sp geometry is a SHORT query
+shard against a LONG rotating KV shard, the transpose of the square
+training case, so the two must not share a cache entry. Shapes the
+tiler cannot take (CPU-mesh tests, non-128-aligned shards, head dims
+below a lane) fall back to the jnp reference the XLA loader impl
+shares, so both backends agree bitwise off-TPU.
+
+Validity rides the positions: the caller maps never-written /
+beyond-frontier pool rows to an out-of-range sentinel position, so the
+causal mask ``q_pos >= kv_pos`` is the ONLY mask needed — no separate
+validity operand reaches the kernel, and a fully-masked row yields the
+finite-LSE sentinel the merge treats as weightless.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import (
+    DEFAULT_BLOCK_KV,
+    DEFAULT_BLOCK_Q,
+    flash_attention_with_lse,
+    pick_block,
+    supports,
+)
+
+
+def _tuned_caps(sq: int, skv: int, d: int, dtype, sp: int) -> Tuple[int, int]:
+    """(block_q, block_kv) caps from the persistent tuning cache; static
+    defaults off-TPU or on any tuning failure."""
+    from .. import tuning
+
+    if not tuning.tuning_enabled():
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV
+
+    bsq, bskv = tuning.bucket(sq), tuning.bucket(skv)
+
+    def measure(cand):
+        bq, bkv = cand
+        q = jnp.zeros((1, bsq, 4, d), dtype)
+        k = jnp.zeros((1, bskv, 1, d), dtype)
+        v = jnp.zeros((1, bskv, 1, d), dtype)
+        qp = jnp.broadcast_to(jnp.arange(bsq, dtype=jnp.int32)[None], (1, bsq))
+        kp = jnp.broadcast_to(jnp.arange(bskv, dtype=jnp.int32)[None], (1, bskv))
+        fn = jax.jit(functools.partial(
+            sp_prefill_attention, block_q=bq, block_kv=bkv,
+        ))
+        return tuning.time_fn(fn, q, k, v, qp, kp)
+
+    try:
+        return tuning.sp_prefill_blocks(
+            sq, skv, d, dtype, sp, measure,
+            (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV),
+        )
+    except Exception:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV
+
+
+def sp_prefill_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_positions: jax.Array,
+    kv_positions: jax.Array,
+    *,
+    sp_degree: int = 1,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One ring hop: causal attention of a query shard against one K/V
+    shard. q ``[B, Sq, Hq, D]``; k/v ``[B, Skv, Hkv, D]``; positions
+    ``[B, Sq]`` / ``[B, Skv]`` global token ids (invalid KV rows carry an
+    out-of-range sentinel so the causal mask drops them). Returns
+    ``(out [B, Sq, Hq, D] fp32, lse [B, Hq, Sq] fp32)`` for the
+    streaming merge. ``sp_degree`` keys the tuning-cache entry (it does
+    not change the math — the ICI overlap profile differs per ring
+    width, so measurements must not cross degrees)."""
+    b, sq, hq, d = q.shape
+    skv = k.shape[1]
+    if supports(q.shape, k.shape, block_q, block_kv):
+        if block_q is None or block_kv is None:
+            cq, ckv = _tuned_caps(sq, skv, d, q.dtype, sp_degree)
+            block_q = block_q or pick_block(sq, cq)
+            block_kv = block_kv or pick_block(skv, ckv)
+        out, lse = flash_attention_with_lse(
+            q, k, v, causal=True,
+            q_positions=q_positions, kv_positions=kv_positions,
+            block_q=block_q, block_kv=block_kv,
+        )
+        return out.astype(jnp.float32), lse
+    # odd shapes: the jnp reference the XLA loader impl also resolves to
+    from colossalai_tpu.shardformer.layer.ring_attention import _attn_with_lse
+
+    return _attn_with_lse(q, k, v, q_positions, kv_positions, causal=True)

@@ -278,6 +278,35 @@ def flash_blocks(
     )
 
 
+def sp_prefill_blocks(
+    sq: int, skv: int, d: int, dtype, sp: int,
+    measure: Callable[[Tuple[int, int]], float],
+    default: Tuple[int, int],
+) -> Tuple[int, int]:
+    """(block_q cap, block_kv cap) for the sequence-parallel prefill hop
+    (kernel/pallas/sp_prefill.py). The geometry is a SHORT local query
+    shard against a LONG rotating K/V shard — the transpose of the
+    square training flash case — so the profitable tiling differs and
+    the entry is keyed separately (``"sp_prefill"``). ``sp`` (the ring
+    width) is part of the key: the same local shapes under a wider ring
+    see a different compute/ICI overlap, and a winner measured at sp=2
+    must not decide sp=8's tiling. The result is a CAP — callers still
+    run ``pick_block`` so non-bucket shards stay legal."""
+    bq, bkv = bucket(sq), bucket(skv)
+    cands: List[Tuple[int, int]] = [
+        c for c in (
+            (128, 1024), (256, 1024), (256, 2048), (512, 1024),
+            (512, 2048), (512, 512), (1024, 1024),
+        )
+        if c[0] <= bq and c[1] <= bkv
+    ] or [default]
+    return get_tuner().tune(
+        "sp_prefill",
+        (device_kind(), bq, bkv, d, _dt(dtype), int(sp)),
+        cands, measure, default,
+    )
+
+
 def norm_rows(
     kernel: str, n: int, h: int, dtype,
     measure: Callable[[int], float], default: int,

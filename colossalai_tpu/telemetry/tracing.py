@@ -56,10 +56,11 @@ SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
 #: in docs/observability.md are all checked against; extend all three
 #: together or none
 SPAN_CATALOG = frozenset({
-    "request", "queue", "prefill", "prefill_chunk", "prefill_stall",
-    "first_token", "decode_megastep", "spec_megastep", "prefix_cache_hit",
-    "prefix_cache_evict", "page_refund", "router.place", "router.sync",
-    "shed", "preempt", "resume", "kv_transfer",
+    "request", "queue", "prefill", "prefill_chunk", "prefill_sp",
+    "prefill_stall", "first_token", "decode_megastep", "spec_megastep",
+    "prefix_cache_hit", "prefix_cache_evict", "page_refund",
+    "router.place", "router.sync", "shed", "preempt", "resume",
+    "kv_transfer",
 })
 
 

@@ -260,10 +260,10 @@ def test_span_names_match_grammar_over_engine_smoke():
     from colossalai_tpu.telemetry import SPAN_CATALOG
 
     catalog = {"request", "queue", "prefill", "prefill_chunk",
-               "prefill_stall", "first_token", "decode_megastep",
-               "spec_megastep", "prefix_cache_hit", "prefix_cache_evict",
-               "page_refund", "router.place", "router.sync",
-               "shed", "preempt", "resume", "kv_transfer"}
+               "prefill_sp", "prefill_stall", "first_token",
+               "decode_megastep", "spec_megastep", "prefix_cache_hit",
+               "prefix_cache_evict", "page_refund", "router.place",
+               "router.sync", "shed", "preempt", "resume", "kv_transfer"}
     assert catalog == set(SPAN_CATALOG)
     assert names <= catalog, names - catalog
 

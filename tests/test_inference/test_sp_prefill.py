@@ -1,0 +1,223 @@
+"""Sequence-parallel prefill (sp_prefill=) and its satellites.
+
+The tentpole contract: sharding a long prompt's prefill attention over
+the tp mesh axis (paged_modeling.prefill_sp — query rows split, K/V
+ring-rotated, streaming-softmax merge) changes NOTHING a client can
+see — greedy outputs are token-identical to the monolithic path with
+every composition the engine supports on a tp mesh (int8 KV pages,
+prefix cache warm/cold, chunked prefill). Plus:
+
+- prefill_sp vs prefill_chunk_paged direct numerics: layer-0 pages
+  bitwise identical (the projection path is op-for-op the same), final
+  logits argmax-equal;
+- long chunked prompts crossing many chunk boundaries with
+  non-block-aligned tails stay token-identical to single-shot prefill
+  under chunked × prefix-cache × int8 (the satellite matrix);
+- the chunked-GROUP follower-tail reservation: a competitor admitted
+  mid-chunked-prefill must not starve the leader's final chunk into
+  OutOfBlocks (tail pages are allocated at admission now);
+- knob validation fails fast (no mesh / pp mesh).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine
+from colossalai_tpu.inference.kv_cache import init_paged_cache
+from colossalai_tpu.inference.paged_modeling import (
+    prefill_chunk_paged,
+    prefill_sp,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    """f32 compute: the sp ring's only numeric delta vs monolithic is
+    merge ordering — float-epsilon, which greedy argmax absorbs."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("seed", 0)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _prompt(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, 100, size=n).tolist()
+
+
+# --------------------------------------------------------------- numerics
+def test_prefill_sp_matches_chunk_paged_directly(parts, mesh):
+    """prefill_sp IS prefill_chunk_paged with the attention ring-sharded:
+    layer-0 pages (projections only — no attention upstream) must be
+    bitwise identical, logits argmax-equal with fp32-epsilon diffs."""
+    cfg, params = parts
+    bs, max_blocks = 16, 8
+    cache_a = init_paged_cache(cfg, 1 + max_blocks, bs, dtype=jnp.float32)
+    cache_b = jax.tree.map(jnp.copy, cache_a)
+    C, n_valid = 64, 37  # non-block-aligned tail
+    ids = np.zeros((1, C), np.int32)
+    ids[0, :n_valid] = _prompt(n_valid)
+    table = np.arange(1, 1 + max_blocks, dtype=np.int32)
+
+    la, cache_a = prefill_chunk_paged(
+        params, cfg, jnp.asarray(ids), jnp.asarray(0, jnp.int32),
+        jnp.asarray(n_valid, jnp.int32), cache_a, jnp.asarray(table))
+    lb, cache_b = prefill_sp(
+        params, cfg, jnp.asarray(ids), jnp.asarray(0, jnp.int32),
+        jnp.asarray(n_valid, jnp.int32), cache_b, jnp.asarray(table), mesh)
+
+    la, lb = np.asarray(la), np.asarray(lb)
+    assert la.argmax() == lb.argmax()
+    np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-4)
+    # layer 0: nothing upstream of the k/v projection differs
+    np.testing.assert_array_equal(np.asarray(cache_a.k)[0],
+                                  np.asarray(cache_b.k)[0])
+    # deeper layers: attention feeds the next projection — close, not bitwise
+    np.testing.assert_allclose(np.asarray(cache_a.k), np.asarray(cache_b.k),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------- engine token identity
+@pytest.mark.parametrize("compose", [
+    {},
+    {"kv_dtype": "int8"},
+    {"kv_dtype": "int8", "prefix_cache": True, "prefill_chunk": 32},
+])
+def test_sp_engine_tokens_identical_to_monolithic(parts, mesh, compose):
+    """The acceptance gate: sp on vs off, greedy, token-identical — with
+    int8 KV and prefix cache + chunked prefill composed on top."""
+    prompts = [_prompt(50, seed=1), _prompt(37, seed=2)]
+    gen = GenerationConfig(max_new_tokens=8)
+    base = _engine(parts, mesh=mesh, **compose).generate(prompts, gen)
+    eng = _engine(parts, mesh=mesh, sp_prefill=0, **compose)
+    got = eng.generate(prompts, gen)
+    assert got == base
+    assert eng.stats.prefill_sp_chunks > 0  # the ring actually ran
+
+
+def test_sp_warm_prefix_hit_suffix_only(parts, mesh):
+    """Warm pass shards only the uncached SUFFIX — tokens must still
+    match the cold pass exactly."""
+    eng = _engine(parts, mesh=mesh, sp_prefill=0, prefix_cache=True,
+                  kv_dtype="int8")
+    prompt = _prompt(50, seed=3)
+    gen = GenerationConfig(max_new_tokens=8)
+    cold = eng.generate([prompt], gen)[0]
+    warm = eng.generate([prompt], gen)[0]
+    assert warm == cold
+    assert eng.stats.prefix_hit_blocks > 0
+
+
+def test_sp_threshold_gates_short_prompts(parts, mesh):
+    """Below the threshold the monolithic program runs (sp_chunks stays
+    0); at/above it the ring runs."""
+    gen = GenerationConfig(max_new_tokens=4)
+    eng = _engine(parts, mesh=mesh, sp_prefill=64)
+    eng.generate([_prompt(20, seed=4)], gen)
+    assert eng.stats.prefill_sp_chunks == 0
+    eng.generate([_prompt(80, seed=4)], gen)
+    assert eng.stats.prefill_sp_chunks > 0
+
+
+def test_sp_knob_validation(parts):
+    cfg, params = parts
+    with pytest.raises(ValueError, match="tp mesh axis"):
+        LLMEngine(params, cfg, max_seq_len=128, block_size=16, sp_prefill=True)
+
+
+# -------------------------------------- chunk-boundary composition matrix
+def test_many_chunk_boundaries_nonaligned_tail_matrix(parts):
+    """Chunked prefill crossing several chunk boundaries with a
+    non-block-aligned tail, × prefix cache × int8 KV: greedy tokens must
+    match the single-shot prefill engine token-for-token (cold AND
+    warm)."""
+    prompt = _prompt(101, seed=5)  # 101 = 6×16 + 5: 4 chunks of 32, ragged
+    gen = GenerationConfig(max_new_tokens=6)
+    single = _engine(parts, max_seq_len=256).generate([prompt], gen)[0]
+    for kv_dtype in ("bf16", "int8"):
+        eng = _engine(parts, max_seq_len=256, prefill_chunk=32,
+                      prefix_cache=True, kv_dtype=kv_dtype)
+        cold = eng.generate([prompt], gen)[0]
+        warm = eng.generate([prompt], gen)[0]
+        if kv_dtype == "bf16":  # f32 compute + f32 pool: lossless pages
+            assert cold == single
+        assert warm == cold
+        assert eng.stats.prefill_chunks >= 4
+        assert eng.stats.prefix_hit_blocks > 0
+
+
+# ------------------------------------- group follower-tail reservation
+def test_group_tail_reserved_against_midprefill_competitor(parts):
+    """The OutOfBlocks regression: a grouped request mid-chunked-prefill
+    holds its followers' tail pages from ADMISSION, so a competitor
+    admitted on a later tick cannot starve the leader's final chunk.
+
+    The arithmetic reproduces the pre-fix death exactly: 8 usable pages;
+    the group (prompt 40, bucket 64, n_samples=2) funds 4 leader + 2
+    follower-tail pages; a 2-page competitor admitted between chunk 1
+    and the final chunk leaves 0 free — without the reservation,
+    _finish_prefill's tail allocation raised OutOfBlocks with the group
+    half-built (``_admit`` runs BEFORE ``_advance_prefills`` in a tick,
+    so the competitor really does get there first)."""
+    eng = _engine(parts, max_seq_len=128, block_size=16, num_blocks=9,
+                  prefill_buckets=(32, 64, 128), prefill_chunk=32)
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True)
+    group = eng.add_request(_prompt(40, seed=6), gen, n_samples=2)
+    assert isinstance(group, list) and len(group) == 2
+    eng.step()  # admits the group, runs chunk 1 of 2
+    assert eng.prefilling
+    # the follower's 2 tail pages are HELD, not merely funded: 8 - 4 - 2
+    # (pre-fix this read 4, and the competitor below would drain it to 0
+    # with the tail still unallocated)
+    assert eng.allocator.num_free == 2
+    # competitor arrives mid-prefill and takes the last free pages
+    eng.add_request(_prompt(20, seed=7), GenerationConfig(max_new_tokens=2))
+    done = {}
+    for _ in range(64):
+        for r in eng.step():
+            done[r.request_id] = r
+        if not eng.has_work:
+            break
+    assert not eng.has_work
+    # every group member finished normally — nobody died in OutOfBlocks
+    for rid in group:
+        assert rid in done
+        assert done[rid].finish_reason in ("eos", "length")
+    # no page leaked: drained engine returns to a full pool
+    assert eng.allocator.num_free == eng.allocator.num_blocks - 1
+
+
+def test_group_tail_reservation_freed_on_abort(parts):
+    """Aborting the leader mid-chunked-prefill must return the reserved
+    follower tails — no page leak."""
+    eng = _engine(parts, max_seq_len=128, block_size=16, num_blocks=12,
+                  prefill_chunk=32)
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True)
+    group = eng.add_request(_prompt(50, seed=8), gen, n_samples=2)
+    eng.step()  # mid-prefill, reservation held
+    assert eng.prefilling
+    held = eng.allocator.num_free
+    assert eng.abort(group[0])
+    assert eng.allocator.num_free == eng.allocator.num_blocks - 1
+    assert eng.allocator.num_free > held

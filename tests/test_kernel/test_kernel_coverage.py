@@ -39,7 +39,8 @@ def test_loader_ops_are_registered():
     deep inside a jitted forward."""
     from colossalai_tpu.kernel.loader import KernelLoader
 
-    for op in ("flash_attention", "rms_norm", "fused_moe", "paged_attention"):
+    for op in ("flash_attention", "rms_norm", "fused_moe", "paged_attention",
+               "sp_prefill_attention"):
         assert op in KernelLoader._registry, (
             f"kernel op {op!r} never registered with KernelLoader"
         )

@@ -1,0 +1,131 @@
+"""sp_prefill_attention parity + ring-merge algebra (kernel/pallas/sp_prefill.py).
+
+The op is one sequence-parallel prefill ring hop: a local query shard
+against one rotating K/V shard, returning (out fp32, lse fp32) for the
+streaming-softmax merge. Pins:
+
+- parity with a naive masked softmax under the position-exact causal
+  mask (validity rides the positions: sentinel rows must contribute
+  nothing);
+- merging per-shard hop results reproduces full attention exactly —
+  the algebraic identity ``prefill_sp`` (inference/paged_modeling.py)
+  rests on;
+- the 128-aligned flash path (interpret mode on CPU) agrees with the
+  jnp fallback the odd-shape / XLA loader path resolves to.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.kernel.ops import sp_prefill_attention as loader_op
+from colossalai_tpu.kernel.pallas.sp_prefill import sp_prefill_attention
+from colossalai_tpu.shardformer.layer.ring_attention import _merge
+
+#: an out-of-range position for invalid KV rows — same trick
+#: paged_modeling._SP_INVALID_POS uses: the causal mask IS the validity
+#: mask then
+SENTINEL = np.int32(2**30)
+
+
+def _naive(q, k, v, q_pos, kv_pos):
+    """Masked softmax reference, GQA-aware, fp32 accumulation."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    s = jnp.einsum("bshgd,bthd->bhgst", qf, k.astype(jnp.float32))
+    s = s * (d ** -0.5)
+    mask = q_pos[:, None, None, :, None] >= kv_pos[:, None, None, None, :]
+    s = jnp.where(mask, s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgst,bthd->bshgd", p, v.astype(jnp.float32))
+    return o.reshape(b, sq, hq, d)
+
+
+def _rand(key, *shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+def test_matches_naive_masked_softmax_with_sentinel_rows():
+    b, sq, skv, hq, hkv, d = 1, 16, 48, 4, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand(ks[0], b, sq, hq, d)
+    k = _rand(ks[1], b, skv, hkv, d)
+    v = _rand(ks[2], b, skv, hkv, d)
+    q_pos = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32)[None], (b, sq)) + 10
+    kv_pos = np.arange(skv, dtype=np.int32)
+    kv_pos[30:] = SENTINEL  # never-written pool rows
+    kv_pos = jnp.broadcast_to(jnp.asarray(kv_pos)[None], (b, skv))
+
+    out, lse = sp_prefill_attention(q, k, v, q_pos, kv_pos)
+    ref = _naive(q, k, v, q_pos, kv_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert out.dtype == jnp.float32 and lse.shape == (b, hq, sq)
+
+
+def test_shard_merge_equals_full_attention():
+    """Run the op per K/V shard and fold with _merge: the result must
+    equal one full-sequence call — the ring's correctness in miniature
+    (hop order must not matter either)."""
+    b, sq, skv, hq, hkv, d = 1, 8, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand(ks[0], b, sq, hq, d)
+    k = _rand(ks[1], b, skv, hkv, d)
+    v = _rand(ks[2], b, skv, hkv, d)
+    # queries sit at the END of the context so every kv row is visible
+    q_pos = jnp.broadcast_to(
+        jnp.arange(skv - sq, skv, dtype=jnp.int32)[None], (b, sq))
+    kv_pos = jnp.broadcast_to(jnp.arange(skv, dtype=jnp.int32)[None], (b, skv))
+
+    full, _ = sp_prefill_attention(q, k, v, q_pos, kv_pos)
+
+    half = skv // 2
+    shards = [(k[:, :half], v[:, :half], kv_pos[:, :half]),
+              (k[:, half:], v[:, half:], kv_pos[:, half:])]
+    for order in (shards, shards[::-1]):
+        (k0, v0, p0), (k1, v1, p1) = order
+        o0, l0 = sp_prefill_attention(q, k0, v0, q_pos, p0)
+        o1, l1 = sp_prefill_attention(q, k1, v1, q_pos, p1)
+        merged, _ = _merge(o0, l0, o1, l1)
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_flash_path_agrees_with_fallback():
+    """128-aligned shapes route through the flash block machinery
+    (interpret mode off-TPU); oddly-shaped ones through the jnp
+    reference. Both must agree."""
+    b, s, hq, hkv, d = 1, 128, 4, 2, 128
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand(ks[0], b, s, hq, d)
+    k = _rand(ks[1], b, s, hkv, d)
+    v = _rand(ks[2], b, s, hkv, d)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+
+    flash_out, flash_lse = sp_prefill_attention(
+        q, k, v, pos, pos, block_q=128, block_kv=128)
+    ref = _naive(q, k, v, pos, pos)
+    np.testing.assert_allclose(np.asarray(flash_out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    assert flash_lse.shape == (b, hq, s)
+
+
+def test_loader_resolves_off_tpu():
+    """The public kernel op (KernelLoader-dispatched) must resolve to the
+    XLA fallback on CPU and return the same (out, lse) contract."""
+    b, sq, skv, hq, hkv, d = 1, 4, 8, 2, 1, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(ks[0], b, sq, hq, d)
+    k = _rand(ks[1], b, skv, hkv, d)
+    v = _rand(ks[2], b, skv, hkv, d)
+    q_pos = jnp.broadcast_to(
+        jnp.arange(skv - sq, skv, dtype=jnp.int32)[None], (b, sq))
+    kv_pos = jnp.broadcast_to(jnp.arange(skv, dtype=jnp.int32)[None], (b, skv))
+    out, lse = loader_op(q, k, v, q_pos, kv_pos, sp_degree=2)
+    ref = _naive(q, k, v, q_pos, kv_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert out.shape == (b, sq, hq, d) and lse.shape == (b, hq, sq)

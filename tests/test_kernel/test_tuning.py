@@ -229,3 +229,49 @@ def test_fused_moe_block_i_round_trip(tmp_path, monkeypatch):
     calls.clear()
     assert tuning.fused_moe_block_i(4, 2, 64, 128, "float32", 16, measure) == 128
     assert calls == [] and t.misses == 2
+
+
+def test_sp_prefill_blocks_keys_on_ring_degree(tmp_path, monkeypatch):
+    """The sp-prefill hop tunes under its own "sp_prefill" kernel entry,
+    keyed by (seq buckets, head dim, dtype, RING DEGREE): the same local
+    shard shapes overlap compute with ICI differently per ring width, so
+    a winner measured at sp=2 must not decide sp=4's tiling — and a
+    repeat lookup at either degree must hit without re-benchmarking."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    times = {(128, 1024): 0.003, (256, 1024): 0.001, (256, 2048): 0.002,
+             (512, 1024): 0.004, (512, 2048): 0.005, (512, 512): 0.006,
+             (1024, 1024): 0.007}
+    calls = []
+
+    def measure(cand):
+        calls.append(cand)
+        return times[cand]
+
+    got = tuning.sp_prefill_blocks(1024, 4096, 128, "bfloat16", 2, measure,
+                                   default=(1024, 1024))
+    assert got == (256, 1024)  # the measured winner
+    assert t.misses == 1
+
+    # same geometry, wider ring → distinct key, measured again
+    got4 = tuning.sp_prefill_blocks(1024, 4096, 128, "bfloat16", 4, measure,
+                                    default=(1024, 1024))
+    assert got4 == (256, 1024) and t.misses == 2
+    keys = list(t.chosen)
+    assert any(k.endswith("|2") for k in keys), keys
+    assert any(k.endswith("|4") for k in keys), keys
+    assert all(k.startswith("sp_prefill|") for k in keys), keys
+
+    # repeat at sp=2: pure cache hit
+    calls.clear()
+    assert tuning.sp_prefill_blocks(1024, 4096, 128, "bfloat16", 2, measure,
+                                    default=(1024, 1024)) == (256, 1024)
+    assert calls == [] and t.hits == 1 and t.misses == 2
+
+    # shards too short for ANY candidate collapse to the default alone
+    calls.clear()
+    got_small = tuning.sp_prefill_blocks(128, 512, 128, "float32", 2, measure,
+                                         default=(1024, 1024))
+    assert got_small == (1024, 1024) and calls == [(1024, 1024)]
