@@ -186,4 +186,16 @@ class ElasticTrainer:
                         f"elastic: step failed ({type(exc).__name__}: {exc}); "
                         f"restart {self.restarts}/{self.max_restarts} from last checkpoint"
                     )
+                    # a failure inside an ASYNC checkpoint save surfaces
+                    # again at the next wait() — which _resume_if_possible
+                    # runs before restoring. Drain it here, inside THIS
+                    # restart's accounting, or one failed save would count
+                    # two restarts (once now, once at resume).
+                    try:
+                        self.booster.wait()
+                    except Exception as pending:
+                        self.logger.warning(
+                            "elastic: pending async checkpoint error drained "
+                            f"({type(pending).__name__}: {pending})"
+                        )
                     time.sleep(0.1)

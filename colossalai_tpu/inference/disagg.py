@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Set, Union
 from colossalai_tpu.telemetry.capacity import CapacityMonitor, fleet_capacity
 
 from .engine import EngineStats, GenerationConfig, LLMEngine, Request
+from .fault import RetryPolicy
 from .kv_cache import SequenceTable
 from .kv_transport import DeviceKVTransport, KVTransport, page_nbytes
 from .telemetry import SLOTracker, Telemetry, Tracer
@@ -167,9 +168,26 @@ class DisaggEngine:
         slo: Union[bool, SLOTracker, None] = True,
         overload=None,
         capacity=None,
+        fault=None,
+        retry: Optional[RetryPolicy] = None,
         **engine_kwargs,
     ):
         self.transport = transport if transport is not None else DeviceKVTransport()
+        #: shared FaultInjector (None = all seams disabled, zero cost);
+        #: also handed to both workers so the megastep_dispatch seam and
+        #: the HTTP server's http_generate seam see the same switchboard
+        self.fault = fault
+        #: backoff schedule for handoff splices whose KV transfer fails
+        #: (checksum mismatch, dropped buffer, injected raise)
+        self.retry = retry if retry is not None else RetryPolicy()
+        #: request_id → failed splice attempts since the last success
+        self._handoff_attempts: Dict[int, int] = {}
+        #: request_id → monotonic deadline before the next splice attempt
+        self._handoff_next_try: Dict[int, float] = {}
+        #: request_id → times this request went all the way back to the
+        #: prefill queue after exhausting its retry budget — the poison
+        #: pill guard finishes it with reason "error" past the cap
+        self._requeue_counts: Dict[int, int] = {}
         # ---- ONE telemetry facade for the pair (same validation contract
         # as LLMEngine): lifecycle stamps survive the handoff because the
         # Request object itself crosses, and both workers report into the
@@ -216,9 +234,11 @@ class DisaggEngine:
         pre_kw["megastep_k"] = 1  # ingestion only — this side never decodes
         pre_kw["overload"] = overload  # admission control gates HERE
         pre_kw["capacity"] = pre_cap
+        pre_kw["fault"] = fault
         pre_kw.update(prefill_overrides or {})
         dec_kw = dict(engine_kwargs)
         dec_kw["capacity"] = dec_cap
+        dec_kw["fault"] = fault
         dec_kw.update(decode_overrides or {})
         self.prefill = _PrefillWorker(
             params, config,
@@ -277,9 +297,10 @@ class DisaggEngine:
     def step(self) -> List[Request]:
         """One disaggregated tick: advance prompt ingestion, move every
         finished handoff the decode side can seat, then advance decode
-        megasteps. Both workers' finishes merge into one list."""
+        megasteps. Both workers' finishes merge into one list (the pump
+        contributes poison-pilled requests it finished with ``"error"``)."""
         finished = list(self.prefill.step())
-        self._pump_handoffs()
+        finished.extend(self._pump_handoffs())
         finished.extend(self.decode.step())
         return finished
 
@@ -301,22 +322,101 @@ class DisaggEngine:
         return [done[rid].output_ids for rid in order]
 
     # ------------------------------------------------------------- handoff
-    def _pump_handoffs(self) -> None:
+    def _pump_handoffs(self) -> List[Request]:
         """Splice finished prefills into the decode worker, FIFO. The
         per-pump ``dst_map`` keeps grouped-sampling page sharing intact
         across the boundary: a source page two members share is moved
         once and fork-shared on the decode side. Stops at the first
         request the decode side can't seat (no free slot / pages) — the
         queue holds, prefill-side pages stay live, and prompt ingestion
-        backpressures naturally."""
+        backpressures naturally.
+
+        A splice whose transfer FAILS (wire checksum mismatch, dropped
+        buffer, injected raise at the ``handoff_pump`` seam) is retried
+        under :attr:`retry`'s backoff: the request holds in the handoff
+        queue with a wall-clock ``next_try`` deadline — no sleeps, the
+        engine keeps stepping — while later handoffs pump past it. A
+        request that exhausts its retry budget requeues to the prefill
+        queue (pages released, re-prefills from scratch through the
+        resume path, token-identical); one that keeps failing across
+        ``>2`` requeues is a poison pill and finishes with reason
+        ``"error"`` — returned here so the serving loop reports it.
+        Returns the requests the pump finished this tick."""
+        finished: List[Request] = []
         if "decode" in self._draining:
-            return
+            return finished
         p = self.prefill
+        now = time.monotonic()
         dst_map: Dict[int, int] = {}
         for slot in list(p._handoff):
-            if not self._try_splice(p._handoff[slot], dst_map):
-                break
+            req = p._handoff[slot]
+            rid = req.request_id
+            if self._handoff_next_try.get(rid, 0.0) > now:
+                continue  # backing off — later handoffs may pump past
+            try:
+                if self.fault is not None:
+                    # raise/hang fire here; corrupt/drop belong to the
+                    # kv_transfer seam inside the transport
+                    self.fault.check("handoff_pump")
+                ok = self._try_splice(req, dst_map)
+            except Exception as exc:
+                self._note_splice_failure(slot, req, exc, finished)
+                continue
+            if not ok:
+                break  # capacity backpressure, not a failure: FIFO holds
             p.complete_handoff(slot)
+            self._handoff_attempts.pop(rid, None)
+            self._handoff_next_try.pop(rid, None)
+            self._requeue_counts.pop(rid, None)
+        return finished
+
+    def _note_splice_failure(self, slot: int, req: Request, exc: Exception,
+                             finished: List[Request]) -> None:
+        """One failed splice attempt: schedule a backoff retry, or —
+        budget exhausted — requeue to prefill / poison-pill the request."""
+        p, d = self.prefill, self.decode
+        rid = req.request_id
+        attempts = self._handoff_attempts.get(rid, 0) + 1
+        self._handoff_attempts[rid] = attempts
+        d.stats.kv_retries += 1
+        d.telemetry.trace_instant(req, "kv_retry", attempt=attempts,
+                                  error=type(exc).__name__)
+        if not self.retry.exhausted(attempts):
+            self._handoff_next_try[rid] = (
+                time.monotonic() + self.retry.delay(attempts))
+            return
+        # budget gone: this handoff is not completing by retry. Release
+        # the held prefill-side pages either way.
+        self._handoff_attempts.pop(rid, None)
+        self._handoff_next_try.pop(rid, None)
+        p._handoff.pop(slot)
+        p._release(slot, req)
+        p._reserved.discard(slot)
+        requeues = self._requeue_counts.get(rid, 0) + 1
+        self._requeue_counts[rid] = requeues
+        if req.group_ids is not None or requeues > 2:
+            # grouped members share interleaved pages — not individually
+            # re-prefillable; and a request that failed through multiple
+            # full prefill+retry cycles is a poison pill. Terminal either
+            # way: reason "error" keeps the invariant balancing.
+            self._requeue_counts.pop(rid, None)
+            req.slot = None
+            req.table = None
+            p._finish(req, "error")
+            finished.append(req)
+            return
+        # back to the prefill queue: prompt + committed first token ride
+        # the Request object, so re-admission replays the resume path
+        req.slot = None
+        req.table = None
+        req.prefill_pos = 0
+        req.cached_blocks = []
+        req.group_slots = None
+        if p.prefix_cache is not None and req.cache_node is not None:
+            p.prefix_cache.unpin(req.cache_node)
+        req.cache_node = None
+        p.waiting.append(req)
+        p.stats.handoff_requeues += 1
 
     def _try_splice(self, req: Request, dst_map: Dict[int, int]) -> bool:
         """Move one request's KV pages into the decode pool and seat it
@@ -337,9 +437,11 @@ class DisaggEngine:
         t0 = time.monotonic()
         fresh_dst = d.allocator.allocate(len(fresh_src))
         dst_blocks: List[int] = []
+        forked: List[int] = []
         for b in src_blocks:
             if b in dst_map:
                 d.allocator.fork([dst_map[b]])  # group-shared page: reuse
+                forked.append(dst_map[b])
             else:
                 dst_map[b] = fresh_dst.pop(0)
             dst_blocks.append(dst_map[b])
@@ -348,21 +450,34 @@ class DisaggEngine:
         copy_dst = [dst_map[s] for s in fresh_src]
         moved = 0
         nbytes = 0
-        if fresh_src:
-            d.cache = self.transport.transfer(
-                p.cache, d.cache, fresh_src, copy_dst)
-            moved = len(fresh_src)
-            nbytes = moved * self._page_bytes
-            if d.draft_len and d.draft_cache is not None:
-                # the draft pool mirrors the target's block ids on both
-                # sides: the prefill worker ingested the prompt into its
-                # draft pool at these src ids, so the same index move lands
-                # draft KV at the same dst ids the decode-side spec
-                # megastep will read
-                d.draft_cache = self.transport.transfer(
-                    p.draft_cache, d.draft_cache, fresh_src, copy_dst)
-                moved += len(fresh_src)
-                nbytes += len(fresh_src) * self._draft_page_bytes
+        try:
+            if fresh_src:
+                d.cache = self.transport.transfer(
+                    p.cache, d.cache, fresh_src, copy_dst)
+                moved = len(fresh_src)
+                nbytes = moved * self._page_bytes
+                if d.draft_len and d.draft_cache is not None:
+                    # the draft pool mirrors the target's block ids on both
+                    # sides: the prefill worker ingested the prompt into its
+                    # draft pool at these src ids, so the same index move
+                    # lands draft KV at the same dst ids the decode-side
+                    # spec megastep will read
+                    d.draft_cache = self.transport.transfer(
+                        p.draft_cache, d.draft_cache, fresh_src, copy_dst)
+                    moved += len(fresh_src)
+                    nbytes += len(fresh_src) * self._draft_page_bytes
+        except Exception:
+            # a failed transfer (checksum mismatch, dropped buffer,
+            # injected fault) must leave the decode pool exactly as it
+            # was: drop the fork refs, release the fresh pages, and
+            # retract this call's dst_map entries — the retrying pump
+            # starts a clean splice. Prefill-side pages are untouched.
+            if forked:
+                d.allocator.free(forked)
+            d.allocator.free(copy_dst)
+            for s in fresh_src:
+                del dst_map[s]
+            raise
         t1 = time.monotonic()
         d.stats.kv_transfers += 1
         d.stats.kv_transfer_blocks += moved

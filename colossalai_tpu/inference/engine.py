@@ -56,7 +56,7 @@ import dataclasses
 import functools
 import itertools
 import time
-from typing import Dict, List, Optional, Set, Union
+from typing import Dict, List, Optional, Set, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -250,6 +250,18 @@ class EngineStats:
     kv_transfer_blocks: int = 0
     #: bytes those pages represent (k + v + int8 k/v scales, both pools)
     kv_transfer_bytes: int = 0
+    # ---- fault tolerance (inference/fault.py): the terminal invariant
+    # widens to completed + aborted + shed + error == submitted.
+    #: requests finished with terminal reason "error" — the poison-pill
+    #: guard for repeatedly-failing handoffs and failovers with no
+    #: surviving replica (never a client abort, never a natural finish)
+    requests_error: int = 0
+    #: failed handoff-splice / KV-transfer attempts that were retried
+    #: under the RetryPolicy (each backoff round counts once)
+    kv_retries: int = 0
+    #: handoffs whose retry budget ran out and were requeued to the
+    #: prefill waiting queue instead of poisoning the decode worker
+    handoff_requeues: int = 0
 
     @property
     def spec_acceptance_rate(self) -> float:
@@ -382,8 +394,14 @@ class LLMEngine:
         moe_impl: str = "auto",
         kv_dtype: str = "bf16",
         sp_prefill: Union[bool, int, None] = None,
+        fault=None,
     ):
         self.config = config
+        #: optional seeded FaultInjector (inference/fault.py) checked at
+        #: the ``megastep_dispatch`` seam (and ``http_generate`` by the
+        #: server). None (the default) is the zero-overhead path — every
+        #: check site gates on ``is not None``.
+        self.fault = fault
         # ---- observability: lifecycle stamps + histograms are host-side
         # floats observed at scheduling boundaries that exist anyway, so
         # the default is ON (device traffic provably unchanged — asserted
@@ -1504,6 +1522,12 @@ class LLMEngine:
     def _decode_tick(self, finished: List[Request]) -> None:
         if not self.running:
             return
+        if self.fault is not None:
+            # the megastep_dispatch seam fires BEFORE any state mutation,
+            # so an injected raise leaves the engine consistent and its
+            # in-flight work evacuable (router failover resumes it
+            # token-identically elsewhere)
+            self.fault.check("megastep_dispatch")
         # span attribution: ONE wall interval per tick (funding through
         # commit), attributed below to every sampled request that lived
         # through it — two monotonic() calls, no device traffic
@@ -1748,6 +1772,11 @@ class LLMEngine:
             self.stats.requests_aborted += count
         elif reason == "shed":
             self.stats.requests_shed += count
+        elif reason == "error":
+            # poison pill / failover-with-no-survivor: its own terminal
+            # bucket so the invariant stays assertable as
+            # completed + aborted + shed + error == submitted
+            self.stats.requests_error += count
         else:
             self.stats.requests_completed += count
             if reason == "truncated":
@@ -1815,6 +1844,55 @@ class LLMEngine:
         self.stats.requests_preempted += 1
         self.telemetry.trace_instant(req, "preempt", tokens=len(ctx))
         self.waiting.append(req)
+
+    def evacuate(self) -> Tuple[List[Request], List[Request]]:
+        """Strip EVERY in-flight request off this engine — the failover
+        primitive the Router calls on a replica it declared dead. Running
+        singles leave via the preempt path (pages donated to the prefix
+        cache, request reset to prompt + committed output — resumable
+        token-identically on any replica); chunked-prefill leaders
+        release their slots/pages/reservations and restart from scratch;
+        the waiting queue drains whole. Running GROUP members are not
+        resumable (their pages interleave with their siblings') and
+        finish with terminal reason ``"error"``. Returns ``(movable,
+        finished)``: requests a surviving replica can adopt into its
+        waiting queue, and requests terminally finished here (errored
+        group members plus any shed-but-unreported backlog) the caller
+        must still surface to its scheduler."""
+        finished: List[Request] = []
+        for slot, req in list(self.running.items()):
+            if req.group_ids is None:
+                self._preempt_slot(slot, req)
+            else:
+                self._release(slot, req)
+                self._finish(req, "error")
+                finished.append(req)
+        seen = set()
+        for slot, req in list(self.prefilling.items()):
+            if id(req) in seen:
+                continue  # a group leader may key several slots
+            seen.add(id(req))
+            self._reserved.difference_update(req.group_slots or [])
+            self._release(slot, req)
+            req.slot = None
+            req.table = None
+            req.prefill_pos = 0
+            req.cached_blocks = []
+            req.group_slots = None
+            self.waiting.append(req)
+        movable = list(self.waiting)
+        self.waiting.clear()
+        for req in movable:
+            # the cache node points into THIS engine's radix tree — a
+            # survivor re-walks its own tree at admission
+            if self.prefix_cache is not None and req.cache_node is not None:
+                self.prefix_cache.unpin(req.cache_node)
+            req.cache_node = None
+        # a shed-but-unreported backlog would never surface once the
+        # router stops stepping this replica — hand it back now
+        finished.extend(self._shed_done)
+        self._shed_done.clear()
+        return movable, finished
 
     def _preempt_for_priority(self) -> None:
         """Priority preemption (step() runs this before _admit): when the

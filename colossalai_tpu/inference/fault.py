@@ -1,0 +1,262 @@
+"""Seeded fault injection + retry policy for the serving fleet.
+
+Serving crosses more and more seams — router → replica step, prefill →
+decode handoff, KV page transfer, HTTP ingress — and every one of them
+can raise, hang, or deliver garbage in production. This module gives the
+fleet one deterministic way to *prove* it survives those failures:
+
+- :class:`FaultInjector` — a seeded chaos switchboard registered at
+  named seams. Off by default: components hold ``fault=None`` and gate
+  every check on ``is not None``, so the disabled path costs nothing and
+  the transfer-counter byte-identity gates keep holding. Armed, it fires
+  at exact invocation counts (``at=``/``times=``), so a chaos test run
+  twice kills the same replica on the same step.
+- :class:`RetryPolicy` — bounded exponential backoff with deterministic
+  jitter for the cross-worker seams (disagg handoff pump, KV transport):
+  the delay schedule is a pure function of ``(seed, attempt)``, so a
+  retry storm replays identically under test.
+- :class:`InjectedFault` — the exception ``raise``/``hang`` faults
+  surface as; a ``RuntimeError`` so existing crash-retry machinery
+  (``elastic/trainer.py``) treats it like any real crash.
+
+Seam catalog (the only names ``arm``/``check`` accept):
+
+=================== ====================================================
+``replica_step``    the Router about to call one replica's ``step()``
+``kv_transfer``     a KVTransport page move (disagg handoff splice)
+``handoff_pump``    the disagg pump about to splice one finished prefill
+``megastep_dispatch`` the engine about to dispatch a decode megastep
+``http_generate``   the HTTP server about to admit a ``/generate`` body
+=================== ====================================================
+
+Modes: ``raise`` (throw :class:`InjectedFault`), ``hang`` (sleep
+``hang_s`` then return — long enough for a watchdog deadline to trip,
+bounded so tests terminate), ``corrupt`` (the caller routes payload
+bytes through :meth:`FaultInjector.corrupt_bytes`, which flips seeded
+byte positions — the CRC32 wire checksum must catch it), ``drop``
+(returned to the caller, which discards the payload as if it never
+arrived).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: every seam a component may register a check at — ``arm`` validates
+#: against this so a typo'd seam name fails loudly instead of never firing
+FAULT_SEAMS = (
+    "replica_step",
+    "kv_transfer",
+    "handoff_pump",
+    "megastep_dispatch",
+    "http_generate",
+)
+
+FAULT_MODES = ("raise", "hang", "corrupt", "drop")
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic injected failure (``raise``/``hang`` modes)."""
+
+    def __init__(self, seam: str, mode: str = "raise"):
+        super().__init__(f"injected fault at seam {seam!r} (mode={mode})")
+        self.seam = seam
+        self.mode = mode
+
+
+@dataclasses.dataclass
+class _Arm:
+    """One armed fault: fire on invocations ``at .. at+times-1`` of its
+    seam (1-based; ``times=-1`` fires forever once reached). ``key``
+    narrows the arm to checks carrying the same key (e.g. a replica
+    index) — the invocation count is then per ``(seam, key)``, so "kill
+    replica 1 on its 3rd step" is exact even when replicas step on
+    concurrent threads."""
+
+    mode: str
+    at: int
+    times: int
+    hang_s: float
+    key: object = None
+    fired: int = 0
+
+    def due(self, call_no: int) -> bool:
+        if call_no < self.at:
+            return False
+        return self.times < 0 or self.fired < self.times
+
+
+class FaultInjector:
+    """Seeded, deterministic fault switchboard for the serving seams.
+
+    Thread-safe (router step threads and HTTP handler threads may check
+    concurrently). ``stats()``/``prom_counters()`` expose the check and
+    injection counts — rendered as the ``clt_fault_*`` Prometheus
+    families by any server the injector is attached to.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._arms: Dict[str, List[_Arm]] = {}
+        #: (seam, key) → invocation count; (seam, None) is the seam total
+        self._calls: Dict[tuple, int] = {}
+        self._injected: Dict[str, int] = {m: 0 for m in FAULT_MODES}
+
+    # --------------------------------------------------------------- arming
+    def arm(self, seam: str, mode: str, at: int = 1, times: int = 1,
+            hang_s: float = 0.05, key=None) -> "FaultInjector":
+        """Schedule ``times`` consecutive faults of ``mode`` starting at
+        the ``at``-th invocation of ``seam`` (1-based). ``times=-1`` fires
+        on every invocation from ``at`` on. ``key`` restricts the arm to
+        checks carrying the same key (the Router checks ``replica_step``
+        with ``key=<replica index>``) and counts invocations per key.
+        Returns self (chainable)."""
+        if seam not in FAULT_SEAMS:
+            raise ValueError(f"unknown seam {seam!r}; one of {FAULT_SEAMS}")
+        if mode not in FAULT_MODES:
+            raise ValueError(f"unknown mode {mode!r}; one of {FAULT_MODES}")
+        if at < 1:
+            raise ValueError(f"at={at} must be >= 1 (1-based invocation)")
+        with self._lock:
+            self._arms.setdefault(seam, []).append(
+                _Arm(mode=mode, at=int(at), times=int(times),
+                     hang_s=float(hang_s), key=key))
+        return self
+
+    def disarm(self, seam: Optional[str] = None) -> None:
+        """Drop every armed fault (for ``seam``, or all of them). Call
+        counters keep advancing so re-arming stays deterministic."""
+        with self._lock:
+            if seam is None:
+                self._arms.clear()
+            else:
+                self._arms.pop(seam, None)
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return any(self._arms.values())
+
+    # --------------------------------------------------------------- firing
+    def _fire(self, seam: str, key=None):
+        """Advance the seam's invocation counters; return the fault that
+        fires on THIS invocation (None = pass through). An unkeyed arm
+        schedules against the seam's total invocation count; a keyed arm
+        against the per-key count."""
+        if seam not in FAULT_SEAMS:
+            raise ValueError(f"unknown seam {seam!r}; one of {FAULT_SEAMS}")
+        with self._lock:
+            total = self._calls[(seam, None)] = \
+                self._calls.get((seam, None), 0) + 1
+            keyed = total
+            if key is not None:
+                keyed = self._calls[(seam, key)] = \
+                    self._calls.get((seam, key), 0) + 1
+            for arm in self._arms.get(seam, ()):
+                if arm.key is not None and arm.key != key:
+                    continue
+                call_no = total if arm.key is None else keyed
+                if arm.due(call_no):
+                    arm.fired += 1
+                    self._injected[arm.mode] += 1
+                    return arm if arm.mode == "hang" else arm.mode
+        return None
+
+    def check(self, seam: str, key=None) -> Optional[str]:
+        """The inline seam hook. Raises :class:`InjectedFault` for a due
+        ``raise`` fault; sleeps then returns ``"hang"`` for a due hang
+        (the caller's watchdog sees the stall); returns ``"corrupt"`` /
+        ``"drop"`` for the caller to apply; returns None when clean."""
+        hit = self._fire(seam, key)
+        if hit is None:
+            return None
+        if isinstance(hit, _Arm):  # hang carries its duration
+            time.sleep(hit.hang_s)
+            return "hang"
+        if hit == "raise":
+            raise InjectedFault(seam, "raise")
+        return hit
+
+    def corrupt_bytes(self, seam: str, buf: bytes) -> bytes:
+        """Flip a few seeded byte positions of ``buf`` — byte positions
+        come from the injector's seeded rng, so the same seed corrupts
+        the same offsets. Used by transports when ``check`` returned
+        ``"corrupt"``."""
+        if not buf:
+            return buf
+        out = bytearray(buf)
+        n_flips = min(4, len(out))
+        # skip the first 12 bytes when possible so the corruption lands in
+        # header/payload content, not the magic — exercising the checksum,
+        # not just the magic guard
+        lo = 12 if len(out) > 64 else 0
+        for _ in range(n_flips):
+            pos = self._rng.randrange(lo, len(out))
+            out[pos] ^= 0xFF
+        return bytes(out)
+
+    # ---------------------------------------------------------- observability
+    def stats(self) -> Dict[str, int]:
+        """Cumulative check/injection counters, one key per seam and per
+        mode — the raw dict behind ``prom_counters``."""
+        with self._lock:
+            d = {f"checks_{s}": self._calls.get((s, None), 0)
+                 for s in FAULT_SEAMS}
+            d.update({f"injected_{m}": c for m, c in self._injected.items()})
+            d["injected_total"] = sum(self._injected.values())
+            return d
+
+    def prom_counters(self) -> Dict[str, int]:
+        """The ``clt_fault_*`` Prometheus families (the exposition layer
+        adds the ``clt_`` prefix)."""
+        return {f"fault_{k}": v for k, v in self.stats().items()}
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``delay(attempt)`` (1-based) is a pure function of the policy's
+    ``seed`` and the attempt number: ``base * 2^(attempt-1)`` capped at
+    ``max_delay_s``, stretched by up to ``jitter`` fraction using a
+    per-attempt seeded draw — two policies with the same knobs produce
+    the same schedule, so retry timing never makes a chaos test flaky.
+    """
+
+    def __init__(self, max_retries: int = 3, base_delay_s: float = 0.005,
+                 max_delay_s: float = 0.25, jitter: float = 0.25,
+                 seed: int = 0):
+        if max_retries < 0:
+            raise ValueError(f"max_retries={max_retries} must be >= 0")
+        if base_delay_s < 0 or max_delay_s < base_delay_s:
+            raise ValueError(
+                f"need 0 <= base_delay_s <= max_delay_s, got "
+                f"base={base_delay_s} max={max_delay_s}")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter={jitter} must be in [0, 1]")
+        self.max_retries = int(max_retries)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        if attempt < 1:
+            raise ValueError(f"attempt={attempt} must be >= 1")
+        d = min(self.base_delay_s * (2.0 ** (attempt - 1)), self.max_delay_s)
+        if self.jitter:
+            # int-seeded Random is stable across processes (unlike hash()
+            # of strings) — the schedule really is deterministic
+            frac = random.Random(self.seed * 1000003 + attempt).random()
+            d = min(d * (1.0 + self.jitter * frac), self.max_delay_s)
+        return d
+
+    def exhausted(self, attempts: int) -> bool:
+        """True once ``attempts`` failures mean no retry budget remains."""
+        return attempts > self.max_retries

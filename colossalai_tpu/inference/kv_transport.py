@@ -40,6 +40,7 @@ import dataclasses
 import functools
 import json
 import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -58,7 +59,11 @@ __all__ = [
 ]
 
 _WIRE_MAGIC = b"CKVT"
-_WIRE_VERSION = 1
+#: v1 carried no integrity field; v2 adds a CRC32 of the tensor payload
+#: to the header. Writers emit v2; readers accept both (a v1 buffer just
+#: skips the checksum verification).
+_WIRE_VERSION = 2
+_WIRE_KNOWN_VERSIONS = (1, 2)
 
 
 def pool_geometry(cache: PagedKVCache) -> Tuple:
@@ -181,6 +186,7 @@ class PageBlockWire:
         arrays = [("k", self.k), ("v", self.v)]
         if self.quantized:
             arrays += [("k_scale", self.k_scale), ("v_scale", self.v_scale)]
+        payloads = [np.ascontiguousarray(a).tobytes() for _name, a in arrays]
         header = {
             "kv_dtype": self.kv_dtype,
             "block_size": self.block_size,
@@ -189,23 +195,56 @@ class PageBlockWire:
                 {"name": name, "shape": list(a.shape), "dtype": a.dtype.name}
                 for name, a in arrays
             ],
+            # integrity: CRC32 over the concatenated tensor payload. A
+            # flipped bit anywhere in the page bytes fails verification in
+            # from_bytes instead of silently splicing garbage KV — the
+            # disagg pump's retry loop keys off that ValueError.
+            "crc32": zlib.crc32(b"".join(payloads)) & 0xFFFFFFFF,
         }
         hdr = json.dumps(header).encode()
         parts = [_WIRE_MAGIC, struct.pack("<II", _WIRE_VERSION, len(hdr)), hdr]
-        parts += [np.ascontiguousarray(a).tobytes() for _name, a in arrays]
+        parts += payloads
         return b"".join(parts)
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "PageBlockWire":
         if buf[:4] != _WIRE_MAGIC:
             raise ValueError("not a KV page-block wire buffer (bad magic)")
+        if len(buf) < 12:
+            raise ValueError(
+                f"truncated wire buffer: {len(buf)} bytes is shorter than "
+                "the 12-byte preamble")
         version, hdr_len = struct.unpack("<II", buf[4:12])
-        if version != _WIRE_VERSION:
+        if version not in _WIRE_KNOWN_VERSIONS:
             raise ValueError(f"unsupported wire version {version}")
-        header = json.loads(buf[12:12 + hdr_len].decode())
+        if 12 + hdr_len > len(buf):
+            raise ValueError(
+                f"truncated wire buffer: header claims {hdr_len} bytes but "
+                f"only {len(buf) - 12} follow the preamble")
+        try:
+            header = json.loads(buf[12:12 + hdr_len].decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"malformed wire header: {e}") from None
         off = 12 + hdr_len
+        expected = 0
+        specs = header["arrays"]
+        for spec in specs:
+            count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+            expected += count * np.dtype(jnp.dtype(spec["dtype"])).itemsize
+        if off + expected > len(buf):
+            raise ValueError(
+                f"truncated payload: header describes {expected} tensor "
+                f"bytes but only {len(buf) - off} are present")
+        if off + expected < len(buf):
+            raise ValueError(
+                f"header/tensor length mismatch: header describes "
+                f"{expected} tensor bytes but {len(buf) - off} are present")
+        crc = header.get("crc32")
+        if crc is not None and zlib.crc32(buf[off:]) & 0xFFFFFFFF != crc:
+            raise ValueError(
+                "wire payload checksum mismatch (corrupt transfer)")
         fields: Dict[str, np.ndarray] = {}
-        for spec in header["arrays"]:
+        for spec in specs:
             # bf16 has no stock numpy dtype name — resolve through jnp,
             # which maps both standard names and ml_dtypes extensions
             dt = np.dtype(jnp.dtype(spec["dtype"]))
@@ -315,10 +354,16 @@ class HostKVTransport(KVTransport):
     :class:`DeviceKVTransport` — the seam test for later cross-host
     transports."""
 
-    def __init__(self, serialize: bool = True):
+    def __init__(self, serialize: bool = True, fault=None):
         #: round-trip the buffer through bytes (the honest wire rehearsal);
         #: False skips the copy for in-process staging benchmarks
         self.serialize = serialize
+        #: optional FaultInjector (inference/fault.py) checked at the
+        #: ``kv_transfer`` seam: ``corrupt`` flips seeded buffer bytes so
+        #: the CRC32 verification trips; ``drop`` discards the buffer as
+        #: if it never arrived (both surface as the ValueError the disagg
+        #: pump retries on). None (the default) costs nothing.
+        self.fault = fault
 
     def transfer(self, src: PagedKVCache, dst: PagedKVCache,
                  src_blocks: List[int], dst_blocks: List[int]) -> PagedKVCache:
@@ -332,5 +377,13 @@ class HostKVTransport(KVTransport):
             return dst
         wire = self.pack(src, src_blocks)
         if self.serialize:
-            wire = PageBlockWire.from_bytes(wire.to_bytes())
+            buf = wire.to_bytes()
+            if self.fault is not None:
+                mode = self.fault.check("kv_transfer")
+                if mode == "corrupt":
+                    buf = self.fault.corrupt_bytes("kv_transfer", buf)
+                elif mode == "drop":
+                    raise ValueError(
+                        "kv wire buffer dropped in transit (injected)")
+            wire = PageBlockWire.from_bytes(buf)
         return self.deliver(dst, wire, dst_blocks)

@@ -63,6 +63,8 @@ directly (pinned by ``tests/test_inference/test_router.py``).
 from __future__ import annotations
 
 import itertools
+import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -80,6 +82,14 @@ from .engine import GenerationConfig, LLMEngine, Request
 #: placement policies — ``cache_aware`` degrades to ``least_loaded`` on a
 #: cold cache, which degrades to round-robin when loads tie
 ROUTER_POLICIES = ("cache_aware", "least_loaded", "round_robin")
+
+#: the replica health state machine (fault tolerance): healthy → suspect
+#: (one failed/overrun step) → dead (``fail_threshold`` consecutive
+#: failures; in-flight work fails over to survivors) → healthy again via
+#: :meth:`Router.revive`. A clean step clears a suspect back to healthy.
+REPLICA_HEALTH_STATES = ("healthy", "suspect", "dead")
+
+_LOG = logging.getLogger(__name__)
 
 
 class Router:
@@ -102,6 +112,9 @@ class Router:
         devices: Optional[Sequence] = None,
         tracer: Optional[Tracer] = None,
         slo_aware: bool = True,
+        fault=None,
+        watchdog_s: Optional[float] = None,
+        fail_threshold: int = 2,
     ):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
@@ -159,6 +172,26 @@ class Router:
             ThreadPoolExecutor(max_workers=n, thread_name_prefix="router-step")
             if parallel_step and n > 1 else None
         )
+        # ---- fault tolerance: an optional seeded FaultInjector checked
+        # at the replica_step seam (key = replica index), a per-step
+        # watchdog deadline (None = off), and the health state machine
+        # feeding failover. fail_threshold consecutive failed/overrun
+        # steps declare a replica dead and evacuate its in-flight work.
+        self.fault = fault
+        self.watchdog_s = watchdog_s
+        if fail_threshold < 1:
+            raise ValueError(f"fail_threshold={fail_threshold} must be >= 1")
+        self.fail_threshold = int(fail_threshold)
+        self._health = ["healthy"] * n
+        self._fail_streak = [0] * n
+        self._failures_total = [0] * n
+        #: failed-over rid → adopting replica (consulted by replica_of;
+        #: entries retire as their requests finish)
+        self._owner_override: Dict[int, int] = {}
+        #: requests terminally finished during a failover (errored group
+        #: members, shed backlog, no-survivor poison pills) — surfaced by
+        #: the next step() so the scheduler's waiters unblock
+        self._failover_finished: List[Request] = []
         # ---- router-level counters (host-side ints; /metrics renders them
         # as clt_router_* counter families — linted in test_metric_names)
         self.requests_routed = 0
@@ -167,6 +200,10 @@ class Router:
         self.round_robin_placements = 0
         self.replica_drains = 0
         self.slo_avoided_placements = 0
+        self.replica_deaths = 0
+        self.replica_revivals = 0
+        self.requests_failed_over = 0
+        self.watchdog_trips = 0
 
     # ------------------------------------------------------------- placement
     @property
@@ -174,8 +211,12 @@ class Router:
         return len(self.engines)
 
     def replica_of(self, request_id: int) -> int:
-        """Owning replica of a request id — pure arithmetic, no table."""
-        return request_id % len(self.engines)
+        """Owning replica of a request id — pure arithmetic (``rid % n``)
+        except for failed-over requests, whose adoption broke the modular
+        convention and is recorded in a small override table that retires
+        as they finish."""
+        return self._owner_override.get(
+            request_id, request_id % len(self.engines))
 
     def _load(self, i: int) -> int:
         e = self.engines[i]
@@ -221,11 +262,11 @@ class Router:
 
     def _place(self, prompt_ids: List[int]) -> int:
         eligible = [i for i in range(len(self.engines))
-                    if not self._draining[i]]
+                    if not self._draining[i] and self._health[i] != "dead"]
         if not eligible:
             raise RuntimeError(
-                "every replica is draining — undrain one before routing "
-                "new requests"
+                "every replica is draining or dead — undrain/revive one "
+                "before routing new requests"
             )
         if self.slo_aware:
             eligible = self._slo_healthy(eligible)
@@ -327,14 +368,26 @@ class Router:
         """One tick of every busy replica; returns all finished requests.
         Busy replicas step CONCURRENTLY on worker threads (unless
         ``parallel_step=False``): the megasteps overlap on device while
-        each replica's host scheduler runs its own slice of Python."""
-        busy = [i for i, e in enumerate(self.engines) if e.has_work]
+        each replica's host scheduler runs its own slice of Python.
+
+        This is also the health machine's observation point: a replica
+        whose step raises — or overruns ``watchdog_s`` wall-clock (a hung
+        dispatch) — is marked suspect, and ``fail_threshold`` consecutive
+        failures declare it dead: its in-flight requests fail over to
+        surviving replicas (resumed token-identically via the
+        preempt/resume path) and placement excludes it until
+        :meth:`revive`. Finished requests a completed-but-overrun step
+        produced are still returned — their terminal accounting already
+        happened."""
+        busy = [i for i, e in enumerate(self.engines)
+                if e.has_work and self._health[i] != "dead"]
         if not busy:
             return []
         finished: List[Request] = []
         tr = self.tracer
         t_step0 = tr._clock() if tr is not None else 0.0
         intervals: Dict[int, tuple] = {}
+        failed: Dict[int, bool] = {}
 
         def timed(i: int) -> List[Request]:
             t0 = tr._clock()
@@ -344,12 +397,46 @@ class Router:
                 intervals[i] = (t0, tr._clock())
 
         run = self._step_one if tr is None else timed
+
+        def guarded(i: int) -> List[Request]:
+            t0 = time.monotonic()
+            try:
+                if self.fault is not None:
+                    # the replica_step seam, keyed by replica index so an
+                    # armed kill targets one replica deterministically
+                    self.fault.check("replica_step", key=i)
+                out = run(i)
+            except Exception as exc:
+                _LOG.warning("replica %d step failed: %s: %s",
+                             i, type(exc).__name__, exc)
+                failed[i] = True
+                return []
+            if (self.watchdog_s is not None
+                    and time.monotonic() - t0 > self.watchdog_s):
+                self.watchdog_trips += 1
+                failed[i] = True
+            return out
+
         if self._pool is not None and len(busy) > 1:
-            for fut in [self._pool.submit(run, i) for i in busy]:
+            for fut in [self._pool.submit(guarded, i) for i in busy]:
                 finished.extend(fut.result())
         else:
             for i in busy:
-                finished.extend(run(i))
+                finished.extend(guarded(i))
+        # health transitions and failover run on THIS thread, after every
+        # worker joined — no replica is mid-step while its waiting queue
+        # is mutated
+        for i in busy:
+            if failed.get(i):
+                self._note_step_failure(i)
+            else:
+                self._note_step_ok(i)
+        if self._failover_finished:
+            finished.extend(self._failover_finished)
+            self._failover_finished.clear()
+        if self._owner_override:
+            for req in finished:
+                self._owner_override.pop(req.request_id, None)
         if tr is not None and len(busy) > 1:
             self._trace_sync_waits(busy, t_step0, intervals)
         return finished
@@ -413,15 +500,98 @@ class Router:
     def draining(self, i: int) -> bool:
         return self._draining[i]
 
+    def health(self, i: int) -> str:
+        """The replica's health-machine state (``healthy`` / ``suspect``
+        / ``dead``); drain state is orthogonal — see
+        :meth:`replica_health` for the combined view."""
+        return self._health[i]
+
+    def _note_step_ok(self, i: int) -> None:
+        """A clean step clears a suspect replica back to healthy — only
+        *consecutive* failures escalate to dead."""
+        self._fail_streak[i] = 0
+        if self._health[i] == "suspect":
+            self._health[i] = "healthy"
+
+    def _note_step_failure(self, i: int) -> None:
+        if self._health[i] == "dead":
+            return
+        self._failures_total[i] += 1
+        self._fail_streak[i] += 1
+        if self._fail_streak[i] >= self.fail_threshold:
+            self._mark_dead(i)
+        else:
+            self._health[i] = "suspect"
+
+    def _mark_dead(self, i: int) -> None:
+        """Declare replica ``i`` dead and fail its in-flight work over.
+
+        The dead engine's :meth:`LLMEngine.evacuate` converts every
+        in-flight request back to movable form (pages released, prompt +
+        committed output intact) — each movable request re-enters a
+        surviving replica's queue and resumes through the preempt/resume
+        path, token-identical under greedy decoding. Grouped running
+        requests (n>1 samples with interleaved pages) are not movable;
+        evacuate already finished them with reason ``"error"``. With no
+        survivor at all, every movable request finishes ``"error"`` too —
+        the terminal invariant keeps balancing either way. Runs on the
+        router thread only (callers join all step workers first)."""
+        self._health[i] = "dead"
+        self._fail_streak[i] = 0
+        self.replica_deaths += 1
+        _LOG.warning("replica %d marked dead after %d consecutive step "
+                     "failures", i, self.fail_threshold)
+        dead_eng = self.engines[i]
+        movable, finished = dead_eng.evacuate()
+        tr = self.tracer
+        if tr is not None and movable:
+            tr.instant(movable[0].request_id, "replica_dead", track="router",
+                       replica=i, in_flight=len(movable) + len(finished))
+        alive = [j for j in range(len(self.engines))
+                 if self._health[j] != "dead"]
+        # prefer non-draining survivors; a fully-draining fleet still
+        # adopts the orphans rather than failing them
+        pref = [j for j in alive if not self._draining[j]] or alive
+        for req in movable:
+            if not alive:
+                dead_eng._finish(req, "error", count=req.n_samples)
+                finished.append(req)
+                continue
+            j = self._pick_balanced(list(pref))
+            self._owner_override[req.request_id] = j
+            for rid in (req.group_ids or ()):
+                self._owner_override[rid] = j
+            self.engines[j].waiting.append(req)
+            self.requests_failed_over += 1
+            if tr is not None:
+                tr.instant(req.request_id, "failover", track="router",
+                           src=i, dst=j)
+        self._failover_finished.extend(finished)
+
+    def revive(self, i: int) -> None:
+        """Return a dead replica to service (operator action / restart
+        probe succeeded): placement-eligible again, failure streak reset.
+        Its totals keep accumulating — ``replica_health`` shows history."""
+        _ = self.engines[i]  # index check
+        if self._health[i] == "dead":
+            self.replica_revivals += 1
+        self._health[i] = "healthy"
+        self._fail_streak[i] = 0
+
     def replica_health(self) -> List[Dict]:
         """Per-replica point-in-time health: queues, pool headroom,
         terminal counters, drain state. ``idle & not draining`` is the
         ready signal a balancer would scrape."""
         out = []
         for i, e in enumerate(self.engines):
+            state = self._health[i]
+            if state == "healthy" and self._draining[i]:
+                state = "draining"
             entry = {
                 "replica": i,
                 "draining": self._draining[i],
+                "health": state,
+                "failures": self._failures_total[i],
                 "running": len(e.running),
                 "waiting": len(e.waiting),
                 "prefilling": len(e.prefilling),
@@ -457,6 +627,10 @@ class Router:
             "router_round_robin_placements": self.round_robin_placements,
             "router_replica_drains": self.replica_drains,
             "router_slo_avoided_placements": self.slo_avoided_placements,
+            "router_replica_deaths": self.replica_deaths,
+            "router_replica_revivals": self.replica_revivals,
+            "router_requests_failed_over": self.requests_failed_over,
+            "router_watchdog_trips": self.watchdog_trips,
         }
 
     def merged_stats(self) -> Dict[str, float]:
@@ -539,6 +713,8 @@ class Router:
             "free_blocks": sum(e.allocator.num_free for e in self.engines),
             "router_replicas": len(self.engines),
             "router_replicas_draining": sum(self._draining),
+            "router_replicas_dead": sum(
+                1 for h in self._health if h == "dead"),
         }
 
     def metrics_text(self) -> str:
@@ -569,6 +745,12 @@ class Router:
             cap_counters, cap_gauges = merged_capacity_prom(mons.values())
             counters.update(cap_counters)
             gauges.update(cap_gauges)
+        if self.fault is not None:
+            # clt_fault_* families: the router-attached injector's seam
+            # check counts and injections by mode (replicas built with
+            # the SAME injector share these counters — no double count,
+            # merged_stats only folds EngineStats)
+            counters.update(self.fault.prom_counters())
         return prometheus_exposition(counters, gauges,
                                      self.merged_histograms())
 
@@ -596,7 +778,10 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
     ``POST /drain`` ``{"replica": i, "drain": bool}`` toggles placement
     eligibility for rolling restarts — an optional ``"role"``
     (``"prefill"``/``"decode"``) narrows the drain to one worker class
-    of a disaggregated replica."""
+    of a disaggregated replica; ``POST /undrain`` ``{"replica": i}`` is
+    the explicit inverse (same body shape as /drain, role included);
+    ``POST /revive`` ``{"replica": i}`` returns a dead replica to
+    placement after the operator restarts it."""
     import json
 
     from .server import make_server
@@ -652,7 +837,7 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                 base_handler.do_GET(self)
 
         def do_POST(self):
-            if self.path == "/drain":
+            if self.path in ("/drain", "/undrain"):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
@@ -661,7 +846,11 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                         self._json(400, {"error": f"no replica {i}"})
                         return
                     role = str(req.get("role", "all"))
-                    if bool(req.get("drain", True)):
+                    if self.path == "/undrain":
+                        # explicit inverse endpoint — ignores any "drain"
+                        # key so a balancer can't accidentally re-drain
+                        router.undrain(i, role=role)
+                    elif bool(req.get("drain", True)):
                         router.drain(i, role=role)
                     else:
                         router.undrain(i, role=role)
@@ -675,6 +864,21 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                         e = router.engines[i]
                         if hasattr(e, "role_health"):
                             payload["roles"] = e.role_health()
+                    self._json(200, payload)
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/revive":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    i = int(req["replica"])
+                    if not 0 <= i < router.n_replicas:
+                        self._json(400, {"error": f"no replica {i}"})
+                        return
+                    with sched.lock:
+                        router.revive(i)
+                        payload = {"replica": i, "health": router.health(i)}
                     self._json(200, payload)
                 except Exception as e:
                     self._json(400, {"error": str(e)})

@@ -77,6 +77,7 @@ from urllib.parse import parse_qs, urlparse
 from colossalai_tpu.utils.profiler import start_profile, stop_profile
 
 from .engine import GenerationConfig, LLMEngine
+from .fault import InjectedFault
 from .telemetry import prometheus_exposition
 
 #: sentinel pushed to a stream queue when its request leaves the engine
@@ -409,6 +410,11 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         # rates, pressure, recompile sentinel
                         counters.update(cap.prom_counters())
                         gauges.update(cap.prom_gauges())
+                    flt = getattr(engine, "fault", None)
+                    if flt is not None:
+                        # clt_fault_* families: seam check counts and
+                        # injections by mode (chaos-drill observability)
+                        counters.update(flt.prom_counters())
                     body = prometheus_exposition(
                         counters, gauges, engine.telemetry.histograms,
                     ).encode()
@@ -528,6 +534,16 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})
                 return
+            fault = getattr(engine, "fault", None)
+            if fault is not None:
+                # the http_generate seam: an injected ingress fault answers
+                # 503 (retryable) BEFORE the request ever reaches the
+                # engine — proving a flaky front door never strands ids
+                try:
+                    fault.check("http_generate")
+                except InjectedFault as e:
+                    self._json(503, {"error": str(e), "injected": True})
+                    return
             try:
                 gen = GenerationConfig(
                     max_new_tokens=int(req.get("max_new_tokens", 64)),
@@ -573,6 +589,13 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         payload["retry_after_s"] = hint
                         headers = {"Retry-After": max(1, int(math.ceil(hint)))}
                     self._json(503, payload, headers=headers)
+                elif status == "error":
+                    # the fault layer's poison pill: the request failed
+                    # repeatedly across retries/failover — a server-side
+                    # failure, so 5xx (clients may retry a fresh id)
+                    self._json(500, {"request_id": rid, "error": "error",
+                                     "finish_reason": "error",
+                                     "output_ids": out})
                 elif out is None:
                     self._json(504, {"error": "generation timed out"})
                 else:

@@ -58,8 +58,10 @@ _NULL_CM = contextlib.nullcontext()
 
 #: every terminal state a request can reach — the ``finish_reason`` field
 #: of lifecycle records is always one of these ("shed" = rejected by
-#: overload admission control before ever being admitted)
-FINISH_REASONS = ("eos", "length", "aborted", "truncated", "shed")
+#: overload admission control before ever being admitted; "error" = the
+#: fault layer's poison pill — a handoff that exhausted its retry budget
+#: repeatedly, or a failover with no surviving replica)
+FINISH_REASONS = ("eos", "length", "aborted", "truncated", "shed", "error")
 
 #: histogram catalog: name → constructor. Latencies get log-spaced bounds
 #: spanning 100µs–1h; queue depth gets powers of two (an integer gauge).

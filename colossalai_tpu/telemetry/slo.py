@@ -229,13 +229,14 @@ class SLOTracker:
         reason: Optional[str] = None,
     ) -> bool:
         """Feed one finished request; returns whether it landed within
-        SLO. Aborted and shed requests count toward ``requests_total`` but
-        never toward goodput — shed load is not good load."""
+        SLO. Aborted, shed, and errored requests count toward
+        ``requests_total`` but never toward goodput — shed or failed load
+        is not good load."""
         values = {"ttft": ttft, "itl": itl, "e2e": e2e, "queue_wait": queue_wait}
         for metric, v in values.items():
             if v is not None:
                 self.windows[metric].observe(v)
-        within = reason not in ("aborted", "shed")
+        within = reason not in ("aborted", "shed", "error")
         if within:
             for _key, metric, _q, bound in self._parsed:
                 v = values[metric]

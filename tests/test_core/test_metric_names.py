@@ -136,7 +136,10 @@ def test_router_names_match_grammar():
             "clt_router_least_loaded_placements",
             "clt_router_round_robin_placements", "clt_router_replica_drains",
             "clt_router_slo_avoided_placements",
-            "clt_router_replicas", "clt_router_replicas_draining"} <= names
+            "clt_router_replica_deaths", "clt_router_replica_revivals",
+            "clt_router_requests_failed_over", "clt_router_watchdog_trips",
+            "clt_router_replicas", "clt_router_replicas_draining",
+            "clt_router_replicas_dead"} <= names
     # the merged view keeps every single-engine family name, so one
     # dashboard reads a bare engine and a router interchangeably
     assert _serving_names() <= names
@@ -179,6 +182,33 @@ def test_capacity_names_match_grammar_and_collide_with_nothing():
     assert not names & _serving_names()
     assert not names & _training_names()
     assert not names & _slo_names()
+
+
+def _fault_names():
+    """The ``clt_fault_*`` catalog a server with an attached injector
+    adds to its exposition — all counters are unconditional, so a fresh
+    injector already renders the full set."""
+    from colossalai_tpu.inference.fault import FaultInjector
+
+    return _family_names(prometheus_exposition(
+        FaultInjector().prom_counters(), {}, {}, prefix="clt"))
+
+
+def test_fault_names_match_grammar_and_collide_with_nothing():
+    names = _fault_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+        assert name.startswith("clt_fault_"), name
+    assert {"clt_fault_checks_replica_step", "clt_fault_checks_kv_transfer",
+            "clt_fault_checks_handoff_pump",
+            "clt_fault_checks_megastep_dispatch",
+            "clt_fault_checks_http_generate", "clt_fault_injected_raise",
+            "clt_fault_injected_hang", "clt_fault_injected_corrupt",
+            "clt_fault_injected_drop", "clt_fault_injected_total"} <= names
+    assert not names & _serving_names()
+    assert not names & _training_names()
+    assert not names & _slo_names()
+    assert not names & _capacity_names()
 
 
 def test_every_histogram_family_exports_dropped_total():
@@ -263,7 +293,8 @@ def test_span_names_match_grammar_over_engine_smoke():
                "prefill_sp", "prefill_stall", "first_token",
                "decode_megastep", "spec_megastep", "prefix_cache_hit",
                "prefix_cache_evict", "page_refund", "router.place",
-               "router.sync", "shed", "preempt", "resume", "kv_transfer"}
+               "router.sync", "shed", "preempt", "resume", "kv_transfer",
+               "replica_dead", "failover", "kv_retry"}
     assert catalog == set(SPAN_CATALOG)
     assert names <= catalog, names - catalog
 

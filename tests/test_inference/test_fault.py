@@ -1,0 +1,578 @@
+"""Fault-tolerant serving (inference/fault.py + the seam wiring).
+
+The chaos matrix under test — injector × seam × {single engine,
+2-replica router, disaggregated pair}:
+
+- **determinism** — a seeded ``FaultInjector`` fires at exact invocation
+  counts (keyed per replica where threads race) and a ``RetryPolicy``'s
+  backoff schedule is a pure function of (seed, attempt), so every chaos
+  scenario replays identically;
+- **failover is token-identical** — killing a replica mid-decode fails
+  its in-flight requests over to the survivor through the
+  preempt/resume path; greedy outputs equal the healthy-fleet reference
+  and no page leaks on either pool;
+- **the wire defends itself** — ``PageBlockWire.from_bytes`` rejects
+  bad magic / unknown version / truncation / length mismatch with
+  distinct errors, and the CRC32 checksum catches corrupted payloads;
+- **retry/backoff closes the handoff seam** — a corrupted transfer
+  retries and completes token-identically; exhausted retries requeue to
+  prefill; a poison pill finishes with the new terminal reason
+  ``"error"`` and the invariant widens to ``completed + aborted + shed
+  + error == submitted``;
+- **zero overhead off** — an attached-but-unarmed injector leaves
+  outputs and the per-token transfer counters byte-identical to
+  ``fault=None``.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from colossalai_tpu.inference import (
+    DisaggEngine,
+    GenerationConfig,
+    HostKVTransport,
+    LLMEngine,
+    PageBlockWire,
+    Router,
+    init_paged_cache,
+    make_router_server,
+)
+from colossalai_tpu.inference.fault import (
+    FAULT_MODES,
+    FAULT_SEAMS,
+    FaultInjector,
+    InjectedFault,
+    RetryPolicy,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+BASE = dict(max_batch_size=4, max_seq_len=128, block_size=16,
+            prefill_buckets=(16, 32, 64))
+PROMPTS = [[3, 14, 15, 9, 2, 6], list(range(40, 59)), [5] * 33, [7, 8, 9]]
+GEN = GenerationConfig(max_new_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    return LLMEngine(params, cfg, **{**BASE, **kw})
+
+
+def _disagg(parts, **kw):
+    cfg, params = parts
+    return DisaggEngine(params, cfg, **{**BASE, **kw})
+
+
+def _assert_invariant(stats):
+    s = stats if isinstance(stats, dict) else stats.as_dict()
+    assert s["requests_completed"] + s["requests_aborted"] \
+        + s["requests_shed"] + s["requests_error"] \
+        == s["requests_submitted"], s
+
+
+def _assert_no_engine_leak(eng):
+    resident = (len(eng.prefix_cache.resident_blocks())
+                if eng.prefix_cache is not None else 0)
+    assert eng.allocator.num_free + resident == eng.allocator.num_blocks - 1
+
+
+def _assert_no_disagg_leak(dis):
+    for eng in (dis.prefill, dis.decode):
+        _assert_no_engine_leak(eng)
+    assert not dis.prefill._handoff and not dis.prefill._reserved
+    assert not dis._handoff_attempts and not dis._handoff_next_try
+
+
+def _run(router_or_engine, prompts=PROMPTS, gen=GEN):
+    order = [router_or_engine.add_request(list(p), gen) for p in prompts]
+    done = {}
+    steps = 0
+    while router_or_engine.has_work:
+        steps += 1
+        assert steps < 2000, "serving loop did not converge"
+        for r in router_or_engine.step():
+            done[r.request_id] = r
+    return order, done
+
+
+# ------------------------------------------------------- injector mechanics
+def test_injector_fires_at_exact_counts():
+    f = FaultInjector(seed=7)
+    f.arm("replica_step", "raise", at=3, times=2)
+    fired = []
+    for i in range(1, 7):
+        try:
+            f.check("replica_step")
+            fired.append(None)
+        except InjectedFault as e:
+            assert e.seam == "replica_step" and e.mode == "raise"
+            fired.append("raise")
+    # fires on invocations 3 and 4, nowhere else
+    assert fired == [None, None, "raise", "raise", None, None]
+    s = f.stats()
+    assert s["checks_replica_step"] == 6
+    assert s["injected_raise"] == 2 and s["injected_total"] == 2
+
+
+def test_injector_keyed_arms_count_per_key():
+    """A keyed arm targets one key's own invocation count — the property
+    that makes "kill replica 1 on its 3rd step" exact even when replicas
+    step on concurrent threads."""
+    f = FaultInjector()
+    f.arm("replica_step", "raise", at=2, times=1, key=1)
+    log = []
+    for _ in range(3):
+        for key in (0, 1):
+            try:
+                f.check("replica_step", key=key)
+                log.append((key, "ok"))
+            except InjectedFault:
+                log.append((key, "raise"))
+    assert log == [(0, "ok"), (1, "ok"), (0, "ok"), (1, "raise"),
+                   (0, "ok"), (1, "ok")]
+
+
+def test_injector_modes_and_validation():
+    f = FaultInjector()
+    f.arm("kv_transfer", "corrupt", times=1)
+    assert f.check("kv_transfer") == "corrupt"
+    assert f.check("kv_transfer") is None  # times exhausted
+    f.arm("kv_transfer", "drop", times=-1)
+    assert f.check("kv_transfer") == "drop"
+    assert f.check("kv_transfer") == "drop"  # -1 = forever
+    f.disarm("kv_transfer")
+    assert f.check("kv_transfer") is None
+    assert not f.armed
+    # corruption really flips bytes, deterministically for one seed
+    buf = bytes(range(200))
+    assert FaultInjector(seed=3).corrupt_bytes("kv_transfer", buf) \
+        == FaultInjector(seed=3).corrupt_bytes("kv_transfer", buf) != buf
+    with pytest.raises(ValueError, match="unknown seam"):
+        f.arm("nope", "raise")
+    with pytest.raises(ValueError, match="unknown mode"):
+        f.arm("kv_transfer", "explode")
+    with pytest.raises(ValueError, match="must be >= 1"):
+        f.arm("kv_transfer", "raise", at=0)
+    with pytest.raises(ValueError, match="unknown seam"):
+        f.check("nope")
+    assert set(FAULT_SEAMS) == {"replica_step", "kv_transfer",
+                                "handoff_pump", "megastep_dispatch",
+                                "http_generate"}
+    assert set(FAULT_MODES) == {"raise", "hang", "corrupt", "drop"}
+
+
+def test_retry_policy_schedule_is_deterministic():
+    a = RetryPolicy(max_retries=4, base_delay_s=0.01, max_delay_s=0.1,
+                    jitter=0.25, seed=42)
+    b = RetryPolicy(max_retries=4, base_delay_s=0.01, max_delay_s=0.1,
+                    jitter=0.25, seed=42)
+    sched = [a.delay(i) for i in range(1, 6)]
+    assert sched == [b.delay(i) for i in range(1, 6)]
+    # exponential up to the cap, jitter bounded
+    for i, d in enumerate(sched, start=1):
+        base = min(0.01 * 2 ** (i - 1), 0.1)
+        assert base <= d <= 0.1
+    assert not a.exhausted(4) and a.exhausted(5)
+    no_jitter = RetryPolicy(base_delay_s=0.01, max_delay_s=1.0, jitter=0.0)
+    assert [no_jitter.delay(i) for i in (1, 2, 3)] == [0.01, 0.02, 0.04]
+    with pytest.raises(ValueError, match="max_retries"):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError, match="base_delay_s"):
+        RetryPolicy(base_delay_s=0.5, max_delay_s=0.1)
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError, match="attempt"):
+        no_jitter.delay(0)
+
+
+# ----------------------------------------------------------- wire hardening
+def _wire_buf(parts):
+    from colossalai_tpu.inference import DeviceKVTransport
+
+    cfg, _ = parts
+    cache = init_paged_cache(cfg, 4, 16, dtype=jnp.bfloat16)
+    return DeviceKVTransport().pack(cache, [1, 2],
+                                    meta={"rid": 9}).to_bytes()
+
+
+def test_wire_rejects_each_malformation_distinctly(parts):
+    buf = _wire_buf(parts)
+    with pytest.raises(ValueError, match="bad magic"):
+        PageBlockWire.from_bytes(b"nope" + buf[4:])
+    with pytest.raises(ValueError, match="12-byte preamble"):
+        PageBlockWire.from_bytes(buf[:8])
+    bad_ver = buf[:4] + (99).to_bytes(4, "little") + buf[8:]
+    with pytest.raises(ValueError, match="unsupported wire version 99"):
+        PageBlockWire.from_bytes(bad_ver)
+    huge_hdr = buf[:8] + (2 ** 20).to_bytes(4, "little") + buf[12:]
+    with pytest.raises(ValueError, match="header claims"):
+        PageBlockWire.from_bytes(huge_hdr)
+    with pytest.raises(ValueError, match="truncated payload"):
+        PageBlockWire.from_bytes(buf[:-5])
+    with pytest.raises(ValueError, match="header/tensor length mismatch"):
+        PageBlockWire.from_bytes(buf + b"\x00" * 8)
+
+
+def test_wire_checksum_catches_payload_corruption(parts):
+    good = _wire_buf(parts)
+    buf = bytearray(good)
+    buf[-3] ^= 0xFF  # flip one payload byte; length/shape stay valid
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        PageBlockWire.from_bytes(bytes(buf))
+    # an uncorrupted buffer round-trips, crc present in the header
+    wire = PageBlockWire.from_bytes(good)
+    assert wire.meta == {"rid": 9}
+    assert int.from_bytes(good[4:8], "little") == 2  # preamble version
+    hdr_len = int.from_bytes(good[8:12], "little")
+    header = json.loads(good[12:12 + hdr_len])
+    assert "crc32" in header
+
+
+def test_wire_accepts_checksumless_v1_buffer(parts):
+    """A v1 peer's buffer (no crc32 field) still decodes — readers accept
+    both known versions, so a rolling upgrade never bricks transfers."""
+    buf = _wire_buf(parts)
+    hdr_len = int.from_bytes(buf[8:12], "little")
+    header = json.loads(buf[12:12 + hdr_len])
+    del header["crc32"]
+    hdr = json.dumps(header).encode()
+    v1 = buf[:4] + (1).to_bytes(4, "little") \
+        + len(hdr).to_bytes(4, "little") + hdr + buf[12 + hdr_len:]
+    wire = PageBlockWire.from_bytes(v1)
+    assert wire.meta == {"rid": 9}
+
+
+# --------------------------------------------------- single-engine seams
+def test_megastep_dispatch_fault_leaves_engine_consistent(parts):
+    """The megastep_dispatch seam fires BEFORE any state mutation: the
+    injected raise surfaces to the caller, and after disarming, the same
+    engine finishes every request token-identically."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    fault = FaultInjector()
+    fault.arm("megastep_dispatch", "raise", at=2, times=1)
+    eng = _engine(parts, fault=fault)
+    order = [eng.add_request(list(p), GEN) for p in PROMPTS]
+    done = {}
+    raised = 0
+    steps = 0
+    while eng.has_work:
+        steps += 1
+        assert steps < 2000
+        try:
+            for r in eng.step():
+                done[r.request_id] = r
+        except InjectedFault:
+            raised += 1
+    assert raised == 1
+    assert [done[rid].output_ids for rid in order] == ref
+    _assert_invariant(eng.stats)
+    _assert_no_engine_leak(eng)
+
+
+def test_evacuate_returns_engine_to_empty(parts):
+    """evacuate() converts every in-flight request to movable form and
+    leaves the pool page-clean — the primitive failover builds on."""
+    eng = _engine(parts, prefix_cache=True)
+    for p in PROMPTS:
+        eng.add_request(list(p), GEN)
+    eng.step()  # some admitted/prefilled/running, some waiting
+    movable, finished = eng.evacuate()
+    assert not eng.has_work
+    assert not eng.running and not eng.prefilling and not eng.waiting
+    assert len(movable) + len(finished) == len(PROMPTS)
+    for req in movable:
+        assert req.slot is None and req.table is None
+        assert req.cache_node is None and req.prefill_pos == 0
+    _assert_no_engine_leak(eng)
+
+
+# ------------------------------------------------------------- router failover
+def test_router_failover_token_identity(parts):
+    """Kill replica 1 mid-run: its in-flight requests re-enter replica 0
+    and complete with greedy outputs equal to the healthy reference;
+    both pools end page-clean and the widened invariant balances."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    fault = FaultInjector(seed=0)
+    fault.arm("replica_step", "raise", at=2, times=-1, key=1)
+    router = Router([_engine(parts), _engine(parts)],
+                    policy="least_loaded", fault=fault, fail_threshold=2)
+    try:
+        order, done = _run(router)
+        assert [done[rid].output_ids for rid in order] == ref
+        assert router.health(0) == "healthy" and router.health(1) == "dead"
+        assert router.replica_deaths == 1
+        assert router.requests_failed_over > 0
+        assert not router._owner_override  # cleaned up as requests finish
+        _assert_invariant(router.merged_stats())
+        for e in router.engines:
+            _assert_no_engine_leak(e)
+        # health surfaces: per-replica state + failure counts, the dead
+        # gauge, and the failover counter families
+        health = router.replica_health()
+        assert health[0]["health"] == "healthy"
+        assert health[1]["health"] == "dead"
+        assert health[1]["failures"] >= 2
+        assert router.occupancy()["router_replicas_dead"] == 1
+        counters = router.router_counters()
+        assert counters["router_replica_deaths"] == 1
+        assert counters["router_requests_failed_over"] \
+            == router.requests_failed_over
+        # placement now refuses: the fleet has no eligible replica left
+        router.drain(0)
+        with pytest.raises(RuntimeError, match="draining or dead"):
+            router.add_request([1, 2, 3], GEN)
+        router.undrain(0)
+        # revive restores placement eligibility
+        router.revive(1)
+        assert router.health(1) == "healthy"
+        assert router.replica_revivals == 1
+        fault.disarm()
+        order2, done2 = _run(router, prompts=[[9, 8, 7]])
+        assert done2[order2[0]].finish_reason in ("eos", "length")
+    finally:
+        router.close()
+
+
+def test_router_suspect_recovers_on_clean_step(parts):
+    """A single transient failure marks the replica suspect, not dead —
+    the next clean step restores it and nothing fails over."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    fault = FaultInjector()
+    fault.arm("replica_step", "raise", at=1, times=1, key=1)
+    router = Router([_engine(parts), _engine(parts)],
+                    policy="least_loaded", fault=fault, fail_threshold=2)
+    try:
+        order, done = _run(router)
+        assert [done[rid].output_ids for rid in order] == ref
+        assert router.health(1) == "healthy"
+        assert router.replica_deaths == 0
+        assert router.requests_failed_over == 0
+        assert router._failures_total[1] == 1
+        _assert_invariant(router.merged_stats())
+    finally:
+        router.close()
+
+
+def test_router_watchdog_trips_on_hang(parts):
+    """A hung step (bounded sleep via the hang mode) overruns the
+    wall-clock watchdog: the step's results still return, the trip
+    counts as a failure, and fail_threshold=1 escalates straight to
+    dead + failover."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    fault = FaultInjector()
+    router = Router([_engine(parts), _engine(parts)],
+                    policy="least_loaded", fault=fault, fail_threshold=1,
+                    watchdog_s=1.0, parallel_step=False)
+    try:
+        # warm-up pass with nothing armed: compiles every bucket so the
+        # deadline is only ever exceeded by the injected hang, not by a
+        # first-step XLA compile
+        _run(router)
+        assert router.watchdog_trips == 0
+        fault.arm("replica_step", "hang", at=1, times=1, hang_s=1.5, key=1)
+        order, done = _run(router)
+        assert [done[rid].output_ids for rid in order] == ref
+        assert router.watchdog_trips == 1
+        assert router.health(1) == "dead"
+        _assert_invariant(router.merged_stats())
+        for e in router.engines:
+            _assert_no_engine_leak(e)
+    finally:
+        router.close()
+
+
+def test_router_no_survivor_finishes_error(parts):
+    """Every replica dead: in-flight requests finish with the terminal
+    reason "error" (never hang, never leak) and the widened invariant
+    still balances."""
+    fault = FaultInjector()
+    fault.arm("replica_step", "raise", at=2, times=-1)
+    router = Router([_engine(parts)], policy="least_loaded", fault=fault,
+                    fail_threshold=1)
+    try:
+        order, done = _run(router)
+        assert router.health(0) == "dead"
+        assert all(done[rid].finish_reason == "error" for rid in order)
+        ms = router.merged_stats()
+        assert ms["requests_error"] == len(PROMPTS)
+        _assert_invariant(ms)
+        _assert_no_engine_leak(router.engines[0])
+        with pytest.raises(RuntimeError, match="draining or dead"):
+            router.add_request([1, 2, 3], GEN)
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------- disagg seams
+def test_disagg_corrupt_transfer_retries_token_identical(parts):
+    """One corrupted wire transfer: the CRC32 check fails the splice, the
+    decode pool rolls back exactly, and the backoff retry completes the
+    handoff — outputs token-identical to the monolithic reference."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    fault = FaultInjector(seed=0)
+    fault.arm("kv_transfer", "corrupt", at=1, times=1)
+    dis = _disagg(
+        parts, transport=HostKVTransport(serialize=True, fault=fault),
+        fault=fault,
+        retry=RetryPolicy(max_retries=3, base_delay_s=0.0, max_delay_s=0.0,
+                          jitter=0.0))
+    order, done = _run(dis)
+    assert [done[rid].output_ids for rid in order] == ref
+    assert dis.stats.kv_retries == 1
+    assert dis.stats.handoff_requeues == 0
+    assert dis.stats.requests_error == 0
+    _assert_invariant(dis.stats)
+    _assert_no_disagg_leak(dis)
+
+
+def test_disagg_exhausted_retries_requeue_to_prefill(parts):
+    """A transfer that fails through the whole retry budget sends the
+    request back to the prefill queue; the fresh prefill + clean handoff
+    still lands token-identical output."""
+    ref = _engine(parts).generate([[3, 1, 4, 1, 5]], GEN)
+    fault = FaultInjector(seed=0)
+    retry = RetryPolicy(max_retries=1, base_delay_s=0.0, max_delay_s=0.0,
+                        jitter=0.0)
+    # exactly max_retries+1 failures: one full cycle fails, the requeued
+    # prefill's handoff transfers clean
+    fault.arm("kv_transfer", "corrupt", at=1, times=retry.max_retries + 1)
+    dis = _disagg(
+        parts, transport=HostKVTransport(serialize=True, fault=fault),
+        fault=fault, retry=retry)
+    order, done = _run(dis, prompts=[[3, 1, 4, 1, 5]])
+    assert done[order[0]].output_ids == ref[0]
+    assert done[order[0]].finish_reason in ("eos", "length")
+    assert dis.stats.handoff_requeues == 1
+    assert dis.stats.kv_retries == retry.max_retries + 1
+    _assert_invariant(dis.stats)
+    _assert_no_disagg_leak(dis)
+
+
+def test_disagg_poison_pill_finishes_error(parts):
+    """A transfer that NEVER succeeds exhausts retries, requeues, fails
+    again, and past the requeue cap finishes with reason "error" — the
+    serving loop terminates, nothing leaks, the invariant balances."""
+    fault = FaultInjector(seed=1)
+    fault.arm("kv_transfer", "drop", at=1, times=-1)
+    dis = _disagg(
+        parts, transport=HostKVTransport(serialize=True, fault=fault),
+        fault=fault,
+        retry=RetryPolicy(max_retries=1, base_delay_s=0.0, max_delay_s=0.0,
+                          jitter=0.0))
+    order, done = _run(dis, prompts=[[3, 1, 4, 1, 5]])
+    assert done[order[0]].finish_reason == "error"
+    assert dis.stats.requests_error == 1
+    assert dis.stats.handoff_requeues == 2
+    _assert_invariant(dis.stats)
+    _assert_no_disagg_leak(dis)
+
+
+def test_unarmed_injector_is_byte_identical(parts):
+    """fault=<attached but never armed> must be indistinguishable from
+    fault=None: same outputs, byte-identical transfer counters — the
+    zero-overhead contract for the fault layer."""
+    gold = _disagg(parts, transport=HostKVTransport(serialize=True))
+    gold_out = gold.generate([list(p) for p in PROMPTS], GEN)
+    gold_stats = gold.stats.as_dict()
+
+    fault = FaultInjector(seed=0)
+    dis = _disagg(parts,
+                  transport=HostKVTransport(serialize=True, fault=fault),
+                  fault=fault)
+    out = dis.generate([list(p) for p in PROMPTS], GEN)
+    stats = dis.stats.as_dict()
+    assert out == gold_out
+    for k in ("kv_transfers", "kv_transfer_blocks", "kv_transfer_bytes",
+              "requests_completed", "requests_error", "kv_retries",
+              "handoff_requeues"):
+        assert stats[k] == gold_stats[k], k
+    # the seams were exercised (checks counted) yet nothing injected
+    s = fault.stats()
+    assert s["checks_kv_transfer"] > 0 and s["checks_handoff_pump"] > 0
+    assert s["injected_total"] == 0
+
+
+# ------------------------------------------------------------ HTTP surface
+@pytest.fixture()
+def served_fault_router(parts):
+    fault = FaultInjector(seed=0)
+    router = Router([_engine(parts), _engine(parts)],
+                    policy="least_loaded", fault=fault)
+    server, sched = make_router_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield router, fault, base
+    server.shutdown()
+    sched.stop()
+    router.close()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_http_fault_surface(parts, served_fault_router):
+    router, fault, base = served_fault_router
+
+    # /health carries the per-replica health state + failure counts
+    with urllib.request.urlopen(f"{base}/health", timeout=30) as r:
+        health = json.loads(r.read())
+    assert [rep["health"] for rep in health["replicas"]] \
+        == ["healthy", "healthy"]
+    assert [rep["failures"] for rep in health["replicas"]] == [0, 0]
+    assert health["router_replicas_dead"] == 0
+
+    # POST /undrain is the explicit inverse of /drain
+    assert _post(base, "/drain", {"replica": 1}) \
+        == {"replica": 1, "draining": True}
+    with urllib.request.urlopen(f"{base}/health", timeout=30) as r:
+        health = json.loads(r.read())
+    assert health["replicas"][1]["health"] == "draining"
+    assert _post(base, "/undrain", {"replica": 1}) \
+        == {"replica": 1, "draining": False}
+    assert not router.draining(1)
+
+    # POST /revive returns the replica's health state
+    assert _post(base, "/revive", {"replica": 1}) \
+        == {"replica": 1, "health": "healthy"}
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/revive", {"replica": 7})
+    assert exc.value.code == 400
+
+    # /metrics exposes the clt_fault_* families of the attached injector
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "clt_fault_injected_total 0" in text
+    assert "clt_router_replica_deaths 0" in text
+
+    # an armed http_generate fault rejects admission with 503 before the
+    # request ever reaches a replica
+    fault.arm("http_generate", "raise", at=1, times=1)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/generate", {"prompt_ids": [1, 2, 3],
+                                  "max_new_tokens": 4})
+    assert exc.value.code == 503
+    body = json.loads(exc.value.read())
+    assert body["injected"] is True and "http_generate" in body["error"]
+    # the next request (fault exhausted) serves normally
+    out = _post(base, "/generate", {"prompt_ids": [1, 2, 3],
+                                    "max_new_tokens": 4})
+    assert len(out["output_ids"]) == 4

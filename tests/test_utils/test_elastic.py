@@ -81,6 +81,37 @@ def test_crash_before_first_periodic_checkpoint_recovers(tmp_path):
     assert len(losses) == 6  # one entry per step, replay overwrites
 
 
+def test_async_save_failure_counts_one_restart(tmp_path):
+    """A failure inside an async checkpoint save surfaces TWICE in the
+    machinery — once where the crash lands, and again at the next
+    ``wait()`` (which the resume path runs before restoring). The retry
+    handler must drain the pending error inside the same restart's
+    accounting, or one failed save burns two of the restart budget."""
+    cfg = LlamaConfig.tiny()
+    data = _data_fn(cfg)
+    booster, tr = _fresh(cfg, tmp_path / "asyncfail")
+
+    real_wait = booster.checkpoint_io.wait
+    fails = {"left": 0}
+
+    def flaky_wait():
+        if fails["left"] > 0:
+            fails["left"] -= 1
+            raise RuntimeError("async save failed")
+        real_wait()
+
+    booster.checkpoint_io.wait = flaky_wait
+    # let the bootstrap checkpoint land cleanly, then arm the failure:
+    # the post-save wait raises (restart counted), and the pending-error
+    # replay raises once more when the handler drains it
+    tr.fit(data, total_steps=2)
+    assert tr.restarts == 0
+    fails["left"] = 2
+    tr.fit(data, total_steps=6)
+    assert tr.restarts == 1  # regression: was 2 (drain counted separately)
+    assert int(jax.device_get(tr.boosted.state.step)) == 6
+
+
 def test_crash_budget_exhausts(tmp_path):
     cfg = LlamaConfig.tiny()
     booster, tr = _fresh(cfg, tmp_path / "budget")

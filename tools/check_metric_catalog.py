@@ -5,7 +5,7 @@ Docs drift silently: a renamed gauge or a new span keeps working while
 the documentation describes a dashboard that no longer exists. This tool
 renders every Prometheus catalog the code can emit (serving ``clt_*``,
 SLO ``clt_slo_*``, router ``clt_router_*``, training ``clt_train_*``,
-capacity ``clt_capacity_*``) the same way the HTTP endpoints render
+capacity ``clt_capacity_*``, fault ``clt_fault_*``) the same way the HTTP endpoints render
 them, parses the metric names and span table out of the docs, and fails
 on any mismatch:
 
@@ -13,6 +13,9 @@ on any mismatch:
   renderer and obey the Prometheus grammar;
 - every ``clt_capacity_*`` family the code emits must be documented
   (the strict direction for the newest family);
+- every ``clt_fault_*`` family and the router failover counters must be
+  documented too — a chaos drill is exactly when an undocumented
+  counter hurts most;
 - the span table in the docs must equal ``SPAN_CATALOG`` exactly —
   extend both or neither;
 - every histogram family must export its ``_dropped_total`` companion.
@@ -150,6 +153,19 @@ def router_families():
         router.close()
 
 
+def fault_families():
+    """Every ``clt_fault_*`` family an attached injector emits — the
+    per-seam check counters and per-mode injection counters are all
+    unconditional, so a fresh injector already renders the full set."""
+    from colossalai_tpu.inference.fault import FaultInjector
+    from colossalai_tpu.telemetry import prometheus_exposition
+
+    names = _family_names(prometheus_exposition(
+        FaultInjector().prom_counters(), {}, {}, prefix="clt"))
+    assert all(n.startswith("clt_fault_") for n in names), names
+    return names
+
+
 def capacity_families():
     """Every ``clt_capacity_*`` family a fully-lit monitor emits — all
     conditional gauges (goodput, KV, queue, headroom, HBM) forced on."""
@@ -181,6 +197,7 @@ def run_checks(doc_text=None):
         "train": train_families(),
         "router": router_families(),
         "capacity": capacity_families(),
+        "fault": fault_families(),
     }
     known = set().union(*catalogs.values())
 
@@ -198,6 +215,19 @@ def run_checks(doc_text=None):
         failures.append(
             f"code emits {name} but docs/observability.md does not "
             "document it (extend the clt_capacity_* table)")
+
+    # the fault + failover families are strict in BOTH directions too:
+    # a chaos drill is exactly when an undocumented counter hurts most
+    strict_router = {n for n in catalogs["router"]
+                     if n in ("clt_router_replica_deaths",
+                              "clt_router_replica_revivals",
+                              "clt_router_requests_failed_over",
+                              "clt_router_watchdog_trips",
+                              "clt_router_replicas_dead")}
+    for name in sorted((catalogs["fault"] | strict_router) - documented):
+        failures.append(
+            f"code emits {name} but docs/observability.md does not "
+            "document it (extend the fault-tolerance tables)")
 
     doc_spans = doc_span_names(text)
     code_spans = set(SPAN_CATALOG)
