@@ -645,6 +645,177 @@ def measure_kv_quant(bs: int = 4, prompt_len: int = 64, new_tokens: int = 32,
     return out
 
 
+def _timed_engine_drain(engine, prompts, gen):
+    """Submit ``prompts`` and drain the engine, timing per-request TTFT /
+    ITL from the host clock. Returns (tokens_per_s, ttft list, itl list)."""
+    import time as _time
+
+    t_submit, t_first, t_done, n_toks = {}, {}, {}, {}
+    rids = []
+    for p in prompts:
+        rids.append(engine.add_request(list(p), gen))
+        t_submit[rids[-1]] = _time.perf_counter()
+    t0 = _time.perf_counter()
+    while engine.has_work:
+        finished = engine.step()
+        now = _time.perf_counter()
+        for req in engine.running.values():
+            if req.output_ids and req.request_id not in t_first:
+                t_first[req.request_id] = now
+        for req in finished:
+            t_first.setdefault(req.request_id, now)
+            t_done[req.request_id] = now
+            n_toks[req.request_id] = len(req.output_ids)
+    dt = _time.perf_counter() - t0
+    ttft = [t_first[r] - t_submit[r] for r in rids]
+    itl = [(t_done[r] - t_first[r]) / max(n_toks[r] - 1, 1) for r in rids]
+    return sum(n_toks.values()) / dt, ttft, itl
+
+
+def measure_weight_quant(bs: int = 4, prompt_len: int = 64,
+                         new_tokens: int = 32, k: int = 4):
+    """Quantized-weight serving scenario: the SAME greedy workload through
+    a full-precision engine and a ``weight_dtype="int8"`` +
+    ``kv_dtype="int8"`` engine. Reports per-mode tokens/s and TTFT/ITL
+    tails, the measured weight-pool and KV-pool bytes, the model+KV
+    residency headline (how much smaller the quantized deployment sits in
+    HBM — the projections shrink 4x here since compute is f32; ~2x from
+    bf16 on TPU), the concurrent-user ratio at the full-precision arm's
+    byte budget (freed weight bytes become KV pages), and the greedy
+    agreement rate.
+
+    The config keeps the vocabulary small so the seven quantized
+    projections dominate the parameter count, as they do at real model
+    scale — a fat embedding table would hide the projection win."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=1024, dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
+               for _ in range(bs)]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    mk = dict(max_batch_size=bs, max_seq_len=256, block_size=32, megastep_k=k)
+    arms = {"bf16": {}, "int8": {"weight_dtype": "int8", "kv_dtype": "int8"}}
+
+    out = {}
+    for name, knobs in arms.items():
+        engine = LLMEngine(params, cfg, **knobs, **mk)
+        engine.generate([prompts[0]], GenerationConfig(max_new_tokens=2))
+        tps, ttft, itl = _timed_engine_drain(engine, prompts, gen)
+        ttft_p50, ttft_p99 = _tail_ms(ttft)
+        itl_p50, itl_p99 = _tail_ms(itl)
+        st = engine.stats
+        pool_tokens = (engine.allocator.num_blocks - 1) * engine.block_size
+        out[name] = {
+            "tokens_per_s": round(tps, 1),
+            "ttft_ms_p50": ttft_p50,
+            "ttft_ms_p99": ttft_p99,
+            "itl_ms_p50": itl_p50,
+            "itl_ms_p99": itl_p99,
+            "weight_pool_bytes": st.weight_pool_bytes,
+            "kv_pool_bytes": st.kv_pool_bytes,
+            "model_plus_kv_bytes": st.weight_pool_bytes + st.kv_pool_bytes,
+            "bytes_per_kv_token": round(st.kv_pool_bytes / pool_tokens, 2),
+        }
+    # residency headline: how much total HBM the quantized deployment
+    # frees at identical geometry — the >= 2.5x model+KV claim
+    out["model_kv_residency_ratio"] = round(
+        out["bf16"]["model_plus_kv_bytes"]
+        / out["int8"]["model_plus_kv_bytes"], 3)
+    # concurrent users at the FULL-PRECISION arm's byte budget: freed
+    # weight bytes turn into resident KV pages, so the quantized arm fits
+    # more simultaneous sequences of the same shape
+    budget = out["bf16"]["model_plus_kv_bytes"]
+    seq_len = prompt_len + new_tokens
+    for name in arms:
+        per_user = out[name]["bytes_per_kv_token"] * seq_len
+        out[name]["concurrent_users_at_bf16_budget"] = int(
+            max(budget - out[name]["weight_pool_bytes"], 0) / per_user)
+    out["concurrent_users_ratio"] = round(
+        out["int8"]["concurrent_users_at_bf16_budget"]
+        / max(out["bf16"]["concurrent_users_at_bf16_budget"], 1), 3)
+
+    # greedy parity vs the kv-matched reference (int8 KV both sides, so
+    # the weight quantization is the only delta in the rate)
+    parity = [list(rng.randint(0, cfg.vocab_size, size=(n,)))
+              for n in (6, 11, 19)]
+    pgen = GenerationConfig(max_new_tokens=12)
+    ref = LLMEngine(params, cfg, kv_dtype="int8", **mk).generate(
+        [list(p) for p in parity], pgen)
+    quant = LLMEngine(params, cfg, kv_dtype="int8", weight_dtype="int8",
+                      **mk).generate([list(p) for p in parity], pgen)
+    total = sum(len(o) for o in ref)
+    agree = sum(int(x == y) for a, b in zip(ref, quant)
+                for x, y in zip(a, b))
+    out["greedy_agreement_rate"] = round(agree / max(total, 1), 3)
+    return out
+
+
+def measure_overlap(bs: int = 4, prompt_len: int = 64, new_tokens: int = 48,
+                    k: int = 4, tps=(2, 4), chunks: int = 4):
+    """Overlap-scheduled decode A/B: the same greedy workload on a tp mesh
+    with ``overlap_decode`` off vs on. On TPU the per-chunk all-reduce
+    hides behind the next chunk's matmul, so the win shows up in the ITL
+    tail; on CPU the chunks serialize and the numbers mostly pin the
+    no-regression floor. Token identity between the arms is asserted by
+    tests/test_inference/test_overlap.py — this measures latency only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from colossalai_tpu.inference import GenerationConfig, LLMEngine
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    n_dev = len(jax.devices())
+    if n_dev < min(tps):
+        return {"skipped": f"needs >= {min(tps)} devices, have {n_dev}"}
+    cfg = _small_serving_config()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
+               for _ in range(bs)]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    mk = dict(max_batch_size=bs, max_seq_len=256, block_size=32, megastep_k=k)
+
+    out = {}
+    for tp in tps:
+        if n_dev < tp or cfg.num_key_value_heads % tp:
+            continue
+        mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+        row = {}
+        for arm, od in (("overlap_off", None), ("overlap_on", chunks)):
+            engine = LLMEngine(params, cfg, mesh=mesh, overlap_decode=od,
+                               **mk)
+            engine.generate([prompts[0]], GenerationConfig(max_new_tokens=2))
+            tps_tok, ttft, itl = _timed_engine_drain(engine, prompts, gen)
+            itl_p50, itl_p99 = _tail_ms(itl)
+            row[arm] = {
+                "tokens_per_s": round(tps_tok, 1),
+                "itl_ms_p50": itl_p50,
+                "itl_ms_p99": itl_p99,
+            }
+        row["decode_overlap_gain_p50"] = round(
+            row["overlap_off"]["itl_ms_p50"]
+            / max(row["overlap_on"]["itl_ms_p50"], 1e-9), 3)
+        row["chunks"] = chunks
+        out[f"tp{tp}"] = row
+    return out
+
+
 def _small_serving_config():
     """CPU-runnable llama for serving scenarios (the kv-quant shape)."""
     import jax.numpy as jnp
@@ -1667,6 +1838,12 @@ def child_main():
         except Exception as e:
             print(f"kv quant bench failed: {e}", file=sys.stderr)
         try:
+            # int8 weights + in-kernel dequant: tokens/s + model+KV
+            # residency ratio + concurrent users at the bf16 byte budget
+            extras["weight_quant"] = measure_weight_quant()
+        except Exception as e:
+            print(f"weight quant bench failed: {e}", file=sys.stderr)
+        try:
             # multi-replica front door: aggregate tokens/s vs replica
             # count + cache-aware vs round-robin TTFT on a shared prefix
             extras["router"] = measure_router()
@@ -1726,6 +1903,12 @@ def child_main():
                     block_size=128)
             except Exception as e:
                 print(f"long context bench failed: {e}", file=sys.stderr)
+            try:
+                # overlap-scheduled decode: ITL p50/p99 with the chunked
+                # all-reduce overlap off vs on, per tp degree
+                extras["overlap"] = measure_overlap()
+            except Exception as e:
+                print(f"overlap bench failed: {e}", file=sys.stderr)
 
     try:
         # autotuner visibility: chosen tilings per (kernel, device, shape
@@ -1779,6 +1962,16 @@ def cpu_child_main():
     except Exception as e:
         print(f"cpu kv quant bench failed: {e}", file=sys.stderr)
     try:
+        extras["weight_quant_cpu"] = measure_weight_quant(
+            bs=2, prompt_len=32, new_tokens=12)
+    except Exception as e:
+        print(f"cpu weight quant bench failed: {e}", file=sys.stderr)
+    try:
+        extras["overlap_cpu"] = measure_overlap(
+            bs=2, prompt_len=32, new_tokens=12, tps=(2,))
+    except Exception as e:
+        print(f"cpu overlap bench failed: {e}", file=sys.stderr)
+    try:
         extras["router_cpu"] = measure_router()
     except Exception as e:
         print(f"cpu router bench failed: {e}", file=sys.stderr)
@@ -1816,6 +2009,27 @@ def cpu_child_main():
         summary[f"serving_{kk}_tokens_per_s"] = v["tokens_per_s"]
         summary[f"serving_{kk}_ttft_ms_p50"] = v["ttft_ms_p50"]
         summary[f"serving_{kk}_itl_ms_p50"] = v["itl_ms_p50"]
+    wq = extras.get("weight_quant_cpu", {})
+    for kk in ("model_kv_residency_ratio", "concurrent_users_ratio",
+               "greedy_agreement_rate"):
+        if kk in wq:
+            summary[f"weight_quant_{kk}"] = wq[kk]
+    for arm in ("bf16", "int8"):
+        if arm in wq:
+            summary[f"weight_quant_{arm}_tokens_per_s"] = \
+                wq[arm]["tokens_per_s"]
+            summary[f"weight_quant_{arm}_itl_ms_p50"] = wq[arm]["itl_ms_p50"]
+    ovl = extras.get("overlap_cpu", {})
+    for tpk, row in ovl.items():
+        if not tpk.startswith("tp"):
+            continue
+        for arm in ("overlap_off", "overlap_on"):
+            summary[f"overlap_{tpk}_{arm}_itl_ms_p50"] = \
+                row[arm]["itl_ms_p50"]
+            summary[f"overlap_{tpk}_{arm}_itl_ms_p99"] = \
+                row[arm]["itl_ms_p99"]
+        summary[f"overlap_{tpk}_decode_overlap_gain_p50"] = \
+            row["decode_overlap_gain_p50"]
     rtr = extras.get("router_cpu", {})
     for n_key in ("n1", "n2"):
         if n_key in rtr:
@@ -1910,7 +2124,7 @@ _LOWER_BETTER = ("ttft", "itl", "stall", "latency")
 #: summary-key substrings where a LOWER value is a regression
 _HIGHER_BETTER = ("tokens_per_s", "goodput", "attainment", "scaling_x",
                   "mfu", "agreement", "gain", "concurrent_users",
-                  "reduction_x")
+                  "reduction_x", "residency")
 
 
 def _compare_summaries(current: dict, baseline: dict,

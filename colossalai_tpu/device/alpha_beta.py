@@ -22,6 +22,8 @@ import time
 from typing import Dict, Optional, Tuple
 
 import jax
+
+from colossalai_tpu.shard_compat import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 
@@ -189,8 +191,8 @@ class AlphaBetaProfiler:
         def fn(x):
             return jax.lax.psum(x, axis)
 
-        shard = jax.jit(jax.shard_map(
-            fn, mesh=jmesh, in_specs=P(axis), out_specs=P(), check_vma=False,
+        shard = jax.jit(_shard_map(
+            fn, mesh=jmesh, in_specs=P(axis), out_specs=P(),
         ))
         n = jmesh.shape[axis]
         x = jnp.ones((n * n_elems,), jnp.float32)

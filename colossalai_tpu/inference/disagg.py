@@ -641,6 +641,10 @@ class DisaggEngine:
         return self.decode.kv_dtype
 
     @property
+    def weight_dtype(self):
+        return self.decode.weight_dtype
+
+    @property
     def max_batch(self):
         return self.prefill.max_batch
 

@@ -66,7 +66,9 @@ from colossalai_tpu.models.llama import LlamaConfig
 from colossalai_tpu.utils.profiler import annotate, step_annotation
 
 from colossalai_tpu.telemetry import CapacityMonitor
+from colossalai_tpu.kernel import tuning
 
+from . import weight_quant
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
 from .overload import OverloadConfig, OverloadController, retry_after_hint
 from .prefix_cache import PrefixCache
@@ -241,6 +243,11 @@ class EngineStats:
     #: physical pages currently allocated (live sequences + prefix-cache
     #: retained pages; the reserved null page 0 never counts)
     kv_blocks_in_use: int = 0
+    #: bytes the weights keep resident (target + draft trees, int8 kernels
+    #: and their scale leaves included) — with kv_pool_bytes it is the
+    #: numerator of the weight_dtype="int8" residency win (same HBM,
+    #: ~2x the model + more concurrent KV)
+    weight_pool_bytes: int = 0
     # ---- disaggregated serving (DisaggEngine): KVTransport accounting —
     # each counted transfer moves one finished prefill's pages (target +
     # draft pool) into the decode worker's pool
@@ -393,6 +400,8 @@ class LLMEngine:
         capacity: Union[bool, CapacityMonitor, None] = None,
         moe_impl: str = "auto",
         kv_dtype: str = "bf16",
+        weight_dtype: str = "bf16",
+        overlap_decode: Union[bool, int, None] = None,
         sp_prefill: Union[bool, int, None] = None,
         fault=None,
     ):
@@ -527,30 +536,94 @@ class LLMEngine:
         self.use_kernel = use_kernel
         self.mesh = mesh
         # ---- KV-pool dtype: "bf16" stores pages in the compute dtype;
-        # "int8" quantizes them (symmetric absmax per page per kv head, see
-        # kv_quant.py) for ~2x the resident KV tokens per HBM byte. The
+        # "int8" / "fp8" quantize them (symmetric absmax per page per kv
+        # head, see kv_quant.py — fp8 is float8_e4m3fn: same bytes per
+        # token as int8, ~3 mantissa bits with wider in-page dynamic
+        # range) for ~2x the resident KV tokens per HBM byte. The
         # quantized pool composes with megastep K, chunked prefill, the
         # prefix cache (shared pages carry their scales — they are indexed
         # by PHYSICAL block id), speculative decoding (the draft pool
         # quantizes too), MoE serving, and GSPMD tp meshes (the scales
         # shard their kv-head dim next to the pool); the pp relay's
         # [pp, L/pp, ...] pool resharding has no scale path.
-        if kv_dtype not in ("bf16", "int8"):
+        if kv_dtype not in ("bf16", "int8", "fp8"):
             raise ValueError(
                 f"kv_dtype={kv_dtype!r}: pass 'bf16' (pages in the compute "
-                "dtype) or 'int8' (quantized pages + per-page scales)"
+                "dtype), 'int8', or 'fp8' (quantized pages + per-page "
+                "scales)"
+            )
+        if kv_dtype == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "kv_dtype='fp8' needs jnp.float8_e4m3fn, which this jax "
+                "build does not expose — use kv_dtype='int8' (same bytes "
+                "per cached token) or upgrade jax"
             )
         mesh_axes = dict(mesh.shape) if mesh is not None else {}
-        if kv_dtype == "int8" and mesh_axes.get("pp", 1) > 1:
+        if kv_dtype in ("int8", "fp8") and mesh_axes.get("pp", 1) > 1:
             raise NotImplementedError(
-                "kv_dtype='int8' does not compose with pipeline-parallel "
-                "decode — the pp relay's stage-resharded pool carries no "
-                "scale tensors; use a tp-only mesh (GSPMD shards the "
-                "scales) or kv_dtype='bf16'"
+                f"kv_dtype={kv_dtype!r} does not compose with "
+                "pipeline-parallel decode — the pp relay's stage-resharded "
+                "pool carries no scale tensors; use a tp-only mesh (GSPMD "
+                "shards the scales) or kv_dtype='bf16'"
             )
         self.kv_dtype = kv_dtype
         dtype = config.dtype or jnp.bfloat16
-        pool_dtype = jnp.int8 if kv_dtype == "int8" else dtype
+        pool_dtype = {
+            "int8": jnp.int8,
+            "fp8": getattr(jnp, "float8_e4m3fn", None),
+        }.get(kv_dtype, dtype)
+        # ---- weight dtype: "int8" re-stores every attention/MLP
+        # projection as {int8 kernel, f32 per-output-channel scale}
+        # (weight_quant.py) at load; the forward dequantizes INSIDE the
+        # matmul (kernel op quant_matmul — Pallas epilogue fusion on TPU,
+        # the bitwise-identical f32 chain under XLA), so a bf16 copy of
+        # the projections never lands in HBM. Embeddings, lm_head, norms,
+        # and MoE expert banks stay in the checkpoint dtype. Composes
+        # with quantized KV, the prefix cache, speculative decoding (the
+        # draft tree quantizes too), chunked/sp prefill, and GSPMD tp
+        # meshes (scale leaves shard like their kernel's output dim).
+        if weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"weight_dtype={weight_dtype!r}: pass 'bf16' (checkpoint "
+                "dtype) or 'int8' (per-channel quantized projections with "
+                "in-kernel dequant)"
+            )
+        if weight_dtype == "int8" and mesh_axes.get("pp", 1) > 1:
+            raise NotImplementedError(
+                "weight_dtype='int8' does not compose with "
+                "pipeline-parallel decode — the pp stage placement carries "
+                "no scale leaves; use a tp-only mesh or weight_dtype='bf16'"
+            )
+        self.weight_dtype = weight_dtype
+        if weight_dtype == "int8":
+            params = weight_quant.quantize_params(params)
+            if draft_params is not None:
+                # a separate draft model quantizes too (a self-draft slices
+                # the already-quantized target tree below)
+                draft_params = weight_quant.quantize_params(draft_params)
+        # ---- overlap-scheduled decode (overlap_decode=): split the
+        # row-parallel o_proj/down_proj matmuls into k output-column
+        # chunks so chunk i's all-reduce overlaps chunk i+1's compute
+        # (modeling._row_matmul). Token outputs are IDENTICAL to the
+        # monolithic schedule by construction. True picks k from the
+        # tuning cache (kernel/tuning.py::overlap_chunks, keyed on
+        # device/tp/hidden/dtype); an int pins it.
+        if overlap_decode is None or overlap_decode is False:
+            self.overlap_chunks = 1
+        elif overlap_decode is True:
+            self.overlap_chunks = tuning.overlap_chunks(
+                config.hidden_size, dtype, mesh_axes.get("tp", 1)
+            )
+        else:
+            k = int(overlap_decode)
+            if k < 1 or config.hidden_size % k:
+                raise ValueError(
+                    f"overlap_decode={overlap_decode}: pass True (tuned), "
+                    "False/None (off), or a positive divisor of "
+                    f"hidden_size={config.hidden_size} (the row matmuls "
+                    "chunk their output columns evenly)"
+                )
+            self.overlap_chunks = k
         cache = init_paged_cache(config, num_blocks, block_size, dtype=pool_dtype)
         # ---- speculative decoding (draft_len > 0): the megastep drafts
         # draft_len tokens per iteration (separate draft model, or a
@@ -824,6 +897,14 @@ class LLMEngine:
         if self.draft_cache is not None:
             self._kv_pool_nbytes += int(sum(
                 leaf.nbytes for leaf in jax.tree.leaves(self.draft_cache)))
+        # weight residency is equally static: the target tree plus any
+        # draft tree (a self-draft's sliced blocks count what they hold;
+        # its aliased embed/norm/head leaves double-count a sliver, same
+        # as the draft pool above)
+        self._weight_pool_nbytes = weight_quant.tree_weight_bytes(params)
+        if self.draft_params is not None:
+            self._weight_pool_nbytes += weight_quant.tree_weight_bytes(
+                self.draft_params)
         self._refresh_kv_gauges()
         # ---- device-resident decode state: the scheduler PATCHES these
         # (O(1) scalars at admission / page growth / release) and the
@@ -1125,6 +1206,7 @@ class LLMEngine:
             logits, self.cache = prefill_sp(
                 self.params, self.config, a_ids, a_start, a_n,
                 self.cache, a_table, self._tp_mesh,
+                overlap_chunks=self.overlap_chunks,
             )
             self.stats.prefill_sp_chunks += 1
         else:
@@ -1139,6 +1221,7 @@ class LLMEngine:
                 _, self.draft_cache = prefill_sp(
                     self.draft_params, self.draft_config, a_ids, a_start,
                     a_n, self.draft_cache, a_table, self._tp_mesh,
+                    overlap_chunks=self.overlap_chunks,
                 )
             else:
                 _, self.draft_cache = prefill_chunk_paged(
@@ -1244,6 +1327,7 @@ class LLMEngine:
         complement) — no device fetch, so telemetry on/off cannot change
         transfer counters."""
         self.stats.kv_pool_bytes = self._kv_pool_nbytes
+        self.stats.weight_pool_bytes = self._weight_pool_nbytes
         self.stats.kv_blocks_in_use = (
             self.allocator.num_blocks - 1 - self.allocator.num_free
         )
@@ -1616,7 +1700,7 @@ class LLMEngine:
                     self._dev_temp, self._dev_topk, self._dev_topp,
                     self._dev_sample, keys, k_steps=k, draft_len=d,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
-                    tp_shard=tp_shard,
+                    tp_shard=tp_shard, overlap_chunks=self.overlap_chunks,
                 )
             elif self._pp:
                 (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
@@ -1636,6 +1720,7 @@ class LLMEngine:
                     self._dev_sample, keys, k_steps=k,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
                     moe_fused=self._moe_fused, tp_shard=tp_shard,
+                    overlap_chunks=self.overlap_chunks,
                 )
                 # MoE param trees append the [E] expert_counts tally
                 expert_counts = out[7] if self._moe else None

@@ -26,10 +26,11 @@ import jax.numpy as jnp
 class PagedKVCache(NamedTuple):
     k: jax.Array  # [L, n_blocks, Hkv, block_size, D]
     v: jax.Array  # [L, n_blocks, Hkv, block_size, D]
-    #: int8 pools only: per-(layer, physical page, kv head) symmetric
-    #: absmax scales (see kv_quant.py); None for float pools. None leaves
-    #: give the two modes distinct pytree structures, so every jit in the
-    #: serving stack traces a separate (and for bf16, unchanged) program.
+    #: quantized pools (int8 / fp8) only: per-(layer, physical page, kv
+    #: head) symmetric absmax scales (see kv_quant.py); None for plain
+    #: float pools. None leaves give the modes distinct pytree structures,
+    #: so every jit in the serving stack traces a separate (and for bf16,
+    #: unchanged) program.
     k_scale: Optional[jax.Array] = None  # [L, n_blocks, Hkv] f32
     v_scale: Optional[jax.Array] = None  # [L, n_blocks, Hkv] f32
 
@@ -46,13 +47,27 @@ class PagedKVCache(NamedTuple):
         return self.k_scale is not None
 
 
+def _quantized_pool_dtype(dt) -> bool:
+    """Pool dtypes that carry per-(page, head) scale tensors: int8 and
+    fp8 (e4m3). An fp8 POOL is quantized storage, not a compute dtype —
+    it is deliberately not lumped in with the plain-float branch."""
+    if dt == jnp.dtype(jnp.int8):
+        return True
+    return hasattr(jnp, "float8_e4m3fn") and dt == jnp.dtype(jnp.float8_e4m3fn)
+
+
 def init_paged_cache(cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16) -> PagedKVCache:
     dt = jnp.dtype(dtype)
-    if not (jnp.issubdtype(dt, jnp.floating) or dt == jnp.dtype(jnp.int8)):
+    quantized = _quantized_pool_dtype(dt)
+    if not quantized and not (
+        jnp.issubdtype(dt, jnp.floating)
+        and jnp.finfo(dt).bits >= 16
+    ):
         raise ValueError(
             f"init_paged_cache dtype={dt.name!r} is not a supported pool "
-            "dtype: use a float dtype (bf16/f32 pages) or int8 (quantized "
-            "pages with per-page-per-head scales)"
+            "dtype: use a >=16-bit float dtype (bf16/f32 pages) or a "
+            "quantized pool dtype — int8 / float8_e4m3fn (pages with "
+            "per-page-per-head scales)"
         )
     from colossalai_tpu.kernel.loader import on_tpu
 
@@ -70,7 +85,7 @@ def init_paged_cache(cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16) 
     # heads BEFORE block_size: pages must be (block_size, head_dim) tiles
     # for the Pallas paged kernel (Mosaic last-two-dims constraint)
     shape = (cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads, block_size, cfg.head_dim_)
-    if dt == jnp.dtype(jnp.int8):
+    if quantized:
         sshape = (cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads)
         return PagedKVCache(
             k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),

@@ -1,13 +1,22 @@
-"""Int8 KV-page quantization: symmetric absmax per (page, kv-head).
+"""Quantized KV pages: symmetric absmax per (page, kv-head).
 
 The paged pool (``kv_cache.PagedKVCache``) stores K/V pages either in the
-compute dtype (bf16 — the default) or as int8 with one f32 scale per
-(layer, physical page, kv head): ``scale = absmax / 127`` over the page's
-(block_size, head_dim) tile, ``q = clip(round(x / scale), -127, 127)``,
-``dequant = q * scale``. Halving the bytes per cached token doubles the
-concurrent-user / context capacity of a fixed HBM budget (the ROADMAP's
-~2x unlock); the Pallas paged-attention kernel dequantizes tiles
-in-register so a bf16 copy of the pool never materializes.
+compute dtype (bf16 — the default) or quantized with one f32 scale per
+(layer, physical page, kv head). Two quantized pool dtypes share every
+helper below:
+
+- ``int8`` — ``scale = absmax / 127`` over the page's (block_size,
+  head_dim) tile, ``q = clip(round(x / scale), -127, 127)``;
+- ``fp8`` (``float8_e4m3fn``) — ``scale = absmax / 448`` (e4m3's finite
+  max), ``q = cast(clip(x / scale, ±448))`` — the float cast itself
+  rounds, so no explicit ``round`` (an e4m3 value keeps a ~3-bit
+  mantissa, trading the int8 grid's uniform steps for wider dynamic
+  range within a page).
+
+``dequant = q * scale`` either way. Halving the bytes per cached token
+doubles the concurrent-user / context capacity of a fixed HBM budget (the
+ROADMAP's ~2x unlock); the Pallas paged-attention kernel dequantizes
+tiles in-register so a bf16 copy of the pool never materializes.
 
 Quantization granularity is per PAGE per KV HEAD — coarse enough that the
 scale tensors are negligible (``2 * L * n_blocks * Hkv`` f32 ≈ 0.8% of the
@@ -41,6 +50,34 @@ from colossalai_tpu.tensor.sharding import constrain
 #: symmetric int8 range: quantized values live in [-127, 127] (never -128,
 #: so negation round-trips and |q * scale| <= absmax)
 INT8_MAX = 127.0
+#: float8_e4m3fn's largest finite value — the symmetric fp8 range
+FP8_E4M3_MAX = 448.0
+
+
+def qmax_for(pool_dtype) -> float:
+    """The symmetric quantization range of a supported pool dtype.
+
+    Raises a ValueError naming the dtype otherwise — the one choke point
+    every quantized write shape funnels through, so an unsupported pool
+    dtype fails readably instead of silently quantizing to garbage."""
+    dt = jnp.dtype(pool_dtype)
+    if dt == jnp.dtype(jnp.int8):
+        return INT8_MAX
+    if hasattr(jnp, "float8_e4m3fn") and dt == jnp.dtype(jnp.float8_e4m3fn):
+        return FP8_E4M3_MAX
+    raise ValueError(
+        f"unsupported quantized KV pool dtype {dt.name!r}: expected int8 "
+        "or float8_e4m3fn"
+    )
+
+
+def _cast_quantized(q32: jax.Array, pool_dtype) -> jax.Array:
+    """f32 quantized values → pool dtype: round+clip for the int8 grid,
+    clip-then-cast for fp8 (the float cast rounds)."""
+    qmax = qmax_for(pool_dtype)
+    if jnp.dtype(pool_dtype) == jnp.dtype(jnp.int8):
+        q32 = jnp.round(q32)
+    return jnp.clip(q32, -qmax, qmax).astype(pool_dtype)
 
 
 def safe_scale(scale: jax.Array) -> jax.Array:
@@ -49,7 +86,8 @@ def safe_scale(scale: jax.Array) -> jax.Array:
     return jnp.where(scale > 0, scale, 1.0)
 
 
-def page_scales(pages: jax.Array, valid: jax.Array) -> jax.Array:
+def page_scales(pages: jax.Array, valid: jax.Array,
+                pool_dtype=jnp.int8) -> jax.Array:
     """Per-(page, kv-head) scales for whole-page writes.
 
     pages [..., Hkv, block_size, D] (compute dtype); valid
@@ -58,27 +96,31 @@ def page_scales(pages: jax.Array, valid: jax.Array) -> jax.Array:
     """
     a = jnp.abs(pages.astype(jnp.float32))
     a = jnp.where(valid[..., None, :, None], a, 0.0)
-    return jnp.max(a, axis=(-2, -1)) / INT8_MAX
+    return jnp.max(a, axis=(-2, -1)) / qmax_for(pool_dtype)
 
 
-def quantize_pages(pages: jax.Array, scales: jax.Array) -> jax.Array:
-    """pages [..., Hkv, block_size, D] / scales [..., Hkv] → int8 pages."""
-    q = jnp.round(pages.astype(jnp.float32) / safe_scale(scales)[..., None, None])
-    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+def quantize_pages(pages: jax.Array, scales: jax.Array,
+                   pool_dtype=jnp.int8) -> jax.Array:
+    """pages [..., Hkv, block_size, D] / scales [..., Hkv] → pool-dtype
+    pages (int8 or fp8)."""
+    q = pages.astype(jnp.float32) / safe_scale(scales)[..., None, None]
+    return _cast_quantized(q, pool_dtype)
 
 
 def dequantize_pages(q: jax.Array, scales: jax.Array, dtype) -> jax.Array:
-    """int8 pages [..., Hkv, block_size, D] * scales [..., Hkv] → compute
-    dtype. The single cast point every read path shares (bitwise warm/cold
-    identity depends on this)."""
+    """Quantized pages [..., Hkv, block_size, D] * scales [..., Hkv] →
+    compute dtype. The single cast point every read path shares (bitwise
+    warm/cold identity depends on this); ``q.astype(f32) * scale`` is
+    dtype-generic, so int8 and fp8 pools share it verbatim."""
     return (q.astype(jnp.float32) * scales[..., None, None]).astype(dtype)
 
 
 def append_token(pool, scales, wb, wo, tok, ok):
-    """Quantized single-token append: the int8 counterpart of the decode
-    scatter ``pool.at[wb, :, wo].set(tok)``.
+    """Quantized single-token append: the quantized counterpart of the
+    decode scatter ``pool.at[wb, :, wo].set(tok)``. The pool's own dtype
+    (int8 or fp8) picks the range and the cast.
 
-    pool [n_blocks, Hkv, block_size, D] int8; scales [n_blocks, Hkv] f32;
+    pool [n_blocks, Hkv, block_size, D] int8/fp8; scales [n_blocks, Hkv] f32;
     wb/wo [S] int32 write page / offset (callers mask both to the null
     page 0 for slots with ``ok`` False); tok [S, Hkv, D] compute dtype;
     ok [S] bool.
@@ -99,11 +141,12 @@ def append_token(pool, scales, wb, wo, tok, ok):
     scatter writes identical values and stays deterministic, exactly like
     the bf16 path's masked scatter. Returns (pool, scales).
     """
+    qmax = qmax_for(pool.dtype)
     old = scales[wb]  # [S, Hkv]
-    page = pool[wb]  # [S, Hkv, block_size, D] int8
+    page = pool[wb]  # [S, Hkv, block_size, D] int8/fp8
     block_size = pool.shape[2]
     t32 = tok.astype(jnp.float32)
-    t_scale = jnp.max(jnp.abs(t32), axis=-1) / INT8_MAX  # [S, Hkv]
+    t_scale = jnp.max(jnp.abs(t32), axis=-1) / qmax  # [S, Hkv]
     fresh = (wo == 0) & ok
     old_eff = jnp.where(fresh[:, None], 0.0, old)
     new = jnp.maximum(old_eff, t_scale)
@@ -111,13 +154,9 @@ def append_token(pool, scales, wb, wo, tok, ok):
     # requantize the page to the (possibly grown) scale; ratio == 1 when
     # the scale is unchanged, 0 when the page starts fresh at offset 0
     ratio = old_eff / safe_scale(new)
-    repage = jnp.clip(
-        jnp.round(page.astype(jnp.float32) * ratio[..., None, None]),
-        -INT8_MAX, INT8_MAX,
-    ).astype(jnp.int8)
-    qtok = jnp.clip(
-        jnp.round(t32 / safe_scale(new)[..., None]), -INT8_MAX, INT8_MAX
-    ).astype(jnp.int8)
+    repage = _cast_quantized(
+        page.astype(jnp.float32) * ratio[..., None, None], pool.dtype)
+    qtok = _cast_quantized(t32 / safe_scale(new)[..., None], pool.dtype)
     at_wo = (
         jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
         == wo[:, None, None]

@@ -43,18 +43,74 @@ def _rms(x, scale, eps):
     return (x32 * jax.lax.rsqrt(jnp.mean(x32**2, -1, keepdims=True) + eps) * scale).astype(x.dtype)
 
 
+def _matmul(h, kernel, scale, dtype):
+    """One projection matmul, quantization-aware: a float kernel is a
+    plain cast-and-matmul; an int8 kernel (``scale`` present — see
+    weight_quant.py) routes through the ``quant_matmul`` kernel op, which
+    folds the per-output-channel dequant into the matmul epilogue (Pallas
+    on TPU, the bitwise-identical f32 chain under XLA)."""
+    if scale is None:
+        return h @ kernel.astype(dtype)
+    from colossalai_tpu.kernel import quant_matmul
+
+    return quant_matmul(h, kernel, scale, out_dtype=dtype)
+
+
 def _proj(h, leaf, dtype):
     """x @ kernel (+ bias when the checkpoint has one — qwen2-style
     attention_bias configs; under a tp shard_map the bias arrives
     column-sliced like its kernel)."""
-    y = h @ leaf["kernel"].astype(dtype)
+    y = _matmul(h, leaf["kernel"], leaf.get("scale"), dtype)
     if "bias" in leaf:
         y = y + leaf["bias"].astype(dtype)
     return y
 
 
+def _row_matmul(h, leaf, dtype, tp_axis=None, overlap_chunks=1):
+    """The row-parallel o_proj / down_proj matmul, overlap-scheduled.
+
+    With ``overlap_chunks=k > 1`` the kernel's OUTPUT columns split into k
+    equal chunks and each chunk's partial runs as its own matmul(+psum):
+    chunk i's all-reduce is independent of chunk i+1's compute, so the
+    compiler (async collectives on TPU) overlaps the psum of one chunk
+    with the matmul of the next — the GSPMD-style latency-hiding
+    decomposition. Numerics are IDENTICAL to the monolithic matmul by
+    construction: each output element's full contraction lives inside one
+    chunk (the split is along output columns only) and the psum is
+    elementwise, so per-chunk psum + concat reproduces the unchunked
+    result bit for bit — the token-identity contract
+    ``tests/test_inference/test_overlap.py`` asserts.
+
+    ``tp_axis`` names the shard_map axis to psum over (manual-collective
+    tp decode); under GSPMD (no ``tp_axis``) the per-chunk matmuls still
+    split so XLA inserts one all-reduce per chunk. A chunk count that
+    does not divide the output dim falls back to 1 (a ragged tail would
+    change the decomposition, and the engine validates the knob anyway).
+    Quantized leaves chunk their scale alongside the kernel columns."""
+    kernel = leaf["kernel"]
+    scale = leaf.get("scale")
+    n_out = kernel.shape[-1]
+    k = int(overlap_chunks) if overlap_chunks else 1
+    if k <= 1 or n_out % k != 0:
+        y = _matmul(h, kernel, scale, dtype)
+        return jax.lax.psum(y, tp_axis) if tp_axis is not None else y
+    cols = n_out // k
+    parts = []
+    for i in range(k):
+        with jax.named_scope(f"overlap_chunk_{i}"):
+            w = jax.lax.slice_in_dim(kernel, i * cols, (i + 1) * cols, axis=-1)
+            sc = None if scale is None else jax.lax.slice_in_dim(
+                scale, i * cols, (i + 1) * cols, axis=-1)
+            y = _matmul(h, w, sc, dtype)
+            if tp_axis is not None:
+                y = jax.lax.psum(y, tp_axis)
+        parts.append(y)
+    return jnp.concatenate(parts, axis=-1)
+
+
 def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
-                tp_axis=None, moe_fused=False, return_moe_routing=False):
+                tp_axis=None, moe_fused=False, return_moe_routing=False,
+                overlap_chunks=1):
     """One decoder block over x [B, S, H] attending to the cache + itself.
 
     k_cache/v_cache: [B, S_max, Hkv, D] already containing THIS x's K/V at
@@ -65,6 +121,9 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     column-sliced) and ``tp_axis`` names the axis to psum the o_proj /
     down_proj row-matmul partials over (the Megatron pattern, manual
     collectives because shard_map sees per-device values).
+    ``overlap_chunks`` splits those two row matmuls into k output-column
+    chunks so each chunk's all-reduce overlaps the next chunk's compute
+    (see ``_row_matmul`` — numerically identical to the monolithic form).
 
     A layer with a ``"moe"`` param subtree (Mixtral/Qwen2-MoE families)
     takes the routed expert MLP instead of the dense tail; ``moe_fused``
@@ -77,9 +136,6 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     eps = cfg.rms_norm_eps
     hd = cfg.head_dim_
     b, s, _ = x.shape
-
-    def _row_out(y):
-        return jax.lax.psum(y, tp_axis) if tp_axis is not None else y
 
     h = _rms(x, p["input_layernorm"]["scale"], eps)
     q = _proj(h, p["self_attn"]["q_proj"], dtype)
@@ -101,7 +157,8 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     attn = jnp.einsum("bhgst,bthd->bshgd", probs, v_cache, preferred_element_type=jnp.float32)
     attn = attn.reshape(b, s, n_heads * hd).astype(dtype)
-    x = x + _row_out(attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype))
+    x = x + _row_matmul(attn, p["self_attn"]["o_proj"], dtype,
+                        tp_axis=tp_axis, overlap_chunks=overlap_chunks)
 
     h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
     if "moe" in p:
@@ -114,10 +171,13 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
         y, routing, cap = moe_ffn(cfg, p["moe"], h, fused=moe_fused)
         x = x + y
         return (x, (routing, cap)) if return_moe_routing else x
-    gate = h @ p["mlp"]["gate_proj"]["kernel"].astype(dtype)
-    up = h @ p["mlp"]["up_proj"]["kernel"].astype(dtype)
+    gate = _matmul(h, p["mlp"]["gate_proj"]["kernel"],
+                   p["mlp"]["gate_proj"].get("scale"), dtype)
+    up = _matmul(h, p["mlp"]["up_proj"]["kernel"],
+                 p["mlp"]["up_proj"].get("scale"), dtype)
     act = jax.nn.silu(gate) * up
-    x = x + _row_out(act @ p["mlp"]["down_proj"]["kernel"].astype(dtype))
+    x = x + _row_matmul(act, p["mlp"]["down_proj"], dtype,
+                        tp_axis=tp_axis, overlap_chunks=overlap_chunks)
     return (x, None) if return_moe_routing else x
 
 
@@ -183,7 +243,8 @@ def prefill(params, cfg: LlamaConfig, input_ids, cache: KVCache, slot_lengths) -
     return last, KVCache(k=k_new, v=v_new, lengths=slot_lengths)
 
 
-def _extend_impl(params, cfg: LlamaConfig, tokens, cache: KVCache):
+def _extend_impl(params, cfg: LlamaConfig, tokens, cache: KVCache,
+                 overlap_chunks: int = 1):
     """Shared cache-extend forward: tokens [B, K] → (logits [B, K, V],
     cache with K new positions written). decode_step is the K=1 special
     case; extend_step the speculative verification window."""
@@ -208,7 +269,8 @@ def _extend_impl(params, cfg: LlamaConfig, tokens, cache: KVCache):
         k_new, v_new = _project_kv(cfg, layer_params, h, positions)
         k_l = write_at(k_all, k_new)
         v_l = write_at(v_all, v_new)
-        x = _block_step(cfg, layer_params, x, k_l, v_l, positions, valid)
+        x = _block_step(cfg, layer_params, x, k_l, v_l, positions, valid,
+                        overlap_chunks=overlap_chunks)
         return x, (k_l, v_l)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -223,25 +285,31 @@ def _extend_impl(params, cfg: LlamaConfig, tokens, cache: KVCache):
     return logits, k_new, v_new
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def extend_step(params, cfg: LlamaConfig, tokens, cache: KVCache) -> Tuple[jax.Array, KVCache]:
+@partial(jax.jit, static_argnames=("cfg", "overlap_chunks"),
+         donate_argnames=("cache",))
+def extend_step(params, cfg: LlamaConfig, tokens, cache: KVCache,
+                overlap_chunks: int = 1) -> Tuple[jax.Array, KVCache]:
     """Score K tokens per slot in ONE forward: tokens [B, K] →
     logits [B, K, V], cache advanced by K — the verification pass of
     speculative decoding (≙ llm_engine.py:301: the target model scores the
     whole draft window at once)."""
-    logits, k_new, v_new = _extend_impl(params, cfg, tokens, cache)
+    logits, k_new, v_new = _extend_impl(params, cfg, tokens, cache,
+                                        overlap_chunks)
     return logits, KVCache(k=k_new, v=v_new, lengths=cache.lengths + tokens.shape[1])
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg", "overlap_chunks"),
+         donate_argnames=("cache",))
 def decode_step(
-    params, cfg: LlamaConfig, tokens, cache: KVCache, active=None
+    params, cfg: LlamaConfig, tokens, cache: KVCache, active=None,
+    overlap_chunks: int = 1
 ) -> Tuple[jax.Array, KVCache]:
     """One token per slot: tokens [B] → logits [B, V], cache advanced.
 
     ``active`` ([B] bool) freezes idle slots: their lengths do not advance,
     so a free slot's stale cache rows are never progressively marked valid
     and lengths can't creep past S_max while the slot sits empty."""
-    logits, k_new, v_new = _extend_impl(params, cfg, tokens[:, None], cache)
+    logits, k_new, v_new = _extend_impl(params, cfg, tokens[:, None], cache,
+                                        overlap_chunks)
     advance = 1 if active is None else active.astype(jnp.int32)
     return logits[:, 0], KVCache(k=k_new, v=v_new, lengths=cache.lengths + advance)

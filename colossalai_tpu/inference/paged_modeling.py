@@ -52,7 +52,7 @@ from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
 from . import kv_quant
 from .kv_cache import PagedKVCache
-from .modeling import _block_step, _proj, _project_kv, _rms
+from .modeling import _block_step, _matmul, _proj, _project_kv, _rms, _row_matmul
 from .moe_modeling import moe_expert_counts, moe_ffn
 
 
@@ -154,10 +154,11 @@ def prefill_paged(
         v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
         if k_sc is not None:
             page_valid = valid[0].reshape(n_pages, bs)  # pad excluded from absmax
-            ks = kv_quant.page_scales(k_pages, page_valid)
-            vs = kv_quant.page_scales(v_pages, page_valid)
-            k_pages = kv_quant.quantize_pages(k_pages, ks)
-            v_pages = kv_quant.quantize_pages(v_pages, vs)
+            pd = k_pool.dtype
+            ks = kv_quant.page_scales(k_pages, page_valid, pool_dtype=pd)
+            vs = kv_quant.page_scales(v_pages, page_valid, pool_dtype=pd)
+            k_pages = kv_quant.quantize_pages(k_pages, ks, pool_dtype=pd)
+            v_pages = kv_quant.quantize_pages(v_pages, vs, pool_dtype=pd)
             k_sc = k_sc.at[block_table[:n_pages]].set(ks)
             v_sc = v_sc.at[block_table[:n_pages]].set(vs)
             # attend to the round-tripped values the pool now holds, not
@@ -232,10 +233,11 @@ def prefill_chunk_paged(
             # chunks are block-aligned, so each page is written by exactly
             # one chunk and its validity is local: token i real iff i < n_valid
             page_valid = (jnp.arange(c) < n_valid).reshape(n_pages, bs)
-            ks = kv_quant.page_scales(k_pages, page_valid)
-            vs = kv_quant.page_scales(v_pages, page_valid)
-            k_pages = kv_quant.quantize_pages(k_pages, ks)
-            v_pages = kv_quant.quantize_pages(v_pages, vs)
+            pd = k_pool.dtype
+            ks = kv_quant.page_scales(k_pages, page_valid, pool_dtype=pd)
+            vs = kv_quant.page_scales(v_pages, page_valid, pool_dtype=pd)
+            k_pages = kv_quant.quantize_pages(k_pages, ks, pool_dtype=pd)
+            v_pages = kv_quant.quantize_pages(v_pages, vs, pool_dtype=pd)
             k_sc = k_sc.at[page_ids].set(ks)
             v_sc = v_sc.at[page_ids].set(vs)
         k_pool = k_pool.at[page_ids].set(k_pages)
@@ -275,6 +277,54 @@ def prefill_chunk_paged(
 _SP_INVALID_POS = jnp.int32(2**30)
 
 
+def _ring_permutation(mesh, axis: str = "tp"):
+    """Topology-aware ring order for the sp K/V rotation: a single cycle
+    over the mesh axis' positions, ordered so consecutive hops are
+    physically adjacent chips where the hardware exposes coordinates.
+
+    TPU devices carry ``.coords`` (their position in the physical torus);
+    a greedy nearest-neighbour walk over L1 distance builds a cycle whose
+    hops stay on neighbouring chips — the TASP-style "fold the ring onto
+    the torus" layout, so each ppermute hop is one ICI link instead of a
+    mesh-order stride that may cross the torus. Devices without coords
+    (CPU hosts, older platforms) fall back to mesh order, which keeps the
+    CPU test numerics byte-identical to the historical fixed ring.
+
+    ANY single cycle is numerically valid: every shard still visits every
+    other shard exactly once, and the streaming-softmax merge is
+    order-insensitive up to the usual float reassociation (greedy outputs
+    are pinned token-identical by tests/test_inference/test_sp_prefill.py).
+    Returns ``[(src, dst), ...]`` in mesh-axis index space, as
+    ``lax.ppermute`` expects."""
+    sp = mesh.shape[axis]
+    axis_idx = tuple(mesh.axis_names).index(axis)
+    # devices along the axis, at index 0 of every other axis — the ring
+    # runs within one axis slice, and GSPMD replicates it across the rest
+    sl = tuple(
+        slice(None) if i == axis_idx else 0 for i in range(mesh.devices.ndim)
+    )
+    devices = list(mesh.devices[sl])
+    coords = [getattr(d, "coords", None) for d in devices]
+    if sp <= 2 or any(c is None for c in coords):
+        order = list(range(sp))
+    else:
+        # greedy nearest-neighbour cycle: start at axis position 0, hop to
+        # the closest unvisited chip (L1 over torus coords)
+        order = [0]
+        remaining = set(range(1, sp))
+        while remaining:
+            here = coords[order[-1]]
+            nxt = min(
+                remaining,
+                key=lambda j: (
+                    sum(abs(a - b) for a, b in zip(coords[j], here)), j
+                ),
+            )
+            order.append(nxt)
+            remaining.discard(nxt)
+    return [(order[j], order[(j + 1) % sp]) for j in range(sp)]
+
+
 def _sp_attention(mesh, q, k_seq, v_seq, q_pos, kv_pos):
     """Sequence-parallel chunk attention: shard query rows AND the
     table-gathered K/V over the ``tp`` mesh axis, rotate K/V ring-wise.
@@ -300,7 +350,7 @@ def _sp_attention(mesh, q, k_seq, v_seq, q_pos, kv_pos):
     from colossalai_tpu.shardformer.layer.ring_attention import _merge
 
     sp = mesh.shape["tp"]
-    perm = [(j, (j + 1) % sp) for j in range(sp)]
+    perm = _ring_permutation(mesh)
     seq_spec = P(None, "tp", None, None)
     pos_spec = P(None, "tp")
 
@@ -332,14 +382,18 @@ def _sp_attention(mesh, q, k_seq, v_seq, q_pos, kv_pos):
     return fn(q, k_seq, v_seq, q_pos, kv_pos)
 
 
-def _block_step_sp(cfg, p, x, k_seq, v_seq, positions, kv_valid, mesh):
+def _block_step_sp(cfg, p, x, k_seq, v_seq, positions, kv_valid, mesh,
+                   overlap_chunks=1):
     """``_block_step`` with the attention swapped for the sp ring — the
     projections, rope, residuals, and dense MLP are op-for-op the same
     (MoE never reaches here: the engine guards MoE+mesh at
     construction). Merge ordering makes the output not bitwise equal to
     the monolithic softmax, but the math is the identical streamed
     decomposition — greedy outputs stay token-identical (pinned by
-    tests/test_inference/test_sp_prefill.py)."""
+    tests/test_inference/test_sp_prefill.py). Row matmuls go through
+    :func:`~colossalai_tpu.inference.modeling._row_matmul` with no
+    explicit psum — GSPMD inserts the collectives — so overlap chunking
+    and int8 weight dequant compose with the sp path unchanged."""
     dtype = x.dtype
     eps = cfg.rms_norm_eps
     hd = cfg.head_dim_
@@ -357,19 +411,24 @@ def _block_step_sp(cfg, p, x, k_seq, v_seq, positions, kv_valid, mesh):
     kv_pos = jnp.where(kv_valid, kv_pos, _SP_INVALID_POS)
     attn = _sp_attention(mesh, q, k_seq, v_seq, positions, kv_pos)
     attn = attn.reshape(b, s, n_heads * hd).astype(dtype)
-    x = x + attn @ p["self_attn"]["o_proj"]["kernel"].astype(dtype)
+    x = x + _row_matmul(attn, p["self_attn"]["o_proj"], dtype,
+                        overlap_chunks=overlap_chunks)
 
     h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
-    gate = h @ p["mlp"]["gate_proj"]["kernel"].astype(dtype)
-    up = h @ p["mlp"]["up_proj"]["kernel"].astype(dtype)
-    x = x + (jax.nn.silu(gate) * up) @ p["mlp"]["down_proj"]["kernel"].astype(dtype)
+    gate = _matmul(h, p["mlp"]["gate_proj"]["kernel"],
+                   p["mlp"]["gate_proj"].get("scale"), dtype)
+    up = _matmul(h, p["mlp"]["up_proj"]["kernel"],
+                 p["mlp"]["up_proj"].get("scale"), dtype)
+    x = x + _row_matmul(jax.nn.silu(gate) * up, p["mlp"]["down_proj"], dtype,
+                        overlap_chunks=overlap_chunks)
     return x
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "overlap_chunks"),
+         donate_argnames=("cache",))
 def prefill_sp(
     params, cfg: LlamaConfig, input_ids, start, n_valid, cache: PagedKVCache,
-    block_table, mesh,
+    block_table, mesh, overlap_chunks: int = 1,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """:func:`prefill_chunk_paged` with the attention sharded over the tp
     mesh axis — the sequence-parallel long-context prefill path.
@@ -409,10 +468,11 @@ def prefill_sp(
         v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
         if k_sc is not None:
             page_valid = (jnp.arange(c) < n_valid).reshape(n_pages, bs)
-            ks = kv_quant.page_scales(k_pages, page_valid)
-            vs = kv_quant.page_scales(v_pages, page_valid)
-            k_pages = kv_quant.quantize_pages(k_pages, ks)
-            v_pages = kv_quant.quantize_pages(v_pages, vs)
+            pd = k_pool.dtype
+            ks = kv_quant.page_scales(k_pages, page_valid, pool_dtype=pd)
+            vs = kv_quant.page_scales(v_pages, page_valid, pool_dtype=pd)
+            k_pages = kv_quant.quantize_pages(k_pages, ks, pool_dtype=pd)
+            v_pages = kv_quant.quantize_pages(v_pages, vs, pool_dtype=pd)
             k_sc = k_sc.at[page_ids].set(ks)
             v_sc = v_sc.at[page_ids].set(vs)
         k_pool = k_pool.at[page_ids].set(k_pages)
@@ -426,7 +486,8 @@ def prefill_sp(
             return g.reshape(s_max, pool.shape[1], pool.shape[3])[None]
 
         x = _block_step_sp(cfg, layer_params, x, to_seq(k_pool, k_sc),
-                           to_seq(v_pool, v_sc), positions, kv_valid, mesh)
+                           to_seq(v_pool, v_sc), positions, kv_valid, mesh,
+                           overlap_chunks=overlap_chunks)
         return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     with jax.named_scope("prefill_sp"):
@@ -444,7 +505,7 @@ def prefill_sp(
 
 def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
                  cache: PagedKVCache, active, use_kernel: bool,
-                 moe_fused: bool = False):
+                 moe_fused: bool = False, overlap_chunks: int = 1):
     """One decode iteration over unwrapped params: tokens [S] at positions
     ``lengths`` → (logits [S, V], cache, expert_counts). The shared
     core of ``decode_paged`` (K=1, jitted per call) and ``decode_megastep``
@@ -505,9 +566,9 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
             attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1,
                                    k_scale=k_sc, v_scale=v_sc)
             attn = attn.reshape(n_slots, 1, cfg.num_attention_heads * cfg.head_dim_)
-            attn_out = (
-                attn.astype(dtype)
-                @ layer_params["self_attn"]["o_proj"]["kernel"].astype(dtype)
+            attn_out = _row_matmul(
+                attn.astype(dtype), layer_params["self_attn"]["o_proj"],
+                dtype, overlap_chunks=overlap_chunks,
             )
             # fused residual+norm kernel: h2 = rms(x + attn_out), x = x + attn_out
             h2, x = fused_add_rms_norm(
@@ -519,9 +580,13 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
                 x = x + y
                 counts = counts + moe_expert_counts(r, cap, n_experts, active)
             else:
-                gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
-                up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
-                x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
+                mlp = layer_params["mlp"]
+                gate = _matmul(h2, mlp["gate_proj"]["kernel"],
+                               mlp["gate_proj"].get("scale"), dtype)
+                up = _matmul(h2, mlp["up_proj"]["kernel"],
+                             mlp["up_proj"].get("scale"), dtype)
+                x = x + _row_matmul(jax.nn.silu(gate) * up, mlp["down_proj"],
+                                    dtype, overlap_chunks=overlap_chunks)
         else:
             # XLA path: gather this slot's pages into a contiguous view
             # [S, max_blocks, Hkv, bs, D] → [S, s_max, Hkv, D]
@@ -537,6 +602,7 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
             x, moe_aux = _block_step(
                 cfg, layer_params, x, k_seq, v_seq, positions, attend,
                 moe_fused=moe_fused, return_moe_routing=True,
+                overlap_chunks=overlap_chunks,
             )
             if has_moe:
                 r, cap = moe_aux
@@ -553,11 +619,13 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
             counts if has_moe else None)
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_kernel", "moe_fused"),
+@partial(jax.jit,
+         static_argnames=("cfg", "use_kernel", "moe_fused", "overlap_chunks"),
          donate_argnames=("cache",))
 def decode_paged(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, use_kernel: bool = False, moe_fused: bool = False,
+    overlap_chunks: int = 1,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """One token per slot through the paged pool.
 
@@ -567,14 +635,14 @@ def decode_paged(
     p = params["params"] if "params" in params else params
     logits, cache, _ = _decode_once(
         p, cfg, tokens, block_tables, lengths, cache, active,
-        use_kernel, moe_fused,
+        use_kernel, moe_fused, overlap_chunks,
     )
     return logits, cache
 
 
 def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                  cache: PagedKVCache, active, use_kernel: bool,
-                 moe_fused: bool = False):
+                 moe_fused: bool = False, overlap_chunks: int = 1):
     """One MULTI-TOKEN decode iteration: tokens [S, W] at positions
     ``lengths .. lengths+W-1`` → (logits [S, W, V], cache).
 
@@ -650,9 +718,9 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
             attn = paged_attention(q, k_pool, v_pool, block_tables, lengths + 1,
                                    k_scale=k_sc, v_scale=v_sc)
             attn = attn.reshape(n_slots, w, cfg.num_attention_heads * cfg.head_dim_)
-            attn_out = (
-                attn.astype(dtype)
-                @ layer_params["self_attn"]["o_proj"]["kernel"].astype(dtype)
+            attn_out = _row_matmul(
+                attn.astype(dtype), layer_params["self_attn"]["o_proj"],
+                dtype, overlap_chunks=overlap_chunks,
             )
             h2, x = fused_add_rms_norm(
                 x, attn_out, layer_params["post_attention_layernorm"]["scale"],
@@ -662,9 +730,13 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                 y, _, _ = moe_ffn(cfg, layer_params["moe"], h2, fused=moe_fused)
                 x = x + y
             else:
-                gate = h2 @ layer_params["mlp"]["gate_proj"]["kernel"].astype(dtype)
-                up = h2 @ layer_params["mlp"]["up_proj"]["kernel"].astype(dtype)
-                x = x + (jax.nn.silu(gate) * up) @ layer_params["mlp"]["down_proj"]["kernel"].astype(dtype)
+                mlp = layer_params["mlp"]
+                gate = _matmul(h2, mlp["gate_proj"]["kernel"],
+                               mlp["gate_proj"].get("scale"), dtype)
+                up = _matmul(h2, mlp["up_proj"]["kernel"],
+                             mlp["up_proj"].get("scale"), dtype)
+                x = x + _row_matmul(jax.nn.silu(gate) * up, mlp["down_proj"],
+                                    dtype, overlap_chunks=overlap_chunks)
         else:
             def to_seq(pool, sc):
                 g = pool[block_tables]  # [S, mb, Hkv, bs, D]
@@ -675,7 +747,7 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
 
             x = _block_step(cfg, layer_params, x, to_seq(k_pool, k_sc),
                             to_seq(v_pool, v_sc), positions, attend,
-                            moe_fused=moe_fused)
+                            moe_fused=moe_fused, overlap_chunks=overlap_chunks)
         return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
@@ -686,11 +758,13 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
             PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new))
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_kernel", "moe_fused"),
+@partial(jax.jit,
+         static_argnames=("cfg", "use_kernel", "moe_fused", "overlap_chunks"),
          donate_argnames=("cache",))
 def verify_paged(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, use_kernel: bool = False, moe_fused: bool = False,
+    overlap_chunks: int = 1,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """W tokens per slot through the paged pool in ONE forward — the
     standalone multi-token verify entry (the speculative megastep traces
@@ -702,21 +776,21 @@ def verify_paged(
     limits = lengths + tokens.shape[1]
     return _extend_once(
         p, cfg, tokens, block_tables, lengths, limits, cache,
-        active, use_kernel, moe_fused,
+        active, use_kernel, moe_fused, overlap_chunks,
     )
 
 
 @partial(
     jax.jit,
     static_argnames=("cfg", "k_steps", "use_kernel", "use_sampling", "moe_fused",
-                     "tp_shard"),
+                     "tp_shard", "overlap_chunks"),
     donate_argnames=("cache",),
 )
 def decode_megastep(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
     k_steps: int, use_kernel: bool = False, use_sampling: bool = False,
-    moe_fused: bool = False, tp_shard: bool = False,
+    moe_fused: bool = False, tp_shard: bool = False, overlap_chunks: int = 1,
 ):
     """Device-resident decode loop: ``k_steps`` iterations of
     forward→sample→commit inside one ``lax.fori_loop`` — ONE dispatch and
@@ -755,7 +829,7 @@ def decode_megastep(
     def decode_once(tok, lens, cache_i, alive):
         return _decode_once(
             p, cfg, tok, block_tables, lens, cache_i, alive, use_kernel,
-            moe_fused,
+            moe_fused, overlap_chunks,
         )
 
     return megastep_loop(

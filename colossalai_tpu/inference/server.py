@@ -345,6 +345,7 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                         "scheduler_policy": engine.scheduler_policy,
                         "prefix_cache": engine.prefix_cache is not None,
                         "kv_dtype": engine.kv_dtype,
+                        "weight_dtype": engine.weight_dtype,
                         **self._occupancy(),
                     }
                     # one serialization for every counter: as_dict() keys
@@ -396,6 +397,8 @@ def make_server(engine: LLMEngine, host: str = "127.0.0.1", port: int = 8000,
                     # pool footprint is fixed at init and blocks-in-use
                     # shrinks on free — both gauges, not counters
                     gauges["kv_pool_bytes"] = counters.pop("kv_pool_bytes")
+                    gauges["weight_pool_bytes"] = \
+                        counters.pop("weight_pool_bytes")
                     gauges["kv_blocks_in_use"] = \
                         counters.pop("kv_blocks_in_use")
                     slo = getattr(engine.telemetry, "slo", None)

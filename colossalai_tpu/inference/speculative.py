@@ -450,7 +450,8 @@ def spec_megastep_loop(
 @partial(
     jax.jit,
     static_argnames=("cfg", "draft_cfg", "k_steps", "draft_len",
-                     "use_kernel", "use_sampling", "tp_shard"),
+                     "use_kernel", "use_sampling", "tp_shard",
+                     "overlap_chunks"),
     donate_argnames=("cache", "draft_cache"),
 )
 def decode_spec_megastep(
@@ -458,7 +459,7 @@ def decode_spec_megastep(
     cache: PagedKVCache, draft_cache: PagedKVCache, active, budgets, eos_ids,
     temp, topk, topp, do_sample, rng_keys, k_steps: int, draft_len: int,
     use_kernel: bool = False, use_sampling: bool = False,
-    tp_shard: bool = False,
+    tp_shard: bool = False, overlap_chunks: int = 1,
 ):
     """Device-resident SPECULATIVE decode megastep over the paged pool —
     ``decode_megastep`` with a draft/verify inner loop: per iteration the
@@ -476,12 +477,16 @@ def decode_spec_megastep(
 
     def target_extend(toks, lens, limits, kv, alive):
         return _extend_once(
-            p, cfg, toks, block_tables, lens, limits, kv, alive, use_kernel)
+            p, cfg, toks, block_tables, lens, limits, kv, alive, use_kernel,
+            overlap_chunks=overlap_chunks)
 
     def draft_extend(toks, lens, limits, kv, alive):
+        # the draft's hidden size may differ from the target's: chunks that
+        # don't divide a draft projection fall back to the monolithic
+        # matmul inside _row_matmul, so one static value drives both
         return _extend_once(
             dp, draft_cfg, toks, block_tables, lens, limits, kv, alive,
-            use_kernel)
+            use_kernel, overlap_chunks=overlap_chunks)
 
     return spec_megastep_loop(
         target_extend, draft_extend, tokens, lengths, cache, draft_cache,

@@ -1,0 +1,123 @@
+"""Int8 projection weights: symmetric absmax per OUTPUT channel.
+
+The serving engine's attention/MLP projection matmuls dominate decode HBM
+traffic once the KV pool is quantized (PR 7); this module quantizes those
+weights once at engine load (``LLMEngine(weight_dtype="int8")``) the same
+way ``kv_quant.py`` quantizes pages — symmetric absmax, one f32 scale per
+output channel, and ONE shared cast point:
+
+- ``scale[j] = absmax(W[:, j]) / 127`` over the input (contraction) dim;
+- ``Wq = clip(round(W / scale), -127, 127)`` stored as int8;
+- every read path computes ``y = (x · Wq accumulated in f32) * scale``
+  and casts to the compute dtype LAST — the Pallas ``quant_matmul``
+  kernel fuses the scale multiply into its matmul epilogue, and the XLA
+  reference branch (``kernel/ops.py::_quant_matmul_xla``) runs the
+  identical chain, so the two are bitwise-interchangeable (the parity
+  contract ``tests/test_kernel/test_quant_matmul.py`` asserts).
+
+Per-OUTPUT-channel granularity is what lets the scale ride the epilogue:
+the contraction consumes whole input columns, so each output element owns
+exactly one scale and the dequant is a rank-1 broadcast after the int
+matmul — no per-block rescale mid-accumulation.
+
+A quantized projection leaf is the plain leaf plus a ``"scale"`` entry::
+
+    {"kernel": int8 [in, out], "scale": f32 [out], ("bias": f32 [out])}
+
+(scanned layer stacks carry the layer dim in front: kernel [L, in, out],
+scale [L, out] — ``lax.scan`` slices both together). Biases stay float —
+they are O(out) and add AFTER the dequant, so quantizing them buys
+nothing. The decode forwards (``modeling._proj`` / ``_row_matmul``)
+dispatch on the presence of ``"scale"``, so quantized and plain trees
+share every jitted program shape decision downstream.
+
+Only the seven dense projections quantize (q/k/v/o, gate/up/down):
+embeddings and the lm_head stay in the checkpoint dtype (logit fidelity),
+norms are O(hidden), and MoE expert banks keep their own layout — a MoE
+model still quantizes its attention projections and runs unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: symmetric int8 range, matching kv_quant (never -128: negation
+#: round-trips and |q * scale| <= absmax)
+INT8_MAX = 127.0
+
+#: the projection leaves that quantize — everything else passes through
+PROJ_NAMES = frozenset(
+    ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj",
+     "down_proj")
+)
+
+
+def channel_scales(w: jax.Array) -> jax.Array:
+    """Per-output-channel symmetric scales: absmax over the INPUT dim.
+
+    w [..., in, out] (any leading layer dims) → f32 [..., out]. All-zero
+    channels get scale 1.0 (quantize to zeros) instead of dividing by
+    zero — the same discipline as ``kv_quant.safe_scale``."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = absmax / INT8_MAX
+    return jnp.where(scale > 0, scale, 1.0)
+
+
+def quantize_weight(w: jax.Array, scales: jax.Array) -> jax.Array:
+    """w [..., in, out] / scales [..., out] → int8 [..., in, out]."""
+    q = jnp.round(w.astype(jnp.float32) / scales[..., None, :])
+    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+
+def dequantize_weight(q: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """int8 [..., in, out] * scales [..., out] → ``dtype``. The reference
+    cast chain (f32 multiply, cast last); the matmul paths never call
+    this — they fold the scale into the epilogue instead — but tests and
+    offline tooling need the materialized round-trip."""
+    return (q.astype(jnp.float32) * scales[..., None, :]).astype(dtype)
+
+
+def quantize_leaf(leaf: dict) -> dict:
+    """One projection leaf {"kernel", ("bias")} → its quantized form."""
+    scales = channel_scales(leaf["kernel"])
+    out = dict(leaf)
+    out["kernel"] = quantize_weight(leaf["kernel"], scales)
+    out["scale"] = scales
+    return out
+
+
+def quantize_params(params):
+    """Quantize every attention/MLP projection in a param tree in place
+    of its float kernel (returns a new tree; the input is not mutated).
+
+    Walks the nested-dict tree and rewrites exactly the ``PROJ_NAMES``
+    leaves that look like projections (a dict holding a ``"kernel"``);
+    everything else — embeddings, norms, lm_head, MoE expert banks,
+    non-dict leaves — passes through untouched. Scanned stacks work
+    unchanged: the absmax reduces the input dim only, so a [L, in, out]
+    kernel yields [L, out] scales that scan alongside it."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for name, child in node.items():
+            if (
+                name in PROJ_NAMES
+                and isinstance(child, dict)
+                and "kernel" in child
+            ):
+                out[name] = quantize_leaf(child)
+            else:
+                out[name] = walk(child)
+        return out
+
+    return walk(params)
+
+
+def tree_weight_bytes(params) -> int:
+    """Real device bytes of a param tree (the ``weight_pool_bytes``
+    gauge): summed from ``.nbytes`` so the number is what HBM actually
+    holds, scales included."""
+    return int(sum(leaf.nbytes for leaf in jax.tree.leaves(params)))

@@ -15,6 +15,7 @@ from .ops import (
     fused_moe,
     fused_rms_norm,
     fused_softmax,
+    quant_matmul,
     rope_and_cache_update,
     rope_embed,
     silu_and_mul,
@@ -29,6 +30,7 @@ __all__ = [
     "fused_moe",
     "fused_rms_norm",
     "fused_softmax",
+    "quant_matmul",
     "rope_and_cache_update",
     "rope_embed",
     "silu_and_mul",

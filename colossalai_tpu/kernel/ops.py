@@ -119,6 +119,38 @@ def fused_add_rms_norm(x, residual, scale, eps: float = 1e-5):
     return KernelLoader.load("rms_norm")(x, scale, eps=eps, residual=residual)
 
 
+# ------------------------------------------------------- dequantizing matmul
+# ≙ reference colossalai/quantization weight-only int8 linear (PAPER.md
+# layer 5); serving-side consumer is inference/weight_quant.py
+
+
+def _quant_matmul_xla(x, wq, scale, out_dtype=None):
+    """The reference chain the Pallas kernel must reproduce bitwise:
+    cast both operands to f32, contract in f32, scale in f32, cast last."""
+    out_dtype = jnp.dtype(out_dtype if out_dtype is not None else x.dtype)
+    acc = jnp.dot(x.astype(jnp.float32), wq.astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    return (acc * scale.astype(jnp.float32)).astype(out_dtype)
+
+
+def _quant_matmul_pallas(x, wq, scale, out_dtype=None):
+    from .pallas.quant_matmul import quant_matmul as qm
+
+    return qm(x, wq, scale, out_dtype=out_dtype)
+
+
+KernelLoader.register("quant_matmul", "pallas", _pallas_module("quant_matmul"), _quant_matmul_pallas)
+KernelLoader.register("quant_matmul", "xla", lambda: True, _quant_matmul_xla)
+
+
+def quant_matmul(x, wq, scale, out_dtype=None):
+    """``x [..., in] @ int8 wq [in, out] * f32 scale [out]`` with the
+    per-output-channel dequant fused into the matmul epilogue (Pallas on
+    TPU — the int8 tile is the only weight HBM traffic) or the identical
+    f32-accumulate chain under XLA."""
+    return KernelLoader.load("quant_matmul")(x, wq, scale, out_dtype=out_dtype)
+
+
 # ---------------------------------------------------------------- LayerNorm
 # ≙ layer_norm_kernel.cu (683 LoC, Apex lineage)
 

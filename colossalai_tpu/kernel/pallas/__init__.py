@@ -13,6 +13,7 @@ from .flash_attention import (
 from .fused_moe import fused_moe
 from .layer_norm import layer_norm
 from .paged_attention import paged_attention
+from .quant_matmul import quant_matmul
 from .rms_norm import fused_add_rms_norm, rms_norm
 from .rope import fused_rope, rope_and_cache_update
 from .softmax import (
@@ -29,6 +30,7 @@ __all__ = [
     "fused_rope",
     "layer_norm",
     "paged_attention",
+    "quant_matmul",
     "rms_norm",
     "rope_and_cache_update",
     "scaled_masked_softmax",

@@ -1,0 +1,88 @@
+"""Pallas dequantizing matmul: int8 weights, scale fused into the epilogue.
+
+The serving engine's ``weight_dtype="int8"`` mode stores every attention/
+MLP projection as ``{int8 kernel [in, out], f32 scale [out]}``
+(``inference/weight_quant.py`` — symmetric per-output-channel absmax).
+This kernel computes
+
+    y[i, j] = (sum_k x[i, k] * Wq[k, j]) * scale[j]
+
+with the contraction accumulated in f32 and the scale multiply riding the
+matmul epilogue — the int8 weight tile is the only weight traffic; a
+bf16/f32 copy of the projection never materializes in HBM.
+
+The grid tiles rows of ``x`` and output columns of ``Wq``; every tile
+spans the FULL contraction dim, so each output element is one whole dot
+product — per-element results are independent of the tiling, which is
+what makes the kernel bitwise-interchangeable with the XLA reference
+branch (``kernel/ops.py::_quant_matmul_xla`` runs the identical
+cast→dot(f32)→scale→cast chain). The parity test
+(``tests/test_kernel/test_quant_matmul.py``) asserts exactly that under
+interpret mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_mode as _interpret
+
+#: static tile caps; both are clamped to divisors of the actual shape so
+#: ragged edges fall back to whole-dim tiles (the parity configuration)
+_BLOCK_ROWS = 256
+_BLOCK_COLS = 512
+
+
+def _pick(cap: int, n: int) -> int:
+    """Largest divisor-of-n tile <= cap (whole-dim fallback)."""
+    t = min(cap, n)
+    while n % t:
+        t -= 1
+    return t
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref):
+    # f32 contraction + f32 scale multiply, cast LAST — the one shared
+    # chain the XLA reference reproduces verbatim
+    acc = jnp.dot(
+        x_ref[:].astype(jnp.float32),
+        w_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)[None, :]).astype(o_ref.dtype)
+
+
+def quant_matmul(x, wq, scale, out_dtype=None):
+    """``x [..., in] @ int8 wq [in, out] * f32 scale [out] → [..., out]``.
+
+    ``out_dtype`` defaults to ``x.dtype``; the accumulation is always f32
+    regardless (int8 weights carry no fraction — the f32 pass keeps the
+    epilogue exact for the bitwise parity contract)."""
+    out_dtype = jnp.dtype(out_dtype if out_dtype is not None else x.dtype)
+    lead = x.shape[:-1]
+    kin = x.shape[-1]
+    n_out = wq.shape[-1]
+    x2d = x.reshape(-1, kin)
+    n = x2d.shape[0]
+    rows = _pick(_BLOCK_ROWS, n)
+    cols = _pick(_BLOCK_COLS, n_out)
+    out = pl.pallas_call(
+        _kernel,
+        grid=(pl.cdiv(n, rows), pl.cdiv(n_out, cols)),
+        in_specs=[
+            pl.BlockSpec((rows, kin), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kin, cols), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((cols,), lambda i, j: (j,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, cols), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, n_out), out_dtype),
+        interpret=_interpret(),
+    )(x2d, wq, scale)
+    return out.reshape(lead + (n_out,))

@@ -291,7 +291,12 @@ def sp_prefill_blocks(
     width) is part of the key: the same local shapes under a wider ring
     see a different compute/ICI overlap, and a winner measured at sp=2
     must not decide sp=8's tiling. The result is a CAP — callers still
-    run ``pick_block`` so non-bucket shards stay legal."""
+    run ``pick_block`` so non-bucket shards stay legal.
+
+    The degree joins the key as ``tp<n>`` — the uniform mesh-degree
+    component every mesh-dependent key carries (see
+    ``paged_heads_per_step`` / ``overlap_chunks``), so a bare shape
+    integer can never collide with a degree."""
     bq, bkv = bucket(sq), bucket(skv)
     cands: List[Tuple[int, int]] = [
         c for c in (
@@ -302,7 +307,7 @@ def sp_prefill_blocks(
     ] or [default]
     return get_tuner().tune(
         "sp_prefill",
-        (device_kind(), bq, bkv, d, _dt(dtype), int(sp)),
+        (device_kind(), bq, bkv, d, _dt(dtype), f"tp{int(sp)}"),
         cands, measure, default,
     )
 
@@ -338,7 +343,10 @@ def paged_heads_per_step(
     must not decide the tiling for the per-shard geometry (and vice
     versa) — the degree is part of the cache key. The candidate split
     must divide the PER-SHARD head count, or a winner chosen on the full
-    pool would be illegal inside a shard."""
+    pool would be illegal inside a shard. The degree rides the key as
+    ``tp<n>`` — the uniform mesh-degree component shared with
+    ``sp_prefill_blocks`` / ``overlap_chunks`` — so a degree can never
+    collide with a neighbouring bare shape integer."""
     tp = max(int(tp), 1)
     hkv_local = max(hkv // tp, 1)
     cands = sorted(
@@ -351,8 +359,37 @@ def paged_heads_per_step(
     return get_tuner().tune(
         "paged_attention",
         (device_kind(), hkv, group, d, block_size, _dt(dtype), qlen,
-         _dt(pool_dtype), tp),
+         _dt(pool_dtype), f"tp{tp}"),
         cands, measure, hkv_local,
+    )
+
+
+def overlap_chunks(
+    hidden: int, dtype, tp: int,
+    measure: Optional[Callable[[int], float]] = None, default: int = 4,
+) -> int:
+    """Chunk count for the overlap-scheduled decode row matmuls
+    (``inference/modeling.py::_row_matmul``): the tp-sharded o_proj /
+    down_proj output dim is split into ``k`` column chunks so chunk
+    ``i``'s all-reduce overlaps chunk ``i+1``'s compute. More chunks hide
+    more latency but shrink each matmul below the MXU sweet spot, so the
+    winner is measured per ``(device_kind, tp<n>, hidden, dtype)`` — the
+    tp degree scales both the partial-sum volume and the per-shard matmul
+    shape, so degrees never share an entry (the uniform ``tp<n>`` key
+    component, like ``paged_heads_per_step`` / ``sp_prefill_blocks``).
+    Candidates must divide ``hidden`` (a ragged tail chunk would change
+    numerics vs the monolithic matmul). With no ``measure`` closure the
+    largest legal candidate ≤ ``default`` is returned statically — the
+    deterministic off-TPU path."""
+    cands = [c for c in (1, 2, 4, 8) if hidden % c == 0]
+    legal_default = max((c for c in cands if c <= max(int(default), 1)),
+                        default=1)
+    if measure is None or len(cands) == 1:
+        return legal_default
+    return get_tuner().tune(
+        "overlap_decode",
+        (device_kind(), f"tp{max(int(tp), 1)}", hidden, _dt(dtype)),
+        cands, measure, legal_default,
     )
 
 

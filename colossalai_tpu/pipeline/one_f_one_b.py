@@ -38,6 +38,8 @@ import math
 from typing import Any, Callable
 
 import jax
+
+from colossalai_tpu.shard_compat import shard_map as _shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -199,13 +201,12 @@ def _pipe_fwd_impl(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
     param_specs = jax.tree.map(
         lambda l: P(None, pp_axis, *([None] * (l.ndim - 2))), params_r
     )
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb)),
         out_specs=(P(), P()),
         axis_names={pp_axis},
-        check_vma=False,
     )
     out_mb, aux_total = fn(params_r, x_mb, aux_mb)
     out = out_mb.reshape(x.shape).astype(x_dtype)
@@ -455,13 +456,12 @@ def _pipe_bwd(block_apply, mesh, n_micro, pp_axis, remat, chunks, split_dw,
     param_specs = jax.tree.map(
         lambda l: P(None, pp_axis, *([None] * (l.ndim - 2))), params_r
     )
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb), P(), P()),
         out_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb)),
         axis_names={pp_axis},
-        check_vma=False,
     )
     # the fwd averaged aux over microbatches, so each per-mb vjp seed is 1/n
     daux_in = jnp.asarray(daux, jnp.float32) / n

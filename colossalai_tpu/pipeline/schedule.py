@@ -27,6 +27,8 @@ import functools
 from typing import Any, Callable, Optional
 
 import jax
+
+from colossalai_tpu.shard_compat import shard_map as _shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -146,13 +148,12 @@ def pipeline_blocks(
     param_specs = jax.tree.map(
         lambda l: P(pp_axis, *([None] * (l.ndim - 1))), stacked_params
     )
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), aux_mb)),
         out_specs=P(),
         axis_names={pp_axis},
-        check_vma=False,
     )
     out_mb = fn(stacked_params, x_mb, aux_mb)
     return out_mb.reshape(x.shape)

@@ -1,0 +1,35 @@
+"""shard_map across jax versions.
+
+The manual-collective entry point moved twice: ``jax.experimental.
+shard_map.shard_map(..., auto=, check_rep=)`` (<= 0.4.x) became
+``jax.shard_map(..., axis_names=, check_vma=)`` (>= 0.6). ``shard_map``
+here speaks the NEW surface — ``axis_names`` names the manual mesh axes
+(None = all of them) — and translates to whichever signature the
+installed jax exposes: ``axis_names`` complements into ``auto`` and
+``check_vma`` falls back to ``check_rep``. Replication checking stays
+off either way; scan-carried ppermute state defeats the static analysis.
+"""
+
+import inspect
+
+try:  # jax >= 0.6 re-exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+_NEW_API = "axis_names" in _PARAMS
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
+    if _NEW_API:
+        kwargs = {"check_vma": False}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+    else:
+        kwargs = {"check_rep": False}
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

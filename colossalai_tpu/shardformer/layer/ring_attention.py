@@ -41,6 +41,8 @@ import functools
 from typing import Optional, Tuple
 
 import jax
+
+from colossalai_tpu.shard_compat import shard_map as _shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -166,13 +168,12 @@ def _ring_flash_fwd_impl(mesh, sp_axis, causal, window, scale, q, k, v, pos, seg
         return out.astype(q_l.dtype), lse
 
     in_specs = [qkv_spec, qkv_spec, qkv_spec, pos_spec] + ([pos_spec] if has_seg else [])
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=(qkv_spec, lse_spec),
         axis_names=manual,
-        check_vma=False,
     )
     args = (q, k, v, pos) + ((seg,) if has_seg else ())
     return fn(*args)
@@ -252,13 +253,12 @@ def _ring_flash_bwd(mesh, sp_axis, causal, window, scale, res, do):
     in_specs = [qkv_spec, qkv_spec, qkv_spec, pos_spec, qkv_spec, lse_spec, qkv_spec]
     if has_seg:
         in_specs.append(pos_spec)
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=(qkv_spec, qkv_spec, qkv_spec),
         axis_names=manual,
-        check_vma=False,
     )
     args = (q, k, v, pos, out, lse, do) + ((seg,) if has_seg else ())
     dq, dk, dv = fn(*args)
@@ -356,13 +356,14 @@ def ring_attention(
         return out.astype(q_l.dtype)
 
     in_specs = (qkv_spec, qkv_spec, qkv_spec, pos_spec) + ((pos_spec,) if has_seg else ())
-    fn = jax.shard_map(
+    # fully manual (axis_names=None): the body is pure jnp — no internal
+    # GSPMD constraints to preserve — and old XLA aborts compiling a
+    # partial-manual region with several auto axes (see shard_compat)
+    fn = _shard_map(
         local_fn,
         mesh=mesh_arg,
         in_specs=in_specs,
         out_specs=qkv_spec,
-        axis_names={sp_axis},
-        check_vma=False,
     )
     args = (q, k, v, positions) + ((segment_ids,) if has_seg else ())
     return fn(*args)

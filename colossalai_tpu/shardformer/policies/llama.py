@@ -6,7 +6,11 @@ Megatron-style TP layout:
 - embed_tokens: vocab-parallel on the vocab dim;
 - lm_head: column parallel on vocab (parallel_output keeps logits sharded
   through the CE loss, ≙ DistCrossEntropy);
-- norms replicated.
+- norms replicated;
+- weight-quant scale leaves (``weight_dtype="int8"`` projections carry a
+  per-output-channel f32 ``scale`` next to their int8 kernel) follow the
+  kernel's OUTPUT dim: column-parallel projections shard it over tp, row-
+  parallel ones (o/down — output dim is the replicated one) replicate it.
 """
 
 from .base_policy import Policy
@@ -17,7 +21,9 @@ class LlamaPolicy(Policy):
         (r"embed_tokens/embedding$", ("tp", None)),
         (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$", (None, "tp")),
         (r"(q_proj|k_proj|v_proj)/bias$", ("tp",)),
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/scale$", ("tp",)),
         (r"(o_proj|down_proj)/kernel$", ("tp", None)),
+        (r"(o_proj|down_proj)/scale$", ()),
         (r"lm_head/kernel$", (None, "tp")),
         (r"(input_layernorm|post_attention_layernorm|norm)/scale$", ()),
     ]

@@ -182,6 +182,8 @@ _SLOW_NODEIDS = frozenset((
     "tests/test_inference/test_kv_quant.py::test_int8_spec_rollback_refunds_pages",
     "tests/test_inference/test_kv_quant.py::test_int8_spec_tp_mesh_matches_mesh_free",
     "tests/test_inference/test_megastep.py::test_megastep_greedy_parity_k1_vs_k4",
+    "tests/test_inference/test_overlap.py::test_overlap_token_identity_on_tp_mesh[int8-True-1]",
+    "tests/test_inference/test_overlap.py::test_overlap_token_identity_on_tp_mesh[int8-True-4]",
     "tests/test_inference/test_overload.py::test_preempt_resume_identity_speculative",
     "tests/test_inference/test_telemetry.py::test_profile_endpoint_captures_annotated_trace",
     "tests/test_models/test_bert_vit_fp8.py::test_bert_tp_training",

@@ -117,6 +117,8 @@ def test_serving_names_match_grammar():
     for name in names:
         assert METRIC_NAME_RE.match(name), name
     assert {f"clt_{h}" for h in _HISTOGRAM_SPECS} <= names
+    # the residency gauges both quantization knobs report against
+    assert {"clt_kv_pool_bytes", "clt_weight_pool_bytes"} <= names
 
 
 def test_training_names_match_grammar():

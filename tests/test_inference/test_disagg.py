@@ -153,7 +153,11 @@ def _tiny_pools(cfg, dtype, n_src=6, n_dst=5):
     return src, dst
 
 
-@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.int8])
+_POOL_DTYPES = [jnp.bfloat16, jnp.int8] + (
+    [jnp.float8_e4m3fn] if hasattr(jnp, "float8_e4m3fn") else [])
+
+
+@pytest.mark.parametrize("dtype", _POOL_DTYPES)
 def test_host_transport_byte_identical_to_device(parts, dtype):
     cfg, _ = parts
     src, dst_a = _tiny_pools(cfg, dtype)
@@ -173,7 +177,8 @@ def test_host_transport_byte_identical_to_device(parts, dtype):
 
 
 @pytest.mark.parametrize("dtype,name", [(jnp.bfloat16, "bf16"),
-                                        (jnp.int8, "int8")])
+                                        (jnp.int8, "int8")] + (
+    [(jnp.float8_e4m3fn, "fp8")] if hasattr(jnp, "float8_e4m3fn") else []))
 def test_wire_roundtrip(parts, dtype, name):
     cfg, _ = parts
     src, _dst = _tiny_pools(cfg, dtype)
@@ -183,7 +188,7 @@ def test_wire_roundtrip(parts, dtype, name):
     back = PageBlockWire.from_bytes(buf)
     assert back.kv_dtype == name and back.block_size == 16
     assert back.n_blocks == 2 and back.meta == {"request_id": 7, "tokens": 33}
-    assert back.quantized == (name == "int8")
+    assert back.quantized == (name in ("int8", "fp8"))
     np.testing.assert_array_equal(back.k, wire.k)
     np.testing.assert_array_equal(back.v, wire.v)
     if back.quantized:

@@ -1,0 +1,193 @@
+"""Overlap-scheduled decode (overlap_decode= + modeling._row_matmul) and
+the topology-aware sp-prefill ring (paged_modeling._ring_permutation).
+
+The tp-sharded o_proj / down_proj matmuls decompose into k output-column
+chunks so chunk i's all-reduce overlaps chunk i+1's compute. Because the
+split is along OUTPUT columns, every output element keeps its whole
+contraction inside one chunk and ``psum`` is elementwise — so per-chunk
+psum + concat is ALGEBRAICALLY the monolithic matmul, and the contract is
+token IDENTITY (not a tolerance) against the unchunked engine across
+every composition: megastep K, speculative self-draft, int8 KV pages,
+int8 weights, sp prefill, with and without a tp mesh.
+
+The ring permutation tests pin the TASP-style greedy nearest-neighbour
+ordering on fake device coords (every hop distance-1 on a torus where
+mesh order would hop distance-2) and the mesh-order fallback whenever
+coords are absent (CPU) — which is what keeps these CPU tests exercising
+the same numerics as before.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine
+from colossalai_tpu.inference.paged_modeling import _ring_permutation
+from colossalai_tpu.kernel import tuning
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("seed", 0)
+    return LLMEngine(params, cfg, **kw)
+
+
+_RNG = np.random.RandomState(7)
+PROMPTS = [list(map(int, _RNG.randint(0, 256, size=(n,))))
+           for n in (6, 19)]
+GEN = GenerationConfig(max_new_tokens=8)
+
+
+# ------------------------------------------------------- token identity
+@pytest.mark.parametrize("megastep_k", [1, 4])
+@pytest.mark.parametrize("spec", [False, True])
+@pytest.mark.parametrize("kv", ["bf16", "int8"])
+def test_overlap_token_identity_on_tp_mesh(parts, mesh, megastep_k, spec, kv):
+    """The acceptance grid: overlap on vs off under a 2-device tp mesh
+    must be bit-identical for every (megastep K, speculative, int8 KV)
+    combination — chunked psum+concat is the same algebra, so any
+    divergence is a real bug (a ragged chunk, a missing psum, a draft
+    stack chunked with the wrong hidden size)."""
+    kw = dict(mesh=mesh, megastep_k=megastep_k)
+    if spec:
+        kw.update(draft_len=2, self_draft_layers=1)
+        kw["megastep_k"] = max(megastep_k, 2)
+    if kv == "int8":
+        kw["kv_dtype"] = "int8"
+    base = _engine(parts, **kw).generate([list(p) for p in PROMPTS], GEN)
+    out = _engine(parts, overlap_decode=4, **kw).generate(
+        [list(p) for p in PROMPTS], GEN)
+    assert out == base
+
+
+def test_overlap_single_device_identity(parts):
+    """No mesh: the chunks concat with no psum at all — still identical."""
+    base = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    out = _engine(parts, overlap_decode=2).generate(
+        [list(p) for p in PROMPTS], GEN)
+    assert out == base
+
+
+def test_overlap_composes_with_sp_prefill(parts, mesh):
+    """sp prefill's block steps route the same _row_matmul chunking (no
+    explicit psum — GSPMD owns the reduction) — identity must hold from
+    the prefill ring through overlapped decode."""
+    kw = dict(mesh=mesh, sp_prefill=0, prefill_chunk=16)
+    base = _engine(parts, **kw).generate([list(p) for p in PROMPTS], GEN)
+    out = _engine(parts, overlap_decode=4, **kw).generate(
+        [list(p) for p in PROMPTS], GEN)
+    assert out == base
+
+
+def test_overlap_composes_with_int8_weights(parts, mesh):
+    """Chunked dequantizing matmuls: the per-chunk scale slice rides each
+    kernel slice, so int8 weights + overlap == int8 weights alone."""
+    kw = dict(mesh=mesh, weight_dtype="int8")
+    base = _engine(parts, **kw).generate([list(p) for p in PROMPTS], GEN)
+    out = _engine(parts, overlap_decode=4, **kw).generate(
+        [list(p) for p in PROMPTS], GEN)
+    assert out == base
+
+
+# ------------------------------------------------------------ knob wiring
+def test_overlap_decode_knob_resolution(parts):
+    assert _engine(parts).overlap_chunks == 1
+    assert _engine(parts, overlap_decode=False).overlap_chunks == 1
+    assert _engine(parts, overlap_decode=2).overlap_chunks == 2
+    # True defers to the tuner's static default: largest legal candidate
+    eng = _engine(parts, overlap_decode=True)
+    assert eng.overlap_chunks == tuning.overlap_chunks(
+        LlamaConfig.tiny().hidden_size, jnp.float32, 1)
+
+
+def test_overlap_decode_validation(parts):
+    # 5 does not divide hidden_size=64: a ragged tail chunk would change
+    # numerics vs the monolithic matmul, so the engine rejects up front
+    with pytest.raises(ValueError, match="overlap_decode"):
+        _engine(parts, overlap_decode=5)
+    with pytest.raises(ValueError, match="overlap_decode"):
+        _engine(parts, overlap_decode=-2)
+
+
+# -------------------------------------------------------- ring permutation
+class _Dev:
+    def __init__(self, coords=None):
+        if coords is not None:
+            self.coords = coords
+
+
+class _FakeMesh:
+    def __init__(self, devs, axis="tp"):
+        self.devices = np.array(devs, dtype=object)
+        self.axis_names = (axis,)
+        self.shape = {axis: len(devs)}
+
+
+def test_ring_permutation_mesh_order_without_coords():
+    """CPU devices expose no coords: the ring must fall back to mesh
+    order exactly (this is what keeps every sp numerics test above
+    byte-stable vs the pre-topology implementation)."""
+    perm = _ring_permutation(_FakeMesh([_Dev(), _Dev(), _Dev(), _Dev()]))
+    assert perm == [(0, 1), (1, 2), (2, 3), (3, 0)]
+
+
+def test_ring_permutation_real_cpu_mesh(mesh):
+    perm = _ring_permutation(mesh)
+    assert perm == [(0, 1), (1, 0)]
+
+
+def test_ring_permutation_greedy_nearest_neighbour_on_torus():
+    """A 2x2 torus slice enumerated in row-major mesh order: mesh-order
+    hops twice at L1 distance 2; the greedy ordering visits (0,0) ->
+    (1,0) -> (1,1) -> (0,1), every hop distance 1."""
+    devs = [_Dev((0, 0, 0)), _Dev((1, 0, 0)), _Dev((0, 1, 0)),
+            _Dev((1, 1, 0))]
+    perm = _ring_permutation(_FakeMesh(devs))
+    assert perm == [(0, 1), (1, 3), (3, 2), (2, 0)]
+    for src, dst in perm:
+        d = sum(abs(a - b) for a, b in zip(devs[src].coords, devs[dst].coords))
+        assert d == 1
+
+
+def test_ring_permutation_is_single_cycle():
+    """Any valid ring is ONE cycle visiting every shard once — kv
+    positions travel with the data and the streaming-softmax merge is
+    order-insensitive, so the cycle property is the whole correctness
+    requirement."""
+    rng = np.random.RandomState(0)
+    coords = [tuple(map(int, c)) for c in rng.randint(0, 4, size=(8, 3))]
+    perm = _ring_permutation(_FakeMesh([_Dev(c) for c in coords]))
+    assert sorted(s for s, _ in perm) == list(range(8))
+    assert sorted(d for _, d in perm) == list(range(8))
+    seen, cur = [], 0
+    for _ in range(8):
+        seen.append(cur)
+        cur = dict(perm)[cur]
+    assert cur == 0 and sorted(seen) == list(range(8))
+
+
+def test_ring_permutation_two_shards_skip_topology():
+    """sp=2 is its own inverse — topology cannot improve it, so even
+    coord-bearing devices keep mesh order."""
+    perm = _ring_permutation(_FakeMesh([_Dev((0, 0)), _Dev((3, 3))]))
+    assert perm == [(0, 1), (1, 0)]

@@ -371,7 +371,7 @@ def test_metrics_exposition_parses_and_counters_monotone(served):
     # (ratios, pool-occupancy gauges) are declared gauges
     for key in eng.stats.as_dict():
         if key in ("spec_acceptance_rate", "kv_pool_bytes",
-                   "kv_blocks_in_use"):
+                   "kv_blocks_in_use", "weight_pool_bytes"):
             assert fam1[f"clt_{key}"]["type"] == "gauge"
         else:
             assert fam1[f"clt_{key}"]["type"] == "counter"
@@ -412,6 +412,9 @@ def test_health_serializes_through_as_dict(served):
                 "megastep_k", "scheduler_policy", "prefix_cache",
                 "prefix_cache_blocks", "draft_len"):
         assert key in payload
+    # both quantization knobs surface their mode next to the gauges
+    assert payload["kv_dtype"] == eng.kv_dtype
+    assert payload["weight_dtype"] == eng.weight_dtype
 
 
 def test_profile_endpoint_captures_annotated_trace(served, tmp_path):

@@ -1,0 +1,231 @@
+"""Int8 weight quantization (weight_quant.py + the weight_dtype engine
+knob).
+
+The contracts under test:
+
+- quantize→dequant round-trip error is bounded by half a quantization
+  step per output channel, and ``quantize_params`` rewrites ONLY the
+  seven attention/MLP projections (embeddings, norms, lm_head keep full
+  precision) for flat and scanned-stack layouts alike;
+- the quantized tree is materially smaller (the residency claim, from
+  real ``.nbytes`` — bench.py measures the headline model+KV ratio);
+- greedy decoding with int8 weights agrees with the full-precision
+  engine on >= 95% of TEACHER-FORCED steps (each step continues the
+  reference prefix, so one near-tie argmax flip cannot cascade into an
+  unrelated trajectory and mask the real agreement rate), and the knob
+  composes with int8 KV, speculative self-draft, prefix-cache + chunked
+  prefill, and a tp mesh;
+- megastep K never changes content, the weight-pool gauge reports the
+  quantized footprint, and config validation fails fast.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine
+from colossalai_tpu.inference import weight_quant
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def parts():
+    """f32 compute so quantization under test is the only numeric delta."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _engine(parts, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("seed", 0)
+    return LLMEngine(params, cfg, **kw)
+
+
+_RNG = np.random.RandomState(7)
+PROMPTS = [list(map(int, _RNG.randint(0, 256, size=(n,))))
+           for n in (6, 11, 19)]
+GEN = GenerationConfig(max_new_tokens=12)
+
+
+def _tf_agreement(parts, ref_kw, quant_kw):
+    """Teacher-forced per-step greedy agreement: generate the reference
+    trajectory, then ask the quantized engine for ONE token from every
+    reference prefix. Sequence-level comparison is useless here — a
+    single near-tie flip early in a 12-token rollout diverges the whole
+    tail autoregressively even when per-step agreement is ~100%."""
+    base = _engine(parts, **ref_kw).generate(
+        [list(p) for p in PROMPTS], GEN)
+    reqs, want = [], []
+    for p, out in zip(PROMPTS, base):
+        assert len(out) == 12
+        ctx = list(p)
+        for tok in out:
+            reqs.append(list(ctx))
+            want.append(tok)
+            ctx.append(tok)
+    got = _engine(parts, **quant_kw).generate(
+        reqs, GenerationConfig(max_new_tokens=1))
+    hits = sum(int(len(g) == 1 and g[0] == w) for g, w in zip(got, want))
+    return hits / len(want)
+
+
+# ------------------------------------------------------------ leaf math
+def test_channel_scales_round_trip_bound():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 48) * 2.0, jnp.float32)
+    scale = weight_quant.channel_scales(w)
+    assert scale.shape == (48,)
+    wq = weight_quant.quantize_weight(w, scale)
+    assert wq.dtype == jnp.int8
+    deq = weight_quant.dequantize_weight(wq, scale, jnp.float32)
+    err = np.abs(np.asarray(deq) - np.asarray(w))
+    bound = np.asarray(scale)[None, :] / 2 + 1e-7
+    assert (err <= bound).all(), err.max()
+    # nothing clips: the absmax element maps exactly to +-127
+    assert np.abs(np.asarray(wq)).max() == 127
+
+
+def test_channel_scales_zero_column_is_safe():
+    w = jnp.zeros((8, 4), jnp.float32)
+    scale = weight_quant.channel_scales(w)
+    np.testing.assert_array_equal(np.asarray(scale), np.ones(4))  # no /0
+    wq = weight_quant.quantize_weight(w, scale)
+    assert not np.asarray(wq).any()
+
+
+def test_quantize_params_structure(parts):
+    """Only the seven projection leaves are rewritten; every other tensor
+    (embeddings, norms, lm_head) is the SAME array object — quantization
+    must not touch, copy, or retype them."""
+    cfg, params = parts
+    qp = weight_quant.quantize_params(params)
+
+    proj_seen, scale_shapes_ok = 0, True
+    def walk(orig, quant, path=""):
+        nonlocal proj_seen, scale_shapes_ok
+        if isinstance(orig, dict):
+            assert set(quant) >= set(orig) - {"kernel"}, path
+            name = path.rsplit("/", 1)[-1]
+            if name in weight_quant.PROJ_NAMES and "kernel" in orig:
+                proj_seen += 1
+                assert quant["kernel"].dtype == jnp.int8, path
+                assert quant["scale"].dtype == jnp.float32, path
+                # flat [in, out] -> scale [out]; scanned [L, in, out] ->
+                # scale [L, out]
+                k = orig["kernel"]
+                want = k.shape[:-2] + k.shape[-1:]
+                scale_shapes_ok &= quant["scale"].shape == want
+                return
+            for key, sub in orig.items():
+                walk(sub, quant[key], f"{path}/{key}")
+        else:
+            assert quant is orig, path  # untouched leaf, same object
+
+    walk(params, qp)
+    assert proj_seen >= 7 and scale_shapes_ok
+
+
+def test_tree_weight_bytes_residency(parts):
+    """The quantized tree must be materially smaller; with f32 source
+    weights the seven projections shrink 4x (int8 + a thin scale), so the
+    whole tree (embeddings stay f32) lands well under 0.55x."""
+    cfg, params = parts
+    full = weight_quant.tree_weight_bytes(params)
+    quant = weight_quant.tree_weight_bytes(weight_quant.quantize_params(params))
+    assert 0 < quant < 0.55 * full, (quant, full)
+
+
+# -------------------------------------------------- greedy agreement gates
+def test_int8_weights_track_full_precision(parts):
+    agree = _tf_agreement(parts, {}, {"weight_dtype": "int8"})
+    assert agree >= 0.95, agree
+
+
+def test_int8_weights_compose_with_int8_kv(parts):
+    """Both quantizers on at once, judged against the int8-KV reference so
+    the weight quantization is the only delta under test."""
+    agree = _tf_agreement(
+        parts, {"kv_dtype": "int8"},
+        {"kv_dtype": "int8", "weight_dtype": "int8"})
+    assert agree >= 0.95, agree
+
+
+def test_int8_weights_compose_with_speculative(parts):
+    """Self-draft speculative megasteps run the dequantizing matmuls in
+    BOTH the draft and verify passes (the draft's truncated stack falls
+    back to monolithic row matmuls — overlap chunking keys on the full
+    hidden size)."""
+    kw = dict(draft_len=2, self_draft_layers=1, megastep_k=2)
+    agree = _tf_agreement(parts, dict(kw), dict(kw, weight_dtype="int8"))
+    assert agree >= 0.95, agree
+
+
+def test_int8_weights_prefix_cache_warm_cold_identity(parts):
+    """Prefix-cache + chunked prefill over quantized weights: warm hits
+    replay the same pages, so warm == cold exactly; and the composition
+    stays within the agreement gate vs its full-precision twin."""
+    eng = _engine(parts, weight_dtype="int8", prefix_cache=True,
+                  prefill_chunk=16)
+    cold = eng.generate([list(p) for p in PROMPTS], GEN)
+    warm = eng.generate([list(p) for p in PROMPTS], GEN)
+    assert warm == cold
+    assert eng.stats.prefix_hit_blocks > 0
+    kw = dict(prefix_cache=True, prefill_chunk=16)
+    agree = _tf_agreement(parts, dict(kw), dict(kw, weight_dtype="int8"))
+    assert agree >= 0.95, agree
+
+
+def test_int8_weights_tp_mesh(parts):
+    """Under a 2-device tp mesh the int8 kernels shard on the same axes
+    as their full-precision twins and the per-channel scales follow the
+    output dim (column-parallel sharded, row-parallel replicated — the
+    LlamaPolicy scale rules); agreement vs the full-precision mesh engine
+    holds the same gate."""
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a tp mesh")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    agree = _tf_agreement(
+        parts, {"mesh": mesh}, {"mesh": mesh, "weight_dtype": "int8"})
+    assert agree >= 0.95, agree
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_int8_weights_megastep_k_invariance(parts, k):
+    """K changes sync granularity, never content: the quantized weights
+    are identical per step, so outputs are bit-identical across K."""
+    ref = _engine(parts, weight_dtype="int8").generate(
+        [list(p) for p in PROMPTS], GEN)
+    out = _engine(parts, weight_dtype="int8", megastep_k=k).generate(
+        [list(p) for p in PROMPTS], GEN)
+    assert out == ref
+
+
+# ----------------------------------------------------------- memory gauges
+def test_weight_pool_gauge(parts):
+    eng_f = _engine(parts)
+    eng_q = _engine(parts, weight_dtype="int8")
+    assert eng_f.weight_dtype == "bf16" and eng_q.weight_dtype == "int8"
+    full, quant = eng_f.stats.weight_pool_bytes, eng_q.stats.weight_pool_bytes
+    assert full > 0 and quant > 0
+    assert quant < 0.55 * full, (quant, full)
+    # the gauge flows into the serving metric surface via as_dict
+    assert "weight_pool_bytes" in eng_q.stats.as_dict()
+
+
+def test_weight_dtype_validation(parts):
+    with pytest.raises(ValueError, match="weight_dtype"):
+        _engine(parts, weight_dtype="int4")
+    from jax.sharding import Mesh
+
+    # the pp relay carries no scale tensors: a REAL pp axis rejects
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    with pytest.raises(NotImplementedError, match="weight_dtype"):
+        _engine(parts, weight_dtype="int8", mesh=mesh)

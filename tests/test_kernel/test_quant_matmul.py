@@ -1,0 +1,87 @@
+"""Dequantizing matmul kernel (kernel/pallas/quant_matmul.py) vs the XLA
+reference chain (kernel/ops.py::_quant_matmul_xla).
+
+The contract is BITWISE interchangeability when every tile spans a whole
+dim: both branches run the identical cast->dot(f32)->scale->cast chain
+and each output element is one full dot product, so the Pallas grid must
+not change a single ULP. That is what lets ``weight_dtype="int8"``
+engines flip between kernel and XLA paths (or recompile across chunked
+prefill / megastep shapes) without perturbing greedy argmax decisions.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import weight_quant
+from colossalai_tpu.kernel.ops import _quant_matmul_xla
+from colossalai_tpu.kernel.pallas.quant_matmul import quant_matmul
+
+RNG = np.random.RandomState(0)
+
+
+def _operands(n, kin, n_out, dtype=jnp.float32):
+    x = jnp.asarray(RNG.randn(n, kin), dtype)
+    w = jnp.asarray(RNG.randn(kin, n_out), jnp.float32)
+    scale = weight_quant.channel_scales(w)
+    wq = weight_quant.quantize_weight(w, scale)
+    return x, wq, scale
+
+
+@pytest.mark.parametrize("shape", [(1, 64, 64), (4, 64, 128), (256, 32, 512)])
+def test_pallas_matches_xla_bitwise(shape):
+    # every dim <= its tile cap -> single whole-dim tile per axis: the dot
+    # inside the kernel has the exact shape of the reference dot
+    n, kin, n_out = shape
+    x, wq, scale = _operands(n, kin, n_out)
+    out = quant_matmul(x, wq, scale)
+    ref = _quant_matmul_xla(x, wq, scale)
+    assert out.dtype == ref.dtype == x.dtype
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pallas_matches_xla_bitwise_bf16_out():
+    # cast-last epilogue: the f32 accumulation result is identical, so the
+    # final bf16 rounding lands on the same values too
+    x, wq, scale = _operands(8, 64, 128, dtype=jnp.bfloat16)
+    out = quant_matmul(x, wq, scale, out_dtype=jnp.bfloat16)
+    ref = _quant_matmul_xla(x, wq, scale, out_dtype=jnp.bfloat16)
+    assert out.dtype == jnp.dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pallas_tiled_grid_matches_xla():
+    # rows/cols above the tile caps: the grid splits into multiple tiles
+    # but every tile still spans the full contraction dim, so each output
+    # element remains one whole dot product
+    x, wq, scale = _operands(512, 64, 1024)
+    out = quant_matmul(x, wq, scale)
+    ref = _quant_matmul_xla(x, wq, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_leading_batch_dims_flattened():
+    x = jnp.asarray(RNG.randn(2, 3, 32), jnp.float32)
+    w = jnp.asarray(RNG.randn(32, 48), jnp.float32)
+    scale = weight_quant.channel_scales(w)
+    wq = weight_quant.quantize_weight(w, scale)
+    out = quant_matmul(x, wq, scale)
+    assert out.shape == (2, 3, 48)
+    ref = _quant_matmul_xla(x, wq, scale)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_dequant_matmul_tracks_full_precision():
+    # end to end: int8 weight + fused scale stays within the quantization
+    # error envelope of the full-precision matmul
+    x = jnp.asarray(RNG.randn(16, 64), jnp.float32)
+    w = jnp.asarray(RNG.randn(64, 96), jnp.float32)
+    scale = weight_quant.channel_scales(w)
+    wq = weight_quant.quantize_weight(w, scale)
+    out = np.asarray(quant_matmul(x, wq, scale))
+    full = np.asarray(x) @ np.asarray(w)
+    # per-element error bound: sum of per-weight rounding errors (scale/2
+    # each) weighted by |x|
+    bound = np.abs(np.asarray(x)) @ np.full((64, 96), 0.5) * np.asarray(scale)
+    assert np.all(np.abs(out - full) <= bound + 1e-5)

@@ -181,8 +181,8 @@ def test_paged_heads_per_step_keys_on_tp_degree(tmp_path, monkeypatch):
     tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure)
     assert t.misses == 2  # tp=2 and tp=1 measured under distinct keys
     keys = list(t.chosen)
-    assert any(k.endswith("|2") for k in keys)
-    assert any(k.endswith("|1") for k in keys)
+    assert any(k.endswith("|tp2") for k in keys)
+    assert any(k.endswith("|tp1") for k in keys)
 
     # hkv/tp == 1 leaves a single legal split: resolved with no benchmark
     assert tuning.paged_heads_per_step(4, 2, 128, 16, "float32", measure,
@@ -260,8 +260,8 @@ def test_sp_prefill_blocks_keys_on_ring_degree(tmp_path, monkeypatch):
                                     default=(1024, 1024))
     assert got4 == (256, 1024) and t.misses == 2
     keys = list(t.chosen)
-    assert any(k.endswith("|2") for k in keys), keys
-    assert any(k.endswith("|4") for k in keys), keys
+    assert any(k.endswith("|tp2") for k in keys), keys
+    assert any(k.endswith("|tp4") for k in keys), keys
     assert all(k.startswith("sp_prefill|") for k in keys), keys
 
     # repeat at sp=2: pure cache hit
@@ -275,3 +275,49 @@ def test_sp_prefill_blocks_keys_on_ring_degree(tmp_path, monkeypatch):
     got_small = tuning.sp_prefill_blocks(128, 512, 128, "float32", 2, measure,
                                          default=(1024, 1024))
     assert got_small == (1024, 1024) and calls == [(1024, 1024)]
+
+
+def test_overlap_chunks_keys_on_tp_degree(tmp_path, monkeypatch):
+    """The overlap-scheduled decode chunk count keys on (device kind,
+    tp<n>, hidden, dtype): the tp degree scales both the partial-sum
+    volume and the per-shard matmul shape, so tp=2 and tp=4 must never
+    share a measurement. Candidates must divide hidden — a ragged tail
+    chunk would change numerics vs the monolithic matmul — and with no
+    measure closure the largest legal candidate <= default is returned
+    statically without touching the tuner."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    # static path: no measure closure, no tuner traffic
+    assert tuning.overlap_chunks(64, "bfloat16", 2) == 4
+    assert tuning.overlap_chunks(64, "bfloat16", 2, default=8) == 8
+    assert t.misses == 0 and t.hits == 0
+
+    # non-divisible candidates are filtered: hidden=12 legalizes to {1,2,4}
+    assert tuning.overlap_chunks(12, "bfloat16", 2, default=8) == 4
+
+    seen = []
+
+    def measure(k):
+        seen.append(k)
+        return {1: 0.004, 2: 0.001, 4: 0.002, 8: 0.003}[k]
+
+    got = tuning.overlap_chunks(4096, "bfloat16", 2, measure)
+    assert got == 2  # the measured winner
+    assert sorted(set(seen)) == [1, 2, 4, 8]
+    assert t.misses == 1
+
+    # wider tp -> distinct key, measured again
+    assert tuning.overlap_chunks(4096, "bfloat16", 4, measure) == 2
+    assert t.misses == 2
+    keys = list(t.chosen)
+    assert all(k.startswith("overlap_decode|") for k in keys), keys
+    assert any("|tp2|" in k for k in keys), keys
+    assert any("|tp4|" in k for k in keys), keys
+    assert all("4096" in k and "bfloat16" in k for k in keys), keys
+
+    # repeat at tp=2: pure cache hit
+    seen.clear()
+    assert tuning.overlap_chunks(4096, "bfloat16", 2, measure) == 2
+    assert seen == [] and t.hits == 1 and t.misses == 2

@@ -59,19 +59,48 @@ def test_capacity_row_runs_tiny(bench):
     assert "signal_before_collapse" in out
 
 
+@pytest.mark.slow
+def test_weight_quant_row_runs_tiny(bench):
+    out = bench.measure_weight_quant(bs=2, prompt_len=16, new_tokens=6)
+    for arm in ("bf16", "int8"):
+        assert out[arm]["tokens_per_s"] > 0
+        assert out[arm]["weight_pool_bytes"] > 0
+    # the residency headline: quantized model+KV sits much smaller, and
+    # the freed bytes turn into concurrent users
+    assert out["model_kv_residency_ratio"] >= 2.5
+    assert out["concurrent_users_ratio"] > 1.0
+    assert 0.0 <= out["greedy_agreement_rate"] <= 1.0
+
+
+@pytest.mark.slow
+def test_overlap_row_runs_tiny(bench):
+    out = bench.measure_overlap(bs=2, prompt_len=16, new_tokens=6, tps=(2,))
+    assert "tp2" in out, out
+    for arm in ("overlap_off", "overlap_on"):
+        assert out["tp2"][arm]["tokens_per_s"] > 0
+        assert out["tp2"][arm]["itl_ms_p50"] >= 0
+    assert out["tp2"]["decode_overlap_gain_p50"] > 0
+    # a 1-device run degrades to a skip record, not a crash
+    skipped = bench.measure_overlap(tps=(64,))
+    assert "skipped" in skipped
+
+
 # ---------------------------------------------------- --compare gate (fast)
 def test_compare_summaries_directions(bench):
     baseline = {"ttft_p99_ms": 100.0, "tokens_per_s": 1000.0,
                 "goodput_ratio": 0.9, "policy_flag": True,
-                "mystery_knob": 5.0, "dropped_key": 1.0}
+                "mystery_knob": 5.0, "dropped_key": 1.0,
+                "model_kv_residency_ratio": 3.0}
     current = {"ttft_p99_ms": 150.0,       # +50% latency: regression
                "tokens_per_s": 1200.0,     # +20% throughput: improvement
                "goodput_ratio": 0.5,       # -44% goodput: regression
                "policy_flag": False,       # bool: ignored
-               "mystery_knob": 50.0}       # unknown direction: never flagged
+               "mystery_knob": 50.0,       # unknown direction: never flagged
+               "model_kv_residency_ratio": 2.0}  # -33% residency: regression
     out = bench._compare_summaries(current, baseline, threshold=0.1)
     assert out["regressed"] is True
-    assert set(out["regressions"]) == {"ttft_p99_ms", "goodput_ratio"}
+    assert set(out["regressions"]) == {"ttft_p99_ms", "goodput_ratio",
+                                       "model_kv_residency_ratio"}
     assert set(out["improvements"]) == {"tokens_per_s"}
     assert out["missing"] == ["dropped_key"]
     assert "mystery_knob" not in out["regressions"]
