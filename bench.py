@@ -1655,6 +1655,206 @@ def measure_disagg(cfg=None, bs: int = 4, prompt_len: int = 48,
     }
 
 
+def measure_kv_wire(cfg=None, page_counts=(2, 8, 32), xfer_repeats: int = 5,
+                    bs: int = 2, prompt_len: int = 32, new_tokens: int = 24,
+                    n_batches: int = 4, load_factor: float = 1.5, k: int = 4,
+                    repeats: int = 2):
+    """Socket-streamed KV handoff (PR-17) vs blocking host staging.
+
+    Two questions, two sections. **Handoff**: move the same page set
+    pool-to-pool through ``HostKVTransport`` (pack the whole wire, then
+    deliver — the blocking baseline) and through ``SocketKVTransport``
+    (length-prefixed frames over a loopback TCP socket, one frame per
+    layer group, decode-side scatter overlapped with the next frame's
+    send), reporting per-page-count latency and payload bandwidth. Each
+    (transport, page count) pair is warmed once off the clock — the
+    scatter jit specializes on the page-count shape — and timed as the
+    best of ``xfer_repeats``, the standard microbench defense against a
+    shared-host scheduling glitch landing inside one sample.
+
+    **ITL parity**: the acceptance gate for streaming is that it buys
+    pipelining without taxing the decode tick. Both arms run the SAME
+    open-loop schedule through a :class:`DisaggEngine` — identical but
+    for the transport — and the report pairs decode ITL tails with the
+    streamed arm's ``kvwire_*`` counters (frames/bytes/overlap actually
+    observed). Arms run as order-flipped adjacent pairs with the median
+    pair reported, exactly like :func:`measure_disagg`, because tail
+    ratios on a shared host drift at whole-run granularity. The headline
+    ``itl_p99_parity_ratio`` is streamed/blocking: ≤ 1.1 on the CPU
+    path means streaming is free where it isn't actively winning."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colossalai_tpu.inference import (
+        DisaggEngine,
+        GenerationConfig,
+        HostKVTransport,
+        SocketKVTransport,
+        init_paged_cache,
+    )
+    from colossalai_tpu.inference.kv_transport import page_nbytes
+    from colossalai_tpu.models import LlamaForCausalLM
+
+    if cfg is None:
+        cfg = _small_serving_config()
+
+    # ---- section 1: transport-level handoff latency/bandwidth ----
+    block_size = 32
+    n_blocks = max(page_counts) + 2  # +1 null page, +1 slack
+    ramp = jnp.arange(n_blocks, dtype=jnp.float32)[None, :, None, None, None]
+
+    def make_pools():
+        src = init_paged_cache(cfg, n_blocks, block_size, dtype=jnp.bfloat16)
+        src = src._replace(k=src.k + ramp.astype(src.k.dtype),
+                           v=src.v - ramp.astype(src.v.dtype))
+        dst = init_paged_cache(cfg, n_blocks, block_size, dtype=jnp.bfloat16)
+        return src, dst
+
+    def time_handoff(transport, n_pages):
+        src, dst = make_pools()
+        blocks = list(range(1, n_pages + 1))  # page 0 is the null page
+        # warm: the scatter jit specializes on the page-count shape
+        dst = transport.transfer(src, dst, blocks, blocks)
+        jax.block_until_ready(dst.k)
+        best = float("inf")
+        for _ in range(xfer_repeats):
+            _, dst = make_pools()
+            jax.block_until_ready((src.k, dst.k))
+            t0 = time.perf_counter()
+            dst = transport.transfer(src, dst, blocks, blocks)
+            jax.block_until_ready(dst.k)
+            best = min(best, time.perf_counter() - t0)
+        return best, page_nbytes(dst) * n_pages
+
+    handoff = {}
+    socket_tx = SocketKVTransport()
+    try:
+        for n_pages in page_counts:
+            blocking_s, nbytes = time_handoff(HostKVTransport(), n_pages)
+            streamed_s, _ = time_handoff(socket_tx, n_pages)
+            ws = socket_tx.pop_wire_stats()
+            handoff[f"p{n_pages}"] = {
+                "n_pages": n_pages,
+                "payload_mb": round(nbytes / 1e6, 3),
+                "blocking_handoff_latency_s": round(blocking_s, 5),
+                "streamed_handoff_latency_s": round(streamed_s, 5),
+                "blocking_handoff_gbps": round(nbytes / blocking_s / 1e9, 4),
+                "streamed_handoff_gbps": round(nbytes / streamed_s / 1e9, 4),
+                "wire_frames_per_xfer": ws["frames"] // (xfer_repeats + 1),
+                "overlap_frames": ws["overlap_frames"],
+            }
+    finally:
+        socket_tx.close()
+
+    # ---- section 2: decode ITL parity, streamed vs blocking engine ----
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    n_req = n_batches * bs
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=(prompt_len,)))
+               for _ in range(n_req)]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+
+    def make_engine(kind):
+        transport = (SocketKVTransport() if kind == "streamed"
+                     else HostKVTransport())
+        e = DisaggEngine(params, cfg, transport=transport, max_batch_size=bs,
+                         max_seq_len=512, block_size=32, megastep_k=k,
+                         prefix_cache=True, tracer=True)
+        throwaway = [[int(t) ^ 1 for t in prompts[0]]] * bs
+        e.generate([list(p) for p in throwaway],
+                   GenerationConfig(max_new_tokens=k + 2))
+        e.telemetry.tracer.clear()
+        return e
+
+    # calibration: closed-loop full batch = sustainable request rate
+    eng = make_engine("blocking")
+    try:
+        t0 = time.perf_counter()
+        for p in prompts[:bs]:
+            eng.add_request(list(p), gen)
+        while eng.has_work:
+            eng.step()
+        peak_req_rate = bs / (time.perf_counter() - t0)
+    finally:
+        eng.close()
+
+    def run_arm(kind):
+        eng = make_engine(kind)
+        try:
+            s0 = eng.stats
+            base = (s0.kvwire_frames, s0.kvwire_bytes,
+                    s0.kvwire_overlap_frames, s0.kv_transfers)
+            interarrival = 1.0 / (load_factor * peak_req_rate)
+            last, itls = {}, []
+
+            def observe(req, now):
+                rid, n = req.request_id, len(req.output_ids)
+                if rid in last:
+                    t_prev, n_prev = last[rid]
+                    if n > n_prev:
+                        itls.extend(
+                            [(now - t_prev) / (n - n_prev)] * (n - n_prev))
+                last[rid] = (now, n)
+
+            i = 0
+            t0 = time.perf_counter()
+            while i < n_req or eng.has_work:
+                now = time.perf_counter()
+                while i < n_req and now - t0 >= i * interarrival:
+                    eng.add_request(list(prompts[i]), gen)
+                    i += 1
+                if eng.has_work:
+                    finished = eng.step()
+                    now = time.perf_counter()
+                    for req in eng.decode.running.values():
+                        observe(req, now)
+                    for req in finished:
+                        if req.request_id in last:
+                            observe(req, now)
+                            del last[req.request_id]
+                else:
+                    time.sleep(min(interarrival, 0.002))
+            itl_p50, itl_p99 = _tail_ms(itls)
+            s = eng.stats
+            arm = {
+                "n_requests": n_req,
+                "itl_ms_p50": itl_p50,
+                "itl_ms_p99": itl_p99,
+                "kv_transfers": s.kv_transfers - base[3],
+            }
+            if kind == "streamed":
+                arm["kvwire_frames"] = s.kvwire_frames - base[0]
+                arm["kvwire_mb"] = round((s.kvwire_bytes - base[1]) / 1e6, 3)
+                arm["kvwire_overlap_frames"] = (
+                    s.kvwire_overlap_frames - base[2])
+            return arm
+        finally:
+            eng.close()
+
+    pairs = []
+    for r in range(repeats):
+        if r % 2 == 0:
+            blk = run_arm("blocking")
+            strm = run_arm("streamed")
+        else:
+            strm = run_arm("streamed")
+            blk = run_arm("blocking")
+        pairs.append((strm["itl_ms_p99"] / max(blk["itl_ms_p99"], 1e-9),
+                      blk, strm))
+    pairs.sort(key=lambda t: t[0])
+    ratio, blk, strm = pairs[len(pairs) // 2]
+    return {
+        "handoff": handoff,
+        "peak_req_per_s": round(peak_req_rate, 2),
+        "repeats": repeats,
+        "blocking": blk,
+        "streamed": strm,
+        "itl_p99_parity_ratio": round(ratio, 3),
+    }
+
+
 def measure_moe(n_dev: int, steps: int = 5):
     """MoE pretraining throughput: a ~0.8B-active mixtral-shaped model
     (tokens/s/device — MoE MFU accounting is convention-laden, so the raw
@@ -1991,6 +2191,12 @@ def cpu_child_main():
     except Exception as e:
         print(f"cpu disagg bench failed: {e}", file=sys.stderr)
     try:
+        extras["kv_wire_cpu"] = measure_kv_wire(
+            page_counts=(2, 8, 32), xfer_repeats=3, bs=2, prompt_len=32,
+            new_tokens=24, n_batches=3, repeats=3)
+    except Exception as e:
+        print(f"cpu kv wire bench failed: {e}", file=sys.stderr)
+    try:
         extras["capacity_cpu"] = measure_capacity(
             bs=2, prompt_len=32, new_tokens=12,
             factors=(0.25, 0.5, 1.0, 2.0))
@@ -2061,6 +2267,18 @@ def cpu_child_main():
             summary[f"disagg_{arm}_itl_ms_p99"] = dg[arm]["itl_ms_p99"]
     if "itl_p99_ratio" in dg:
         summary["disagg_itl_p99_ratio"] = dg["itl_p99_ratio"]
+    kw = extras.get("kv_wire_cpu", {})
+    for pk, row in kw.get("handoff", {}).items():
+        for arm in ("blocking", "streamed"):
+            summary[f"kv_wire_{pk}_{arm}_handoff_latency_s"] = \
+                row[f"{arm}_handoff_latency_s"]
+            summary[f"kv_wire_{pk}_{arm}_handoff_gbps"] = \
+                row[f"{arm}_handoff_gbps"]
+    for arm in ("blocking", "streamed"):
+        if arm in kw:
+            summary[f"kv_wire_{arm}_itl_ms_p99"] = kw[arm]["itl_ms_p99"]
+    if "itl_p99_parity_ratio" in kw:
+        summary["kv_wire_itl_p99_parity_ratio"] = kw["itl_p99_parity_ratio"]
     capn = extras.get("capacity_cpu", {})
     for kk in ("busy_monotone_below_sat",
                "goodput_per_chip_monotone_below_sat",
@@ -2124,7 +2342,7 @@ _LOWER_BETTER = ("ttft", "itl", "stall", "latency")
 #: summary-key substrings where a LOWER value is a regression
 _HIGHER_BETTER = ("tokens_per_s", "goodput", "attainment", "scaling_x",
                   "mfu", "agreement", "gain", "concurrent_users",
-                  "reduction_x", "residency")
+                  "reduction_x", "residency", "gbps")
 
 
 def _compare_summaries(current: dict, baseline: dict,

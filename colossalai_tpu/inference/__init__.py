@@ -37,7 +37,12 @@ from .kv_transport import (
     HostKVTransport,
     KVTransport,
     PageBlockWire,
+    PoolGeometry,
+    ReshardPlan,
+    describe_pool,
+    reshard_plan,
 )
+from .kv_wire import SocketKVTransport
 from .overload import (
     PREEMPT_VICTIM_POLICIES,
     SHED_POLICIES,
@@ -107,6 +112,11 @@ __all__ = [
     "HostKVTransport",
     "KVTransport",
     "PageBlockWire",
+    "PoolGeometry",
+    "ReshardPlan",
+    "SocketKVTransport",
+    "describe_pool",
+    "reshard_plan",
     "OverloadConfig",
     "OverloadController",
     "PREEMPT_VICTIM_POLICIES",

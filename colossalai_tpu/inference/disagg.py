@@ -148,8 +148,13 @@ class DisaggEngine:
 
     ``transport`` defaults to the in-process
     :class:`~.kv_transport.DeviceKVTransport`; pass any
-    :class:`~.kv_transport.KVTransport` (e.g. ``HostKVTransport`` to
-    rehearse the wire format).
+    :class:`~.kv_transport.KVTransport` — ``HostKVTransport`` to
+    rehearse the wire format, or :class:`~.kv_wire.SocketKVTransport`
+    to stream frames over a real TCP socket (per-layer pipelining,
+    ``kvwire_*`` counters, ``kv_wire`` spans). Since ``reshard_plan``
+    the two workers may run DIFFERENT meshes (``prefill_overrides=
+    {"mesh": ...}``): a tp=N sp-prefill pool feeds a tp=M decode pool,
+    pages re-sharded in flight by the transport.
     """
 
     role = "disagg"
@@ -370,6 +375,30 @@ class DisaggEngine:
             self._requeue_counts.pop(rid, None)
         return finished
 
+    def _drain_wire_stats(self) -> Optional[Dict]:
+        """Fold a socket transport's per-transfer counters into the
+        decode worker's ``EngineStats`` (``kvwire_*`` → ``clt_kvwire_*``
+        on /metrics). A transport without ``pop_wire_stats`` — Device,
+        Host — reports None and costs one getattr."""
+        pop = getattr(self.transport, "pop_wire_stats", None)
+        if pop is None:
+            return None
+        ws = pop()
+        d = self.decode
+        d.stats.kvwire_frames += ws.get("frames", 0)
+        d.stats.kvwire_bytes += ws.get("bytes", 0)
+        d.stats.kvwire_reconnects += ws.get("reconnects", 0)
+        d.stats.kvwire_overlap_frames += ws.get("overlap_frames", 0)
+        return ws
+
+    def close(self) -> None:
+        """Release transport-held resources (the socket transport's
+        listener thread and connection). Engines have no teardown of
+        their own; safe to call twice."""
+        close = getattr(self.transport, "close", None)
+        if close is not None:
+            close()
+
     def _note_splice_failure(self, slot: int, req: Request, exc: Exception,
                              finished: List[Request]) -> None:
         """One failed splice attempt: schedule a backoff retry, or —
@@ -472,6 +501,9 @@ class DisaggEngine:
             # was: drop the fork refs, release the fresh pages, and
             # retract this call's dst_map entries — the retrying pump
             # starts a clean splice. Prefill-side pages are untouched.
+            # Wire counters of the failed attempt (frames that DID go
+            # out, reconnects) still account.
+            self._drain_wire_stats()
             if forked:
                 d.allocator.free(forked)
             d.allocator.free(copy_dst)
@@ -484,6 +516,11 @@ class DisaggEngine:
         d.stats.kv_transfer_bytes += nbytes
         d.telemetry.trace_interval(req, "kv_transfer", t0, t1,
                                    blocks=moved, nbytes=nbytes)
+        ws = self._drain_wire_stats()
+        if ws is not None and ws.get("frames"):
+            d.telemetry.trace_interval(
+                req, "kv_wire", t0, t1, frames=ws["frames"],
+                nbytes=ws["bytes"], overlap_frames=ws["overlap_frames"])
         # ---- block-table splice + direct seat in the decode batch
         slot = free[0]
         table = SequenceTable(dst_blocks)

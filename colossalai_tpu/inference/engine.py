@@ -269,6 +269,19 @@ class EngineStats:
     #: handoffs whose retry budget ran out and were requeued to the
     #: prefill waiting queue instead of poisoning the decode worker
     handoff_requeues: int = 0
+    # ---- socket KV wire (SocketKVTransport): the length-prefixed TCP
+    # framing under the disagg handoff, streamed one layer group per
+    # frame so decode-side scatter overlaps the send of later layers
+    #: wire frames sent (layer groups × transfers, target + draft pools)
+    kvwire_frames: int = 0
+    #: bytes on the wire (frame payloads + length prefixes)
+    kvwire_bytes: int = 0
+    #: times the per-pair connection was re-dialed after a wire error
+    kvwire_reconnects: int = 0
+    #: frames whose decode-side scatter landed before the sender finished
+    #: the transfer's last frame — nonzero means streaming really
+    #: pipelines instead of degenerating to blocking send-then-scatter
+    kvwire_overlap_frames: int = 0
 
     @property
     def spec_acceptance_rate(self) -> float:

@@ -24,6 +24,10 @@ Seam catalog (the only names ``arm``/``check`` accept):
 =================== ====================================================
 ``replica_step``    the Router about to call one replica's ``step()``
 ``kv_transfer``     a KVTransport page move (disagg handoff splice)
+``kv_wire``         one socket frame of a SocketKVTransport stream
+                    (checked per layer-group frame: corrupt flips frame
+                    bytes so the crc32 trips, drop loses the frame so
+                    the receiver's sequence check trips)
 ``handoff_pump``    the disagg pump about to splice one finished prefill
 ``megastep_dispatch`` the engine about to dispatch a decode megastep
 ``http_generate``   the HTTP server about to admit a ``/generate`` body
@@ -51,6 +55,7 @@ from typing import Dict, List, Optional
 FAULT_SEAMS = (
     "replica_step",
     "kv_transfer",
+    "kv_wire",
     "handoff_pump",
     "megastep_dispatch",
     "http_generate",

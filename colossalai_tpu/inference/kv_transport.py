@@ -27,11 +27,23 @@ Two implementations share one contract:
 Pools must agree on page GEOMETRY (layers, kv heads, block size, head
 dim, dtype, quantization); they may differ in block COUNT — a prefill
 worker typically runs a deep pool for long prompts while decode sizes
-for resident sequences.
+for resident sequences — and, since :func:`reshard_plan`, in device
+SHARDING: a tp=N prefill pool can feed a tp=M decode pool. Pages are
+logical ``[L, Hkv, bs, D]`` slabs; how each pool splits the kv-head
+axis over devices is that pool's business, so re-sharding in flight is
+a mechanical index transform (GSPMD's observation), not a format
+change. The jitted fast path requires matching shardings; every other
+pairing routes through host staging, where the gather reads the global
+array and the scatter lands under the destination's sharding.
 
 The transport itself is pure pool arithmetic: no telemetry, no
 scheduling. Callers (``DisaggEngine``) wrap transfers in ``kv_transfer``
 spans and account blocks/bytes on ``EngineStats``.
+
+:class:`SocketKVTransport` (``inference/kv_wire.py``) frames this
+module's :class:`PageBlockWire` over a real TCP socket with per-layer
+pipelined streaming; the zero-copy :meth:`PageBlockWire.iter_frame_chunks`
+iterator exists for that send path.
 """
 
 from __future__ import annotations
@@ -54,7 +66,11 @@ __all__ = [
     "DeviceKVTransport",
     "HostKVTransport",
     "PageBlockWire",
+    "PoolGeometry",
+    "ReshardPlan",
     "pool_geometry",
+    "describe_pool",
+    "reshard_plan",
     "page_nbytes",
 ]
 
@@ -85,14 +101,119 @@ def page_nbytes(cache: PagedKVCache) -> int:
     return per
 
 
-def _check_pools(src: PagedKVCache, dst: PagedKVCache) -> None:
-    gs, gd = pool_geometry(src), pool_geometry(dst)
-    if gs != gd:
+def _tp_degree(arr) -> Tuple[int, str]:
+    """Sharding degree of a pool tensor over its kv-head axis (axis 2 of
+    ``[L, n, Hkv, bs, D]``) plus a human-readable tag. Unsharded /
+    single-device / unrecognized shardings all report tp=1."""
+    try:
+        sh = arr.sharding
+    except AttributeError:
+        return 1, "replicated"
+    if isinstance(sh, jax.sharding.NamedSharding):
+        spec = tuple(sh.spec)
+        axes = spec[2] if len(spec) > 2 else None
+        if axes is None:
+            return 1, "replicated"
+        names = (axes,) if isinstance(axes, str) else tuple(axes)
+        tp = 1
+        for name in names:
+            tp *= int(sh.mesh.shape[name])
+        return (tp, f"tp{tp}[kv_heads]") if tp > 1 else (1, "replicated")
+    return 1, "replicated"
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolGeometry:
+    """Structured description of one page pool: the per-page logical
+    shape every transfer must preserve, plus the per-pool degrees of
+    freedom (block count, device sharding / tp) a transfer may change.
+    Built by :func:`describe_pool`; compared by :func:`reshard_plan`."""
+
+    layers: int
+    kv_heads: int      # GLOBAL kv heads — sharding never changes this
+    block_size: int
+    head_dim: int
+    kv_dtype: str
+    quantized: bool
+    n_blocks: int      # pool capacity; transfers never require equality
+    tp: int            # kv-head sharding degree (1 = replicated)
+    sharding: str      # human tag, e.g. "tp2[kv_heads]" / "replicated"
+
+    @property
+    def page_shape(self) -> Tuple[int, int, int, int]:
+        return (self.layers, self.kv_heads, self.block_size, self.head_dim)
+
+    def describe(self) -> str:
+        scales = "present" if self.quantized else "absent"
+        return (f"{self.page_shape} kv_dtype={self.kv_dtype} "
+                f"scales={scales} n_blocks={self.n_blocks} "
+                f"sharding={self.sharding}")
+
+
+def describe_pool(cache: PagedKVCache) -> PoolGeometry:
+    """The :class:`PoolGeometry` of a live pool. Shapes are the GLOBAL
+    array shapes, so two shardings of the same logical pool describe the
+    same pages."""
+    L, n, Hkv, bs, D = cache.k.shape
+    tp, tag = _tp_degree(cache.k)
+    return PoolGeometry(
+        layers=L, kv_heads=Hkv, block_size=bs, head_dim=D,
+        kv_dtype=jnp.dtype(cache.k.dtype).name, quantized=cache.quantized,
+        n_blocks=n, tp=tp, sharding=tag,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """A validated page mapping between two pools. Existence of the plan
+    IS the compatibility proof: per-page logical geometry matches, so
+    pages move 1:1 by block id and any sharding difference is resolved
+    by gathering the global page and scattering it under the
+    destination's sharding (scales ride along for quantized pools)."""
+
+    src: PoolGeometry
+    dst: PoolGeometry
+
+    @property
+    def cross_geometry(self) -> bool:
+        """True when the pools disagree on block count or tp degree —
+        the N:M pairing the socket/host paths re-shard in flight."""
+        return (self.src.tp != self.dst.tp
+                or self.src.n_blocks != self.dst.n_blocks)
+
+    def layer_frames(self, layers_per_frame: int = 1) -> List[Tuple[int, int]]:
+        """``(lo, hi)`` layer groups for pipelined streaming — one wire
+        frame per group, scattered on arrival."""
+        g = max(1, int(layers_per_frame))
+        L = self.src.layers
+        return [(lo, min(lo + g, L)) for lo in range(0, L, g)]
+
+
+def reshard_plan(src, dst) -> ReshardPlan:
+    """Validate that pages can move from ``src`` into ``dst`` (each a
+    :class:`PagedKVCache` or a :class:`PoolGeometry`) and return the
+    :class:`ReshardPlan`. Raises ``ValueError`` on the immovable
+    mismatches — per-page shape, kv_dtype, quantization — with both
+    pools' dtype and scale-presence spelled out so a quantization
+    mismatch reads differently from a shape mismatch. Block count,
+    kv-head sharding, and tp degree are NOT immovable: those pairs get
+    a plan, and the transport re-shards in flight."""
+    gs = src if isinstance(src, PoolGeometry) else describe_pool(src)
+    gd = dst if isinstance(dst, PoolGeometry) else describe_pool(dst)
+    if (gs.page_shape != gd.page_shape or gs.kv_dtype != gd.kv_dtype
+            or gs.quantized != gd.quantized):
         raise ValueError(
-            f"pool geometry mismatch: source {gs} vs destination {gd} — "
-            "pages only move between pools built from the same model "
-            "config, block_size, and kv_dtype"
+            f"pool geometry mismatch: source {gs.describe()} vs "
+            f"destination {gd.describe()} — pages only move between pools "
+            "built from the same model config, block_size, and kv_dtype "
+            "(block count, KV-head sharding, and tp degree MAY differ; "
+            "reshard_plan maps those in flight)"
         )
+    return ReshardPlan(src=gs, dst=gd)
+
+
+def _check_pools(src: PagedKVCache, dst: PagedKVCache) -> ReshardPlan:
+    return reshard_plan(src, dst)
 
 
 def _pad_pow2(n: int) -> int:
@@ -135,6 +256,26 @@ def _deliver_pages(dst: PagedKVCache, k, v, scales, dst_idx) -> PagedKVCache:
         )
     return PagedKVCache(k=dst.k.at[:, dst_idx].set(k),
                         v=dst.v.at[:, dst_idx].set(v))
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("lo",))
+def _scatter_layer_slab(dst, k, v, scales, dst_idx, *, lo):
+    """Scatter ONE layer group's host-staged pages (``[g, n, ...]``,
+    layers ``lo .. lo+g``) into the donated destination pool — the
+    pipelined landing half: frame k lands while frame k+1 is still on
+    the wire. Padding columns scatter onto the null page (block 0),
+    whose content is never read."""
+    hi = lo + k.shape[0]
+    if dst.quantized:
+        k_scale, v_scale = scales
+        return PagedKVCache(
+            k=dst.k.at[lo:hi, dst_idx].set(k),
+            v=dst.v.at[lo:hi, dst_idx].set(v),
+            k_scale=dst.k_scale.at[lo:hi, dst_idx].set(k_scale),
+            v_scale=dst.v_scale.at[lo:hi, dst_idx].set(v_scale),
+        )
+    return PagedKVCache(k=dst.k.at[lo:hi, dst_idx].set(k),
+                        v=dst.v.at[lo:hi, dst_idx].set(v))
 
 
 def _np_payload(arr) -> np.ndarray:
@@ -182,11 +323,34 @@ class PageBlockWire:
             n += self.k_scale.nbytes + self.v_scale.nbytes
         return n
 
-    def to_bytes(self) -> bytes:
+    def iter_frame_chunks(self, wire_version: int = _WIRE_VERSION):
+        """Yield the wire buffer as chunks WITHOUT materializing one
+        contiguous copy of the payload: first the preamble+header bytes,
+        then one ``memoryview`` per tensor aliasing the array's own
+        storage (``ascontiguousarray`` is a no-op for the C-contiguous
+        arrays ``pack`` produces). The CRC32 is folded incrementally over
+        the same views, so a socket sender can ``sendall`` each chunk
+        straight from pool-staged memory — no second full-payload copy
+        anywhere on the send path. ``b"".join(iter_frame_chunks())`` is
+        byte-identical to :meth:`to_bytes`.
+
+        ``wire_version=1`` emits the legacy pre-checksum framing (no
+        ``crc32`` header field) — the compat knob interop tests use to
+        prove v2 readers still accept v1 senders.
+        """
+        if wire_version not in _WIRE_KNOWN_VERSIONS:
+            raise ValueError(f"unsupported wire version {wire_version}")
         arrays = [("k", self.k), ("v", self.v)]
         if self.quantized:
             arrays += [("k_scale", self.k_scale), ("v_scale", self.v_scale)]
-        payloads = [np.ascontiguousarray(a).tobytes() for _name, a in arrays]
+
+        def _byte_view(a: np.ndarray) -> memoryview:
+            # ml_dtypes extension dtypes (bf16, fp8) reject the buffer
+            # protocol; a uint8 reinterpret view is still zero-copy
+            a = np.ascontiguousarray(a)
+            return memoryview(a.view(np.uint8)).cast("B")
+
+        views = [_byte_view(a) for _name, a in arrays]
         header = {
             "kv_dtype": self.kv_dtype,
             "block_size": self.block_size,
@@ -195,16 +359,29 @@ class PageBlockWire:
                 {"name": name, "shape": list(a.shape), "dtype": a.dtype.name}
                 for name, a in arrays
             ],
+        }
+        if wire_version >= 2:
             # integrity: CRC32 over the concatenated tensor payload. A
             # flipped bit anywhere in the page bytes fails verification in
             # from_bytes instead of silently splicing garbage KV — the
             # disagg pump's retry loop keys off that ValueError.
-            "crc32": zlib.crc32(b"".join(payloads)) & 0xFFFFFFFF,
-        }
+            crc = 0
+            for view in views:
+                crc = zlib.crc32(view, crc)
+            header["crc32"] = crc & 0xFFFFFFFF
         hdr = json.dumps(header).encode()
-        parts = [_WIRE_MAGIC, struct.pack("<II", _WIRE_VERSION, len(hdr)), hdr]
-        parts += payloads
-        return b"".join(parts)
+        yield _WIRE_MAGIC + struct.pack("<II", wire_version, len(hdr)) + hdr
+        for view in views:
+            yield view
+
+    def frame_nbytes(self, wire_version: int = _WIRE_VERSION) -> int:
+        """Exact serialized length of :meth:`iter_frame_chunks` /
+        :meth:`to_bytes` output — what a length-prefixed framing writes
+        before the chunks."""
+        return sum(len(c) for c in self.iter_frame_chunks(wire_version))
+
+    def to_bytes(self, wire_version: int = _WIRE_VERSION) -> bytes:
+        return b"".join(self.iter_frame_chunks(wire_version))
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "PageBlockWire":
@@ -296,6 +473,75 @@ class KVTransport:
         )
         return wire
 
+    def pack_layers(self, src: PagedKVCache, blocks: List[int],
+                    lo: int, hi: int, kv_dtype: str = "bf16",
+                    meta: Optional[Dict] = None) -> PageBlockWire:
+        """Fetch layers ``lo .. hi`` of ``blocks`` into one streaming
+        frame (``k``/``v`` are ``[hi-lo, n, Hkv, bs, D]``). The layer
+        window rides in ``meta["layer_lo"]``/``meta["layer_hi"]`` so the
+        receiver scatters the slab without reassembling the full pages."""
+        idx = np.asarray(list(blocks), np.int32)
+        m = dict(meta or {})
+        m["layer_lo"], m["layer_hi"] = int(lo), int(hi)
+        return PageBlockWire(
+            kv_dtype=kv_dtype,
+            block_size=src.block_size,
+            k=_np_payload(src.k[lo:hi, idx]),
+            v=_np_payload(src.v[lo:hi, idx]),
+            k_scale=(_np_payload(src.k_scale[lo:hi, idx])
+                     if src.quantized else None),
+            v_scale=(_np_payload(src.v_scale[lo:hi, idx])
+                     if src.quantized else None),
+            meta=m,
+        )
+
+    def deliver_layers(self, dst: PagedKVCache, wire: PageBlockWire,
+                       dst_blocks: List[int]) -> PagedKVCache:
+        """Land ONE layer-group frame (``meta["layer_lo"]`` window) into
+        ``dst_blocks`` of the destination pool — the streaming splice:
+        call it per frame, in arrival order, reassigning the pool each
+        time. Indices pad to power-of-two buckets aimed at the null page
+        so a handful of programs covers every transfer size."""
+        if wire.quantized != dst.quantized:
+            raise ValueError(
+                f"wire carries quantized={wire.quantized} pages but the "
+                f"destination pool is quantized={dst.quantized}"
+            )
+        if wire.block_size != dst.block_size:
+            raise ValueError(
+                f"wire block_size={wire.block_size} != destination "
+                f"block_size={dst.block_size}"
+            )
+        if wire.n_blocks != len(dst_blocks):
+            raise ValueError(
+                f"wire holds {wire.n_blocks} pages but {len(dst_blocks)} "
+                "destination blocks were given"
+            )
+        lo = int(wire.meta.get("layer_lo", 0))
+        g = int(wire.k.shape[0])
+        if lo + g > dst.k.shape[0]:
+            raise ValueError(
+                f"frame covers layers [{lo}, {lo + g}) but the "
+                f"destination pool has {dst.k.shape[0]} layers")
+        m = _pad_pow2(len(dst_blocks))
+        idx = np.zeros(m, np.int32)
+        idx[:len(dst_blocks)] = dst_blocks
+
+        def _padded(a: np.ndarray) -> np.ndarray:
+            if a.shape[1] == m:
+                return a
+            pad = np.zeros((a.shape[0], m - a.shape[1]) + a.shape[2:],
+                           a.dtype)
+            return np.concatenate([a, pad], axis=1)
+
+        scales = None
+        if dst.quantized:
+            scales = (jnp.asarray(_padded(wire.k_scale)),
+                      jnp.asarray(_padded(wire.v_scale)))
+        return _scatter_layer_slab(dst, jnp.asarray(_padded(wire.k)),
+                                   jnp.asarray(_padded(wire.v)),
+                                   scales, jnp.asarray(idx), lo=lo)
+
     def deliver(self, dst: PagedKVCache, wire: PageBlockWire,
                 dst_blocks: List[int]) -> PagedKVCache:
         """Land a wire payload into ``dst_blocks`` of the destination
@@ -323,10 +569,26 @@ class KVTransport:
                               scales, idx)
 
 
+def _same_sharding(src: PagedKVCache, dst: PagedKVCache) -> bool:
+    """True when both pools' tensors live under one sharding (same
+    devices, same partitioning) — the precondition for the single-program
+    gather→scatter fast path. Cross-sharding pairs (tp=N prefill feeding
+    tp=M decode) must stage through the host instead: one jitted program
+    cannot span two placements."""
+    try:
+        return src.k.sharding == dst.k.sharding
+    except AttributeError:
+        return True
+
+
 class DeviceKVTransport(KVTransport):
     """In-process device-to-device page move: one jitted gather→scatter,
-    destination pool donated. The fast path when both pools live in the
-    same process (colocated disaggregation, tests, single-host fleets)."""
+    destination pool donated. The fast path when both pools live under
+    the same sharding (colocated disaggregation, tests, single-host
+    fleets). A cross-sharding pair — the N:M disagg deployment pairing a
+    tp=N prefill pool with a tp=M decode pool — transparently re-shards
+    through host staging: gather the global pages, scatter them under
+    the destination's own sharding (the :func:`reshard_plan` contract)."""
 
     def transfer(self, src: PagedKVCache, dst: PagedKVCache,
                  src_blocks: List[int], dst_blocks: List[int]) -> PagedKVCache:
@@ -335,9 +597,14 @@ class DeviceKVTransport(KVTransport):
                 f"{len(src_blocks)} source vs {len(dst_blocks)} destination "
                 "blocks — transfers are 1:1"
             )
-        _check_pools(src, dst)
+        plan = _check_pools(src, dst)
         if not src_blocks:
             return dst
+        if not _same_sharding(src, dst):
+            # re-shard in flight: the wire-format halves already do
+            # exactly gather-global → scatter-under-dst-sharding
+            wire = self.pack(src, src_blocks, kv_dtype=plan.src.kv_dtype)
+            return self.deliver(dst, wire, dst_blocks)
         m = _pad_pow2(len(src_blocks))
         si = np.zeros(m, np.int32)
         di = np.zeros(m, np.int32)
@@ -372,10 +639,10 @@ class HostKVTransport(KVTransport):
                 f"{len(src_blocks)} source vs {len(dst_blocks)} destination "
                 "blocks — transfers are 1:1"
             )
-        _check_pools(src, dst)
+        plan = _check_pools(src, dst)
         if not src_blocks:
             return dst
-        wire = self.pack(src, src_blocks)
+        wire = self.pack(src, src_blocks, kv_dtype=plan.src.kv_dtype)
         if self.serialize:
             buf = wire.to_bytes()
             if self.fault is not None:

@@ -1,0 +1,453 @@
+"""SocketKVTransport: ``PageBlockWire`` over a real TCP socket, streamed
+per layer group.
+
+``HostKVTransport`` rehearses the wire format in-process; this module
+puts an actual wire under it. One listener per transport (bind port 0
+for an ephemeral port — parallel CI runs never collide), ONE connection
+per prefill→decode pair (dialed lazily, redialed under the
+:class:`~.fault.RetryPolicy` backoff schedule after a wire error), and
+length-prefixed frames::
+
+    u32 frame_length | PageBlockWire bytes (one layer group)
+
+**Pipelined streaming** is the point: the sender emits one frame per
+layer group the moment it is packed, and the receiver thread scatters
+layer group k into device pages while group k+1 is still in flight —
+handoff latency hides behind the wire instead of serializing
+pack→send→recv→scatter. Each frame is a self-contained
+:class:`~.kv_transport.PageBlockWire` buffer (crc32'd, versioned) whose
+``meta`` carries the transfer id, frame index, and layer window; the
+receiver lands it with ``deliver_layers`` and signals completion after
+the final frame.
+
+Failure semantics reuse PR 15's machinery verbatim: any wire error — a
+frame that fails ``from_bytes`` (checksum mismatch, truncation), a
+broken sequence (dropped frame), a dead connection — surfaces to the
+caller as the ``ValueError`` the disagg pump already retries under its
+``RetryPolicy`` and escalates through requeue → poison pill. The
+connection is torn down on error, so the next attempt starts clean on a
+fresh dial (counted in ``reconnects``). A stream truncated mid-frame is
+classified by running ``from_bytes`` over the partial bytes, so the
+distinct truncation ``ValueError`` surfaces instead of a hang; every
+blocking wait carries a timeout.
+
+The :class:`~.fault.FaultInjector` arms at the ``kv_wire`` seam, checked
+once per FRAME on the send side: ``corrupt`` flips seeded bytes of one
+frame (the receiver's crc32 trips), ``drop`` discards one frame in
+transit (the receiver's sequence check trips), ``raise``/``hang`` fire
+in the sender.
+
+Geometry re-sharding rides on :func:`~.kv_transport.reshard_plan`: the
+wire carries GLOBAL logical pages, so a tp=N source pool feeds a tp=M
+destination pool with no extra machinery — the receiver's scatter lands
+under the destination's own sharding.
+
+In-process the two halves share one object (loopback, like
+``HostKVTransport`` — but the bytes genuinely cross the kernel's TCP
+stack); a cross-host deployment splits them, with the decode host
+running the listener half and completion signaled by the connection
+instead of the in-process event.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .fault import RetryPolicy
+from .kv_cache import PagedKVCache
+from .kv_transport import (
+    _WIRE_VERSION,
+    KVTransport,
+    PageBlockWire,
+    _check_pools,
+)
+
+__all__ = ["SocketKVTransport"]
+
+#: sanity cap on a single frame's length prefix — a garbage prefix must
+#: fail loudly instead of waiting for gigabytes that never arrive
+_MAX_FRAME_BYTES = 1 << 31
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Tuple[bytes, bool]:
+    """Read exactly ``n`` bytes. Returns ``(data, eof)``: ``eof=True``
+    with partial (possibly empty) data means the peer closed mid-read."""
+    parts: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = conn.recv(min(n - got, 1 << 20))
+        if not chunk:
+            return b"".join(parts), True
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts), False
+
+
+class _Delivery:
+    """Receiver-side state of one in-flight transfer: the destination
+    pool being updated frame by frame, the scatter event log, and the
+    completion/error signal the sender waits on."""
+
+    def __init__(self, dst: PagedKVCache, dst_blocks: List[int],
+                 n_frames: int):
+        self.dst = dst
+        self.dst_blocks = list(dst_blocks)
+        self.n_frames = n_frames
+        self.frames_seen = 0
+        self.done = threading.Event()
+        self.error: Optional[Exception] = None
+        #: ("scatter", frame_idx, t0, t1) — t1 is after block_until_ready,
+        #: so "landed" means landed
+        self.events: List[Tuple] = []
+
+    def fail(self, exc: Exception) -> None:
+        if self.error is None:
+            self.error = exc
+        self.done.set()
+
+
+class SocketKVTransport(KVTransport):
+    """KV page moves framed over a loopback TCP socket with per-layer
+    pipelined streaming — the cross-process rehearsal of the disagg
+    handoff.
+
+    Knobs:
+
+    - ``layers_per_frame`` — layer-group granularity of the stream (1 =
+      one frame per layer, maximum overlap; larger groups amortize
+      header/scatter overhead for deep models).
+    - ``retry`` — the ``RetryPolicy`` governing connection (re)dials;
+      transfer-level failures propagate to the caller, whose pump owns
+      that retry budget (PR 15 semantics, reused verbatim).
+    - ``fault`` — optional ``FaultInjector`` checked at the ``kv_wire``
+      seam once per frame.
+    - ``frame_pause_s`` — sender-side pause between frames; 0 in
+      production. Tests/benches use it to make the send window wide
+      enough that scatter/send overlap is deterministic to assert.
+    - ``wire_version`` — emitted ``PageBlockWire`` framing version (the
+      v1 compat knob; readers always accept both).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 layers_per_frame: int = 1,
+                 retry: Optional[RetryPolicy] = None,
+                 fault=None,
+                 frame_pause_s: float = 0.0,
+                 recv_timeout_s: float = 30.0,
+                 connect_timeout_s: float = 2.0,
+                 wire_version: int = _WIRE_VERSION):
+        self.layers_per_frame = max(1, int(layers_per_frame))
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.fault = fault
+        self.frame_pause_s = float(frame_pause_s)
+        self.recv_timeout_s = float(recv_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.wire_version = int(wire_version)
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(0.2)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._closed = False
+        self._conn_lock = threading.Lock()
+        self._client: Optional[socket.socket] = None
+        self._ever_connected = False
+        self._dlock = threading.Lock()
+        self._deliveries: Dict[int, _Delivery] = {}
+        self._xfer_ids = itertools.count()
+        #: last wire-level parse/stream error the receiver saw (the
+        #: truncated-mid-frame test reads it; production reads counters)
+        self.last_wire_error: Optional[Exception] = None
+        #: merged, time-ordered ("send"|"scatter", frame, t0, t1) events
+        #: of the most recent transfer — the pipelining proof surface
+        self.last_events: List[Tuple] = []
+        self.last_transfer: Dict[str, float] = {}
+        self._pending_stats = self._zero_stats()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="kvwire-accept", daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------- lifecycle
+    @staticmethod
+    def _zero_stats() -> Dict[str, int]:
+        return {"frames": 0, "bytes": 0, "reconnects": 0,
+                "overlap_frames": 0}
+
+    def pop_wire_stats(self) -> Dict[str, int]:
+        """Drain the counters accumulated since the last pop — the disagg
+        pump folds them into ``EngineStats.kvwire_*`` after each splice."""
+        with self._dlock:
+            out, self._pending_stats = self._pending_stats, self._zero_stats()
+        return out
+
+    def close(self) -> None:
+        """Tear down listener, connection, and pending deliveries. Safe
+        to call twice; the transport is unusable afterwards."""
+        self._closed = True
+        self._drop_connection()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._abort_pending(ValueError("kv wire transport closed"))
+        self._accept_thread.join(timeout=1.0)
+
+    def __enter__(self) -> "SocketKVTransport":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ sender half
+    def transfer(self, src: PagedKVCache, dst: PagedKVCache,
+                 src_blocks: List[int], dst_blocks: List[int]) -> PagedKVCache:
+        if self._closed:
+            raise ValueError("kv wire transport is closed")
+        if len(src_blocks) != len(dst_blocks):
+            raise ValueError(
+                f"{len(src_blocks)} source vs {len(dst_blocks)} destination "
+                "blocks — transfers are 1:1"
+            )
+        plan = _check_pools(src, dst)
+        if not src_blocks:
+            return dst
+        groups = plan.layer_frames(self.layers_per_frame)
+        xid = next(self._xfer_ids)
+        delivery = _Delivery(dst, dst_blocks, len(groups))
+        with self._dlock:
+            self._deliveries[xid] = delivery
+        send_events: List[Tuple] = []
+        nbytes = 0
+        try:
+            nbytes = self._send_frames(src, src_blocks, groups, xid,
+                                       plan.src.kv_dtype, delivery,
+                                       send_events)
+            if not delivery.done.wait(self.recv_timeout_s):
+                raise ValueError(
+                    f"kv wire transfer {xid} timed out after "
+                    f"{self.recv_timeout_s}s waiting for the receiver "
+                    f"({delivery.frames_seen}/{delivery.n_frames} frames "
+                    "landed)")
+            if delivery.error is not None:
+                raise ValueError(
+                    f"kv wire transfer failed: {delivery.error}"
+                ) from delivery.error
+        except Exception:
+            # next attempt starts on a fresh dial; the receiver half of a
+            # dead conversation closes itself
+            self._drop_connection()
+            raise
+        finally:
+            with self._dlock:
+                self._deliveries.pop(xid, None)
+        self._finish_accounting(send_events, delivery, nbytes)
+        return delivery.dst
+
+    def _finish_accounting(self, send_events: List[Tuple],
+                           delivery: _Delivery, nbytes: int) -> None:
+        events = sorted(send_events + delivery.events, key=lambda e: e[2])
+        self.last_events = events
+        last_send_end = max((e[3] for e in send_events), default=0.0)
+        # a frame "overlapped" when its scatter STARTED before the sender
+        # finished the transfer's LAST frame — the streaming win
+        overlap = sum(1 for e in delivery.events
+                      if e[0] == "scatter" and e[2] < last_send_end
+                      and e[1] < delivery.n_frames - 1)
+        self.last_transfer = {
+            "frames": delivery.n_frames,
+            "bytes": nbytes,
+            "overlap_frames": overlap,
+        }
+        with self._dlock:
+            self._pending_stats["frames"] += delivery.n_frames
+            self._pending_stats["bytes"] += nbytes
+            self._pending_stats["overlap_frames"] += overlap
+
+    def _send_frames(self, src: PagedKVCache, blocks: List[int],
+                     groups: List[Tuple[int, int]], xid: int, kv_dtype: str,
+                     delivery: _Delivery, send_events: List[Tuple]) -> int:
+        conn = self._ensure_connected()
+        n = len(groups)
+        total_sent = 0
+        for i, (lo, hi) in enumerate(groups):
+            wire = self.pack_layers(
+                src, blocks, lo, hi, kv_dtype=kv_dtype,
+                meta={"xfer": xid, "frame": i, "n_frames": n})
+            mode = None
+            if self.fault is not None:
+                mode = self.fault.check("kv_wire")
+            t0 = time.monotonic()
+            try:
+                if mode == "drop":
+                    # the frame vanishes in transit: the receiver's
+                    # sequence check surfaces it on the NEXT frame (or the
+                    # sender's completion wait times out on a 1-frame
+                    # transfer)
+                    continue
+                if mode == "corrupt":
+                    body = self.fault.corrupt_bytes(
+                        "kv_wire", wire.to_bytes(self.wire_version))
+                    conn.sendall(struct.pack("<I", len(body)))
+                    conn.sendall(body)
+                    sent = 4 + len(body)
+                else:
+                    # zero-copy send: length prefix, then the header and
+                    # per-tensor memoryview chunks straight from the
+                    # pack-staged arrays
+                    chunks = list(wire.iter_frame_chunks(self.wire_version))
+                    length = sum(len(c) for c in chunks)
+                    conn.sendall(struct.pack("<I", length))
+                    for chunk in chunks:
+                        conn.sendall(chunk)
+                    sent = 4 + length
+            except OSError as exc:
+                # receiver may have torn the connection down because IT
+                # failed — prefer its diagnosis over "broken pipe"
+                delivery.done.wait(0.5)
+                if delivery.error is not None:
+                    raise ValueError(
+                        f"kv wire transfer failed: {delivery.error}"
+                    ) from delivery.error
+                raise ValueError(
+                    f"kv wire connection lost mid-transfer: {exc}") from exc
+            t1 = time.monotonic()
+            send_events.append(("send", i, t0, t1))
+            total_sent += sent
+            if self.frame_pause_s:
+                time.sleep(self.frame_pause_s)
+        return total_sent
+
+    def _ensure_connected(self) -> socket.socket:
+        with self._conn_lock:
+            if self._client is not None:
+                return self._client
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.port),
+                        timeout=self.connect_timeout_s)
+                    # Nagle would batch the 4-byte length prefix with the
+                    # frame body of the NEXT send — per-frame pipelining
+                    # lives on small writes landing immediately
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(self.recv_timeout_s)
+                    if self._ever_connected:
+                        with self._dlock:
+                            self._pending_stats["reconnects"] += 1
+                    self._ever_connected = True
+                    self._client = s
+                    return s
+                except OSError as exc:
+                    if self.retry.exhausted(attempt):
+                        raise ValueError(
+                            f"kv wire connect to {self.host}:{self.port} "
+                            f"failed after {attempt} attempts: {exc}"
+                        ) from exc
+                    time.sleep(self.retry.delay(attempt))
+
+    def _drop_connection(self) -> None:
+        with self._conn_lock:
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+                self._client = None
+
+    # ---------------------------------------------------------- receiver half
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="kvwire-recv", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(self.recv_timeout_s)
+        try:
+            while True:
+                prefix, eof = _recv_exact(conn, 4)
+                if eof and not prefix:
+                    return  # clean close between frames
+                if eof:
+                    raise ValueError(
+                        "socket stream truncated inside a frame length "
+                        f"prefix ({len(prefix)}/4 bytes)")
+                (length,) = struct.unpack("<I", prefix)
+                if length > _MAX_FRAME_BYTES:
+                    raise ValueError(
+                        f"kv wire frame length {length} exceeds the "
+                        f"{_MAX_FRAME_BYTES}-byte cap (garbage prefix?)")
+                body, eof = _recv_exact(conn, length)
+                if eof:
+                    # classify the partial bytes through the wire parser:
+                    # its distinct truncation ValueError is the diagnosis
+                    # (never a hang)
+                    try:
+                        PageBlockWire.from_bytes(body)
+                    except ValueError as exc:
+                        raise ValueError(
+                            "socket stream truncated mid-frame "
+                            f"({len(body)}/{length} bytes): {exc}"
+                        ) from exc
+                    raise ValueError(
+                        "socket stream truncated mid-frame "
+                        f"({len(body)}/{length} bytes)")
+                self._handle_frame(body)
+        except Exception as exc:  # noqa: BLE001 — every wire error lands here
+            if not self._closed:
+                self.last_wire_error = exc
+                self._abort_pending(exc)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_frame(self, body: bytes) -> None:
+        wire = PageBlockWire.from_bytes(body)
+        meta = wire.meta
+        xid, frame = meta.get("xfer"), meta.get("frame")
+        with self._dlock:
+            delivery = self._deliveries.get(xid)
+        if delivery is None:
+            raise ValueError(
+                f"kv wire frame for unknown transfer {xid!r} (stale or "
+                "aborted conversation)")
+        try:
+            if frame != delivery.frames_seen:
+                raise ValueError(
+                    f"kv wire frame sequence broken: expected frame "
+                    f"{delivery.frames_seen}, got {frame} — a frame was "
+                    "dropped in transit")
+            t0 = time.monotonic()
+            delivery.dst = self.deliver_layers(delivery.dst, wire,
+                                               delivery.dst_blocks)
+            jax.block_until_ready(delivery.dst.k)
+            t1 = time.monotonic()
+            delivery.events.append(("scatter", frame, t0, t1))
+            delivery.frames_seen += 1
+            if delivery.frames_seen == delivery.n_frames:
+                delivery.done.set()
+        except Exception as exc:
+            delivery.fail(exc)
+            raise
+
+    def _abort_pending(self, exc: Exception) -> None:
+        with self._dlock:
+            pending = list(self._deliveries.values())
+        for delivery in pending:
+            delivery.fail(exc)

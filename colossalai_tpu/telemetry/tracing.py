@@ -60,7 +60,7 @@ SPAN_CATALOG = frozenset({
     "prefill_stall", "first_token", "decode_megastep", "spec_megastep",
     "prefix_cache_hit", "prefix_cache_evict", "page_refund",
     "router.place", "router.sync", "shed", "preempt", "resume",
-    "kv_transfer", "replica_dead", "failover", "kv_retry",
+    "kv_transfer", "kv_wire", "replica_dead", "failover", "kv_retry",
 })
 
 

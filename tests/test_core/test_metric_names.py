@@ -202,6 +202,7 @@ def test_fault_names_match_grammar_and_collide_with_nothing():
         assert METRIC_NAME_RE.match(name), name
         assert name.startswith("clt_fault_"), name
     assert {"clt_fault_checks_replica_step", "clt_fault_checks_kv_transfer",
+            "clt_fault_checks_kv_wire",
             "clt_fault_checks_handoff_pump",
             "clt_fault_checks_megastep_dispatch",
             "clt_fault_checks_http_generate", "clt_fault_injected_raise",
@@ -296,7 +297,7 @@ def test_span_names_match_grammar_over_engine_smoke():
                "decode_megastep", "spec_megastep", "prefix_cache_hit",
                "prefix_cache_evict", "page_refund", "router.place",
                "router.sync", "shed", "preempt", "resume", "kv_transfer",
-               "replica_dead", "failover", "kv_retry"}
+               "kv_wire", "replica_dead", "failover", "kv_retry"}
     assert catalog == set(SPAN_CATALOG)
     assert names <= catalog, names - catalog
 
@@ -311,11 +312,14 @@ def test_disagg_span_and_counter_names():
     from colossalai_tpu.telemetry import SPAN_NAME_RE
 
     assert SPAN_NAME_RE.match("kv_transfer")
+    assert SPAN_NAME_RE.match("kv_wire")
     names = _serving_names()
     assert {"clt_kv_transfers", "clt_kv_transfer_blocks",
-            "clt_kv_transfer_bytes"} <= names
+            "clt_kv_transfer_bytes", "clt_kvwire_frames",
+            "clt_kvwire_bytes", "clt_kvwire_reconnects",
+            "clt_kvwire_overlap_frames"} <= names
     assert {"clt_kv_transfers", "clt_kv_transfer_blocks",
-            "clt_kv_transfer_bytes"} <= _router_names()
+            "clt_kv_transfer_bytes", "clt_kvwire_frames"} <= _router_names()
 
 
 def test_exposition_skips_unrenderable_values():

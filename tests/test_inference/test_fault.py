@@ -168,7 +168,7 @@ def test_injector_modes_and_validation():
         f.arm("kv_transfer", "raise", at=0)
     with pytest.raises(ValueError, match="unknown seam"):
         f.check("nope")
-    assert set(FAULT_SEAMS) == {"replica_step", "kv_transfer",
+    assert set(FAULT_SEAMS) == {"replica_step", "kv_transfer", "kv_wire",
                                 "handoff_pump", "megastep_dispatch",
                                 "http_generate"}
     assert set(FAULT_MODES) == {"raise", "hang", "corrupt", "drop"}

@@ -1,0 +1,415 @@
+"""Socket-streamed KV handoff (SocketKVTransport) + N:M re-sharding.
+
+The load-bearing contracts of PR 17:
+
+- **real wire, same bytes** — pages moved over the loopback TCP socket
+  land byte-identical to ``DeviceKVTransport``, for every pool dtype
+  (scales ride along), under wire v1 and v2 framing alike;
+- **token identity** — a ``DisaggEngine`` over ``SocketKVTransport``
+  produces the same greedy tokens as one over ``HostKVTransport``
+  across megastep K x {bf16, int8} x prefix cache, including the
+  speculative draft-pool mirror;
+- **pipelining is real** — with the sender throttled, the first
+  decode-side scatter lands BEFORE the sender finishes the last layer
+  frame (event-ordering proof), and the transfer accounts
+  ``overlap_frames > 0``;
+- **N:M geometry** — ``reshard_plan`` lets pools disagree on block
+  count, KV-head sharding, and tp degree; pages move tp=2 -> tp=1 and
+  back byte-identically, scales included, and a true geometry mismatch
+  (page shape / kv dtype) still fails with a message that names the
+  kv_dtype and scale-presence of both pools;
+- **failure classification** — a stream truncated mid-frame surfaces
+  the distinct ``from_bytes`` truncation error (no hang); ``kv_wire``
+  faults (corrupt -> crc trip, drop -> sequence trip) are retried by
+  the disagg pump to token-identical output, PR-15 semantics verbatim.
+
+Every transport binds port 0 (ephemeral) — parallel runs never collide.
+"""
+
+import socket
+import struct
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from colossalai_tpu.inference import (
+    DeviceKVTransport,
+    DisaggEngine,
+    GenerationConfig,
+    HostKVTransport,
+    SocketKVTransport,
+    init_paged_cache,
+    reshard_plan,
+)
+from colossalai_tpu.inference.fault import FaultInjector, RetryPolicy
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+
+BASE = dict(max_batch_size=4, max_seq_len=64, block_size=16,
+            prefill_buckets=(16, 32, 64))
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [1, 2, 3, 4, 5],
+           [2, 4, 6, 8, 10, 12, 14, 16, 18]]
+GEN = GenerationConfig(max_new_tokens=8)
+
+_POOL_DTYPES = [jnp.bfloat16, jnp.int8] + (
+    [jnp.float8_e4m3fn] if hasattr(jnp, "float8_e4m3fn") else [])
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _disagg(parts, **kw):
+    cfg, params = parts
+    return DisaggEngine(params, cfg, **{**BASE, **kw})
+
+
+def _pools(cfg, dtype, n_src=6, n_dst=5, block_size=16):
+    src = init_paged_cache(cfg, n_src, block_size, dtype=dtype)
+    ramp = jnp.arange(n_src, dtype=jnp.float32)[None, :, None, None, None]
+    src = src._replace(k=(src.k + ramp.astype(src.k.dtype)),
+                       v=(src.v - ramp.astype(src.v.dtype)))
+    if src.quantized:
+        sramp = jnp.arange(n_src, dtype=jnp.float32)[None, :, None]
+        src = src._replace(k_scale=src.k_scale + 0.5 * sramp,
+                           v_scale=src.v_scale + 0.25 * sramp)
+    dst = init_paged_cache(cfg, n_dst, block_size, dtype=dtype)
+    return src, dst
+
+
+def _assert_pools_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ------------------------------------------------------------ wire identity
+@pytest.mark.parametrize("dtype", _POOL_DTYPES)
+def test_socket_transport_byte_identical_to_device(parts, dtype):
+    """The socket path is a pure relocation: same pages as the jitted
+    device copy, for every pool dtype (scales ride along)."""
+    cfg, _ = parts
+    src, dst_a = _pools(cfg, dtype)
+    _, dst_b = _pools(cfg, dtype)
+    moves = ([3, 1, 4], [2, 4, 1])
+    out_a = DeviceKVTransport().transfer(src, dst_a, *moves)
+    with SocketKVTransport() as tx:
+        assert tx.port != 0  # port 0 bound an ephemeral port
+        out_b = tx.transfer(src, dst_b, *moves)
+        ws = tx.pop_wire_stats()
+    _assert_pools_equal(out_a, out_b)
+    assert ws["frames"] == cfg.num_hidden_layers  # layers_per_frame=1
+    assert ws["bytes"] > 0
+
+
+def test_wire_v1_and_v2_interop_over_socket(parts):
+    """A v1-emitting sender lands the same pages through a receiver that
+    accepts both framing versions — the rolling-upgrade path."""
+    cfg, _ = parts
+    src, dst_a = _pools(cfg, jnp.bfloat16)
+    _, dst_b = _pools(cfg, jnp.bfloat16)
+    moves = ([2, 3], [1, 2])
+    with SocketKVTransport(wire_version=1) as v1, SocketKVTransport() as v2:
+        out_a = v1.transfer(src, dst_a, *moves)
+        out_b = v2.transfer(src, dst_b, *moves)
+    _assert_pools_equal(out_a, out_b)
+
+
+def test_iter_frame_chunks_zero_copy_and_byte_identical(parts):
+    """The chunk iterator is the serialization: joined chunks equal
+    ``to_bytes`` for both wire versions, and the payload chunks alias
+    the staged arrays (no intermediate copy)."""
+    cfg, _ = parts
+    src, _ = _pools(cfg, jnp.bfloat16)
+    wire = HostKVTransport().pack(src, [1, 3])
+    for v in (1, 2):
+        chunks = list(wire.iter_frame_chunks(wire_version=v))
+        assert b"".join(chunks) == wire.to_bytes(wire_version=v)
+    # chunk 0 is the preamble+header; chunk 1 is k's bytes, zero-copy
+    assert np.shares_memory(np.frombuffer(chunks[1], np.uint8),
+                            np.ascontiguousarray(wire.k).view(np.uint8))
+
+
+# ------------------------------------------------------------ N:M geometry
+def test_reshard_plan_tolerates_block_count_divergence(parts):
+    """Pools that differ ONLY in block count are transferable — the plan
+    maps pages between them instead of rejecting the pair."""
+    cfg, _ = parts
+    src, _ = _pools(cfg, jnp.bfloat16, n_src=8)
+    dst = init_paged_cache(cfg, 3, 16, dtype=jnp.bfloat16)
+    plan = reshard_plan(src, dst)
+    assert plan.src.n_blocks == 8 and plan.dst.n_blocks == 3
+    out = HostKVTransport().transfer(src, dst, [5, 7], [1, 2])
+    np.testing.assert_array_equal(np.asarray(out.k[:, 1]),
+                                  np.asarray(src.k[:, 5]))
+    np.testing.assert_array_equal(np.asarray(out.v[:, 2]),
+                                  np.asarray(src.v[:, 7]))
+
+
+def test_geometry_mismatch_error_names_dtype_and_scales(parts):
+    """The immovable-mismatch error spells out kv_dtype and
+    scale-presence of BOTH pools — the first question a paging bug
+    report needs answered."""
+    cfg, _ = parts
+    src, _ = _pools(cfg, jnp.bfloat16)
+    _, dst = _pools(cfg, jnp.int8)
+    with pytest.raises(ValueError, match="pool geometry mismatch") as ei:
+        reshard_plan(src, dst)
+    msg = str(ei.value)
+    assert "kv_dtype=bfloat16" in msg and "kv_dtype=int8" in msg
+    assert "scales=absent" in msg and "scales=present" in msg
+    # the relaxation is documented in the error itself
+    assert "MAY differ" in msg
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.int8])
+def test_nm_reshard_tp2_to_tp1_and_back_byte_identical(parts, dtype):
+    """tp=2 -> tp=1 -> tp=2: pages survive both direction changes
+    byte-identically, per-page scales included. The transport detects
+    the sharding divergence and host-stages the move."""
+    cfg, _ = parts
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    kv_spec = NamedSharding(mesh, P(None, None, "tp", None, None))
+    sc_spec = NamedSharding(mesh, P(None, None, "tp"))
+
+    def shard(pool):
+        kw = dict(k=jax.device_put(pool.k, kv_spec),
+                  v=jax.device_put(pool.v, kv_spec))
+        if pool.quantized:
+            kw.update(k_scale=jax.device_put(pool.k_scale, sc_spec),
+                      v_scale=jax.device_put(pool.v_scale, sc_spec))
+        return pool._replace(**kw)
+
+    src, dst1 = _pools(cfg, dtype, n_src=6, n_dst=6)
+    src = shard(src)  # tp=2 source, replicated (tp=1) destination
+    plan = reshard_plan(src, dst1)
+    assert plan.src.tp == 2 and plan.dst.tp == 1 and plan.cross_geometry
+    tx = DeviceKVTransport()
+    moves = ([1, 3, 5], [2, 4, 5])
+    down = tx.transfer(src, dst1, *moves)
+    for b_src, b_dst in zip(*moves):
+        np.testing.assert_array_equal(np.asarray(src.k[:, b_src]),
+                                      np.asarray(down.k[:, b_dst]))
+        if src.quantized:
+            np.testing.assert_array_equal(
+                np.asarray(src.k_scale[:, b_src]),
+                np.asarray(down.k_scale[:, b_dst]))
+    # and back up: tp=1 source into a tp=2-sharded pool
+    _, dst2 = _pools(cfg, dtype, n_dst=6)
+    up = tx.transfer(down, shard(dst2), [2, 4, 5], [1, 3, 5])
+    for leaf in jax.tree.leaves(up):
+        assert len(leaf.sharding.device_set) == 2  # still tp-sharded
+    np.testing.assert_array_equal(np.asarray(up.k[:, 1]),
+                                  np.asarray(src.k[:, 1]))
+    np.testing.assert_array_equal(np.asarray(up.v[:, 5]),
+                                  np.asarray(src.v[:, 5]))
+    if src.quantized:
+        np.testing.assert_array_equal(np.asarray(up.v_scale[:, 3]),
+                                      np.asarray(src.v_scale[:, 3]))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+def test_disagg_nm_mesh_token_identity(parts):
+    """End to end: a tp=2 prefill worker feeds an unsharded decode
+    worker. The reference pair re-shards host-staged (DeviceKVTransport
+    detects the sharding divergence); the socket pair re-shards over
+    the wire — same prefill numerics, so any token drift is the
+    transport's N:M path. (An UNSHARDED reference is deliberately not
+    the bar: tp=2 matmuls reduce in a different order, and greedy
+    argmax over a random-init model is chaotic under that epsilon.)"""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    ref = _disagg(parts, prefill_overrides={"mesh": mesh}) \
+        .generate(PROMPTS, GEN)
+    dis = _disagg(parts, prefill_overrides={"mesh": mesh},
+                  transport=SocketKVTransport())
+    try:
+        assert dis.generate(PROMPTS, GEN) == ref
+        assert dis.stats.kvwire_frames > 0
+    finally:
+        dis.close()
+
+
+# --------------------------------------------------------------- streaming
+def test_pipelining_first_scatter_lands_before_last_send(parts):
+    """The event-ordering proof: with the sender throttled between
+    frames, the receiver's first scatter COMPLETES before the sender
+    finishes the last layer frame — the stream genuinely overlaps."""
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    with SocketKVTransport(frame_pause_s=0.02) as tx:
+        tx.transfer(src, dst, [1, 2], [1, 2])  # warm the scatter jit
+        src2, dst2 = _pools(cfg, jnp.bfloat16)
+        tx.pop_wire_stats()
+        tx.transfer(src2, dst2, [1, 2], [1, 2])
+        events = tx.last_events
+        ws = tx.pop_wire_stats()
+    sends = [e for e in events if e[0] == "send"]
+    scatters = [e for e in events if e[0] == "scatter"]
+    assert len(sends) == len(scatters) == cfg.num_hidden_layers >= 2
+    last_send_end = sends[-1][3]
+    assert scatters[0][3] < last_send_end  # landed, not merely started
+    assert ws["overlap_frames"] >= 1
+
+
+def test_layers_per_frame_groups_the_stream(parts):
+    """layers_per_frame=num_layers pools the whole transfer into one
+    frame — the no-pipelining degenerate case still lands identical
+    bytes."""
+    cfg, _ = parts
+    src, dst_a = _pools(cfg, jnp.int8)
+    _, dst_b = _pools(cfg, jnp.int8)
+    moves = ([1, 4], [3, 1])
+    out_a = DeviceKVTransport().transfer(src, dst_a, *moves)
+    with SocketKVTransport(layers_per_frame=cfg.num_hidden_layers) as tx:
+        out_b = tx.transfer(src, dst_b, *moves)
+        assert tx.pop_wire_stats()["frames"] == 1
+    _assert_pools_equal(out_a, out_b)
+
+
+# ----------------------------------------------------- failure classification
+def test_truncated_mid_frame_distinct_error_no_hang(parts):
+    """A peer that dies mid-frame: the receiver classifies the partial
+    bytes through ``from_bytes`` and records the distinct truncation
+    error instead of hanging — and the transport keeps serving."""
+    cfg, _ = parts
+    with SocketKVTransport() as tx:
+        raw = socket.create_connection((tx.host, tx.port), timeout=2.0)
+        src, _ = _pools(cfg, jnp.bfloat16)
+        body = HostKVTransport().pack(src, [1]).to_bytes()
+        raw.sendall(struct.pack("<I", len(body)))
+        raw.sendall(body[:40])  # die mid-frame
+        raw.close()
+        deadline = time.monotonic() + 5.0
+        while tx.last_wire_error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        msg = str(tx.last_wire_error)
+        assert "truncated mid-frame" in msg
+        assert f"40/{len(body)} bytes" in msg
+        assert "truncated" in msg.split(":", 1)[1]  # from_bytes' diagnosis
+        # a fresh transfer on the same transport still works
+        src2, dst2 = _pools(cfg, jnp.bfloat16)
+        out = tx.transfer(src2, dst2, [2], [3])
+        np.testing.assert_array_equal(np.asarray(out.k[:, 3]),
+                                      np.asarray(src2.k[:, 2]))
+
+
+def test_garbage_length_prefix_fails_loudly(parts):
+    """A prefix claiming gigabytes that never arrive must error, not
+    wait for them."""
+    with SocketKVTransport() as tx:
+        raw = socket.create_connection((tx.host, tx.port), timeout=2.0)
+        raw.sendall(struct.pack("<I", (1 << 32) - 1))
+        deadline = time.monotonic() + 5.0
+        while tx.last_wire_error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        raw.close()
+        assert "frame length" in str(tx.last_wire_error)
+
+
+# ----------------------------------------------------- engine token identity
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_socket_engine_token_identity_grid(parts, kv_dtype):
+    """The acceptance grid: DisaggEngine over the socket equals the
+    host-transport pair token-for-token across K x prefix-cache, and
+    the kvwire counters account real frames/bytes."""
+    for k in (1, 4):
+        for pc in (False, True):
+            kw = dict(kv_dtype=kv_dtype, megastep_k=k, prefix_cache=pc)
+            ref_eng = _disagg(parts, transport=HostKVTransport(), **kw)
+            ref = ref_eng.generate(PROMPTS, GEN)
+            dis = _disagg(parts, transport=SocketKVTransport(), **kw)
+            try:
+                assert dis.generate(PROMPTS, GEN) == ref, (kv_dtype, k, pc)
+                s = dis.stats
+                assert s.kv_transfers == len(PROMPTS)
+                assert s.kvwire_frames > 0
+                assert s.kvwire_bytes >= s.kv_transfer_bytes
+                assert s.kvwire_reconnects == 0
+            finally:
+                dis.close()
+
+
+def test_socket_engine_token_identity_speculative(parts):
+    """The draft-pool mirror crosses the wire too: spec decode over the
+    socket equals the host-transport pair."""
+    kw = dict(megastep_k=2, draft_len=2, self_draft_layers=1)
+    ref = _disagg(parts, transport=HostKVTransport(), **kw) \
+        .generate(PROMPTS[:2], GEN)
+    dis = _disagg(parts, transport=SocketKVTransport(), **kw)
+    try:
+        assert dis.generate(PROMPTS[:2], GEN) == ref
+        # every splice moved target AND draft pages over the wire
+        assert dis.stats.kv_transfer_blocks % 2 == 0
+        assert dis.stats.kvwire_frames > 0
+    finally:
+        dis.close()
+
+
+def test_kv_wire_span_and_counters_flow_to_stats(parts):
+    """The splice path drains the transport's counters into
+    ``EngineStats.kvwire_*`` (the /metrics surface) and emits a
+    ``kv_wire`` span alongside each ``kv_transfer``."""
+    dis = _disagg(parts, transport=SocketKVTransport(), tracer=True)
+    try:
+        dis.generate(PROMPTS, GEN)
+        d = dis.stats.as_dict()
+        assert d["kvwire_frames"] > 0 and d["kvwire_bytes"] > 0
+        assert d["kvwire_reconnects"] == 0
+        spans = [s for s in dis.telemetry.tracer.spans()
+                 if s.name == "kv_wire"]
+        assert len(spans) == dis.stats.kv_transfers
+        assert all(s.args["frames"] > 0 for s in spans)
+    finally:
+        dis.close()
+
+
+# ------------------------------------------------------------ fault seams
+def test_kv_wire_corrupt_fault_retries_token_identical(parts):
+    """One corrupted frame: the receiver's crc trips, the pump rolls
+    back and retries over a FRESH connection — token-identical output,
+    one kv retry, one reconnect on the books."""
+    ref_eng = _disagg(parts, transport=HostKVTransport())
+    ref = ref_eng.generate(PROMPTS, GEN)
+    fault = FaultInjector(seed=0)
+    fault.arm("kv_wire", "corrupt", at=1, times=1)
+    retry = RetryPolicy(max_retries=3, base_delay_s=0.0, max_delay_s=0.0,
+                        jitter=0.0)
+    dis = _disagg(parts,
+                  transport=SocketKVTransport(fault=fault, retry=retry),
+                  fault=fault, retry=retry)
+    try:
+        assert dis.generate(PROMPTS, GEN) == ref
+        assert dis.stats.kv_retries == 1
+        assert dis.stats.kvwire_reconnects == 1
+        assert dis.stats.requests_error == 0
+        assert fault.stats()["checks_kv_wire"] > 0
+    finally:
+        dis.close()
+
+
+def test_kv_wire_drop_fault_breaks_sequence_then_retries(parts):
+    """A frame dropped in transit trips the receiver's sequence check
+    (frames must arrive in order); the pump's retry completes the
+    handoff token-identically."""
+    ref_eng = _disagg(parts, transport=HostKVTransport())
+    ref = ref_eng.generate(PROMPTS[:2], GEN)
+    fault = FaultInjector(seed=0)
+    fault.arm("kv_wire", "drop", at=1, times=1)
+    retry = RetryPolicy(max_retries=3, base_delay_s=0.0, max_delay_s=0.0,
+                        jitter=0.0)
+    dis = _disagg(parts,
+                  transport=SocketKVTransport(fault=fault, retry=retry),
+                  fault=fault, retry=retry)
+    try:
+        assert dis.generate(PROMPTS[:2], GEN) == ref
+        assert dis.stats.kv_retries >= 1
+        assert dis.stats.requests_error == 0
+    finally:
+        dis.close()

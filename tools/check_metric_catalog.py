@@ -11,8 +11,8 @@ on any mismatch:
 
 - every ``clt_*`` family the docs mention must be emitted by some
   renderer and obey the Prometheus grammar;
-- every ``clt_capacity_*`` family the code emits must be documented
-  (the strict direction for the newest family);
+- every ``clt_capacity_*`` and ``clt_kvwire_*`` family the code emits
+  must be documented (the strict direction for the newest families);
 - every ``clt_fault_*`` family and the router failover counters must be
   documented too — a chaos drill is exactly when an undocumented
   counter hurts most;
@@ -215,6 +215,19 @@ def run_checks(doc_text=None):
         failures.append(
             f"code emits {name} but docs/observability.md does not "
             "document it (extend the clt_capacity_* table)")
+
+    # the KV-wire family (SocketKVTransport) is strict in both
+    # directions: every clt_kvwire_* counter the engine can emit must be
+    # documented — cross-process disagg debugging leans on these rows
+    kvwire = {n for n in catalogs["serving"] if n.startswith("clt_kvwire_")}
+    if not kvwire:
+        failures.append(
+            "EngineStats no longer emits any clt_kvwire_* family — the "
+            "socket KV wire lost its counters")
+    for name in sorted(kvwire - documented):
+        failures.append(
+            f"code emits {name} but docs/observability.md does not "
+            "document it (extend the KV-wire counter table)")
 
     # the fault + failover families are strict in BOTH directions too:
     # a chaos drill is exactly when an undocumented counter hurts most
