@@ -481,8 +481,18 @@ class DisaggEngine:
         nbytes = 0
         try:
             if fresh_src:
-                d.cache = self.transport.transfer(
-                    p.cache, d.cache, fresh_src, copy_dst)
+                # a streamed transport donates the destination pool frame
+                # by frame; on failure it hands the LIVE pool back as
+                # ``exc.live_dst`` — rebind before re-raising so the retry
+                # never touches a donated/deleted buffer
+                try:
+                    d.cache = self.transport.transfer(
+                        p.cache, d.cache, fresh_src, copy_dst)
+                except Exception as exc:
+                    live = getattr(exc, "live_dst", None)
+                    if live is not None:
+                        d.cache = live
+                    raise
                 moved = len(fresh_src)
                 nbytes = moved * self._page_bytes
                 if d.draft_len and d.draft_cache is not None:
@@ -491,8 +501,14 @@ class DisaggEngine:
                     # draft pool at these src ids, so the same index move
                     # lands draft KV at the same dst ids the decode-side
                     # spec megastep will read
-                    d.draft_cache = self.transport.transfer(
-                        p.draft_cache, d.draft_cache, fresh_src, copy_dst)
+                    try:
+                        d.draft_cache = self.transport.transfer(
+                            p.draft_cache, d.draft_cache, fresh_src, copy_dst)
+                    except Exception as exc:
+                        live = getattr(exc, "live_dst", None)
+                        if live is not None:
+                            d.draft_cache = live
+                        raise
                     moved += len(fresh_src)
                     nbytes += len(fresh_src) * self._draft_page_bytes
         except Exception:

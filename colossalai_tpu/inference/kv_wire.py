@@ -26,10 +26,17 @@ broken sequence (dropped frame), a dead connection — surfaces to the
 caller as the ``ValueError`` the disagg pump already retries under its
 ``RetryPolicy`` and escalates through requeue → poison pill. The
 connection is torn down on error, so the next attempt starts clean on a
-fresh dial (counted in ``reconnects``). A stream truncated mid-frame is
-classified by running ``from_bytes`` over the partial bytes, so the
-distinct truncation ``ValueError`` surfaces instead of a hang; every
-blocking wait carries a timeout.
+fresh dial (counted in ``reconnects``). Because each frame's scatter
+donates the previous destination-pool buffer, a failed transfer attaches
+the LIVE pool to the raised exception as ``exc.live_dst`` — the caller
+must rebind its pool reference from it before retrying, or the retry
+reads a deleted array on TPU/GPU (donation is a no-op only on CPU). A
+stream truncated mid-frame is classified by running ``from_bytes`` over
+the partial bytes, so the distinct truncation ``ValueError`` surfaces
+instead of a hang; every blocking wait carries a timeout — but an IDLE
+timeout between frames is not an error: the sender caches its connection
+across arbitrarily long gaps between transfers, and the receiver keeps
+waiting unless prefix bytes arrived or a transfer is in flight.
 
 The :class:`~.fault.FaultInjector` arms at the ``kv_wire`` seam, checked
 once per FRAME on the send side: ``corrupt`` flips seeded bytes of one
@@ -103,6 +110,9 @@ class _Delivery:
         self.n_frames = n_frames
         self.frames_seen = 0
         self.done = threading.Event()
+        #: held across each scatter+rebind of ``dst`` so a failing sender
+        #: never reads the donated pre-scatter buffer as "live"
+        self.lock = threading.Lock()
         self.error: Optional[Exception] = None
         #: ("scatter", frame_idx, t0, t1) — t1 is after block_until_ready,
         #: so "landed" means landed
@@ -156,6 +166,7 @@ class SocketKVTransport(KVTransport):
         self.host, self.port = self._listener.getsockname()[:2]
         self._closed = False
         self._conn_lock = threading.Lock()
+        self._send_lock = threading.Lock()
         self._client: Optional[socket.socket] = None
         self._ever_connected = False
         self._dlock = threading.Lock()
@@ -223,11 +234,11 @@ class SocketKVTransport(KVTransport):
         with self._dlock:
             self._deliveries[xid] = delivery
         send_events: List[Tuple] = []
-        nbytes = 0
+        progress = {"frames": 0, "bytes": 0}
         try:
-            nbytes = self._send_frames(src, src_blocks, groups, xid,
-                                       plan.src.kv_dtype, delivery,
-                                       send_events)
+            self._send_frames(src, src_blocks, groups, xid,
+                              plan.src.kv_dtype, delivery, send_events,
+                              progress)
             if not delivery.done.wait(self.recv_timeout_s):
                 raise ValueError(
                     f"kv wire transfer {xid} timed out after "
@@ -238,15 +249,31 @@ class SocketKVTransport(KVTransport):
                 raise ValueError(
                     f"kv wire transfer failed: {delivery.error}"
                 ) from delivery.error
-        except Exception:
+        except Exception as exc:
             # next attempt starts on a fresh dial; the receiver half of a
             # dead conversation closes itself
             self._drop_connection()
+            # frames that DID go out still account — the failed attempt's
+            # wire traffic was real
+            with self._dlock:
+                self._pending_stats["frames"] += progress["frames"]
+                self._pending_stats["bytes"] += progress["bytes"]
+            # frames that landed donated the caller's pool buffer frame by
+            # frame — hand the live pool back so the retry starts from a
+            # real buffer, not a deleted array. Best-effort lock: if a
+            # scatter is still in flight after the grace period, the last
+            # published rebind is the best answer available.
+            acquired = delivery.lock.acquire(timeout=2.0)
+            try:
+                exc.live_dst = delivery.dst
+            finally:
+                if acquired:
+                    delivery.lock.release()
             raise
         finally:
             with self._dlock:
                 self._deliveries.pop(xid, None)
-        self._finish_accounting(send_events, delivery, nbytes)
+        self._finish_accounting(send_events, delivery, progress["bytes"])
         return delivery.dst
 
     def _finish_accounting(self, send_events: List[Tuple],
@@ -271,14 +298,25 @@ class SocketKVTransport(KVTransport):
 
     def _send_frames(self, src: PagedKVCache, blocks: List[int],
                      groups: List[Tuple[int, int]], xid: int, kv_dtype: str,
-                     delivery: _Delivery, send_events: List[Tuple]) -> int:
+                     delivery: _Delivery, send_events: List[Tuple],
+                     progress: Dict[str, int]) -> None:
         conn = self._ensure_connected()
         n = len(groups)
-        total_sent = 0
         for i, (lo, hi) in enumerate(groups):
             wire = self.pack_layers(
                 src, blocks, lo, hi, kv_dtype=kv_dtype,
                 meta={"xfer": xid, "frame": i, "n_frames": n})
+            # zero-copy framing: the header and per-tensor memoryview
+            # chunks straight from the pack-staged arrays
+            chunks = list(wire.iter_frame_chunks(self.wire_version))
+            length = sum(len(c) for c in chunks)
+            if length > _MAX_FRAME_BYTES:
+                raise ValueError(
+                    f"kv wire frame of {length} bytes (layers [{lo}, {hi}) "
+                    f"x {len(blocks)} blocks) exceeds the "
+                    f"{_MAX_FRAME_BYTES}-byte frame cap — lower "
+                    f"layers_per_frame (currently {self.layers_per_frame}) "
+                    "or split the transfer into fewer blocks")
             mode = None
             if self.fault is not None:
                 mode = self.fault.check("kv_wire")
@@ -290,22 +328,21 @@ class SocketKVTransport(KVTransport):
                     # sender's completion wait times out on a 1-frame
                     # transfer)
                     continue
-                if mode == "corrupt":
-                    body = self.fault.corrupt_bytes(
-                        "kv_wire", wire.to_bytes(self.wire_version))
-                    conn.sendall(struct.pack("<I", len(body)))
-                    conn.sendall(body)
-                    sent = 4 + len(body)
-                else:
-                    # zero-copy send: length prefix, then the header and
-                    # per-tensor memoryview chunks straight from the
-                    # pack-staged arrays
-                    chunks = list(wire.iter_frame_chunks(self.wire_version))
-                    length = sum(len(c) for c in chunks)
-                    conn.sendall(struct.pack("<I", length))
-                    for chunk in chunks:
-                        conn.sendall(chunk)
-                    sent = 4 + length
+                # one frame's prefix+body writes are a unit — the lock
+                # keeps concurrent transfer() callers from interleaving
+                # them and corrupting the framing
+                with self._send_lock:
+                    if mode == "corrupt":
+                        body = self.fault.corrupt_bytes(
+                            "kv_wire", b"".join(chunks))
+                        conn.sendall(struct.pack("<I", len(body)))
+                        conn.sendall(body)
+                        sent = 4 + len(body)
+                    else:
+                        conn.sendall(struct.pack("<I", length))
+                        for chunk in chunks:
+                            conn.sendall(chunk)
+                        sent = 4 + length
             except OSError as exc:
                 # receiver may have torn the connection down because IT
                 # failed — prefer its diagnosis over "broken pipe"
@@ -318,10 +355,10 @@ class SocketKVTransport(KVTransport):
                     f"kv wire connection lost mid-transfer: {exc}") from exc
             t1 = time.monotonic()
             send_events.append(("send", i, t0, t1))
-            total_sent += sent
+            progress["frames"] += 1
+            progress["bytes"] += sent
             if self.frame_pause_s:
                 time.sleep(self.frame_pause_s)
-        return total_sent
 
     def _ensure_connected(self) -> socket.socket:
         with self._conn_lock:
@@ -374,12 +411,45 @@ class SocketKVTransport(KVTransport):
             threading.Thread(target=self._serve_conn, args=(conn,),
                              name="kvwire-recv", daemon=True).start()
 
+    def _inflight(self) -> bool:
+        with self._dlock:
+            return bool(self._deliveries)
+
+    def _recv_prefix(self, conn: socket.socket) -> Tuple[bytes, bool]:
+        """Read one frame's 4-byte length prefix. An idle timeout BETWEEN
+        frames is not a wire error — the sender caches its connection
+        across arbitrarily long gaps between transfers — so keep waiting
+        unless prefix bytes already arrived or a transfer is in flight
+        (the sender is counting down the same ``recv_timeout_s`` then)."""
+        buf = b""
+        while len(buf) < 4:
+            try:
+                chunk = conn.recv(4 - len(buf))
+            except socket.timeout:
+                if buf:
+                    raise ValueError(
+                        "kv wire receiver timed out inside a frame length "
+                        f"prefix ({len(buf)}/4 bytes after "
+                        f"{self.recv_timeout_s}s)") from None
+                if self._inflight():
+                    raise ValueError(
+                        "kv wire receiver timed out with a transfer in "
+                        f"flight (no frame for {self.recv_timeout_s}s)"
+                    ) from None
+                if self._closed:
+                    return b"", True
+                continue
+            if not chunk:
+                return buf, True
+            buf += chunk
+        return buf, False
+
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.settimeout(self.recv_timeout_s)
         try:
             while True:
-                prefix, eof = _recv_exact(conn, 4)
+                prefix, eof = self._recv_prefix(conn)
                 if eof and not prefix:
                     return  # clean close between frames
                 if eof:
@@ -434,9 +504,13 @@ class SocketKVTransport(KVTransport):
                     f"{delivery.frames_seen}, got {frame} — a frame was "
                     "dropped in transit")
             t0 = time.monotonic()
-            delivery.dst = self.deliver_layers(delivery.dst, wire,
-                                               delivery.dst_blocks)
-            jax.block_until_ready(delivery.dst.k)
+            # scatter + rebind under the delivery lock: the sender's
+            # failure path reads ``dst`` as the live pool, and mid-scatter
+            # the pre-donation buffer it would see is already deleted
+            with delivery.lock:
+                delivery.dst = self.deliver_layers(delivery.dst, wire,
+                                                   delivery.dst_blocks)
+                jax.block_until_ready(delivery.dst.k)
             t1 = time.monotonic()
             delivery.events.append(("scatter", frame, t0, t1))
             delivery.frames_seen += 1

@@ -273,6 +273,40 @@ def test_layers_per_frame_groups_the_stream(parts):
     _assert_pools_equal(out_a, out_b)
 
 
+def test_idle_connection_survives_recv_timeout(parts):
+    """An idle gap between transfers longer than ``recv_timeout_s`` must
+    not tear down the cached connection: the receiver keeps waiting
+    between frames, and the next transfer reuses the dialed socket
+    (reconnects stay 0, no wire error recorded)."""
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    with SocketKVTransport() as warm:  # warm the scatter jit off-timeout
+        warm.transfer(src, dst, [1], [1])
+    src2, dst2 = _pools(cfg, jnp.bfloat16)
+    src3, dst3 = _pools(cfg, jnp.bfloat16)
+    with SocketKVTransport(recv_timeout_s=0.3) as tx:
+        tx.transfer(src2, dst2, [1], [1])
+        time.sleep(0.8)  # > 2x recv_timeout_s of pure idle
+        out = tx.transfer(src3, dst3, [2], [3])
+        assert tx.last_wire_error is None
+        assert tx.pop_wire_stats()["reconnects"] == 0
+    np.testing.assert_array_equal(np.asarray(out.k[:, 3]),
+                                  np.asarray(src3.k[:, 2]))
+
+
+def test_oversize_frame_rejected_before_send(parts, monkeypatch):
+    """A frame over the receiver's cap fails on the SENDER with a
+    descriptive error naming layers_per_frame — not an opaque
+    struct.error after shipping gigabytes the receiver rejects."""
+    import colossalai_tpu.inference.kv_wire as kw
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    monkeypatch.setattr(kw, "_MAX_FRAME_BYTES", 64)
+    with SocketKVTransport() as tx:
+        with pytest.raises(ValueError, match="layers_per_frame"):
+            tx.transfer(src, dst, [1, 2], [1, 2])
+
+
 # ----------------------------------------------------- failure classification
 def test_truncated_mid_frame_distinct_error_no_hang(parts):
     """A peer that dies mid-frame: the receiver classifies the partial
@@ -371,10 +405,44 @@ def test_kv_wire_span_and_counters_flow_to_stats(parts):
 
 
 # ------------------------------------------------------------ fault seams
+def test_failed_stream_hands_back_live_pool(parts):
+    """A wire failure mid-stream hands the LIVE destination pool back as
+    ``exc.live_dst``: earlier frames donated the caller's buffer frame by
+    frame, so retrying against the original reference would read a
+    deleted array on TPU/GPU. The retry against the live pool completes
+    byte-identically, and the failed attempt's frames still account."""
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    _, dst_ref = _pools(cfg, jnp.bfloat16)
+    moves = ([1, 2], [1, 2])
+    expect = DeviceKVTransport().transfer(src, dst_ref, *moves)
+    fault = FaultInjector(seed=0)
+    fault.arm("kv_wire", "corrupt", at=2, times=1)  # frame 0 lands first
+    retry = RetryPolicy(max_retries=2, base_delay_s=0.0, max_delay_s=0.0,
+                        jitter=0.0)
+    with SocketKVTransport(fault=fault, retry=retry) as tx:
+        with pytest.raises(ValueError) as ei:
+            tx.transfer(src, dst, *moves)
+        live = getattr(ei.value, "live_dst", None)
+        assert live is not None
+        # frame 0 (layer 0) already landed in the live pool before the
+        # corrupt frame tripped the receiver's crc
+        np.testing.assert_array_equal(np.asarray(live.k[0, 1]),
+                                      np.asarray(src.k[0, 1]))
+        out = tx.transfer(src, live, *moves)
+        ws = tx.pop_wire_stats()
+    _assert_pools_equal(out, expect)
+    # failed attempt's wire traffic accounts: >= 2 frames went out before
+    # the abort, plus the full successful retry, over a fresh dial
+    assert ws["frames"] >= cfg.num_hidden_layers + 2
+    assert ws["reconnects"] == 1
+
+
 def test_kv_wire_corrupt_fault_retries_token_identical(parts):
     """One corrupted frame: the receiver's crc trips, the pump rolls
     back and retries over a FRESH connection — token-identical output,
     one kv retry, one reconnect on the books."""
+    cfg, _ = parts
     ref_eng = _disagg(parts, transport=HostKVTransport())
     ref = ref_eng.generate(PROMPTS, GEN)
     fault = FaultInjector(seed=0)
@@ -389,6 +457,9 @@ def test_kv_wire_corrupt_fault_retries_token_identical(parts):
         assert dis.stats.kv_retries == 1
         assert dis.stats.kvwire_reconnects == 1
         assert dis.stats.requests_error == 0
+        # the failed attempt's frames account alongside the successes
+        assert (dis.stats.kvwire_frames
+                >= len(PROMPTS) * cfg.num_hidden_layers + 1)
         assert fault.stats()["checks_kv_wire"] > 0
     finally:
         dis.close()
