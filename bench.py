@@ -1384,6 +1384,227 @@ def measure_capacity(cfg=None, bs: int = 4, prompt_len: int = 48,
     return out
 
 
+def measure_autoscale(maxr: int = 2, prompt_len: int = 32,
+                      new_tokens: int = 64, step_sleep_s: float = 0.03,
+                      stage_factors=(0.3, 2.0, 0.3),
+                      stage_seconds=(2.0, 14.0, 6.0)):
+    """Autoscaling ground truth (the FleetController's reason to exist):
+    drive the SAME open-loop offered-load ramp - low, a burst past one
+    replica's peak rate, low again - through a signal-driven fleet and
+    through every static fleet size it could have been pinned to, and
+    compare two axes:
+
+    - **attainment**: fraction of requests whose TTFT met the target
+      (measured host-side from the first token reaching the
+      control-channel mirror);
+    - **chip_seconds**: the cost integral (live replicas x wall time,
+      ``clt_fleet_chip_seconds``).
+
+    The claim the numbers must support: the controlled fleet holds
+    attainment >= the best static fleet while spending fewer
+    chip-seconds than that static fleet - the small fleet fails the
+    burst, the big fleet burns chips through both idle valleys, the
+    signal-driven fleet does neither.
+
+    The TTFT target is calibrated against the controller's own actuation
+    latency (a measured warm replica build + warmup, the thread-backend
+    spawn cost): an autoscaler can only protect SLOs looser than the
+    time it takes to actually add capacity plus the backlog-recovery
+    margin, so the target is ``max(4 x unloaded tail, spawn + 4 s)``.
+    Replicas run ``max_batch_size=1`` with a ``step_sleep_s`` throttle
+    (see :func:`tiny_llama_engine`) so per-replica capacity is
+    deterministic and sleep-bound - co-located CPU replicas of the
+    compute-bound tiny model would otherwise contend for cores and a
+    second replica would add contention, not capacity.
+
+    The controlled arm's tail doubles as the live weight-swap drill: a
+    rolling same-weights swap runs with requests still in flight (the
+    swap thread uses ``step=False`` while the measurement loop keeps
+    stepping, the HTTP-scheduler shape), and the summary reports zero
+    dropped requests plus token-identical greedy output before and
+    after."""
+    import threading
+
+    import numpy as np
+
+    from colossalai_tpu.inference import GenerationConfig
+    from colossalai_tpu.inference.fleet import (
+        AutoscalePolicy,
+        FleetController,
+        RemoteReplica,
+        ReplicaSpec,
+        tiny_llama_engine,
+        tiny_llama_params,
+    )
+
+    rng = np.random.RandomState(0)
+    vocab = 256
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    probe = list(rng.randint(1, vocab, size=(prompt_len,)))
+    engine_kw = {"max_batch_size": 1, "step_sleep_s": step_sleep_s}
+
+    # -- calibration: a first build pays the shared jit compiles, then a
+    # SECOND build measures the warm thread-spawn cost the fleet's
+    # scale-up actually pays
+    eng = tiny_llama_engine(**engine_kw)
+    eng.generate([list(probe)], GenerationConfig(max_new_tokens=4))
+    t_build0 = time.perf_counter()
+    warm = tiny_llama_engine(**engine_kw)
+    warm.generate([list(probe)], GenerationConfig(max_new_tokens=4))
+    spawn_s = time.perf_counter() - t_build0
+    del warm
+    cal_prompts = [list(rng.randint(1, vocab, size=(prompt_len,)))
+                   for _ in range(4)]
+    rids = [eng.add_request(list(p), gen) for p in cal_prompts]
+    now0 = time.perf_counter()
+    t_submit = {r: now0 for r in rids}
+    t_first = {}
+    while eng.has_work:
+        fin = eng.step()
+        now = time.perf_counter()
+        for req in eng.running.values():
+            if req.output_ids and req.request_id not in t_first:
+                t_first[req.request_id] = now
+        for req in fin:
+            t_first.setdefault(req.request_id, now)
+    dt = time.perf_counter() - now0
+    peak_req_rate = len(rids) / dt
+    # target sits between a lone replica's queue tail (which a 2x burst
+    # blows through) and a right-sized fleet's TTFT — but never tighter
+    # than the time it takes to actually actuate a scale-up
+    ttft_target = max(
+        1.5 * max(t_first[r] - t_submit[r] for r in rids),
+        spawn_s + 4.0)
+    probe_ref = eng.generate([list(probe)], gen)[0]
+    del eng
+
+    # open-loop arrival schedule shared by every arm
+    schedule = []
+    t_off = 0.0
+    for factor, secs in zip(stage_factors, stage_seconds):
+        gap = 1.0 / (factor * peak_req_rate)
+        t_stage_end = t_off + secs
+        while t_off < t_stage_end:
+            schedule.append(t_off)
+            t_off += gap
+    n_total = len(schedule)
+    prompts = [list(rng.randint(1, vocab, size=(prompt_len,)))
+               for _ in range(n_total + 2)]
+
+    spec = ReplicaSpec(kwargs={"capacity_interval_s": 0.25,
+                               "capacity_idle_busy": 0.30,
+                               **engine_kw},
+                       slots=1, warmup_new_tokens=3)
+
+    def run_arm(min_r, max_r, swap=False):
+        policy = AutoscalePolicy(min_replicas=min_r, max_replicas=max_r,
+                                 cooldown_s=1.0, up_consecutive=1,
+                                 down_consecutive=8)
+        fc = FleetController(spec, min_replicas=min_r, max_replicas=max_r,
+                             backend="thread", autoscale=policy,
+                             spawn_inline=False, signal_poll_s=0.25)
+        t_sub, t_tok, done = {}, {}, {}
+        try:
+            # drop bootstrap spawn cost off the cost integral: every arm
+            # starts its meter with its initial fleet already warm
+            fc.counters["fleet_chip_seconds"] = 0.0
+            fc._last_chip_t = fc._clock()
+            i = 0
+            t0 = time.perf_counter()
+            while i < n_total or len(done) < n_total:
+                now = time.perf_counter()
+                while i < n_total and now - t0 >= schedule[i]:
+                    rid = fc.router.add_request(list(prompts[i]), gen)
+                    t_sub[rid] = now
+                    i += 1
+                finished = fc.step()
+                now = time.perf_counter()
+                for e in fc.router.engines:
+                    if not isinstance(e, RemoteReplica):
+                        continue
+                    for rid, m in e._reqs.items():
+                        if rid in t_sub and rid not in t_tok \
+                                and m.output_ids:
+                            t_tok[rid] = now
+                for req in finished:
+                    if req.request_id in t_sub:
+                        t_tok.setdefault(req.request_id, now)
+                        done[req.request_id] = req
+                if not fc.router.has_work:
+                    time.sleep(0.002)
+            n_spawned = int(fc.counters.get("fleet_replicas_spawned",
+                                            min_r))
+            n_retired = int(fc.counters.get("fleet_replicas_retired", 0))
+            # the cost integral covers the SERVING window only — the
+            # swap drill below is the controlled arm's extra credit, not
+            # part of the static-fleet comparison
+            chip_s = fc.chip_seconds
+            swap_row = {}
+            if swap:
+                # rolling same-weights swap with fresh work in flight:
+                # the swap thread drains with step=False while THIS loop
+                # keeps stepping and harvesting finishes
+                inflight = set(fc.router.add_request(list(p), gen)
+                               for p in prompts[n_total:n_total + 2])
+                seats = []
+                th = threading.Thread(
+                    target=lambda: seats.extend(
+                        fc.swap_weights(tiny_llama_params(seed=0),
+                                        step=False)),
+                    daemon=True)
+                th.start()
+                outs = {}
+                while th.is_alive() or not inflight <= set(outs):
+                    for req in fc.step():
+                        outs[req.request_id] = req
+                    time.sleep(0.001)
+                th.join()
+                dropped = sum(
+                    1 for rid in inflight
+                    if rid not in outs or outs[rid].finish_reason not in
+                    ("eos", "length", "stop"))
+                post = fc.generate([list(probe)], gen)[0]
+                swap_row = {
+                    "swapped_replicas": len(seats),
+                    "swap_dropped": dropped,
+                    "swap_token_identical": post == probe_ref,
+                }
+        finally:
+            fc.close()
+        ttfts = {r: t_tok[r] - t_sub[r] for r in t_sub if r in t_tok}
+        n_ok = sum(1 for v in ttfts.values() if v <= ttft_target)
+        return {
+            "attainment": round(n_ok / max(len(t_sub), 1), 3),
+            "chip_seconds": round(chip_s, 2),
+            "ttft_p99_ms": round(1e3 * float(np.percentile(
+                list(ttfts.values()), 99)), 1) if ttfts else None,
+            "completed": len(done),
+            "replicas_spawned": n_spawned,
+            "replicas_retired": n_retired,
+            **swap_row,
+        }
+
+    out = {
+        "peak_req_per_s": round(peak_req_rate, 2),
+        "spawn_s": round(spawn_s, 2),
+        "ttft_target_ms": round(1e3 * ttft_target, 1),
+        "stage_factors": list(stage_factors),
+        "stage_seconds": list(stage_seconds),
+        "n_requests": n_total,
+    }
+    out["controlled"] = run_arm(1, maxr, swap=True)
+    for n in range(1, maxr + 1):
+        out[f"static_{n}"] = run_arm(n, n)
+    statics = [out[f"static_{n}"] for n in range(1, maxr + 1)]
+    best = max(statics, key=lambda s: (s["attainment"], -s["chip_seconds"]))
+    out["static_best_attainment"] = best["attainment"]
+    out["static_best_chip_seconds"] = best["chip_seconds"]
+    ctl = out["controlled"]
+    out["holds_attainment"] = ctl["attainment"] >= best["attainment"]
+    out["fewer_chip_seconds"] = ctl["chip_seconds"] < best["chip_seconds"]
+    return out
+
+
 def measure_long_context(cfg=None, lengths=(256, 512, 1024),
                          new_tokens: int = 4, block_size: int = 32,
                          max_seq_len: int = 2048):
@@ -2203,6 +2424,10 @@ def cpu_child_main():
     except Exception as e:
         print(f"cpu capacity bench failed: {e}", file=sys.stderr)
     try:
+        extras["autoscale_cpu"] = measure_autoscale()
+    except Exception as e:
+        print(f"cpu autoscale bench failed: {e}", file=sys.stderr)
+    try:
         extras["long_context_cpu"] = measure_long_context(
             lengths=(128, 256, 512), max_seq_len=1024)
     except Exception as e:
@@ -2292,6 +2517,22 @@ def cpu_child_main():
             summary[f"capacity_{fk}_goodput_per_chip_s"] = \
                 capn[fk]["goodput_per_chip_s"]
             summary[f"capacity_{fk}_signal"] = capn[fk]["signal"]
+    asc = extras.get("autoscale_cpu", {})
+    if "controlled" in asc:
+        summary["autoscale_attainment"] = asc["controlled"]["attainment"]
+        summary["autoscale_chip_seconds"] = \
+            asc["controlled"]["chip_seconds"]
+        summary["autoscale_static_best_attainment"] = \
+            asc["static_best_attainment"]
+        summary["autoscale_static_best_chip_seconds"] = \
+            asc["static_best_chip_seconds"]
+        summary["autoscale_holds_attainment"] = asc["holds_attainment"]
+        summary["autoscale_fewer_chip_seconds"] = \
+            asc["fewer_chip_seconds"]
+        summary["autoscale_swap_dropped"] = \
+            asc["controlled"].get("swap_dropped")
+        summary["autoscale_swap_token_identical"] = \
+            asc["controlled"].get("swap_token_identical")
     lc = extras.get("long_context_cpu", {})
     for lk, row in lc.get("lengths", {}).items():
         summary[f"long_context_{lk}_ttft_ms_sp_off"] = row["ttft_ms_sp_off"]
@@ -2338,7 +2579,8 @@ def _cpu_fallback(budget_s: float):
 
 
 #: summary-key substrings where a HIGHER value is a regression
-_LOWER_BETTER = ("ttft", "itl", "stall", "latency")
+_LOWER_BETTER = ("ttft", "itl", "stall", "latency", "chip_seconds",
+                 "swap_dropped")
 #: summary-key substrings where a LOWER value is a regression
 _HIGHER_BETTER = ("tokens_per_s", "goodput", "attainment", "scaling_x",
                   "mfu", "agreement", "gain", "concurrent_users",

@@ -14,6 +14,7 @@ from .engine import (
     LLMEngine,
     Request,
 )
+from .fleet import AutoscalePolicy, FleetController, ReplicaSpec
 from .kv_cache import (
     BlockAllocator,
     OutOfBlocks,
@@ -42,7 +43,7 @@ from .kv_transport import (
     describe_pool,
     reshard_plan,
 )
-from .kv_wire import SocketKVTransport
+from .kv_wire import SocketKVDialer, SocketKVReceiver, SocketKVTransport
 from .overload import (
     PREEMPT_VICTIM_POLICIES,
     SHED_POLICIES,
@@ -114,8 +115,13 @@ __all__ = [
     "PageBlockWire",
     "PoolGeometry",
     "ReshardPlan",
+    "SocketKVDialer",
+    "SocketKVReceiver",
     "SocketKVTransport",
     "describe_pool",
+    "AutoscalePolicy",
+    "FleetController",
+    "ReplicaSpec",
     "reshard_plan",
     "OverloadConfig",
     "OverloadController",

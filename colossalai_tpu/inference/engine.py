@@ -1032,6 +1032,30 @@ class LLMEngine:
         # mirror the wrapper convention self.params was constructed with
         self.params = {"params": inner} if "params" in self.params else inner
 
+    def swap_weights(self, params) -> int:
+        """Hot-swap model weights into an IDLE engine (the fleet's
+        zero-downtime deploy primitive): :meth:`sync_params` with a
+        quiesce guard. A drained replica calls this between requests —
+        swapping under in-flight decodes would mix two models' logits in
+        one sequence, so any queued/prefilling/running work refuses the
+        swap. Returns the number of leaves placed (the controller's
+        ack)."""
+        if self.has_work:
+            raise RuntimeError(
+                f"swap_weights on a busy engine ({len(self.waiting)} "
+                f"waiting, {len(self.prefilling)} prefilling, "
+                f"{len(self.running)} running) — drain it idle first"
+            )
+        self.sync_params(params)
+        return len(jax.tree.leaves(params))
+
+    def seed_ids(self, start: int, stride: int) -> None:
+        """Re-seed the request-id counter to mint ``start, start+stride,
+        ...`` — the Router's ``rid % stride`` ownership contract. The
+        explicit hook (rather than poking ``_ids``) lets a remote-replica
+        proxy forward the reseed over its control channel."""
+        self._ids = itertools.count(int(start), int(stride))
+
     # ------------------------------------------------------------- frontend
     def add_request(
         self, prompt_ids, gen: Optional[GenerationConfig] = None,

@@ -31,6 +31,11 @@ Seam catalog (the only names ``arm``/``check`` accept):
 ``handoff_pump``    the disagg pump about to splice one finished prefill
 ``megastep_dispatch`` the engine about to dispatch a decode megastep
 ``http_generate``   the HTTP server about to admit a ``/generate`` body
+``fleet_control``   one control-plane RPC from the FleetController to a
+                    replica process (keyed by replica seat): ``raise``
+                    models a crashed child, ``hang`` a wedged one — both
+                    must escalate through the Router's health machine to
+                    dead → evacuate → respawn, never a forever-wait
 =================== ====================================================
 
 Modes: ``raise`` (throw :class:`InjectedFault`), ``hang`` (sleep
@@ -59,6 +64,7 @@ FAULT_SEAMS = (
     "handoff_pump",
     "megastep_dispatch",
     "http_generate",
+    "fleet_control",
 )
 
 FAULT_MODES = ("raise", "hang", "corrupt", "drop")

@@ -1,0 +1,1621 @@
+"""FleetController: cross-process replicas, autoscaling, live weight swap.
+
+The Router (``router.py``) fronts N replica engines but, until now, all
+of them lived in the controller's own process — "replicas" were really
+threads sharing one GIL and one JAX runtime. This module puts each
+replica in its **own OS process** and closes the capacity loop:
+
+- :class:`FleetController` spawns N engine replicas as separate
+  processes, fronts them with the existing :class:`~.router.Router`
+  (health machine, evacuate-on-death failover, least-loaded placement
+  all reused verbatim — the Router steps :class:`RemoteReplica` proxies
+  exactly like local engines), and **acts** on the merged ``/capacity``
+  :class:`~colossalai_tpu.telemetry.capacity.ScalingSignal`: scale_up
+  spawns a fresh replica (spawn → warm → undrain), scale_down drains
+  one, evacuates any stragglers, and SIGTERM-reaps the child.
+- :class:`AutoscalePolicy` is the pure decision layer between signal
+  and actuation — hysteresis (N consecutive same-direction signals),
+  cooldown after every action, min/max replica bounds, and an in-flight
+  floor so scale_down never retires capacity the current load needs.
+  It is clock-patchable and process-free, so the whole policy is unit
+  tested with a fake clock (same discipline as ``test_overload.py``).
+- :meth:`FleetController.swap_weights` hot-swaps model weights into a
+  **live** fleet one replica at a time: drain → wait idle → push new
+  params over the control channel (inline tree or checkpoint path) →
+  ``engine.swap_weights`` child-side → undrain. In-flight requests
+  drain to sibling replicas, so a rolling swap drops nothing and
+  post-swap greedy output is token-identical to a fresh engine built
+  from the new weights.
+
+Control plane: one length-prefixed socket per replica —
+``u32 header_len | u32 payload_len | header JSON | payload bytes`` —
+carrying tiny JSON ops (``step``, ``add_request``, ``adopt``,
+``evacuate``, ``swap_weights``, ...) plus an optional binary payload
+(packed weight trees). GenerationConfigs cross the boundary through the
+lockstep codec (:func:`~.multiprocess.pack_gen`), so the field-count
+version-skew guard protects this seam too. Every control RPC checks the
+``fleet_control`` fault seam (keyed by replica seat): an injected
+``raise`` models a crashed child, ``hang`` a wedged one, and both
+escalate through the Router's existing health machine — consecutive
+failures or a watchdog overrun mark the replica dead, the proxy's
+mirrored request state is evacuated onto survivors, and the controller
+reaps the corpse and spawns a replacement.
+
+Request-id arithmetic across a *dynamic* fleet: ids are minted
+child-side from ``itertools.count(seat, id_stride)`` where ``seat`` is
+a stable slot number < ``id_stride`` (NOT the router index — indices
+are reused, seats are too, but never while the old occupant can still
+mint). ``rid % id_stride`` therefore names the minting seat for the
+life of the fleet, and the Router's ownership map stays a pure
+function of the id plus its failover overrides.
+
+Child-process hygiene (a controller must never leak children): the
+graceful path is just closing the control socket — the child's serve
+loop exits on EOF. On top of that, every child installs a SIGTERM
+handler and a parent-pid watch thread (``os._exit`` when reparented,
+covering SIGKILL of the controller), handles register in a module-wide
+set reaped by ``atexit`` (SIGTERM, bounded join, SIGKILL escalation),
+and processes are spawned daemonic so the interpreter's own teardown
+is a final backstop.
+
+Observability: ``clt_fleet_*`` counters/gauges (spawns, retires,
+replacements, swaps, per-reason scale suppressions, chip-seconds) and
+``fleet.spawn`` / ``fleet.retire`` / ``weight_swap`` spans on a
+synthetic fleet-track trace. ``bench.py measure_autoscale`` is the
+ground truth: under an offered-load ramp the controlled fleet must hold
+SLO attainment at least as well as the best static fleet while burning
+fewer chip-seconds.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import itertools
+import json
+import os
+import signal as _signal
+import socket
+import struct
+import threading
+import time
+import zlib
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .engine import GenerationConfig, LLMEngine, Request
+from .fault import FaultInjector, InjectedFault
+from .multiprocess import pack_gen, unpack_gen
+from .telemetry import Telemetry
+from ..telemetry.capacity import ScalingSignal, combine_signals
+
+#: synthetic trace id anchoring the fleet lifecycle spans (real request
+#: traces use non-negative ids, so -1 can never collide)
+FLEET_TRACE_ID = -1
+
+#: every ``clt_fleet_*`` counter the controller can emit — a static
+#: tuple so the metric-catalog lint renders the family without building
+#: a fleet (mirrors ``FaultInjector.prom_counters``'s static seams)
+FLEET_COUNTER_NAMES = (
+    "fleet_replicas_spawned",
+    "fleet_replicas_retired",
+    "fleet_replicas_replaced",
+    "fleet_spawn_failures",
+    "fleet_weight_swaps",
+    "fleet_scale_up_total",
+    "fleet_scale_down_total",
+    "fleet_scale_suppressed_hysteresis",
+    "fleet_scale_suppressed_cooldown",
+    "fleet_scale_suppressed_bounds",
+    "fleet_scale_suppressed_inflight",
+    "fleet_control_rpcs",
+    "fleet_control_failures",
+    "fleet_child_force_kills",
+    "fleet_chip_seconds",
+)
+
+FLEET_GAUGE_NAMES = (
+    "fleet_replicas_active",
+    "fleet_replicas_retiring",
+)
+
+#: policy suppression reason → the counter that tallies it
+_SUPPRESS_COUNTER = {
+    "hysteresis": "fleet_scale_suppressed_hysteresis",
+    "cooldown": "fleet_scale_suppressed_cooldown",
+    "min_bound": "fleet_scale_suppressed_bounds",
+    "max_bound": "fleet_scale_suppressed_bounds",
+    "inflight_floor": "fleet_scale_suppressed_inflight",
+}
+
+
+class FleetWireError(RuntimeError):
+    """Control-channel failure: EOF, timeout, or a child-side op error."""
+
+
+# =========================================================== wire framing
+# One frame: u32 header_len | u32 payload_len | header JSON | payload.
+# The header is a tiny JSON dict ({"op": ...} plus op args / reply
+# fields); the payload carries bulk bytes (packed weight trees) so big
+# tensors never round-trip through JSON.
+_LEN = struct.Struct("<II")
+
+#: refuse absurd frames instead of allocating whatever a corrupt length
+#: prefix asks for (packed weight trees stay far under this)
+_MAX_FRAME_BYTES = 1 << 31
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise :class:`FleetWireError` on EOF."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = conn.recv(min(1 << 20, n - len(buf)))
+        except socket.timeout as exc:
+            raise FleetWireError(
+                f"control channel timed out mid-frame ({len(buf)}/{n} "
+                "bytes)") from exc
+        if not chunk:
+            raise FleetWireError(
+                f"control channel closed mid-frame ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return bytes(buf)
+
+
+def send_frame(conn: socket.socket, header: Dict, payload: bytes = b"") -> None:
+    """Write one length-prefixed ``header JSON + payload`` frame."""
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    conn.sendall(_LEN.pack(len(hj), len(payload)) + hj + payload)
+
+
+def recv_frame(conn: socket.socket,
+               timeout: Optional[float] = None) -> Tuple[Dict, bytes]:
+    """Read one frame; ``timeout=None`` blocks until EOF (child serve
+    loop), a finite timeout turns a wedged peer into a
+    :class:`FleetWireError` the caller's health machine can act on."""
+    conn.settimeout(timeout)
+    raw = _recv_exact(conn, _LEN.size)
+    hlen, plen = _LEN.unpack(raw)
+    if hlen > _MAX_FRAME_BYTES or plen > _MAX_FRAME_BYTES:
+        raise FleetWireError(
+            f"frame header announces {hlen}+{plen} bytes — corrupt length "
+            "prefix?")
+    header = json.loads(_recv_exact(conn, hlen).decode())
+    payload = _recv_exact(conn, plen) if plen else b""
+    return header, payload
+
+
+# ========================================================== params codec
+# Self-contained weight-tree wire format (np.savez chokes on ml_dtypes
+# like bfloat16, so leaves ship as raw bytes + dtype string + shape):
+# u32 index_len | index JSON | concatenated leaf bytes, crc32-guarded.
+_SEP = "::"
+
+
+def _flatten_tree(tree, prefix: str, out: Dict[str, np.ndarray]) -> None:
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            _flatten_tree(tree[k], key, out)
+    else:
+        out[prefix] = np.asarray(tree)
+
+
+def pack_params(tree) -> bytes:
+    """Serialize a (possibly nested-dict) weight tree to bytes."""
+    leaves: Dict[str, np.ndarray] = {}
+    _flatten_tree(tree, "", leaves)
+    index, blobs = [], []
+    for key, arr in leaves.items():
+        blob = np.ascontiguousarray(arr).tobytes()
+        index.append({"k": key, "dtype": str(arr.dtype),
+                      "shape": list(arr.shape), "n": len(blob)})
+        blobs.append(blob)
+    body = b"".join(blobs)
+    head = json.dumps({"leaves": index,
+                       "crc": zlib.crc32(body) & 0xFFFFFFFF}).encode()
+    return struct.pack("<I", len(head)) + head + body
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # ml_dtypes names (bfloat16, float8_e4m3fn, ...) resolve once the
+        # extension types are imported
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def unpack_params(data: bytes):
+    """Inverse of :func:`pack_params` — rebuilds the nested dict tree."""
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    head = json.loads(data[4:4 + hlen].decode())
+    body = memoryview(data)[4 + hlen:]
+    if (zlib.crc32(body) & 0xFFFFFFFF) != head["crc"]:
+        raise FleetWireError(
+            "packed weight tree failed its crc32 — corrupt transfer")
+    tree: Dict = {}
+    off = 0
+    for ent in head["leaves"]:
+        arr = np.frombuffer(
+            body[off:off + ent["n"]], dtype=_np_dtype(ent["dtype"]),
+        ).reshape(ent["shape"])
+        off += ent["n"]
+        node = tree
+        parts = ent["k"].split(_SEP)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def save_params(path: str, tree) -> None:
+    """Write a weight tree as a packed-params file (the checkpoint format
+    :meth:`FleetController.swap_weights` accepts by path)."""
+    with open(path, "wb") as f:
+        f.write(pack_params(tree))
+
+
+def load_params(path: str):
+    with open(path, "rb") as f:
+        return unpack_params(f.read())
+
+
+# ========================================================== replica spec
+@dataclasses.dataclass
+class ReplicaSpec:
+    """Picklable recipe a child process builds its engine from.
+
+    ``factory`` is a ``"module.path:callable"`` dotted reference; the
+    callable receives ``**kwargs`` and returns a ready
+    :class:`~.engine.LLMEngine`. Everything here must survive pickling
+    into a spawn-context child, so keep kwargs primitive.
+    """
+
+    factory: str = "colossalai_tpu.inference.fleet:tiny_llama_engine"
+    kwargs: Dict = dataclasses.field(default_factory=dict)
+    #: prompts generated at spawn to compile prefill/decode BEFORE the
+    #: replica joins the router ("warm" in spawn → warm → undrain);
+    #: () skips warmup
+    warmup_prompts: Tuple = ((1, 2, 3),)
+    warmup_new_tokens: int = 3
+    #: concurrent-slot hint for the autoscaler's in-flight floor
+    slots: int = 4
+
+
+def _resolve_factory(ref: str):
+    mod, _, attr = ref.partition(":")
+    if not attr:
+        raise ValueError(
+            f"factory {ref!r} must be a 'module.path:callable' reference")
+    import importlib
+
+    fn = getattr(importlib.import_module(mod), attr)
+    if not callable(fn):
+        raise TypeError(f"factory {ref!r} resolved to non-callable {fn!r}")
+    return fn
+
+
+def tiny_llama_params(seed: int = 0):
+    """Params for :func:`tiny_llama_engine` — a distinct seed gives a
+    distinct tree of the same shapes, the unit of a weight swap."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    return model.init(jax.random.PRNGKey(int(seed)),
+                      jnp.ones((1, 8), jnp.int32))
+
+
+def tiny_llama_engine(
+    *,
+    seed: int = 0,
+    max_batch_size: int = 4,
+    max_seq_len: int = 128,
+    block_size: int = 16,
+    capacity_interval_s: float = 0.0,
+    capacity_idle_busy: float = 0.10,
+    capacity_saturation_busy: float = 0.85,
+    step_sleep_s: float = 0.0,
+    **engine_kw,
+) -> LLMEngine:
+    """Default replica factory: a tiny CPU Llama engine. The same
+    ``seed`` on every replica gives byte-identical weights, so fleet
+    output is token-identical to a single engine. A positive
+    ``capacity_interval_s`` attaches a CapacityMonitor whose signal the
+    child streams back over the control channel.
+
+    ``step_sleep_s`` throttles each working step with a sleep — on CPU
+    the tiny model is compute-bound and XLA already saturates every
+    core, so co-located replicas contend instead of adding capacity; a
+    sleep-bound step emulates the accelerator-bound replica the control
+    plane is actually built for (sleeps overlap perfectly across
+    replicas, so fleet throughput scales with replica count)."""
+    from ..models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    capacity = None
+    if capacity_interval_s and capacity_interval_s > 0:
+        from ..telemetry.capacity import CapacityMonitor
+
+        capacity = CapacityMonitor(
+            interval_s=float(capacity_interval_s), n_intervals=8, chips=1,
+            sentinel=False, idle_busy=capacity_idle_busy,
+            saturation_busy=capacity_saturation_busy)
+    engine = LLMEngine(
+        tiny_llama_params(seed), cfg,
+        max_batch_size=max_batch_size, max_seq_len=max_seq_len,
+        block_size=block_size, prefill_buckets=(16, 32, 64),
+        capacity=capacity, **engine_kw)
+    if step_sleep_s and step_sleep_s > 0:
+        orig_step = engine.step
+
+        def _throttled_step():
+            busy = engine.has_work
+            out = orig_step()
+            if busy:
+                time.sleep(step_sleep_s)
+            return out
+
+        engine.step = _throttled_step
+    return engine
+
+
+# ============================================================ child side
+def _build_engine(spec: ReplicaSpec) -> LLMEngine:
+    return _resolve_factory(spec.factory)(**dict(spec.kwargs))
+
+
+def _sync_fields(engine: LLMEngine) -> Dict:
+    """The mirror-state snapshot riding on every reply: queue depths,
+    running rids, stats counters, and the capacity signal (when the
+    child engine carries a monitor)."""
+    d = {
+        "counts": {
+            "waiting": len(engine.waiting),
+            "prefilling": len(engine.prefilling),
+            "running": len(engine.running),
+        },
+        "running_rids": [int(r.request_id) for r in engine.running.values()],
+        "free_blocks": int(engine.allocator.num_free),
+        "has_work": bool(engine.has_work),
+        "stats": {k: v for k, v in engine.stats.as_dict().items()
+                  if isinstance(v, (int, float))},
+    }
+    cap = getattr(engine, "capacity", None)
+    if cap is not None:
+        try:
+            d["signal"] = cap.signal().as_dict()
+        except Exception:
+            pass
+    return d
+
+
+def _fin_record(req: Request) -> Dict:
+    return {
+        "rid": int(req.request_id),
+        "output_ids": [int(t) for t in req.output_ids],
+        "finish_reason": req.finish_reason,
+        "truncated": bool(req.truncated),
+        "retry_after": req.retry_after,
+    }
+
+
+def _handle_op(engine: LLMEngine, state: Dict, header: Dict,
+               payload: bytes) -> Tuple[Dict, bytes]:
+    op = header.get("op")
+    reply: Dict = {"ok": True}
+    if op in ("ping", "stats", "stop"):
+        pass
+    elif op == "seed_ids":
+        start, stride = int(header["start"]), int(header["stride"])
+        if stride != state["stride"] or start % stride != state["seat"]:
+            raise ValueError(
+                f"seed_ids({start}, {stride}) conflicts with spawn seat "
+                f"{state['seat']} / stride {state['stride']}")
+        # fast-forward past ids already minted (warmup + adds) so a
+        # re-seed never reissues a live id
+        engine.seed_ids(start + state["minted"] * stride, stride)
+    elif op == "add_request":
+        gen = unpack_gen(np.asarray(header["gen"], np.float64))
+        rid = engine.add_request([int(t) for t in header["prompt_ids"]],
+                                 gen, priority=int(header.get("priority", 0)))
+        state["minted"] += 1
+        reply["rid"] = int(rid)
+    elif op == "adopt":
+        # failover re-admission: the rid is preserved (minted by the dead
+        # seat), committed output rides along, pages re-prefill here
+        gen = unpack_gen(np.asarray(header["gen"], np.float64))
+        req = Request(int(header["rid"]),
+                      [int(t) for t in header["prompt_ids"]], gen,
+                      priority=int(header.get("priority", 0)))
+        req.output_ids = [int(t) for t in header.get("output_ids", ())]
+        engine.telemetry.on_submitted(req)
+        engine.waiting.append(req)
+    elif op == "step":
+        finished = engine.step()
+        pushed = state["pushed"]
+        deltas = []
+        for r in engine.running.values():
+            rid = int(r.request_id)
+            sent = pushed.get(rid, 0)
+            if len(r.output_ids) > sent:
+                deltas.append([rid, [int(t) for t in r.output_ids[sent:]]])
+                pushed[rid] = len(r.output_ids)
+        reply["deltas"] = deltas
+        reply["finished"] = [_fin_record(r) for r in finished]
+        for r in finished:
+            pushed.pop(int(r.request_id), None)
+    elif op == "abort":
+        reply["aborted"] = bool(engine.abort(int(header["rid"])))
+        state["pushed"].pop(int(header["rid"]), None)
+    elif op == "evacuate":
+        movable, finished = engine.evacuate()
+        reply["movable"] = [{
+            "rid": int(r.request_id),
+            "prompt_ids": [int(t) for t in r.prompt_ids],
+            "output_ids": [int(t) for t in r.output_ids],
+            "gen": [float(x) for x in pack_gen(r.gen)],
+            "priority": int(r.priority),
+        } for r in movable]
+        reply["finished"] = [_fin_record(r) for r in finished]
+        state["pushed"].clear()
+    elif op == "swap_weights":
+        if header.get("kind") == "path":
+            params = load_params(header["path"])
+        else:
+            params = unpack_params(payload)
+        reply["leaves"] = int(engine.swap_weights(params))
+    elif op == "kv_endpoint":
+        # disagg pairing over the control channel: build a standalone
+        # paged pool of the asked geometry, park a SocketKVReceiver on
+        # it, and advertise the endpoint back to the controller
+        from .kv_cache import init_paged_cache
+        from .kv_wire import SocketKVReceiver
+
+        g = header["geometry"]
+        cfg = SimpleNamespace(
+            num_hidden_layers=int(g["layers"]),
+            num_key_value_heads=int(g["kv_heads"]),
+            head_dim_=int(g["head_dim"]))
+        pool = init_paged_cache(cfg, int(g["num_blocks"]),
+                                int(g["block_size"]))
+        recv = SocketKVReceiver()
+        name = str(header.get("pool", "kv"))
+
+        def _rebind(new_pool, _name=name):
+            state["kv_pools"][_name] = new_pool
+
+        recv.register_pool(name, pool, on_update=_rebind)
+        state["kv_pools"][name] = pool
+        state["kv_receivers"].append(recv)
+        host, port = recv.advertise()
+        reply.update({"host": host, "port": port, "pool": name})
+    elif op == "kv_checksum":
+        pool = state["kv_pools"][str(header.get("pool", "kv"))]
+        idx = np.asarray([int(b) for b in header["blocks"]], np.int32)
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(pool.k)[:, idx]).tobytes())
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(pool.v)[:, idx]).tobytes(), crc)
+        reply["crc"] = int(crc & 0xFFFFFFFF)
+    else:
+        raise ValueError(f"unknown fleet op {op!r}")
+    reply.update(_sync_fields(engine))
+    return reply, b""
+
+
+def _serve_replica(engine: LLMEngine, conn: socket.socket, seat: int,
+                   stride: int, minted: int = 0) -> None:
+    """The child's op loop: one frame in, one reply out, until ``stop``
+    or EOF (the controller closing the socket IS the graceful retire)."""
+    state = {"seat": int(seat), "stride": int(stride), "minted": int(minted),
+             "pushed": {}, "kv_pools": {}, "kv_receivers": []}
+    try:
+        while True:
+            try:
+                header, payload = recv_frame(conn, timeout=None)
+            except (FleetWireError, OSError):
+                break
+            try:
+                reply, rpay = _handle_op(engine, state, header, payload)
+            except Exception as exc:  # op failed; channel stays up
+                reply, rpay = {"ok": False,
+                               "error": f"{type(exc).__name__}: {exc}"}, b""
+            try:
+                send_frame(conn, reply, rpay)
+            except OSError:
+                break
+            if header.get("op") == "stop":
+                break
+    finally:
+        for recv in state["kv_receivers"]:
+            try:
+                recv.close()
+            except Exception:
+                pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def _warm_and_serve(spec: ReplicaSpec, conn: socket.socket, seat: int,
+                    stride: int) -> None:
+    engine = _build_engine(spec)
+    engine.seed_ids(seat, stride)
+    minted = 0
+    if spec.warmup_prompts:
+        engine.generate([list(p) for p in spec.warmup_prompts],
+                        GenerationConfig(
+                            max_new_tokens=int(spec.warmup_new_tokens)))
+        minted = len(spec.warmup_prompts)
+        # warmup traffic must not make the replica look used: the Router
+        # refuses engines with prior submissions, and warmup counters
+        # would pollute merged fleet stats
+        engine.stats = type(engine.stats)()
+    send_frame(conn, {"op": "hello", "seat": int(seat), "warmup": minted})
+    _serve_replica(engine, conn, seat, stride, minted=minted)
+
+
+def _watch_parent(parent_pid: int) -> None:
+    # reparenting (getppid changes) means the controller died — even by
+    # SIGKILL, which no handler can see. Exit hard: this process owns
+    # nothing worth flushing.
+    while True:
+        time.sleep(0.25)
+        if os.getppid() != parent_pid:
+            os._exit(1)
+
+
+def _replica_main(spec: ReplicaSpec, host: str, port: int, seat: int,
+                  stride: int, parent_pid: int) -> None:
+    """Spawn-context child entrypoint. Connects FIRST (so the parent's
+    accept returns immediately), then builds + warms the engine, then
+    announces readiness with a ``hello`` frame and serves ops."""
+    _signal.signal(_signal.SIGTERM, lambda *_: os._exit(0))
+    threading.Thread(target=_watch_parent, args=(int(parent_pid),),
+                     daemon=True).start()
+    try:
+        conn = socket.create_connection((host, int(port)), timeout=30.0)
+    except OSError:
+        os._exit(1)
+    try:
+        _warm_and_serve(spec, conn, int(seat), int(stride))
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        os._exit(0)
+
+
+def _replica_thread_main(spec: ReplicaSpec, conn: socket.socket, seat: int,
+                         stride: int) -> None:
+    """Thread-backend twin of :func:`_replica_main` — same wire protocol
+    end to end, no process isolation. This is what tier-1 tests and the
+    CPU bench drive: every fleet code path minus fork/exec cost."""
+    try:
+        _warm_and_serve(spec, conn, int(seat), int(stride))
+    except Exception:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+# ============================================================ proxy side
+class _StatsMirror:
+    """Attribute-read view over the child's last stats snapshot — the
+    Router and the metric surfaces read ``e.stats.<counter>`` /
+    ``.as_dict()`` and never notice the engine is remote."""
+
+    def __init__(self):
+        from .engine import EngineStats
+
+        object.__setattr__(self, "_d", dict(EngineStats().as_dict()))
+
+    def __getattr__(self, name):
+        try:
+            return self._d[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def update(self, d: Dict) -> None:
+        self._d.update(d)
+
+    def as_dict(self) -> Dict:
+        return dict(self._d)
+
+
+@dataclasses.dataclass
+class RemoteRequest:
+    """Host-side mirror of a request living in a child engine: enough
+    state (prompt + streamed output prefix) to stream deltas, report
+    completion, and — if the child dies — re-create a real
+    :class:`~.engine.Request` for failover."""
+
+    request_id: int
+    prompt_ids: List[int]
+    gen: GenerationConfig
+    priority: int = 0
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    truncated: bool = False
+    finish_reason: Optional[str] = None
+    retry_after: Optional[float] = None
+    group_ids = None
+
+    @property
+    def n_samples(self) -> int:
+        return 1
+
+
+class _AdoptQueue(list):
+    """The proxy's ``waiting`` list. The Router's failover path appends
+    evacuated requests straight onto ``engines[j].waiting`` — here that
+    append becomes an ``adopt`` RPC handing the request (rid preserved,
+    committed output attached) to the child."""
+
+    def __init__(self, owner: "RemoteReplica"):
+        super().__init__()
+        self._owner = owner
+
+    def append(self, req) -> None:  # noqa: A003 - list API
+        self._owner._adopt(req)
+        super().append(req)
+
+
+class RemoteReplica:
+    """Engine-shaped proxy over one replica's control socket.
+
+    Duck-types everything the Router touches — ``add_request`` /
+    ``step`` / ``abort`` / ``evacuate`` / ``has_work`` / queue lens /
+    ``stats`` — against host-side mirrors refreshed by the sync fields
+    riding on every reply. When the wire dies, ``evacuate`` falls back
+    to the mirrors: prompt + streamed output prefix re-admit on a
+    survivor, and greedy decode of the lost tail is token-identical.
+    """
+
+    def __init__(self, conn: socket.socket, seat: int, *,
+                 fault: Optional[FaultInjector] = None,
+                 timeout_s: float = 30.0, fleet=None):
+        self._conn = conn
+        self.seat = int(seat)
+        self.fault = fault
+        self.timeout_s = float(timeout_s)
+        self._fleet = fleet
+        self._lock = threading.Lock()
+        self._wire_dead = False
+        self._busy = False
+        self._reqs: Dict[int, RemoteRequest] = {}
+        self.last_signal: Optional[ScalingSignal] = None
+        self.last_sync_t = 0.0
+        # the engine-duck surface the Router validates and reads
+        self.stats = _StatsMirror()
+        self.telemetry = Telemetry()
+        self.waiting = _AdoptQueue(self)
+        self.prefilling: Dict[int, None] = {}
+        self.running: Dict[int, RemoteRequest] = {}
+        self.allocator = SimpleNamespace(num_free=0)
+        self.prefix_cache = None
+        self.slo = None
+        self.capacity = None
+
+    # ------------------------------------------------------------- wire
+    def call(self, op: str, body: Optional[Dict] = None,
+             payload: bytes = b"",
+             timeout: Optional[float] = None) -> Tuple[Dict, bytes]:
+        if self._wire_dead:
+            raise FleetWireError(
+                f"replica seat {self.seat}: control channel already dead")
+        if self._fleet is not None:
+            self._fleet._count("fleet_control_rpcs")
+        if self.fault is not None:
+            # the fleet_control seam: raise models a crashed child, hang a
+            # wedged one — either way the Router's health machine (not a
+            # forever-wait) decides the replica's fate
+            try:
+                self.fault.check("fleet_control", key=self.seat)
+            except InjectedFault:
+                if self._fleet is not None:
+                    self._fleet._count("fleet_control_failures")
+                raise
+        header = {"op": op}
+        if body:
+            header.update(body)
+        with self._lock:
+            try:
+                send_frame(self._conn, header, payload)
+                reply, rpay = recv_frame(
+                    self._conn, timeout if timeout is not None
+                    else self.timeout_s)
+            except (OSError, FleetWireError) as exc:
+                self._wire_dead = True
+                if self._fleet is not None:
+                    self._fleet._count("fleet_control_failures")
+                raise FleetWireError(
+                    f"replica seat {self.seat}: control channel failed "
+                    f"during {op!r}: {exc}") from exc
+        if not reply.get("ok", False):
+            raise FleetWireError(
+                f"replica seat {self.seat}: {op!r} failed child-side: "
+                f"{reply.get('error')}")
+        self._apply_sync(reply)
+        return reply, rpay
+
+    def _apply_sync(self, reply: Dict) -> None:
+        counts = reply.get("counts")
+        if counts is None:
+            return
+        self.last_sync_t = time.monotonic()
+        self._busy = bool(reply.get("has_work", False))
+        self.allocator.num_free = int(reply.get("free_blocks", 0))
+        if "stats" in reply:
+            self.stats.update(reply["stats"])
+        if reply.get("signal"):
+            self.last_signal = ScalingSignal.from_dict(reply["signal"])
+        self.prefilling = {i: None for i in range(int(counts["prefilling"]))}
+        rids = reply.get("running_rids", ())
+        self.running = {int(rid): self._reqs[int(rid)]
+                        for rid in rids if int(rid) in self._reqs}
+        # rebuild the waiting mirror to the child's count (placeholders —
+        # nothing reads the elements, only the length)
+        n_wait = int(counts["waiting"])
+        del self.waiting[:]
+        list.extend(self.waiting, [None] * n_wait)
+
+    # ----------------------------------------------------- engine surface
+    @property
+    def has_work(self) -> bool:
+        if self._wire_dead:
+            return any(not r.finished for r in self._reqs.values())
+        return self._busy
+
+    def seed_ids(self, start: int, stride: int) -> None:
+        self.call("seed_ids", {"start": int(start), "stride": int(stride)})
+
+    def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None,
+                    n_samples: int = 1, priority: int = 0) -> int:
+        if n_samples != 1:
+            raise NotImplementedError(
+                "grouped sampling (n_samples > 1) does not cross the fleet "
+                "control channel yet — groups fork KV pages at admission, "
+                "which only exists child-side; submit groups to a local "
+                "engine")
+        gen = gen or GenerationConfig()
+        reply, _ = self.call("add_request", {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "gen": [float(x) for x in pack_gen(gen)],
+            "priority": int(priority)})
+        rid = int(reply["rid"])
+        self._reqs[rid] = RemoteRequest(rid, [int(t) for t in prompt_ids],
+                                        gen, priority=int(priority))
+        return rid
+
+    def step(self) -> List[RemoteRequest]:
+        reply, _ = self.call("step")
+        for rid, toks in reply.get("deltas", ()):
+            mirror = self._reqs.get(int(rid))
+            if mirror is not None:
+                mirror.output_ids.extend(int(t) for t in toks)
+        out = []
+        for fin in reply.get("finished", ()):
+            rid = int(fin["rid"])
+            mirror = self._reqs.pop(rid, None)
+            if mirror is None:
+                mirror = RemoteRequest(rid, [], GenerationConfig())
+            mirror.output_ids = [int(t) for t in fin["output_ids"]]
+            mirror.finished = True
+            mirror.finish_reason = fin.get("finish_reason")
+            mirror.truncated = bool(fin.get("truncated", False))
+            mirror.retry_after = fin.get("retry_after")
+            self.running.pop(rid, None)
+            out.append(mirror)
+        return out
+
+    def abort(self, request_id: int) -> bool:
+        reply, _ = self.call("abort", {"rid": int(request_id)})
+        self._reqs.pop(int(request_id), None)
+        self.running.pop(int(request_id), None)
+        return bool(reply.get("aborted", False))
+
+    def _adopt(self, req) -> None:
+        if getattr(req, "group_ids", None):
+            raise FleetWireError(
+                "grouped requests cannot fail over across the fleet "
+                "control channel")
+        self.call("adopt", {
+            "rid": int(req.request_id),
+            "prompt_ids": [int(t) for t in req.prompt_ids],
+            "output_ids": [int(t) for t in req.output_ids],
+            "gen": [float(x) for x in pack_gen(req.gen)],
+            "priority": int(getattr(req, "priority", 0))})
+        self._reqs[int(req.request_id)] = RemoteRequest(
+            int(req.request_id), [int(t) for t in req.prompt_ids], req.gen,
+            priority=int(getattr(req, "priority", 0)),
+            output_ids=[int(t) for t in req.output_ids])
+
+    def evacuate(self) -> Tuple[List[Request], List[RemoteRequest]]:
+        """Pull every movable request off this replica as REAL Request
+        objects (adoptable by local engines and proxies alike). Live
+        wire: the child evacuates (pages released, committed output
+        intact). Dead wire: rebuild from the host mirrors — prompt +
+        streamed output prefix; the unstreamed tail re-decodes
+        identically under greedy."""
+        if not self._wire_dead:
+            try:
+                reply, _ = self.call("evacuate")
+                movable = []
+                for m in reply.get("movable", ()):
+                    req = Request(
+                        int(m["rid"]), [int(t) for t in m["prompt_ids"]],
+                        unpack_gen(np.asarray(m["gen"], np.float64)),
+                        priority=int(m.get("priority", 0)))
+                    req.output_ids = [int(t) for t in m["output_ids"]]
+                    movable.append(req)
+                finished = []
+                for fin in reply.get("finished", ()):
+                    mirror = self._reqs.pop(int(fin["rid"]), None) or \
+                        RemoteRequest(int(fin["rid"]), [], GenerationConfig())
+                    mirror.output_ids = [int(t) for t in fin["output_ids"]]
+                    mirror.finished = True
+                    mirror.finish_reason = fin.get("finish_reason")
+                    finished.append(mirror)
+                self._clear_mirrors()
+                return movable, finished
+            except (FleetWireError, InjectedFault, OSError):
+                pass  # fall through to the mirror path
+        movable = []
+        for rid in sorted(self._reqs):
+            mirror = self._reqs[rid]
+            if mirror.finished:
+                continue
+            req = Request(rid, list(mirror.prompt_ids), mirror.gen,
+                          priority=mirror.priority)
+            req.output_ids = list(mirror.output_ids)
+            movable.append(req)
+        self._clear_mirrors()
+        return movable, []
+
+    def _finish(self, req, reason: str, count: int = 1) -> None:
+        """Terminal-mark a request the Router could not fail over (no
+        surviving replica) — mirror of LLMEngine's private helper."""
+        req.finished = True
+        req.finish_reason = reason
+        self._reqs.pop(int(req.request_id), None)
+        self.running.pop(int(req.request_id), None)
+
+    def _clear_mirrors(self) -> None:
+        self._reqs.clear()
+        self.running = {}
+        self.prefilling = {}
+        del self.waiting[:]
+        self._busy = False
+
+    def close(self) -> None:
+        self._wire_dead = True
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+# ======================================================== autoscale policy
+@dataclasses.dataclass
+class ScaleDecision:
+    """What the policy wants done NOW: ``spawn`` / ``retire`` / ``hold``
+    plus the reason (``signal``, or which gate suppressed the action)."""
+
+    action: str
+    reason: str
+
+
+class AutoscalePolicy:
+    """Pure signal → actuation decision layer (no processes, no I/O).
+
+    Feed it the fleet's combined :class:`ScalingSignal` action once per
+    tick; it answers spawn/retire/hold after four gates, in order:
+
+    1. **bounds** — never above ``max_replicas`` or below
+       ``min_replicas``;
+    2. **hysteresis** — an action needs ``up_consecutive`` /
+       ``down_consecutive`` *uninterrupted* same-direction signals (any
+       hold or flip resets both streaks, so an oscillating signal
+       actuates nothing);
+    3. **cooldown** — at least ``cooldown_s`` between actions, so one
+       saturated burst can't stairstep the fleet to max;
+    4. **in-flight floor** (scale_down only) — never retire capacity
+       the current load still needs:
+       ``(n-1) * slots_per_replica >= in_flight`` must hold.
+
+    ``_clock`` is patchable; the unit tests drive it with a fake clock.
+    """
+
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 cooldown_s: float = 5.0, up_consecutive: int = 2,
+                 down_consecutive: int = 4):
+        if min_replicas < 1:
+            raise ValueError(f"min_replicas={min_replicas} must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas={max_replicas} < min_replicas={min_replicas}")
+        if up_consecutive < 1 or down_consecutive < 1:
+            raise ValueError("hysteresis thresholds must be >= 1")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.cooldown_s = float(cooldown_s)
+        self.up_consecutive = int(up_consecutive)
+        self.down_consecutive = int(down_consecutive)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_t: Optional[float] = None
+
+    def _cooling(self, now: float) -> bool:
+        return (self._last_action_t is not None
+                and now - self._last_action_t < self.cooldown_s)
+
+    def decide(self, action: str, *, n_replicas: int, in_flight: int = 0,
+               slots_per_replica: int = 1) -> ScaleDecision:
+        now = self._clock()
+        if action == "scale_up":
+            self._up_streak += 1
+            self._down_streak = 0
+            if n_replicas >= self.max_replicas:
+                return ScaleDecision("hold", "max_bound")
+            if self._up_streak < self.up_consecutive:
+                return ScaleDecision("hold", "hysteresis")
+            if self._cooling(now):
+                return ScaleDecision("hold", "cooldown")
+            self._commit(now)
+            return ScaleDecision("spawn", "signal")
+        if action == "scale_down":
+            self._down_streak += 1
+            self._up_streak = 0
+            if n_replicas <= self.min_replicas:
+                return ScaleDecision("hold", "min_bound")
+            if self._down_streak < self.down_consecutive:
+                return ScaleDecision("hold", "hysteresis")
+            if self._cooling(now):
+                return ScaleDecision("hold", "cooldown")
+            if (n_replicas - 1) * max(1, slots_per_replica) < in_flight:
+                return ScaleDecision("hold", "inflight_floor")
+            self._commit(now)
+            return ScaleDecision("retire", "signal")
+        self._up_streak = self._down_streak = 0
+        return ScaleDecision("hold", "hold")
+
+    def _commit(self, now: float) -> None:
+        self._last_action_t = now
+        self._up_streak = self._down_streak = 0
+
+
+# ========================================================= process hygiene
+@dataclasses.dataclass(eq=False)
+class _ReplicaHandle:
+    """One spawned replica: its process (or thread), control socket, and
+    the SIGTERM → SIGKILL teardown ladder."""
+
+    seat: int
+    backend: str
+    proc: object
+    conn: socket.socket
+    t_spawn0: float = 0.0
+    t_ready: float = 0.0
+
+    def alive(self) -> bool:
+        return bool(self.proc is not None and self.proc.is_alive())
+
+    def terminate(self, grace_s: float = 2.0, counters=None) -> None:
+        # closing the control socket is the graceful signal: the child's
+        # serve loop exits on EOF
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        if self.backend == "process" and self.proc is not None:
+            if self.proc.is_alive():
+                self.proc.terminate()  # SIGTERM
+                self.proc.join(grace_s)
+                if self.proc.is_alive():
+                    self.proc.kill()  # SIGKILL — no child survives retire
+                    self.proc.join(1.0)
+                    if counters is not None:
+                        counters["fleet_child_force_kills"] += 1
+        elif self.proc is not None:
+            self.proc.join(grace_s)
+        _LIVE_HANDLES.discard(self)
+
+
+#: every live child handle, reaped at interpreter exit — a crashed or
+#: lazy controller must still leave zero orphan processes behind
+_LIVE_HANDLES: set = set()
+
+
+def _reap_all_handles() -> None:
+    for handle in list(_LIVE_HANDLES):
+        try:
+            handle.terminate(2.0)
+        except Exception:
+            pass
+
+
+atexit.register(_reap_all_handles)
+
+
+# ============================================================= controller
+class FleetController:
+    """Own the replica fleet: spawn/retire processes off the capacity
+    signal, front them with a Router, swap weights live.
+
+    The controller IS an engine to the serving layer above it (the HTTP
+    scheduler, ``generate`` callers): unknown attributes delegate to the
+    internal :class:`~.router.Router`, and :meth:`step` steps the fleet
+    then runs one control :meth:`tick`. The scheduler's idle branch
+    calls :meth:`idle_tick`, so autoscaling keeps actuating (and
+    retirements keep completing) while no request is in flight.
+    """
+
+    _clock = staticmethod(time.monotonic)
+
+    def __init__(
+        self,
+        spec: Optional[ReplicaSpec] = None,
+        *,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        backend: str = "process",
+        autoscale: Optional[AutoscalePolicy] = None,
+        router_policy: str = "least_loaded",
+        id_stride: Optional[int] = None,
+        fault: Optional[FaultInjector] = None,
+        watchdog_s: Optional[float] = None,
+        fail_threshold: int = 2,
+        control_timeout_s: float = 30.0,
+        spawn_timeout_s: float = 300.0,
+        grace_s: float = 5.0,
+        tracer=None,
+        signal_poll_s: float = 0.5,
+        spawn_inline: Optional[bool] = None,
+        chips_per_replica: int = 1,
+    ):
+        if backend not in ("process", "thread"):
+            raise ValueError(
+                f"backend={backend!r}: 'process' (real isolation) or "
+                "'thread' (same wire protocol, no fork/exec — tests/bench)")
+        self.spec = spec or ReplicaSpec()
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas
+                                if max_replicas is not None
+                                else max(self.min_replicas, 4))
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas={self.max_replicas} < "
+                f"min_replicas={self.min_replicas}")
+        self.backend = backend
+        self.fault = fault
+        self.grace_s = float(grace_s)
+        self.control_timeout_s = float(control_timeout_s)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.signal_poll_s = float(signal_poll_s)
+        self.chips_per_replica = int(chips_per_replica)
+        self.tracer = tracer
+        # id arithmetic must survive the fleet's MAXIMUM size, with slack
+        # so a seat freed by retirement isn't immediately remintable
+        self.id_stride = int(id_stride if id_stride is not None
+                             else max(16, 2 * self.max_replicas))
+        if self.id_stride < self.max_replicas:
+            raise ValueError(
+                f"id_stride={self.id_stride} < max_replicas="
+                f"{self.max_replicas}: seats would collide")
+        self.autoscale = autoscale or AutoscalePolicy(
+            min_replicas=self.min_replicas, max_replicas=self.max_replicas)
+        # one source of truth for bounds: the controller's
+        self.autoscale.min_replicas = self.min_replicas
+        self.autoscale.max_replicas = self.max_replicas
+        # inline spawn blocks the tick (bench determinism, thread backend);
+        # async spawn warms the replica on a side thread (serving stays up)
+        self.spawn_inline = (backend == "thread" if spawn_inline is None
+                             else bool(spawn_inline))
+
+        self.counters: Dict[str, float] = {n: 0 for n in FLEET_COUNTER_NAMES}
+        self._lock = threading.RLock()
+        self._handles: Dict[int, _ReplicaHandle] = {}
+        self._pending: Dict[int, threading.Thread] = {}
+        self._ready: List[Tuple[int, _ReplicaHandle, RemoteReplica]] = []
+        self._retiring: set = set()  # router indices draining to retirement
+        self._closed = False
+        self._last_chip_t = self._clock()
+        self.last_signal = ScalingSignal("hold", ("no_signal",))
+
+        if self.tracer is not None:
+            self.tracer.begin(FLEET_TRACE_ID, t0=self._clock(), track="fleet")
+
+        proxies = []
+        for seat in range(self.min_replicas):
+            handle, proxy = self._spawn(seat)
+            self._register(seat, handle)
+            proxies.append(proxy)
+            self._count("fleet_replicas_spawned")
+            self._span("fleet.spawn", handle.t_spawn0, handle.t_ready,
+                       seat=seat, reason="bootstrap")
+
+        from .router import Router
+
+        self.router = Router(
+            proxies, policy=router_policy, parallel_step=True,
+            slo_aware=True, fault=fault, watchdog_s=watchdog_s,
+            fail_threshold=fail_threshold, id_stride=self.id_stride)
+        self._update_gauges()
+
+    # everything the controller doesn't own IS the router's engine surface
+    # (add_request, abort, running, merged_stats, drain, replica_health...)
+    def __getattr__(self, name):
+        router = self.__dict__.get("router")
+        if router is None:
+            raise AttributeError(name)
+        return getattr(router, name)
+
+    # -------------------------------------------------------------- spawn
+    def _spawn(self, seat: int) -> Tuple[_ReplicaHandle, RemoteReplica]:
+        """Blocking spawn → warm: returns once the child said hello (its
+        engine is built, warmed, and id-seeded for ``seat``)."""
+        t0 = self._clock()
+        if self.backend == "thread":
+            parent_sock, child_sock = socket.socketpair()
+            thread = threading.Thread(
+                target=_replica_thread_main,
+                args=(self.spec, child_sock, seat, self.id_stride),
+                daemon=True, name=f"fleet-replica-{seat}")
+            thread.start()
+            conn, proc = parent_sock, thread
+        else:
+            import multiprocessing as mp
+
+            srv = socket.create_server(("127.0.0.1", 0))
+            srv.settimeout(self.spawn_timeout_s)
+            host, port = srv.getsockname()[:2]
+            proc = mp.get_context("spawn").Process(
+                target=_replica_main,
+                args=(self.spec, host, port, seat, self.id_stride,
+                      os.getpid()),
+                daemon=True, name=f"fleet-replica-{seat}")
+            proc.start()
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                proc.terminate()
+                raise FleetWireError(
+                    f"replica seat {seat} never connected within "
+                    f"{self.spawn_timeout_s}s")
+            finally:
+                srv.close()
+        handle = _ReplicaHandle(seat=seat, backend=self.backend, proc=proc,
+                                conn=conn, t_spawn0=t0)
+        _LIVE_HANDLES.add(handle)
+        try:
+            hello, _ = recv_frame(conn, timeout=self.spawn_timeout_s)
+        except FleetWireError:
+            handle.terminate(self.grace_s, self.counters)
+            raise FleetWireError(
+                f"replica seat {seat} died before hello (engine build or "
+                "warmup failed child-side)")
+        if hello.get("op") != "hello" or int(hello.get("seat", -1)) != seat:
+            handle.terminate(self.grace_s, self.counters)
+            raise FleetWireError(
+                f"replica seat {seat}: bad hello {hello!r}")
+        handle.t_ready = self._clock()
+        proxy = RemoteReplica(conn, seat, fault=self.fault,
+                              timeout_s=self.control_timeout_s, fleet=self)
+        return handle, proxy
+
+    def _register(self, seat: int, handle: _ReplicaHandle) -> None:
+        self._handles[seat] = handle
+
+    def _free_seat(self) -> int:
+        with self._lock:
+            used = set(self._handles) | set(self._pending)
+            for seat in range(self.id_stride):
+                if seat not in used:
+                    return seat
+        raise FleetWireError("no free seat (id_stride exhausted)")
+
+    def _spawn_async(self, reason: str) -> None:
+        seat = self._free_seat()
+        if self.spawn_inline:
+            try:
+                handle, proxy = self._spawn(seat)
+            except FleetWireError:
+                self._count("fleet_spawn_failures")
+                return
+            self._integrate_one(seat, handle, proxy, reason)
+            return
+
+        def _worker():
+            try:
+                handle, proxy = self._spawn(seat)
+            except Exception:
+                with self._lock:
+                    self._pending.pop(seat, None)
+                    self._count("fleet_spawn_failures")
+                return
+            with self._lock:
+                self._pending.pop(seat, None)
+                if self._closed:
+                    handle.terminate(self.grace_s, self.counters)
+                    return
+                self._ready.append((seat, handle, proxy))
+
+        thread = threading.Thread(target=_worker, daemon=True,
+                                  name=f"fleet-spawn-{seat}")
+        with self._lock:
+            self._pending[seat] = thread
+        thread.start()
+
+    def _integrate_one(self, seat: int, handle: _ReplicaHandle,
+                       proxy: RemoteReplica, reason: str) -> None:
+        self._register(seat, handle)
+        try:
+            self.router.add_replica(proxy, seat=seat)
+        except Exception:
+            # the reseed RPC (or registration itself) failed — a replica
+            # that can't take its first order is a failed spawn, not a
+            # reason to crash the control loop; retire it and let the
+            # min-replicas floor trigger another attempt
+            self._handles.pop(seat, None)
+            proxy.close()
+            handle.terminate(self.grace_s, self.counters)
+            self._count("fleet_spawn_failures")
+            return
+        self._count("fleet_replicas_spawned")
+        self._span("fleet.spawn", handle.t_spawn0, handle.t_ready,
+                   seat=seat, reason=reason)
+
+    # --------------------------------------------------------------- tick
+    def step(self) -> List:
+        """One fleet step: the Router steps every busy replica (its
+        parallel-step pool drives each proxy's socket concurrently),
+        then one control tick runs the autoscale/retire machinery."""
+        finished = self.router.step()
+        self.tick()
+        return finished
+
+    def idle_tick(self) -> None:
+        """Control tick with no engine work — the HTTP scheduler's idle
+        branch calls this so scale-down (and spawn integration) proceeds
+        while the fleet sits idle."""
+        self.tick()
+
+    def tick(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            now = self._clock()
+            self._integrate_chips(now)
+            self._integrate_ready()
+            self._reap_dead()
+            self._finish_retirements()
+            self._poll_signals(now)
+            self._maybe_scale()
+            self._update_gauges()
+
+    def _integrate_chips(self, now: float) -> None:
+        dt = max(0.0, now - self._last_chip_t)
+        self._last_chip_t = now
+        n = len(self._handles) + len(self._pending)
+        self.counters["fleet_chip_seconds"] += dt * n * self.chips_per_replica
+
+    def _integrate_ready(self) -> None:
+        while self._ready:
+            seat, handle, proxy = self._ready.pop()
+            self._integrate_one(seat, handle, proxy, "signal")
+
+    def _active_indices(self) -> List[int]:
+        return [i for i in range(self.router.n_replicas)
+                if self.router.health(i) not in ("dead", "retired")]
+
+    @property
+    def n_active(self) -> int:
+        return len(self._active_indices())
+
+    @property
+    def chip_seconds(self) -> float:
+        return float(self.counters["fleet_chip_seconds"])
+
+    def _in_flight(self) -> int:
+        return sum(self.router._load(i) for i in self._active_indices())
+
+    def _reap_dead(self) -> None:
+        """A replica the Router marked dead (step failures, watchdog,
+        control-channel loss) is a corpse: reap the process, free its
+        seat, and — below min_replicas — spawn a replacement without
+        waiting out the cooldown."""
+        for i in range(self.router.n_replicas):
+            if self.router.health(i) != "dead":
+                continue
+            seat = self.router.seat_of(i)
+            handle = self._handles.pop(seat, None)
+            if handle is None:
+                continue  # not ours / already reaped
+            eng = self.router.engines[i]
+            if isinstance(eng, RemoteReplica):
+                eng.close()
+            handle.terminate(self.grace_s, self.counters)
+            self.router.remove_replica(i)
+            self._retiring.discard(i)
+            self._count("fleet_replicas_replaced")
+            now = self._clock()
+            self._span("fleet.retire", now, now, seat=seat, reason="dead")
+        want = self.min_replicas
+        have = (len(self._active_indices()) - len(self._retiring)
+                + len(self._pending) + len(self._ready))
+        while have < want:
+            self._spawn_async("replace")
+            have += 1
+
+    def _finish_retirements(self) -> None:
+        for i in sorted(self._retiring):
+            eng = self.router.engines[i]
+            if eng.has_work or self.router._load(i) > 0:
+                continue  # still draining
+            seat = self.router.seat_of(i)
+            t0 = self._clock()
+            if isinstance(eng, RemoteReplica):
+                try:
+                    eng.call("stop", timeout=self.grace_s)
+                except (FleetWireError, InjectedFault):
+                    pass
+                eng.close()
+            handle = self._handles.pop(seat, None)
+            if handle is not None:
+                handle.terminate(self.grace_s, self.counters)
+            self.router.remove_replica(i)
+            self._retiring.discard(i)
+            self._count("fleet_replicas_retired")
+            self._span("fleet.retire", t0, self._clock(), seat=seat,
+                       reason="signal")
+
+    def _poll_signals(self, now: float) -> None:
+        """Refresh stale replica signals over the control channel and
+        fold them. A poll RPC that fails feeds the Router's OWN health
+        counter — the same consecutive-failure machine that catches step
+        failures catches a dead control channel."""
+        signals: Dict[str, ScalingSignal] = {}
+        for i in self._active_indices():
+            eng = self.router.engines[i]
+            if not isinstance(eng, RemoteReplica):
+                continue
+            if now - eng.last_sync_t > self.signal_poll_s:
+                try:
+                    eng.call("stats")
+                except (FleetWireError, InjectedFault, OSError):
+                    self.router._note_step_failure(i)
+                    continue
+            sig = eng.last_signal
+            if sig is not None and i not in self._retiring:
+                signals[f"replica{eng.seat}"] = sig
+        self.last_signal = combine_signals(signals) if signals else \
+            ScalingSignal("hold", ("no_signal",))
+
+    def _maybe_scale(self) -> None:
+        if self._pending or self._ready or self._retiring:
+            return  # one actuation in flight at a time
+        n = len(self._active_indices())
+        decision = self.autoscale.decide(
+            self.last_signal.action, n_replicas=n,
+            in_flight=self._in_flight(),
+            slots_per_replica=int(self.spec.slots))
+        if decision.action == "spawn":
+            self._count("fleet_scale_up_total")
+            self._spawn_async("signal")
+        elif decision.action == "retire":
+            victim = min(
+                (i for i in self._active_indices()
+                 if not self.router.draining(i)),
+                key=lambda i: self.router._load(i), default=None)
+            if victim is None:
+                return
+            self.router.drain(victim)
+            self._retiring.add(victim)
+            self._count("fleet_scale_down_total")
+        elif decision.reason in _SUPPRESS_COUNTER:
+            self._count(_SUPPRESS_COUNTER[decision.reason])
+
+    # -------------------------------------------------------- weight swap
+    def swap_weights(self, source, *, step: bool = True,
+                     timeout_s: float = 300.0) -> List[int]:
+        """Rolling live swap: for each replica — drain, wait idle (new
+        work lands on siblings), push the new weights over the control
+        channel, undrain. ``source`` is a packed-params checkpoint path
+        (children read it themselves — nothing crosses the wire but the
+        op) or an in-memory tree (packed and shipped inline). With
+        ``step=True`` the controller self-steps the fleet while waiting;
+        ``step=False`` sleeps instead (an external loop — the HTTP
+        scheduler — is stepping). Returns the seats swapped."""
+        if isinstance(source, (str, os.PathLike)):
+            body, payload = {"kind": "path",
+                             "path": os.fspath(source)}, b""
+        else:
+            body, payload = {"kind": "inline"}, pack_params(source)
+        swapped = []
+        for i in list(self._active_indices()):
+            if i in self._retiring:
+                continue
+            eng = self.router.engines[i]
+            if not isinstance(eng, RemoteReplica):
+                continue
+            seat = self.router.seat_of(i)
+            t0 = self._clock()
+            self.router.drain(i)
+            deadline = time.monotonic() + timeout_s
+            try:
+                while eng.has_work or self.router._load(i) > 0:
+                    if time.monotonic() > deadline:
+                        raise FleetWireError(
+                            f"replica seat {seat} did not drain within "
+                            f"{timeout_s}s for weight swap")
+                    if step:
+                        self.step()
+                    else:
+                        time.sleep(0.01)
+                eng.call("swap_weights", body, payload,
+                         timeout=max(self.control_timeout_s, 60.0))
+            finally:
+                try:
+                    self.router.undrain(i)
+                except Exception:
+                    pass
+            self._count("fleet_weight_swaps")
+            self._span("weight_swap", t0, self._clock(), seat=seat)
+            swapped.append(seat)
+        return swapped
+
+    # ------------------------------------------------------- manual scale
+    def scale_to(self, n: int) -> Dict[str, int]:
+        """Operator override (the ``/scale`` endpoint): spawn or drain
+        toward ``n`` replicas immediately, bypassing the policy's
+        hysteresis/cooldown (bounds still apply)."""
+        n = max(self.min_replicas, min(self.max_replicas, int(n)))
+        with self._lock:
+            active = [i for i in self._active_indices()
+                      if i not in self._retiring]
+            have = len(active) + len(self._pending) + len(self._ready)
+            spawned = retired = 0
+            while have + spawned < n:
+                self._spawn_async("manual")
+                spawned += 1
+            excess = have - n
+            if excess > 0:
+                for i in sorted(active, key=self.router._load)[:excess]:
+                    self.router.drain(i)
+                    self._retiring.add(i)
+                    retired += 1
+        return {"target": n, "spawning": spawned, "retiring": retired}
+
+    # ------------------------------------------------------------ surface
+    def generate(self, prompts, gen: Optional[GenerationConfig] = None
+                 ) -> List[List[int]]:
+        """Batch convenience mirroring ``LLMEngine.generate`` — drives
+        :meth:`step` (so control ticks interleave) until every prompt
+        finishes."""
+        gen = gen or GenerationConfig()
+        rids = [self.router.add_request(list(p), gen) for p in prompts]
+        outs: Dict[int, List[int]] = {}
+        want = set(rids)
+        while want - set(outs):
+            for req in self.step():
+                if req.request_id in want:
+                    outs[req.request_id] = list(req.output_ids)
+        return [outs[rid] for rid in rids]
+
+    def _count(self, name: str, inc: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + inc
+
+    def _span(self, name: str, t0: float, t1: float, **args) -> None:
+        if self.tracer is not None:
+            self.tracer.add(FLEET_TRACE_ID, name, t0, t1, track="fleet",
+                            **args)
+
+    def _update_gauges(self) -> None:
+        self.gauges = {
+            "fleet_replicas_active": len(self._active_indices()),
+            "fleet_replicas_retiring": len(self._retiring),
+        }
+
+    def prom_counters(self) -> Dict[str, float]:
+        return dict(self.counters)
+
+    def prom_gauges(self) -> Dict[str, float]:
+        self._update_gauges()
+        return dict(self.gauges)
+
+    def metrics_text(self) -> str:
+        """Router exposition plus the ``clt_fleet_*`` families."""
+        from ..telemetry.core import prometheus_exposition
+
+        return self.router.metrics_text() + prometheus_exposition(
+            self.prom_counters(), self.prom_gauges(), {})
+
+    def fleet_status(self) -> Dict:
+        """The ``/fleet`` endpoint body: per-replica rows + control
+        state."""
+        with self._lock:
+            rows = []
+            for i in range(self.router.n_replicas):
+                health = self.router.health(i)
+                if health == "retired":
+                    continue
+                rows.append({
+                    "index": i,
+                    "seat": self.router.seat_of(i),
+                    "health": health,
+                    "draining": bool(self.router.draining(i)),
+                    "retiring": i in self._retiring,
+                    "load": int(self.router._load(i)),
+                })
+            return {
+                "backend": self.backend,
+                "replicas": rows,
+                "n_active": len(self._active_indices()),
+                "spawning": sorted(self._pending),
+                "signal": self.last_signal.as_dict(),
+                "counters": self.prom_counters(),
+                "gauges": self.prom_gauges(),
+            }
+
+    # -------------------------------------------------------------- close
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._pending.values())
+        for thread in pending:
+            thread.join(self.spawn_timeout_s)
+        with self._lock:
+            while self._ready:
+                _, handle, _ = self._ready.pop()
+                handle.terminate(self.grace_s, self.counters)
+            for i in range(self.router.n_replicas):
+                eng = self.router.engines[i]
+                if isinstance(eng, RemoteReplica) and not eng._wire_dead:
+                    try:
+                        eng.call("stop", timeout=self.grace_s)
+                    except (FleetWireError, InjectedFault):
+                        pass
+                    eng.close()
+            for handle in list(self._handles.values()):
+                handle.terminate(self.grace_s, self.counters)
+            self._handles.clear()
+        self.router.close()
+
+    def __enter__(self) -> "FleetController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = [
+    "AutoscalePolicy",
+    "FLEET_COUNTER_NAMES",
+    "FLEET_GAUGE_NAMES",
+    "FLEET_TRACE_ID",
+    "FleetController",
+    "FleetWireError",
+    "RemoteReplica",
+    "ReplicaSpec",
+    "ScaleDecision",
+    "load_params",
+    "pack_params",
+    "save_params",
+    "tiny_llama_engine",
+    "tiny_llama_params",
+    "unpack_params",
+]

@@ -49,11 +49,21 @@ wire carries GLOBAL logical pages, so a tp=N source pool feeds a tp=M
 destination pool with no extra machinery — the receiver's scatter lands
 under the destination's own sharding.
 
-In-process the two halves share one object (loopback, like
-``HostKVTransport`` — but the bytes genuinely cross the kernel's TCP
-stack); a cross-host deployment splits them, with the decode host
-running the listener half and completion signaled by the connection
-instead of the in-process event.
+:class:`SocketKVTransport` keeps both halves in one object (loopback
+rehearsal: the bytes genuinely cross the kernel's TCP stack, completion
+is an in-process event). The SPLIT deployment shape lives beside it:
+:class:`SocketKVReceiver` is the standalone listener half — it owns the
+destination pool(s), scatters arriving frames, and answers each
+completed transfer with an ack frame carrying its scatter timings —
+and :class:`SocketKVDialer` is the standalone sender half, dialing a
+``(host, port)`` advertisement handed over out-of-band (the
+FleetController ships it to disagg pairs over its control channel).
+Completion crosses the wire as the ack instead of an event, and frame
+``meta`` additionally names the destination pool and blocks, since the
+sender no longer holds a reference to either. Ack scatter timings are
+``time.monotonic`` values — CLOCK_MONOTONIC is system-wide on Linux, so
+the dialer compares them against its own send timestamps directly to
+count overlapped frames.
 """
 
 from __future__ import annotations
@@ -77,7 +87,7 @@ from .kv_transport import (
     _check_pools,
 )
 
-__all__ = ["SocketKVTransport"]
+__all__ = ["SocketKVDialer", "SocketKVReceiver", "SocketKVTransport"]
 
 #: sanity cap on a single frame's length prefix — a garbage prefix must
 #: fail loudly instead of waiting for gigabytes that never arrive
@@ -525,3 +535,376 @@ class SocketKVTransport(KVTransport):
             pending = list(self._deliveries.values())
         for delivery in pending:
             delivery.fail(exc)
+
+
+# ============================================== split listener/dialer halves
+def _send_ack(conn: socket.socket, payload: Dict) -> None:
+    import json
+
+    body = json.dumps(payload, separators=(",", ":")).encode()
+    conn.sendall(struct.pack("<I", len(body)) + body)
+
+
+class SocketKVReceiver(KVTransport):
+    """The standalone listener half of the socket KV wire.
+
+    Lives in the process that OWNS the destination pool(s) — a decode
+    worker in a disaggregated pair. :meth:`register_pool` names a pool
+    the wire may scatter into (``on_update`` receives every post-scatter
+    rebind, since each ``deliver_layers`` donates the previous buffer);
+    :meth:`advertise` returns the ``(host, port)`` a dialer in another
+    process connects to — hand it over however you like (the
+    FleetController ships it over its control channel).
+
+    Per-transfer protocol, one direction each way on one connection:
+    data frames (``u32 length | PageBlockWire bytes``) flow in, frame
+    ``meta`` naming the transfer, frame index/count, destination pool
+    and blocks; after the final frame (or on any error) ONE ack frame
+    (``u32 length | JSON``) flows back with the scatter event timings —
+    completion signaling for a sender that holds no reference to the
+    pool. Any wire error nacks and tears the connection down; the next
+    transfer starts on a fresh dial.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 recv_timeout_s: float = 30.0):
+        self.recv_timeout_s = float(recv_timeout_s)
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(0.2)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._closed = False
+        self._plock = threading.Lock()
+        self._pools: Dict[str, PagedKVCache] = {}
+        self._on_update: Dict[str, Optional[callable]] = {}
+        self.transfers_completed = 0
+        self.last_wire_error: Optional[Exception] = None
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="kvrecv-accept", daemon=True)
+        self._accept_thread.start()
+
+    def advertise(self) -> Tuple[str, int]:
+        """The ``(host, port)`` endpoint a :class:`SocketKVDialer` in
+        another process dials."""
+        return self.host, self.port
+
+    def register_pool(self, name: str, pool: PagedKVCache,
+                      on_update=None) -> None:
+        """Expose ``pool`` to the wire under ``name``. ``on_update`` is
+        called with the rebound pool after every frame's scatter — the
+        owner MUST adopt it (donation deletes the old buffer on
+        TPU/GPU)."""
+        with self._plock:
+            self._pools[name] = pool
+            self._on_update[name] = on_update
+
+    def pool(self, name: str) -> PagedKVCache:
+        with self._plock:
+            return self._pools[name]
+
+    def transfer(self, src, dst, src_blocks, dst_blocks):
+        raise NotImplementedError(
+            "SocketKVReceiver is the listener half — the sending process "
+            "drives transfers through a SocketKVDialer aimed at "
+            "advertise()")
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=1.0)
+
+    def __enter__(self) -> "SocketKVReceiver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --------------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="kvrecv-serve", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(self.recv_timeout_s)
+        #: xid → (frames_seen, events) for transfers on THIS connection
+        live: Dict[int, Tuple[int, List[Tuple]]] = {}
+        try:
+            while not self._closed:
+                prefix, eof = _recv_exact(conn, 4)
+                if eof and not prefix:
+                    return  # clean close between transfers
+                if eof:
+                    raise ValueError(
+                        "kv wire stream truncated inside a frame length "
+                        f"prefix ({len(prefix)}/4 bytes)")
+                (length,) = struct.unpack("<I", prefix)
+                if length > _MAX_FRAME_BYTES:
+                    raise ValueError(
+                        f"kv wire frame length {length} exceeds the "
+                        f"{_MAX_FRAME_BYTES}-byte cap (garbage prefix?)")
+                body, eof = _recv_exact(conn, length)
+                if eof:
+                    try:
+                        PageBlockWire.from_bytes(body)
+                    except ValueError as exc:
+                        raise ValueError(
+                            "kv wire stream truncated mid-frame "
+                            f"({len(body)}/{length} bytes): {exc}") from exc
+                    raise ValueError(
+                        "kv wire stream truncated mid-frame "
+                        f"({len(body)}/{length} bytes)")
+                self._land_frame(conn, body, live)
+        except Exception as exc:  # noqa: BLE001 — every wire error lands here
+            if not self._closed:
+                self.last_wire_error = exc
+                try:
+                    _send_ack(conn, {"ok": False, "error": str(exc)})
+                except OSError:
+                    pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _land_frame(self, conn: socket.socket, body: bytes,
+                    live: Dict[int, Tuple[int, List[Tuple]]]) -> None:
+        wire = PageBlockWire.from_bytes(body)
+        meta = wire.meta
+        xid = meta.get("xfer")
+        frame, n_frames = meta.get("frame"), meta.get("n_frames")
+        name = meta.get("pool", "kv")
+        dst_blocks = [int(b) for b in meta.get("dst_blocks", ())]
+        seen, events = live.get(xid, (0, []))
+        if frame != seen:
+            raise ValueError(
+                f"kv wire frame sequence broken: expected frame {seen} of "
+                f"transfer {xid!r}, got {frame} — a frame was dropped in "
+                "transit")
+        with self._plock:
+            if name not in self._pools:
+                raise ValueError(
+                    f"kv wire frame targets unregistered pool {name!r} "
+                    f"(registered: {sorted(self._pools)})")
+            pool = self._pools[name]
+        t0 = time.monotonic()
+        pool = self.deliver_layers(pool, wire, dst_blocks)
+        jax.block_until_ready(pool.k)
+        t1 = time.monotonic()
+        with self._plock:
+            self._pools[name] = pool
+            cb = self._on_update.get(name)
+        if cb is not None:
+            cb(pool)
+        events.append(("scatter", frame, t0, t1))
+        seen += 1
+        if seen == n_frames:
+            live.pop(xid, None)
+            self.transfers_completed += 1
+            _send_ack(conn, {
+                "ok": True, "xfer": xid, "frames": int(n_frames),
+                "events": [[int(f), float(a), float(b)]
+                           for _, f, a, b in events]})
+        else:
+            live[xid] = (seen, events)
+
+
+class SocketKVDialer(KVTransport):
+    """The standalone sender half of the socket KV wire: dial a
+    :class:`SocketKVReceiver`'s advertisement and stream page frames at
+    it, layer group by layer group.
+
+    :meth:`transfer_remote` replaces the in-process ``transfer`` — the
+    destination pool lives in the receiver's process, so the sender
+    names it (``pool=``) plus the destination block list, and completion
+    comes back as the receiver's ack (scatter timings included, from
+    which ``overlap_frames`` is computed — same pipelining proof as the
+    combined transport). Wire errors surface as the ``ValueError`` the
+    disagg pump retries under its ``RetryPolicy``; the connection drops
+    on error so the next attempt redials clean.
+    """
+
+    def __init__(self, address: Tuple[str, int], *,
+                 layers_per_frame: int = 1,
+                 retry: Optional[RetryPolicy] = None,
+                 fault=None,
+                 frame_pause_s: float = 0.0,
+                 recv_timeout_s: float = 30.0,
+                 connect_timeout_s: float = 2.0,
+                 wire_version: int = _WIRE_VERSION):
+        self.host, self.port = str(address[0]), int(address[1])
+        self.layers_per_frame = max(1, int(layers_per_frame))
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.fault = fault
+        self.frame_pause_s = float(frame_pause_s)
+        self.recv_timeout_s = float(recv_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.wire_version = int(wire_version)
+        self._conn_lock = threading.Lock()
+        self._client: Optional[socket.socket] = None
+        self._ever_connected = False
+        self._slock = threading.Lock()
+        self._xfer_ids = itertools.count()
+        self._pending_stats = SocketKVTransport._zero_stats()
+        self.last_transfer: Dict[str, float] = {}
+
+    def pop_wire_stats(self) -> Dict[str, int]:
+        with self._slock:
+            out = self._pending_stats
+            self._pending_stats = SocketKVTransport._zero_stats()
+        return out
+
+    def close(self) -> None:
+        self._drop_connection()
+
+    def __enter__(self) -> "SocketKVDialer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def transfer(self, src, dst, src_blocks, dst_blocks):
+        raise NotImplementedError(
+            "SocketKVDialer has no local destination pool — use "
+            "transfer_remote(src, src_blocks, dst_blocks, pool=...)")
+
+    def transfer_remote(self, src: PagedKVCache, src_blocks: List[int],
+                        dst_blocks: List[int], pool: str = "kv",
+                        kv_dtype: Optional[str] = None) -> Dict:
+        """Stream ``src_blocks`` of the local pool into ``dst_blocks``
+        of the receiver's pool named ``pool``; block until the
+        receiver's ack and return it. ``kv_dtype`` defaults to the
+        source pool's page dtype family."""
+        if len(src_blocks) != len(dst_blocks):
+            raise ValueError(
+                f"{len(src_blocks)} source vs {len(dst_blocks)} destination "
+                "blocks — transfers are 1:1")
+        if not src_blocks:
+            return {"ok": True, "frames": 0}
+        if kv_dtype is None:
+            kv_dtype = jax.numpy.dtype(src.k.dtype).name
+        n_layers = int(src.k.shape[0])
+        groups = [(lo, min(lo + self.layers_per_frame, n_layers))
+                  for lo in range(0, n_layers, self.layers_per_frame)]
+        xid = next(self._xfer_ids)
+        conn = self._ensure_connected()
+        send_events: List[Tuple] = []
+        frames = nbytes = 0
+        try:
+            for i, (lo, hi) in enumerate(groups):
+                wire = self.pack_layers(
+                    src, src_blocks, lo, hi, kv_dtype=kv_dtype,
+                    meta={"xfer": xid, "frame": i, "n_frames": len(groups),
+                          "pool": pool,
+                          "dst_blocks": [int(b) for b in dst_blocks]})
+                chunks = list(wire.iter_frame_chunks(self.wire_version))
+                length = sum(len(c) for c in chunks)
+                mode = None
+                if self.fault is not None:
+                    mode = self.fault.check("kv_wire")
+                t0 = time.monotonic()
+                if mode == "drop":
+                    continue
+                if mode == "corrupt":
+                    body = self.fault.corrupt_bytes("kv_wire",
+                                                    b"".join(chunks))
+                    conn.sendall(struct.pack("<I", len(body)) + body)
+                    sent = 4 + len(body)
+                else:
+                    conn.sendall(struct.pack("<I", length))
+                    for chunk in chunks:
+                        conn.sendall(chunk)
+                    sent = 4 + length
+                send_events.append(("send", i, t0, time.monotonic()))
+                frames += 1
+                nbytes += sent
+                if self.frame_pause_s:
+                    time.sleep(self.frame_pause_s)
+            ack = self._recv_ack(conn)
+        except (OSError, ValueError) as exc:
+            self._drop_connection()
+            with self._slock:
+                self._pending_stats["frames"] += frames
+                self._pending_stats["bytes"] += nbytes
+            if isinstance(exc, ValueError):
+                raise
+            raise ValueError(
+                f"kv wire connection lost mid-transfer: {exc}") from exc
+        if not ack.get("ok", False):
+            self._drop_connection()
+            raise ValueError(
+                f"kv wire transfer failed receiver-side: {ack.get('error')}")
+        # monotonic clocks are system-wide on Linux: the receiver's scatter
+        # timestamps compare directly against our send timestamps
+        last_send_end = max((e[3] for e in send_events), default=0.0)
+        overlap = sum(1 for f, a, _b in ack.get("events", ())
+                      if a < last_send_end and f < len(groups) - 1)
+        self.last_transfer = {"frames": len(groups), "bytes": nbytes,
+                              "overlap_frames": overlap}
+        with self._slock:
+            self._pending_stats["frames"] += len(groups)
+            self._pending_stats["bytes"] += nbytes
+            self._pending_stats["overlap_frames"] += overlap
+        return ack
+
+    def _recv_ack(self, conn: socket.socket) -> Dict:
+        import json
+
+        prefix, eof = _recv_exact(conn, 4)
+        if eof:
+            raise ValueError(
+                "kv wire connection closed waiting for the receiver's ack")
+        (length,) = struct.unpack("<I", prefix)
+        if length > (1 << 24):
+            raise ValueError(
+                f"kv wire ack length {length} is not plausible")
+        body, eof = _recv_exact(conn, length)
+        if eof:
+            raise ValueError("kv wire ack truncated")
+        return json.loads(body.decode())
+
+    def _ensure_connected(self) -> socket.socket:
+        with self._conn_lock:
+            if self._client is not None:
+                return self._client
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.port),
+                        timeout=self.connect_timeout_s)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(self.recv_timeout_s)
+                    if self._ever_connected:
+                        with self._slock:
+                            self._pending_stats["reconnects"] += 1
+                    self._ever_connected = True
+                    self._client = s
+                    return s
+                except OSError as exc:
+                    if self.retry.exhausted(attempt):
+                        raise ValueError(
+                            f"kv wire connect to {self.host}:{self.port} "
+                            f"failed after {attempt} attempts: {exc}"
+                        ) from exc
+                    time.sleep(self.retry.delay(attempt))
+
+    def _drop_connection(self) -> None:
+        with self._conn_lock:
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+                self._client = None

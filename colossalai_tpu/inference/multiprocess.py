@@ -14,6 +14,12 @@ the prompt batch (``LLMEngine.broadcast_prompts``). Generation params are
 broadcast too — a mismatched ``max_new_tokens`` would desync the two
 hosts' step loops and deadlock the collectives, so followers never trust
 local defaults.
+
+The GenerationConfig wire codec (:func:`pack_gen`/:func:`unpack_gen`) is
+shared with the fleet control plane (``inference/fleet.py``): ONE codec
+for "a GenerationConfig crosses a process boundary", so the field-count
+version-skew check and the 2^24 exact-int guard protect both the
+lockstep broadcast and the controller→replica RPC the same way.
 """
 
 from __future__ import annotations
@@ -86,6 +92,14 @@ def _unpack_gen(arr: np.ndarray) -> GenerationConfig:
         else:
             kwargs[name] = float(raw)
     return GenerationConfig(**kwargs)
+
+
+#: public names of the shared codec — the fleet control plane serializes
+#: GenerationConfig through these, the lockstep broadcast through the
+#: underscore originals (same functions)
+GEN_WIRE_FIELDS = _GEN_FIELDS
+pack_gen = _pack_gen
+unpack_gen = _unpack_gen
 
 
 class MultiProcessFrontend:

@@ -41,11 +41,17 @@ the single front door that decides WHICH replica serves each request:
   target whose ``_count`` equals the sum over replicas.
 
 Request ids are globally unique WITHOUT a translation table: the router
-re-seeds each fresh replica's id counter to ``count(i, n_replicas)``, so
-replica ``i`` only ever mints ids ≡ i (mod n) and ``rid % n_replicas``
-IS the owning replica — abort/streaming lookups are O(1) and the ids a
-replica hands back (including grouped-sampling member lists) need no
-rewriting.
+re-seeds each fresh replica's id counter to ``count(seat, id_stride)``,
+so a replica only ever mints ids ≡ its seat (mod stride) and
+``rid % id_stride`` names the minting seat — abort/streaming lookups
+are O(1) and the ids a replica hands back (including grouped-sampling
+member lists) need no rewriting. ``id_stride`` defaults to the initial
+replica count (the classic ``rid % n`` contract); a FleetController
+passes a larger stride so membership can GROW: :meth:`add_replica`
+seats a fresh replica mid-flight (reusing a retired slot index when one
+exists) and :meth:`remove_replica` tombstones a dead or drained-idle
+one — its terminal counters stay in the merged view, its seat frees for
+a future replica.
 
 ``step()`` advances every busy replica; with ``parallel_step=True`` (the
 default) each busy replica steps on its own worker thread — the host
@@ -87,9 +93,35 @@ ROUTER_POLICIES = ("cache_aware", "least_loaded", "round_robin")
 #: (one failed/overrun step) → dead (``fail_threshold`` consecutive
 #: failures; in-flight work fails over to survivors) → healthy again via
 #: :meth:`Router.revive`. A clean step clears a suspect back to healthy.
-REPLICA_HEALTH_STATES = ("healthy", "suspect", "dead")
+#: ``retired`` is terminal: :meth:`Router.remove_replica` tombstoned the
+#: slot (counters frozen into the merged view, seat freed for reuse).
+REPLICA_HEALTH_STATES = ("healthy", "suspect", "dead", "retired")
 
 _LOG = logging.getLogger(__name__)
+
+
+class _RetiredReplica:
+    """Tombstone occupying a removed replica's slot: frozen terminal
+    counters stay in the merged view (``merged_stats`` keeps balancing
+    submitted = completed + aborted across retirements), everything live
+    reads empty. Never placed, never stepped."""
+
+    def __init__(self, engine):
+        from types import SimpleNamespace
+
+        snap = {k: v for k, v in engine.stats.as_dict().items()
+                if isinstance(v, (int, float))}
+        self.stats = SimpleNamespace(
+            as_dict=lambda _d=dict(snap): dict(_d), **snap)
+        # histograms (and an attached SLO tracker) keep contributing their
+        # final state to the merged exposition
+        self.telemetry = engine.telemetry
+        self.waiting: list = []
+        self.prefilling: dict = {}
+        self.running: dict = {}
+        self.allocator = SimpleNamespace(num_free=0)
+        self.prefix_cache = None
+        self.has_work = False
 
 
 class Router:
@@ -115,6 +147,7 @@ class Router:
         fault=None,
         watchdog_s: Optional[float] = None,
         fail_threshold: int = 2,
+        id_stride: Optional[int] = None,
     ):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
@@ -146,10 +179,23 @@ class Router:
             )
         self.engines = list(engines)
         n = len(self.engines)
+        # replica i mints ids seat, seat+stride, ... — globally unique
+        # and self-describing (rid % stride == seat). The stride must
+        # survive the fleet's MAXIMUM size, so dynamic fleets pass one
+        # larger than any replica count they'll reach.
+        self._id_stride = int(id_stride) if id_stride else n
+        if self._id_stride < n:
+            raise ValueError(
+                f"id_stride={self._id_stride} < {n} replicas — seats "
+                "would collide and rid ownership would be ambiguous")
+        #: engine index → minting seat (-1 once retired); seats are
+        #: stable for a replica's lifetime, indices are the Router's
+        #: slot numbers (reused by add_replica after a retirement)
+        self._seats = list(range(n))
+        self._seat_owner: Dict[int, int] = {s: i
+                                            for i, s in enumerate(self._seats)}
         for i, e in enumerate(self.engines):
-            # replica i mints ids i, i+n, i+2n, ... — globally unique and
-            # self-describing (rid % n == i)
-            e._ids = itertools.count(i, n)
+            self._reseed(e, i)
             # each replica's spans render on their own named track in the
             # Chrome export (harmless when no tracer is attached)
             e.telemetry.track = f"replica{i}"
@@ -168,6 +214,7 @@ class Router:
         self._devices = list(devices) if devices is not None else None
         self._draining = [False] * n
         self._rr = 0
+        self._parallel = bool(parallel_step)
         self._pool = (
             ThreadPoolExecutor(max_workers=n, thread_name_prefix="router-step")
             if parallel_step and n > 1 else None
@@ -204,6 +251,124 @@ class Router:
         self.replica_revivals = 0
         self.requests_failed_over = 0
         self.watchdog_trips = 0
+        self.replicas_added = 0
+        self.replicas_retired = 0
+
+    # -------------------------------------------------- dynamic membership
+    def _reseed(self, e, seat: int) -> None:
+        """Point a fresh replica's id counter at its seat's residue
+        class. Engines expose :meth:`LLMEngine.seed_ids`; any duck-typed
+        replica without it gets its counter replaced directly."""
+        seeder = getattr(e, "seed_ids", None)
+        if callable(seeder):
+            seeder(seat, self._id_stride)
+        else:
+            e._ids = itertools.count(seat, self._id_stride)
+
+    def seat_of(self, i: int) -> int:
+        """The minting seat of replica slot ``i`` (-1 once retired)."""
+        return self._seats[i]
+
+    def add_replica(self, engine, seat: Optional[int] = None) -> int:
+        """Seat a FRESH replica mid-flight and return its slot index.
+
+        A retired slot is reused when one exists (the engines list never
+        shrinks or reorders, so existing indices stay valid); otherwise
+        the fleet grows by one slot. ``seat`` picks the id residue class
+        — callers that pre-seeded the engine (a FleetController spawning
+        a warmed child) pass the seat it was spawned with; default is
+        the lowest free seat."""
+        if self._devices is not None:
+            raise ValueError(
+                "dynamic membership with devices= pinning is not "
+                "supported — device lists are fixed at construction")
+        if self.policy == "cache_aware" and engine.prefix_cache is None:
+            raise ValueError(
+                "policy='cache_aware' requires the new replica to carry a "
+                "prefix cache (prefix_cache=True)")
+        if engine.stats.requests_submitted or engine.has_work:
+            raise ValueError(
+                "add_replica needs a fresh engine — it already served "
+                "requests and re-seeding would break rid ownership")
+        used = set(self._seat_owner)
+        if seat is None:
+            free = [s for s in range(self._id_stride) if s not in used]
+            if not free:
+                raise ValueError(
+                    f"all {self._id_stride} seats occupied — build the "
+                    "router with a larger id_stride")
+            seat = free[0]
+        else:
+            seat = int(seat)
+            if not 0 <= seat < self._id_stride:
+                raise ValueError(
+                    f"seat={seat} outside [0, {self._id_stride})")
+            if seat in used:
+                raise ValueError(f"seat {seat} is occupied by replica "
+                                 f"{self._seat_owner[seat]}")
+        self._reseed(engine, seat)
+        engine.telemetry.track = f"replica{seat}"
+        for idx, h in enumerate(self._health):
+            if h == "retired":
+                break
+        else:
+            idx = len(self.engines)
+            self.engines.append(engine)
+            self._draining.append(False)
+            self._health.append("healthy")
+            self._fail_streak.append(0)
+            self._failures_total.append(0)
+            self._seats.append(seat)
+        self.engines[idx] = engine
+        self._draining[idx] = False
+        self._health[idx] = "healthy"
+        self._fail_streak[idx] = 0
+        self._seats[idx] = seat
+        self._seat_owner[seat] = idx
+        self.replicas_added += 1
+        self._resize_pool()
+        return idx
+
+    def remove_replica(self, i: int) -> None:
+        """Tombstone replica slot ``i``: legal for a DEAD replica (its
+        work already failed over) or a DRAINED-idle one (scale-down
+        completed). The slot keeps the replica's terminal counters in
+        the merged view via a stub engine; its seat frees for reuse."""
+        e = self.engines[i]
+        h = self._health[i]
+        if h == "retired":
+            raise ValueError(f"replica {i} is already retired")
+        if h != "dead" and (not self._draining[i] or e.has_work
+                            or self._load(i) > 0):
+            raise ValueError(
+                f"replica {i} is {h} with work or placement eligibility — "
+                "drain it idle (or let the health machine mark it dead) "
+                "before removing")
+        seat = self._seats[i]
+        self.engines[i] = _RetiredReplica(e)
+        self._health[i] = "retired"
+        self._draining[i] = False
+        self._fail_streak[i] = 0
+        self._seat_owner.pop(seat, None)
+        self._seats[i] = -1
+        self.replicas_retired += 1
+        self._resize_pool()
+
+    def _resize_pool(self) -> None:
+        """Keep one step worker per live replica as membership changes.
+        Runs on the control thread between steps (the controller ticks
+        after every step), never concurrently with step workers."""
+        if not self._parallel:
+            return
+        n_live = sum(1 for h in self._health if h != "retired")
+        old = self._pool
+        self._pool = (
+            ThreadPoolExecutor(max_workers=n_live,
+                               thread_name_prefix="router-step")
+            if n_live > 1 else None
+        )
+        if old is not None:
+            old.shutdown(wait=False)
 
     # ------------------------------------------------------------- placement
     @property
@@ -211,12 +376,15 @@ class Router:
         return len(self.engines)
 
     def replica_of(self, request_id: int) -> int:
-        """Owning replica of a request id — pure arithmetic (``rid % n``)
-        except for failed-over requests, whose adoption broke the modular
-        convention and is recorded in a small override table that retires
-        as they finish."""
-        return self._owner_override.get(
-            request_id, request_id % len(self.engines))
+        """Owning replica of a request id — pure arithmetic (the seat is
+        ``rid % id_stride``) except for failed-over requests, whose
+        adoption broke the modular convention and is recorded in a small
+        override table that retires as they finish."""
+        override = self._owner_override.get(request_id)
+        if override is not None:
+            return override
+        return self._seat_owner.get(request_id % self._id_stride,
+                                    request_id % len(self.engines))
 
     def _load(self, i: int) -> int:
         e = self.engines[i]
@@ -262,7 +430,8 @@ class Router:
 
     def _place(self, prompt_ids: List[int]) -> int:
         eligible = [i for i in range(len(self.engines))
-                    if not self._draining[i] and self._health[i] != "dead"]
+                    if not self._draining[i]
+                    and self._health[i] not in ("dead", "retired")]
         if not eligible:
             raise RuntimeError(
                 "every replica is draining or dead — undrain/revive one "
@@ -380,7 +549,7 @@ class Router:
         produced are still returned — their terminal accounting already
         happened."""
         busy = [i for i, e in enumerate(self.engines)
-                if e.has_work and self._health[i] != "dead"]
+                if e.has_work and self._health[i] not in ("dead", "retired")]
         if not busy:
             return []
         finished: List[Request] = []
@@ -469,6 +638,8 @@ class Router:
         decodes run dry (weight swap quiesce) while the replica KEEPS
         taking new prompts — they queue on the prefill side."""
         e = self.engines[i]  # index check
+        if self._health[i] == "retired":
+            raise ValueError(f"replica {i} is retired")
         if role != "all":
             if not hasattr(e, "drain_role"):
                 raise ValueError(
@@ -482,6 +653,8 @@ class Router:
 
     def undrain(self, i: int, role: str = "all") -> None:
         e = self.engines[i]
+        if self._health[i] == "retired":
+            raise ValueError(f"replica {i} is retired")
         if role != "all":
             if not hasattr(e, "drain_role"):
                 raise ValueError(
@@ -514,7 +687,7 @@ class Router:
             self._health[i] = "healthy"
 
     def _note_step_failure(self, i: int) -> None:
-        if self._health[i] == "dead":
+        if self._health[i] in ("dead", "retired"):
             return
         self._failures_total[i] += 1
         self._fail_streak[i] += 1
@@ -548,7 +721,7 @@ class Router:
             tr.instant(movable[0].request_id, "replica_dead", track="router",
                        replica=i, in_flight=len(movable) + len(finished))
         alive = [j for j in range(len(self.engines))
-                 if self._health[j] != "dead"]
+                 if self._health[j] not in ("dead", "retired")]
         # prefer non-draining survivors; a fully-draining fleet still
         # adopts the orphans rather than failing them
         pref = [j for j in alive if not self._draining[j]] or alive
@@ -573,6 +746,10 @@ class Router:
         probe succeeded): placement-eligible again, failure streak reset.
         Its totals keep accumulating — ``replica_health`` shows history."""
         _ = self.engines[i]  # index check
+        if self._health[i] == "retired":
+            raise ValueError(
+                f"replica {i} is retired — its slot can only be refilled "
+                "by add_replica")
         if self._health[i] == "dead":
             self.replica_revivals += 1
         self._health[i] = "healthy"
@@ -631,6 +808,8 @@ class Router:
             "router_replica_revivals": self.replica_revivals,
             "router_requests_failed_over": self.requests_failed_over,
             "router_watchdog_trips": self.watchdog_trips,
+            "router_replicas_added": self.replicas_added,
+            "router_replicas_retired": self.replicas_retired,
         }
 
     def merged_stats(self) -> Dict[str, float]:
@@ -711,7 +890,8 @@ class Router:
             "waiting": sum(len(e.waiting) for e in self.engines),
             "prefilling": sum(len(e.prefilling) for e in self.engines),
             "free_blocks": sum(e.allocator.num_free for e in self.engines),
-            "router_replicas": len(self.engines),
+            "router_replicas": sum(
+                1 for h in self._health if h != "retired"),
             "router_replicas_draining": sum(self._draining),
             "router_replicas_dead": sum(
                 1 for h in self._health if h == "dead"),
@@ -757,7 +937,7 @@ class Router:
 
 def make_router_server(router: Router, host: str = "127.0.0.1",
                        port: int = 8000, request_timeout: float = 300.0,
-                       tokenizer=None, detokenizer=None):
+                       tokenizer=None, detokenizer=None, fleet=None):
     """HTTP front door over a :class:`Router` — the multi-replica
     counterpart of :func:`~.server.make_server`, running the SAME
     scheduler thread (the router duck-types the engine surface it
@@ -781,13 +961,24 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
     of a disaggregated replica; ``POST /undrain`` ``{"replica": i}`` is
     the explicit inverse (same body shape as /drain, role included);
     ``POST /revive`` ``{"replica": i}`` returns a dead replica to
-    placement after the operator restarts it."""
+    placement after the operator restarts it.
+
+    With a :class:`~.fleet.FleetController` attached (``fleet=`` — pass
+    the controller itself as ``router`` too; it delegates the engine
+    surface): ``GET /fleet`` reports per-replica seats/health plus the
+    control-plane counters and last combined signal; ``POST /scale``
+    ``{"replicas": n}`` is the operator override (bounds apply,
+    hysteresis/cooldown bypassed); ``POST /swap`` ``{"path": p}`` runs a
+    rolling live weight swap from a packed-params checkpoint while the
+    scheduler keeps serving; and ``GET /metrics`` grows the
+    ``clt_fleet_*`` families."""
     import json
 
     from .server import make_server
 
+    engine_like = fleet if fleet is not None else router
     server, sched = make_server(
-        router, host=host, port=port, request_timeout=request_timeout,
+        engine_like, host=host, port=port, request_timeout=request_timeout,
         tokenizer=tokenizer, detokenizer=detokenizer,
     )
     base_handler = server.RequestHandlerClass
@@ -823,13 +1014,16 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                 self._json(200, payload)
             elif self.path == "/metrics":
                 with sched.lock:
-                    body = router.metrics_text().encode()
+                    src = fleet if fleet is not None else router
+                    body = src.metrics_text().encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/fleet" and fleet is not None:
+                self._json(200, fleet.fleet_status())
             else:
                 # /slo and /trace fall through to the single-engine handler
                 # (its _slo_payload/_attached_tracer hooks resolve against
@@ -880,6 +1074,26 @@ def make_router_server(router: Router, host: str = "127.0.0.1",
                         router.revive(i)
                         payload = {"replica": i, "health": router.health(i)}
                     self._json(200, payload)
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/scale" and fleet is not None:
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    self._json(200, fleet.scale_to(int(req["replicas"])))
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/swap" and fleet is not None:
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    # step=False: the scheduler thread keeps stepping the
+                    # fleet while each replica drains — the swap only
+                    # waits and pushes weights
+                    seats = fleet.swap_weights(str(req["path"]), step=False)
+                    self._json(200, {"swapped_seats": seats})
                 except Exception as e:
                     self._json(400, {"error": str(e)})
                 return

@@ -203,6 +203,13 @@ class _Scheduler(threading.Thread):
             with self.lock:
                 busy = self.engine.has_work
             if not busy:
+                # an idle engine may still have control-plane work: a
+                # FleetController scales down / finishes retirements from
+                # its idle_tick (plain engines don't expose the hook)
+                idle_tick = getattr(self.engine, "idle_tick", None)
+                if callable(idle_tick):
+                    with self.lock:
+                        idle_tick()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

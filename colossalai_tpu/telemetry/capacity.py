@@ -42,8 +42,12 @@ storm" flag rises when compiles in the current interval reach
 recompiling means the shape-bucket plan is broken.
 
 :class:`ScalingSignal` is the recommendation the fleet view serves —
-``scale_up | scale_down | hold`` with human-readable reasons. This PR is
-observational: nothing acts on it yet.
+``scale_up | scale_down | hold`` with human-readable reasons. The
+``FleetController`` (``inference/fleet.py``) closes the loop: per-replica
+signals cross the control channel as dicts (:meth:`ScalingSignal.
+as_dict` / :meth:`ScalingSignal.from_dict`), fold through
+:func:`combine_signals`, and drive spawn/retire through its
+hysteresis/cooldown policy.
 """
 
 from __future__ import annotations
@@ -188,13 +192,24 @@ class RecompileSentinel:
 
 @dataclass
 class ScalingSignal:
-    """Observational scaling recommendation — acted on next PR."""
+    """Scaling recommendation — consumed by the FleetController, which
+    spawns/retires replica processes off the combined fleet signal."""
 
     action: str  # "scale_up" | "scale_down" | "hold"
     reasons: Tuple[str, ...] = field(default_factory=tuple)
 
     def as_dict(self) -> Dict[str, object]:
         return {"action": self.action, "reasons": list(self.reasons)}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, object]) -> "ScalingSignal":
+        """Inverse of :meth:`as_dict` — the fleet control channel ships
+        per-replica signals as JSON dicts and the controller folds the
+        reconstructed signals through :func:`combine_signals`."""
+        action = str(d.get("action", "hold"))
+        if action not in ("scale_up", "scale_down", "hold"):
+            raise ValueError(f"unknown scaling action {action!r}")
+        return cls(action, tuple(str(r) for r in d.get("reasons", ())))
 
 
 def combine_signals(per_replica: Mapping[str, ScalingSignal]) -> ScalingSignal:

@@ -214,6 +214,44 @@ def test_fault_names_match_grammar_and_collide_with_nothing():
     assert not names & _capacity_names()
 
 
+def _fleet_names():
+    """The ``clt_fleet_*`` catalog a FleetController's ``/metrics``
+    adds — counter and gauge names are static module constants, so no
+    replica ever spawns here."""
+    from colossalai_tpu.inference.fleet import (
+        FLEET_COUNTER_NAMES,
+        FLEET_GAUGE_NAMES,
+    )
+
+    return _family_names(prometheus_exposition(
+        {n: 0 for n in FLEET_COUNTER_NAMES},
+        {n: 0 for n in FLEET_GAUGE_NAMES}, {}, prefix="clt"))
+
+
+def test_fleet_names_match_grammar_and_collide_with_nothing():
+    names = _fleet_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+        assert name.startswith("clt_fleet_"), name
+    assert {"clt_fleet_replicas_spawned", "clt_fleet_replicas_retired",
+            "clt_fleet_replicas_replaced", "clt_fleet_spawn_failures",
+            "clt_fleet_weight_swaps", "clt_fleet_scale_up_total",
+            "clt_fleet_scale_down_total",
+            "clt_fleet_scale_suppressed_hysteresis",
+            "clt_fleet_scale_suppressed_cooldown",
+            "clt_fleet_scale_suppressed_bounds",
+            "clt_fleet_scale_suppressed_inflight",
+            "clt_fleet_control_rpcs", "clt_fleet_control_failures",
+            "clt_fleet_child_force_kills", "clt_fleet_chip_seconds",
+            "clt_fleet_replicas_active",
+            "clt_fleet_replicas_retiring"} <= names
+    assert not names & _serving_names()
+    assert not names & _training_names()
+    assert not names & _slo_names()
+    assert not names & _capacity_names()
+    assert not names & _fault_names()
+
+
 def test_every_histogram_family_exports_dropped_total():
     """``Histogram.dropped`` (non-finite refusals) renders as a
     ``<family>_dropped_total`` counter family of its own — for every
@@ -297,7 +335,8 @@ def test_span_names_match_grammar_over_engine_smoke():
                "decode_megastep", "spec_megastep", "prefix_cache_hit",
                "prefix_cache_evict", "page_refund", "router.place",
                "router.sync", "shed", "preempt", "resume", "kv_transfer",
-               "kv_wire", "replica_dead", "failover", "kv_retry"}
+               "kv_wire", "replica_dead", "failover", "kv_retry",
+               "fleet.spawn", "fleet.retire", "weight_swap"}
     assert catalog == set(SPAN_CATALOG)
     assert names <= catalog, names - catalog
 

@@ -170,7 +170,7 @@ def test_injector_modes_and_validation():
         f.check("nope")
     assert set(FAULT_SEAMS) == {"replica_step", "kv_transfer", "kv_wire",
                                 "handoff_pump", "megastep_dispatch",
-                                "http_generate"}
+                                "http_generate", "fleet_control"}
     assert set(FAULT_MODES) == {"raise", "hang", "corrupt", "drop"}
 
 

@@ -1,0 +1,313 @@
+"""FleetController integration tests — thread backend, real wire.
+
+The thread backend runs the EXACT control-plane code paths of the
+process backend — the same length-prefixed socket protocol, the same
+``RemoteReplica`` proxies, the same spawn/warm/undrain and
+drain/evacuate/reap lifecycles — minus fork/exec, so these tests stay
+in the tier-1 budget. The real-process variants (isolation, orphan
+reaping, cross-process KV handoff) live in ``test_fleet_process.py``
+behind the ``slow`` marker.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference.engine import GenerationConfig
+from colossalai_tpu.inference.fault import FaultInjector
+from colossalai_tpu.inference.fleet import (
+    AutoscalePolicy,
+    FleetController,
+    FleetWireError,
+    ReplicaSpec,
+    load_params,
+    pack_params,
+    recv_frame,
+    save_params,
+    send_frame,
+    tiny_llama_engine,
+    tiny_llama_params,
+    unpack_params,
+)
+from colossalai_tpu.inference.router import make_router_server
+from colossalai_tpu.telemetry.capacity import ScalingSignal
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+GEN = GenerationConfig(max_new_tokens=8)
+SPEC = ReplicaSpec(warmup_new_tokens=2)
+
+
+# ============================================================= the wire
+def test_wire_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"op": "step", "n": 3}, b"\x00\x01raw")
+        header, payload = recv_frame(b, timeout=5.0)
+        assert header == {"op": "step", "n": 3}
+        assert payload == b"\x00\x01raw"
+        # payload-free frames are the common case on the control channel
+        send_frame(a, {"op": "stats"})
+        header, payload = recv_frame(b, timeout=5.0)
+        assert header == {"op": "stats"} and payload == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_eof_mid_frame_is_wire_error():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x08\x00")  # 2 of the 8 length-prefix bytes
+        a.close()
+        with pytest.raises(FleetWireError, match="mid-frame"):
+            recv_frame(b, timeout=5.0)
+    finally:
+        b.close()
+
+
+def test_wire_corrupt_length_prefix_rejected():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<II", (1 << 31) + 1, 0))
+        with pytest.raises(FleetWireError, match="corrupt length"):
+            recv_frame(b, timeout=5.0)
+    finally:
+        a.close()
+        b.close()
+
+
+# ========================================================= params codec
+def _leaves(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaves(tree[k], f"{prefix}/{k}")
+    else:
+        yield prefix, np.asarray(tree)
+
+
+def test_params_codec_roundtrip(tmp_path):
+    tree = tiny_llama_params(seed=3)
+    back = unpack_params(pack_params(tree))
+    want, got = dict(_leaves(tree)), dict(_leaves(back))
+    assert set(want) == set(got)
+    for key in want:
+        assert want[key].dtype == got[key].dtype, key
+        assert want[key].shape == got[key].shape, key
+        np.testing.assert_array_equal(np.asarray(want[key]), got[key])
+    # the checkpoint-file form FleetController.swap_weights takes by path
+    path = tmp_path / "weights.ckpt"
+    save_params(str(path), tree)
+    reloaded = dict(_leaves(load_params(str(path))))
+    for key in want:
+        np.testing.assert_array_equal(np.asarray(want[key]), reloaded[key])
+
+
+def test_params_codec_crc_guards_corruption():
+    data = bytearray(pack_params({"w": np.arange(16, dtype=np.float32)}))
+    data[-1] ^= 0xFF  # flip one body byte
+    with pytest.raises(FleetWireError, match="crc32"):
+        unpack_params(bytes(data))
+
+
+# ====================================================== controller fleet
+@pytest.fixture(scope="module")
+def ref_out():
+    """Greedy output of a lone engine built from the fleet's weights —
+    the parity oracle for every routed/swapped/failed-over request."""
+    eng = tiny_llama_engine()
+    return eng.generate([list(PROMPT)], GEN)[0]
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    fc = FleetController(SPEC, min_replicas=2, max_replicas=3,
+                         backend="thread")
+    yield fc
+    fc.close()
+
+
+def test_fleet_generate_matches_single_engine(fleet, ref_out):
+    outs = fleet.generate([list(PROMPT), list(PROMPT)], GEN)
+    assert outs == [ref_out, ref_out]
+
+
+def test_fleet_status_and_metrics(fleet):
+    st = fleet.fleet_status()
+    assert st["backend"] == "thread"
+    assert st["n_active"] == 2
+    assert sorted(r["seat"] for r in st["replicas"]) == [0, 1]
+    assert all(r["health"] == "healthy" for r in st["replicas"])
+    assert st["counters"]["fleet_replicas_spawned"] == 2
+    text = fleet.metrics_text()
+    # fleet families ride the SAME exposition as the router's
+    assert "clt_fleet_replicas_spawned 2" in text
+    assert "clt_fleet_replicas_active 2" in text
+    assert "clt_router_requests_routed" in text
+
+
+def test_scale_to_current_size_is_noop(fleet):
+    assert fleet.scale_to(2) == {"target": 2, "spawning": 0, "retiring": 0}
+
+
+def test_live_swap_same_weights_token_identical(fleet, ref_out):
+    seats = fleet.swap_weights(tiny_llama_params(seed=0))
+    assert sorted(seats) == [0, 1]
+    assert fleet.counters["fleet_weight_swaps"] >= 2
+    assert fleet.generate([list(PROMPT)], GEN)[0] == ref_out
+
+
+def test_swap_checkpoint_path_changes_and_restores(fleet, ref_out,
+                                                   tmp_path):
+    path = tmp_path / "seed7.ckpt"
+    save_params(str(path), tiny_llama_params(seed=7))
+    assert sorted(fleet.swap_weights(str(path))) == [0, 1]
+    assert fleet.generate([list(PROMPT)], GEN)[0] != ref_out
+    # roll back: a swap is just another swap
+    fleet.swap_weights(tiny_llama_params(seed=0))
+    assert fleet.generate([list(PROMPT)], GEN)[0] == ref_out
+
+
+def test_swap_with_inflight_work_drops_nothing(fleet, ref_out):
+    """The rolling swap's contract: requests in flight when the swap
+    starts drain to siblings and finish normally — zero drops. The swap
+    thread runs ``step=False`` (the HTTP-scheduler shape) while this
+    loop keeps stepping the fleet."""
+    gen = GenerationConfig(max_new_tokens=16)
+    rids = [fleet.router.add_request(list(PROMPT), gen) for _ in range(3)]
+    seats, done = [], {}
+    th = threading.Thread(
+        target=lambda: seats.extend(
+            fleet.swap_weights(tiny_llama_params(seed=0), step=False)),
+        daemon=True)
+    th.start()
+    deadline = time.monotonic() + 120
+    while (th.is_alive() or not set(rids) <= set(done)) \
+            and time.monotonic() < deadline:
+        for req in fleet.step():
+            done[req.request_id] = req
+    th.join(5)
+    assert sorted(seats) == [0, 1]
+    for rid in rids:
+        assert rid in done, "request dropped during live swap"
+        assert done[rid].finish_reason in ("eos", "length", "stop")
+    assert fleet.generate([list(PROMPT)], GEN)[0] == ref_out
+
+
+def test_http_fleet_endpoints(fleet, ref_out):
+    server, sched = make_router_server(fleet.router, port=0, fleet=fleet)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    try:
+        out = post("/generate", {"prompt_ids": PROMPT, "max_new_tokens": 8})
+        assert out["output_ids"] == ref_out
+        with urllib.request.urlopen(f"{base}/fleet", timeout=30) as r:
+            st = json.loads(r.read())
+        assert st["backend"] == "thread" and st["n_active"] == 2
+        assert st["signal"]["action"] in ("hold", "scale_up", "scale_down")
+        # /scale at the current size actuates nothing but answers
+        assert post("/scale", {"replicas": 2}) == \
+               {"target": 2, "spawning": 0, "retiring": 0}
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "clt_fleet_replicas_active 2" in text
+        assert "clt_fleet_weight_swaps" in text
+    finally:
+        server.shutdown()
+        sched.stop()
+
+
+# =================================================== signal-driven scale
+def test_signal_scale_up_down_with_suppression():
+    """Close the loop without a real capacity monitor: a stubbed signal
+    poll drives scale_up (spawn → warm → undrain), cooldown suppression,
+    then scale_down (drain → retire) and the min-replicas floor."""
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                             cooldown_s=60.0, up_consecutive=1,
+                             down_consecutive=1)
+    fc = FleetController(SPEC, min_replicas=1, max_replicas=3,
+                         backend="thread", autoscale=policy)
+    sig = {"action": "hold"}
+    fc._poll_signals = lambda now: setattr(
+        fc, "last_signal", ScalingSignal(sig["action"], ("test",)))
+    try:
+        assert fc.n_active == 1
+        sig["action"] = "scale_up"
+        deadline = time.monotonic() + 120
+        while fc.n_active < 2 and time.monotonic() < deadline:
+            fc.idle_tick()
+            time.sleep(0.01)
+        assert fc.n_active == 2
+        assert fc.counters["fleet_scale_up_total"] == 1
+
+        # still under pressure, but inside the cooldown window: held
+        for _ in range(5):
+            fc.idle_tick()
+        assert fc.n_active == 2
+        assert fc.counters["fleet_scale_suppressed_cooldown"] >= 1
+
+        # expire the cooldown and reverse the signal: one replica drains
+        # to retirement...
+        policy._last_action_t = policy._clock() - 120.0
+        sig["action"] = "scale_down"
+        deadline = time.monotonic() + 60
+        while fc.n_active > 1 and time.monotonic() < deadline:
+            fc.idle_tick()
+            time.sleep(0.01)
+        assert fc.n_active == 1
+        assert fc.counters["fleet_scale_down_total"] == 1
+        assert fc.counters["fleet_replicas_retired"] == 1
+
+        # ...and the min-replicas floor holds against further pressure
+        policy._last_action_t = policy._clock() - 120.0
+        for _ in range(5):
+            fc.idle_tick()
+        assert fc.n_active == 1
+        assert fc.counters["fleet_scale_suppressed_bounds"] >= 1
+
+        # the survivor still serves
+        assert fc.generate([list(PROMPT)], GEN)[0] == \
+               tiny_llama_engine().generate([list(PROMPT)], GEN)[0]
+    finally:
+        fc.close()
+
+
+# ================================================== fault-driven replace
+def test_control_fault_kills_replica_and_fleet_replaces_it(ref_out):
+    """An injected ``fleet_control`` raise (times matching the fail
+    threshold) models a crashed child: the Router's health machine marks
+    seat 0 dead, the controller reaps the corpse and spawns a
+    replacement, and serving never returns a wrong token."""
+    fault = FaultInjector()
+    fc = FleetController(SPEC, min_replicas=2, max_replicas=2,
+                         backend="thread", fault=fault, fail_threshold=2,
+                         signal_poll_s=0.05)
+    try:
+        fault.arm("fleet_control", "raise", at=1, times=2, key=0)
+        deadline = time.monotonic() + 120
+        while (fc.counters["fleet_replicas_replaced"] < 1
+               or fc.n_active < 2) and time.monotonic() < deadline:
+            fc.idle_tick()
+            time.sleep(0.01)
+        assert fc.counters["fleet_replicas_replaced"] == 1
+        assert fc.counters["fleet_control_failures"] >= 2
+        assert fc.n_active == 2
+        # the replacement fleet serves token-identically
+        assert fc.generate([list(PROMPT), list(PROMPT)], GEN) == \
+               [ref_out, ref_out]
+    finally:
+        fc.close()

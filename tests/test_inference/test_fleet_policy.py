@@ -1,0 +1,137 @@
+"""AutoscalePolicy unit tests — pure decision logic on a fake clock.
+
+No engines, no processes, no sockets: the policy is a function of
+(signal action, fleet size, in-flight load, time), and every gate —
+bounds, hysteresis, cooldown, the in-flight scale-down floor — must be
+testable by stepping a fake clock. The FleetController integration
+tests (test_fleet.py) assume each of these gates works in isolation.
+"""
+
+import pytest
+
+from colossalai_tpu.inference.fleet import AutoscalePolicy
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_policy(clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("up_consecutive", 2)
+    kw.setdefault("down_consecutive", 3)
+    p = AutoscalePolicy(**kw)
+    p._clock = clock
+    return p
+
+
+def test_scale_up_needs_consecutive_signals():
+    clock = FakeClock()
+    p = make_policy(clock, up_consecutive=2)
+    d1 = p.decide("scale_up", n_replicas=1)
+    assert d1.action == "hold" and d1.reason == "hysteresis"
+    d2 = p.decide("scale_up", n_replicas=1)
+    assert d2.action == "spawn"
+
+
+def test_hold_resets_the_streak():
+    clock = FakeClock()
+    p = make_policy(clock, up_consecutive=2)
+    p.decide("scale_up", n_replicas=1)
+    p.decide("hold", n_replicas=1)
+    d = p.decide("scale_up", n_replicas=1)
+    assert d.action == "hold" and d.reason == "hysteresis"
+
+
+def test_cooldown_blocks_back_to_back_actions():
+    clock = FakeClock()
+    p = make_policy(clock, up_consecutive=1, cooldown_s=10.0)
+    assert p.decide("scale_up", n_replicas=1).action == "spawn"
+    clock.advance(5.0)
+    d = p.decide("scale_up", n_replicas=2)
+    assert d.action == "hold" and d.reason == "cooldown"
+    clock.advance(6.0)  # past the window
+    assert p.decide("scale_up", n_replicas=2).action == "spawn"
+
+
+def test_max_bound_suppresses_scale_up():
+    clock = FakeClock()
+    p = make_policy(clock, up_consecutive=1, max_replicas=2)
+    d = p.decide("scale_up", n_replicas=2)
+    assert d.action == "hold" and d.reason == "max_bound"
+
+
+def test_min_bound_suppresses_scale_down():
+    clock = FakeClock()
+    p = make_policy(clock, down_consecutive=1, min_replicas=2)
+    d = p.decide("scale_down", n_replicas=2)
+    assert d.action == "hold" and d.reason == "min_bound"
+
+
+def test_scale_down_after_consecutive_signals():
+    clock = FakeClock()
+    p = make_policy(clock, down_consecutive=3)
+    assert p.decide("scale_down", n_replicas=3).action == "hold"
+    assert p.decide("scale_down", n_replicas=3).action == "hold"
+    assert p.decide("scale_down", n_replicas=3).action == "retire"
+
+
+def test_inflight_floor_vetoes_scale_down():
+    clock = FakeClock()
+    p = make_policy(clock, down_consecutive=1)
+    # 3 replicas x 4 slots; dropping to 2 leaves 8 seats < 9 in flight
+    d = p.decide("scale_down", n_replicas=3, in_flight=9,
+                 slots_per_replica=4)
+    assert d.action == "hold" and d.reason == "inflight_floor"
+    # 8 in flight fits on the surviving 2 replicas — allowed
+    d = p.decide("scale_down", n_replicas=3, in_flight=8,
+                 slots_per_replica=4)
+    assert d.action == "retire"
+
+
+def test_oscillating_signal_never_scales():
+    """Flap suppression: a signal that alternates up/down every tick
+    must never clear either hysteresis streak."""
+    clock = FakeClock()
+    p = make_policy(clock, up_consecutive=2, down_consecutive=2,
+                    cooldown_s=0.0)
+    actions = []
+    for i in range(20):
+        sig = "scale_up" if i % 2 == 0 else "scale_down"
+        actions.append(p.decide(sig, n_replicas=2).action)
+        clock.advance(1.0)
+    assert all(a == "hold" for a in actions)
+
+
+def test_sustained_pressure_scales_through_cooldown():
+    """A genuinely sustained scale_up signal walks the fleet to max,
+    one action per cooldown window."""
+    clock = FakeClock()
+    p = make_policy(clock, up_consecutive=1, cooldown_s=10.0,
+                    max_replicas=4)
+    n = 1
+    for _ in range(100):
+        if p.decide("scale_up", n_replicas=n).action == "spawn":
+            n += 1
+        clock.advance(1.0)
+        if n == 4:
+            break
+    assert n == 4
+    # three actions need two full cooldown windows between them
+    assert clock.t >= 20.0
+
+
+def test_bounds_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)

@@ -1,0 +1,150 @@
+"""Real-process fleet tests — isolation, orphan reaping, KV handoff.
+
+Everything here forks actual OS processes (spawn-context children that
+build their own JAX runtime), so the whole module rides the ``slow``
+marker and stays out of the tier-1 budget. The control-plane logic
+itself is covered by the thread-backend tests in ``test_fleet.py`` —
+this file proves the parts threads cannot: process isolation, the
+child-hygiene guarantees (SIGKILLed controllers leak no children), and
+a cross-process KV-page handoff with end-to-end checksums.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import zlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference.engine import GenerationConfig
+from colossalai_tpu.inference.fleet import (
+    FleetController,
+    ReplicaSpec,
+    tiny_llama_engine,
+)
+
+pytestmark = pytest.mark.slow
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+GEN = GenerationConfig(max_new_tokens=8)
+SPEC = ReplicaSpec(warmup_new_tokens=2)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def test_process_fleet_generate_parity():
+    """Two spawn-context children, each with its own JAX runtime, serve
+    token-identically to a lone in-process engine."""
+    ref = tiny_llama_engine().generate([list(PROMPT)], GEN)[0]
+    with FleetController(SPEC, min_replicas=2, max_replicas=2,
+                         backend="process") as fc:
+        pids = [h.proc.pid for h in fc._handles.values()]
+        assert len(set(pids)) == 2
+        assert all(pid != os.getpid() for pid in pids)
+        outs = fc.generate([list(PROMPT), list(PROMPT)], GEN)
+        assert outs == [ref, ref]
+    # the context-manager close SIGTERM-reaps both children
+    deadline = time.monotonic() + 30
+    while any(_pid_alive(p) for p in pids) and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert not any(_pid_alive(p) for p in pids)
+
+
+def test_sigkilled_controller_leaks_no_children(tmp_path):
+    """The orphan-reap regression: SIGKILL the controller process (no
+    atexit, no SIGTERM handler runs) and the replica children must
+    still exit via their parent-pid watch threads."""
+    pid_file = tmp_path / "pids.json"
+    script = f"""
+import json, time
+from colossalai_tpu.inference.fleet import FleetController, ReplicaSpec
+
+fc = FleetController(ReplicaSpec(warmup_prompts=()), min_replicas=1,
+                     max_replicas=1, backend="process")
+pids = [h.proc.pid for h in fc._handles.values()]
+with open({str(pid_file)!r}, "w") as f:
+    json.dump(pids, f)
+while True:
+    time.sleep(1)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    controller = subprocess.Popen([sys.executable, "-c", script], env=env)
+    try:
+        deadline = time.monotonic() + 300
+        while not pid_file.exists() and time.monotonic() < deadline:
+            assert controller.poll() is None, "controller died early"
+            time.sleep(0.2)
+        child_pids = json.loads(pid_file.read_text())
+        assert child_pids and all(_pid_alive(p) for p in child_pids)
+        controller.kill()  # SIGKILL: no cleanup code runs parent-side
+        controller.wait(30)
+        # the children notice the reparenting (getppid watch, 0.25s
+        # period) and os._exit on their own
+        deadline = time.monotonic() + 30
+        while any(_pid_alive(p) for p in child_pids) \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert not any(_pid_alive(p) for p in child_pids), \
+            "SIGKILLed controller leaked replica children"
+    finally:
+        if controller.poll() is None:
+            controller.kill()
+
+
+def test_cross_process_kv_handoff_checksum():
+    """Disagg pairing end to end: the child builds a destination pool
+    and advertises a SocketKVReceiver endpoint over the control channel,
+    a SocketKVDialer in THIS process streams pages into it, and the
+    child's checksum of the landed blocks matches the source bytes."""
+    import jax
+
+    from colossalai_tpu.inference.kv_cache import init_paged_cache
+    from colossalai_tpu.inference.kv_wire import SocketKVDialer
+
+    geometry = {"layers": 2, "kv_heads": 2, "head_dim": 8,
+                "num_blocks": 8, "block_size": 16}
+    with FleetController(SPEC, min_replicas=1, max_replicas=1,
+                         backend="process") as fc:
+        eng = fc.router.engines[0]
+        reply, _ = eng.call("kv_endpoint",
+                            {"pool": "kv", "geometry": geometry})
+        assert reply["pool"] == "kv"
+
+        cfg = SimpleNamespace(num_hidden_layers=geometry["layers"],
+                              num_key_value_heads=geometry["kv_heads"],
+                              head_dim_=geometry["head_dim"])
+        src = init_paged_cache(cfg, geometry["num_blocks"],
+                               geometry["block_size"])
+        key = jax.random.PRNGKey(0)
+        src = src._replace(
+            k=jax.random.normal(key, src.k.shape, src.k.dtype),
+            v=jax.random.normal(jax.random.fold_in(key, 1),
+                                src.v.shape, src.v.dtype))
+        src_blocks, dst_blocks = [1, 3, 5], [2, 4, 6]
+
+        with SocketKVDialer((reply["host"], reply["port"])) as dialer:
+            ack = dialer.transfer_remote(src, src_blocks, dst_blocks,
+                                         pool="kv")
+            stats = dialer.pop_wire_stats()
+        assert ack["ok"] is True
+        assert stats["frames"] >= 1 and stats["bytes"] > 0
+
+        idx = np.asarray(src_blocks, np.int32)
+        want = zlib.crc32(
+            np.ascontiguousarray(np.asarray(src.k)[:, idx]).tobytes())
+        want = zlib.crc32(
+            np.ascontiguousarray(np.asarray(src.v)[:, idx]).tobytes(), want)
+        reply, _ = eng.call("kv_checksum",
+                            {"pool": "kv", "blocks": dst_blocks})
+        assert reply["crc"] == int(want & 0xFFFFFFFF)

@@ -484,3 +484,106 @@ def test_kv_wire_drop_fault_breaks_sequence_then_retries(parts):
         assert dis.stats.requests_error == 0
     finally:
         dis.close()
+
+
+# ------------------------------------------------- split listener/dialer
+def test_split_receiver_dialer_byte_identical(parts):
+    """The PR-18 split: destination pool owned by a SocketKVReceiver,
+    source streamed at it by a SocketKVDialer holding nothing but the
+    ``(host, port)`` advertisement — the cross-process disagg shape,
+    exercised in-process. Pages land byte-identical, the owner sees
+    every rebind through ``on_update``, and one connection carries
+    back-to-back transfers."""
+    from colossalai_tpu.inference.kv_wire import (
+        SocketKVDialer,
+        SocketKVReceiver,
+    )
+
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    rebinds = []
+    with SocketKVReceiver() as recv:
+        recv.register_pool("kv", dst, on_update=rebinds.append)
+        host, port = recv.advertise()
+        with SocketKVDialer((host, port)) as dialer:
+            # dst block 0 is the null page (scatter padding aims at it),
+            # so real destinations start at 1 — same convention as the
+            # combined transport
+            ack = dialer.transfer_remote(src, [0, 2, 4], [1, 3, 2],
+                                         pool="kv")
+            assert ack["ok"] is True
+            assert ack["frames"] == cfg.num_hidden_layers
+            landed = recv.pool("kv")
+            np.testing.assert_array_equal(
+                np.asarray(src.k)[:, [0, 2, 4]],
+                np.asarray(landed.k)[:, [1, 3, 2]])
+            np.testing.assert_array_equal(
+                np.asarray(src.v)[:, [0, 2, 4]],
+                np.asarray(landed.v)[:, [1, 3, 2]])
+            # on_update fired once per landed frame, ending on the final
+            # pool object the owner must adopt
+            assert len(rebinds) == cfg.num_hidden_layers
+            assert rebinds[-1] is landed
+            stats = dialer.pop_wire_stats()
+            assert stats["frames"] == cfg.num_hidden_layers
+            assert stats["bytes"] > 0 and stats["reconnects"] == 0
+
+            # the SAME connection carries the next transfer
+            ack2 = dialer.transfer_remote(src, [1], [4], pool="kv")
+            assert ack2["ok"] is True
+            np.testing.assert_array_equal(
+                np.asarray(src.k)[:, 1],
+                np.asarray(recv.pool("kv").k)[:, 4])
+            assert dialer.pop_wire_stats()["reconnects"] == 0
+    assert recv.transfers_completed == 2
+
+
+def test_split_dialer_unregistered_pool_is_nacked(parts):
+    """A frame naming a pool the receiver never registered is nacked
+    (the dialer surfaces the receiver's error, not a hang) and the
+    connection redials clean for the next, correctly-named transfer."""
+    from colossalai_tpu.inference.kv_wire import (
+        SocketKVDialer,
+        SocketKVReceiver,
+    )
+
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    with SocketKVReceiver() as recv:
+        recv.register_pool("kv", dst)
+        retry = RetryPolicy(max_retries=0, base_delay_s=0.0,
+                            max_delay_s=0.0, jitter=0.0)
+        # one frame for the whole transfer: the nack comes back before
+        # any follow-up send could trip EPIPE, so the receiver's error
+        # text survives deterministically
+        with SocketKVDialer(recv.advertise(), retry=retry,
+                            layers_per_frame=cfg.num_hidden_layers
+                            ) as dialer:
+            with pytest.raises(ValueError, match="unregistered pool"):
+                dialer.transfer_remote(src, [0], [1], pool="nope")
+            # recovery: redial + a registered name goes through
+            ack = dialer.transfer_remote(src, [0], [1], pool="kv")
+            assert ack["ok"] is True
+            assert dialer.pop_wire_stats()["reconnects"] >= 1
+
+
+def test_split_drop_fault_trips_sequence_check(parts):
+    """kv_wire drop fault on the dialer: the receiver's in-order frame
+    contract trips with the distinct dropped-in-transit error."""
+    from colossalai_tpu.inference.kv_wire import (
+        SocketKVDialer,
+        SocketKVReceiver,
+    )
+
+    cfg, _ = parts
+    src, dst = _pools(cfg, jnp.bfloat16)
+    fault = FaultInjector(seed=0)
+    fault.arm("kv_wire", "drop", at=1, times=1)
+    retry = RetryPolicy(max_retries=0, base_delay_s=0.0, max_delay_s=0.0,
+                        jitter=0.0)
+    with SocketKVReceiver() as recv:
+        recv.register_pool("kv", dst)
+        with SocketKVDialer(recv.advertise(), fault=fault,
+                            retry=retry) as dialer:
+            with pytest.raises(ValueError, match="dropped in transit"):
+                dialer.transfer_remote(src, [0, 1], [0, 1], pool="kv")

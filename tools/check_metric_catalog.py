@@ -5,9 +5,9 @@ Docs drift silently: a renamed gauge or a new span keeps working while
 the documentation describes a dashboard that no longer exists. This tool
 renders every Prometheus catalog the code can emit (serving ``clt_*``,
 SLO ``clt_slo_*``, router ``clt_router_*``, training ``clt_train_*``,
-capacity ``clt_capacity_*``, fault ``clt_fault_*``) the same way the HTTP endpoints render
-them, parses the metric names and span table out of the docs, and fails
-on any mismatch:
+capacity ``clt_capacity_*``, fault ``clt_fault_*``, fleet
+``clt_fleet_*``) the same way the HTTP endpoints render them, parses the
+metric names and span table out of the docs, and fails on any mismatch:
 
 - every ``clt_*`` family the docs mention must be emitted by some
   renderer and obey the Prometheus grammar;
@@ -16,6 +16,9 @@ on any mismatch:
 - every ``clt_fault_*`` family and the router failover counters must be
   documented too — a chaos drill is exactly when an undocumented
   counter hurts most;
+- every ``clt_fleet_*`` family the FleetController emits must be
+  documented, and vice versa — autoscaling decisions are audited
+  through these counters;
 - the span table in the docs must equal ``SPAN_CATALOG`` exactly —
   extend both or neither;
 - every histogram family must export its ``_dropped_total`` companion.
@@ -166,6 +169,24 @@ def fault_families():
     return names
 
 
+def fleet_families():
+    """Every ``clt_fleet_*`` family a FleetController emits. The counter
+    and gauge names are static module constants — render them through
+    the same exposition path the ``/metrics`` endpoint uses, without
+    spawning any replicas."""
+    from colossalai_tpu.inference.fleet import (
+        FLEET_COUNTER_NAMES,
+        FLEET_GAUGE_NAMES,
+    )
+    from colossalai_tpu.telemetry import prometheus_exposition
+
+    names = _family_names(prometheus_exposition(
+        {n: 0 for n in FLEET_COUNTER_NAMES},
+        {n: 0 for n in FLEET_GAUGE_NAMES}, {}, prefix="clt"))
+    assert all(n.startswith("clt_fleet_") for n in names), names
+    return names
+
+
 def capacity_families():
     """Every ``clt_capacity_*`` family a fully-lit monitor emits — all
     conditional gauges (goodput, KV, queue, headroom, HBM) forced on."""
@@ -198,6 +219,7 @@ def run_checks(doc_text=None):
         "router": router_families(),
         "capacity": capacity_families(),
         "fault": fault_families(),
+        "fleet": fleet_families(),
     }
     known = set().union(*catalogs.values())
 
@@ -236,11 +258,20 @@ def run_checks(doc_text=None):
                               "clt_router_replica_revivals",
                               "clt_router_requests_failed_over",
                               "clt_router_watchdog_trips",
-                              "clt_router_replicas_dead")}
+                              "clt_router_replicas_dead",
+                              "clt_router_replicas_added",
+                              "clt_router_replicas_retired")}
     for name in sorted((catalogs["fault"] | strict_router) - documented):
         failures.append(
             f"code emits {name} but docs/observability.md does not "
             "document it (extend the fault-tolerance tables)")
+
+    # the fleet family is strict in both directions: every counter and
+    # gauge backing an autoscaling decision must have a doc row
+    for name in sorted(catalogs["fleet"] - documented):
+        failures.append(
+            f"code emits {name} but docs/observability.md does not "
+            "document it (extend the clt_fleet_* tables)")
 
     doc_spans = doc_span_names(text)
     code_spans = set(SPAN_CATALOG)
