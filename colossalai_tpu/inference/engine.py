@@ -70,6 +70,7 @@ from colossalai_tpu.kernel import tuning
 
 from . import weight_quant
 from .kv_cache import BlockAllocator, OutOfBlocks, PagedKVCache, SequenceTable, init_paged_cache
+from .lora_serving import AdapterPool, LoraServing, OutOfAdapterSlots
 from .overload import OverloadConfig, OverloadController, retry_after_hint
 from .prefix_cache import PrefixCache
 from .telemetry import NullTelemetry, SLOTracker, Telemetry, Tracer
@@ -157,6 +158,12 @@ class Request:
     #: client should wait before retrying, derived from the live SLO
     #: window at shed time — surfaced as the 503 Retry-After header
     retry_after: Optional[float] = None
+    #: multi-tenant LoRA serving (lora_serving=): the registered adapter
+    #: this request decodes through (None = base model)
+    adapter_id: Optional[str] = None
+    #: the AdapterPool slot the admission acquire pinned (doubles as the
+    #: "pin held" marker: release/preempt unpin iff it is not None)
+    adapter_slot: Optional[int] = None
 
     @property
     def n_samples(self) -> int:
@@ -282,6 +289,22 @@ class EngineStats:
     #: the transfer's last frame — nonzero means streaming really
     #: pipelines instead of degenerating to blocking send-then-scatter
     kvwire_overlap_frames: int = 0
+    # ---- multi-tenant LoRA serving (lora_serving=): AdapterPool cache-
+    # tier accounting, mirrored from the pool each gauge refresh (host
+    # ints — device traffic is invariant, like the KV gauges above)
+    #: admission acquires that found the adapter resident (pin bump only)
+    lora_hits: int = 0
+    #: acquires that faulted — host→device factor upload, billed to
+    #: admission (the lora_upload span), never to decode ITL
+    lora_misses: int = 0
+    #: unpinned resident adapters LRU-evicted to make room for a fault
+    #: (forced fleet evict_adapter evictions count here too)
+    lora_evictions: int = 0
+    #: adapters currently resident in device slots (pinned or warm)
+    lora_resident_adapters: int = 0
+    #: bytes the paged adapter slabs keep resident (static for the
+    #: engine's lifetime: slots × every targeted projection's A/B pair)
+    lora_adapter_pool_bytes: int = 0
 
     @property
     def spec_acceptance_rate(self) -> float:
@@ -416,6 +439,7 @@ class LLMEngine:
         weight_dtype: str = "bf16",
         overlap_decode: Union[bool, int, None] = None,
         sp_prefill: Union[bool, int, None] = None,
+        lora_serving: Optional["LoraServing"] = None,
         fault=None,
     ):
         self.config = config
@@ -856,6 +880,41 @@ class LLMEngine:
         # full copy of the weights for the engine's lifetime
         self.params = None if self._pp else params
         self.cache = cache
+        # ---- multi-tenant LoRA serving (lora_serving=LoraServing(...)):
+        # a paged device-resident adapter cache (lora_serving.AdapterPool)
+        # whose per-slot (A, B) factor slabs the decode/spec megasteps
+        # close over; each row gathers its adapter through the batched
+        # lora_matmul epilogue, so a mixed batch of N tenants runs ONE
+        # compiled megastep. Composes with chunked prefill, spec decode
+        # (target-side only), int8/fp8 KV, int8 weights, overlap_decode,
+        # and GSPMD tp meshes (slabs replicate via _put_rep). Gated off
+        # pp (the relay's scan carries no slab xs), sp_prefill (the ring
+        # shards query rows the epilogue would re-gather), and MoE.
+        self.lora: Optional[AdapterPool] = None
+        if lora_serving is not None:
+            if not isinstance(lora_serving, LoraServing):
+                raise ValueError(
+                    "lora_serving= takes a lora_serving.LoraServing config, "
+                    f"got {type(lora_serving).__name__}"
+                )
+            if self._pp:
+                raise NotImplementedError(
+                    "lora_serving does not compose with pipeline-parallel "
+                    "decode — the pp relay's layer scan carries no adapter "
+                    "slabs; use a tp-only mesh"
+                )
+            if self._sp_threshold is not None:
+                raise NotImplementedError(
+                    "lora_serving does not compose with sp_prefill — the "
+                    "sequence-parallel ring shards the query rows the "
+                    "adapter epilogue gathers per sequence"
+                )
+            if self._moe:
+                raise NotImplementedError(
+                    "lora_serving does not compose with MoE serving — the "
+                    "expert MLP path has no adapter epilogue"
+                )
+            self.lora = AdapterPool(config, lora_serving, put=self._put_rep)
         self._rng = jax.random.PRNGKey(seed)
         self._ids = itertools.count()
         self.waiting: List[Request] = []
@@ -935,6 +994,9 @@ class LLMEngine:
         self._dev_topp = self._put_rep(np.ones((mb,), np.float32))
         self._dev_sample = self._put_rep(np.zeros((mb,), bool))
         self._dev_eos = self._put_rep(np.full((mb,), -1, np.int32))
+        #: per-slot AdapterPool slot index (0 = null adapter / base model)
+        #: — the gather index the lora_matmul epilogue reads per row
+        self._dev_adapter_slots = self._put_rep(np.zeros((mb,), np.int32))
 
     def _put(self, x, spec):
         """Place ``x`` on the engine mesh. Single-process: a device_put.
@@ -1049,6 +1111,35 @@ class LLMEngine:
         self.sync_params(params)
         return len(jax.tree.leaves(params))
 
+    def register_adapter(self, adapter_id: str, lora,
+                         alpha: Optional[float] = None) -> None:
+        """Register a LoRA adapter for multi-tenant serving (needs
+        ``lora_serving=``). Host-side only: the factors upload to a device
+        slot on the first ``adapter_id=`` admission (a pool FAULT), so
+        registration never touches in-flight decodes. ``lora`` is a
+        ``peft.init_lora_params``-shaped tree or a prebuilt
+        ``{proj: (A, B)}`` factor dict; ``alpha`` overrides the pool
+        default scaling numerator. Re-registering a RESIDENT id hot-
+        updates its slot in place (the fleet ``load_adapter`` path)."""
+        if self.lora is None:
+            raise RuntimeError(
+                "register_adapter needs lora_serving= at engine "
+                "construction"
+            )
+        self.lora.register(adapter_id, lora, alpha=alpha)
+
+    def evict_adapter(self, adapter_id: str) -> bool:
+        """Force-evict a resident, UNPINNED adapter from its device slot
+        (the fleet ``evict_adapter`` control op); its registration stays,
+        so the next request faults it back in. Returns False — changing
+        nothing — while live sequences pin it, or when it is not
+        resident."""
+        if self.lora is None:
+            raise RuntimeError(
+                "evict_adapter needs lora_serving= at engine construction"
+            )
+        return self.lora.evict(adapter_id)
+
     def seed_ids(self, start: int, stride: int) -> None:
         """Re-seed the request-id counter to mint ``start, start+stride,
         ...`` — the Router's ``rid % stride`` ownership contract. The
@@ -1060,6 +1151,7 @@ class LLMEngine:
     def add_request(
         self, prompt_ids, gen: Optional[GenerationConfig] = None,
         n_samples: int = 1, priority: int = 0,
+        adapter_id: Optional[str] = None,
     ) -> Union[int, List[int]]:
         """Queue a prompt. ``n_samples > 1`` queues a GROUP (GRPO/best-of-n
         rollouts): the prompt is prefilled ONCE, full prompt pages are
@@ -1074,6 +1166,13 @@ class LLMEngine:
         cache on, the prompt walks the radix tree here and the matched
         path is pinned; the match is refreshed at admission so prefixes
         donated while the request waited still count.
+
+        ``adapter_id`` (lora_serving= engines) decodes this request
+        through a registered LoRA adapter: admission pins its pool slot
+        (uploading the factors on a fault) and every forward applies its
+        delta through the batched gather epilogue. Adapter requests skip
+        the prefix cache both ways — adapter-flavored KV must never be
+        shared with another tenant or the base model.
         """
         prompt_ids = list(map(int, prompt_ids))
         if not prompt_ids:
@@ -1085,8 +1184,23 @@ class LLMEngine:
                 f"position — truncate the prompt or build the engine with "
                 f"a larger max_seq_len"
             )
+        if adapter_id is not None:
+            if self.lora is None:
+                raise ValueError(
+                    "adapter_id= needs lora_serving= at engine construction"
+                )
+            if n_samples > 1:
+                raise ValueError(
+                    "grouped sampling (n_samples > 1) does not compose with "
+                    "adapter_id — submit the samples as separate requests"
+                )
+            if adapter_id not in self.lora.registered():
+                raise ValueError(
+                    f"adapter {adapter_id!r} is not registered — call "
+                    "register_adapter(adapter_id, lora) first"
+                )
         req = Request(next(self._ids), prompt_ids, gen or GenerationConfig(),
-                      priority=int(priority))
+                      priority=int(priority), adapter_id=adapter_id)
         if n_samples < 1:
             raise ValueError(f"n_samples={n_samples} must be >= 1")
         if n_samples > self.max_batch:
@@ -1111,7 +1225,7 @@ class LLMEngine:
         # are still returned, and the next step() reports it finished with
         # finish_reason="shed"
         if self._admission_control(req) is not req:
-            if self.prefix_cache is not None:
+            if self.prefix_cache is not None and req.adapter_id is None:
                 # walk the radix tree now (pins the matched path); _admit
                 # re-walks so later donations extend a queued request's hit
                 req.cache_node, req.cached_blocks = \
@@ -1231,7 +1345,8 @@ class LLMEngine:
             return 1
         return sp
 
-    def _run_chunk_prefill(self, ids, start, n_valid, table, sp: int):
+    def _run_chunk_prefill(self, ids, start, n_valid, table, sp: int,
+                           lora=None):
         """One chunk-prefill dispatch (plus its draft-pool mirror):
         ``prefill_sp`` over the tp axis when ``sp > 1``, else the
         monolithic ``prefill_chunk_paged``. Returns the chunk logits."""
@@ -1249,7 +1364,7 @@ class LLMEngine:
         else:
             logits, self.cache = prefill_chunk_paged(
                 self.params, self.config, a_ids, a_start, a_n,
-                self.cache, a_table,
+                self.cache, a_table, lora=lora,
             )
         if self.draft_len:
             # mirror into the draft pool (same physical pages) so the
@@ -1368,6 +1483,13 @@ class LLMEngine:
         self.stats.kv_blocks_in_use = (
             self.allocator.num_blocks - 1 - self.allocator.num_free
         )
+        if self.lora is not None:
+            # adapter-tier counters mirror the pool's host bookkeeping
+            self.stats.lora_hits = self.lora.hits
+            self.stats.lora_misses = self.lora.misses
+            self.stats.lora_evictions = self.lora.evictions
+            self.stats.lora_resident_adapters = len(self.lora.resident())
+            self.stats.lora_adapter_pool_bytes = self.lora.pool_bytes
 
     def _next_waiting(self) -> int:
         """Index of the waiting request the admission policy tries next
@@ -1388,7 +1510,7 @@ class LLMEngine:
             # fresh requests, so this IS the prompt then)
             ctx = req.prompt_ids + req.output_ids
             n = len(ctx)
-            if self.prefix_cache is not None:
+            if self.prefix_cache is not None and req.adapter_id is None:
                 # refresh the tree walk: prefixes donated while this
                 # request waited in the queue extend its hit now — for a
                 # preempted request that includes its OWN donated pages,
@@ -1408,6 +1530,19 @@ class LLMEngine:
                 self._evict_for(need - self.allocator.num_free, req=req)
             if self.allocator.num_free < need:
                 break  # no pages: stay queued until frees arrive
+            if req.adapter_id is not None and req.adapter_slot is None:
+                # pin the adapter's pool slot before committing pages; a
+                # FAULT uploads the factors host→device here — billed to
+                # admission (the lora_upload span), never to decode ITL
+                t0 = time.monotonic()
+                try:
+                    aslot, faulted = self.lora.acquire(req.adapter_id)
+                except OutOfAdapterSlots:
+                    break  # every slot pinned: wait for a running release
+                req.adapter_slot = aslot
+                if faulted:
+                    self.telemetry.trace_interval(
+                        req, "lora_upload", t0, time.monotonic())
             self.waiting.pop(i)
             req.slot = free.pop(0)
             if req.output_ids:  # re-admission after a preemption
@@ -1483,7 +1618,8 @@ class LLMEngine:
                         )
                     else:
                         logits = self._run_chunk_prefill(
-                            ids, pos, n_valid, table, sp)
+                            ids, pos, n_valid, table, sp,
+                            lora=self._lora_prefill_operand(req))
                 self.stats.prefill_chunks += 1
                 self._tick_prefilled = True
                 req.prefill_pos = pos + n_valid
@@ -1589,6 +1725,12 @@ class LLMEngine:
             self._put_rep(np.asarray(self._budget_left(req), np.int32)))
         self._dev_active = _patch1(self._dev_active, idx,
                                    self._put_rep(np.asarray(True)))
+        if self.lora is not None:
+            # per-row adapter gather index (0 = null adapter: a base-model
+            # request reuses the slot bitwise-untouched)
+            self._dev_adapter_slots = _patch1(
+                self._dev_adapter_slots, idx,
+                self._put_rep(np.asarray(req.adapter_slot or 0, np.int32)))
 
     def _fund_slot(self, slot: int, req: Request, k: int) -> bool:
         """Reserve pages for min(k, budget) more tokens of this slot and
@@ -1713,6 +1855,11 @@ class LLMEngine:
         # trace time; tp_shard is STATIC on the megastep jits, so a meshed
         # and a mesh-free engine never share a trace.
         tp_shard = self._tp_mesh is not None
+        # LoRA operand: the pool's slabs + per-row slot indices. None for
+        # non-LoRA engines — None is a leafless pytree, so their megastep
+        # trace is structurally identical to the pre-LoRA engine's.
+        lora_op = (dict(self.lora.operand(), slots=self._dev_adapter_slots)
+                   if self.lora is not None else None)
         if tp_shard:
             from colossalai_tpu.tensor.sharding import use_mesh
 
@@ -1738,6 +1885,7 @@ class LLMEngine:
                     self._dev_sample, keys, k_steps=k, draft_len=d,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
                     tp_shard=tp_shard, overlap_chunks=self.overlap_chunks,
+                    lora=lora_op,
                 )
             elif self._pp:
                 (buf, emitted, alive, self._dev_tokens, self._dev_lengths,
@@ -1757,7 +1905,7 @@ class LLMEngine:
                     self._dev_sample, keys, k_steps=k,
                     use_kernel=self.use_kernel, use_sampling=any_sample,
                     moe_fused=self._moe_fused, tp_shard=tp_shard,
-                    overlap_chunks=self.overlap_chunks,
+                    overlap_chunks=self.overlap_chunks, lora=lora_op,
                 )
                 # MoE param trees append the [E] expert_counts tally
                 expert_counts = out[7] if self._moe else None
@@ -1936,13 +2084,18 @@ class LLMEngine:
         self._dev_active = _patch1(
             self._dev_active, self._put_rep(np.asarray(slot, np.int32)),
             self._put_rep(np.asarray(False)))
+        if req.adapter_slot is not None:
+            # unpin the adapter (stays resident, warm for the resume hit);
+            # re-admission re-acquires through the normal fault path
+            self.lora.release(req.adapter_id)
+            req.adapter_slot = None
         pc = self.prefix_cache
         if pc is not None and req.cache_node is not None:
             pc.unpin(req.cache_node)
             req.cache_node = None
         table = self._tables.pop(slot)
         ctx = req.prompt_ids + req.output_ids
-        if pc is not None:
+        if pc is not None and req.adapter_id is None:
             # donate every page whose tokens ALL hold valid KV. The pool
             # has KV for table.length tokens (the newest sampled token is
             # the next decode input, not yet written); a speculative
@@ -2091,6 +2244,16 @@ class LLMEngine:
         self._dev_eos = _patch1(
             self._dev_eos, idx, self._put_rep(np.asarray(eos, np.int32)))
 
+    def _lora_prefill_operand(self, req: Optional[Request]):
+        """Per-request LoRA operand for a [1, bucket] prefill dispatch:
+        the pool slabs plus a single-row slots index (0 = base model).
+        None when LoRA serving is off — prefill traces stay unchanged."""
+        if self.lora is None:
+            return None
+        slot = 0 if req is None else (req.adapter_slot or 0)
+        return dict(self.lora.operand(),
+                    slots=self._put_rep(np.asarray([slot], np.int32)))
+
     def _prefill_into_slot(self, req: Request, bucket: int):
         """Prefill one prompt into its slot; returns the next-token logits
         [1, V] (grouped sampling draws every member's first token from
@@ -2125,6 +2288,7 @@ class LLMEngine:
                     self.params, self.config, self._put_rep(ids),
                     self._put_rep(np.asarray([n], np.int32)), self.cache,
                     self._put_rep(table),
+                    lora=self._lora_prefill_operand(req),
                 )
                 if self.draft_len:
                     _, self.draft_cache = prefill_paged(
@@ -2169,6 +2333,7 @@ class LLMEngine:
                     self._put_rep(np.asarray(start, np.int32)),
                     self._put_rep(np.asarray(n - start, np.int32)),
                     self.cache, self._put_rep(table),
+                    lora=self._lora_prefill_operand(req),
                 )
                 if self.draft_len:
                     # the cached prefix pages already hold draft KV — their
@@ -2217,6 +2382,11 @@ class LLMEngine:
         self._dev_active = _patch1(
             self._dev_active, self._put_rep(np.asarray(slot, np.int32)),
             self._put_rep(np.asarray(False)))
+        if req is not None and req.adapter_slot is not None:
+            # unpin the adapter slot; the factors stay resident (warm for
+            # the tenant's next request) until LRU eviction wants the slot
+            self.lora.release(req.adapter_id)
+            req.adapter_slot = None
         pc = self.prefix_cache
         if req is not None and req.group_tail_blocks:
             # chunked-group prefill died/aborted before the followers
@@ -2230,10 +2400,12 @@ class LLMEngine:
         table = self._tables.pop(slot, None)
         if table is None:
             return
-        if (pc is not None and req is not None
+        if (pc is not None and req is not None and req.adapter_id is None
                 and table.length >= len(req.prompt_ids)):
             # the full prompt made it into pages: DONATE the complete
             # prompt pages into the radix tree instead of freeing them
+            # (adapter requests never donate — their KV carries a tenant's
+            # LoRA delta and must not seed another tenant's prefix hit)
             # (already-cached chunks net out to a plain free inside
             # insert); the partial tail + generated pages free as usual.
             # Skipped when the prompt never finished prefilling (chunked

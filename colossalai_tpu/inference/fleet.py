@@ -114,6 +114,8 @@ FLEET_COUNTER_NAMES = (
     "fleet_control_failures",
     "fleet_child_force_kills",
     "fleet_chip_seconds",
+    "fleet_adapter_loads",
+    "fleet_adapter_evictions",
 )
 
 FLEET_GAUGE_NAMES = (
@@ -323,6 +325,8 @@ def tiny_llama_engine(
     capacity_idle_busy: float = 0.10,
     capacity_saturation_busy: float = 0.85,
     step_sleep_s: float = 0.0,
+    lora_slots: int = 0,
+    lora_rank: int = 8,
     **engine_kw,
 ) -> LLMEngine:
     """Default replica factory: a tiny CPU Llama engine. The same
@@ -336,10 +340,20 @@ def tiny_llama_engine(
     core, so co-located replicas contend instead of adding capacity; a
     sleep-bound step emulates the accelerator-bound replica the control
     plane is actually built for (sleeps overlap perfectly across
-    replicas, so fleet throughput scales with replica count)."""
+    replicas, so fleet throughput scales with replica count).
+
+    ``lora_slots > 0`` builds the replica with multi-tenant LoRA serving
+    (``lora_serving=LoraServing(slots=lora_slots, r=lora_rank)``) —
+    JSON-friendly ints, so fleet spawn specs can ship the knob over the
+    wire; adapters then arrive via the ``load_adapter`` control op."""
     from ..models.llama import LlamaConfig
 
     cfg = LlamaConfig.tiny()
+    if lora_slots and int(lora_slots) > 0:
+        from .lora_serving import LoraServing
+
+        engine_kw["lora_serving"] = LoraServing(slots=int(lora_slots),
+                                                r=int(lora_rank))
     capacity = None
     if capacity_interval_s and capacity_interval_s > 0:
         from ..telemetry.capacity import CapacityMonitor
@@ -394,6 +408,11 @@ def _sync_fields(engine: LLMEngine) -> Dict:
             d["signal"] = cap.signal().as_dict()
         except Exception:
             pass
+    if getattr(engine, "lora", None) is not None:
+        # adapter residency rides along so the controller's router can
+        # place adapter requests with warm-slot affinity
+        d["lora_resident"] = {str(k): int(v)
+                              for k, v in engine.lora.resident().items()}
     return d
 
 
@@ -424,8 +443,12 @@ def _handle_op(engine: LLMEngine, state: Dict, header: Dict,
         engine.seed_ids(start + state["minted"] * stride, stride)
     elif op == "add_request":
         gen = unpack_gen(np.asarray(header["gen"], np.float64))
+        kw = {}
+        if header.get("adapter_id") is not None:
+            kw["adapter_id"] = str(header["adapter_id"])
         rid = engine.add_request([int(t) for t in header["prompt_ids"]],
-                                 gen, priority=int(header.get("priority", 0)))
+                                 gen, priority=int(header.get("priority", 0)),
+                                 **kw)
         state["minted"] += 1
         reply["rid"] = int(rid)
     elif op == "adopt":
@@ -472,6 +495,23 @@ def _handle_op(engine: LLMEngine, state: Dict, header: Dict,
         else:
             params = unpack_params(payload)
         reply["leaves"] = int(engine.swap_weights(params))
+    elif op == "load_adapter":
+        # multi-tenant LoRA: register (or hot-update) an adapter on this
+        # replica's AdapterPool — host-side only, so unlike swap_weights
+        # no drain/quiesce precedes it; the device upload happens on the
+        # first admission that faults the adapter in
+        if header.get("kind") == "path":
+            lora = load_params(header["path"])
+        else:
+            lora = unpack_params(payload)
+        alpha = header.get("alpha")
+        engine.register_adapter(
+            str(header["adapter_id"]), lora,
+            alpha=(float(alpha) if alpha is not None else None))
+        reply["registered"] = engine.lora.registered()
+    elif op == "evict_adapter":
+        reply["evicted"] = bool(
+            engine.evict_adapter(str(header["adapter_id"])))
     elif op == "kv_endpoint":
         # disagg pairing over the control channel: build a standalone
         # paged pool of the asked geometry, park a SocketKVReceiver on
@@ -670,6 +710,27 @@ class _AdoptQueue(list):
         super().append(req)
 
 
+class _RemoteAdapterMirror:
+    """Host-side mirror of a remote replica's AdapterPool registry —
+    just enough surface for the Router's adapter-affinity placement
+    (``registered`` / ``slot_of``). The registered set updates when the
+    controller pushes ``load_adapter``; residency refreshes with the
+    sync fields riding on every control reply."""
+
+    def __init__(self):
+        self._ids: set = set()
+        self._resident: Dict[str, int] = {}
+
+    def registered(self) -> List[str]:
+        return sorted(self._ids)
+
+    def slot_of(self, adapter_id: str) -> Optional[int]:
+        return self._resident.get(adapter_id)
+
+    def resident(self) -> Dict[str, int]:
+        return dict(self._resident)
+
+
 class RemoteReplica:
     """Engine-shaped proxy over one replica's control socket.
 
@@ -705,6 +766,9 @@ class RemoteReplica:
         self.prefix_cache = None
         self.slo = None
         self.capacity = None
+        #: adapter-registry mirror; created by the controller's first
+        #: successful load_adapter against this replica
+        self.lora: Optional[_RemoteAdapterMirror] = None
 
     # ------------------------------------------------------------- wire
     def call(self, op: str, body: Optional[Dict] = None,
@@ -759,6 +823,10 @@ class RemoteReplica:
             self.stats.update(reply["stats"])
         if reply.get("signal"):
             self.last_signal = ScalingSignal.from_dict(reply["signal"])
+        if self.lora is not None and "lora_resident" in reply:
+            self.lora._resident = {
+                str(k): int(v)
+                for k, v in dict(reply["lora_resident"]).items()}
         self.prefilling = {i: None for i in range(int(counts["prefilling"]))}
         rids = reply.get("running_rids", ())
         self.running = {int(rid): self._reqs[int(rid)]
@@ -780,7 +848,8 @@ class RemoteReplica:
         self.call("seed_ids", {"start": int(start), "stride": int(stride)})
 
     def add_request(self, prompt_ids, gen: Optional[GenerationConfig] = None,
-                    n_samples: int = 1, priority: int = 0) -> int:
+                    n_samples: int = 1, priority: int = 0,
+                    adapter_id: Optional[str] = None) -> int:
         if n_samples != 1:
             raise NotImplementedError(
                 "grouped sampling (n_samples > 1) does not cross the fleet "
@@ -788,10 +857,13 @@ class RemoteReplica:
                 "which only exists child-side; submit groups to a local "
                 "engine")
         gen = gen or GenerationConfig()
-        reply, _ = self.call("add_request", {
+        header = {
             "prompt_ids": [int(t) for t in prompt_ids],
             "gen": [float(x) for x in pack_gen(gen)],
-            "priority": int(priority)})
+            "priority": int(priority)}
+        if adapter_id is not None:
+            header["adapter_id"] = str(adapter_id)
+        reply, _ = self.call("add_request", header)
         rid = int(reply["rid"])
         self._reqs[rid] = RemoteRequest(rid, [int(t) for t in prompt_ids],
                                         gen, priority=int(priority))
@@ -1475,6 +1547,70 @@ class FleetController:
             self._span("weight_swap", t0, self._clock(), seat=seat)
             swapped.append(seat)
         return swapped
+
+    # ----------------------------------------------------- adapter control
+    def load_adapter(self, adapter_id: str, source, *,
+                     alpha: Optional[float] = None) -> List[int]:
+        """Register (or hot-update) a LoRA adapter on every active
+        LoRA-serving replica — the multi-tenant twin of
+        :meth:`swap_weights`, minus the drain: registration is host-side
+        on each child (the device upload happens on that child's first
+        adapter fault), so in-flight decodes never pause. ``source`` is
+        a packed-params checkpoint path (children read it themselves) or
+        an in-memory adapter tree / ``{proj: (A, B)}`` factor dict
+        (packed and shipped inline). Returns the seats that registered
+        it."""
+        if isinstance(source, (str, os.PathLike)):
+            body, payload = {"kind": "path",
+                             "path": os.fspath(source)}, b""
+        else:
+            body, payload = {"kind": "inline"}, pack_params(source)
+        body["adapter_id"] = str(adapter_id)
+        if alpha is not None:
+            body["alpha"] = float(alpha)
+        seats = []
+        with self._lock:
+            targets = [i for i in self._active_indices()
+                       if i not in self._retiring]
+        for i in targets:
+            eng = self.router.engines[i]
+            if not isinstance(eng, RemoteReplica):
+                continue
+            t0 = self._clock()
+            eng.call("load_adapter", body, payload,
+                     timeout=max(self.control_timeout_s, 60.0))
+            if eng.lora is None:
+                eng.lora = _RemoteAdapterMirror()
+            eng.lora._ids.add(str(adapter_id))
+            self._count("fleet_adapter_loads")
+            self._span("lora_upload", t0, self._clock(),
+                       seat=self.router.seat_of(i))
+            seats.append(self.router.seat_of(i))
+        if not seats:
+            raise FleetWireError(
+                "load_adapter reached no active replica — is the fleet "
+                "spawned with lora_slots > 0?")
+        return seats
+
+    def evict_adapter(self, adapter_id: str) -> int:
+        """Force-evict an unpinned resident adapter fleet-wide (its
+        registrations stay — the next request faults it back in).
+        Returns how many replicas actually dropped a resident copy."""
+        evicted = 0
+        with self._lock:
+            targets = [i for i in self._active_indices()
+                       if i not in self._retiring]
+        for i in targets:
+            eng = self.router.engines[i]
+            if not isinstance(eng, RemoteReplica) or eng.lora is None:
+                continue
+            reply, _ = eng.call("evict_adapter",
+                                {"adapter_id": str(adapter_id)})
+            if reply.get("evicted"):
+                evicted += 1
+                self._count("fleet_adapter_evictions")
+                eng.lora._resident.pop(str(adapter_id), None)
+        return evicted
 
     # ------------------------------------------------------- manual scale
     def scale_to(self, n: int) -> Dict[str, int]:

@@ -1,0 +1,383 @@
+"""Multi-tenant LoRA serving: a paged device-resident adapter cache.
+
+One fleet serves thousands of fine-tunes without per-tenant replicas:
+every resident adapter's rank-r (A, B) factor pairs live in paged device
+slabs ``[L, P, in, r]`` / ``[L, P, r, out]`` (slot 0 is the reserved
+all-zeros *null adapter* — base-model rows run the same compiled program
+and add exact zeros), one slab pair per targeted projection. The decode
+and speculative megasteps carry a per-sequence adapter-slot index in the
+on-device scheduler state and apply each row's delta through the batched
+gather-matmul epilogue (``kernel/ops.py::lora_matmul``), so a mixed
+batch of N different adapters costs one compiled megastep.
+
+The pool is a cache tier with the same refcount/pin/LRU-eviction
+discipline as KV pages (``kv_cache.BlockAllocator``) and prefix nodes
+(``prefix_cache.PrefixCache``):
+
+- a host-side registry keys adapter id → host factors (``register``);
+- admission ``acquire``\\ s the id: a resident adapter is a *hit* (pin
+  refcount bumps), a registered-but-evicted one *faults* — the factors
+  upload host→device into a free or LRU-evicted unpinned slot, billed
+  to admission (never to decode ITL);
+- adapters stay pinned while any live sequence references them;
+  ``release`` unpins, leaving the slot resident (an LRU eviction
+  candidate, and a free hit for the next sequence);
+- a full pool of pinned adapters raises :class:`OutOfAdapterSlots` —
+  the engine leaves the request waiting, exactly like ``OutOfBlocks``.
+
+See docs/inference.md ("Multi-tenant LoRA serving") for the knob table
+and composition matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: the seven serving-side projections an adapter may target (the
+#: peft DEFAULT_TARGETS attention four plus the MLP three)
+SERVING_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+class OutOfAdapterSlots(RuntimeError):
+    """Every adapter slot is pinned by a live sequence — admission must
+    wait for a running adapter request to finish (the adapter-tier twin
+    of ``kv_cache.OutOfBlocks``)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraServing:
+    """The ``lora_serving=`` engine knob.
+
+    ``slots`` is the number of *usable* adapter slots (the reserved null
+    slot 0 rides on top); ``r`` is the pool rank — adapters with smaller
+    rank zero-pad up to it (mathematically exact), larger ranks are
+    rejected. ``alpha`` is the default scaling numerator for adapters
+    registered without one. ``targets`` restricts which projections get
+    slabs; ``dtype`` is the slab dtype (None → the model compute
+    dtype)."""
+
+    slots: int = 8
+    r: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = SERVING_TARGETS
+    dtype: Any = None
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"lora_serving.slots must be >= 1, got {self.slots}")
+        if self.r < 1:
+            raise ValueError(f"lora_serving.r must be >= 1, got {self.r}")
+        unknown = set(self.targets) - set(SERVING_TARGETS)
+        if unknown:
+            raise ValueError(
+                f"lora_serving.targets {sorted(unknown)} not in "
+                f"{SERVING_TARGETS}")
+
+
+def projection_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    """(d_in, d_out) per targetable projection, from the model config."""
+    h = cfg.hidden_size
+    hd = cfg.head_dim_
+    q = cfg.num_attention_heads * hd
+    kv = cfg.num_key_value_heads * hd
+    i = cfg.intermediate_size
+    return {
+        "q_proj": (h, q), "k_proj": (h, kv), "v_proj": (h, kv),
+        "o_proj": (q, h),
+        "gate_proj": (h, i), "up_proj": (h, i), "down_proj": (i, h),
+    }
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _patch_slot(slab, slot, val):
+    """O(slot-slice) in-place device update (donated, like the engine's
+    ``_patch1``) — an adapter fault uploads one slot, never the slab."""
+    return slab.at[:, slot].set(val)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _patch_scalar(arr, idx, val):
+    return arr.at[idx].set(val)
+
+
+def extract_adapter_factors(lora: Any, cfg, targets=SERVING_TARGETS,
+                            ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Host ``{proj: (A [L, in, r], B [L, r, out])}`` out of a
+    ``peft.init_lora_params``-shaped adapter tree (scanned-stack layout,
+    the layout the paged engine serves). Projections the tree does not
+    adapt are simply absent — the pool zero-fills them."""
+    from colossalai_tpu.shardformer.policies.base_policy import path_str
+
+    L = cfg.num_hidden_layers
+    flat = {path_str(kp): leaf for kp, leaf
+            in jax.tree_util.tree_flatten_with_path(lora)[0]}
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for path, leaf in flat.items():
+        parts = path.split("/")
+        if len(parts) < 2 or parts[-1] != "lora_a":
+            continue
+        name = parts[-2]
+        if name not in targets:
+            continue
+        b = flat.get(f"{path.rsplit('/', 1)[0]}/lora_b")
+        if b is None:
+            raise ValueError(f"adapter tree has {path} but no lora_b twin")
+        a_np, b_np = np.asarray(leaf), np.asarray(b)
+        if a_np.ndim == 2:  # single-layer flat tree
+            a_np, b_np = a_np[None], b_np[None]
+        if a_np.shape[0] != L:
+            raise ValueError(
+                f"{name}: adapter layer dim {a_np.shape[0]} != model "
+                f"num_hidden_layers {L}")
+        out[name] = (a_np, b_np)
+    if not out:
+        raise ValueError(
+            "adapter tree adapts none of the serving targets "
+            f"{tuple(targets)}")
+    return out
+
+
+class AdapterPool:
+    """Paged device-resident LoRA adapter cache (see module docstring).
+
+    ``put`` places host arrays on device — the engine passes its
+    replicated placement so slabs live wherever the weights do."""
+
+    def __init__(self, cfg, serving: LoraServing,
+                 put: Optional[Callable[[np.ndarray], jax.Array]] = None):
+        self.cfg = cfg
+        self.serving = serving
+        self.r = int(serving.r)
+        self.n_slots = int(serving.slots) + 1  # + reserved null slot 0
+        self._put = put if put is not None else jnp.asarray
+        dims = projection_dims(cfg)
+        unknown = [t for t in serving.targets if t not in dims]
+        if unknown:
+            raise ValueError(f"model has no projections {unknown}")
+        self.targets = tuple(t for t in serving.targets)
+        dtype = serving.dtype if serving.dtype is not None else jnp.float32
+        self._dtype = jnp.dtype(dtype)
+        L = cfg.num_hidden_layers
+        self._a: Dict[str, jax.Array] = {}
+        self._b: Dict[str, jax.Array] = {}
+        for name in self.targets:
+            d_in, d_out = dims[name]
+            self._a[name] = self._put(np.zeros(
+                (L, self.n_slots, d_in, self.r), self._dtype))
+            self._b[name] = self._put(np.zeros(
+                (L, self.n_slots, self.r, d_out), self._dtype))
+        self._scaling = self._put(np.zeros((self.n_slots,), np.float32))
+        # host registry + cache-tier bookkeeping
+        self._registry: Dict[str, Dict] = {}
+        self._slot_of: Dict[str, int] = {}
+        self._aid_of: Dict[int, str] = {}
+        self._refs: Dict[int, int] = {}
+        self._last_used: Dict[int, int] = {}
+        self._tick = 0
+        # counters (mirrored into EngineStats by the engine)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.uploads = 0
+        self.upload_seconds = 0.0
+        self.last_upload_s = 0.0
+
+    # ------------------------------------------------------------ registry
+    def register(self, adapter_id: str, lora: Any,
+                 alpha: Optional[float] = None,
+                 scaling: Optional[float] = None) -> None:
+        """Host-side registration (no device traffic): extract and stash
+        the factors; the upload happens on the first ``acquire`` fault.
+        ``lora`` is an ``init_lora_params``-shaped tree or a prebuilt
+        ``{proj: (A, B)}`` factor dict. ``scaling`` overrides the
+        ``alpha / r`` computation outright. Re-registering a *resident*
+        id re-uploads in place (the fleet ``load_adapter`` hot-update
+        path)."""
+        if isinstance(lora, dict) and lora and all(
+                isinstance(v, tuple) for v in lora.values()):
+            factors = {k: (np.asarray(a), np.asarray(b))
+                       for k, (a, b) in lora.items()}
+        else:
+            factors = extract_adapter_factors(lora, self.cfg, self.targets)
+        dims = projection_dims(self.cfg)
+        L = self.cfg.num_hidden_layers
+        norm: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        r_seen = 0
+        for name, (a, b) in factors.items():
+            if name not in self.targets:
+                raise ValueError(f"adapter targets {name!r} but the pool "
+                                 f"only serves {self.targets}")
+            d_in, d_out = dims[name]
+            r = a.shape[-1]
+            if a.shape != (L, d_in, r) or b.shape != (L, r, d_out):
+                raise ValueError(
+                    f"{name}: factor shapes {a.shape} x {b.shape} do not "
+                    f"match [L={L}, in={d_in}] x [r, out={d_out}]")
+            if r > self.r:
+                raise ValueError(
+                    f"{name}: adapter rank {r} exceeds pool rank {self.r}")
+            r_seen = max(r_seen, r)
+            if r < self.r:  # zero-pad up to the pool rank: exact
+                a = np.concatenate(
+                    [a, np.zeros((L, d_in, self.r - r), a.dtype)], axis=-1)
+                b = np.concatenate(
+                    [b, np.zeros((L, self.r - r, d_out), b.dtype)], axis=1)
+            norm[name] = (a.astype(self._dtype), b.astype(self._dtype))
+        if scaling is None:
+            scaling = float(alpha if alpha is not None
+                            else self.serving.alpha) / max(r_seen, 1)
+        self._registry[adapter_id] = {"factors": norm,
+                                      "scaling": float(scaling)}
+        if adapter_id in self._slot_of:  # hot update of a resident id
+            self._upload(self._slot_of[adapter_id], adapter_id)
+
+    def unregister(self, adapter_id: str) -> bool:
+        """Drop an id from the registry (+ its slot when unpinned).
+        Returns False — and changes nothing — while sequences pin it."""
+        if not self.evict(adapter_id) and adapter_id in self._slot_of:
+            return False
+        self._registry.pop(adapter_id, None)
+        return True
+
+    def registered(self) -> List[str]:
+        return sorted(self._registry)
+
+    # --------------------------------------------------------- cache tier
+    def acquire(self, adapter_id: str) -> Tuple[int, bool]:
+        """Pin ``adapter_id`` for one sequence; returns ``(slot,
+        faulted)``. A fault uploads the factors into a free or
+        LRU-evicted unpinned slot; raises :class:`OutOfAdapterSlots`
+        when every slot is pinned."""
+        if adapter_id not in self._registry:
+            raise KeyError(f"adapter {adapter_id!r} is not registered")
+        self._tick += 1
+        slot = self._slot_of.get(adapter_id)
+        if slot is not None:
+            self.hits += 1
+            self._refs[slot] = self._refs.get(slot, 0) + 1
+            self._last_used[slot] = self._tick
+            return slot, False
+        slot = self._find_slot()
+        self.misses += 1
+        self._upload(slot, adapter_id)
+        self._slot_of[adapter_id] = slot
+        self._aid_of[slot] = adapter_id
+        self._refs[slot] = 1
+        self._last_used[slot] = self._tick
+        return slot, True
+
+    def release(self, adapter_id: str) -> None:
+        """Unpin one sequence's reference; the adapter stays resident
+        (warm for the next hit) until LRU eviction wants its slot."""
+        slot = self._slot_of.get(adapter_id)
+        if slot is None:
+            return
+        refs = self._refs.get(slot, 0)
+        if refs <= 0:
+            raise RuntimeError(
+                f"release({adapter_id!r}): refcount already zero")
+        self._refs[slot] = refs - 1
+
+    def evict(self, adapter_id: str) -> bool:
+        """Force-evict a *resident, unpinned* adapter (the fleet
+        ``evict_adapter`` control op). False while pinned or absent."""
+        slot = self._slot_of.get(adapter_id)
+        if slot is None or self._refs.get(slot, 0) > 0:
+            return False
+        self._drop(slot)
+        return True
+
+    def _find_slot(self) -> int:
+        for s in range(1, self.n_slots):
+            if s not in self._aid_of:
+                return s
+        lru = [s for s, refs in self._refs.items() if refs == 0
+               and s in self._aid_of]
+        if not lru:
+            raise OutOfAdapterSlots(
+                f"all {self.n_slots - 1} adapter slots are pinned by "
+                "live sequences")
+        victim = min(lru, key=lambda s: self._last_used.get(s, 0))
+        self._drop(victim)
+        return victim
+
+    def _drop(self, slot: int) -> None:
+        aid = self._aid_of.pop(slot)
+        self._slot_of.pop(aid, None)
+        self._refs.pop(slot, None)
+        self._last_used.pop(slot, None)
+        self.evictions += 1
+
+    def _upload(self, slot: int, adapter_id: str) -> None:
+        """Host→device fault: patch one slot across every slab (donated
+        slice update — the slab never round-trips). Timed, so admission
+        can bill the penalty to itself, never to decode ITL."""
+        entry = self._registry[adapter_id]
+        t0 = time.perf_counter()
+        idx = jnp.asarray(slot, jnp.int32)
+        L = self.cfg.num_hidden_layers
+        for name in self.targets:
+            fac = entry["factors"].get(name)
+            if fac is None:  # untargeted projection: exact-zero factors
+                a = np.zeros((L,) + tuple(self._a[name].shape[2:]),
+                             self._dtype)
+                b = np.zeros((L,) + tuple(self._b[name].shape[2:]),
+                             self._dtype)
+            else:
+                a, b = fac
+            self._a[name] = _patch_slot(self._a[name], idx, self._put(a))
+            self._b[name] = _patch_slot(self._b[name], idx, self._put(b))
+        self._scaling = _patch_scalar(
+            self._scaling, idx,
+            jnp.asarray(entry["scaling"], jnp.float32))
+        jax.block_until_ready(self._scaling)
+        self.last_upload_s = time.perf_counter() - t0
+        self.upload_seconds += self.last_upload_s
+        self.uploads += 1
+
+    # ------------------------------------------------------------ surface
+    def operand(self) -> Dict[str, Any]:
+        """The device pytree the megasteps close over: per-slot scaling
+        plus per-projection ``[L, P, ...]`` slabs (the engine adds the
+        per-sequence ``slots`` index array)."""
+        return {"scaling": self._scaling,
+                "a": dict(self._a), "b": dict(self._b)}
+
+    def slot_of(self, adapter_id: str) -> Optional[int]:
+        """Read-only residency probe (router adapter-affinity)."""
+        return self._slot_of.get(adapter_id)
+
+    def resident(self) -> Dict[str, int]:
+        return dict(self._slot_of)
+
+    def refcounts(self) -> Dict[str, int]:
+        """{adapter_id: live-sequence pins} — the audit surface the
+        eviction/refcount tests walk."""
+        return {aid: self._refs.get(slot, 0)
+                for aid, slot in self._slot_of.items()}
+
+    @property
+    def pool_bytes(self) -> int:
+        n = sum(x.nbytes for x in self._a.values())
+        n += sum(x.nbytes for x in self._b.values())
+        return n + self._scaling.nbytes
+
+
+__all__ = [
+    "AdapterPool",
+    "LoraServing",
+    "OutOfAdapterSlots",
+    "SERVING_TARGETS",
+    "extract_adapter_factors",
+    "projection_dims",
+]

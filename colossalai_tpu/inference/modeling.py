@@ -56,17 +56,39 @@ def _matmul(h, kernel, scale, dtype):
     return quant_matmul(h, kernel, scale, out_dtype=dtype)
 
 
-def _proj(h, leaf, dtype):
+def _lora_apply(y, h, lora, name):
+    """Batched gather-matmul LoRA epilogue (multi-tenant serving): add
+    each row's rank-r delta ``h @ A[slot] @ B[slot] * scaling[slot]``
+    from the paged adapter slabs to the base projection output. ``lora``
+    is the per-layer operand ``{"slots": [B], "scaling": [P],
+    <proj>: {"a": [P, in, r], "b": [P, r, out]}}`` (None → no-op, and
+    the trace is byte-identical to a non-LoRA engine's). Rows whose slot
+    is 0 (the null adapter) pass through the ``where`` bitwise-untouched,
+    so a base-model request in a mixed batch stays exactly on the
+    no-LoRA trajectory."""
+    if lora is None or name not in lora:
+        return y
+    from colossalai_tpu.kernel import lora_matmul
+
+    slots = lora["slots"]
+    delta = lora_matmul(h, lora[name]["a"], lora[name]["b"], slots,
+                        lora["scaling"], out_dtype=y.dtype)
+    return jnp.where((slots > 0)[:, None, None], y + delta, y)
+
+
+def _proj(h, leaf, dtype, lora=None, lora_name=None):
     """x @ kernel (+ bias when the checkpoint has one — qwen2-style
     attention_bias configs; under a tp shard_map the bias arrives
-    column-sliced like its kernel)."""
+    column-sliced like its kernel). ``lora``/``lora_name`` bolt the
+    multi-tenant adapter epilogue onto the output."""
     y = _matmul(h, leaf["kernel"], leaf.get("scale"), dtype)
     if "bias" in leaf:
         y = y + leaf["bias"].astype(dtype)
-    return y
+    return _lora_apply(y, h, lora, lora_name)
 
 
-def _row_matmul(h, leaf, dtype, tp_axis=None, overlap_chunks=1):
+def _row_matmul(h, leaf, dtype, tp_axis=None, overlap_chunks=1,
+                lora=None, lora_name=None):
     """The row-parallel o_proj / down_proj matmul, overlap-scheduled.
 
     With ``overlap_chunks=k > 1`` the kernel's OUTPUT columns split into k
@@ -93,7 +115,9 @@ def _row_matmul(h, leaf, dtype, tp_axis=None, overlap_chunks=1):
     k = int(overlap_chunks) if overlap_chunks else 1
     if k <= 1 or n_out % k != 0:
         y = _matmul(h, kernel, scale, dtype)
-        return jax.lax.psum(y, tp_axis) if tp_axis is not None else y
+        if tp_axis is not None:
+            y = jax.lax.psum(y, tp_axis)
+        return _lora_apply(y, h, lora, lora_name)
     cols = n_out // k
     parts = []
     for i in range(k):
@@ -105,12 +129,12 @@ def _row_matmul(h, leaf, dtype, tp_axis=None, overlap_chunks=1):
             if tp_axis is not None:
                 y = jax.lax.psum(y, tp_axis)
         parts.append(y)
-    return jnp.concatenate(parts, axis=-1)
+    return _lora_apply(jnp.concatenate(parts, axis=-1), h, lora, lora_name)
 
 
 def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
                 tp_axis=None, moe_fused=False, return_moe_routing=False,
-                overlap_chunks=1):
+                overlap_chunks=1, lora=None):
     """One decoder block over x [B, S, H] attending to the cache + itself.
 
     k_cache/v_cache: [B, S_max, Hkv, D] already containing THIS x's K/V at
@@ -138,7 +162,7 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     b, s, _ = x.shape
 
     h = _rms(x, p["input_layernorm"]["scale"], eps)
-    q = _proj(h, p["self_attn"]["q_proj"], dtype)
+    q = _proj(h, p["self_attn"]["q_proj"], dtype, lora=lora, lora_name="q_proj")
     n_heads = q.shape[-1] // hd  # LOCAL heads under a tp shard
     q = q.reshape(b, s, n_heads, hd)
     cos, sin = rope_table(positions, hd, cfg.rope_theta)
@@ -158,7 +182,8 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
     attn = jnp.einsum("bhgst,bthd->bshgd", probs, v_cache, preferred_element_type=jnp.float32)
     attn = attn.reshape(b, s, n_heads * hd).astype(dtype)
     x = x + _row_matmul(attn, p["self_attn"]["o_proj"], dtype,
-                        tp_axis=tp_axis, overlap_chunks=overlap_chunks)
+                        tp_axis=tp_axis, overlap_chunks=overlap_chunks,
+                        lora=lora, lora_name="o_proj")
 
     h = _rms(x, p["post_attention_layernorm"]["scale"], eps)
     if "moe" in p:
@@ -171,24 +196,31 @@ def _block_step(cfg, p, x, k_cache, v_cache, positions, kv_valid_mask,
         y, routing, cap = moe_ffn(cfg, p["moe"], h, fused=moe_fused)
         x = x + y
         return (x, (routing, cap)) if return_moe_routing else x
-    gate = _matmul(h, p["mlp"]["gate_proj"]["kernel"],
-                   p["mlp"]["gate_proj"].get("scale"), dtype)
-    up = _matmul(h, p["mlp"]["up_proj"]["kernel"],
-                 p["mlp"]["up_proj"].get("scale"), dtype)
+    gate = _lora_apply(
+        _matmul(h, p["mlp"]["gate_proj"]["kernel"],
+                p["mlp"]["gate_proj"].get("scale"), dtype),
+        h, lora, "gate_proj")
+    up = _lora_apply(
+        _matmul(h, p["mlp"]["up_proj"]["kernel"],
+                p["mlp"]["up_proj"].get("scale"), dtype),
+        h, lora, "up_proj")
     act = jax.nn.silu(gate) * up
     x = x + _row_matmul(act, p["mlp"]["down_proj"], dtype,
-                        tp_axis=tp_axis, overlap_chunks=overlap_chunks)
+                        tp_axis=tp_axis, overlap_chunks=overlap_chunks,
+                        lora=lora, lora_name="down_proj")
     return (x, None) if return_moe_routing else x
 
 
-def _project_kv(cfg, p, h_normed, positions):
+def _project_kv(cfg, p, h_normed, positions, lora=None):
     dtype = h_normed.dtype
     hd = cfg.head_dim_
     b, s, _ = h_normed.shape
-    k_flat = _proj(h_normed, p["self_attn"]["k_proj"], dtype)
+    k_flat = _proj(h_normed, p["self_attn"]["k_proj"], dtype,
+                   lora=lora, lora_name="k_proj")
     n_kv = k_flat.shape[-1] // hd  # LOCAL kv heads under a tp shard
     k = k_flat.reshape(b, s, n_kv, hd)
-    v = _proj(h_normed, p["self_attn"]["v_proj"], dtype).reshape(
+    v = _proj(h_normed, p["self_attn"]["v_proj"], dtype,
+              lora=lora, lora_name="v_proj").reshape(
         b, s, n_kv, hd
     )
     cos, sin = rope_table(positions, hd, cfg.rope_theta)

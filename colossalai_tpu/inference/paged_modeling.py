@@ -52,7 +52,15 @@ from colossalai_tpu.models.llama import LlamaConfig, apply_rope, rope_table
 
 from . import kv_quant
 from .kv_cache import PagedKVCache
-from .modeling import _block_step, _matmul, _proj, _project_kv, _rms, _row_matmul
+from .modeling import (
+    _block_step,
+    _lora_apply,
+    _matmul,
+    _proj,
+    _project_kv,
+    _rms,
+    _row_matmul,
+)
 from .moe_modeling import moe_expert_counts, moe_ffn
 
 
@@ -75,6 +83,31 @@ def constrain_cache(kv: PagedKVCache) -> PagedKVCache:
         v_scale=(None if kv.v_scale is None
                  else constrain(kv.v_scale, None, None, "tp")),
     )
+
+
+def _lora_xs(lora):
+    """The multi-tenant LoRA operand's per-layer scan slices.
+
+    The engine-side operand (see ``inference/lora_serving.py``) stacks
+    every projection's paged adapter slabs with a leading layer dim:
+    ``{"slots": [S], "scaling": [P], "a": {proj: [L, P, in, r]},
+    "b": {proj: [L, P, r, out]}}``. The slabs ride the layer scan's xs
+    (leading L, sliced per layer alongside the KV pools); slots/scaling
+    are layer-invariant and stay in the closure — see :func:`_lora_layer`.
+    Returns None when ``lora`` is None: None is a leafless pytree, so the
+    scan xs keep their structure and a LoRA-free trace is unchanged."""
+    if lora is None:
+        return None
+    return {name: {"a": lora["a"][name], "b": lora["b"][name]}
+            for name in lora["a"]}
+
+
+def _lora_layer(lora, sliced):
+    """Combine one layer's scan-sliced slabs with the invariant
+    slots/scaling into the per-layer operand ``_block_step`` expects."""
+    if lora is None:
+        return None
+    return dict(sliced, slots=lora["slots"], scaling=lora["scaling"])
 
 
 def _logits_head(p, cfg: LlamaConfig, x) -> jax.Array:
@@ -128,10 +161,13 @@ def sample_tokens(logits, rng, temperature, top_k, top_p, do_sample):
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill_paged(
-    params, cfg: LlamaConfig, input_ids, n_tokens, cache: PagedKVCache, block_table
+    params, cfg: LlamaConfig, input_ids, n_tokens, cache: PagedKVCache,
+    block_table, lora=None
 ) -> Tuple[jax.Array, PagedKVCache]:
     """One prompt [1, S_pad] → last-token logits [1, V]; K/V written into
-    the pages named by ``block_table`` (S_pad must be a page multiple)."""
+    the pages named by ``block_table`` (S_pad must be a page multiple).
+    ``lora`` is the multi-tenant adapter operand with slots [1] — the
+    request's adapter slot (0 = base model)."""
     p = params["params"] if "params" in params else params
     stacked = p["layers"]["block"]
     dtype = cfg.dtype or jnp.bfloat16
@@ -145,9 +181,10 @@ def prefill_paged(
 
     def layer(carry, inputs):
         x, i = carry
-        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc, lora_sl = inputs
+        lora_l = _lora_layer(lora, lora_sl)
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        k, v = _project_kv(cfg, layer_params, h, positions)
+        k, v = _project_kv(cfg, layer_params, h, positions, lora=lora_l)
         # page scatter: logical page j → physical block_table[j];
         # pool layout is [n_blocks, Hkv, bs, D]
         k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
@@ -172,7 +209,8 @@ def prefill_paged(
         k_pool = k_pool.at[block_table[:n_pages]].set(k_pages)
         v_pool = v_pool.at[block_table[:n_pages]].set(v_pages)
         # prompt attention is self-contained (causal over the prompt)
-        x = _block_step(cfg, layer_params, x, k, v, positions, valid)
+        x = _block_step(cfg, layer_params, x, k, v, positions, valid,
+                        lora=lora_l)
         return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     # named HLO region: a /profile capture attributes this op cluster to
@@ -180,7 +218,8 @@ def prefill_paged(
     with jax.named_scope("prefill"):
         (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
             layer, (x.astype(dtype), 0),
-            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale,
+             _lora_xs(lora)),
         )
 
     logits = _logits_head(p, cfg, x)
@@ -191,7 +230,7 @@ def prefill_paged(
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill_chunk_paged(
     params, cfg: LlamaConfig, input_ids, start, n_valid, cache: PagedKVCache,
-    block_table,
+    block_table, lora=None,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """One CHUNK [1, C] of a longer prompt (chunked prefill).
 
@@ -224,9 +263,10 @@ def prefill_chunk_paged(
 
     def layer(carry, inputs):
         x, i = carry
-        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc, lora_sl = inputs
+        lora_l = _lora_layer(lora, lora_sl)
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        k, v = _project_kv(cfg, layer_params, h, positions)
+        k, v = _project_kv(cfg, layer_params, h, positions, lora=lora_l)
         k_pages = k[0].reshape(n_pages, bs, *k.shape[2:]).transpose(0, 2, 1, 3)
         v_pages = v[0].reshape(n_pages, bs, *v.shape[2:]).transpose(0, 2, 1, 3)
         if k_sc is not None:
@@ -253,13 +293,15 @@ def prefill_chunk_paged(
             return g.reshape(s_max, pool.shape[1], pool.shape[3])[None]
 
         x = _block_step(cfg, layer_params, x, to_seq(k_pool, k_sc),
-                        to_seq(v_pool, v_sc), positions, kv_valid)
+                        to_seq(v_pool, v_sc), positions, kv_valid,
+                        lora=lora_l)
         return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     with jax.named_scope("prefill_chunk"):
         (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
             layer, (x.astype(dtype), 0),
-            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale,
+             _lora_xs(lora)),
         )
 
     logits = _logits_head(p, cfg, x)
@@ -505,7 +547,8 @@ def prefill_sp(
 
 def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
                  cache: PagedKVCache, active, use_kernel: bool,
-                 moe_fused: bool = False, overlap_chunks: int = 1):
+                 moe_fused: bool = False, overlap_chunks: int = 1,
+                 lora=None):
     """One decode iteration over unwrapped params: tokens [S] at positions
     ``lengths`` → (logits [S, V], cache, expert_counts). The shared
     core of ``decode_paged`` (K=1, jitted per call) and ``decode_megastep``
@@ -539,9 +582,10 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
 
     def layer(carry, inputs):
         x, counts, i = carry
-        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc, lora_sl = inputs
+        lora_l = _lora_layer(lora, lora_sl)
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        k, v = _project_kv(cfg, layer_params, h, positions)  # [S,1,Hkv,D]
+        k, v = _project_kv(cfg, layer_params, h, positions, lora=lora_l)  # [S,1,Hkv,D]
         # masked scatter: inactive slots write to the reserved null page 0
         # at offset 0 — harmless garbage no table points to for reading
         wb = jnp.where(active, w_block, 0)
@@ -559,7 +603,8 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
             from colossalai_tpu.kernel import fused_add_rms_norm
             from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
 
-            q = _proj(h, layer_params["self_attn"]["q_proj"], dtype)
+            q = _proj(h, layer_params["self_attn"]["q_proj"], dtype,
+                      lora=lora_l, lora_name="q_proj")
             q = q.reshape(n_slots, cfg.num_attention_heads, cfg.head_dim_)
             cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
             q = apply_rope(q[:, None], cos, sin)[:, 0]
@@ -569,6 +614,7 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
             attn_out = _row_matmul(
                 attn.astype(dtype), layer_params["self_attn"]["o_proj"],
                 dtype, overlap_chunks=overlap_chunks,
+                lora=lora_l, lora_name="o_proj",
             )
             # fused residual+norm kernel: h2 = rms(x + attn_out), x = x + attn_out
             h2, x = fused_add_rms_norm(
@@ -581,12 +627,17 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
                 counts = counts + moe_expert_counts(r, cap, n_experts, active)
             else:
                 mlp = layer_params["mlp"]
-                gate = _matmul(h2, mlp["gate_proj"]["kernel"],
-                               mlp["gate_proj"].get("scale"), dtype)
-                up = _matmul(h2, mlp["up_proj"]["kernel"],
-                             mlp["up_proj"].get("scale"), dtype)
+                gate = _lora_apply(
+                    _matmul(h2, mlp["gate_proj"]["kernel"],
+                            mlp["gate_proj"].get("scale"), dtype),
+                    h2, lora_l, "gate_proj")
+                up = _lora_apply(
+                    _matmul(h2, mlp["up_proj"]["kernel"],
+                            mlp["up_proj"].get("scale"), dtype),
+                    h2, lora_l, "up_proj")
                 x = x + _row_matmul(jax.nn.silu(gate) * up, mlp["down_proj"],
-                                    dtype, overlap_chunks=overlap_chunks)
+                                    dtype, overlap_chunks=overlap_chunks,
+                                    lora=lora_l, lora_name="down_proj")
         else:
             # XLA path: gather this slot's pages into a contiguous view
             # [S, max_blocks, Hkv, bs, D] → [S, s_max, Hkv, D]
@@ -602,7 +653,7 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
             x, moe_aux = _block_step(
                 cfg, layer_params, x, k_seq, v_seq, positions, attend,
                 moe_fused=moe_fused, return_moe_routing=True,
-                overlap_chunks=overlap_chunks,
+                overlap_chunks=overlap_chunks, lora=lora_l,
             )
             if has_moe:
                 r, cap = moe_aux
@@ -612,7 +663,8 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
     counts0 = jnp.zeros((n_experts,), jnp.int32)
     (x, counts, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
         layer, (x.astype(dtype), counts0, 0),
-        (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale,
+         _lora_xs(lora)),
     )
     return (_logits_head(p, cfg, x)[:, 0],
             PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new),
@@ -625,7 +677,7 @@ def _decode_once(p, cfg: LlamaConfig, tokens, block_tables, lengths,
 def decode_paged(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, use_kernel: bool = False, moe_fused: bool = False,
-    overlap_chunks: int = 1,
+    overlap_chunks: int = 1, lora=None,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """One token per slot through the paged pool.
 
@@ -635,14 +687,15 @@ def decode_paged(
     p = params["params"] if "params" in params else params
     logits, cache, _ = _decode_once(
         p, cfg, tokens, block_tables, lengths, cache, active,
-        use_kernel, moe_fused, overlap_chunks,
+        use_kernel, moe_fused, overlap_chunks, lora,
     )
     return logits, cache
 
 
 def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                  cache: PagedKVCache, active, use_kernel: bool,
-                 moe_fused: bool = False, overlap_chunks: int = 1):
+                 moe_fused: bool = False, overlap_chunks: int = 1,
+                 lora=None):
     """One MULTI-TOKEN decode iteration: tokens [S, W] at positions
     ``lengths .. lengths+W-1`` → (logits [S, W, V], cache).
 
@@ -686,9 +739,10 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
 
     def layer(carry, inputs):
         x, i = carry
-        layer_params, k_pool, v_pool, k_sc, v_sc = inputs
+        layer_params, k_pool, v_pool, k_sc, v_sc, lora_sl = inputs
+        lora_l = _lora_layer(lora, lora_sl)
         h = _rms(x, layer_params["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        k, v = _project_kv(cfg, layer_params, h, positions)  # [S,W,Hkv,D]
+        k, v = _project_kv(cfg, layer_params, h, positions, lora=lora_l)  # [S,W,Hkv,D]
         if k_sc is not None:
             # sequential per-token appends: window tokens can share a page,
             # and the running-absmax rescale must see each predecessor's
@@ -709,7 +763,8 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
             from colossalai_tpu.kernel import fused_add_rms_norm
             from colossalai_tpu.kernel.pallas.paged_attention import paged_attention
 
-            q = _proj(h, layer_params["self_attn"]["q_proj"], dtype)
+            q = _proj(h, layer_params["self_attn"]["q_proj"], dtype,
+                      lora=lora_l, lora_name="q_proj")
             q = q.reshape(n_slots, w, cfg.num_attention_heads, cfg.head_dim_)
             cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
             q = apply_rope(q, cos, sin)
@@ -721,6 +776,7 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
             attn_out = _row_matmul(
                 attn.astype(dtype), layer_params["self_attn"]["o_proj"],
                 dtype, overlap_chunks=overlap_chunks,
+                lora=lora_l, lora_name="o_proj",
             )
             h2, x = fused_add_rms_norm(
                 x, attn_out, layer_params["post_attention_layernorm"]["scale"],
@@ -731,12 +787,17 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
                 x = x + y
             else:
                 mlp = layer_params["mlp"]
-                gate = _matmul(h2, mlp["gate_proj"]["kernel"],
-                               mlp["gate_proj"].get("scale"), dtype)
-                up = _matmul(h2, mlp["up_proj"]["kernel"],
-                             mlp["up_proj"].get("scale"), dtype)
+                gate = _lora_apply(
+                    _matmul(h2, mlp["gate_proj"]["kernel"],
+                            mlp["gate_proj"].get("scale"), dtype),
+                    h2, lora_l, "gate_proj")
+                up = _lora_apply(
+                    _matmul(h2, mlp["up_proj"]["kernel"],
+                            mlp["up_proj"].get("scale"), dtype),
+                    h2, lora_l, "up_proj")
                 x = x + _row_matmul(jax.nn.silu(gate) * up, mlp["down_proj"],
-                                    dtype, overlap_chunks=overlap_chunks)
+                                    dtype, overlap_chunks=overlap_chunks,
+                                    lora=lora_l, lora_name="down_proj")
         else:
             def to_seq(pool, sc):
                 g = pool[block_tables]  # [S, mb, Hkv, bs, D]
@@ -747,12 +808,14 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
 
             x = _block_step(cfg, layer_params, x, to_seq(k_pool, k_sc),
                             to_seq(v_pool, v_sc), positions, attend,
-                            moe_fused=moe_fused, overlap_chunks=overlap_chunks)
+                            moe_fused=moe_fused, overlap_chunks=overlap_chunks,
+                            lora=lora_l)
         return (x, i + 1), (k_pool, v_pool, k_sc, v_sc)
 
     (x, _), (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
         layer, (x.astype(dtype), 0),
-        (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale,
+         _lora_xs(lora)),
     )
     return (_logits_head(p, cfg, x),
             PagedKVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new))
@@ -764,7 +827,7 @@ def _extend_once(p, cfg: LlamaConfig, tokens, block_tables, lengths, limits,
 def verify_paged(
     params, cfg: LlamaConfig, tokens, block_tables, lengths, cache: PagedKVCache,
     active, use_kernel: bool = False, moe_fused: bool = False,
-    overlap_chunks: int = 1,
+    overlap_chunks: int = 1, lora=None,
 ) -> Tuple[jax.Array, PagedKVCache]:
     """W tokens per slot through the paged pool in ONE forward — the
     standalone multi-token verify entry (the speculative megastep traces
@@ -776,7 +839,7 @@ def verify_paged(
     limits = lengths + tokens.shape[1]
     return _extend_once(
         p, cfg, tokens, block_tables, lengths, limits, cache,
-        active, use_kernel, moe_fused, overlap_chunks,
+        active, use_kernel, moe_fused, overlap_chunks, lora,
     )
 
 
@@ -791,6 +854,7 @@ def decode_megastep(
     active, budgets, eos_ids, temp, topk, topp, do_sample, rng_keys,
     k_steps: int, use_kernel: bool = False, use_sampling: bool = False,
     moe_fused: bool = False, tp_shard: bool = False, overlap_chunks: int = 1,
+    lora=None,
 ):
     """Device-resident decode loop: ``k_steps`` iterations of
     forward→sample→commit inside one ``lax.fori_loop`` — ONE dispatch and
@@ -829,7 +893,7 @@ def decode_megastep(
     def decode_once(tok, lens, cache_i, alive):
         return _decode_once(
             p, cfg, tok, block_tables, lens, cache_i, alive, use_kernel,
-            moe_fused, overlap_chunks,
+            moe_fused, overlap_chunks, lora,
         )
 
     return megastep_loop(

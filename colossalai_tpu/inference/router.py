@@ -243,6 +243,7 @@ class Router:
         # as clt_router_* counter families — linted in test_metric_names)
         self.requests_routed = 0
         self.cache_hit_placements = 0
+        self.adapter_affinity_placements = 0
         self.least_loaded_placements = 0
         self.round_robin_placements = 0
         self.replica_drains = 0
@@ -428,7 +429,8 @@ class Router:
         self.slo_avoided_placements += 1
         return [i for i in candidates if i not in breached]
 
-    def _place(self, prompt_ids: List[int]) -> int:
+    def _place(self, prompt_ids: List[int],
+               adapter_id: Optional[str] = None) -> int:
         eligible = [i for i in range(len(self.engines))
                     if not self._draining[i]
                     and self._health[i] not in ("dead", "retired")]
@@ -439,6 +441,24 @@ class Router:
             )
         if self.slo_aware:
             eligible = self._slo_healthy(eligible)
+        if adapter_id is not None:
+            # adapter affinity: a replica where the adapter already sits
+            # in a device slot serves it without the upload fault; only
+            # replicas that KNOW the adapter are eligible at all
+            knowing = [i for i in eligible
+                       if getattr(self.engines[i], "lora", None) is not None
+                       and adapter_id in self.engines[i].lora.registered()]
+            if not knowing:
+                raise ValueError(
+                    f"adapter {adapter_id!r} is registered on no eligible "
+                    "replica — push_adapter it first"
+                )
+            warm = [i for i in knowing
+                    if self.engines[i].lora.slot_of(adapter_id) is not None]
+            if warm:
+                self.adapter_affinity_placements += 1
+                return self._pick_balanced(warm)
+            eligible = knowing
         if self.policy == "round_robin":
             pick = eligible[self._rr % len(eligible)]
             self._rr += 1
@@ -459,6 +479,7 @@ class Router:
     def add_request(
         self, prompt_ids, gen: Optional[GenerationConfig] = None,
         n_samples: int = 1, priority: int = 0,
+        adapter_id: Optional[str] = None,
     ) -> Union[int, List[int]]:
         """Route one prompt (or one grouped-sampling request — a group
         lands whole on one replica, same as one engine requires) and
@@ -473,10 +494,14 @@ class Router:
         prompt_ids = list(map(int, prompt_ids))
         tr = self.tracer
         t0 = tr._clock() if tr is not None else 0.0
-        i = self._place(prompt_ids)
+        i = self._place(prompt_ids, adapter_id=adapter_id)
         self.requests_routed += n_samples
+        # only forward the kwarg when set — disagg replicas (no LoRA
+        # serving path) keep their narrower add_request signature
+        extra = {} if adapter_id is None else {"adapter_id": adapter_id}
         rids = self.engines[i].add_request(
-            prompt_ids, gen, n_samples=n_samples, priority=priority)
+            prompt_ids, gen, n_samples=n_samples, priority=priority,
+            **extra)
         if tr is not None:
             # stitch the routing decision UNDER the root the replica just
             # opened (groups trace through their leader) — the root widens
@@ -623,6 +648,29 @@ class Router:
     def close(self) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
+
+    # --------------------------------------------------------- LoRA adapters
+    def push_adapter(self, adapter_id: str, lora,
+                     alpha: Optional[float] = None) -> int:
+        """Register a LoRA adapter on every live LoRA-serving replica
+        (the fleet-wide twin of ``LLMEngine.register_adapter``) so
+        placement is free to land the adapter's requests anywhere.
+        Host-side only — no replica uploads until a request faults the
+        adapter into its pool. Returns the number of replicas that took
+        the registration; raises when NO replica serves LoRA."""
+        n = 0
+        for i, e in enumerate(self.engines):
+            if self._health[i] in ("dead", "retired"):
+                continue
+            if getattr(e, "lora", None) is not None:
+                e.register_adapter(adapter_id, lora, alpha=alpha)
+                n += 1
+        if n == 0:
+            raise RuntimeError(
+                "no live replica was built with lora_serving= — "
+                "push_adapter has nowhere to register"
+            )
+        return n
 
     # ------------------------------------------------------ health / draining
     def drain(self, i: int, role: str = "all") -> None:
@@ -800,6 +848,7 @@ class Router:
         return {
             "router_requests_routed": self.requests_routed,
             "router_cache_hit_placements": self.cache_hit_placements,
+            "router_adapter_affinity_placements": self.adapter_affinity_placements,
             "router_least_loaded_placements": self.least_loaded_placements,
             "router_round_robin_placements": self.round_robin_placements,
             "router_replica_drains": self.replica_drains,

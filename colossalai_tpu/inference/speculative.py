@@ -459,7 +459,7 @@ def decode_spec_megastep(
     cache: PagedKVCache, draft_cache: PagedKVCache, active, budgets, eos_ids,
     temp, topk, topp, do_sample, rng_keys, k_steps: int, draft_len: int,
     use_kernel: bool = False, use_sampling: bool = False,
-    tp_shard: bool = False, overlap_chunks: int = 1,
+    tp_shard: bool = False, overlap_chunks: int = 1, lora=None,
 ):
     """Device-resident SPECULATIVE decode megastep over the paged pool —
     ``decode_megastep`` with a draft/verify inner loop: per iteration the
@@ -468,7 +468,14 @@ def decode_spec_megastep(
     the target verifies all ``draft_len+1`` in one multi-token paged
     forward, and the matching prefix + correction commit on device. ONE
     dispatch and ONE host sync per megastep; see :func:`spec_megastep_loop`
-    for inputs/outputs."""
+    for inputs/outputs.
+
+    ``lora`` (the multi-tenant adapter operand) applies to the TARGET
+    forward only: under greedy verification the committed tokens are
+    exactly the target's greedy outputs whatever the draft proposes, so
+    an un-adapted draft keeps token identity while a per-tenant draft
+    pool would double the adapter cache footprint for no correctness
+    gain (a cold draft just lowers the acceptance rate)."""
     if draft_len < 1:
         raise ValueError(f"draft_len={draft_len} must be >= 1 here "
                          "(draft_len=0 is the plain decode_megastep)")
@@ -478,7 +485,7 @@ def decode_spec_megastep(
     def target_extend(toks, lens, limits, kv, alive):
         return _extend_once(
             p, cfg, toks, block_tables, lens, limits, kv, alive, use_kernel,
-            overlap_chunks=overlap_chunks)
+            overlap_chunks=overlap_chunks, lora=lora)
 
     def draft_extend(toks, lens, limits, kv, alive):
         # the draft's hidden size may differ from the target's: chunks that

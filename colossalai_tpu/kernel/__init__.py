@@ -15,6 +15,7 @@ from .ops import (
     fused_moe,
     fused_rms_norm,
     fused_softmax,
+    lora_matmul,
     quant_matmul,
     rope_and_cache_update,
     rope_embed,
@@ -30,6 +31,7 @@ __all__ = [
     "fused_moe",
     "fused_rms_norm",
     "fused_softmax",
+    "lora_matmul",
     "quant_matmul",
     "rope_and_cache_update",
     "rope_embed",

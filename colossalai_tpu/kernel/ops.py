@@ -151,6 +151,48 @@ def quant_matmul(x, wq, scale, out_dtype=None):
     return KernelLoader.load("quant_matmul")(x, wq, scale, out_dtype=out_dtype)
 
 
+# ---------------------------------------------------- LoRA gather-matmul
+# multi-tenant adapter epilogue (inference/lora_serving.py): each batch
+# row gathers its own rank-r (A, B) factor pair out of the paged adapter
+# slabs, so a mixed batch of N adapters runs one compiled program
+
+
+def _lora_matmul_xla(h, a, b, slots, scaling, out_dtype=None):
+    """The reference chain the Pallas kernel must reproduce bitwise:
+    gather the factor pair per row, contract twice in f32, scale in f32,
+    cast last."""
+    out_dtype = jnp.dtype(out_dtype if out_dtype is not None else h.dtype)
+    slots = slots.astype(jnp.int32)
+    af = a[slots].astype(jnp.float32)     # [S, in, r]
+    bf = b[slots].astype(jnp.float32)     # [S, r, out]
+    acc = jnp.einsum("swi,sir->swr", h.astype(jnp.float32), af,
+                     preferred_element_type=jnp.float32)
+    acc = jnp.einsum("swr,sro->swo", acc, bf,
+                     preferred_element_type=jnp.float32)
+    scale = scaling.astype(jnp.float32)[slots][:, None, None]
+    return (acc * scale).astype(out_dtype)
+
+
+def _lora_matmul_pallas(h, a, b, slots, scaling, out_dtype=None):
+    from .pallas.lora_matmul import lora_matmul as lm
+
+    return lm(h, a, b, slots, scaling, out_dtype=out_dtype)
+
+
+KernelLoader.register("lora_matmul", "pallas", _pallas_module("lora_matmul"), _lora_matmul_pallas)
+KernelLoader.register("lora_matmul", "xla", lambda: True, _lora_matmul_xla)
+
+
+def lora_matmul(h, a, b, slots, scaling, out_dtype=None):
+    """Batched LoRA delta ``(h[s] @ a[slots[s]] @ b[slots[s]]) *
+    scaling[slots[s]]`` for ``h [S, W, in]`` against paged adapter slabs
+    ``a [P, in, r]`` / ``b [P, r, out]``. Slot 0 is the null adapter
+    (zero factors) — base-model rows produce exact zeros through the
+    same program."""
+    return KernelLoader.load("lora_matmul")(h, a, b, slots, scaling,
+                                            out_dtype=out_dtype)
+
+
 # ---------------------------------------------------------------- LayerNorm
 # ≙ layer_norm_kernel.cu (683 LoC, Apex lineage)
 

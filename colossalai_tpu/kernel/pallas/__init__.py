@@ -12,6 +12,7 @@ from .flash_attention import (
 )
 from .fused_moe import fused_moe
 from .layer_norm import layer_norm
+from .lora_matmul import lora_matmul
 from .paged_attention import paged_attention
 from .quant_matmul import quant_matmul
 from .rms_norm import fused_add_rms_norm, rms_norm
@@ -29,6 +30,7 @@ __all__ = [
     "fused_moe",
     "fused_rope",
     "layer_norm",
+    "lora_matmul",
     "paged_attention",
     "quant_matmul",
     "rms_norm",

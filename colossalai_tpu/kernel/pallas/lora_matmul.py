@@ -1,0 +1,119 @@
+"""Pallas batched gather-matmul for multi-tenant LoRA serving.
+
+``inference/lora_serving.py`` keeps every resident adapter's (A, B)
+factor pair for one projection in paged device slabs ``a [P, in, r]`` /
+``b [P, r, out]`` (slot 0 is the reserved all-zeros null adapter). A
+mixed decode batch carries a per-sequence slot index, and this kernel
+computes every row's rank-r delta in one launch:
+
+    y[s, w, :] = (h[s, w, :] @ A[slots[s]] @ B[slots[s]]) * scaling[slots[s]]
+
+The slot indices and per-slot scaling ride the scalar-prefetch channel
+(the ``paged_attention`` block-table idiom), so each grid step DMAs only
+its own sequence's factor pair — N different adapters in one batch cost
+one compiled program, never a per-tenant recompile.
+
+Both contractions accumulate in f32, the scaling multiply stays in f32,
+and the cast to the output dtype comes last. Output-column tiles span
+the full contraction dims, so each element is one whole dot-product
+chain — bitwise-interchangeable with the XLA gather reference
+(``kernel/ops.py::_lora_matmul_xla``), which is what lets the engine
+flip between kernel and XLA epilogues without perturbing greedy argmax.
+``tests/test_kernel/test_lora_matmul.py`` pins the parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_mode as _interpret
+
+#: static output-column tile cap, clamped to a divisor of the actual out
+#: dim (whole-dim fallback — the parity configuration); the tuned value
+#: comes through ``tuning.lora_matmul_block``
+_BLOCK_COLS = 512
+
+
+def _pick(cap: int, n: int) -> int:
+    """Largest divisor-of-n tile <= cap (whole-dim fallback)."""
+    t = min(cap, n)
+    while n % t:
+        t -= 1
+    return t
+
+
+def _kernel(slots_ref, scaling_ref, h_ref, a_ref, b_ref, o_ref):
+    s = pl.program_id(0)
+    # f32 chain: dot(h, A) -> dot(., B) -> * scaling, cast LAST — the
+    # exact chain _lora_matmul_xla reproduces
+    hw = jnp.dot(
+        h_ref[0].astype(jnp.float32),
+        a_ref[0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    acc = jnp.dot(
+        hw,
+        b_ref[0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    scale = scaling_ref[slots_ref[s]].astype(jnp.float32)
+    o_ref[0] = (acc * scale).astype(o_ref.dtype)
+
+
+def _tuned_cols(n_out: int, r: int, dtype) -> int:
+    """Column tile from the tuning cache (static legal default off-TPU);
+    never let tuning break the hot path."""
+    try:
+        from .. import tuning
+
+        return tuning.lora_matmul_block(n_out, r, dtype)
+    except Exception:
+        return _pick(_BLOCK_COLS, n_out)
+
+
+def lora_matmul(h, a, b, slots, scaling, out_dtype=None):
+    """``h [S, W, in] x slabs a [P, in, r] / b [P, r, out]`` gathered per
+    sequence by ``slots [S] int32`` and scaled by ``scaling [P] f32``
+    → ``[S, W, out]``.
+
+    ``out_dtype`` defaults to ``h.dtype``; accumulation is always f32.
+    Slot 0 is the null adapter (zero factors, zero scaling) — base-model
+    rows run the same program and produce exact zeros."""
+    out_dtype = jnp.dtype(out_dtype if out_dtype is not None else h.dtype)
+    n_seq, window, d_in = h.shape
+    r = a.shape[-1]
+    n_out = b.shape[-1]
+    slots = slots.astype(jnp.int32)
+    cols = _pick(_tuned_cols(n_out, r, h.dtype), n_out)
+
+    def h_map(s, j, *_pf):
+        return (s, 0, 0)
+
+    def a_map(s, j, *pf):
+        return (pf[0][s], 0, 0)
+
+    def b_map(s, j, *pf):
+        return (pf[0][s], 0, j)
+
+    def o_map(s, j, *_pf):
+        return (s, 0, j)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_seq, pl.cdiv(n_out, cols)),
+        in_specs=[
+            pl.BlockSpec((1, window, d_in), h_map),
+            pl.BlockSpec((1, d_in, r), a_map),
+            pl.BlockSpec((1, r, cols), b_map),
+        ],
+        out_specs=pl.BlockSpec((1, window, cols), o_map),
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_seq, window, n_out), out_dtype),
+        interpret=_interpret(),
+    )(slots, scaling, h, a, b)

@@ -393,6 +393,34 @@ def overlap_chunks(
     )
 
 
+def lora_matmul_block(
+    n_out: int, r: int, dtype,
+    measure: Optional[Callable[[int], float]] = None, default: int = 512,
+) -> int:
+    """Output-column tile for the batched LoRA gather-matmul
+    (``kernel/pallas/lora_matmul.py``): each grid step streams one
+    sequence's ``[r, cols]`` B tile, so wider tiles amortize the slab
+    DMA while narrower ones overlap it against the rank-r contraction.
+    Candidates must divide ``n_out`` — a ragged tail tile would split a
+    dot product and break the bitwise parity contract with the XLA
+    gather reference. The key carries the rank alongside the projection
+    width and dtype (the A-side contraction scales with ``r``, so an
+    r=8 winner must not decide r=64's tiling). With no ``measure``
+    closure the largest legal candidate ≤ ``default`` is returned
+    statically — the deterministic off-TPU path."""
+    cands = [c for c in (128, 256, 512, 1024) if c <= n_out
+             and n_out % c == 0] or [n_out]
+    legal_default = max((c for c in cands if c <= max(int(default), 1)),
+                        default=cands[0])
+    if measure is None or len(cands) == 1:
+        return legal_default
+    return get_tuner().tune(
+        "lora_matmul",
+        (device_kind(), n_out, r, _dt(dtype)),
+        cands, measure, legal_default,
+    )
+
+
 def fused_moe_block_i(
     num_experts: int, top_k: int, hidden: int, intermediate: int, dtype,
     qlen: int, measure: Callable[[int], float],

@@ -68,8 +68,15 @@ def _target_leaves(params: Any, cfg: LoraConfig):
     """(keypath, leaf) pairs the config adapts; leading layer dim allowed."""
     out = []
     for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        if leaf.ndim in (2, 3) and cfg.matches(path_str(kp)):
-            out.append((kp, leaf))
+        if not cfg.matches(path_str(kp)):
+            continue
+        if leaf.ndim not in (2, 3):
+            raise ValueError(
+                f"target {path_str(kp)} has shape {tuple(leaf.shape)}; LoRA "
+                "adapts 2D kernels (or scanned (L, in, out) stacks) only — "
+                "tighten target_modules to exclude it"
+            )
+        out.append((kp, leaf))
     return out
 
 
@@ -89,12 +96,23 @@ def init_lora_params(params: Any, cfg: LoraConfig, rng: jax.Array) -> Any:
     becomes ``.../lora_a`` (in, r) gaussian and ``.../lora_b`` (r, out) zeros
     — the standard init making the adapted model exactly equal the base model
     at step 0."""
+    if cfg.r <= 0:
+        raise ValueError(f"LoraConfig.r must be a positive int, got {cfg.r}")
     targets = _target_leaves(params, cfg)
     if not targets:
         raise ValueError(
             f"LoraConfig{cfg.target_modules} matched no kernels; check "
             "target_modules against the model's param paths"
         )
+    for kp, leaf in targets:
+        d_in, d_out = leaf.shape[-2], leaf.shape[-1]
+        if cfg.r > min(d_in, d_out):
+            raise ValueError(
+                f"LoraConfig.r={cfg.r} exceeds min(in, out)={min(d_in, d_out)} "
+                f"for {path_str(kp)} {tuple(leaf.shape)}; a rank-r factorization "
+                "larger than the matrix rank wastes memory without adding "
+                "expressivity — lower r or narrow target_modules"
+            )
     flat = {}
     keys = jax.random.split(rng, len(targets))
     for key, (kp, leaf) in zip(keys, targets):
@@ -131,6 +149,23 @@ def merge_lora(base: Any, lora: Any, cfg: LoraConfig) -> Any:
         base = dequantize_tree(base, jax.tree_util.tree_leaves(lora)[0].dtype)
     lora_flat = _flat_by_path(lora)
     prefixes = {p.rsplit("/", 1)[0] for p in lora_flat}
+    base_prefixes = {
+        path_str(kp).rsplit("/", 1)[0]
+        for kp, _ in jax.tree_util.tree_flatten_with_path(base)[0]
+        if path_str(kp).endswith("kernel")
+    }
+    for prefix in sorted(prefixes):
+        for part in ("lora_a", "lora_b"):
+            if f"{prefix}/{part}" not in lora_flat:
+                raise ValueError(
+                    f"adapter tree is missing {prefix}/{part}; every adapted "
+                    "kernel needs a (lora_a, lora_b) factor pair"
+                )
+        if prefix not in base_prefixes:
+            raise ValueError(
+                f"adapter factors at {prefix} have no matching kernel in the "
+                "base tree; base and adapter come from different models"
+            )
 
     def visit(kp, leaf):
         path = path_str(kp)
@@ -139,6 +174,17 @@ def merge_lora(base: Any, lora: Any, cfg: LoraConfig) -> Any:
             return leaf
         a = lora_flat[f"{prefix}/lora_a"]
         b = lora_flat[f"{prefix}/lora_b"]
+        if (
+            a.shape[:-2] != leaf.shape[:-2]
+            or a.shape[-2] != leaf.shape[-2]
+            or b.shape[-1] != leaf.shape[-1]
+            or a.shape[-1] != b.shape[-2]
+        ):
+            raise ValueError(
+                f"adapter factors for {path} are incongruent with the kernel: "
+                f"kernel {tuple(leaf.shape)}, lora_a {tuple(a.shape)}, "
+                f"lora_b {tuple(b.shape)}"
+            )
         if leaf.ndim == 2:
             delta = a @ b
         else:

@@ -61,7 +61,7 @@ SPAN_CATALOG = frozenset({
     "prefix_cache_hit", "prefix_cache_evict", "page_refund",
     "router.place", "router.sync", "shed", "preempt", "resume",
     "kv_transfer", "kv_wire", "replica_dead", "failover", "kv_retry",
-    "fleet.spawn", "fleet.retire", "weight_swap",
+    "fleet.spawn", "fleet.retire", "weight_swap", "lora_upload",
 })
 
 

@@ -129,3 +129,66 @@ def test_lora_save_export_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(out_merged), np.asarray(out_eval), rtol=2e-5, atol=2e-5
     )
+
+
+def test_init_rejects_bad_rank_and_targets():
+    """Config validation fails fast with the offending path/shape in the
+    message — not deep inside a jit trace later."""
+    cfg = LlamaConfig.tiny()
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), _batch(cfg.vocab_size)["input_ids"]
+    )["params"]
+    with pytest.raises(ValueError, match="positive"):
+        init_lora_params(params, LoraConfig(r=0), jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="positive"):
+        init_lora_params(params, LoraConfig(r=-4), jax.random.PRNGKey(1))
+    # r above the smallest targeted matrix dim: factorization is vacuous
+    with pytest.raises(ValueError, match="exceeds min"):
+        init_lora_params(params, LoraConfig(r=100_000),
+                         jax.random.PRNGKey(1))
+    # a target regex that catches a non-2D leaf names the culprit
+    with pytest.raises(ValueError, match="2D kernels"):
+        init_lora_params(
+            {"emb": {"kernel": jnp.zeros((8,))}},
+            LoraConfig(r=2, target_modules=("emb",)),
+            jax.random.PRNGKey(1))
+    # no match at all is its own descriptive error
+    with pytest.raises(ValueError, match="matched no kernels"):
+        init_lora_params(params,
+                         LoraConfig(r=2, target_modules=("no_such_proj",)),
+                         jax.random.PRNGKey(1))
+
+
+def test_merge_rejects_incongruent_trees():
+    """merge_lora validates base/adapter congruence up front instead of
+    KeyError-ing inside tree_map: missing factor halves, orphan adapter
+    prefixes, and shape-mismatched factors all get descriptive errors."""
+    cfg = LlamaConfig.tiny()
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), _batch(cfg.vocab_size)["input_ids"]
+    )["params"]
+    lcfg = LoraConfig(r=4, lora_alpha=8.0)
+    adapters = init_lora_params(params, lcfg, jax.random.PRNGKey(1))
+
+    # a lora_a with no lora_b twin
+    broken = jax.tree.map(lambda x: x, adapters)  # deep-ish copy
+    del broken["layers"]["block"]["self_attn"]["q_proj"]["lora_b"]
+    with pytest.raises(ValueError, match="lora_b"):
+        merge_lora(params, broken, lcfg)
+
+    # adapter prefixes that exist in no base kernel (wrong model)
+    with pytest.raises(ValueError, match="no matching kernel"):
+        merge_lora(params, {"bogus": {"proj": {
+            "lora_a": jnp.zeros((4, 2)), "lora_b": jnp.zeros((2, 4))
+        }}}, lcfg)
+
+    # factor shapes incongruent with the base kernel
+    q = adapters["layers"]["block"]["self_attn"]["q_proj"]
+    mangled = jax.tree_util.tree_map_with_path(
+        lambda kp, x: x[..., :2, :] if "q_proj/lora_b" in "/".join(
+            str(getattr(k, "key", k)) for k in kp) else x,
+        adapters)
+    assert mangled["layers"]["block"]["self_attn"]["q_proj"][
+        "lora_b"].shape != q["lora_b"].shape
+    with pytest.raises(ValueError, match="incongruent"):
+        merge_lora(params, mangled, lcfg)

@@ -336,7 +336,7 @@ def test_span_names_match_grammar_over_engine_smoke():
                "prefix_cache_evict", "page_refund", "router.place",
                "router.sync", "shed", "preempt", "resume", "kv_transfer",
                "kv_wire", "replica_dead", "failover", "kv_retry",
-               "fleet.spawn", "fleet.retire", "weight_swap"}
+               "fleet.spawn", "fleet.retire", "weight_swap", "lora_upload"}
     assert catalog == set(SPAN_CATALOG)
     assert names <= catalog, names - catalog
 

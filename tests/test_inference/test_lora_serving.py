@@ -1,0 +1,347 @@
+"""Multi-tenant LoRA serving (inference/lora_serving.py + the
+``lora_serving=`` engine knob).
+
+The contracts under test:
+
+- TOKEN IDENTITY: greedy decoding through a resident pool slot equals an
+  engine built on offline ``merge_lora``-merged weights, token for token,
+  for every composition in the grid (megastep K, speculative self-draft,
+  int8 KV pages, a tp mesh) — the paged epilogue is the same math as the
+  merged matmul, f32-accumulated, applied per row;
+- base-model requests on a LoRA engine stay exactly on the no-LoRA
+  trajectory (slot 0 rows pass through the ``where`` bitwise-untouched),
+  including in a MIXED batch where other rows decode through adapters;
+- the pool is a real cache tier: faults upload at admission, hits pin
+  resident slots, eviction displaces only unpinned LRU slots, and an
+  all-pinned pool queues (never drops) the next tenant's admission;
+- adapter requests skip the prefix cache in both directions — adapter-
+  flavored KV must never be shared with another tenant or the base model;
+- composition gates (pp / sp_prefill) and admission validation fail fast.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.inference import GenerationConfig, LLMEngine
+from colossalai_tpu.inference.lora_serving import (
+    AdapterPool,
+    LoraServing,
+    SERVING_TARGETS,
+)
+from colossalai_tpu.models import LlamaConfig, LlamaForCausalLM
+from colossalai_tpu.peft import LoraConfig, init_lora_params, merge_lora
+from colossalai_tpu.shardformer.policies.base_policy import path_str
+
+R, ALPHA = 4, 8.0
+LORA_CFG = LoraConfig(r=R, lora_alpha=ALPHA, target_modules=SERVING_TARGETS)
+
+
+@pytest.fixture(scope="module")
+def parts():
+    """f32 compute so the adapter epilogue under test is the only delta."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params
+
+
+def _adapter(parts, seed):
+    """A non-trivial adapter tree: ``init_lora_params`` zeros B (the
+    step-0-identity init), so randomize every lora_b leaf — otherwise the
+    delta is zero and identity tests pass vacuously."""
+    cfg, params = parts
+    tree = init_lora_params(params, LORA_CFG, jax.random.PRNGKey(seed))
+    counter = [0]
+
+    def visit(kp, leaf):
+        if not path_str(kp).endswith("lora_b"):
+            return leaf
+        counter[0] += 1
+        k = jax.random.fold_in(jax.random.PRNGKey(seed + 1), counter[0])
+        return jax.random.normal(k, leaf.shape, leaf.dtype) * 0.5
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
+def _engine(parts, lora_kw=None, **kw):
+    cfg, params = parts
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("seed", 0)
+    if lora_kw is not None:
+        kw["lora_serving"] = LoraServing(r=R, alpha=ALPHA, **lora_kw)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _merged_engine(parts, adapter_tree, **kw):
+    cfg, params = parts
+    merged = merge_lora(params, adapter_tree, LORA_CFG)
+    return _engine((cfg, merged), **kw)
+
+
+_RNG = np.random.RandomState(7)
+PROMPTS = [list(map(int, _RNG.randint(0, 256, size=(n,))))
+           for n in (6, 11, 19)]
+GEN = GenerationConfig(max_new_tokens=12)
+
+
+def _drain(eng, jobs, gen=GEN):
+    """Run ``[(prompt, adapter_id)]`` jobs to completion, outputs in
+    submission order (the adapter-aware twin of ``generate``)."""
+    order = [eng.add_request(list(p), gen, adapter_id=aid)
+             for p, aid in jobs]
+    done = {}
+    while eng.has_work:
+        for r in eng.step():
+            done[r.request_id] = r
+    return [done[rid].output_ids for rid in order]
+
+
+# --------------------------------------------------- token-identity grid
+GRID = {
+    "plain": {},
+    "megastep_k4": {"megastep_k": 4},
+    "spec_self_draft": {"draft_len": 2, "self_draft_layers": 1},
+    "spec_k4": {"draft_len": 2, "self_draft_layers": 1, "megastep_k": 4},
+    "int8_kv": {"kv_dtype": "int8"},
+    "k4_int8": {"megastep_k": 4, "kv_dtype": "int8"},
+    "chunked_prefill": {"prefill_chunk": 16},
+}
+
+
+@pytest.mark.parametrize("kw", GRID.values(), ids=GRID.keys())
+def test_adapter_matches_offline_merge(parts, kw):
+    """Serving through the paged pool == decoding on offline-merged
+    weights, token for token, across the composition grid."""
+    adapter = _adapter(parts, seed=3)
+    ref = _merged_engine(parts, adapter, **kw).generate(
+        [list(p) for p in PROMPTS], GEN)
+    eng = _engine(parts, lora_kw={"slots": 4}, **kw)
+    eng.register_adapter("t1", adapter)
+    got = _drain(eng, [(p, "t1") for p in PROMPTS])
+    assert got == ref
+    # the adapter is not a no-op: the merged trajectory differs from base
+    base = _engine(parts, **kw).generate([list(p) for p in PROMPTS], GEN)
+    assert got != base
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_adapter_spec_int8_teacher_forced(parts, k):
+    """Speculative × int8 KV is the one composition where SEQUENCE
+    identity with the merged baseline is not ULP-guaranteed: merged-
+    weight matmul vs base-matmul-plus-epilogue differ in final-bit
+    rounding, the int8 page absmax scale inherits that ULP, and a flipped
+    quantization bucket can flip one near-tie argmax — which greedy
+    decoding then cascades autoregressively. Judge it the way
+    test_weight_quant judges quantizers: teacher-forced per-step
+    agreement against the merged reference trajectory."""
+    kw = dict(draft_len=2, self_draft_layers=1, megastep_k=k,
+              kv_dtype="int8")
+    adapter = _adapter(parts, seed=3)
+    ref = _merged_engine(parts, adapter, **kw).generate(
+        [list(p) for p in PROMPTS], GEN)
+    reqs, want = [], []
+    for p, out in zip(PROMPTS, ref):
+        ctx = list(p)
+        for tok in out:
+            reqs.append(list(ctx))
+            want.append(tok)
+            ctx.append(tok)
+    eng = _engine(parts, lora_kw={"slots": 4}, **kw)
+    eng.register_adapter("t1", adapter)
+    got = _drain(eng, [(p, "t1") for p in reqs],
+                 gen=GenerationConfig(max_new_tokens=1))
+    hits = sum(int(len(g) == 1 and g[0] == w)
+               for g, w in zip(got, want))
+    assert hits / len(want) >= 0.95, hits / len(want)
+
+
+def test_adapter_matches_offline_merge_tp2(parts):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a tp mesh")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    adapter = _adapter(parts, seed=3)
+    ref = _merged_engine(parts, adapter, mesh=mesh).generate(
+        [list(p) for p in PROMPTS], GEN)
+    eng = _engine(parts, lora_kw={"slots": 4}, mesh=mesh)
+    eng.register_adapter("t1", adapter)
+    assert _drain(eng, [(p, "t1") for p in PROMPTS]) == ref
+
+
+def test_base_requests_unperturbed(parts):
+    """An engine with a (resident!) adapter pool serves base requests
+    exactly like a no-LoRA engine — slot-0 rows ride the same program but
+    the null-adapter delta is exact zeros behind a pass-through where."""
+    ref = _engine(parts).generate([list(p) for p in PROMPTS], GEN)
+    eng = _engine(parts, lora_kw={"slots": 4})
+    eng.register_adapter("t1", _adapter(parts, seed=3))
+    # warm the slot so the base requests share a batch with resident slabs
+    _drain(eng, [(PROMPTS[0], "t1")])
+    assert _drain(eng, [(p, None) for p in PROMPTS]) == ref
+
+
+def test_mixed_batch_isolation(parts):
+    """Two tenants plus a base request decode CONCURRENTLY in one batch;
+    each row must match its own single-tenant reference exactly."""
+    a1 = _adapter(parts, seed=3)
+    a2 = jax.tree.map(lambda x: -x, a1)  # a genuinely different tenant
+    ref1 = _merged_engine(parts, a1).generate([list(PROMPTS[0])], GEN)[0]
+    ref2 = _merged_engine(parts, a2).generate([list(PROMPTS[1])], GEN)[0]
+    ref0 = _engine(parts).generate([list(PROMPTS[2])], GEN)[0]
+
+    eng = _engine(parts, lora_kw={"slots": 4})
+    eng.register_adapter("t1", a1)
+    eng.register_adapter("t2", a2)
+    got = _drain(eng, [(PROMPTS[0], "t1"), (PROMPTS[1], "t2"),
+                       (PROMPTS[2], None)])
+    assert got == [ref1, ref2, ref0]
+    assert eng.stats.lora_resident_adapters == 2
+    assert eng.stats.lora_adapter_pool_bytes > 0
+
+
+# ------------------------------------------------------ cache-tier audit
+def test_eviction_refcount_audit(parts):
+    """Three tenants through a two-slot pool: the third admission evicts
+    the LRU unpinned slot, counters account every fault/hit/eviction, and
+    refcounts return to zero when the batch drains."""
+    eng = _engine(parts, lora_kw={"slots": 2})
+    adapters = {f"t{i}": _adapter(parts, seed=10 + i) for i in (1, 2, 3)}
+    refs = {}
+    for aid, tree in adapters.items():
+        eng.register_adapter(aid, tree)
+        refs[aid] = _merged_engine(parts, tree).generate(
+            [list(PROMPTS[0])], GEN)[0]
+
+    # t1, t2 fill the pool; t3 must evict; t1 faults BACK in (2nd miss)
+    for aid in ("t1", "t2", "t3", "t1"):
+        assert _drain(eng, [(PROMPTS[0], aid)]) == [refs[aid]], aid
+    assert eng.stats.lora_misses == 4  # t1, t2, t3, t1-again
+    assert eng.stats.lora_evictions >= 2  # t3 displaced one, t1 another
+    assert eng.stats.lora_resident_adapters <= 2  # never above the pool
+    assert all(v == 0 for v in eng.lora.refcounts().values())
+
+    # a warm repeat is a pure hit: no new fault, no new eviction
+    misses, evictions = eng.stats.lora_misses, eng.stats.lora_evictions
+    _drain(eng, [(PROMPTS[0], "t1")])
+    assert eng.stats.lora_hits >= 1
+    assert (eng.stats.lora_misses, eng.stats.lora_evictions) == \
+        (misses, evictions)
+
+
+def test_all_pinned_pool_queues_not_drops(parts):
+    """With one slot and two tenants submitted together, the second
+    tenant's admission must WAIT for the first release — not error, not
+    drop — and both outputs stay correct."""
+    eng = _engine(parts, lora_kw={"slots": 1})
+    a1, a2 = _adapter(parts, seed=3), _adapter(parts, seed=5)
+    eng.register_adapter("t1", a1)
+    eng.register_adapter("t2", a2)
+    ref1 = _merged_engine(parts, a1).generate([list(PROMPTS[0])], GEN)[0]
+    ref2 = _merged_engine(parts, a2).generate([list(PROMPTS[1])], GEN)[0]
+    got = _drain(eng, [(PROMPTS[0], "t1"), (PROMPTS[1], "t2")])
+    assert got == [ref1, ref2]
+    assert eng.stats.requests_completed == 2
+
+
+def test_forced_evict_adapter(parts):
+    eng = _engine(parts, lora_kw={"slots": 2})
+    eng.register_adapter("t1", _adapter(parts, seed=3))
+    assert eng.evict_adapter("t1") is False  # not resident yet
+    _drain(eng, [(PROMPTS[0], "t1")])
+    assert eng.lora.slot_of("t1") is not None
+    assert eng.evict_adapter("t1") is True
+    assert eng.lora.slot_of("t1") is None
+    # registration survives: the next request faults it back in
+    misses = eng.lora.misses
+    _drain(eng, [(PROMPTS[0], "t1")])
+    assert eng.lora.misses == misses + 1
+
+
+def test_prefix_cache_tenant_isolation(parts):
+    """Adapter requests neither read nor seed the prefix cache: a base
+    request first donates the prompt's pages, then the SAME prompt via an
+    adapter must not hit them — and the adapter's own pages must not be
+    donated for the following base request to hit."""
+    eng = _engine(parts, lora_kw={"slots": 4}, prefix_cache=True)
+    eng.register_adapter("t1", _adapter(parts, seed=3))
+    prompt = PROMPTS[2]
+    _drain(eng, [(prompt, None)])  # donates prompt pages on release
+    hit0 = eng.stats.prefix_hit_blocks
+    _drain(eng, [(prompt, "t1")])  # must NOT consume the base prefix
+    assert eng.stats.prefix_hit_blocks == hit0
+    _drain(eng, [(prompt, "t1")])  # must NOT have donated adapter KV
+    assert eng.stats.prefix_hit_blocks == hit0
+    _drain(eng, [(prompt, None)])  # the base prefix is still there
+    assert eng.stats.prefix_hit_blocks > hit0
+
+
+# ------------------------------------------------- validation & gates
+def test_add_request_validation(parts):
+    eng = _engine(parts, lora_kw={"slots": 2})
+    with pytest.raises(ValueError, match="not registered"):
+        eng.add_request(PROMPTS[0], GEN, adapter_id="nope")
+    eng.register_adapter("t1", _adapter(parts, seed=3))
+    with pytest.raises(ValueError, match="n_samples"):
+        eng.add_request(PROMPTS[0], GEN, n_samples=2, adapter_id="t1")
+    plain = _engine(parts)
+    with pytest.raises(ValueError, match="lora_serving"):
+        plain.add_request(PROMPTS[0], GEN, adapter_id="t1")
+    with pytest.raises(RuntimeError, match="lora_serving"):
+        plain.register_adapter("t1", _adapter(parts, seed=3))
+
+
+def test_serving_config_validation(parts):
+    with pytest.raises(ValueError, match="slots"):
+        LoraServing(slots=0)
+    with pytest.raises(ValueError, match="r"):
+        LoraServing(r=0)
+    with pytest.raises(ValueError, match="lora_serving"):
+        _engine(parts, lora_serving="yes")
+
+
+def test_pool_register_validation(parts):
+    cfg, params = parts
+    pool = AdapterPool(cfg, LoraServing(slots=2, r=R, alpha=ALPHA))
+    # a lower-rank adapter zero-pads into the pool's rank-R slabs
+    small = init_lora_params(
+        params, LoraConfig(r=2, lora_alpha=4.0,
+                           target_modules=SERVING_TARGETS),
+        jax.random.PRNGKey(0))
+    pool.register("small", small)
+    # a HIGHER-rank adapter cannot fit the slabs: reject, don't truncate
+    big = init_lora_params(
+        params, LoraConfig(r=2 * R, lora_alpha=4.0 * R,
+                           target_modules=SERVING_TARGETS),
+        jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="rank"):
+        pool.register("big", big)
+
+
+def test_composition_gates(parts):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for pp/sp meshes")
+    from jax.sharding import Mesh
+
+    tp = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    with pytest.raises(NotImplementedError, match="sp_prefill"):
+        _engine(parts, lora_kw={"slots": 2}, mesh=tp, sp_prefill=0)
+    pp = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        _engine(parts, lora_kw={"slots": 2}, mesh=pp)
+
+
+def test_lora_gauges_on_metric_surface(parts):
+    eng = _engine(parts, lora_kw={"slots": 2})
+    eng.register_adapter("t1", _adapter(parts, seed=3))
+    _drain(eng, [(PROMPTS[0], "t1")])
+    d = eng.stats.as_dict()
+    for key in ("lora_hits", "lora_misses", "lora_evictions",
+                "lora_resident_adapters", "lora_adapter_pool_bytes"):
+        assert key in d, key
+    assert d["lora_misses"] == 1 and d["lora_resident_adapters"] == 1
+    assert d["lora_adapter_pool_bytes"] > 0

@@ -40,7 +40,7 @@ def test_loader_ops_are_registered():
     from colossalai_tpu.kernel.loader import KernelLoader
 
     for op in ("flash_attention", "rms_norm", "fused_moe", "paged_attention",
-               "sp_prefill_attention"):
+               "sp_prefill_attention", "lora_matmul"):
         assert op in KernelLoader._registry, (
             f"kernel op {op!r} never registered with KernelLoader"
         )

@@ -1,0 +1,95 @@
+"""Batched LoRA gather-matmul (kernel/pallas/lora_matmul.py) vs the XLA
+gather reference (kernel/ops.py::_lora_matmul_xla).
+
+The contract is BITWISE interchangeability when the output-column tile
+spans the whole projection width: both branches run the identical
+cast->dot(f32)->dot(f32)->scale(f32)->cast chain and each output element
+is one full dot-product chain, so the Pallas grid must not change a
+single ULP. That is what lets a ``lora_serving=`` engine flip between
+kernel and XLA epilogues (or recompile across prefill / megastep window
+shapes) without perturbing greedy argmax decisions — the token-identity
+grid in tests/test_inference/test_lora_serving.py leans on this.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colossalai_tpu.kernel.ops import _lora_matmul_xla
+from colossalai_tpu.kernel.pallas.lora_matmul import lora_matmul
+
+RNG = np.random.RandomState(0)
+
+
+def _operands(n_seq, window, d_in, r, n_out, n_slots=4, dtype=jnp.float32):
+    h = jnp.asarray(RNG.randn(n_seq, window, d_in), dtype)
+    # slot 0 is the reserved null adapter: zero factors, zero scaling
+    a = RNG.randn(n_slots, d_in, r)
+    b = RNG.randn(n_slots, r, n_out)
+    a[0] = 0.0
+    b[0] = 0.0
+    scaling = np.full((n_slots,), 2.0, np.float32)
+    scaling[0] = 0.0
+    slots = jnp.asarray(RNG.randint(0, n_slots, size=(n_seq,)), jnp.int32)
+    return (h, jnp.asarray(a, dtype), jnp.asarray(b, dtype), slots,
+            jnp.asarray(scaling))
+
+
+@pytest.mark.parametrize("shape", [
+    (1, 1, 64, 4, 64),     # single decode row
+    (4, 1, 64, 8, 128),    # mixed decode batch
+    (2, 16, 32, 8, 96),    # prefill window
+])
+def test_pallas_matches_xla_bitwise(shape):
+    # n_out <= the column-tile cap -> one whole-dim tile: the dots inside
+    # the kernel have the exact shape of the reference dots
+    h, a, b, slots, scaling = _operands(*shape)
+    out = lora_matmul(h, a, b, slots, scaling)
+    ref = _lora_matmul_xla(h, a, b, slots, scaling)
+    assert out.dtype == ref.dtype == h.dtype
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pallas_matches_xla_bitwise_bf16():
+    # cast-last epilogue: identical f32 accumulation, so the final bf16
+    # rounding lands on the same values too
+    h, a, b, slots, scaling = _operands(4, 2, 64, 8, 128,
+                                        dtype=jnp.bfloat16)
+    out = lora_matmul(h, a, b, slots, scaling, out_dtype=jnp.bfloat16)
+    ref = _lora_matmul_xla(h, a, b, slots, scaling, out_dtype=jnp.bfloat16)
+    assert out.dtype == jnp.dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pallas_tiled_grid_matches_xla():
+    # n_out above the column-tile cap: the grid splits the output columns
+    # but every tile still spans both contraction dims, so each output
+    # element remains one whole dot-product chain
+    h, a, b, slots, scaling = _operands(3, 4, 32, 4, 2048)
+    out = lora_matmul(h, a, b, slots, scaling)
+    ref = _lora_matmul_xla(h, a, b, slots, scaling)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_null_slot_rows_are_exact_zeros():
+    # base-model rows in a mixed batch run the same program; their delta
+    # must be exactly 0.0, not merely small — the engine's where() then
+    # leaves the base projection output bitwise-untouched
+    h, a, b, _, scaling = _operands(4, 2, 64, 8, 128)
+    slots = jnp.asarray([0, 2, 0, 3], jnp.int32)
+    out = np.asarray(lora_matmul(h, a, b, slots, scaling))
+    assert np.all(out[0] == 0.0) and np.all(out[2] == 0.0)
+    assert np.any(out[1] != 0.0) and np.any(out[3] != 0.0)
+
+
+def test_gather_selects_the_right_pair():
+    # per-row gather: a batch where every row names the same slot must
+    # equal the single-slot dense computation row by row
+    h, a, b, _, scaling = _operands(3, 2, 32, 4, 64)
+    for slot in (1, 2, 3):
+        slots = jnp.full((3,), slot, jnp.int32)
+        out = np.asarray(lora_matmul(h, a, b, slots, scaling))
+        dense = (np.asarray(h, np.float32) @ np.asarray(a, np.float32)[slot]
+                 @ np.asarray(b, np.float32)[slot]) * float(scaling[slot])
+        np.testing.assert_allclose(out, dense, atol=1e-5, rtol=1e-5)

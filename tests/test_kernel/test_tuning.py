@@ -231,6 +231,53 @@ def test_fused_moe_block_i_round_trip(tmp_path, monkeypatch):
     assert calls == [] and t.misses == 2
 
 
+def test_lora_matmul_block_round_trip(tmp_path, monkeypatch):
+    """The LoRA column tile keys on (projection width, RANK, dtype): the
+    A-side contraction scales with r, so an r=8 winner must not decide
+    r=64's tiling. Candidates must divide n_out (ragged tails would
+    split a dot product and break bitwise parity with the XLA gather
+    reference), a repeat lookup must hit without re-benchmarking, and
+    the measure-less path must return the static legal default."""
+    t = KernelTuner(cache_dir=str(tmp_path))
+    monkeypatch.setattr(tuning, "get_tuner", lambda: t)
+    monkeypatch.setattr(tuning, "tuning_enabled", lambda: True)
+
+    times = {128: 0.003, 256: 0.001, 512: 0.002, 1024: 0.005}
+    calls = []
+
+    def measure(cols):
+        calls.append(cols)
+        return times[cols]
+
+    got = tuning.lora_matmul_block(2048, 8, "bfloat16", measure)
+    assert got == 256  # the measured winner among the divisor candidates
+    assert sorted(set(calls)) == [128, 256, 512, 1024]
+    assert t.misses == 1
+
+    # same width, different rank → a distinct key, measured again
+    got64 = tuning.lora_matmul_block(2048, 64, "bfloat16", measure)
+    assert got64 == 256 and t.misses == 2
+    keys = list(t.chosen)
+    assert any(k.endswith("|2048|8|bfloat16") for k in keys), keys
+    assert any(k.endswith("|2048|64|bfloat16") for k in keys), keys
+    assert all(k.startswith("lora_matmul|") for k in keys), keys
+
+    # repeat of the first config: pure cache hit, no re-benchmark
+    calls.clear()
+    assert tuning.lora_matmul_block(2048, 8, "bfloat16", measure) == 256
+    assert calls == [] and t.hits == 1 and t.misses == 2
+
+    # no measure closure: static largest-legal-<=default, tuner untouched
+    assert tuning.lora_matmul_block(2048, 8, "float32") == 512
+    assert tuning.lora_matmul_block(192, 8, "float32") == 192  # no divisor cand
+    assert t.misses == 2
+
+    # narrow projection: every candidate must divide n_out exactly
+    calls.clear()
+    assert tuning.lora_matmul_block(256, 4, "float32", measure) == 256
+    assert sorted(set(calls)) == [128, 256]
+
+
 def test_sp_prefill_blocks_keys_on_ring_degree(tmp_path, monkeypatch):
     """The sp-prefill hop tunes under its own "sp_prefill" kernel entry,
     keyed by (seq buckets, head dim, dtype, RING DEGREE): the same local

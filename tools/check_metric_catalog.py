@@ -11,8 +11,9 @@ metric names and span table out of the docs, and fails on any mismatch:
 
 - every ``clt_*`` family the docs mention must be emitted by some
   renderer and obey the Prometheus grammar;
-- every ``clt_capacity_*`` and ``clt_kvwire_*`` family the code emits
-  must be documented (the strict direction for the newest families);
+- every ``clt_capacity_*``, ``clt_kvwire_*`` and ``clt_lora_*`` family
+  the code emits must be documented (the strict direction for the
+  newest families);
 - every ``clt_fault_*`` family and the router failover counters must be
   documented too — a chaos drill is exactly when an undocumented
   counter hurts most;
@@ -250,6 +251,19 @@ def run_checks(doc_text=None):
         failures.append(
             f"code emits {name} but docs/observability.md does not "
             "document it (extend the KV-wire counter table)")
+
+    # the LoRA serving family is strict in both directions: multi-tenant
+    # capacity planning reads these (pool occupancy, hit rate, eviction
+    # churn), so every clt_lora_* counter must carry a doc row
+    lora = {n for n in catalogs["serving"] if n.startswith("clt_lora_")}
+    if not lora:
+        failures.append(
+            "EngineStats no longer emits any clt_lora_* family — the "
+            "adapter pool lost its counters")
+    for name in sorted(lora - documented):
+        failures.append(
+            f"code emits {name} but docs/observability.md does not "
+            "document it (extend the LoRA serving counter table)")
 
     # the fault + failover families are strict in BOTH directions too:
     # a chaos drill is exactly when an undocumented counter hurts most
