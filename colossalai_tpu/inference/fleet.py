@@ -95,6 +95,11 @@ from ..telemetry.capacity import ScalingSignal, combine_signals
 #: traces use non-negative ids, so -1 can never collide)
 FLEET_TRACE_ID = -1
 
+#: spans retained per seat for the post-mortem dump — the most recent
+#: harvested window of a replica's flight recorder, written out as a
+#: Chrome trace when that replica dies
+_POSTMORTEM_SPANS = 512
+
 #: every ``clt_fleet_*`` counter the controller can emit — a static
 #: tuple so the metric-catalog lint renders the family without building
 #: a fleet (mirrors ``FaultInjector.prom_counters``'s static seams)
@@ -537,6 +542,26 @@ def _handle_op(engine: LLMEngine, state: Dict, header: Dict,
         state["kv_receivers"].append(recv)
         host, port = recv.advertise()
         reply.update({"host": host, "port": port, "pool": name})
+    elif op == "trace":
+        # cross-process span harvest: ship every CLOSED span this
+        # replica's flight recorder committed since the controller's
+        # last mark (span ids mint monotonically per tracer, so the
+        # mark is a plain high-water id). Open spans stay behind —
+        # they'll ship once they close. Replicas without a tracer
+        # report tracer=False so the controller stops asking.
+        tr = engine.telemetry.tracer
+        since = int(header.get("since", -1))
+        if tr is None:
+            reply.update({"tracer": False, "spans": [], "last": since})
+        else:
+            with tr._lock:
+                spans = [s.as_dict() for s in tr._buf
+                         if s.span_id > since and s.t1 is not None]
+            reply.update({
+                "tracer": True,
+                "spans": spans,
+                "last": max((s["span_id"] for s in spans), default=since),
+            })
     elif op == "kv_checksum":
         pool = state["kv_pools"][str(header.get("pool", "kv"))]
         idx = np.asarray([int(b) for b in header["blocks"]], np.int32)
@@ -1154,6 +1179,8 @@ class FleetController:
         grace_s: float = 5.0,
         tracer=None,
         signal_poll_s: float = 0.5,
+        trace_poll_s: Optional[float] = None,
+        postmortem_dir: Optional[str] = None,
         spawn_inline: Optional[bool] = None,
         chips_per_replica: int = 1,
     ):
@@ -1178,6 +1205,18 @@ class FleetController:
         self.signal_poll_s = float(signal_poll_s)
         self.chips_per_replica = int(chips_per_replica)
         self.tracer = tracer
+        # cross-process span harvest: with trace_poll_s set (and a
+        # controller tracer attached) the tick drains each child's
+        # flight recorder into this process's trace on per-replica
+        # tracks; the last harvested window per seat is kept for a
+        # post-mortem dump when that replica dies
+        self.trace_poll_s = (float(trace_poll_s)
+                             if trace_poll_s is not None else None)
+        self.postmortem_dir = postmortem_dir
+        self._trace_marks: Dict[int, int] = {}   # seat -> high-water span id
+        self._trace_absent: set = set()          # seats without a tracer
+        self._last_harvest: Dict[int, List[Dict]] = {}
+        self._last_trace_poll = 0.0
         # id arithmetic must survive the fleet's MAXIMUM size, with slack
         # so a seat freed by retirement isn't immediately remintable
         self.id_stride = int(id_stride if id_stride is not None
@@ -1374,6 +1413,10 @@ class FleetController:
             self._reap_dead()
             self._finish_retirements()
             self._poll_signals(now)
+            if (self.tracer is not None and self.trace_poll_s is not None
+                    and now - self._last_trace_poll > self.trace_poll_s):
+                self._last_trace_poll = now
+                self.harvest_traces()
             self._maybe_scale()
             self._update_gauges()
 
@@ -1418,6 +1461,8 @@ class FleetController:
             eng = self.router.engines[i]
             if isinstance(eng, RemoteReplica):
                 eng.close()
+            self._dump_postmortem(seat)
+            self._drop_trace_state(seat)
             handle.terminate(self.grace_s, self.counters)
             self.router.remove_replica(i)
             self._retiring.discard(i)
@@ -1447,11 +1492,83 @@ class FleetController:
             handle = self._handles.pop(seat, None)
             if handle is not None:
                 handle.terminate(self.grace_s, self.counters)
+            self._drop_trace_state(seat)
             self.router.remove_replica(i)
             self._retiring.discard(i)
             self._count("fleet_replicas_retired")
             self._span("fleet.retire", t0, self._clock(), seat=seat,
                        reason="signal")
+
+    def harvest_traces(self) -> int:
+        """Drain every child's flight recorder into the controller's
+        tracer (one ``trace`` control RPC per replica, incremental by
+        span id). Harvested spans land on a ``replica<seat>`` track —
+        the cross-process analogue of the shared-tracer stitching a
+        single-process router gets for free — so ``export_chrome`` on
+        the controller tracer shows the whole fleet on per-replica
+        tracks. Children report span times on their own
+        ``time.monotonic()`` axis; processes on one host share that
+        axis, so tracks line up (cross-host fleets would need an
+        offset handshake — see docs). Returns spans ingested."""
+        if self.tracer is None:
+            return 0
+        n = 0
+        with self._lock:
+            for i in self._active_indices():
+                eng = self.router.engines[i]
+                if not isinstance(eng, RemoteReplica):
+                    continue
+                seat = eng.seat
+                if seat in self._trace_absent:
+                    continue
+                try:
+                    reply, _ = eng.call(
+                        "trace", {"since": self._trace_marks.get(seat, -1)})
+                except (FleetWireError, InjectedFault, OSError):
+                    self.router._note_step_failure(i)
+                    continue
+                if not reply.get("tracer"):
+                    self._trace_absent.add(seat)
+                    continue
+                spans = reply.get("spans") or []
+                if not spans:
+                    continue
+                self._trace_marks[seat] = int(reply["last"])
+                kept = self._last_harvest.setdefault(seat, [])
+                kept.extend(spans)
+                del kept[:-_POSTMORTEM_SPANS]
+                n += self.tracer.ingest(spans, track=f"replica{seat}")
+        return n
+
+    def _dump_postmortem(self, seat: int) -> None:
+        """Flight-recorder dump for a dead replica: the child is gone
+        (its control channel died with it), so what we have is the LAST
+        harvested window — written as a standalone Chrome trace next to
+        the controller's event log (or ``postmortem_dir``)."""
+        spans = self._last_harvest.get(seat)
+        if not spans:
+            return
+        out_dir = self.postmortem_dir
+        if out_dir is None and self.tracer is not None \
+                and self.tracer.events is not None:
+            out_dir = os.path.dirname(
+                os.path.abspath(self.tracer.events.path))
+        if out_dir is None:
+            return
+        from colossalai_tpu.telemetry.tracing import Tracer as _Tracer
+
+        t = _Tracer(max_spans=len(spans))
+        t.ingest(spans, track=f"replica{seat}")
+        try:
+            t.export_chrome(
+                os.path.join(out_dir, f"replica{seat}.postmortem.json"))
+        except OSError:
+            pass  # best-effort: a full disk must not stop the reap
+
+    def _drop_trace_state(self, seat: int) -> None:
+        self._trace_marks.pop(seat, None)
+        self._trace_absent.discard(seat)
+        self._last_harvest.pop(seat, None)
 
     def _poll_signals(self, now: float) -> None:
         """Refresh stale replica signals over the control channel and

@@ -50,6 +50,7 @@ from colossalai_tpu.telemetry.core import (  # noqa: F401  (re-exports)
     Histogram,
     _fmt,
     prometheus_exposition,
+    read_events,
 )
 from colossalai_tpu.telemetry.slo import SLOTracker  # noqa: F401  (re-export)
 from colossalai_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
@@ -191,6 +192,14 @@ class Telemetry:
                 "finish_reason": req.finish_reason,
                 "prompt_tokens": len(req.prompt_ids),
                 "generated_tokens": n_gen,
+                # replay-complete fields: arrival stamp (engine clock),
+                # priority, adapter and token budget make the record a
+                # self-sufficient workload trace (WorkloadTrace replays
+                # a recording from these four + prompt/generated above)
+                "arrival_s": _r(req.t_arrival),
+                "priority": int(getattr(req, "priority", 0) or 0),
+                "adapter_id": getattr(req, "adapter_id", None),
+                "max_new_tokens": int(req.gen.max_new_tokens),
                 "queue_wait_s": _r(queue_wait),
                 "ttft_s": _r(ttft),
                 "itl_mean_s": _r(itl),

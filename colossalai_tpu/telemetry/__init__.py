@@ -14,7 +14,14 @@ from .capacity import (
     fleet_capacity,
     merged_capacity_prom,
 )
-from .core import METRIC_NAME_RE, EventLog, Histogram, prometheus_exposition
+from .core import (
+    METRIC_NAME_RE,
+    EventLog,
+    Histogram,
+    prometheus_exposition,
+    read_events,
+)
+from .sim import SIM_COUNTER_NAMES, SIM_GAUGE_NAMES, CostModel, FleetSim
 from .slo import DEFAULT_TARGETS, SLO_TARGET_RE, SLOTracker, WindowedHistogram
 from .timeseries import TimeSeries
 from .tracing import SPAN_CATALOG, SPAN_NAME_RE, Span, Tracer
@@ -27,12 +34,21 @@ from .train_monitor import (
     fetch_scalars,
     transfer_counter,
 )
+from .workload import TRACE_DEFAULTS, WorkloadRequest, WorkloadTrace
 
 __all__ = [
     "METRIC_NAME_RE",
     "EventLog",
     "Histogram",
     "prometheus_exposition",
+    "read_events",
+    "SIM_COUNTER_NAMES",
+    "SIM_GAUGE_NAMES",
+    "CostModel",
+    "FleetSim",
+    "TRACE_DEFAULTS",
+    "WorkloadRequest",
+    "WorkloadTrace",
     "CapacityMonitor",
     "RecompileSentinel",
     "ScalingSignal",

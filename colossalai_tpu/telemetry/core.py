@@ -250,6 +250,22 @@ class EventLog:
         self.close()
 
 
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Load an :class:`EventLog` recording INCLUDING its rotated
+    generation: records from ``<path>.1`` (the older segment, if rotation
+    ever fired) followed by records from ``<path>``, in emission order.
+    Either file may be absent — a never-rotated log has no ``.1``, and a
+    recording that rotated right at the end may have an empty live file —
+    so both are optional; an empty list means nothing was recorded at
+    all. This is the reader replay tooling should use: ``EventLog.read``
+    alone silently drops everything before the rotation point."""
+    out: List[Dict[str, Any]] = []
+    for p in (path + ".1", path):
+        if os.path.exists(p):
+            out.extend(EventLog.read(p))
+    return out
+
+
 def prometheus_exposition(
     counters: Dict[str, Any],
     gauges: Dict[str, Any],

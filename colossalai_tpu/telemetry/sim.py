@@ -1,0 +1,1017 @@
+"""FleetSim: a discrete-event fleet simulator driving the REAL policies.
+
+The closing half of the record→replay loop (:mod:`.workload` is the
+recording half). A :class:`FleetSim` replays a
+:class:`~.workload.WorkloadTrace` — recorded or synthetic — against
+:class:`SimReplica` stand-ins whose timing comes from a
+:class:`CostModel` calibrated on recorded telemetry, while every
+*decision* is made by the real, unmodified policy code:
+
+- :class:`~colossalai_tpu.inference.fleet.AutoscalePolicy` — the same
+  hysteresis/cooldown/bounds/in-flight gates, driven by the same
+  :func:`~.capacity.combine_signals` fold over real
+  :class:`~.capacity.CapacityMonitor` instances;
+- :class:`~colossalai_tpu.telemetry.SLOTracker` +
+  :class:`~colossalai_tpu.inference.overload.OverloadController` — the
+  same windowed-breach shedding gate;
+- optionally the real :class:`~colossalai_tpu.inference.router.Router`
+  (``use_router=True``) — placement, drain, and the
+  consecutive-failure health machine with evacuate/failover;
+- :class:`~colossalai_tpu.inference.fault.FaultInjector` — the
+  ``replica_step`` seam fires at simulated service starts, so mid-sim
+  replica death uses the same arming surface as the chaos tests.
+
+This works because every one of those objects reads time through a
+patchable ``_clock`` seam (the PR 11/15/18 fake-clock discipline): the
+sim assigns each instance a closure over its mock clock and advances
+that clock event by event. No ``time.sleep``, no threads — a 500-replica
+100k-request diurnal day simulates in seconds of CPU wall.
+
+The sim emits the same observability surface as a live fleet: the
+``clt_slo_*`` / ``clt_capacity_*`` / ``clt_fleet_*`` families through
+the existing renderers plus its own ``clt_sim_*`` family
+(:data:`SIM_COUNTER_NAMES` / :data:`SIM_GAUGE_NAMES` — catalog-linted),
+a scaling-action timeline, an attainment/goodput/chip-seconds report,
+and a per-simulated-replica Chrome trace through the PR 10 exporter.
+
+Determinism: given the same trace and seed, the event order, timeline,
+report, and metric exposition are byte-identical run to run — the
+determinism gate in ``tests/test_core/test_fleetsim.py`` pins this.
+
+Fidelity caveats (also in docs/observability.md): service times are
+analytic (``prefill + tokens × megastep``) rather than batch-coupled,
+the default ``capacity_mode="merged"`` drives ONE monitor with the
+fleet-mean busy signal (``"per_replica"`` runs a real monitor per
+replica through the real ``combine_signals`` fold — exact, but O(n)
+per tick), and KV-page pressure / prefix-cache effects are not modeled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import math
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .capacity import CapacityMonitor, ScalingSignal, combine_signals
+from .core import Histogram, prometheus_exposition
+from .slo import SLOTracker
+from .tracing import Tracer
+from .workload import WorkloadTrace
+
+#: every ``clt_sim_*`` counter a FleetSim can emit — static, so the
+#: metric-catalog lint renders the family without running a sim
+SIM_COUNTER_NAMES = (
+    "sim_requests_total",
+    "sim_requests_finished",
+    "sim_requests_shed",
+    "sim_requests_failed_over",
+    "sim_requests_errored",
+    "sim_events_processed",
+    "sim_workload_defaults_total",
+)
+
+SIM_GAUGE_NAMES = (
+    "sim_replicas_peak",
+    "sim_horizon_seconds",
+)
+
+#: the ``clt_fleet_*`` subset the sim maintains with live-fleet
+#: semantics (names and meanings identical to the FleetController's)
+_FLEET_COUNTER_NAMES = (
+    "fleet_replicas_spawned",
+    "fleet_replicas_retired",
+    "fleet_replicas_replaced",
+    "fleet_scale_up_total",
+    "fleet_scale_down_total",
+    "fleet_scale_suppressed_hysteresis",
+    "fleet_scale_suppressed_cooldown",
+    "fleet_scale_suppressed_bounds",
+    "fleet_scale_suppressed_inflight",
+    "fleet_chip_seconds",
+)
+
+#: ScaleDecision.reason → suppression counter (mirrors fleet.py)
+_SUPPRESS_COUNTER = {
+    "hysteresis": "fleet_scale_suppressed_hysteresis",
+    "cooldown": "fleet_scale_suppressed_cooldown",
+    "min_bound": "fleet_scale_suppressed_bounds",
+    "max_bound": "fleet_scale_suppressed_bounds",
+    "inflight_floor": "fleet_scale_suppressed_inflight",
+}
+
+#: synthetic trace id for fleet-lifecycle spans (matches fleet.py)
+_FLEET_TRACE_ID = -1
+
+
+def _r(v: float) -> float:
+    return round(float(v), 6)
+
+
+# ============================================================= cost model
+@dataclasses.dataclass
+class CostModel:
+    """Replica timing for the simulator, calibrated from recordings.
+
+    - ``megastep_s``: wall per decode megastep (≈ per generated token
+      per request; batched decode shares the step, so up to ``slots``
+      concurrent requests each advance one token per megastep);
+    - ``ttft_base_s`` + ``prompt_tokens × ttft_per_prompt_token_s``:
+      the prefill wall (TTFT above queue wait);
+    - ``spawn_s``: warm replica spawn → ready (the actuation latency an
+      autoscaler pays);
+    - ``slots``: concurrent decode slots per replica (its
+      ``max_batch_size``).
+    """
+
+    megastep_s: float = 0.02
+    ttft_base_s: float = 0.005
+    ttft_per_prompt_token_s: float = 0.0
+    spawn_s: float = 1.0
+    slots: int = 8
+
+    def __post_init__(self):
+        if self.megastep_s <= 0:
+            raise ValueError(f"megastep_s={self.megastep_s} must be > 0")
+        if self.slots < 1:
+            raise ValueError(f"slots={self.slots} must be >= 1")
+
+    def prefill_s(self, prompt_tokens: int) -> float:
+        return self.ttft_base_s + prompt_tokens * self.ttft_per_prompt_token_s
+
+    def service_s(self, prompt_tokens: int, new_tokens: int) -> float:
+        return self.prefill_s(prompt_tokens) + new_tokens * self.megastep_s
+
+    # ---------------------------------------------------------- calibration
+    @classmethod
+    def from_histograms(cls, histograms: Dict[str, Histogram],
+                        **overrides) -> "CostModel":
+        """Calibrate from a live engine's cumulative histograms: p50
+        megastep wall and p50 TTFT (as the flat prefill cost — the
+        histograms don't carry prompt lengths, so the per-token slope
+        stays 0; use :meth:`from_events` when the event log is
+        available)."""
+        kw: Dict[str, Any] = {}
+        h = histograms.get("megastep_seconds")
+        if h is not None and h.count:
+            kw["megastep_s"] = h.percentile(50.0)
+        h = histograms.get("ttft_seconds")
+        if h is not None and h.count:
+            kw["ttft_base_s"] = h.percentile(50.0)
+        kw.update(overrides)
+        return cls(**kw)
+
+    @classmethod
+    def from_events(cls, records: Iterable[Dict[str, Any]],
+                    **overrides) -> "CostModel":
+        """Calibrate from recorded per-request jsonl records: mean ITL →
+        megastep wall, and a least-squares fit of ``ttft_s`` against
+        ``prompt_tokens`` → (base, per-prompt-token) prefill cost. Queue
+        wait is NOT subtracted from TTFT here — recordings made at low
+        load have ≈0 queue wait, which is the regime to calibrate in."""
+        pairs: List[Tuple[float, float]] = []
+        itls: List[float] = []
+        for rec in records:
+            if rec.get("event") != "request":
+                continue
+            itl = rec.get("itl_mean_s")
+            if itl is not None and itl > 0:
+                itls.append(float(itl))
+            ttft, pt = rec.get("ttft_s"), rec.get("prompt_tokens")
+            if ttft is not None and pt is not None:
+                pairs.append((float(pt), float(ttft)))
+        kw: Dict[str, Any] = {}
+        if itls:
+            kw["megastep_s"] = sum(itls) / len(itls)
+        if pairs:
+            n = len(pairs)
+            mx = sum(p for p, _ in pairs) / n
+            my = sum(t for _, t in pairs) / n
+            var = sum((p - mx) ** 2 for p, _ in pairs)
+            slope = (sum((p - mx) * (t - my) for p, t in pairs) / var
+                     if var > 0 else 0.0)
+            slope = max(0.0, slope)
+            kw["ttft_per_prompt_token_s"] = slope
+            kw["ttft_base_s"] = max(1e-6, my - slope * mx)
+        kw.update(overrides)
+        return cls(**kw)
+
+    @classmethod
+    def from_bench(cls, autoscale_payload: Dict[str, Any],
+                   **overrides) -> "CostModel":
+        """Calibrate from a ``bench.py measure_autoscale`` payload: its
+        measured warm-spawn latency and single-replica peak request rate
+        (``max_batch_size=1``, sleep-throttled — service is sequential,
+        so one request's wall is ``1/peak`` and one megastep is that
+        divided by the token budget)."""
+        kw: Dict[str, Any] = {"slots": 1}
+        if autoscale_payload.get("spawn_s") is not None:
+            kw["spawn_s"] = float(autoscale_payload["spawn_s"])
+        peak = autoscale_payload.get("peak_req_per_s")
+        new_tokens = int(autoscale_payload.get("new_tokens", 64))
+        if peak:
+            per_req = 1.0 / float(peak)
+            kw["megastep_s"] = per_req / max(1, new_tokens)
+            kw["ttft_base_s"] = kw["megastep_s"]
+        kw.update(overrides)
+        return cls(**kw)
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "megastep_s": _r(self.megastep_s),
+            "ttft_base_s": _r(self.ttft_base_s),
+            "ttft_per_prompt_token_s": _r(self.ttft_per_prompt_token_s),
+            "spawn_s": _r(self.spawn_s),
+            "slots": self.slots,
+        }
+
+
+# ============================================================ sim request
+class _SimReq:
+    """One in-flight simulated request. ``epoch`` invalidates scheduled
+    finish events across failover requeues (a stale event carries the
+    epoch it was scheduled under)."""
+
+    __slots__ = ("request_id", "arrival_s", "prompt_tokens",
+                 "max_new_tokens", "priority", "adapter_id", "t_start",
+                 "epoch", "replica", "n_samples", "group_ids")
+
+    def __init__(self, rid: int, w):
+        self.request_id = rid
+        self.arrival_s = w.arrival_s
+        self.prompt_tokens = w.prompt_tokens
+        self.max_new_tokens = w.max_new_tokens
+        self.priority = w.priority
+        self.adapter_id = w.adapter_id
+        self.t_start: Optional[float] = None
+        self.epoch = 0
+        self.replica: Optional["SimReplica"] = None
+        # router failover duck surface
+        self.n_samples = 1
+        self.group_ids = None
+
+
+class _SimStats:
+    """Engine-stats duck for the Router (``_RetiredReplica`` snapshots
+    retirees via ``stats.as_dict()``)."""
+
+    __slots__ = ("requests_submitted", "requests_completed",
+                 "requests_aborted")
+
+    def __init__(self):
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_aborted = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"requests_submitted": self.requests_submitted,
+                "requests_completed": self.requests_completed,
+                "requests_aborted": self.requests_aborted}
+
+
+# ============================================================ sim replica
+class SimReplica:
+    """A replica modeled as a ``slots``-server priority queue.
+
+    Duck-types the engine surface the real Router reads (``waiting`` /
+    ``prefilling`` / ``running`` / ``stats`` / ``telemetry`` /
+    ``allocator`` / ``has_work`` / ``add_request`` / ``evacuate`` /
+    ``seed_ids``) so ``use_router=True`` drives the real placement and
+    health machine over these objects unmodified.
+    """
+
+    def __init__(self, seat: int, sim: "FleetSim"):
+        from types import SimpleNamespace
+
+        self.seat = seat
+        self._sim = sim
+        self.waiting: List[_SimReq] = []   # router failover appends here
+        self.running: Dict[int, _SimReq] = {}
+        self.prefilling: Dict[int, _SimReq] = {}
+        self.draining = False
+        self.dead = False
+        self.busy_accum = 0.0
+        self._busy_mark: Optional[float] = None
+        self.requests_served = 0
+        # engine-duck surface for the real Router
+        self.prefix_cache = None
+        self.lora = None
+        self.stats = _SimStats()
+        self.telemetry = SimpleNamespace(slo=None, histograms={},
+                                         track=f"replica{seat}")
+        self.allocator = SimpleNamespace(num_free=1 << 20)
+        self._ids = itertools.count(seat, 1 << 20)
+
+    # ---------------------------------------------------------- sim surface
+    @property
+    def load(self) -> int:
+        return len(self.waiting) + len(self.running)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running or self.prefilling)
+
+    def touch_busy(self, now: float) -> None:
+        """Advance the busy-wall integral (time with ≥1 request in
+        service — NOT summed per-request service, which would overcount
+        batched decode)."""
+        if self._busy_mark is not None:
+            self.busy_accum += now - self._busy_mark
+            self._busy_mark = now if self.running else None
+        elif self.running:
+            self._busy_mark = now
+
+    def take_busy(self, now: float) -> float:
+        self.touch_busy(now)
+        d, self.busy_accum = self.busy_accum, 0.0
+        return d
+
+    def pop_next(self) -> Optional[_SimReq]:
+        """Highest priority first, FIFO within a level (the engine's
+        admission order under priority scheduling). Uniform-priority
+        traces — the common replay case — take the O(1)-scan FIFO fast
+        path instead of the priority sweep."""
+        if not self.waiting:
+            return None
+        if not self._sim._any_prio:
+            return self.waiting.pop(0)
+        best = 0
+        for i in range(1, len(self.waiting)):
+            if self.waiting[i].priority > self.waiting[best].priority:
+                best = i
+        return self.waiting.pop(best)
+
+    # -------------------------------------------------- router-duck surface
+    def seed_ids(self, seat: int, stride: int) -> None:
+        self._ids = itertools.count(seat, stride)
+
+    def add_request(self, prompt_ids, gen=None, n_samples: int = 1,
+                    priority: int = 0, **_kw) -> int:
+        """Router placement lands here: mint a rid (seat + k·stride) and
+        enqueue the WorkloadRequest the sim staged for this arrival."""
+        rid = next(self._ids)
+        self.stats.requests_submitted += 1
+        self._sim._accept(self, rid)
+        return rid
+
+    def evacuate(self) -> Tuple[List[_SimReq], List[_SimReq]]:
+        """Everything in flight becomes movable (the sim has no grouped
+        requests, so nothing force-finishes here). Scheduled finish
+        events go stale via the epoch bump."""
+        movable = list(self.waiting) + list(self.running.values())
+        for req in movable:
+            req.epoch += 1
+            req.replica = None
+            req.t_start = None
+        self.waiting = []
+        self.running = {}
+        self.prefilling = {}
+        return movable, []
+
+    def _finish(self, req: _SimReq, reason: str, count: int = 1) -> None:
+        """Router terminal path (no survivor for a failover)."""
+        self._sim._finish_error(req, reason)
+
+
+# ================================================================ FleetSim
+class FleetSim:
+    """Seeded discrete-event fleet simulator (see module docstring).
+
+    Parameters mirror a FleetController where one exists: ``autoscale``
+    is a real :class:`AutoscalePolicy` (default-constructed lazily when
+    omitted), ``slo`` a real :class:`SLOTracker` (or pass
+    ``slo_targets``), ``overload`` a real ``OverloadConfig`` /
+    ``True``, ``fault`` a real :class:`FaultInjector` armed at the
+    ``replica_step`` seam, ``tracer`` a :class:`Tracer` / ``True``.
+    ``kill_at`` schedules deterministic replica deaths as ``(t, seat)``
+    pairs. ``capacity_mode`` picks the signal-plane granularity (see
+    fidelity caveats in the module docstring); ``use_router=True``
+    routes placement and death through the real Router.
+    """
+
+    def __init__(
+        self,
+        cost: Optional[CostModel] = None,
+        *,
+        autoscale=None,
+        slo: Optional[SLOTracker] = None,
+        slo_targets: Optional[Dict[str, float]] = None,
+        slo_window_s: float = 60.0,
+        overload=None,
+        fault=None,
+        tracer=None,
+        capacity_mode: str = "merged",
+        capacity_kw: Optional[Dict[str, Any]] = None,
+        slo_drives_signal: bool = True,
+        idle_tail_s: float = 0.0,
+        tick_s: float = 0.25,
+        seed: int = 0,
+        use_router: bool = False,
+        fail_threshold: int = 2,
+        kill_at: Iterable[Tuple[float, int]] = (),
+    ):
+        if capacity_mode not in ("merged", "per_replica"):
+            raise ValueError(
+                f"capacity_mode={capacity_mode!r}: 'merged' or 'per_replica'")
+        if tick_s <= 0:
+            raise ValueError(f"tick_s={tick_s} must be > 0")
+        self.cost = cost or CostModel()
+        self.tick_s = float(tick_s)
+        self.seed = int(seed)
+        self.capacity_mode = capacity_mode
+        self.capacity_kw = dict(capacity_kw or {})
+        self.capacity_kw.setdefault("interval_s", max(self.tick_s, 0.25))
+        self.capacity_kw.setdefault("n_intervals", 8)
+        self.capacity_kw.setdefault("chips", 1)
+        self.capacity_kw.setdefault("sentinel", False)
+        self.capacity_kw.setdefault("hbm", False)
+        # a live fleet's capacity monitors ride in the CHILD processes,
+        # which may have no SLO tracker — slo_drives_signal=False
+        # reproduces that wiring (breaches still count attainment, they
+        # just don't feed the scaling signal)
+        self.slo_drives_signal = bool(slo_drives_signal)
+        # keep control ticks running this long after the last work
+        # drains — a live controller keeps ticking while the fleet
+        # idles, which is when deferred scale-downs actually land
+        self.idle_tail_s = float(idle_tail_s)
+        self._last_work_t = 0.0
+        self.use_router = bool(use_router)
+        self.fail_threshold = int(fail_threshold)
+        self.kill_at = sorted((float(t), int(s)) for t, s in kill_at)
+
+        self.now = 0.0
+        self._clock_fn = lambda: self.now
+
+        if autoscale is None:
+            from colossalai_tpu.inference.fleet import AutoscalePolicy
+
+            autoscale = AutoscalePolicy()
+        self.autoscale = autoscale
+        self.autoscale._clock = self._clock_fn
+
+        self.slo = slo if slo is not None else SLOTracker(
+            targets=slo_targets, window_s=slo_window_s)
+        self._patch_slo_clock(self.slo)
+
+        self.overload = None
+        if overload is not None and overload is not False:
+            from colossalai_tpu.inference.overload import (
+                OverloadConfig,
+                OverloadController,
+            )
+
+            cfg = OverloadConfig() if overload is True else overload
+            self.overload = OverloadController(self.slo, cfg)
+
+        self.fault = fault
+        self.tracer: Optional[Tracer] = (
+            Tracer() if tracer is True else tracer)
+        if self.tracer is not None:
+            self.tracer._clock = self._clock_fn
+
+        #: the merged fleet-view monitor — always maintained (it is the
+        #: observability surface); in "merged" mode it also IS the signal
+        self.monitor = self._make_monitor()
+        #: per-replica monitors (capacity_mode="per_replica" only)
+        self._monitors: Dict[int, CapacityMonitor] = {}
+
+        self.counters: Dict[str, float] = {
+            n: 0 for n in SIM_COUNTER_NAMES + _FLEET_COUNTER_NAMES}
+        self.timeline: List[Dict[str, Any]] = []
+        self.last_signal = ScalingSignal("hold", ("no_signal",))
+
+        self._replicas: Dict[int, SimReplica] = {}
+        self._pending: Dict[int, float] = {}   # seat -> ready time
+        self._retiring: set = set()
+        self._next_seat = 0
+        self._heap: List[Tuple[float, int, int, Any]] = []
+        self._seq = itertools.count()
+        self._place_heap: List[Tuple[int, int, int]] = []
+        self._pseq = itertools.count()
+        self._peak_replicas = 0
+        self._last_chip_t = 0.0
+        self._arrival_ctx = None   # staged (WorkloadRequest, rid) in flight
+        self._any_prio = False
+        self._id_stride = max(16, 2 * self.autoscale.max_replicas)
+        self.router = None
+        self._trace: Optional[WorkloadTrace] = None
+        self._arrivals_left = 0
+        self._ran = False
+
+    # ------------------------------------------------------- clock patching
+    def _patch_slo_clock(self, slo: SLOTracker) -> None:
+        slo._clock = self._clock_fn
+        for w in slo.windows.values():
+            w._clock = self._clock_fn
+
+    def _make_monitor(self) -> CapacityMonitor:
+        mon = CapacityMonitor(**self.capacity_kw)
+        mon._clock = self._clock_fn
+        mon.series._clock = self._clock_fn
+        return mon
+
+    # ----------------------------------------------------------- event heap
+    # kinds order ties at one timestamp: control(0) observes the world
+    # BEFORE this instant's arrivals/finishes mutate it — matching a live
+    # controller whose tick reads state accumulated strictly before now
+    _K_CONTROL, _K_KILL, _K_READY, _K_FINISH, _K_ARRIVAL = 0, 1, 2, 3, 4
+
+    def _push(self, t: float, kind: int, payload) -> None:
+        heapq.heappush(self._heap, (t, kind, next(self._seq), payload))
+
+    # ------------------------------------------------------------ placement
+    def _push_place(self, rep: SimReplica) -> None:
+        heapq.heappush(self._place_heap,
+                       (rep.load, next(self._pseq), rep.seat))
+
+    def _pick_replica(self) -> Optional[SimReplica]:
+        """Least-loaded alive non-draining replica — the Router's
+        ``least_loaded`` policy over a lazy heap (stale entries are
+        re-pushed with their current load), so placement is O(log n)
+        per arrival instead of O(n_replicas)."""
+        while self._place_heap:
+            load, _, seat = self._place_heap[0]
+            rep = self._replicas.get(seat)
+            if rep is None or rep.dead or rep.draining:
+                heapq.heappop(self._place_heap)
+                continue
+            if rep.load != load:
+                heapq.heappop(self._place_heap)
+                self._push_place(rep)
+                continue
+            return rep
+        return None
+
+    # ------------------------------------------------------ replica lifecycle
+    def _spawn(self, reason: str) -> None:
+        seat = self._next_seat
+        self._next_seat += 1
+        self.counters["fleet_replicas_spawned"] += 1
+        self._pending[seat] = self.now + self.cost.spawn_s
+        self.timeline.append({"t": _r(self.now), "event": "spawn",
+                              "seat": seat, "reason": reason})
+        self._push(self.now + self.cost.spawn_s, self._K_READY, seat)
+
+    def _bootstrap(self, n: int) -> None:
+        """Initial fleet, already warm (a live controller blocks on its
+        bootstrap spawns before serving — the sim starts serving at
+        t=0 with the minimum fleet seated)."""
+        for _ in range(n):
+            seat = self._next_seat
+            self._next_seat += 1
+            self.counters["fleet_replicas_spawned"] += 1
+            self.timeline.append({"t": 0.0, "event": "spawn", "seat": seat,
+                                  "reason": "bootstrap"})
+            self._seat_replica(seat)
+
+    def _seat_replica(self, seat: int) -> SimReplica:
+        rep = SimReplica(seat, self)
+        self._replicas[seat] = rep
+        if self.capacity_mode == "per_replica":
+            self._monitors[seat] = self._make_monitor()
+        if self.router is not None:
+            self.router.add_replica(rep)   # router picks a free rid seat
+        else:
+            rep.seed_ids(seat, self._id_stride)
+        self._push_place(rep)
+        self._peak_replicas = max(self._peak_replicas, len(self._replicas))
+        return rep
+
+    def _on_ready(self, seat: int) -> None:
+        self._pending.pop(seat, None)
+        rep = self._seat_replica(seat)
+        self.timeline.append({"t": _r(self.now), "event": "ready",
+                              "seat": seat})
+        if self.tracer is not None:
+            self.tracer.add(_FLEET_TRACE_ID, "fleet.spawn",
+                            self.now - self.cost.spawn_s, self.now,
+                            track="fleet", seat=seat)
+        self._fill_slots(rep)
+
+    def _router_index(self, rep: SimReplica) -> Optional[int]:
+        for i, e in enumerate(self.router.engines):
+            if e is rep:
+                return i
+        return None
+
+    def _kill(self, rep: SimReplica, cause: str) -> None:
+        """Replica death: evacuate + failover (through the real Router's
+        ``_mark_dead`` when attached), reap the seat, and repair the
+        fleet below ``min_replicas`` — the FleetController's
+        ``_reap_dead`` semantics."""
+        if rep.dead:
+            return
+        rep.touch_busy(self.now)
+        rep.dead = True
+        self._retiring.discard(rep.seat)
+        self.timeline.append({"t": _r(self.now), "event": "replica_dead",
+                              "seat": rep.seat, "reason": cause})
+        if self.tracer is not None:
+            self.tracer.instant(_FLEET_TRACE_ID, "replica_dead", t=self.now,
+                                track="fleet", replica=rep.seat, cause=cause)
+        if self.router is not None:
+            i = self._router_index(rep)
+            before = self.router.requests_failed_over
+            for _ in range(self.fail_threshold):
+                self.router._note_step_failure(i)
+            moved = self.router.requests_failed_over - before
+            self.counters["sim_requests_failed_over"] += moved
+            self.router.remove_replica(i)
+            self._replicas.pop(rep.seat, None)
+            self._monitors.pop(rep.seat, None)
+            # the router appended evacuees onto survivors' waiting lists
+            for other in list(self._replicas.values()):
+                self._fill_slots(other)
+        else:
+            movable, _ = rep.evacuate()
+            self._replicas.pop(rep.seat, None)
+            self._monitors.pop(rep.seat, None)
+            for req in movable:
+                target = self._pick_replica()
+                if target is None:
+                    self._finish_error(req, "error")
+                    continue
+                self.counters["sim_requests_failed_over"] += 1
+                if self.tracer is not None:
+                    self.tracer.instant(_FLEET_TRACE_ID, "failover",
+                                        t=self.now, track="fleet",
+                                        src=rep.seat, dst=target.seat)
+                target.waiting.append(req)
+                self._push_place(target)
+                self._fill_slots(target)
+        self.counters["fleet_replicas_replaced"] += 1
+        self._repair_min()
+
+    def _repair_min(self) -> None:
+        want = self.autoscale.min_replicas
+        have = (len(self._replicas) - len(self._retiring)
+                + len(self._pending))
+        while have < want:
+            self._spawn("replace")
+            have += 1
+
+    def _retire(self, rep: SimReplica) -> None:
+        rep.touch_busy(self.now)
+        self._retiring.discard(rep.seat)
+        if self.router is not None:
+            i = self._router_index(rep)
+            if i is not None:
+                self.router.remove_replica(i)
+        self._replicas.pop(rep.seat, None)
+        self._monitors.pop(rep.seat, None)
+        self.counters["fleet_replicas_retired"] += 1
+        self.timeline.append({"t": _r(self.now), "event": "retired",
+                              "seat": rep.seat})
+        if self.tracer is not None:
+            self.tracer.add(_FLEET_TRACE_ID, "fleet.retire", self.now,
+                            self.now, track="fleet", seat=rep.seat,
+                            reason="signal")
+
+    # ------------------------------------------------------------- requests
+    def _accept(self, rep: SimReplica, rid: int) -> None:
+        """Enqueue the staged arrival on ``rep`` (called directly in
+        internal mode; via ``SimReplica.add_request`` when the real
+        Router places). The sim-global rid staged with the arrival is
+        the trace id — engine-minted seat-strided rids would collide
+        with the shed path's ids."""
+        w, global_rid = self._arrival_ctx
+        req = _SimReq(global_rid, w)
+        req.arrival_s = self.now
+        rep.waiting.append(req)
+        self._push_place(rep)
+        self._fill_slots(rep)
+
+    def _on_arrival(self, w) -> None:
+        self._arrivals_left -= 1
+        self.counters["sim_requests_total"] += 1
+        rid = int(self.counters["sim_requests_total"])
+        if w.priority:
+            self._any_prio = True
+        rep = self._pick_replica()
+        if rep is None:
+            self._finish_error(_SimReq(rid, w), "error")
+            return
+        if (self.overload is not None and self.overload.shedding
+                and len(rep.waiting)
+                >= self.overload.shed_queue_depth(self.cost.slots)):
+            self.counters["sim_requests_shed"] += 1
+            self.slo.record_request(tokens=0, reason="shed")
+            if self.tracer is not None:
+                if self.tracer.begin(rid, t0=self.now,
+                                     track=f"replica{rep.seat}") is not None:
+                    self.tracer.instant(rid, "shed", t=self.now,
+                                        track=f"replica{rep.seat}")
+                    self.tracer.end_trace(rid, t1=self.now,
+                                          finish_reason="shed")
+            return
+        self._arrival_ctx = (w, rid)
+        if self.router is not None:
+            self.router.add_request([0] * int(w.prompt_tokens), None,
+                                    priority=int(w.priority),
+                                    adapter_id=w.adapter_id)
+        else:
+            rep.add_request(None, priority=int(w.priority))
+
+    def _fill_slots(self, rep: SimReplica) -> None:
+        while (not rep.dead and rep.waiting
+               and len(rep.running) < self.cost.slots):
+            req = rep.pop_next()
+            if self.fault is not None:
+                try:
+                    self.fault.check("replica_step", key=rep.seat)
+                except Exception:  # InjectedFault — replica dies mid-step
+                    rep.waiting.append(req)
+                    self._kill(rep, "fault")
+                    return
+            req.t_start = self.now
+            req.replica = rep
+            rep.running[req.request_id] = req
+            rep.touch_busy(self.now)
+            self._push_place(rep)
+            t_done = self.now + self.cost.service_s(
+                req.prompt_tokens, req.max_new_tokens)
+            self._push(t_done, self._K_FINISH, (req, req.epoch))
+
+    def _finish_error(self, req: _SimReq, reason: str) -> None:
+        self.counters["sim_requests_errored"] += 1
+        self.slo.record_request(tokens=0, reason=reason)
+
+    def _on_finish(self, req: _SimReq, epoch: int) -> None:
+        rep = req.replica
+        if req.epoch != epoch or rep is None or rep.dead:
+            return  # stale: the request failed over after scheduling
+        rep.running.pop(req.request_id, None)
+        rep.touch_busy(self.now)
+        rep.requests_served += 1
+        rep.stats.requests_completed += 1
+        self._push_place(rep)
+        self.counters["sim_requests_finished"] += 1
+        queue_wait = req.t_start - req.arrival_s
+        prefill = self.cost.prefill_s(req.prompt_tokens)
+        ttft = queue_wait + prefill + self.cost.megastep_s
+        e2e = self.now - req.arrival_s
+        self.slo.record_request(
+            ttft=ttft, itl=self.cost.megastep_s, e2e=e2e,
+            queue_wait=queue_wait, tokens=req.max_new_tokens,
+            reason="length")
+        tr = self.tracer
+        if tr is not None:
+            track = f"replica{rep.seat}"
+            rid = req.request_id
+            if tr.begin(rid, t0=req.arrival_s, track=track) is not None:
+                tr.add(rid, "queue", req.arrival_s, req.t_start, track=track)
+                tr.add(rid, "prefill", req.t_start, req.t_start + prefill,
+                       track=track, prompt_tokens=req.prompt_tokens)
+                tr.add(rid, "decode_megastep", req.t_start + prefill,
+                       self.now, track=track, tokens=req.max_new_tokens)
+                tr.end_trace(rid, t1=self.now, finish_reason="length",
+                             tokens=req.max_new_tokens)
+        self._fill_slots(rep)
+
+    # -------------------------------------------------------------- control
+    def _alive(self) -> List[SimReplica]:
+        return [r for r in self._replicas.values() if not r.dead]
+
+    def _in_flight(self) -> int:
+        return sum(r.load for r in self._alive())
+
+    def _feed_capacity(self) -> None:
+        alive = self._alive()
+        n = max(1, len(alive))
+        breached = self.slo.breached if self.slo_drives_signal else False
+        total_busy = 0.0
+        total_q = total_run = 0
+        for rep in alive:
+            d = rep.take_busy(self.now)
+            total_busy += d
+            total_q += len(rep.waiting)
+            total_run += len(rep.running)
+            if self.capacity_mode == "per_replica":
+                m = self._monitors.get(rep.seat)
+                if m is not None:
+                    if d:
+                        m.on_megastep(d)
+                    m.sample(queue_depth=len(rep.waiting),
+                             running=len(rep.running),
+                             slo_breached=breached)
+        if total_busy:
+            self.monitor.on_megastep(total_busy / n)
+        self.monitor.sample(queue_depth=total_q, running=total_run,
+                            slo_breached=breached)
+
+    def _signal(self) -> ScalingSignal:
+        if self.capacity_mode == "per_replica":
+            sigs = {f"replica{seat}": m.signal()
+                    for seat, m in sorted(self._monitors.items())
+                    if seat in self._replicas
+                    and self._replicas[seat].seat not in self._retiring}
+            return combine_signals(sigs) if sigs else \
+                ScalingSignal("hold", ("no_replicas",))
+        return self.monitor.signal()
+
+    def _on_control(self) -> None:
+        self.slo.evaluate()
+        self._feed_capacity()
+        self.last_signal = self._signal()
+        # finish retirements whose drain completed (a live controller
+        # reaps these on its tick, not at the last request's finish)
+        for seat in sorted(self._retiring):
+            rep = self._replicas.get(seat)
+            if rep is not None and not rep.has_work:
+                self._retire(rep)
+        # one actuation in flight at a time — the FleetController gate
+        if not self._pending and not self._retiring:
+            decision = self.autoscale.decide(
+                self.last_signal.action,
+                n_replicas=len(self._alive()),
+                in_flight=self._in_flight(),
+                slots_per_replica=self.cost.slots)
+            if decision.action == "spawn":
+                self.counters["fleet_scale_up_total"] += 1
+                self._spawn("signal")
+            elif decision.action == "retire":
+                victim = min(
+                    (r for r in self._alive() if not r.draining),
+                    key=lambda r: (r.load, r.seat), default=None)
+                if victim is not None:
+                    victim.draining = True
+                    if self.router is not None:
+                        i = self._router_index(victim)
+                        if i is not None:
+                            self.router.drain(i)
+                    self._retiring.add(victim.seat)
+                    self.counters["fleet_scale_down_total"] += 1
+                    self.timeline.append({
+                        "t": _r(self.now), "event": "retire",
+                        "seat": victim.seat, "reason": decision.reason})
+            elif decision.reason in _SUPPRESS_COUNTER:
+                self.counters[_SUPPRESS_COUNTER[decision.reason]] += 1
+        self._repair_min()
+        if self._arrivals_left > 0 or self._in_flight() > 0 \
+                or self._pending or self._retiring:
+            self._last_work_t = self.now
+            self._push(self.now + self.tick_s, self._K_CONTROL, None)
+        elif self.now - self._last_work_t < self.idle_tail_s:
+            self._push(self.now + self.tick_s, self._K_CONTROL, None)
+
+    # ------------------------------------------------------------------ run
+    def run(self, trace: WorkloadTrace,
+            max_requests: Optional[int] = None) -> Dict[str, Any]:
+        """Replay ``trace`` to completion; returns :meth:`report`."""
+        if self._ran:
+            raise RuntimeError("FleetSim instances are single-shot — "
+                               "build a fresh sim per run")
+        self._ran = True
+        self._trace = trace
+        reqs = trace.requests[:max_requests] if max_requests else \
+            trace.requests
+        self.counters["sim_workload_defaults_total"] = sum(
+            trace.defaulted.values())
+
+        if self.use_router:
+            from colossalai_tpu.inference.router import Router
+
+            boot = []
+            for _ in range(self.autoscale.min_replicas):
+                seat = self._next_seat
+                self._next_seat += 1
+                self.counters["fleet_replicas_spawned"] += 1
+                self.timeline.append({"t": 0.0, "event": "spawn",
+                                      "seat": seat, "reason": "bootstrap"})
+                rep = SimReplica(seat, self)
+                self._replicas[seat] = rep
+                if self.capacity_mode == "per_replica":
+                    self._monitors[seat] = self._make_monitor()
+                self._push_place(rep)
+                boot.append(rep)
+            self._peak_replicas = len(self._replicas)
+            self.router = Router(boot, policy="least_loaded",
+                                 parallel_step=False, slo_aware=False,
+                                 fail_threshold=self.fail_threshold,
+                                 id_stride=self._id_stride)
+        else:
+            self._bootstrap(self.autoscale.min_replicas)
+
+        if self.tracer is not None:
+            self.tracer.begin(_FLEET_TRACE_ID, t0=0.0, track="fleet")
+
+        self._arrivals_left = len(reqs)
+        self._heap = [(w.arrival_s, self._K_ARRIVAL, i, w)
+                      for i, w in enumerate(reqs)]
+        for t, seat in self.kill_at:
+            self._push(t, self._K_KILL, seat)
+        heapq.heapify(self._heap)
+        self._seq = itertools.count(len(reqs))
+        self._push(0.0, self._K_CONTROL, None)
+
+        import time as _time
+
+        wall0 = _time.perf_counter()
+        heap = self._heap
+        while heap:
+            t, kind, _, payload = heapq.heappop(heap)
+            if t > self.now:
+                dt = t - self.now
+                self.counters["fleet_chip_seconds"] += dt * (
+                    len(self._replicas) + len(self._pending))
+                self.now = t
+            self.counters["sim_events_processed"] += 1
+            if kind == self._K_ARRIVAL:
+                self._on_arrival(payload)
+            elif kind == self._K_FINISH:
+                self._on_finish(*payload)
+            elif kind == self._K_CONTROL:
+                self._on_control()
+            elif kind == self._K_READY:
+                self._on_ready(payload)
+            elif kind == self._K_KILL:
+                rep = self._replicas.get(payload)
+                if rep is not None and not rep.dead:
+                    self._kill(rep, "kill_at")
+        self.wall_s = _time.perf_counter() - wall0
+        if self.tracer is not None:
+            self.tracer.end_trace(_FLEET_TRACE_ID, t1=self.now)
+        if self.router is not None:
+            self.router.close()
+        return self.report()
+
+    # ------------------------------------------------------------ reporting
+    def actions(self) -> List[Dict[str, Any]]:
+        """The scaling-action timeline: policy-actuated spawn/retire
+        decisions in order (bootstrap seating and death replacements are
+        lifecycle, not decisions — excluded)."""
+        return [e for e in self.timeline
+                if (e["event"] == "spawn"
+                    and e.get("reason") not in ("bootstrap", "replace"))
+                or e["event"] == "retire"]
+
+    def report(self) -> Dict[str, Any]:
+        """Attainment / goodput / chip-seconds summary — deterministic
+        (wall-clock time is on ``self.wall_s``, not in here, so the
+        determinism gate can compare this byte for byte)."""
+        total = self.slo.requests_total
+        c = self.counters
+        return {
+            "trace": self._trace.summary() if self._trace else None,
+            "cost_model": self.cost.as_dict(),
+            "horizon_s": _r(self.now),
+            "requests": {
+                "total": int(c["sim_requests_total"]),
+                "finished": int(c["sim_requests_finished"]),
+                "shed": int(c["sim_requests_shed"]),
+                "failed_over": int(c["sim_requests_failed_over"]),
+                "errored": int(c["sim_requests_errored"]),
+            },
+            "attainment": _r(self.slo.requests_within_slo / total)
+            if total else 0.0,
+            "goodput_tokens": int(self.slo.goodput_tokens),
+            "chip_seconds": _r(c["fleet_chip_seconds"]),
+            "replicas": {
+                "peak": self._peak_replicas,
+                "spawned": int(c["fleet_replicas_spawned"]),
+                "retired": int(c["fleet_replicas_retired"]),
+                "replaced": int(c["fleet_replicas_replaced"]),
+                "final_active": len(self._alive()),
+            },
+            "events_processed": int(c["sim_events_processed"]),
+            "actions": self.actions(),
+            "signal": self.last_signal.as_dict(),
+        }
+
+    def prom_counters(self) -> Dict[str, float]:
+        out = dict(self.counters)
+        out.update(self.slo.prom_counters())
+        out.update(self.monitor.prom_counters())
+        return out
+
+    def prom_gauges(self) -> Dict[str, float]:
+        out: Dict[str, float] = {
+            "sim_replicas_peak": float(self._peak_replicas),
+            "sim_horizon_seconds": _r(self.now),
+            "fleet_replicas_active": float(len(self._alive())),
+            "fleet_replicas_retiring": float(len(self._retiring)),
+        }
+        out.update(self.slo.prom_gauges())
+        out.update(self.monitor.prom_gauges())
+        return out
+
+    def metrics_text(self) -> str:
+        """The same exposition a live fleet's ``/metrics`` renders —
+        ``clt_sim_*`` + ``clt_fleet_*`` + ``clt_slo_*`` +
+        ``clt_capacity_*`` through :func:`prometheus_exposition`."""
+        gauges = {k: v for k, v in self.prom_gauges().items()
+                  if isinstance(v, (int, float)) and math.isfinite(v)}
+        return prometheus_exposition(self.prom_counters(), gauges, {})
+
+    def export_chrome(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Chrome trace with one track per simulated replica plus the
+        fleet-lifecycle track — the PR 10 exporter, loadable in
+        Perfetto. Requires the sim to have been built with a tracer."""
+        if self.tracer is None:
+            raise ValueError("build the sim with tracer=True to export")
+        return self.tracer.export_chrome(path)
+
+
+__all__ = ["CostModel", "FleetSim", "SimReplica",
+           "SIM_COUNTER_NAMES", "SIM_GAUGE_NAMES"]

@@ -43,7 +43,7 @@ import json
 import re
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Union
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
 
 from .core import EventLog
 
@@ -287,6 +287,43 @@ class Tracer:
             if t0 < root.t0:
                 root.t0 = t0
             return self.add(trace_id, name, t0, t1, track=track, **args)
+
+    def ingest(
+        self,
+        span_dicts: Iterable[Dict[str, Any]],
+        track: Optional[str] = None,
+    ) -> int:
+        """Commit FOREIGN spans (``Span.as_dict()`` payloads harvested
+        from another process's tracer over the fleet wire) straight into
+        this flight recorder, bypassing the root-span bookkeeping — the
+        originating tracer already closed them. ``track`` overrides the
+        track label on every ingested span so each source process gets
+        its own named track (``replica<i>``) in one Chrome export.
+        Span ids are REMINTED from this tracer's counter: the sources'
+        counters overlap, and local ordering (t0, span_id) is what the
+        readers sort by. Returns the number of spans ingested; open
+        spans (``t1`` is None) are skipped — they will arrive closed in
+        a later harvest."""
+        n = 0
+        with self._lock:
+            for d in span_dicts:
+                if d.get("t1") is None:
+                    continue
+                span = Span(
+                    trace_id=int(d["trace_id"]),
+                    span_id=next(self._ids),
+                    parent_id=d.get("parent_id"),
+                    name=str(d["name"]),
+                    t0=float(d["t0"]),
+                    t1=float(d["t1"]),
+                    track=str(track if track is not None
+                              else d.get("track", "engine")),
+                    kind=str(d.get("kind", "complete")),
+                    args=dict(d.get("args") or {}),
+                )
+                self._commit(span)
+                n += 1
+        return n
 
     @contextlib.contextmanager
     def span_cm(

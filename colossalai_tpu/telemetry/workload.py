@@ -1,0 +1,271 @@
+"""Workload traces: recorded EventLog replay + synthetic generators.
+
+The record→replay loop starts here. A serving run with an event log
+attached leaves behind one jsonl record per request (``event: request``
+— see ``inference/telemetry.py``), and since those records carry
+``arrival_s`` / ``prompt_tokens`` / ``max_new_tokens`` / ``priority`` /
+``adapter_id`` they are a *self-sufficient workload trace*:
+:meth:`WorkloadTrace.from_event_log` turns a recording (including its
+rotated ``.1`` segment, via :func:`~.core.read_events`) back into the
+arrival schedule that produced it, and :class:`~.sim.FleetSim` replays
+that schedule against simulated replicas driving the real policy code.
+
+Recordings only reach the scale a real run affords, so the same
+container also holds seeded synthetic generators — homogeneous Poisson,
+bursty (Poisson with square-wave rate modulation), and diurnal ramps
+(sinusoidal rate over a day-like period) — for the 1000-replica,
+million-request scales no CPU recording reaches.
+
+Everything is deterministic given the seed: generators draw from a
+private ``random.Random(seed)`` and the inhomogeneous processes use
+thinning against the peak rate, so the same (generator, seed) pair
+produces byte-identical schedules on every run — the foundation of the
+sim's determinism gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .core import read_events
+
+#: fallback values used when a recorded request record predates the
+#: replay-complete fields (PR 20) — each use is tallied per field in
+#: ``WorkloadTrace.defaulted`` so a replay of an old recording says
+#: loudly how much of its schedule was guessed
+TRACE_DEFAULTS = {
+    "prompt_tokens": 32,
+    "max_new_tokens": 64,
+    "priority": 0,
+    "adapter_id": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadRequest:
+    """One request of a replayable workload: WHEN it arrives (seconds
+    from schedule start) and what shape of work it carries. This is the
+    entire interface between a trace and the simulator — nothing about
+    tokens' *values* survives into a trace, only their counts."""
+
+    arrival_s: float
+    prompt_tokens: int
+    max_new_tokens: int
+    priority: int = 0
+    adapter_id: Optional[str] = None
+
+
+class WorkloadTrace:
+    """An ordered arrival schedule of :class:`WorkloadRequest`.
+
+    Construct from a recording (:meth:`from_event_log`), from synthetic
+    generators (:meth:`poisson` / :meth:`bursty` / :meth:`diurnal`), or
+    directly from a request list. Arrivals are normalized to offsets
+    from the earliest arrival and sorted, so a trace is position- and
+    clock-origin-independent: replaying it at mock-clock 0 or wall-clock
+    noon is the same schedule.
+
+    ``defaulted`` counts, per field, how many records fell back to
+    :data:`TRACE_DEFAULTS` because the recording predates the
+    replay-complete fields — a non-empty dict means the replay's
+    request shapes are partly synthetic even though its arrival *times*
+    are real.
+    """
+
+    def __init__(self, requests: Iterable[WorkloadRequest],
+                 defaulted: Optional[Dict[str, int]] = None,
+                 source: str = "inline"):
+        reqs = sorted(requests, key=lambda r: r.arrival_s)
+        if reqs:
+            t0 = reqs[0].arrival_s
+            if t0 != 0.0:
+                reqs = [dataclasses.replace(r, arrival_s=r.arrival_s - t0)
+                        for r in reqs]
+        self.requests: List[WorkloadRequest] = reqs
+        self.defaulted: Dict[str, int] = dict(defaulted or {})
+        self.source = source
+
+    # ------------------------------------------------------------ properties
+    def __len__(self) -> int:
+        return len(self.requests)
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    @property
+    def duration_s(self) -> float:
+        """Span of the arrival schedule (0 for empty/single traces)."""
+        return self.requests[-1].arrival_s if self.requests else 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        n = len(self.requests)
+        return {
+            "source": self.source,
+            "n_requests": n,
+            "duration_s": round(self.duration_s, 6),
+            "arrival_rate": round(n / self.duration_s, 6)
+            if self.duration_s > 0 else 0.0,
+            "mean_prompt_tokens": round(
+                sum(r.prompt_tokens for r in self.requests) / n, 3)
+            if n else 0.0,
+            "mean_max_new_tokens": round(
+                sum(r.max_new_tokens for r in self.requests) / n, 3)
+            if n else 0.0,
+            "n_adapters": len({r.adapter_id for r in self.requests
+                               if r.adapter_id is not None}),
+            "defaulted": dict(self.defaulted),
+        }
+
+    # ---------------------------------------------------------- from records
+    @classmethod
+    def from_records(cls, records: Iterable[Dict[str, Any]],
+                     source: str = "records") -> "WorkloadTrace":
+        """Build a trace from already-loaded jsonl records. Non-request
+        events (spans, train steps) are skipped; requests that were shed
+        are REPLAYED — the recording says they arrived, and whether the
+        simulated fleet sheds them too is exactly the question a policy
+        replay asks. Records missing a replay field fall back to
+        :data:`TRACE_DEFAULTS` with a per-field tally."""
+        reqs: List[WorkloadRequest] = []
+        defaulted: Dict[str, int] = {}
+        seq = 0  # arrival-less records keep file order, 1ms apart
+        for rec in records:
+            if rec.get("event") != "request":
+                continue
+            arrival = rec.get("arrival_s")
+            if arrival is None:
+                defaulted["arrival_s"] = defaulted.get("arrival_s", 0) + 1
+                arrival = seq * 1e-3
+            seq += 1
+
+            def field(key, rec=rec, defaulted=defaulted):
+                v = rec.get(key)
+                if v is None and TRACE_DEFAULTS[key] is not None:
+                    defaulted[key] = defaulted.get(key, 0) + 1
+                    v = TRACE_DEFAULTS[key]
+                return v
+
+            reqs.append(WorkloadRequest(
+                arrival_s=float(arrival),
+                prompt_tokens=int(field("prompt_tokens")),
+                max_new_tokens=int(field("max_new_tokens")),
+                priority=int(field("priority")),
+                adapter_id=field("adapter_id"),
+            ))
+        return cls(reqs, defaulted=defaulted, source=source)
+
+    @classmethod
+    def from_event_log(cls, path: str) -> "WorkloadTrace":
+        """Load a recorded EventLog (live file + rotated ``.1`` segment,
+        stitched in order by :func:`~.core.read_events`) into a trace."""
+        return cls.from_records(read_events(path), source=path)
+
+    # ------------------------------------------------------------ generators
+    @staticmethod
+    def _draw_shape(rng: random.Random,
+                    prompt_tokens: Tuple[int, int],
+                    max_new_tokens: Tuple[int, int],
+                    n_adapters: int, priorities: Tuple[int, ...]):
+        return dict(
+            prompt_tokens=rng.randint(*prompt_tokens),
+            max_new_tokens=rng.randint(*max_new_tokens),
+            priority=rng.choice(priorities) if len(priorities) > 1
+            else priorities[0],
+            adapter_id=(f"tenant{rng.randrange(n_adapters)}"
+                        if n_adapters > 0 else None),
+        )
+
+    @classmethod
+    def poisson(cls, rate: float, duration_s: float, seed: int = 0,
+                prompt_tokens: Tuple[int, int] = (16, 128),
+                max_new_tokens: Tuple[int, int] = (16, 128),
+                n_adapters: int = 0,
+                priorities: Tuple[int, ...] = (0,)) -> "WorkloadTrace":
+        """Homogeneous Poisson arrivals at ``rate`` req/s for
+        ``duration_s`` seconds (exponential inter-arrival gaps)."""
+        if rate <= 0:
+            raise ValueError(f"rate={rate} must be > 0")
+        rng = random.Random(seed)
+        reqs, t = [], 0.0
+        while True:
+            t += rng.expovariate(rate)
+            if t >= duration_s:
+                break
+            reqs.append(WorkloadRequest(arrival_s=t, **cls._draw_shape(
+                rng, prompt_tokens, max_new_tokens, n_adapters, priorities)))
+        return cls(reqs, source=f"poisson(rate={rate})")
+
+    @classmethod
+    def _inhomogeneous(cls, rate_fn, peak_rate: float, duration_s: float,
+                       seed: int, prompt_tokens, max_new_tokens,
+                       n_adapters, priorities, source) -> "WorkloadTrace":
+        """Inhomogeneous Poisson via thinning: draw candidate arrivals at
+        the peak rate, keep each with probability rate(t)/peak."""
+        rng = random.Random(seed)
+        reqs, t = [], 0.0
+        while True:
+            t += rng.expovariate(peak_rate)
+            if t >= duration_s:
+                break
+            if rng.random() * peak_rate < rate_fn(t):
+                reqs.append(WorkloadRequest(
+                    arrival_s=t, **cls._draw_shape(
+                        rng, prompt_tokens, max_new_tokens, n_adapters,
+                        priorities)))
+        return cls(reqs, source=source)
+
+    @classmethod
+    def bursty(cls, base_rate: float, burst_rate: float, duration_s: float,
+               period_s: float = 60.0, duty: float = 0.2, seed: int = 0,
+               prompt_tokens: Tuple[int, int] = (16, 128),
+               max_new_tokens: Tuple[int, int] = (16, 128),
+               n_adapters: int = 0,
+               priorities: Tuple[int, ...] = (0,)) -> "WorkloadTrace":
+        """Square-wave bursts: ``burst_rate`` for the first ``duty``
+        fraction of every ``period_s`` window, ``base_rate`` otherwise —
+        the offered-load shape that trips autoscaler hysteresis."""
+        if not (0.0 < duty < 1.0):
+            raise ValueError(f"duty={duty} must be in (0, 1)")
+        if burst_rate < base_rate:
+            raise ValueError("burst_rate must be >= base_rate")
+
+        def rate_fn(t):
+            return burst_rate if (t % period_s) < duty * period_s \
+                else base_rate
+
+        return cls._inhomogeneous(
+            rate_fn, burst_rate, duration_s, seed, prompt_tokens,
+            max_new_tokens, n_adapters, priorities,
+            source=f"bursty(base={base_rate},burst={burst_rate})")
+
+    @classmethod
+    def diurnal(cls, peak_rate: float, duration_s: float,
+                period_s: float = 86400.0, floor: float = 0.1,
+                seed: int = 0,
+                prompt_tokens: Tuple[int, int] = (16, 128),
+                max_new_tokens: Tuple[int, int] = (16, 128),
+                n_adapters: int = 0,
+                priorities: Tuple[int, ...] = (0,)) -> "WorkloadTrace":
+        """Diurnal ramp: rate rides a raised sinusoid from
+        ``floor * peak_rate`` (trough) up to ``peak_rate`` (peak) over
+        ``period_s`` — a compressed day. The trough-ramp-peak-ramp shape
+        is what capacity planning cares about: a fleet pinned for the
+        peak idles all night, one pinned for the trough dies at noon."""
+        if not (0.0 <= floor <= 1.0):
+            raise ValueError(f"floor={floor} must be in [0, 1]")
+
+        def rate_fn(t):
+            # trough at t=0, peak at t=period/2
+            phase = 0.5 - 0.5 * math.cos(2.0 * math.pi * t / period_s)
+            return peak_rate * (floor + (1.0 - floor) * phase)
+
+        return cls._inhomogeneous(
+            rate_fn, peak_rate, duration_s, seed, prompt_tokens,
+            max_new_tokens, n_adapters, priorities,
+            source=f"diurnal(peak={peak_rate},period={period_s})")
+
+
+__all__ = ["WorkloadRequest", "WorkloadTrace", "TRACE_DEFAULTS"]

@@ -1,0 +1,363 @@
+"""FleetSim (PR 20): record→replay observability.
+
+The two load-bearing contracts, plus the seams around them:
+
+- **determinism gate** — the same (trace, seed) produces a
+  byte-identical report, timeline, and metric exposition on every run,
+  in both internal-placement and real-Router modes. Everything the sim
+  reports rides on this: a replay that flaps run-to-run can't be used
+  to compare policy arms.
+- **sim-vs-real cross-validation** — the deterministic overload A/B
+  from ``test_overload.test_controlled_goodput_rate_beats_uncontrolled``
+  (2 requests/tick into a 2-slot engine, ~3× the service rate, fake
+  clock) is re-run through the simulator with a cost model matching the
+  fake clock's timing, and the sim reproduces the test's conclusions:
+  control ON sheds, control OFF doesn't, shedding never costs goodput
+  tokens, and the controlled goodput RATE is at least the uncontrolled
+  one.
+
+The satellites: WorkloadTrace round-trips a rotated EventLog recording
+(including per-field default tallies for pre-PR-20 records), CostModel
+calibrates from recorded request records / engine histograms / bench
+payloads, the FaultInjector and kill_at death seams drive failover and
+min-fleet repair, and the Chrome export carries per-simulated-replica
+tracks.
+"""
+
+import json
+
+import pytest
+
+from colossalai_tpu.telemetry import (
+    EventLog,
+    SIM_COUNTER_NAMES,
+    SIM_GAUGE_NAMES,
+    SLOTracker,
+    CostModel,
+    FleetSim,
+    WorkloadRequest,
+    WorkloadTrace,
+    read_events,
+)
+from colossalai_tpu.telemetry.core import Histogram
+
+
+def _policy(**kw):
+    from colossalai_tpu.inference.fleet import AutoscalePolicy
+
+    return AutoscalePolicy(**kw)
+
+
+def _snapshot(sim, report):
+    """Everything the determinism gate compares, as one canonical blob."""
+    return json.dumps({
+        "report": report,
+        "timeline": sim.timeline,
+        "counters": sim.counters,
+        "metrics": sim.metrics_text(),
+    }, sort_keys=True)
+
+
+# ------------------------------------------------------------ workload traces
+def test_trace_generators_deterministic_and_normalized():
+    a = WorkloadTrace.poisson(rate=20.0, duration_s=30.0, seed=7)
+    b = WorkloadTrace.poisson(rate=20.0, duration_s=30.0, seed=7)
+    assert [r.arrival_s for r in a] == [r.arrival_s for r in b]
+    assert a.requests == b.requests
+    assert WorkloadTrace.poisson(rate=20.0, duration_s=30.0,
+                                 seed=8).requests != a.requests
+    # arrivals are sorted and normalized to start at 0
+    t = WorkloadTrace([WorkloadRequest(5.0, 8, 8),
+                       WorkloadRequest(3.0, 8, 8)])
+    assert [r.arrival_s for r in t] == [0.0, 2.0]
+    for ctor in (
+        lambda s: WorkloadTrace.bursty(2.0, 40.0, 20.0, period_s=5.0,
+                                       duty=0.3, seed=s),
+        lambda s: WorkloadTrace.diurnal(30.0, 60.0, period_s=60.0,
+                                        floor=0.1, seed=s),
+    ):
+        x, y = ctor(3), ctor(3)
+        assert x.requests == y.requests and len(x) > 0
+    with pytest.raises(ValueError):
+        WorkloadTrace.poisson(rate=0.0, duration_s=1.0)
+    with pytest.raises(ValueError):
+        WorkloadTrace.bursty(5.0, 1.0, 10.0)  # burst < base
+    with pytest.raises(ValueError):
+        WorkloadTrace.diurnal(5.0, 10.0, floor=1.5)
+
+
+def test_trace_from_rotated_event_log_with_default_tally(tmp_path):
+    """A recording that rotated mid-run replays in order across the
+    ``.1`` + live segments, and records predating the PR 20 replay
+    fields fall back to TRACE_DEFAULTS with a per-field tally."""
+    path = str(tmp_path / "events.jsonl")
+    recs = []
+    for i in range(8):
+        rec = {"event": "request", "request_id": i,
+               "arrival_s": 10.0 + i, "prompt_tokens": 4 + i,
+               "max_new_tokens": 3, "priority": 0, "adapter_id": None}
+        if i == 5:  # a pre-PR-20 record: no replay fields at all
+            rec = {"event": "request", "request_id": i, "arrival_s": 15.0}
+        recs.append(rec)
+    recs.append({"event": "span", "name": "noise"})  # skipped by replay
+    # cap sized so the log rotates exactly once, after the 6th record
+    cap = sum(len(json.dumps(r)) + 1 for r in recs[:6])
+    log = EventLog(path, max_bytes=cap)
+    for rec in recs:
+        log.emit(rec)
+    log.close()
+    assert EventLog.read(path + ".1")  # rotation actually fired
+    stitched = read_events(path)
+    assert [r["request_id"] for r in stitched
+            if r.get("event") == "request"] == list(range(8))
+
+    trace = WorkloadTrace.from_event_log(path)
+    assert len(trace) == 8
+    assert trace.requests[0].arrival_s == 0.0  # normalized from 10.0
+    assert trace.defaulted == {"prompt_tokens": 1, "max_new_tokens": 1,
+                               "priority": 1}
+    assert trace.requests[5].prompt_tokens == 32  # TRACE_DEFAULTS
+    assert trace.summary()["defaulted"]["prompt_tokens"] == 1
+    # the tally surfaces as a sim counter so a replay of an old
+    # recording says loudly how much of its schedule was guessed
+    sim = FleetSim(CostModel(megastep_s=0.01, slots=4),
+                   autoscale=_policy(min_replicas=1, max_replicas=1))
+    sim.run(trace)
+    assert sim.counters["sim_workload_defaults_total"] == 3
+
+
+# ------------------------------------------------------------ cost model
+def test_cost_model_calibration():
+    # from_events: ITL mean -> megastep; ttft-vs-prompt least squares
+    recs = [{"event": "request", "itl_mean_s": 0.01,
+             "ttft_s": 0.1 + 0.001 * p, "prompt_tokens": p}
+            for p in (10, 20, 30, 40)]
+    cm = CostModel.from_events(recs)
+    assert cm.megastep_s == pytest.approx(0.01)
+    assert cm.ttft_per_prompt_token_s == pytest.approx(0.001, rel=1e-6)
+    assert cm.ttft_base_s == pytest.approx(0.1, rel=1e-6)
+    assert cm.prefill_s(100) == pytest.approx(0.2, rel=1e-5)
+    # a negative fitted slope clamps to 0 (prefill can't get cheaper
+    # with more prompt tokens; noise at tiny N produces such fits)
+    cm2 = CostModel.from_events(
+        [{"event": "request", "ttft_s": 0.2, "prompt_tokens": 10},
+         {"event": "request", "ttft_s": 0.1, "prompt_tokens": 20}])
+    assert cm2.ttft_per_prompt_token_s == 0.0
+
+    h = Histogram.log_spaced(1e-3, 10.0, 32)
+    for v in (0.02, 0.02, 0.02):
+        h.observe(v)
+    cm3 = CostModel.from_histograms({"megastep_seconds": h}, slots=2)
+    assert cm3.slots == 2 and cm3.megastep_s > 0
+
+    cm4 = CostModel.from_bench({"spawn_s": 2.5, "peak_req_per_s": 4.0,
+                                "new_tokens": 10})
+    assert cm4.slots == 1 and cm4.spawn_s == 2.5
+    assert cm4.megastep_s == pytest.approx(1.0 / 4.0 / 10)
+
+    with pytest.raises(ValueError):
+        CostModel(megastep_s=0.0)
+    with pytest.raises(ValueError):
+        CostModel(slots=0)
+
+
+# ------------------------------------------------------- determinism gate
+@pytest.mark.parametrize("use_router", [False, True])
+def test_determinism_gate(use_router):
+    """Same trace + same seed ⇒ byte-identical report, timeline,
+    counters, and metric exposition — with the autoscaler scaling, the
+    shed gate armed, a mid-run replica kill, and (parametrized) the
+    real Router doing placement and failover."""
+
+    def run():
+        from colossalai_tpu.inference.overload import OverloadConfig
+
+        trace = WorkloadTrace.bursty(
+            base_rate=5.0, burst_rate=120.0, duration_s=40.0,
+            period_s=10.0, duty=0.3, seed=11,
+            prompt_tokens=(8, 32), max_new_tokens=(4, 16),
+            priorities=(0, 0, 5))
+        sim = FleetSim(
+            CostModel(megastep_s=0.02, ttft_base_s=0.004, spawn_s=0.5,
+                      slots=4),
+            autoscale=_policy(min_replicas=2, max_replicas=6,
+                              cooldown_s=1.0, up_consecutive=2,
+                              down_consecutive=8),
+            slo_targets={"ttft_p99": 1.0}, slo_window_s=30.0,
+            overload=OverloadConfig(shed_queue_depth=8),
+            tick_s=0.5, use_router=use_router,
+            kill_at=[(12.0, 0)])
+        report = sim.run(trace)
+        return _snapshot(sim, report), report
+
+    (snap1, rep1), (snap2, rep2), (snap3, _) = run(), run(), run()
+    assert snap1 == snap2 == snap3
+    # the scenario actually exercised the machinery it claims to pin
+    assert rep1["requests"]["total"] > 100
+    assert rep1["requests"]["shed"] > 0
+    assert rep1["replicas"]["replaced"] == 1
+    assert rep1["replicas"]["peak"] > 2
+    assert len(rep1["actions"]) > 0
+    assert rep1["requests"]["finished"] + rep1["requests"]["shed"] \
+        + rep1["requests"]["errored"] == rep1["requests"]["total"]
+
+
+def test_seed_and_trace_changes_change_the_run():
+    """The inverse control for the gate: a different arrival seed is a
+    different simulation (otherwise the gate would pass vacuously)."""
+
+    def run(seed):
+        trace = WorkloadTrace.poisson(rate=40.0, duration_s=20.0,
+                                      seed=seed, max_new_tokens=(4, 8))
+        sim = FleetSim(CostModel(megastep_s=0.02, slots=4),
+                       autoscale=_policy(min_replicas=1, max_replicas=4,
+                                         cooldown_s=1.0))
+        return _snapshot(sim, sim.run(trace))
+
+    assert run(1) == run(1)
+    assert run(1) != run(2)
+
+
+# ---------------------------------------------- sim-vs-real cross-validation
+def test_sim_reproduces_overload_ab_conclusions():
+    """The simulator re-runs ``test_overload``'s deterministic A/B (the
+    fake-clock goodput-rate test) and reaches the same conclusions from
+    the same arrival schedule. Timing mirror: the real test advances its
+    clock 1 s per scheduler tick and decodes 1 token/tick with 2 slots,
+    so megastep_s=1.0, slots=2; 2 requests arrive per tick (~3× the
+    service rate); max_new_tokens=3; targets={'ttft_p99': 2.5}."""
+    n_req = 30
+    reqs = [WorkloadRequest(arrival_s=float(i // 2), prompt_tokens=4,
+                            max_new_tokens=3) for i in range(n_req)]
+
+    def run(overload):
+        from colossalai_tpu.inference.overload import OverloadConfig
+
+        sim = FleetSim(
+            CostModel(megastep_s=1.0, ttft_base_s=0.0, slots=2),
+            autoscale=_policy(min_replicas=1, max_replicas=1),
+            slo=SLOTracker(targets={"ttft_p99": 2.5}, window_s=600.0),
+            overload=OverloadConfig(shed_queue_depth=2) if overload
+            else None,
+            tick_s=1.0)
+        rep = sim.run(WorkloadTrace(reqs))
+        return sim, rep
+
+    sim_u, rep_u = run(False)
+    sim_c, rep_c = run(True)
+    # every arrival reaches a terminal state in both arms
+    for rep in (rep_u, rep_c):
+        assert rep["requests"]["total"] == n_req
+        assert (rep["requests"]["finished"] + rep["requests"]["shed"]
+                == n_req)
+    # control OFF never sheds; control ON does — same as the real engine
+    assert rep_u["requests"]["shed"] == 0
+    assert rep_c["requests"]["shed"] > 0
+    # shedding never costs goodput tokens, and the drain is strictly
+    # shorter, so the controlled goodput RATE is at least uncontrolled
+    assert sim_c.slo.goodput_tokens >= sim_u.slo.goodput_tokens > 0
+    assert rep_c["horizon_s"] < rep_u["horizon_s"]
+    rate_u = sim_u.slo.goodput_tokens / rep_u["horizon_s"]
+    rate_c = sim_c.slo.goodput_tokens / rep_c["horizon_s"]
+    assert rate_c >= rate_u
+    # attainment orders the same way the breach math does
+    assert rep_c["attainment"] <= 1.0 and rep_u["attainment"] < 1.0
+
+
+# ------------------------------------------------------------- death seams
+def test_kill_at_failover_and_min_repair():
+    """A scheduled kill evacuates in-flight work to survivors (counted
+    as failovers), replaces the seat to hold ``min_replicas``, and the
+    evacuated requests still finish."""
+    reqs = [WorkloadRequest(arrival_s=0.1 * i, prompt_tokens=8,
+                            max_new_tokens=20) for i in range(40)]
+    sim = FleetSim(
+        CostModel(megastep_s=0.05, spawn_s=0.5, slots=4),
+        autoscale=_policy(min_replicas=2, max_replicas=2),
+        kill_at=[(1.0, 0)], tick_s=0.25)
+    rep = sim.run(WorkloadTrace(reqs))
+    assert rep["replicas"]["replaced"] == 1
+    assert rep["requests"]["failed_over"] > 0
+    assert rep["requests"]["finished"] == 40
+    assert rep["requests"]["errored"] == 0
+    events = [e["event"] for e in sim.timeline]
+    assert "replica_dead" in events
+    # the repair spawn is lifecycle, not a policy decision
+    assert all(a["event"] != "spawn" or a["reason"] == "signal"
+               for a in rep["actions"])
+
+
+def test_fault_injector_replica_step_seam():
+    """The real FaultInjector arms the same ``replica_step`` seam the
+    chaos tests use; the sim consults it at service start, so an armed
+    fault kills the replica mid-sim and the fleet repairs itself."""
+    from colossalai_tpu.inference.fault import FaultInjector
+
+    fault = FaultInjector().arm("replica_step", "raise", at=5)
+    reqs = [WorkloadRequest(arrival_s=0.05 * i, prompt_tokens=4,
+                            max_new_tokens=8) for i in range(20)]
+    sim = FleetSim(CostModel(megastep_s=0.02, spawn_s=0.3, slots=2),
+                   autoscale=_policy(min_replicas=2, max_replicas=2),
+                   fault=fault, tick_s=0.25)
+    rep = sim.run(WorkloadTrace(reqs))
+    assert rep["replicas"]["replaced"] == 1
+    assert rep["requests"]["finished"] == 20
+
+
+# -------------------------------------------------- observability surface
+def test_metrics_and_chrome_export(tmp_path):
+    """The sim emits the live fleet's exposition families plus its own
+    ``clt_sim_*``, and the Chrome export carries one track per
+    simulated replica plus the fleet track."""
+    reqs = [WorkloadRequest(arrival_s=0.02 * i, prompt_tokens=8,
+                            max_new_tokens=6) for i in range(50)]
+    sim = FleetSim(CostModel(megastep_s=0.01, spawn_s=0.2, slots=2),
+                   autoscale=_policy(min_replicas=2, max_replicas=4,
+                                     cooldown_s=0.5),
+                   tracer=True, tick_s=0.25)
+    sim.run(WorkloadTrace(reqs))
+    text = sim.metrics_text()
+    for name in SIM_COUNTER_NAMES + SIM_GAUGE_NAMES:
+        assert f"clt_{name}" in text
+    for family in ("clt_fleet_chip_seconds", "clt_slo_requests_total",
+                   "clt_capacity_busy_fraction"):
+        assert family in text
+
+    out = str(tmp_path / "sim_trace.json")
+    payload = sim.export_chrome(out)
+    tracks = {e["args"]["name"] for e in payload["traceEvents"]
+              if e.get("name") == "thread_name"}
+    assert "fleet" in tracks
+    assert any(t.startswith("replica") for t in tracks)
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert {"queue", "prefill", "decode_megastep"} <= names
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
+
+    # tracer-less sims refuse to export instead of emitting nothing
+    bare = FleetSim(CostModel(slots=2),
+                    autoscale=_policy(min_replicas=1, max_replicas=1))
+    with pytest.raises(ValueError, match="tracer"):
+        bare.export_chrome()
+
+
+def test_capacity_mode_per_replica_and_validation():
+    reqs = [WorkloadRequest(arrival_s=0.05 * i, prompt_tokens=8,
+                            max_new_tokens=8) for i in range(30)]
+    sim = FleetSim(CostModel(megastep_s=0.02, slots=2),
+                   autoscale=_policy(min_replicas=2, max_replicas=3,
+                                     cooldown_s=0.5),
+                   capacity_mode="per_replica", tick_s=0.25)
+    rep = sim.run(WorkloadTrace(reqs))
+    assert rep["requests"]["finished"] == 30
+    assert rep["signal"]["action"] in ("hold", "scale_up", "scale_down")
+
+    with pytest.raises(ValueError, match="capacity_mode"):
+        FleetSim(capacity_mode="nope")
+    with pytest.raises(ValueError, match="tick_s"):
+        FleetSim(tick_s=0.0)
+    sim2 = FleetSim(CostModel(slots=1),
+                    autoscale=_policy(min_replicas=1, max_replicas=1))
+    sim2.run(WorkloadTrace([]))
+    with pytest.raises(RuntimeError, match="single-shot"):
+        sim2.run(WorkloadTrace([]))

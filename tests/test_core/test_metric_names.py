@@ -252,6 +252,45 @@ def test_fleet_names_match_grammar_and_collide_with_nothing():
     assert not names & _fault_names()
 
 
+def _sim_names():
+    """The ``clt_sim_*`` catalog a FleetSim's ``metrics_text()`` adds —
+    counter and gauge names are static module constants, so no
+    simulation ever runs here."""
+    from colossalai_tpu.telemetry.sim import SIM_COUNTER_NAMES, SIM_GAUGE_NAMES
+
+    return _family_names(prometheus_exposition(
+        {n: 0 for n in SIM_COUNTER_NAMES},
+        {n: 0 for n in SIM_GAUGE_NAMES}, {}, prefix="clt"))
+
+
+def test_sim_names_match_grammar_and_collide_with_nothing():
+    names = _sim_names()
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+        assert name.startswith("clt_sim_"), name
+    assert {"clt_sim_requests_total", "clt_sim_requests_finished",
+            "clt_sim_requests_shed", "clt_sim_requests_failed_over",
+            "clt_sim_requests_errored", "clt_sim_events_processed",
+            "clt_sim_workload_defaults_total", "clt_sim_replicas_peak",
+            "clt_sim_horizon_seconds"} <= names
+    assert not names & _serving_names()
+    assert not names & _training_names()
+    assert not names & _slo_names()
+    assert not names & _capacity_names()
+    assert not names & _fault_names()
+    assert not names & _fleet_names()
+    # a sim's full exposition reuses the LIVE fleet/slo/capacity family
+    # names verbatim — that reuse is on purpose (same dashboards), and
+    # the clt_sim_* prefix is what marks the run as simulated
+    from colossalai_tpu.telemetry import CostModel, FleetSim
+
+    sim = FleetSim(CostModel(slots=1))
+    rendered = _family_names(sim.metrics_text())
+    assert _sim_names() <= rendered
+    assert {"clt_fleet_chip_seconds", "clt_slo_requests_total",
+            "clt_capacity_busy_fraction"} <= rendered
+
+
 def test_every_histogram_family_exports_dropped_total():
     """``Histogram.dropped`` (non-finite refusals) renders as a
     ``<family>_dropped_total`` counter family of its own — for every

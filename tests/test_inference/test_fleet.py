@@ -311,3 +311,66 @@ def test_control_fault_kills_replica_and_fleet_replaces_it(ref_out):
                [ref_out, ref_out]
     finally:
         fc.close()
+
+
+# ==================================================== cross-process spans
+def test_trace_harvest_and_postmortem(tmp_path):
+    """The ``trace`` control op drains child tracer buffers into the
+    controller's tracer on per-seat ``replica<i>`` tracks (PR 20):
+    harvest is incremental (per-seat high-water marks — a re-harvest
+    with nothing new moves zero spans), tracer-less children are probed
+    once then skipped, and a dead replica's last harvested window is
+    dumped as a Chrome-trace post-mortem at reap time."""
+    from colossalai_tpu.telemetry import Tracer
+
+    spec = ReplicaSpec(warmup_new_tokens=2,
+                       kwargs={"tracer": True, "max_batch_size": 2})
+    fault = FaultInjector()
+    fc = FleetController(spec, min_replicas=2, max_replicas=2,
+                         backend="thread", fault=fault, fail_threshold=2,
+                         tracer=Tracer(max_spans=4096),
+                         postmortem_dir=str(tmp_path))
+    try:
+        fc.generate([list(PROMPT), list(PROMPT)], GEN)
+        moved = fc.harvest_traces()
+        assert moved > 0
+        spans = fc.tracer.spans()
+        tracks = {s.track for s in spans}
+        assert {"replica0", "replica1"} <= tracks
+        names = {s.name for s in spans if s.track.startswith("replica")}
+        assert {"request", "prefill", "decode_megastep"} <= names
+        # incremental: nothing new since the last harvest moves nothing
+        assert fc.harvest_traces() == 0
+        assert set(fc._trace_marks) == {0, 1}
+
+        # kill seat 0: the reap dumps its last harvested window
+        fault.arm("fleet_control", "raise", at=1, times=2, key=0)
+        deadline = time.monotonic() + 120
+        while (fc.counters["fleet_replicas_replaced"] < 1
+               or fc.n_active < 2) and time.monotonic() < deadline:
+            fc.idle_tick()
+            time.sleep(0.01)
+        assert fc.counters["fleet_replicas_replaced"] == 1
+        dump = tmp_path / "replica0.postmortem.json"
+        assert dump.exists()
+        events = json.loads(dump.read_text())["traceEvents"]
+        assert any(e.get("ph") == "X" for e in events)
+        # the dead seat's harvest state was dropped with the corpse
+        assert 0 not in fc._trace_marks or fc._trace_marks[0] == 0
+    finally:
+        fc.close()
+
+
+def test_trace_harvest_skips_tracerless_children():
+    """A child built without a tracer answers the probe with
+    ``tracer: false`` and is never asked again."""
+    from colossalai_tpu.telemetry import Tracer
+
+    fc = FleetController(SPEC, min_replicas=1, max_replicas=1,
+                         backend="thread", tracer=Tracer())
+    try:
+        fc.generate([list(PROMPT)], GEN)
+        assert fc.harvest_traces() == 0
+        assert fc._trace_absent == {0}
+    finally:
+        fc.close()

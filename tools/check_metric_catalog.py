@@ -6,7 +6,8 @@ the documentation describes a dashboard that no longer exists. This tool
 renders every Prometheus catalog the code can emit (serving ``clt_*``,
 SLO ``clt_slo_*``, router ``clt_router_*``, training ``clt_train_*``,
 capacity ``clt_capacity_*``, fault ``clt_fault_*``, fleet
-``clt_fleet_*``) the same way the HTTP endpoints render them, parses the
+``clt_fleet_*``, simulator ``clt_sim_*``) the same way the HTTP
+endpoints render them, parses the
 metric names and span table out of the docs, and fails on any mismatch:
 
 - every ``clt_*`` family the docs mention must be emitted by some
@@ -206,6 +207,21 @@ def capacity_families():
     return names
 
 
+def sim_families():
+    """Every ``clt_sim_*`` family a FleetSim emits. Like the fleet
+    family, the names are static module constants — render them through
+    the exposition path ``FleetSim.metrics_text()`` uses, without
+    running a simulation."""
+    from colossalai_tpu.telemetry import prometheus_exposition
+    from colossalai_tpu.telemetry.sim import SIM_COUNTER_NAMES, SIM_GAUGE_NAMES
+
+    names = _family_names(prometheus_exposition(
+        {n: 0 for n in SIM_COUNTER_NAMES},
+        {n: 0 for n in SIM_GAUGE_NAMES}, {}, prefix="clt"))
+    assert all(n.startswith("clt_sim_") for n in names), names
+    return names
+
+
 def run_checks(doc_text=None):
     """Returns a list of human-readable failures (empty == clean)."""
     from colossalai_tpu.telemetry import METRIC_NAME_RE, SPAN_CATALOG
@@ -221,6 +237,7 @@ def run_checks(doc_text=None):
         "capacity": capacity_families(),
         "fault": fault_families(),
         "fleet": fleet_families(),
+        "sim": sim_families(),
     }
     known = set().union(*catalogs.values())
 
@@ -286,6 +303,14 @@ def run_checks(doc_text=None):
         failures.append(
             f"code emits {name} but docs/observability.md does not "
             "document it (extend the clt_fleet_* tables)")
+
+    # the sim family is strict in both directions too: a replay report
+    # is read side by side with live dashboards, so every clt_sim_*
+    # family must carry a doc row distinguishing it from the live ones
+    for name in sorted(catalogs["sim"] - documented):
+        failures.append(
+            f"code emits {name} but docs/observability.md does not "
+            "document it (extend the clt_sim_* table)")
 
     doc_spans = doc_span_names(text)
     code_spans = set(SPAN_CATALOG)
